@@ -1,0 +1,30 @@
+"""apex_tpu — a TPU-native mixed-precision / fused-kernel / distributed training
+framework with the capabilities of NVIDIA Apex (reference: /root/reference).
+
+Built from scratch for TPU: JAX / XLA / Pallas / pjit. The reference's CUDA-era
+mechanisms map onto TPU idioms:
+
+  - ``apex.amp`` monkey-patched eager casts  -> trace-time dtype policy + function
+    interposition on the jax.numpy namespace (O1/O4) and policy-driven parameter
+    casting with fp32 master weights (O2/O5).
+  - ``csrc/multi_tensor_*`` fused CUDA kernels -> Pallas TPU kernels over flat
+    per-dtype parameter buckets (with pure-jnp fallbacks on CPU).
+  - ``apex.parallel.DistributedDataParallel`` NCCL flat-bucket allreduce ->
+    ``jax.lax.psum`` over a named mesh axis inside ``shard_map``/``pjit``; overlap
+    is delegated to XLA's latency-hiding scheduler.
+  - CUDA IPC / process groups -> mesh axis_index_groups on XLA collectives.
+
+Reference layer map: see SURVEY.md at the repo root; top-level wiring mirrors
+``apex/__init__.py:1-24`` of the reference.
+"""
+
+__version__ = "0.1.0"
+
+from apex_tpu import ops
+from apex_tpu import amp
+from apex_tpu import optimizers
+from apex_tpu import parallel
+from apex_tpu import normalization
+from apex_tpu import contrib
+from apex_tpu import fp16_utils
+from apex_tpu import testing

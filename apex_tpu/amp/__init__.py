@@ -1,0 +1,1 @@
+"""apex_tpu.amp (placeholder — populated incrementally)."""

@@ -1,0 +1,1 @@
+"""apex_tpu.contrib (placeholder — populated incrementally)."""

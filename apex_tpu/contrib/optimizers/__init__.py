@@ -1,0 +1,1 @@
+"""apex_tpu.contrib.optimizers (placeholder — populated incrementally)."""

@@ -1,0 +1,1 @@
+"""apex_tpu.fp16_utils (placeholder — populated incrementally)."""

@@ -1,0 +1,1 @@
+"""apex_tpu.mlp (placeholder — populated incrementally)."""

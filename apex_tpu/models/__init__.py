@@ -1,0 +1,1 @@
+"""apex_tpu.models (placeholder — populated incrementally)."""

@@ -1,0 +1,1 @@
+"""apex_tpu.normalization (placeholder — populated incrementally)."""

@@ -1,0 +1,23 @@
+"""apex_tpu.ops — fused kernels and bucket plumbing (reference L0/L1 layers:
+csrc/ + apex/multi_tensor_apply/)."""
+
+from apex_tpu.ops.buckets import (
+    BucketSpec,
+    TreeBucketSpec,
+    flatten_tensors,
+    unflatten_tensors,
+    group_by_dtype,
+    tree_flatten_buckets,
+    tree_unflatten_buckets,
+)
+from apex_tpu.ops.multi_tensor import (
+    multi_tensor_scale,
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_adam,
+    multi_tensor_sgd,
+    multi_tensor_adagrad,
+    multi_tensor_novograd,
+    multi_tensor_lamb,
+    use_pallas,
+)

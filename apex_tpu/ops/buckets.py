@@ -1,0 +1,130 @@
+"""Flat bucket management: the TPU-native analog of ``apex_C.flatten/unflatten``
+(reference: csrc/flatten_unflatten.cpp:5-18) and of the dtype bucketing used by
+the reference DDP (apex/parallel/distributed.py:51-58) and fused optimizers
+(apex/optimizers/fused_adam.py:116-144).
+
+A *bucket* is a single contiguous 1-D array holding many tensors of the same
+dtype. Fused multi-tensor ops (Pallas kernels) run over buckets so that a whole
+model's elementwise update is a handful of kernel launches instead of one per
+parameter — the same motivation as csrc/multi_tensor_apply.cuh:12.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketSpec:
+    """Static (trace-time) description of how tensors pack into one flat bucket."""
+
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtype: Any
+    offsets: Tuple[int, ...]  # start offset of each tensor in the flat bucket
+    sizes: Tuple[int, ...]
+    total: int
+
+    @property
+    def num_tensors(self) -> int:
+        return len(self.shapes)
+
+
+def flatten_tensors(tensors: Sequence[jax.Array]) -> Tuple[jax.Array, BucketSpec]:
+    """Pack a list of same-dtype arrays into one contiguous 1-D bucket.
+
+    Analog of ``apex_C.flatten`` (csrc/flatten_unflatten.cpp:5-10).
+    """
+    if not tensors:
+        raise ValueError("flatten_tensors: empty tensor list")
+    dtype = tensors[0].dtype
+    for t in tensors:
+        if t.dtype != dtype:
+            raise ValueError(
+                f"flatten_tensors: mixed dtypes {t.dtype} vs {dtype}; "
+                "group by dtype first (see group_by_dtype)"
+            )
+    shapes = tuple(tuple(t.shape) for t in tensors)
+    sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
+    offsets = tuple(int(x) for x in np.cumsum((0,) + sizes[:-1]))
+    flat = jnp.concatenate([t.reshape(-1) for t in tensors])
+    spec = BucketSpec(shapes=shapes, dtype=dtype, offsets=offsets, sizes=sizes,
+                      total=int(sum(sizes)))
+    return flat, spec
+
+
+def unflatten_tensors(flat: jax.Array, spec: BucketSpec) -> List[jax.Array]:
+    """Split a flat bucket back into the original tensor list.
+
+    Analog of ``apex_C.unflatten`` (csrc/flatten_unflatten.cpp:12-18).
+    """
+    out = []
+    for off, size, shape in zip(spec.offsets, spec.sizes, spec.shapes):
+        out.append(jax.lax.dynamic_slice_in_dim(flat, off, size).reshape(shape))
+    return out
+
+
+def group_by_dtype(
+    tensors: Sequence[jax.Array],
+) -> Dict[str, List[int]]:
+    """Return {canonical dtype name: indices} preserving order.
+
+    Mirrors the dtype split in the reference fused optimizers
+    (apex/optimizers/fused_adam.py:116-144: fp16 vs bf16 vs fp32 lists) and DDP
+    bucketing (apex/parallel/distributed.py:51-58).
+    """
+    groups: Dict[str, List[int]] = {}
+    for i, t in enumerate(tensors):
+        groups.setdefault(jnp.dtype(t.dtype).name, []).append(i)
+    return groups
+
+
+# ---------------------------------------------------------------------------
+# Pytree-level helpers (the JAX-idiomatic surface used by optimizers/DDP)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class TreeBucketSpec:
+    """Static description of a pytree packed into per-dtype buckets."""
+
+    treedef: Any
+    leaf_dtypes: Tuple[str, ...]
+    group_order: Tuple[str, ...]           # dtype name per bucket
+    group_indices: Tuple[Tuple[int, ...], ...]  # leaf indices per bucket
+    bucket_specs: Tuple[BucketSpec, ...]
+
+
+def tree_flatten_buckets(tree: Any) -> Tuple[List[jax.Array], TreeBucketSpec]:
+    """Flatten an arbitrary pytree into one flat 1-D bucket per dtype."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    groups = group_by_dtype(leaves)
+    buckets, bucket_specs, group_order, group_indices = [], [], [], []
+    for name, idxs in groups.items():
+        flat, spec = flatten_tensors([leaves[i] for i in idxs])
+        buckets.append(flat)
+        bucket_specs.append(spec)
+        group_order.append(name)
+        group_indices.append(tuple(idxs))
+    tspec = TreeBucketSpec(
+        treedef=treedef,
+        leaf_dtypes=tuple(jnp.dtype(l.dtype).name for l in leaves),
+        group_order=tuple(group_order),
+        group_indices=tuple(group_indices),
+        bucket_specs=tuple(bucket_specs),
+    )
+    return buckets, tspec
+
+
+def tree_unflatten_buckets(buckets: Sequence[jax.Array], tspec: TreeBucketSpec) -> Any:
+    """Inverse of :func:`tree_flatten_buckets`."""
+    n_leaves = len(tspec.leaf_dtypes)
+    leaves: List[Any] = [None] * n_leaves
+    for flat, idxs, spec in zip(buckets, tspec.group_indices, tspec.bucket_specs):
+        parts = unflatten_tensors(flat, spec)
+        for i, p in zip(idxs, parts):
+            leaves[i] = p
+    return jax.tree_util.tree_unflatten(tspec.treedef, leaves)

@@ -1,0 +1,380 @@
+"""Fused multi-tensor elementwise ops — the TPU-native counterpart of the
+reference's ``amp_C`` extension (csrc/amp_C_frontend.cpp:115-136 and the
+``csrc/multi_tensor_*`` kernels).
+
+Two execution paths, selected by :func:`use_pallas`:
+
+  * **jnp path** (always available, used on CPU): pure ``jax.numpy`` tree maps.
+    Under ``jit`` XLA fuses the whole-model elementwise update into a few
+    fusions, which already captures most of what multi_tensor_apply buys on
+    CUDA (batching thousands of tiny kernels, csrc/multi_tensor_apply.cuh:12).
+  * **Pallas path** (TPU): parameters are packed into flat per-dtype buckets
+    (ops/buckets.py) and a single Pallas kernel per bucket performs the update,
+    mirroring the reference's chunked launches
+    (csrc/multi_tensor_apply.cuh:41-142).
+
+Overflow contract: the reference kernels set a device-side ``noop_flag`` when
+they see inf/nan (e.g. ScaleFunctor, csrc/multi_tensor_scale_kernel.cu:30).
+Being functional, these ops instead *return* a boolean ``overflow`` scalar that
+stays on device; callers thread it into ``lax.cond``-guarded updates
+(amp/scaler.py) so no host sync is ever required — an improvement over the
+per-step D2H ``.item()`` at apex/amp/scaler.py:209.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import buckets as _buckets
+
+Tree = Any
+
+
+# ---------------------------------------------------------------------------
+# Dispatch control
+# ---------------------------------------------------------------------------
+
+_FORCE = os.environ.get("APEX_TPU_MT_BACKEND", "auto")  # auto | jnp | pallas
+
+# Backends whose devices are TPU chips. "axon" is a PJRT tunnel to a TPU.
+_TPU_BACKENDS = ("tpu", "axon")
+
+
+def on_tpu() -> bool:
+    return jax.default_backend() in _TPU_BACKENDS
+
+
+def use_pallas(*trees: Tree) -> bool:
+    """True when the fused Pallas bucket kernels should be used for ``trees``.
+
+    fp16 always takes the jnp path: Mosaic (the Pallas TPU compiler) has no
+    f16 type, while plain XLA handles f16 storage fine.
+    """
+    if _FORCE == "jnp":
+        return False
+    for t in trees:
+        for l in jax.tree_util.tree_leaves(t):
+            if l.dtype == jnp.float16:
+                return False
+    if _FORCE == "pallas":
+        return True
+    return on_tpu()
+
+
+def _nonfinite(x: jax.Array) -> jax.Array:
+    """Any-nonfinite reduction in fp32 (bool scalar on device)."""
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        return jnp.asarray(False)
+    return jnp.logical_not(jnp.all(jnp.isfinite(x.astype(jnp.float32))))
+
+
+def _tree_overflow(tree: Tree) -> jax.Array:
+    leaves = jax.tree_util.tree_leaves(tree)
+    flags = [_nonfinite(l) for l in leaves]
+    return functools.reduce(jnp.logical_or, flags, jnp.asarray(False))
+
+
+# ---------------------------------------------------------------------------
+# Tree-level public ops (the multi_tensor_applier surface,
+# apex/multi_tensor_apply/multi_tensor_apply.py:3-30)
+# ---------------------------------------------------------------------------
+
+def multi_tensor_scale(tree: Tree, scale: jax.Array) -> Tuple[Tree, jax.Array]:
+    """out = in * scale, with nonfinite detection on the inputs.
+
+    Analog of ``amp_C.multi_tensor_scale`` (csrc/multi_tensor_scale_kernel.cu:30);
+    this is the grad-unscale primitive used by the amp loss scaler
+    (apex/amp/scaler.py:103-128).
+    Returns ``(scaled_tree, overflow)``.
+    """
+    if use_pallas(tree):
+        from apex_tpu.ops import pallas_mt
+        return pallas_mt.scale_tree(tree, scale)
+    overflow = _tree_overflow(tree)
+    out = jax.tree_util.tree_map(
+        lambda x: (x.astype(jnp.float32) * scale).astype(x.dtype), tree)
+    return out, overflow
+
+
+def multi_tensor_axpby(a: jax.Array, x: Tree, b: jax.Array, y: Tree,
+                       ) -> Tuple[Tree, jax.Array]:
+    """out = a*x + b*y with nonfinite detection (csrc/multi_tensor_axpby_kernel.cu).
+
+    Used for merging stashed and freshly-computed grads under grad accumulation
+    (apex/amp/scaler.py:161-193 ``unscale_with_stashed``).
+    """
+    if use_pallas(x, y):
+        from apex_tpu.ops import pallas_mt
+        return pallas_mt.axpby_tree(a, x, b, y)
+    overflow = jnp.logical_or(_tree_overflow(x), _tree_overflow(y))
+    out = jax.tree_util.tree_map(
+        lambda xe, ye: (a * xe.astype(jnp.float32)
+                        + b * ye.astype(jnp.float32)).astype(ye.dtype), x, y)
+    return out, overflow
+
+
+def multi_tensor_l2norm(tree: Tree, per_tensor: bool = False,
+                        ) -> Tuple[jax.Array, Optional[Tree]]:
+    """Global (and optionally per-tensor) L2 norm of a pytree, computed in fp32.
+
+    Analog of ``amp_C.multi_tensor_l2norm``
+    (csrc/multi_tensor_l2norm_kernel.cu:28,197-280 — the two-stage cleanup
+    reduction maps to XLA's reduction + a final psum-free scalar add tree).
+    Returns ``(global_norm, per_tensor_norms_or_None)`` as fp32.
+    """
+    if use_pallas(tree) and not per_tensor:
+        from apex_tpu.ops import pallas_mt
+        return pallas_mt.l2norm_tree(tree), None
+    leaves = jax.tree_util.tree_leaves(tree)
+    sq = [jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves]
+    gnorm = jnp.sqrt(functools.reduce(jnp.add, sq, jnp.asarray(0.0, jnp.float32)))
+    if not per_tensor:
+        return gnorm, None
+    norms = jax.tree_util.tree_map(
+        lambda l: jnp.sqrt(jnp.sum(jnp.square(l.astype(jnp.float32)))), tree)
+    return gnorm, norms
+
+
+def multi_tensor_adam(
+    grads: Tree, params: Tree, exp_avg: Tree, exp_avg_sq: Tree, *,
+    lr: jax.Array, beta1: float, beta2: float, eps: float,
+    step: jax.Array, adam_w_mode: bool = True, bias_correction: bool = True,
+    weight_decay: float = 0.0, grad_scale: Optional[jax.Array] = None,
+) -> Tuple[Tree, Tree, Tree]:
+    """Fused Adam/AdamW step over a whole pytree.
+
+    Math parity with ``amp_C.multi_tensor_adam`` (csrc/multi_tensor_adam.cu:171,
+    signature csrc/amp_C_frontend.cpp:58-69): ``adam_w_mode`` selects decoupled
+    weight decay (AdamW) vs L2-regularization-style decay folded into the grad.
+    ``grad_scale`` optionally divides grads on the fly (fused unscale).
+    Returns ``(new_params, new_exp_avg, new_exp_avg_sq)``.
+    """
+    step = jnp.asarray(step, jnp.float32)
+    if bias_correction:
+        bc1 = 1.0 - jnp.power(jnp.asarray(beta1, jnp.float32), step)
+        bc2 = 1.0 - jnp.power(jnp.asarray(beta2, jnp.float32), step)
+    else:
+        bc1 = jnp.asarray(1.0, jnp.float32)
+        bc2 = jnp.asarray(1.0, jnp.float32)
+    inv_scale = (1.0 / grad_scale) if grad_scale is not None else None
+
+    if use_pallas(grads, params):
+        from apex_tpu.ops import pallas_mt
+        return pallas_mt.adam_tree(
+            grads, params, exp_avg, exp_avg_sq,
+            lr=jnp.asarray(lr, jnp.float32), beta1=beta1, beta2=beta2, eps=eps,
+            bc1=bc1, bc2=bc2, adam_w_mode=adam_w_mode,
+            weight_decay=weight_decay, inv_scale=inv_scale)
+
+    def upd(g, p, m, v):
+        g32 = g.astype(jnp.float32)
+        if inv_scale is not None:
+            g32 = g32 * inv_scale
+        p32 = p.astype(jnp.float32)
+        if not adam_w_mode and weight_decay != 0.0:
+            g32 = g32 + weight_decay * p32
+        m32 = beta1 * m.astype(jnp.float32) + (1.0 - beta1) * g32
+        v32 = beta2 * v.astype(jnp.float32) + (1.0 - beta2) * g32 * g32
+        update = (m32 / bc1) / (jnp.sqrt(v32 / bc2) + eps)
+        if adam_w_mode and weight_decay != 0.0:
+            update = update + weight_decay * p32
+        p32 = p32 - lr * update
+        return p32.astype(p.dtype), m32.astype(m.dtype), v32.astype(v.dtype)
+
+    out = jax.tree_util.tree_map(
+        lambda g, p, m, v: upd(g, p, m, v), grads, params, exp_avg, exp_avg_sq)
+    new_p = jax.tree_util.tree_map(lambda t: t[0], out,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    new_m = jax.tree_util.tree_map(lambda t: t[1], out,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    new_v = jax.tree_util.tree_map(lambda t: t[2], out,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    return new_p, new_m, new_v
+
+
+def multi_tensor_sgd(
+    grads: Tree, params: Tree, momentum_buf: Optional[Tree], *,
+    lr: jax.Array, weight_decay: float = 0.0, momentum: float = 0.0,
+    dampening: float = 0.0, nesterov: bool = False, first_run: bool = False,
+    wd_after_momentum: bool = False, scale: float = 1.0,
+) -> Tuple[Tree, Tree]:
+    """Fused SGD with momentum/nesterov/weight-decay over a pytree.
+
+    Math parity with ``amp_C.multi_tensor_sgd``
+    (csrc/multi_tensor_sgd_kernel.cu:320). ``first_run`` initializes the
+    momentum buffer to the (decayed) grad like torch SGD's lazy init.
+    Returns ``(new_params, new_momentum_buf)``.
+    """
+    def upd(g, p, m):
+        g32 = g.astype(jnp.float32) * scale
+        p32 = p.astype(jnp.float32)
+        if weight_decay != 0.0 and not wd_after_momentum:
+            g32 = g32 + weight_decay * p32
+        if momentum != 0.0:
+            m32 = m.astype(jnp.float32)
+            if first_run:
+                m32 = g32
+            else:
+                m32 = momentum * m32 + (1.0 - dampening) * g32
+            d = g32 + momentum * m32 if nesterov else m32
+        else:
+            m32 = m.astype(jnp.float32) if m is not None else jnp.zeros_like(g32)
+            d = g32
+        if weight_decay != 0.0 and wd_after_momentum:
+            d = d + weight_decay * p32
+        p32 = p32 - lr * d
+        return p32.astype(p.dtype), m32.astype(m.dtype) if m is not None else m32
+
+    if momentum_buf is None:
+        momentum_buf = jax.tree_util.tree_map(
+            lambda g: jnp.zeros_like(g, dtype=jnp.float32), grads)
+    out = jax.tree_util.tree_map(upd, grads, params, momentum_buf)
+    new_p = jax.tree_util.tree_map(lambda t: t[0], out,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    new_m = jax.tree_util.tree_map(lambda t: t[1], out,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    return new_p, new_m
+
+
+def multi_tensor_adagrad(
+    grads: Tree, params: Tree, state_sum: Tree, *,
+    lr: jax.Array, epsilon: float = 1e-10, weight_decay: float = 0.0,
+) -> Tuple[Tree, Tree]:
+    """Fused Adagrad step (csrc/multi_tensor_adagrad.cu).
+
+    Returns ``(new_params, new_state_sum)``.
+    """
+    def upd(g, p, h):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if weight_decay != 0.0:
+            g32 = g32 + weight_decay * p32
+        h32 = h.astype(jnp.float32) + g32 * g32
+        p32 = p32 - lr * g32 / (jnp.sqrt(h32) + epsilon)
+        return p32.astype(p.dtype), h32.astype(h.dtype)
+
+    out = jax.tree_util.tree_map(upd, grads, params, state_sum)
+    new_p = jax.tree_util.tree_map(lambda t: t[0], out,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    new_h = jax.tree_util.tree_map(lambda t: t[1], out,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    return new_p, new_h
+
+
+def multi_tensor_novograd(
+    grads: Tree, params: Tree, exp_avg: Tree, v_per_tensor: Tree, *,
+    lr: jax.Array, beta1: float, beta2: float, eps: float, step: jax.Array,
+    weight_decay: float = 0.0, bias_correction: bool = True,
+    norm_type: int = 2, init_v: bool = False,
+) -> Tuple[Tree, Tree, Tree]:
+    """Fused NovoGrad step (csrc/multi_tensor_novograd.cu,
+    signature csrc/amp_C_frontend.cpp:82-96).
+
+    NovoGrad's second moment ``v`` is a *per-tensor scalar* tracking the grad
+    norm, not an elementwise buffer. ``v_per_tensor`` is a pytree of scalars.
+    Returns ``(new_params, new_exp_avg, new_v)``.
+    """
+    step = jnp.asarray(step, jnp.float32)
+    if bias_correction:
+        bc1 = 1.0 - jnp.power(jnp.asarray(beta1, jnp.float32), step)
+        bc2 = 1.0 - jnp.power(jnp.asarray(beta2, jnp.float32), step)
+    else:
+        bc1 = jnp.asarray(1.0, jnp.float32)
+        bc2 = jnp.asarray(1.0, jnp.float32)
+
+    def upd(g, p, m, v):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if norm_type == 2:
+            gnorm = jnp.sqrt(jnp.sum(g32 * g32))
+        else:
+            gnorm = jnp.max(jnp.abs(g32))
+        v32 = jnp.where(jnp.asarray(init_v),
+                        gnorm * gnorm if norm_type == 2 else gnorm,
+                        beta2 * v.astype(jnp.float32) + (1.0 - beta2) *
+                        (gnorm * gnorm if norm_type == 2 else gnorm))
+        denom = jnp.sqrt(v32 / bc2) + eps
+        gn = g32 / denom
+        if weight_decay != 0.0:
+            gn = gn + weight_decay * p32
+        m32 = beta1 * m.astype(jnp.float32) + (1.0 - beta1) * gn
+        p32 = p32 - lr * (m32 / bc1)
+        return p32.astype(p.dtype), m32.astype(m.dtype), v32.astype(jnp.float32)
+
+    out = jax.tree_util.tree_map(upd, grads, params, exp_avg, v_per_tensor)
+    new_p = jax.tree_util.tree_map(lambda t: t[0], out,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    new_m = jax.tree_util.tree_map(lambda t: t[1], out,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    new_v = jax.tree_util.tree_map(lambda t: t[2], out,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    return new_p, new_m, new_v
+
+
+def multi_tensor_lamb(
+    grads: Tree, params: Tree, exp_avg: Tree, exp_avg_sq: Tree, *,
+    lr: jax.Array, beta1: float, beta2: float, eps: float, step: jax.Array,
+    bias_correction: bool = True, weight_decay: float = 0.0,
+    grad_averaging: bool = True, adam_w_mode: bool = True,
+    global_grad_norm: Optional[jax.Array] = None,
+    max_grad_norm: float = 0.0, use_nvlamb: bool = False,
+) -> Tuple[Tree, Tree, Tree]:
+    """Fused one-shot LAMB step (csrc/multi_tensor_lamb.cu:413, signature
+    csrc/amp_C_frontend.cpp:98-113): global grad-norm clip, Adam moments, then a
+    per-tensor trust ratio ``|p| / |update|`` scaling the learning rate.
+
+    ``use_nvlamb`` keeps the trust ratio even for zero-weight-decay tensors
+    (NVLamb variant, apex/optimizers/fused_lamb.py docs).
+    Returns ``(new_params, new_exp_avg, new_exp_avg_sq)``.
+    """
+    step = jnp.asarray(step, jnp.float32)
+    if bias_correction:
+        bc1 = 1.0 - jnp.power(jnp.asarray(beta1, jnp.float32), step)
+        bc2 = 1.0 - jnp.power(jnp.asarray(beta2, jnp.float32), step)
+    else:
+        bc1 = jnp.asarray(1.0, jnp.float32)
+        bc2 = jnp.asarray(1.0, jnp.float32)
+    beta3 = (1.0 - beta1) if grad_averaging else 1.0
+
+    # Global grad-norm clipping (stage 1 of csrc/multi_tensor_lamb.cu).
+    if global_grad_norm is None:
+        global_grad_norm, _ = multi_tensor_l2norm(grads)
+    if max_grad_norm > 0.0:
+        clip = jnp.where(global_grad_norm > max_grad_norm,
+                         global_grad_norm / max_grad_norm, 1.0)
+    else:
+        clip = jnp.asarray(1.0, jnp.float32)
+
+    def upd(g, p, m, v):
+        g32 = g.astype(jnp.float32) / clip
+        p32 = p.astype(jnp.float32)
+        if not adam_w_mode and weight_decay != 0.0:
+            g32 = g32 + weight_decay * p32
+        m32 = beta1 * m.astype(jnp.float32) + beta3 * g32
+        v32 = beta2 * v.astype(jnp.float32) + (1.0 - beta2) * g32 * g32
+        update = (m32 / bc1) / (jnp.sqrt(v32 / bc2) + eps)
+        if adam_w_mode and weight_decay != 0.0:
+            update = update + weight_decay * p32
+        # Per-tensor trust ratio (stage 2, csrc/multi_tensor_lamb.cu).
+        p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+        u_norm = jnp.sqrt(jnp.sum(update * update))
+        use_ratio = (weight_decay != 0.0) or use_nvlamb
+        ratio = jnp.where(
+            (p_norm > 0.0) & (u_norm > 0.0), p_norm / u_norm, 1.0
+        ) if use_ratio else jnp.asarray(1.0, jnp.float32)
+        p32 = p32 - lr * ratio * update
+        return p32.astype(p.dtype), m32.astype(m.dtype), v32.astype(v.dtype)
+
+    out = jax.tree_util.tree_map(upd, grads, params, exp_avg, exp_avg_sq)
+    new_p = jax.tree_util.tree_map(lambda t: t[0], out,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    new_m = jax.tree_util.tree_map(lambda t: t[1], out,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    new_v = jax.tree_util.tree_map(lambda t: t[2], out,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    return new_p, new_m, new_v

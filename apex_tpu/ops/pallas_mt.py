@@ -1,0 +1,307 @@
+"""Pallas TPU kernels for the multi-tensor bucket ops.
+
+These are the TPU-native re-design of the reference CUDA kernels in
+``csrc/multi_tensor_*_kernel.cu`` driven by the chunked launcher
+``csrc/multi_tensor_apply.cuh:41-142``. Instead of packing up to 110 tensor
+pointers into pinned-host metadata per launch (multi_tensor_apply.cuh:72-118),
+we pack the tensors themselves into one flat per-dtype bucket (ops/buckets.py)
+and run a single Pallas kernel with a 1-D grid of VMEM-sized chunks — the grid
+on TPU is sequential, so the overflow flag and norm accumulators live in
+SMEM/VMEM outputs that persist across grid steps.
+
+Layout: a flat bucket of N elements is zero-padded to a multiple of
+``BLOCK_ROWS * 128`` and viewed as (rows, 128) so the VPU sees full
+(sublane, lane) tiles.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops import buckets as _buckets
+
+Tree = Any
+
+LANES = 128
+BLOCK_ROWS = 512  # 512x128 fp32 = 256 KiB per operand block in VMEM
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _as_blocked(flat: jax.Array) -> Tuple[jax.Array, int]:
+    """Zero-pad a 1-D array to a multiple of BLOCK_ROWS*LANES and reshape to
+    (rows, LANES). Returns (blocked, original_length)."""
+    n = flat.shape[0]
+    chunk = BLOCK_ROWS * LANES
+    padded = ((n + chunk - 1) // chunk) * chunk
+    if padded != n:
+        flat = jnp.pad(flat, (0, padded - n))
+    return flat.reshape(-1, LANES), n
+
+
+def _unblocked(blocked: jax.Array, n: int) -> jax.Array:
+    return blocked.reshape(-1)[:n]
+
+
+# ---------------------------------------------------------------------------
+# scale
+# ---------------------------------------------------------------------------
+
+def _scale_kernel(scale_ref, x_ref, y_ref, of_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        of_ref[0, 0] = 0
+
+    x = x_ref[:].astype(jnp.float32)
+    y_ref[:] = (x * scale_ref[0]).astype(y_ref.dtype)
+    bad = jnp.logical_not(jnp.all(jnp.isfinite(x)))
+    of_ref[0, 0] = jnp.maximum(of_ref[0, 0], bad.astype(jnp.int32))
+
+
+def scale_flat(x: jax.Array, scale: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Fused out = x*scale + nonfinite detect on one flat bucket."""
+    xb, n = _as_blocked(x)
+    rows = xb.shape[0]
+    grid = rows // BLOCK_ROWS
+    y, of = pl.pallas_call(
+        _scale_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(xb.shape, x.dtype),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ],
+        interpret=_interpret(),
+    )(jnp.asarray(scale, jnp.float32).reshape(1), xb)
+    return _unblocked(y, n), of[0, 0] > 0
+
+
+# ---------------------------------------------------------------------------
+# axpby
+# ---------------------------------------------------------------------------
+
+def _axpby_kernel(ab_ref, x_ref, y_ref, out_ref, of_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        of_ref[0, 0] = 0
+
+    x = x_ref[:].astype(jnp.float32)
+    y = y_ref[:].astype(jnp.float32)
+    out_ref[:] = (ab_ref[0] * x + ab_ref[1] * y).astype(out_ref.dtype)
+    bad = jnp.logical_not(jnp.all(jnp.isfinite(x)) & jnp.all(jnp.isfinite(y)))
+    of_ref[0, 0] = jnp.maximum(of_ref[0, 0], bad.astype(jnp.int32))
+
+
+def axpby_flat(a, x: jax.Array, b, y: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    xb, n = _as_blocked(x)
+    yb, _ = _as_blocked(y)
+    grid = xb.shape[0] // BLOCK_ROWS
+    ab = jnp.stack([jnp.asarray(a, jnp.float32), jnp.asarray(b, jnp.float32)])
+    out, of = pl.pallas_call(
+        _axpby_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(yb.shape, y.dtype),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ],
+        interpret=_interpret(),
+    )(ab, xb, yb)
+    return _unblocked(out, n), of[0, 0] > 0
+
+
+# ---------------------------------------------------------------------------
+# l2norm
+# ---------------------------------------------------------------------------
+
+def _l2norm_kernel(x_ref, acc_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[0, 0] = 0.0
+
+    x = x_ref[:].astype(jnp.float32)
+    acc_ref[0, 0] += jnp.sum(x * x)
+
+
+def l2norm_sq_flat(x: jax.Array) -> jax.Array:
+    """Sum of squares of one flat bucket (fp32 scalar)."""
+    xb, _ = _as_blocked(x)
+    grid = xb.shape[0] // BLOCK_ROWS
+    acc = pl.pallas_call(
+        _l2norm_kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((1, 1), lambda i: (0, 0),
+                               memory_space=pltpu.SMEM),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        interpret=_interpret(),
+    )(xb)
+    return acc[0, 0]
+
+
+# ---------------------------------------------------------------------------
+# adam
+# ---------------------------------------------------------------------------
+
+def _adam_kernel(adam_w_mode, c_ref, g_ref, p_ref, m_ref, v_ref,
+                 p_out, m_out, v_out):
+    # c = [lr, beta1, beta2, eps, bc1, bc2, weight_decay, inv_scale]
+    lr, b1, b2, eps = c_ref[0], c_ref[1], c_ref[2], c_ref[3]
+    bc1, bc2, wd, inv_scale = c_ref[4], c_ref[5], c_ref[6], c_ref[7]
+    g = g_ref[:].astype(jnp.float32) * inv_scale
+    p = p_ref[:].astype(jnp.float32)
+    if not adam_w_mode:
+        g = g + wd * p
+    m = b1 * m_ref[:].astype(jnp.float32) + (1.0 - b1) * g
+    v = b2 * v_ref[:].astype(jnp.float32) + (1.0 - b2) * g * g
+    update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+    if adam_w_mode:
+        update = update + wd * p
+    p = p - lr * update
+    p_out[:] = p.astype(p_out.dtype)
+    m_out[:] = m.astype(m_out.dtype)
+    v_out[:] = v.astype(v_out.dtype)
+
+
+def adam_flat(g: jax.Array, p: jax.Array, m: jax.Array, v: jax.Array, *,
+              lr, beta1, beta2, eps, bc1, bc2, adam_w_mode, weight_decay,
+              inv_scale=None) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    gb, n = _as_blocked(g)
+    pb, _ = _as_blocked(p)
+    mb, _ = _as_blocked(m)
+    vb, _ = _as_blocked(v)
+    grid = gb.shape[0] // BLOCK_ROWS
+    c = jnp.stack([
+        jnp.asarray(lr, jnp.float32), jnp.asarray(beta1, jnp.float32),
+        jnp.asarray(beta2, jnp.float32), jnp.asarray(eps, jnp.float32),
+        jnp.asarray(bc1, jnp.float32), jnp.asarray(bc2, jnp.float32),
+        jnp.asarray(weight_decay, jnp.float32),
+        jnp.asarray(1.0 if inv_scale is None else inv_scale, jnp.float32),
+    ])
+    blk = lambda: pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0))
+    p2, m2, v2 = pl.pallas_call(
+        functools.partial(_adam_kernel, bool(adam_w_mode)),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  blk(), blk(), blk(), blk()],
+        out_specs=[blk(), blk(), blk()],
+        out_shape=[
+            jax.ShapeDtypeStruct(pb.shape, p.dtype),
+            jax.ShapeDtypeStruct(mb.shape, m.dtype),
+            jax.ShapeDtypeStruct(vb.shape, v.dtype),
+        ],
+        input_output_aliases={2: 0, 3: 1, 4: 2},
+        interpret=_interpret(),
+    )(c, gb, pb, mb, vb)
+    return _unblocked(p2, n), _unblocked(m2, n), _unblocked(v2, n)
+
+
+# ---------------------------------------------------------------------------
+# Tree-level wrappers: group leaves by dtype signature, bucket, run kernel.
+# ---------------------------------------------------------------------------
+
+def _grouped(trees: Sequence[Tree]):
+    """Align leaves across trees and group indices by their dtype signature."""
+    all_leaves = [jax.tree_util.tree_leaves(t) for t in trees]
+    n = len(all_leaves[0])
+    sig_groups = {}
+    for i in range(n):
+        sig = tuple(jnp.dtype(l[i].dtype).name for l in all_leaves)
+        sig_groups.setdefault(sig, []).append(i)
+    return all_leaves, sig_groups
+
+
+def scale_tree(tree: Tree, scale) -> Tuple[Tree, jax.Array]:
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    groups = _buckets.group_by_dtype(leaves)
+    out_leaves: List[Any] = [None] * len(leaves)
+    overflow = jnp.asarray(False)
+    for _, idxs in groups.items():
+        flat, spec = _buckets.flatten_tensors([leaves[i] for i in idxs])
+        y, of = scale_flat(flat, scale)
+        overflow = jnp.logical_or(overflow, of)
+        for i, t in zip(idxs, _buckets.unflatten_tensors(y, spec)):
+            out_leaves[i] = t
+    return jax.tree_util.tree_unflatten(treedef, out_leaves), overflow
+
+
+def axpby_tree(a, x: Tree, b, y: Tree) -> Tuple[Tree, jax.Array]:
+    (x_leaves, y_leaves), sig_groups = _grouped([x, y])
+    treedef = jax.tree_util.tree_structure(x)
+    out_leaves: List[Any] = [None] * len(x_leaves)
+    overflow = jnp.asarray(False)
+    for _, idxs in sig_groups.items():
+        fx, sx = _buckets.flatten_tensors([x_leaves[i] for i in idxs])
+        fy, _ = _buckets.flatten_tensors([y_leaves[i] for i in idxs])
+        out, of = axpby_flat(a, fx, b, fy)
+        overflow = jnp.logical_or(overflow, of)
+        for i, t in zip(idxs, _buckets.unflatten_tensors(out, sx)):
+            out_leaves[i] = t
+    return jax.tree_util.tree_unflatten(treedef, out_leaves), overflow
+
+
+def l2norm_tree(tree: Tree) -> jax.Array:
+    leaves = jax.tree_util.tree_leaves(tree)
+    groups = _buckets.group_by_dtype(leaves)
+    total = jnp.asarray(0.0, jnp.float32)
+    for _, idxs in groups.items():
+        flat, _ = _buckets.flatten_tensors([leaves[i] for i in idxs])
+        total = total + l2norm_sq_flat(flat)
+    return jnp.sqrt(total)
+
+
+def adam_tree(grads: Tree, params: Tree, exp_avg: Tree, exp_avg_sq: Tree, *,
+              lr, beta1, beta2, eps, bc1, bc2, adam_w_mode, weight_decay,
+              inv_scale=None) -> Tuple[Tree, Tree, Tree]:
+    (g_l, p_l, m_l, v_l), sig_groups = _grouped(
+        [grads, params, exp_avg, exp_avg_sq])
+    treedef = jax.tree_util.tree_structure(params)
+    new_p: List[Any] = [None] * len(p_l)
+    new_m: List[Any] = [None] * len(p_l)
+    new_v: List[Any] = [None] * len(p_l)
+    for _, idxs in sig_groups.items():
+        fg, _ = _buckets.flatten_tensors([g_l[i] for i in idxs])
+        fp, sp = _buckets.flatten_tensors([p_l[i] for i in idxs])
+        fm, sm = _buckets.flatten_tensors([m_l[i] for i in idxs])
+        fv, sv = _buckets.flatten_tensors([v_l[i] for i in idxs])
+        p2, m2, v2 = adam_flat(
+            fg, fp, fm, fv, lr=lr, beta1=beta1, beta2=beta2, eps=eps,
+            bc1=bc1, bc2=bc2, adam_w_mode=adam_w_mode,
+            weight_decay=weight_decay, inv_scale=inv_scale)
+        for i, t in zip(idxs, _buckets.unflatten_tensors(p2, sp)):
+            new_p[i] = t
+        for i, t in zip(idxs, _buckets.unflatten_tensors(m2, sm)):
+            new_m[i] = t
+        for i, t in zip(idxs, _buckets.unflatten_tensors(v2, sv)):
+            new_v[i] = t
+    unf = lambda ls: jax.tree_util.tree_unflatten(treedef, ls)
+    return unf(new_p), unf(new_m), unf(new_v)

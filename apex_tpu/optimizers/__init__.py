@@ -1,0 +1,1 @@
+"""apex_tpu.optimizers (placeholder — populated incrementally)."""

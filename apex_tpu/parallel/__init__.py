@@ -1,0 +1,1 @@
+"""apex_tpu.parallel (placeholder — populated incrementally)."""

@@ -1,0 +1,1 @@
+"""apex_tpu.pyprof (placeholder — populated incrementally)."""

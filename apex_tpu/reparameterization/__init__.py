@@ -1,0 +1,1 @@
+"""apex_tpu.reparameterization (placeholder — populated incrementally)."""

@@ -1,0 +1,1 @@
+"""apex_tpu.rnn (placeholder — populated incrementally)."""

@@ -1,0 +1,1 @@
+"""apex_tpu.sparsity (placeholder — populated incrementally)."""

@@ -1,0 +1,1 @@
+"""apex_tpu.testing (placeholder — populated incrementally)."""

@@ -1,0 +1,1 @@
+"""apex_tpu.utils (placeholder — populated incrementally)."""

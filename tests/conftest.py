@@ -1,0 +1,24 @@
+"""Test configuration: force an 8-device virtual CPU mesh so distributed tests
+run without TPU hardware.
+
+The reference tests all require real GPUs (SURVEY.md §4). Here the XLA CPU
+backend with --xla_force_host_platform_device_count=8 provides a faithful
+multi-device environment for every collective path.
+
+Note: an environment sitecustomize hook may pre-register a remote TPU platform
+and override ``jax_platforms`` via ``jax.config.update`` — so the env var alone
+is not enough; we update the config back to "cpu" before any backend
+initialization.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)

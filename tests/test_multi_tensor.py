@@ -1,0 +1,165 @@
+"""Multi-tensor kernel parity tests — port of the reference L0 kernel tests
+(tests/L0/run_amp/test_multi_tensor_scale.py:129, test_multi_tensor_axpby.py:186,
+test_multi_tensor_l2norm.py:90): sweep tensor-list sizes and dtype combos,
+assert math vs a plain reference and check the overflow flag contract."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import ops
+from apex_tpu.ops import pallas_mt
+
+
+def make_tree(key, sizes, dtype):
+    ks = jax.random.split(key, len(sizes))
+    return {f"t{i}": jax.random.normal(k, (s,), jnp.float32).astype(dtype)
+            for i, (k, s) in enumerate(zip(ks, sizes))}
+
+
+SIZES = [[7], [33, 1], [1024, 16, 555], [2048 * 32 + 1, 3]]
+DTYPES = [jnp.float32, jnp.bfloat16, jnp.float16]
+
+
+@pytest.mark.parametrize("sizes", SIZES)
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_scale(sizes, dtype):
+    tree = make_tree(jax.random.PRNGKey(0), sizes, dtype)
+    out, overflow = ops.multi_tensor_scale(tree, 4.0)
+    assert not bool(overflow)
+    for k in tree:
+        ref = (tree[k].astype(jnp.float32) * 4.0).astype(dtype)
+        np.testing.assert_allclose(np.asarray(out[k], np.float32),
+                                   np.asarray(ref, np.float32), rtol=1e-6)
+
+
+@pytest.mark.parametrize("bad", [float("inf"), float("nan")])
+def test_scale_overflow(bad):
+    tree = make_tree(jax.random.PRNGKey(1), [64, 128], jnp.float32)
+    tree["t1"] = tree["t1"].at[17].set(bad)
+    _, overflow = ops.multi_tensor_scale(tree, 2.0)
+    assert bool(overflow)
+
+
+@pytest.mark.parametrize("sizes", SIZES)
+def test_axpby(sizes):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(2))
+    x = make_tree(k1, sizes, jnp.float32)
+    y = make_tree(k2, sizes, jnp.float32)
+    out, overflow = ops.multi_tensor_axpby(2.0, x, -3.0, y)
+    assert not bool(overflow)
+    for k in x:
+        np.testing.assert_allclose(np.asarray(out[k]),
+                                   2.0 * np.asarray(x[k]) - 3.0 * np.asarray(y[k]),
+                                   rtol=1e-5)
+
+
+def test_axpby_overflow_either_arg():
+    sizes = [256, 9]
+    k1, k2 = jax.random.split(jax.random.PRNGKey(3))
+    for which in (0, 1):
+        x = make_tree(k1, sizes, jnp.float32)
+        y = make_tree(k2, sizes, jnp.float32)
+        if which == 0:
+            x["t0"] = x["t0"].at[0].set(float("nan"))
+        else:
+            y["t1"] = y["t1"].at[3].set(float("inf"))
+        _, overflow = ops.multi_tensor_axpby(1.0, x, 1.0, y)
+        assert bool(overflow)
+
+
+@pytest.mark.parametrize("sizes", SIZES)
+@pytest.mark.parametrize("per_tensor", [False, True])
+def test_l2norm(sizes, per_tensor):
+    tree = make_tree(jax.random.PRNGKey(4), sizes, jnp.float32)
+    gnorm, per = ops.multi_tensor_l2norm(tree, per_tensor=per_tensor)
+    flat = np.concatenate([np.asarray(v).ravel() for v in tree.values()])
+    np.testing.assert_allclose(float(gnorm), np.linalg.norm(flat), rtol=1e-5)
+    if per_tensor:
+        for k in tree:
+            np.testing.assert_allclose(float(per[k]),
+                                       np.linalg.norm(np.asarray(tree[k])),
+                                       rtol=1e-5)
+
+
+def test_mixed_dtype_tree():
+    tree = {"a": jnp.ones((100,), jnp.bfloat16),
+            "b": jnp.full((50,), 2.0, jnp.float32)}
+    out, overflow = ops.multi_tensor_scale(tree, 0.5)
+    assert out["a"].dtype == jnp.bfloat16
+    assert out["b"].dtype == jnp.float32
+    assert not bool(overflow)
+    np.testing.assert_allclose(np.asarray(out["b"]), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernels in interpret mode (CPU) vs the jnp path
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [100, 128 * 512, 128 * 512 * 2 + 77])
+def test_pallas_scale_flat(n):
+    x = jax.random.normal(jax.random.PRNGKey(5), (n,), jnp.float32)
+    y, of = pallas_mt.scale_flat(x, 3.0)
+    assert not bool(of)
+    np.testing.assert_allclose(np.asarray(y), 3.0 * np.asarray(x), rtol=1e-6)
+    x = x.at[n // 2].set(float("nan"))
+    _, of = pallas_mt.scale_flat(x, 3.0)
+    assert bool(of)
+
+
+def test_pallas_axpby_flat():
+    n = 128 * 600 + 13
+    k1, k2 = jax.random.split(jax.random.PRNGKey(6))
+    x = jax.random.normal(k1, (n,), jnp.float32)
+    y = jax.random.normal(k2, (n,), jnp.float32)
+    out, of = pallas_mt.axpby_flat(1.5, x, -0.5, y)
+    assert not bool(of)
+    np.testing.assert_allclose(np.asarray(out),
+                               1.5 * np.asarray(x) - 0.5 * np.asarray(y),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pallas_l2norm_flat():
+    n = 128 * 1024 + 7
+    x = jax.random.normal(jax.random.PRNGKey(7), (n,), jnp.float32)
+    got = pallas_mt.l2norm_sq_flat(x)
+    np.testing.assert_allclose(float(got), float(np.sum(np.asarray(x) ** 2)),
+                               rtol=1e-5)
+
+
+def test_pallas_adam_flat_matches_jnp():
+    n = 128 * 512 + 999
+    keys = jax.random.split(jax.random.PRNGKey(8), 4)
+    g = jax.random.normal(keys[0], (n,), jnp.float32)
+    p = jax.random.normal(keys[1], (n,), jnp.float32)
+    m = jax.random.normal(keys[2], (n,), jnp.float32) * 0.1
+    v = jnp.abs(jax.random.normal(keys[3], (n,), jnp.float32)) * 0.01
+    kw = dict(lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
+              bc1=1.0 - 0.9 ** 3, bc2=1.0 - 0.999 ** 3,
+              adam_w_mode=True, weight_decay=0.01)
+    p2, m2, v2 = pallas_mt.adam_flat(g, p, m, v, **kw)
+    # jnp reference
+    m_ref = 0.9 * m + 0.1 * g
+    v_ref = 0.999 * v + 0.001 * g * g
+    upd = (m_ref / kw["bc1"]) / (jnp.sqrt(v_ref / kw["bc2"]) + 1e-8) + 0.01 * p
+    p_ref = p - 1e-3 * upd
+    np.testing.assert_allclose(np.asarray(p2), np.asarray(p_ref),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(m2), np.asarray(m_ref),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(v2), np.asarray(v_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_bucket_roundtrip():
+    tree = {"w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+            "b": jnp.ones((5,), jnp.float32),
+            "h": jnp.zeros((2, 2), jnp.bfloat16)}
+    bks, spec = ops.tree_flatten_buckets(tree)
+    back = ops.tree_unflatten_buckets(bks, spec)
+    for k in tree:
+        assert back[k].dtype == tree[k].dtype
+        np.testing.assert_array_equal(np.asarray(back[k], np.float32),
+                                      np.asarray(tree[k], np.float32))
