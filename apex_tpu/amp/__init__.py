@@ -1,1 +1,32 @@
-"""apex_tpu.amp (placeholder — populated incrementally)."""
+"""apex_tpu.amp — automatic mixed precision for TPU (reference L2 layer,
+apex/amp/). Public surface mirrors apex.amp: ``initialize``, ``scale_loss``
+(via AmpOptimizer), opt levels O0-O5, autocast interposition, checkpointing.
+"""
+
+from apex_tpu.amp.policy import Properties, opt_levels, resolve
+from apex_tpu.amp.scaler import LossScaler, ScalerState
+from apex_tpu.amp.optimizer import AmpOptimizer, AmpOptimizerState
+from apex_tpu.amp.frontend import (
+    initialize,
+    cast_model,
+    cast_inputs,
+    wrap_apply,
+    state_dict,
+    load_state_dict,
+    master_params,
+    is_batchnorm_path,
+)
+from apex_tpu.amp.interposition import (
+    autocast,
+    disable_casts,
+    register_low_prec_function,
+    register_float_function,
+    low_prec_function,
+    float_function,
+)
+
+# Apex-compatible aliases (apex/amp/amp.py:29-71).
+half_function = low_prec_function
+bfloat16_function = low_prec_function
+register_half_function = register_low_prec_function
+register_bfloat16_function = register_low_prec_function

@@ -1,0 +1,216 @@
+"""amp.initialize and friends — the user-facing entry point, with the API
+shape of the reference frontend (apex/amp/frontend.py:258-425) recast for a
+functional JAX world.
+
+Reference:                         apex_tpu:
+  model, opt = amp.initialize(      apply_fn, amp_opt = amp.initialize(
+      model, opt, opt_level="O2")       apply_fn, opt, opt_level="O2")
+  ...                               params = amp.cast_model(params, "O2")
+  with amp.scale_loss(l, opt) as sl:scaled = amp_opt.scale_loss(l, opt_state)
+      sl.backward()                 grads = jax.grad(...)(params)
+  opt.step()                        params, opt_state, info = amp_opt.step(
+                                        grads, params, opt_state)
+
+``initialize`` wires: model-apply input casting (O2/O3/O5,
+_initialize.py:194-201), namespace interposition (O1/O4, amp.py:75-198),
+optimizer wrapping with master weights + loss scaling
+(_process_optimizer.py:321-489), and per-loss scalers (num_losses,
+_initialize.py:227-231).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import interposition
+from apex_tpu.amp import policy as _policy
+from apex_tpu.amp.optimizer import AmpOptimizer, AmpOptimizerState
+from apex_tpu.amp.scaler import LossScaler, ScalerState
+
+Tree = Any
+
+# Default param-path pattern identifying batch-norm-like params kept fp32
+# under keep_batchnorm_fp32 (the reference checks module types in
+# fp16util.convert_network, fp16util.py:60; with pytrees we match path names).
+_BN_PATH_RE = re.compile(r"(batch[_]?norm|(^|[/_.])bn(\d|$|[/_.])|batchstats)",
+                         re.IGNORECASE)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def is_batchnorm_path(path) -> bool:
+    return bool(_BN_PATH_RE.search(_path_str(path)))
+
+
+def cast_model(params: Tree,
+               opt_level_or_props: Union[str, _policy.Properties],
+               *, bn_predicate: Callable = is_batchnorm_path) -> Tree:
+    """Cast model params per the opt level (the ``.half()`` / ``.bfloat16()``
+    conversion of O2/O3/O5, _initialize.py:176-182), keeping batchnorm-like
+    params fp32 when the policy says so."""
+    props = (opt_level_or_props if isinstance(opt_level_or_props,
+                                              _policy.Properties)
+             else _policy.resolve(opt_level_or_props))
+    target = props.cast_model_type
+    if target is None:
+        return params
+    keep_bn = bool(props.keep_batchnorm_fp32)
+
+    def cast(path, p):
+        if not jnp.issubdtype(p.dtype, jnp.floating):
+            return p
+        if keep_bn and bn_predicate(path):
+            return p.astype(jnp.float32)
+        return p.astype(target)
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def cast_inputs(tree: Tree, dtype) -> Tree:
+    """Cast floating leaves of inputs to ``dtype`` (the patched
+    ``model.forward`` input caster, _initialize.py:194-201)."""
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(
+                jnp.dtype(x.dtype), jnp.floating):
+            return x.astype(dtype)
+        return x
+    return jax.tree_util.tree_map(cast, tree)
+
+
+def wrap_apply(apply_fn: Callable, props: _policy.Properties) -> Callable:
+    """Wrap a model apply function with policy behavior:
+
+    * O2/O3/O5: cast floating inputs to the model compute dtype.
+    * O1/O4: run the body under :func:`interposition.autocast`.
+    """
+    if not props.enabled:
+        return apply_fn
+
+    if props.patch_functions:
+        dtype = props.patch_functions_type
+
+        def patched(*args, **kwargs):
+            with interposition.autocast(dtype):
+                return apply_fn(*args, **kwargs)
+        return patched
+
+    if props.cast_model_type is not None and \
+            props.cast_model_type != jnp.float32:
+        dtype = props.cast_model_type
+
+        def casting(params, *args, **kwargs):
+            args, kwargs = cast_inputs((args, kwargs), dtype)
+            return apply_fn(params, *args, **kwargs)
+        return casting
+
+    return apply_fn
+
+
+def initialize(
+    models: Union[Callable, Sequence[Callable], None],
+    optimizers=None,
+    opt_level: str = "O1",
+    *,
+    cast_model_type=None,
+    patch_functions: Optional[bool] = None,
+    keep_batchnorm_fp32: Optional[bool] = None,
+    master_weights: Optional[bool] = None,
+    loss_scale=None,
+    num_losses: int = 1,
+    min_loss_scale: Optional[float] = None,
+    max_loss_scale: float = 2.0 ** 24,
+    enabled: bool = True,
+    verbosity: int = 1,
+):
+    """Resolve an opt level (+ overrides) and wrap model apply fns and
+    optimizers (frontend.py:258-425).
+
+    ``models``: a model apply callable (or list of them) — e.g.
+    ``functools.partial(module.apply)`` — or None.
+    ``optimizers``: a :class:`~apex_tpu.optimizers.base.FusedOptimizer`
+    (or list). Returns the same shapes the reference returns: single objects
+    when single inputs were given, lists otherwise.
+    """
+    props = _policy.resolve(
+        opt_level, cast_model_type=cast_model_type,
+        patch_functions=patch_functions,
+        keep_batchnorm_fp32=keep_batchnorm_fp32,
+        master_weights=master_weights, loss_scale=loss_scale,
+        enabled=enabled)
+
+    if verbosity > 0 and jax.process_index() == 0:
+        print(f"apex_tpu.amp: opt_level={props.opt_level}, "
+              f"cast_model_type={props.cast_model_type}, "
+              f"patch_functions={props.patch_functions}, "
+              f"keep_batchnorm_fp32={props.keep_batchnorm_fp32}, "
+              f"master_weights={props.master_weights}, "
+              f"loss_scale={props.loss_scale}")
+
+    if props.enabled and props.patch_functions:
+        interposition.install()
+
+    models_was_seq = isinstance(models, (list, tuple))
+    opts_was_seq = isinstance(optimizers, (list, tuple))
+    model_list = (list(models) if models_was_seq
+                  else ([] if models is None else [models]))
+    opt_list = (list(optimizers) if opts_was_seq
+                else ([] if optimizers is None else [optimizers]))
+
+    wrapped_models = [wrap_apply(m, props) for m in model_list]
+    wrapped_opts = [
+        AmpOptimizer(o, props, num_losses=num_losses,
+                     min_loss_scale=min_loss_scale,
+                     max_loss_scale=max_loss_scale)
+        for o in opt_list
+    ]
+
+    out_models = (wrapped_models if models_was_seq
+                  else (wrapped_models[0] if wrapped_models else None))
+    out_opts = (wrapped_opts if opts_was_seq
+                else (wrapped_opts[0] if wrapped_opts else None))
+    if optimizers is None:
+        return out_models
+    return out_models, out_opts
+
+
+# -- module-level checkpoint helpers (frontend.py:428-467 parity) ----------
+
+def state_dict(amp_optimizers, amp_states) -> dict:
+    """Serialize every loss scaler (reference amp.state_dict serializes
+    ``loss_scale``/``unskipped`` per scaler)."""
+    if not isinstance(amp_optimizers, (list, tuple)):
+        amp_optimizers = [amp_optimizers]
+        amp_states = [amp_states]
+    return {f"optimizer{i}": opt.state_dict(st)
+            for i, (opt, st) in enumerate(zip(amp_optimizers, amp_states))}
+
+
+def load_state_dict(amp_optimizers, amp_states, d: dict):
+    single = not isinstance(amp_optimizers, (list, tuple))
+    if single:
+        amp_optimizers = [amp_optimizers]
+        amp_states = [amp_states]
+    out = [opt.load_state_dict(st, d[f"optimizer{i}"])
+           for i, (opt, st) in enumerate(zip(amp_optimizers, amp_states))]
+    return out[0] if single else out
+
+
+def master_params(amp_optimizer: AmpOptimizer, state: AmpOptimizerState):
+    """Generator-free analog of ``amp.master_params`` (_amp_state.py:59-68)."""
+    return amp_optimizer.master_params(state)

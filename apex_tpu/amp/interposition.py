@@ -1,0 +1,196 @@
+"""O1/O4 function interposition: trace-time autocasting by patching the
+``jax.numpy`` / ``jax.lax`` / ``jax.nn`` namespaces.
+
+This is the TPU-native equivalent of the reference's eager monkey-patching
+engine (apex/amp/amp.py:75-198 ``init`` + apex/amp/wrap.py:10-29
+``make_cast_wrapper``). Differences, by design:
+
+  * The wrappers run at *trace* time, so each cast is staged once per jitted
+    step and then CSE'd/fused by XLA — the reference needed a per-call weight
+    cast cache (apex/amp/utils.py:101-133) to avoid re-casting weights every
+    op; under jit that caching is free, preserving the "one cast per weight
+    per step" contract.
+  * There is no Tensor-method table to patch; everything funnels through the
+    jnp/lax function namespaces.
+
+Casting rules (wrap.py:54-55,107-108 incl. the fork's bf16 threading):
+low-prec wrapper casts fp32 floating args down; fp32 wrapper casts
+fp16/bf16 args up. Non-floating args pass through untouched.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import importlib
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import lists as _lists
+
+_LOW_DTYPES = (jnp.float16, jnp.bfloat16)
+
+_state = threading.local()
+
+
+def _active_dtype():
+    return getattr(_state, "cast_dtype", None)
+
+
+def _cast_tree(args, kwargs, convert):
+    def conv(x):
+        if isinstance(x, (jax.Array, jnp.ndarray)) or hasattr(x, "dtype"):
+            try:
+                dt = jnp.dtype(x.dtype)
+            except TypeError:
+                return x
+            return convert(x, dt)
+        return x
+    args = jax.tree_util.tree_map(conv, args)
+    kwargs = jax.tree_util.tree_map(conv, kwargs)
+    return args, kwargs
+
+
+def _to_low(x, dt, target):
+    if dt == jnp.float32:
+        return x.astype(target)
+    return x
+
+
+def _to_fp32(x, dt):
+    if dt in _LOW_DTYPES:
+        return x.astype(jnp.float32)
+    return x
+
+
+def make_low_prec_wrapper(orig, name: str):
+    """Whitelist wrapper (reference ``make_cast_wrapper`` + ``maybe_half`` /
+    ``maybe_bfloat16``, wrap.py:10-29)."""
+    @functools.wraps(orig)
+    def wrapper(*args, **kwargs):
+        target = _active_dtype()
+        if target is None:
+            return orig(*args, **kwargs)
+        args, kwargs = _cast_tree(
+            args, kwargs, lambda x, dt: _to_low(x, dt, target))
+        return orig(*args, **kwargs)
+    wrapper.__apex_tpu_orig__ = orig
+    return wrapper
+
+
+def make_fp32_wrapper(orig, name: str):
+    """Blacklist wrapper (``maybe_float``)."""
+    @functools.wraps(orig)
+    def wrapper(*args, **kwargs):
+        if _active_dtype() is None:
+            return orig(*args, **kwargs)
+        args, kwargs = _cast_tree(args, kwargs, _to_fp32)
+        return orig(*args, **kwargs)
+    wrapper.__apex_tpu_orig__ = orig
+    return wrapper
+
+
+# (module, attr) -> original function, for restore.
+_patched: Dict[Tuple[str, str], Any] = {}
+
+# User-registered extras (amp.py:29-71 half_function/float_function parity).
+_user_low: List[Tuple[str, str]] = []
+_user_fp32: List[Tuple[str, str]] = []
+
+
+def _patch(module_path: str, attr: str, factory) -> None:
+    try:
+        mod = importlib.import_module(module_path)
+        orig = getattr(mod, attr)
+    except (ImportError, AttributeError):
+        return  # tolerate version drift in the jax namespace
+    if getattr(orig, "__apex_tpu_orig__", None) is not None:
+        return  # already patched
+    setattr(mod, attr, factory(orig, f"{module_path}.{attr}"))
+    _patched[(module_path, attr)] = orig
+
+
+def install() -> None:
+    """Patch the namespaces (reference amp.init, amp.py:75-198). Idempotent.
+
+    Patching installs inert wrappers; casting only happens while an
+    opt-level context has set the active dtype (``enable``/``autocast``).
+    """
+    for module_path, attr in _lists.LOW_PREC_FUNCS + _user_low:
+        _patch(module_path, attr, make_low_prec_wrapper)
+    for module_path, attr in _lists.FP32_FUNCS + _user_fp32:
+        _patch(module_path, attr, make_fp32_wrapper)
+
+
+def uninstall() -> None:
+    """Restore every patched function."""
+    for (module_path, attr), orig in list(_patched.items()):
+        mod = importlib.import_module(module_path)
+        setattr(mod, attr, orig)
+        del _patched[(module_path, attr)]
+
+
+def enable(dtype) -> None:
+    """Turn casting on globally (per thread) with the given low dtype."""
+    install()
+    _state.cast_dtype = dtype
+
+
+def disable() -> None:
+    _state.cast_dtype = None
+
+
+@contextlib.contextmanager
+def autocast(dtype=jnp.bfloat16):
+    """Scoped O1/O4-style casting: ``with amp.autocast(jnp.bfloat16): ...``.
+
+    Trace-time scope: wrap the region of your step function (or the whole
+    jitted call) whose ops should autocast.
+    """
+    prev = _active_dtype()
+    enable(dtype)
+    try:
+        yield
+    finally:
+        _state.cast_dtype = prev
+
+
+@contextlib.contextmanager
+def disable_casts():
+    """Parity with ``amp.disable_casts`` (apex/amp/handle.py:48-56)."""
+    prev = _active_dtype()
+    _state.cast_dtype = None
+    try:
+        yield
+    finally:
+        _state.cast_dtype = prev
+
+
+# -- registration API (amp.py:29-71) ---------------------------------------
+
+def register_low_prec_function(module, name: str) -> None:
+    """``amp.register_half_function`` / ``register_bfloat16_function`` analog."""
+    _user_low.append((module if isinstance(module, str) else module.__name__,
+                      name))
+    if _patched:
+        install()
+
+
+def register_float_function(module, name: str) -> None:
+    _user_fp32.append((module if isinstance(module, str) else module.__name__,
+                       name))
+    if _patched:
+        install()
+
+
+def low_prec_function(fn):
+    """Decorator marking a user function to run in the active low dtype
+    (``amp.half_function`` / ``bfloat16_function`` analog, amp.py:29-44)."""
+    return make_low_prec_wrapper(fn, getattr(fn, "__name__", "user_fn"))
+
+
+def float_function(fn):
+    return make_fp32_wrapper(fn, getattr(fn, "__name__", "user_fn"))

@@ -1,0 +1,67 @@
+"""Casting policy tables for O1/O4 function interposition — the JAX analog of
+the reference's whitelist/blacklist (apex/amp/lists/functional_overrides.py:18-91
+and lists/torch_overrides.py:7-136).
+
+Each entry is ``(module_path, attr_name)``. The semantics mirror the
+reference:
+
+  * LOW_PREC (reference FP16/BF16 whitelist): MXU-friendly ops — inputs cast
+    to the policy's low-precision dtype. On TPU these are the ops that hit the
+    128x128 systolic array; everything convolution/matmul-shaped belongs here.
+  * FP32 (reference blacklist): reductions/transcendentals/losses that want
+    fp32 stability — low-precision inputs are cast up.
+  * Promote lists are unnecessary in JAX: jnp's binary-op type promotion
+    already implements "widest input type wins" (the reference needed
+    ``wrap.promote`` only because torch errors on mixed-dtype ops).
+
+Patching ``jax.lax.dot_general`` / ``conv_general_dilated`` covers every
+library built on them (flax Dense/Conv, haiku Linear, jnp.matmul, einsum...)
+— the single-funnel analog of patching ``torch.nn.functional``.
+"""
+
+# MXU-friendly -> low precision (fp16 for O1, bf16 for O4).
+LOW_PREC_FUNCS = [
+    ("jax.lax", "dot_general"),
+    ("jax.lax", "dot"),
+    ("jax.lax", "conv_general_dilated"),
+    ("jax.lax", "conv_with_general_padding"),
+    ("jax.lax", "conv"),
+    ("jax.numpy", "matmul"),
+    ("jax.numpy", "dot"),
+    ("jax.numpy", "vdot"),
+    ("jax.numpy", "inner"),
+    ("jax.numpy", "tensordot"),
+    ("jax.numpy", "einsum"),
+]
+
+# Stability-hungry -> fp32 (reference blacklist: softmax/norms/losses/
+# pointwise transcendentals, torch_overrides.py:21-45).
+FP32_FUNCS = [
+    ("jax.nn", "softmax"),
+    ("jax.nn", "log_softmax"),
+    ("jax.nn", "logsumexp"),
+    ("jax.scipy.special", "logsumexp"),
+    ("jax.numpy", "exp"),
+    ("jax.numpy", "expm1"),
+    ("jax.numpy", "log"),
+    ("jax.numpy", "log10"),
+    ("jax.numpy", "log1p"),
+    ("jax.numpy", "log2"),
+    ("jax.numpy", "power"),
+    ("jax.numpy", "float_power"),
+    ("jax.numpy", "cosh"),
+    ("jax.numpy", "sinh"),
+    ("jax.numpy", "tan"),
+    ("jax.numpy", "reciprocal"),
+    ("jax.lax", "erf_inv"),
+    ("jax.lax", "rsqrt"),
+    # Wide reductions accumulate error in low precision
+    # (torch_overrides blacklists sum/prod/cumsum/cumprod).
+    ("jax.numpy", "sum"),
+    ("jax.numpy", "prod"),
+    ("jax.numpy", "cumsum"),
+    ("jax.numpy", "cumprod"),
+    ("jax.numpy", "mean"),
+    ("jax.numpy", "var"),
+    ("jax.numpy", "std"),
+]

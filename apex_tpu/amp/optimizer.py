@@ -1,0 +1,122 @@
+"""AmpOptimizer: the functional replacement for the reference's optimizer
+surgery (apex/amp/_process_optimizer.py:321-489) — master-weight management,
+fused unscale, and overflow step-skipping, all inside one jittable update.
+
+Reference flow it reproduces (call stack SURVEY.md §3.3):
+  scale_loss -> backward -> [post_backward] unscale grads w/ overflow check ->
+  update_scale -> step or skip.
+
+Improvements inherent to the design:
+  * ``lax.cond`` selects stepped vs un-stepped state on device — no host sync
+    (the reference does a D2H ``.item()`` per step, scaler.py:209, and patches
+    ``optimizer.step`` to a no-op on overflow, handle.py:127-154).
+  * Master fp32 weights live in the optimizer state pytree; the master->model
+    copy (``_process_optimizer.py:14-25``) is a fused cast that XLA schedules
+    with the update.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.policy import Properties
+from apex_tpu.amp.scaler import LossScaler, ScalerState
+
+Tree = Any
+
+
+class AmpOptimizerState(NamedTuple):
+    inner: Any             # fused optimizer state (over master or model params)
+    master: Any            # fp32 master params, or () when not used
+    scaler: ScalerState
+
+
+class AmpOptimizer:
+    """Wraps a :class:`~apex_tpu.optimizers.base.FusedOptimizer` with amp
+    semantics per the resolved ``Properties``."""
+
+    def __init__(self, inner, properties: Properties, *, num_losses: int = 1,
+                 **scaler_kwargs):
+        self.inner = inner
+        self.properties = properties
+        self.scaler = LossScaler(properties.loss_scale, num_losses=num_losses,
+                                 **scaler_kwargs)
+        self.num_losses = num_losses
+
+    # -- state -------------------------------------------------------------
+    def init(self, model_params: Tree) -> AmpOptimizerState:
+        if self.properties.master_weights:
+            master = jax.tree_util.tree_map(
+                lambda p: p.astype(jnp.float32), model_params)
+            inner = self.inner.init(master)
+        else:
+            master = ()
+            inner = self.inner.init(model_params)
+        return AmpOptimizerState(inner=inner, master=master,
+                                 scaler=self.scaler.init())
+
+    # -- loss scaling ------------------------------------------------------
+    def scale_loss(self, loss: jax.Array, state: AmpOptimizerState,
+                   loss_id: int = 0) -> jax.Array:
+        """``with amp.scale_loss(loss, optimizer)`` equivalent: returns the
+        scaled loss to differentiate (handle.py:81-113)."""
+        if not self.properties.enabled:
+            return loss
+        return self.scaler.scale_loss(loss, state.scaler, loss_id)
+
+    # -- the step ----------------------------------------------------------
+    def step(self, scaled_grads: Tree, model_params: Tree,
+             state: AmpOptimizerState, loss_id: int = 0,
+             ) -> Tuple[Tree, AmpOptimizerState, dict]:
+        """Unscale, check overflow, conditionally step, update the scaler.
+
+        Returns ``(new_model_params, new_state, info)`` where info carries
+        ``overflow`` and ``loss_scale`` as device scalars.
+        """
+        props = self.properties
+        use_master = props.master_weights
+
+        grads32, overflow = self.scaler.unscale(
+            scaled_grads, state.scaler, loss_id,
+            out_dtype=jnp.float32 if use_master else None)
+
+        def do_step(_):
+            target = state.master if use_master else model_params
+            new_target, new_inner = self.inner.step(grads32, target,
+                                                    state.inner)
+            if use_master:
+                new_model = jax.tree_util.tree_map(
+                    lambda mp, p: mp.astype(p.dtype), new_target, model_params)
+                return new_model, new_target, new_inner
+            return new_target, (), new_inner
+
+        def skip(_):
+            return model_params, state.master, state.inner
+
+        if props.enabled:
+            new_model, new_master, new_inner = jax.lax.cond(
+                overflow, skip, do_step, None)
+        else:
+            new_model, new_master, new_inner = do_step(None)
+
+        new_scaler = self.scaler.update(state.scaler, overflow, loss_id)
+        new_state = AmpOptimizerState(inner=new_inner, master=new_master,
+                                      scaler=new_scaler)
+        info = {"overflow": overflow,
+                "loss_scale": new_scaler.loss_scale[loss_id]}
+        return new_model, new_state, info
+
+    # -- introspection / checkpointing ------------------------------------
+    def master_params(self, state: AmpOptimizerState) -> Tree:
+        """``amp.master_params(optimizer)`` analog (_amp_state.py:59-68)."""
+        return state.master if self.properties.master_weights else None
+
+    def state_dict(self, state: AmpOptimizerState) -> dict:
+        return self.scaler.state_dict(state.scaler)
+
+    def load_state_dict(self, state: AmpOptimizerState, d: dict,
+                        ) -> AmpOptimizerState:
+        return state._replace(scaler=self.scaler.load_state_dict(d))

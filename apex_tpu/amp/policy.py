@@ -1,0 +1,112 @@
+"""Opt-level policy tables — behavioral parity with the reference amp frontend
+``Properties`` / ``O0``-``O5`` classes (apex/amp/frontend.py:7-254), re-cast as
+an immutable dataclass (JAX configs are trace-time constants, not mutable
+global state).
+
+Opt levels:
+  O0: pure fp32.
+  O1: function interposition — whitelisted ops run in fp16 (dynamic scaling).
+  O2: fp16 model (batchnorm kept fp32) + fp32 master weights (dynamic scaling).
+  O3: pure fp16.
+  O4: function interposition with bf16, no loss scaling (bf16 has fp32 range).
+  O5: bf16 model (batchnorm fp32) + fp32 master weights, no loss scaling.
+
+O4/O5 are the reference fork's signature bf16 additions
+(apex/amp/frontend.py:207-246). On TPU the bf16 levels are the natural ones;
+fp16 levels are kept for API/behavior parity (XLA supports f16 storage).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Union
+
+import jax.numpy as jnp
+
+LossScaleSpec = Union[str, float, int]  # "dynamic" or a static scale
+
+
+@dataclasses.dataclass(frozen=True)
+class Properties:
+    """Resolved amp options (reference ``Properties``, frontend.py:7-113).
+
+    ``None`` means "defer to the opt-level default" during override
+    resolution, mirroring the reference's ``_amp_state`` deferral.
+    """
+
+    enabled: bool = True
+    opt_level: str = "O1"
+    cast_model_type: Optional[Any] = None       # jnp dtype or None
+    patch_functions: bool = False               # = patch_torch_functions
+    patch_functions_type: Optional[Any] = None  # fp16 (O1) or bf16 (O4)
+    keep_batchnorm_fp32: Optional[bool] = None
+    master_weights: bool = False
+    loss_scale: LossScaleSpec = 1.0
+
+    @property
+    def compute_dtype(self):
+        """The low-precision dtype this level computes in (None for O0)."""
+        if self.patch_functions:
+            return self.patch_functions_type
+        if self.cast_model_type is not None and self.cast_model_type != jnp.float32:
+            return self.cast_model_type
+        return None
+
+    @property
+    def dynamic_loss_scale(self) -> bool:
+        return self.loss_scale == "dynamic"
+
+
+def _mk(opt_level, cast_model_type, patch, patch_type, keep_bn, master, scale):
+    return Properties(
+        enabled=True, opt_level=opt_level, cast_model_type=cast_model_type,
+        patch_functions=patch, patch_functions_type=patch_type,
+        keep_batchnorm_fp32=keep_bn, master_weights=master, loss_scale=scale)
+
+
+# Defaults exactly as the reference tables (frontend.py:118-254).
+opt_levels = {
+    "O0": _mk("O0", jnp.float32, False, None, None, False, 1.0),
+    "O1": _mk("O1", None, True, jnp.float16, None, False, "dynamic"),
+    "O2": _mk("O2", jnp.float16, False, None, True, True, "dynamic"),
+    "O3": _mk("O3", jnp.float16, False, None, False, False, 1.0),
+    "O4": _mk("O4", None, True, jnp.bfloat16, None, False, 1.0),
+    "O5": _mk("O5", jnp.bfloat16, False, None, True, True, 1.0),
+}
+
+
+def resolve(opt_level: str = "O1", *,
+            cast_model_type=None, patch_functions=None,
+            keep_batchnorm_fp32=None, master_weights=None,
+            loss_scale=None, enabled: bool = True) -> Properties:
+    """Apply per-kwarg user overrides on top of an opt level, with the
+    reference's consistency checks (frontend.py:249-254,404-419)."""
+    if opt_level not in opt_levels:
+        raise ValueError(
+            f"Unexpected optimization level {opt_level!r}; options are "
+            "'O0', 'O1', 'O2', 'O3', 'O4', 'O5' (the letter O + a digit, "
+            "not zero).")
+    base = opt_levels[opt_level]
+    props = dataclasses.replace(
+        base,
+        enabled=enabled,
+        cast_model_type=(base.cast_model_type if cast_model_type is None
+                         else cast_model_type),
+        patch_functions=(base.patch_functions if patch_functions is None
+                         else patch_functions),
+        keep_batchnorm_fp32=(base.keep_batchnorm_fp32
+                             if keep_batchnorm_fp32 is None
+                             else keep_batchnorm_fp32),
+        master_weights=(base.master_weights if master_weights is None
+                        else master_weights),
+        loss_scale=base.loss_scale if loss_scale is None else loss_scale,
+    )
+    # Consistency checks mirroring Properties.__setattr__ (frontend.py:60-100).
+    if props.keep_batchnorm_fp32 and props.cast_model_type is None:
+        raise ValueError(
+            "keep_batchnorm_fp32 only makes sense with a cast_model_type "
+            "(O2/O3/O5-style levels).")
+    if props.master_weights and props.cast_model_type is None:
+        raise ValueError("master_weights requires cast_model_type "
+                         "(O2/O5-style levels).")
+    return props

@@ -1,0 +1,125 @@
+"""Dynamic/static loss scaling — behavioral parity with the reference
+``LossScaler`` (apex/amp/scaler.py:42-226), designed so the whole
+scale → backward → unscale → maybe-skip → rescale cycle lives INSIDE one
+jitted step:
+
+  * The overflow flag is a device scalar returned by the fused unscale
+    (ops.multi_tensor_scale), never synced to host — the reference pays one
+    D2H ``item()`` per step (scaler.py:209); here ``lax.cond`` selects between
+    stepped and un-stepped state on device.
+  * Scaler state is a pytree (``ScalerState``) carried in the train state and
+    checkpointable (the reference serializes (loss_scale, unskipped) per loss,
+    frontend.py:428-467).
+
+Defaults match scaler.py:47-61: init 2**16, growth/backoff factor 2, growth
+window 2000 steps, max scale 2**24.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import ops
+
+
+class ScalerState(NamedTuple):
+    """Per-loss scaler state; fields have shape (num_losses,)."""
+
+    loss_scale: jax.Array  # f32
+    unskipped: jax.Array   # i32 — steps since last overflow (growth tracker)
+    overflows: jax.Array   # i32 — total overflow count (observability)
+
+
+class LossScaler:
+    """Static config for loss scaling; all methods are pure and jittable."""
+
+    def __init__(self, loss_scale="dynamic", *,
+                 init_scale: float = 2.0 ** 16,
+                 scale_factor: float = 2.0,
+                 scale_window: int = 2000,
+                 min_loss_scale: Optional[float] = None,
+                 max_loss_scale: float = 2.0 ** 24,
+                 num_losses: int = 1):
+        self.dynamic = (loss_scale == "dynamic")
+        self._static_scale = 1.0 if self.dynamic else float(loss_scale)
+        self.init_scale = init_scale if self.dynamic else self._static_scale
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_loss_scale = min_loss_scale
+        self.max_loss_scale = max_loss_scale
+        self.num_losses = num_losses
+
+    # -- state ------------------------------------------------------------
+    def init(self) -> ScalerState:
+        n = self.num_losses
+        return ScalerState(
+            loss_scale=jnp.full((n,), self.init_scale, jnp.float32),
+            unskipped=jnp.zeros((n,), jnp.int32),
+            overflows=jnp.zeros((n,), jnp.int32),
+        )
+
+    # -- hot path ----------------------------------------------------------
+    def scale_loss(self, loss: jax.Array, state: ScalerState,
+                   loss_id: int = 0) -> jax.Array:
+        """loss * current scale (the ``amp.scale_loss`` __enter__ product,
+        apex/amp/handle.py:81-113)."""
+        return loss.astype(jnp.float32) * state.loss_scale[loss_id]
+
+    def unscale(self, scaled_grads: Any, state: ScalerState,
+                loss_id: int = 0, *, out_dtype=None) -> Tuple[Any, jax.Array]:
+        """Fused grads/scale with nonfinite detection (scaler.py:103-128).
+
+        Returns ``(unscaled_grads, overflow)``. ``out_dtype`` optionally casts
+        grads (e.g. to fp32 for master-weight steps) before unscaling.
+        """
+        if out_dtype is not None:
+            scaled_grads = jax.tree_util.tree_map(
+                lambda g: g.astype(out_dtype), scaled_grads)
+        inv = 1.0 / state.loss_scale[loss_id]
+        return ops.multi_tensor_scale(scaled_grads, inv)
+
+    def update(self, state: ScalerState, overflow: jax.Array,
+               loss_id: int = 0) -> ScalerState:
+        """Post-step scale adjustment (scaler.py:206-226): overflow halves the
+        scale and resets the window; ``scale_window`` clean steps double it."""
+        if not self.dynamic:
+            return state._replace(
+                overflows=state.overflows.at[loss_id].add(
+                    overflow.astype(jnp.int32)))
+        scale = state.loss_scale[loss_id]
+        unskipped = state.unskipped[loss_id]
+
+        shrunk = scale / self.scale_factor
+        if self.min_loss_scale is not None:
+            shrunk = jnp.maximum(shrunk, self.min_loss_scale)
+        grown = jnp.minimum(scale * self.scale_factor, self.max_loss_scale)
+
+        new_unskipped = jnp.where(overflow, 0, unskipped + 1)
+        should_grow = new_unskipped >= self.scale_window
+        new_scale = jnp.where(overflow, shrunk,
+                              jnp.where(should_grow, grown, scale))
+        new_unskipped = jnp.where(should_grow, 0, new_unskipped)
+        return ScalerState(
+            loss_scale=state.loss_scale.at[loss_id].set(new_scale),
+            unskipped=state.unskipped.at[loss_id].set(new_unskipped),
+            overflows=state.overflows.at[loss_id].add(
+                overflow.astype(jnp.int32)),
+        )
+
+    # -- checkpointing (amp.state_dict parity, frontend.py:428-467) --------
+    def state_dict(self, state: ScalerState) -> dict:
+        return {
+            "loss_scale": jax.device_get(state.loss_scale),
+            "unskipped": jax.device_get(state.unskipped),
+            "overflows": jax.device_get(state.overflows),
+        }
+
+    def load_state_dict(self, d: dict) -> ScalerState:
+        return ScalerState(
+            loss_scale=jnp.asarray(d["loss_scale"], jnp.float32),
+            unskipped=jnp.asarray(d["unskipped"], jnp.int32),
+            overflows=jnp.asarray(d["overflows"], jnp.int32),
+        )

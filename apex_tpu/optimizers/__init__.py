@@ -1,1 +1,10 @@
-"""apex_tpu.optimizers (placeholder — populated incrementally)."""
+"""apex_tpu.optimizers — fused optimizers (reference L4: apex/optimizers/)."""
+
+from apex_tpu.optimizers.base import FusedOptimizer, resolve_lr
+from apex_tpu.optimizers.fused import (
+    FusedAdam, AdamState,
+    FusedSGD, SGDState,
+    FusedLAMB, LambState,
+    FusedNovoGrad, NovoGradState,
+    FusedAdagrad, AdagradState,
+)

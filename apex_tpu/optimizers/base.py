@@ -1,0 +1,58 @@
+"""Functional fused-optimizer protocol.
+
+The reference optimizers are drop-in ``torch.optim.Optimizer`` subclasses that
+mutate ``param.data`` (apex/optimizers/*). The TPU-native shape is a pure
+``step``: ``(grads, params, state) -> (new_params, new_state)`` that jit/pjit
+can trace, donate, and shard. An optax ``GradientTransformation`` view is
+provided for ecosystem interop (``as_optax``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Tree = Any
+Schedule = Union[float, Callable[[jax.Array], jax.Array]]
+
+
+def resolve_lr(lr: Schedule, step: jax.Array) -> jax.Array:
+    return jnp.asarray(lr(step) if callable(lr) else lr, jnp.float32)
+
+
+class FusedOptimizer:
+    """Base class: subclasses implement ``init`` and ``step``."""
+
+    def init(self, params: Tree) -> Any:
+        raise NotImplementedError
+
+    def step(self, grads: Tree, params: Tree, state: Any,
+             *, grad_scale: Optional[jax.Array] = None,
+             ) -> Tuple[Tree, Any]:
+        """Apply one update. ``grad_scale`` (if given) divides grads on the
+        fly, fused into the update kernel (the reference fused optimizers'
+        ``scale`` argument)."""
+        raise NotImplementedError
+
+    # -- optax interop -----------------------------------------------------
+    def as_optax(self):
+        """View as an optax ``GradientTransformationExtraArgs`` computing
+        ``updates = new_params - params`` (apply with optax.apply_updates)."""
+        import optax
+
+        def init_fn(params):
+            return self.init(params)
+
+        def update_fn(updates, state, params=None, **extra):
+            if params is None:
+                raise ValueError("this transformation requires params")
+            new_params, new_state = self.step(updates, params, state)
+            deltas = jax.tree_util.tree_map(
+                lambda n, p: (n.astype(jnp.float32)
+                              - p.astype(jnp.float32)).astype(p.dtype),
+                new_params, params)
+            return deltas, new_state
+
+        return optax.GradientTransformationExtraArgs(init_fn, update_fn)

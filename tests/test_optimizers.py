@@ -1,0 +1,210 @@
+"""Fused optimizer parity tests vs torch.optim references — port of the
+reference's tests/L0/run_optimizers/ (test_adam.py:181, test_lamb.py:263,
+test_adagrad.py:131): random param sets, several steps, assert trajectories
+match the framework-independent reference implementation."""
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import optimizers as opt
+
+
+def rand_tree(key, shapes):
+    ks = jax.random.split(key, len(shapes))
+    return {f"p{i}": jax.random.normal(k, s, jnp.float32)
+            for i, (k, s) in enumerate(zip(ks, shapes))}
+
+
+SHAPES = [(73,), (13, 64), (4, 3, 9)]
+NSTEPS = 5
+
+
+def run_jax(optimizer, params, grads_per_step):
+    state = optimizer.init(params)
+    for g in grads_per_step:
+        params, state = optimizer.step(g, params, state)
+    return params
+
+
+def run_torch(torch_opt_ctor, params, grads_per_step):
+    tparams = [torch.nn.Parameter(torch.tensor(np.asarray(v)))
+               for v in params.values()]
+    topt = torch_opt_ctor(tparams)
+    for g in grads_per_step:
+        for tp, gv in zip(tparams, g.values()):
+            tp.grad = torch.tensor(np.asarray(gv))
+        topt.step()
+    return {k: tp.detach().numpy() for k, tp in zip(params, tparams)}
+
+
+def make_grads(key, params, n):
+    out = []
+    for i in range(n):
+        key, k = jax.random.split(key)
+        ks = jax.random.split(k, len(params))
+        out.append({name: jax.random.normal(kk, v.shape, jnp.float32)
+                    for kk, (name, v) in zip(ks, params.items())})
+    return out
+
+
+@pytest.mark.parametrize("adam_w,wd", [(True, 0.0), (True, 0.1),
+                                       (False, 0.0), (False, 0.1)])
+def test_fused_adam_vs_torch(adam_w, wd):
+    params = rand_tree(jax.random.PRNGKey(0), SHAPES)
+    grads = make_grads(jax.random.PRNGKey(1), params, NSTEPS)
+    got = run_jax(opt.FusedAdam(lr=1e-2, weight_decay=wd, adam_w_mode=adam_w),
+                  params, grads)
+    ctor = ((lambda ps: torch.optim.AdamW(ps, lr=1e-2, weight_decay=wd))
+            if adam_w else
+            (lambda ps: torch.optim.Adam(ps, lr=1e-2, weight_decay=wd)))
+    want = run_torch(ctor, params, grads)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(got[k]), want[k],
+                                   rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("momentum,nesterov,wd",
+                         [(0.0, False, 0.0), (0.9, False, 0.0),
+                          (0.9, True, 0.0), (0.9, False, 0.05)])
+def test_fused_sgd_vs_torch(momentum, nesterov, wd):
+    params = rand_tree(jax.random.PRNGKey(2), SHAPES)
+    grads = make_grads(jax.random.PRNGKey(3), params, NSTEPS)
+    got = run_jax(opt.FusedSGD(lr=0.05, momentum=momentum, nesterov=nesterov,
+                               weight_decay=wd), params, grads)
+    want = run_torch(
+        lambda ps: torch.optim.SGD(ps, lr=0.05, momentum=momentum,
+                                   nesterov=nesterov, weight_decay=wd),
+        params, grads)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(got[k]), want[k],
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_fused_sgd_dampening_first_step():
+    # torch lazy momentum init: buf_1 = g_1 exactly (not (1-dampening)*g_1).
+    params = rand_tree(jax.random.PRNGKey(4), [(32,)])
+    grads = make_grads(jax.random.PRNGKey(5), params, 3)
+    got = run_jax(opt.FusedSGD(lr=0.1, momentum=0.9, dampening=0.5),
+                  params, grads)
+    want = run_torch(
+        lambda ps: torch.optim.SGD(ps, lr=0.1, momentum=0.9, dampening=0.5),
+        params, grads)
+    np.testing.assert_allclose(np.asarray(got["p0"]), want["p0"],
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_fused_adagrad_vs_torch():
+    params = rand_tree(jax.random.PRNGKey(6), SHAPES)
+    grads = make_grads(jax.random.PRNGKey(7), params, NSTEPS)
+    got = run_jax(opt.FusedAdagrad(lr=0.05, eps=1e-10, weight_decay=0.1),
+                  params, grads)
+    want = run_torch(
+        lambda ps: torch.optim.Adagrad(ps, lr=0.05, eps=1e-10,
+                                       weight_decay=0.1),
+        params, grads)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(got[k]), want[k],
+                                   rtol=2e-5, atol=2e-6)
+
+
+def _reference_lamb_step(params, grads, m, v, step, *, lr, beta1, beta2, eps,
+                         wd, max_grad_norm, grad_averaging=True,
+                         use_nvlamb=False):
+    """Pure-numpy LAMB (the reference test ships its own python LAMB,
+    tests/L0/run_optimizers/test_lamb.py)."""
+    gnorm = np.sqrt(sum(float(np.sum(g * g)) for g in grads.values()))
+    clip = gnorm / max_grad_norm if (max_grad_norm > 0 and
+                                     gnorm > max_grad_norm) else 1.0
+    bc1 = 1 - beta1 ** step
+    bc2 = 1 - beta2 ** step
+    beta3 = (1 - beta1) if grad_averaging else 1.0
+    out = {}
+    for k in params:
+        g = grads[k] / clip
+        p = params[k]
+        m[k] = beta1 * m[k] + beta3 * g
+        v[k] = beta2 * v[k] + (1 - beta2) * g * g
+        upd = (m[k] / bc1) / (np.sqrt(v[k] / bc2) + eps) + wd * p
+        pn = np.linalg.norm(p)
+        un = np.linalg.norm(upd)
+        ratio = pn / un if (wd != 0 or use_nvlamb) and pn > 0 and un > 0 \
+            else 1.0
+        out[k] = p - lr * ratio * upd
+    return out
+
+
+def test_fused_lamb_vs_python_reference():
+    params = rand_tree(jax.random.PRNGKey(8), SHAPES)
+    grads = make_grads(jax.random.PRNGKey(9), params, NSTEPS)
+    lamb = opt.FusedLAMB(lr=1e-2, weight_decay=0.01, max_grad_norm=1.0)
+    state = lamb.init(params)
+    p_jax = params
+    for g in grads:
+        p_jax, state = lamb.step(g, p_jax, state)
+
+    p_np = {k: np.asarray(v).copy() for k, v in params.items()}
+    m = {k: np.zeros_like(v) for k, v in p_np.items()}
+    v2 = {k: np.zeros_like(vv) for k, vv in p_np.items()}
+    for i, g in enumerate(grads):
+        gn = {k: np.asarray(vv) for k, vv in g.items()}
+        p_np = _reference_lamb_step(p_np, gn, m, v2, i + 1, lr=1e-2,
+                                    beta1=0.9, beta2=0.999, eps=1e-6,
+                                    wd=0.01, max_grad_norm=1.0)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p_jax[k]), p_np[k],
+                                   rtol=3e-5, atol=3e-6)
+
+
+def test_fused_novograd_runs_and_converges():
+    # quadratic bowl: params should shrink toward 0
+    params = {"w": jnp.full((64,), 5.0)}
+    ng = opt.FusedNovoGrad(lr=0.5, weight_decay=0.0)
+    state = ng.init(params)
+    for _ in range(200):
+        grads = {"w": params["w"]}  # d/dw 0.5 w^2
+        params, state = ng.step(grads, params, state)
+    assert float(jnp.abs(params["w"]).max()) < 1.0
+
+
+def test_optimizer_step_is_jittable():
+    params = rand_tree(jax.random.PRNGKey(10), [(128,), (16, 8)])
+    adam = opt.FusedAdam(lr=1e-3)
+    state = adam.init(params)
+    grads = make_grads(jax.random.PRNGKey(11), params, 1)[0]
+
+    @jax.jit
+    def f(g, p, s):
+        return adam.step(g, p, s)
+
+    p1, s1 = f(grads, params, state)
+    p2, s2 = adam.step(grads, params, state)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p2[k]),
+                                   rtol=1e-6)
+
+
+def test_lr_schedule_callable():
+    params = {"w": jnp.ones((8,))}
+    sched = lambda step: 0.1 / step.astype(jnp.float32)
+    sgd = opt.FusedSGD(lr=sched)
+    state = sgd.init(params)
+    g = {"w": jnp.ones((8,))}
+    p1, state = sgd.step(g, params, state)     # lr = 0.1
+    np.testing.assert_allclose(np.asarray(p1["w"]), 0.9, rtol=1e-6)
+    p2, state = sgd.step(g, p1, state)         # lr = 0.05
+    np.testing.assert_allclose(np.asarray(p2["w"]), 0.85, rtol=1e-6)
+
+
+def test_as_optax():
+    import optax
+    params = {"w": jnp.ones((16,))}
+    tx = opt.FusedAdam(lr=1e-2).as_optax()
+    state = tx.init(params)
+    g = {"w": jnp.full((16,), 0.5)}
+    updates, state = tx.update(g, state, params)
+    new_params = optax.apply_updates(params, updates)
+    assert float(new_params["w"][0]) < 1.0
