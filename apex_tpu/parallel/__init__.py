@@ -1,1 +1,22 @@
-"""apex_tpu.parallel (placeholder — populated incrementally)."""
+"""apex_tpu.parallel — distributed/parallel layer (reference L3:
+apex/parallel/). DP gradient sync, SyncBatchNorm, LARC, mesh helpers."""
+
+from apex_tpu.parallel.mesh import (
+    make_mesh, data_parallel_mesh, subgroups,
+)
+from apex_tpu.parallel.distributed import (
+    allreduce_gradients,
+    DistributedDataParallel,
+    Reducer,
+    ddp_train_step,
+)
+from apex_tpu.parallel.sync_batchnorm import (
+    SyncBatchNorm,
+    sync_moments,
+    convert_syncbn_model,
+)
+from apex_tpu.parallel.larc import LARC, larc_transform_grads
+
+# create_syncbn_process_group analog (apex/parallel/__init__.py:58-95):
+# rank subsets are plain axis_index_groups lists on TPU.
+create_syncbn_process_group = subgroups

@@ -1,0 +1,83 @@
+"""LARC — Layer-wise Adaptive Rate Clipping/Scaling, parity with
+``apex.parallel.LARC`` (apex/parallel/LARC.py:5-107).
+
+The reference wraps any torch optimizer and, before its step, replaces each
+param's grad with a trust-ratio-scaled grad:
+    ratio = trust_coefficient * |p| / (|g| + wd*|p| + eps)
+    clip mode: ratio <- min(ratio/lr, 1) applied to the grad
+    scale mode: grad <- grad * ratio
+Here the same surgery is a grad transform applied before any
+:class:`~apex_tpu.optimizers.base.FusedOptimizer` step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.base import FusedOptimizer, resolve_lr
+
+Tree = Any
+
+
+def larc_transform_grads(grads: Tree, params: Tree, *, lr: jax.Array,
+                         trust_coefficient: float = 0.02, clip: bool = True,
+                         eps: float = 1e-8, weight_decay: float = 0.0) -> Tree:
+    """The per-tensor grad surgery of LARC.step (LARC.py:78-107)."""
+    def per_tensor(g, p):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+        g_norm = jnp.sqrt(jnp.sum(g32 * g32))
+        ratio = trust_coefficient * p_norm / (
+            g_norm + weight_decay * p_norm + eps)
+        # reference guards p_norm==0 or g_norm==0 -> ratio 1
+        ratio = jnp.where((p_norm > 0) & (g_norm > 0), ratio, 1.0)
+        if clip:
+            ratio = jnp.minimum(ratio / lr, 1.0)
+        out = g32 * ratio
+        if weight_decay != 0.0:
+            out = out + weight_decay * p32 * ratio
+        return out.astype(g.dtype)
+
+    return jax.tree_util.tree_map(per_tensor, grads, params)
+
+
+class LARC(FusedOptimizer):
+    """Optimizer wrapper: ``LARC(FusedSGD(lr=...))`` — same composition shape
+    as the reference (`optim = LARC(optim)`)."""
+
+    def __init__(self, inner: FusedOptimizer, *,
+                 trust_coefficient: float = 0.02, clip: bool = True,
+                 eps: float = 1e-8):
+        self.inner = inner
+        self.trust_coefficient = trust_coefficient
+        self.clip = clip
+        self.eps = eps
+
+    def init(self, params: Tree):
+        return self.inner.init(params)
+
+    def step(self, grads: Tree, params: Tree, state,
+             *, grad_scale: Optional[jax.Array] = None):
+        step_no = getattr(state, "step", jnp.zeros((), jnp.int32)) + 1
+        lr = resolve_lr(getattr(self.inner, "lr", 1.0), step_no)
+        wd = getattr(self.inner, "weight_decay", 0.0)
+        grads = larc_transform_grads(
+            grads, params, lr=lr,
+            trust_coefficient=self.trust_coefficient, clip=self.clip,
+            eps=self.eps, weight_decay=wd)
+        # weight decay was folded into the LARC-adjusted grad (reference
+        # zeroes the optimizer's own wd during its step, LARC.py:88-92)
+        saved_wd = getattr(self.inner, "weight_decay", None)
+        if saved_wd is not None:
+            self.inner.weight_decay = 0.0
+        try:
+            out = self.inner.step(grads, params, state,
+                                  grad_scale=grad_scale)
+        finally:
+            if saved_wd is not None:
+                self.inner.weight_decay = saved_wd
+        return out

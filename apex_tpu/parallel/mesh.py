@@ -1,0 +1,48 @@
+"""Device-mesh helpers — the TPU-native replacement for the reference's
+process-group machinery (torch.distributed process groups, NCCL communicators,
+apex/parallel/__init__.py:58-95 ``create_syncbn_process_group``).
+
+On TPU, "process groups" are named axes of a ``jax.sharding.Mesh``; rank
+subsets become ``axis_index_groups`` on the XLA collective. Collectives ride
+ICI within a slice and DCN across slices — laid out by simply ordering mesh
+axes so the fastest-varying axis maps to ICI neighbors.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axis_sizes: Optional[Sequence[int]] = None,
+              axis_names: Sequence[str] = ("data",),
+              devices=None) -> Mesh:
+    """Build a Mesh over all (or given) devices.
+
+    Default: 1-D "data" mesh over every device — the analog of the reference
+    DDP's default world process group (apex/parallel/distributed.py:162-254).
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if axis_sizes is None:
+        axis_sizes = [len(devices)]
+    arr = np.asarray(devices).reshape(tuple(axis_sizes))
+    return Mesh(arr, tuple(axis_names))
+
+
+def data_parallel_mesh(name: str = "data") -> Mesh:
+    return make_mesh(axis_names=(name,))
+
+
+def subgroups(world_size: int, group_size: int) -> List[List[int]]:
+    """Partition ranks into contiguous groups of ``group_size`` — the analog
+    of ``create_syncbn_process_group`` (apex/parallel/__init__.py:58-95),
+    which requires world_size % group_size == 0."""
+    if group_size <= 0 or world_size % group_size != 0:
+        raise ValueError(
+            f"world_size ({world_size}) must be divisible by group_size "
+            f"({group_size}) — same contract as create_syncbn_process_group")
+    return [list(range(i, i + group_size))
+            for i in range(0, world_size, group_size)]

@@ -1,0 +1,147 @@
+"""Synchronized BatchNorm over a mesh axis — the TPU-native redesign of
+``apex.parallel.SyncBatchNorm`` (apex/parallel/optimized_sync_batchnorm.py:9-86
++ optimized_sync_batchnorm_kernel.py:7-119 + csrc/welford.cu).
+
+The reference pipeline: local Welford stats -> all_gather(mean,var,count) ->
+parallel Welford merge -> normalize; backward all_reduces (sum_dy,
+sum_dy_xmu). Here the cross-replica merge is expressed as ``lax.psum`` of
+(sum, sum_sq, count) — mathematically identical merged moments, one fused
+XLA collective, and the backward collectives fall out of autodiff through
+``psum`` automatically (no hand-written backward kernel needed).
+
+Sub-group stat sync (reference ``process_group`` /
+``create_syncbn_process_group``, apex/parallel/__init__.py:58-95; groupbn's
+CUDA-IPC ``bn_group``) maps to ``axis_index_groups``.
+
+Per-rank batch sizes may differ (reference
+two_gpu_test_different_batch_size.py): the count is psum'd alongside the sums.
+
+Conventions match torch BatchNorm for parity: ``momentum`` is the weight of
+the *new* observation (running = (1-m)*running + m*batch), and running_var
+uses the unbiased estimator while normalization uses the biased one
+(optimized_sync_batchnorm_kernel.py:50-58).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+Tree = Any
+
+
+def sync_moments(x: jax.Array, reduce_axes: Sequence[int],
+                 axis_name: Optional[str],
+                 axis_index_groups=None) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Cross-replica (sum, sum_sq, count) -> (mean, biased var, count).
+
+    The psum of raw moments is the associative form of the reference's
+    Welford merge (welford.cu:578 ``welford_parallel``)."""
+    x32 = x.astype(jnp.float32)
+    local_count = 1.0
+    for ax in reduce_axes:
+        local_count *= x.shape[ax]
+    s = jnp.sum(x32, axis=tuple(reduce_axes))
+    ss = jnp.sum(x32 * x32, axis=tuple(reduce_axes))
+    cnt = jnp.asarray(local_count, jnp.float32)
+    if axis_name is not None:
+        s, ss, cnt = jax.lax.psum(
+            (s, ss, cnt), axis_name, axis_index_groups=axis_index_groups)
+    mean = s / cnt
+    var = ss / cnt - mean * mean
+    return mean, var, cnt
+
+
+class SyncBatchNorm(nn.Module):
+    """flax module with torch-BatchNormNd semantics, stats synchronized over
+    ``axis_name`` (reference SyncBatchNorm module,
+    optimized_sync_batchnorm.py:9-86).
+
+    Input layout: channels last (TPU-native NHWC; the reference's
+    ``channel_last=True`` fast path, syncbn kernels ``*_c_last``).
+    """
+
+    features: int
+    eps: float = 1e-5
+    momentum: float = 0.1            # torch convention: weight of new batch
+    affine: bool = True
+    track_running_stats: bool = True
+    axis_name: Optional[str] = "data"
+    axis_index_groups: Optional[Sequence[Sequence[int]]] = None
+    use_running_average: Optional[bool] = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        use_ra = nn.merge_param(
+            "use_running_average", self.use_running_average,
+            use_running_average)
+        feature_axis = x.ndim - 1
+        reduce_axes = tuple(i for i in range(x.ndim) if i != feature_axis)
+
+        ra_mean = self.variable(
+            "batch_stats", "mean",
+            lambda: jnp.zeros((self.features,), jnp.float32))
+        ra_var = self.variable(
+            "batch_stats", "var",
+            lambda: jnp.ones((self.features,), jnp.float32))
+
+        if use_ra:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            # During flax init no mesh axis is bound; compute local stats.
+            axis = None if self.is_initializing() else self.axis_name
+            mean, var, cnt = sync_moments(
+                x, reduce_axes, axis, self.axis_index_groups)
+            if self.track_running_stats and not self.is_initializing():
+                # unbiased var for running stats (kernel.py:50-58 parity)
+                unbiased = var * cnt / jnp.maximum(cnt - 1.0, 1.0)
+                m = self.momentum
+                ra_mean.value = (1 - m) * ra_mean.value + m * mean
+                ra_var.value = (1 - m) * ra_var.value + m * unbiased
+
+        y = (x.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + self.eps)
+        if self.affine:
+            scale = self.param("scale", nn.initializers.ones,
+                               (self.features,), jnp.float32)
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,), jnp.float32)
+            y = y * scale + bias
+        return y.astype(self.dtype)
+
+
+def convert_syncbn_model(module: nn.Module, *, axis_name: str = "data",
+                         axis_index_groups=None) -> nn.Module:
+    """Analog of ``apex.parallel.convert_syncbn_model``
+    (apex/parallel/__init__.py:21-56): rebuild a flax module tree replacing
+    ``nn.BatchNorm`` with :class:`SyncBatchNorm`.
+
+    flax modules are immutable dataclasses, so this clones the module with
+    substituted definitions. Works for modules whose BatchNorms are direct
+    (possibly nested) dataclass fields; for ``@nn.compact`` models, construct
+    SyncBatchNorm directly instead (documented limitation).
+    """
+    if isinstance(module, nn.BatchNorm):
+        return SyncBatchNorm(
+            features=module.num_features
+            if hasattr(module, "num_features") else module.feature_count
+            if hasattr(module, "feature_count") else None,
+            eps=module.epsilon,
+            momentum=1.0 - module.momentum,  # flax momentum is decay
+            axis_name=axis_name,
+            axis_index_groups=axis_index_groups,
+            use_running_average=module.use_running_average,
+        )
+    changes = {}
+    for name, value in vars(module).items():
+        if isinstance(value, nn.Module):
+            new = convert_syncbn_model(value, axis_name=axis_name,
+                                       axis_index_groups=axis_index_groups)
+            if new is not value:
+                changes[name] = new
+    if changes:
+        return module.clone(**changes)
+    return module
