@@ -1,1 +1,3 @@
-"""apex_tpu.contrib (placeholder — populated incrementally)."""
+"""apex_tpu.contrib — contrib components (reference apex/contrib/)."""
+
+from apex_tpu.contrib import optimizers

@@ -1,1 +1,8 @@
-"""apex_tpu.contrib.optimizers (placeholder — populated incrementally)."""
+"""apex_tpu.contrib.optimizers — ZeRO-style sharded distributed optimizers
+(reference apex/contrib/optimizers/)."""
+
+from apex_tpu.contrib.optimizers.zero import (
+    DistributedFusedAdam,
+    DistributedFusedLAMB,
+    ZeroState,
+)
