@@ -1,3 +1,4 @@
 """apex_tpu.contrib — contrib components (reference apex/contrib/)."""
 
 from apex_tpu.contrib import optimizers
+from apex_tpu.contrib import xentropy

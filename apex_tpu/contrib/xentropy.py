@@ -1,0 +1,78 @@
+"""Fused softmax cross-entropy with label smoothing — parity with
+``apex.contrib.xentropy.SoftmaxCrossEntropyLoss``
+(apex/contrib/xentropy/softmax_xentropy.py:4-28 over the xentropy_cuda
+extension, apex/contrib/csrc/xentropy/xentropy_kernel.cu).
+
+The reference kernel's trick: forward returns (losses, max_log_sum_exp) so
+backward can rebuild the softmax as ``exp(logits - lse)`` without recomputing
+the max/sum reductions. The custom_vjp below keeps exactly that contract;
+XLA fuses the bwd expression into one pass over the logits.
+
+loss_i = logsumexp(x_i) - (1-smoothing) * x_i[y_i] - smoothing * mean_k(x_i[k])
+grad_i = softmax(x_i) - (1-smoothing) * onehot(y_i) - smoothing / K
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def softmax_cross_entropy_loss(logits: jax.Array, labels: jax.Array,
+                               smoothing: float = 0.0,
+                               half_to_float: bool = False) -> jax.Array:
+    """Per-example losses, shape (batch,). ``half_to_float`` mirrors the
+    reference flag: compute/return losses in fp32 even for low-prec logits
+    (always true here — TPU reductions want fp32 anyway)."""
+    losses, _ = _xent_fwd_impl(logits, labels, smoothing)
+    return losses
+
+
+def _xent_fwd_impl(logits, labels, smoothing):
+    x = logits.astype(jnp.float32)
+    mx = jnp.max(x, axis=-1, keepdims=True)
+    lse = jnp.log(jnp.sum(jnp.exp(x - mx), axis=-1, keepdims=True)) + mx
+    picked = jnp.take_along_axis(x, labels[..., None], axis=-1)
+    mean_all = jnp.mean(x, axis=-1, keepdims=True)
+    losses = (lse - (1.0 - smoothing) * picked - smoothing * mean_all)
+    return losses[..., 0], lse[..., 0]
+
+
+def _xent_fwd(logits, labels, smoothing, half_to_float):
+    losses, lse = _xent_fwd_impl(logits, labels, smoothing)
+    return losses, (logits, labels, lse)
+
+
+def _xent_bwd(smoothing, half_to_float, res, g):
+    logits, labels, lse = res
+    k = logits.shape[-1]
+    x = logits.astype(jnp.float32)
+    # softmax rebuilt from the saved max_log_sum_exp (no re-reduction)
+    probs = jnp.exp(x - lse[..., None])
+    onehot = jax.nn.one_hot(labels, k, dtype=jnp.float32)
+    grad = probs - (1.0 - smoothing) * onehot - smoothing / k
+    grad = grad * g[..., None]
+    return grad.astype(logits.dtype), None
+
+
+softmax_cross_entropy_loss.defvjp(_xent_fwd, _xent_bwd)
+
+
+class SoftmaxCrossEntropyLoss:
+    """Class shim matching the reference module surface."""
+
+    def __init__(self, smoothing: float = 0.0, reduction: str = "mean"):
+        self.smoothing = smoothing
+        self.reduction = reduction
+
+    def __call__(self, logits, labels):
+        losses = softmax_cross_entropy_loss(logits, labels, self.smoothing)
+        if self.reduction == "mean":
+            return jnp.mean(losses)
+        if self.reduction == "sum":
+            return jnp.sum(losses)
+        return losses

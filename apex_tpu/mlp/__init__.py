@@ -1,1 +1,75 @@
-"""apex_tpu.mlp (placeholder — populated incrementally)."""
+"""Fused MLP — parity with ``apex.mlp.MLP`` (apex/mlp/mlp.py:8-79 over
+``mlp_cuda``, csrc/mlp.cpp:53-171 + csrc/mlp_cuda.cu: chained cuBLAS GEMMs
+with fused bias/ReLU/sigmoid epilogues).
+
+On TPU no hand-written chain is needed: a jitted sequence of
+``dot_general + bias + activation`` is fused by XLA into MXU matmuls with
+epilogue fusion — the very thing mlp_cuda hand-built. The module keeps the
+reference's constructor surface (``mlp_sizes``, ``bias``, ``activation``,
+amp registration via ``amp.low_prec_function``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.amp.interposition import low_prec_function
+
+_ACTS = {
+    "none": lambda x: x,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+}
+
+
+@low_prec_function
+def mlp_function(x: jax.Array, weights: Sequence[jax.Array],
+                 biases: Sequence[jax.Array], activation: str = "relu",
+                 ) -> jax.Array:
+    """Functional fused MLP: y = act(...act(x W1 + b1)... W_n + b_n).
+    Amp-registered low-precision (the reference registers mlp via
+    ``amp.half_function``, apex/mlp/mlp.py:24). Final layer has no
+    activation, matching mlp_cuda semantics."""
+    act = _ACTS[activation]
+    h = x
+    for i, w in enumerate(weights):
+        h = h @ w.T
+        if biases:
+            h = h + biases[i]
+        if i < len(weights) - 1:
+            h = act(h)
+    return h
+
+
+class MLP(nn.Module):
+    """``MLP(mlp_sizes, bias=True, activation='relu')`` (apex/mlp/mlp.py:30).
+    ``mlp_sizes[0]`` is the input features; weights are stored transposed
+    (out, in) like the reference's torch Linear layout."""
+
+    mlp_sizes: Sequence[int]
+    bias: bool = True
+    activation: str = "relu"
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        sizes = tuple(self.mlp_sizes)
+        if len(sizes) < 2:
+            raise ValueError("mlp_sizes needs at least (in, out)")
+        weights, biases = [], []
+        for i in range(len(sizes) - 1):
+            w = self.param(f"weight_{i}",
+                           nn.initializers.lecun_normal(),
+                           (sizes[i + 1], sizes[i]), jnp.float32)
+            weights.append(w)
+            if self.bias:
+                biases.append(self.param(
+                    f"bias_{i}", nn.initializers.zeros, (sizes[i + 1],),
+                    jnp.float32))
+        y = mlp_function(x, weights, biases if self.bias else [],
+                         self.activation)
+        return y.astype(self.dtype) if self.dtype is not None else y

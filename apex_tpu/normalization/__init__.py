@@ -1,1 +1,8 @@
-"""apex_tpu.normalization (placeholder — populated incrementally)."""
+"""apex_tpu.normalization — fused normalization layers (reference
+apex/normalization/)."""
+
+from apex_tpu.normalization.fused_layer_norm import (
+    FusedLayerNorm,
+    FusedRMSNorm,
+    layer_norm,
+)

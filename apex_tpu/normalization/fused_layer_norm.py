@@ -1,0 +1,138 @@
+"""FusedLayerNorm — parity with ``apex.normalization.FusedLayerNorm``
+(apex/normalization/fused_layer_norm.py:12-165): a LayerNorm whose fwd/bwd
+run as single fused kernels (Pallas on TPU; the reference used
+``fused_layer_norm_cuda``), with a plain-XLA fallback exactly like the
+reference's CPU fallback to ``F.layer_norm`` (:154-156).
+
+``layer_norm`` is a ``jax.custom_vjp``: the Pallas backward consumes the
+saved (mean, rstd) row statistics — same contract as the reference autograd
+bridge (:12-62).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+import numpy as np
+
+from apex_tpu.ops import pallas_layer_norm as _plln
+
+Shape = Union[int, Sequence[int]]
+
+
+def _norm_size(normalized_shape: Shape) -> int:
+    if isinstance(normalized_shape, int):
+        return normalized_shape
+    return int(np.prod(tuple(normalized_shape)))
+
+
+def _use_pallas(d: int) -> bool:
+    import os
+    force = os.environ.get("APEX_TPU_MT_BACKEND", "auto")
+    if force == "jnp":
+        return False
+    if not _plln.supported(d):
+        return False
+    if force == "pallas":
+        return True
+    return jax.default_backend() in ("tpu", "axon")
+
+
+# -- functional, differentiable --------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _layer_norm_pallas(x2d, weight, bias, eps):
+    y, _, _ = _plln.ln_fwd(x2d, weight, bias, eps)
+    return y
+
+
+def _ln_fwd_rule(x2d, weight, bias, eps):
+    y, mu, rstd = _plln.ln_fwd(x2d, weight, bias, eps)
+    return y, (x2d, weight, mu, rstd)
+
+
+def _ln_bwd_rule(eps, res, dy):
+    x2d, weight, mu, rstd = res
+    dx, dw, db = _plln.ln_bwd(x2d, weight, mu, rstd, dy)
+    return dx, dw.astype(weight.dtype), db.astype(weight.dtype)
+
+
+_layer_norm_pallas.defvjp(_ln_fwd_rule, _ln_bwd_rule)
+
+
+def layer_norm(x: jax.Array, weight: Optional[jax.Array] = None,
+               bias: Optional[jax.Array] = None, *,
+               normalized_shape: Optional[Shape] = None,
+               eps: float = 1e-5) -> jax.Array:
+    """Functional fused layer norm over the trailing ``normalized_shape``
+    dims (defaults to the last dim). Affine params optional (the reference's
+    non-affine variant, layer_norm_cuda.cpp)."""
+    if normalized_shape is None:
+        normalized_shape = x.shape[-1]
+    d = _norm_size(normalized_shape)
+    lead = x.shape[:x.ndim - (1 if isinstance(normalized_shape, int)
+                              else len(tuple(normalized_shape)))]
+    x2d = x.reshape(-1, d)
+    w = (jnp.ones((d,), jnp.float32) if weight is None
+         else weight.reshape(-1).astype(jnp.float32))
+    b = (jnp.zeros((d,), jnp.float32) if bias is None
+         else bias.reshape(-1).astype(jnp.float32))
+
+    if _use_pallas(d):
+        y2d = _layer_norm_pallas(x2d, w, b, eps)
+    else:
+        x32 = x2d.astype(jnp.float32)
+        mu = jnp.mean(x32, axis=1, keepdims=True)
+        xc = x32 - mu
+        var = jnp.mean(xc * xc, axis=1, keepdims=True)
+        y2d = (xc * jax.lax.rsqrt(var + eps) * w + b).astype(x2d.dtype)
+    return y2d.reshape(x.shape)
+
+
+class FusedLayerNorm(nn.Module):
+    """Module parity with ``apex.normalization.FusedLayerNorm(normalized_
+    shape, eps, elementwise_affine)``."""
+
+    normalized_shape: Shape
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    dtype: Any = None   # output dtype; None = input dtype
+
+    @nn.compact
+    def __call__(self, x):
+        d = _norm_size(self.normalized_shape)
+        if self.elementwise_affine:
+            weight = self.param("weight", nn.initializers.ones, (d,),
+                                jnp.float32)
+            bias = self.param("bias", nn.initializers.zeros, (d,),
+                              jnp.float32)
+        else:
+            weight = bias = None
+        y = layer_norm(x, weight, bias,
+                       normalized_shape=self.normalized_shape, eps=self.eps)
+        return y.astype(self.dtype) if self.dtype is not None else y
+
+
+class FusedRMSNorm(nn.Module):
+    """RMSNorm sibling (no mean subtraction) — the modern LN variant; kept
+    alongside for transformer models. Not in the reference (additive)."""
+
+    normalized_shape: Shape
+    eps: float = 1e-6
+    elementwise_affine: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        d = _norm_size(self.normalized_shape)
+        x2d = x.reshape(-1, d).astype(jnp.float32)
+        ms = jnp.mean(x2d * x2d, axis=1, keepdims=True)
+        y = x2d * jax.lax.rsqrt(ms + self.eps)
+        if self.elementwise_affine:
+            weight = self.param("weight", nn.initializers.ones, (d,),
+                                jnp.float32)
+            y = y * weight
+        return y.reshape(x.shape).astype(x.dtype)

@@ -1,0 +1,147 @@
+"""Pallas TPU LayerNorm kernels — the counterpart of the reference
+``fused_layer_norm_cuda`` extension (csrc/layer_norm_cuda.cpp +
+csrc/layer_norm_cuda_kernel.cu:285-528: Welford row stats, affine fwd, and the
+two-stage backward producing dx plus dgamma/dbeta cross-row reductions).
+
+Layout: input viewed as (rows, D); one grid step processes a block of rows
+with the full feature dim resident in VMEM. dgamma/dbeta accumulate across
+the sequential TPU grid into a (1, D) fp32 output block.
+
+Constraints: D must be a multiple of 128 (lane width) to take this path;
+other shapes fall back to the jnp implementation in
+apex_tpu/normalization/fused_layer_norm.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+VMEM_BUDGET = 4 * 1024 * 1024  # per operand block
+
+
+def _interpret() -> bool:
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def _rows_per_block(d: int) -> int:
+    rows = max(8, min(1024, VMEM_BUDGET // (4 * d)))
+    return (rows // 8) * 8
+
+
+def supported(d: int) -> bool:
+    return d % LANES == 0
+
+
+# -- forward ----------------------------------------------------------------
+
+def _ln_fwd_kernel(eps, x_ref, w_ref, b_ref, y_ref, mu_ref, rstd_ref):
+    x = x_ref[:].astype(jnp.float32)
+    mu = jnp.mean(x, axis=1, keepdims=True)
+    xc = x - mu
+    var = jnp.mean(xc * xc, axis=1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = xc * rstd
+    w = w_ref[:].astype(jnp.float32)
+    b = b_ref[:].astype(jnp.float32)
+    y_ref[:] = (xhat * w + b).astype(y_ref.dtype)
+    mu_ref[:] = mu
+    rstd_ref[:] = rstd
+
+
+def ln_fwd(x2d: jax.Array, w: jax.Array, b: jax.Array, eps: float
+           ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    n, d = x2d.shape
+    rows = _rows_per_block(d)
+    padded = ((n + rows - 1) // rows) * rows
+    if padded != n:
+        x2d = jnp.pad(x2d, ((0, padded - n), (0, 0)))
+    grid = padded // rows
+    y, mu, rstd = pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, eps),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((padded, d), x2d.dtype),
+            jax.ShapeDtypeStruct((padded, 1), jnp.float32),
+            jax.ShapeDtypeStruct((padded, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x2d, w.reshape(1, d), b.reshape(1, d))
+    return y[:n], mu[:n], rstd[:n]
+
+
+# -- backward ---------------------------------------------------------------
+
+def _ln_bwd_kernel(x_ref, w_ref, mu_ref, rstd_ref, dy_ref,
+                   dx_ref, dw_ref, db_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_ref[:] = jnp.zeros_like(dw_ref)
+        db_ref[:] = jnp.zeros_like(db_ref)
+
+    x = x_ref[:].astype(jnp.float32)
+    dy = dy_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    mu = mu_ref[:]
+    rstd = rstd_ref[:]
+    xhat = (x - mu) * rstd
+    wdy = dy * w
+    c1 = jnp.mean(wdy, axis=1, keepdims=True)
+    c2 = jnp.mean(wdy * xhat, axis=1, keepdims=True)
+    dx_ref[:] = ((wdy - c1 - xhat * c2) * rstd).astype(dx_ref.dtype)
+    dw_ref[:] += jnp.sum(dy * xhat, axis=0, keepdims=True)
+    db_ref[:] += jnp.sum(dy, axis=0, keepdims=True)
+
+
+def ln_bwd(x2d, w, mu, rstd, dy2d):
+    n, d = x2d.shape
+    rows = _rows_per_block(d)
+    padded = ((n + rows - 1) // rows) * rows
+    if padded != n:
+        x2d = jnp.pad(x2d, ((0, padded - n), (0, 0)))
+        dy2d = jnp.pad(dy2d, ((0, padded - n), (0, 0)))
+        mu = jnp.pad(mu, ((0, padded - n), (0, 0)))
+        # rstd padding must be finite; zeros keep padded dx rows at 0
+        rstd = jnp.pad(rstd, ((0, padded - n), (0, 0)))
+    grid = padded // rows
+    dx, dw, db = pl.pallas_call(
+        _ln_bwd_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+            pl.BlockSpec((rows, d), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((padded, d), dy2d.dtype),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x2d, w.reshape(1, d), mu, rstd, dy2d)
+    return dx[:n], dw.reshape(-1), db.reshape(-1)

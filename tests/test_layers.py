@@ -1,0 +1,188 @@
+"""Fused layer tests — ports of the reference layer-parity suites:
+FusedLayerNorm vs plain layer norm (tests/L0/run_fused_layer_norm/
+test_fused_layer_norm.py:42), fused MLP vs a Linear stack incl. grad check
+(tests/L0/run_mlp/test_mlp.py:223), xentropy vs reference math + label
+smoothing (apex/contrib/test/ label-smoothing tests)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import normalization
+from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+from apex_tpu.mlp import MLP, mlp_function
+from apex_tpu.ops import pallas_layer_norm as plln
+
+
+# ---------------------------------------------------------------------------
+# LayerNorm
+# ---------------------------------------------------------------------------
+
+def _ref_layer_norm(x, w, b, eps=1e-5):
+    x64 = np.asarray(x, np.float64)
+    mu = x64.mean(-1, keepdims=True)
+    var = x64.var(-1, keepdims=True)
+    return (x64 - mu) / np.sqrt(var + eps) * np.asarray(w) + np.asarray(b)
+
+
+@pytest.mark.parametrize("shape", [(4, 256), (2, 3, 128), (5, 384)])
+def test_layer_norm_forward(shape):
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, shape, jnp.float32) * 3 + 1
+    d = shape[-1]
+    w = jax.random.normal(jax.random.PRNGKey(1), (d,)) + 1.0
+    b = jax.random.normal(jax.random.PRNGKey(2), (d,))
+    y = normalization.layer_norm(x, w, b)
+    np.testing.assert_allclose(np.asarray(y), _ref_layer_norm(x, w, b),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_layer_norm_pallas_matches_jnp():
+    # force the pallas path (interpret mode on CPU) vs the jnp fallback
+    x = jax.random.normal(jax.random.PRNGKey(3), (48, 256), jnp.float32)
+    w = jnp.ones((256,)) * 1.3
+    b = jnp.zeros((256,)) + 0.1
+    y_pallas = plln.ln_fwd(x, w, b, 1e-5)[0]
+    y_ref = _ref_layer_norm(x, w, b)
+    np.testing.assert_allclose(np.asarray(y_pallas), y_ref, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_layer_norm_pallas_grads():
+    x = jax.random.normal(jax.random.PRNGKey(4), (24, 128), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(5), (128,)) + 1.0
+    b = jnp.zeros((128,))
+
+    from apex_tpu.normalization.fused_layer_norm import _layer_norm_pallas
+
+    def f_pallas(x, w, b):
+        return jnp.sum(jnp.sin(_layer_norm_pallas(x, w, b, 1e-5)))
+
+    def f_ref(x, w, b):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean((x - mu) ** 2, axis=-1, keepdims=True)
+        y = (x - mu) * jax.lax.rsqrt(var + 1e-5) * w + b
+        return jnp.sum(jnp.sin(y))
+
+    gx1, gw1, gb1 = jax.grad(f_pallas, argnums=(0, 1, 2))(x, w, b)
+    gx2, gw2, gb2 = jax.grad(f_ref, argnums=(0, 1, 2))(x, w, b)
+    np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx2), rtol=1e-3,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw1), np.asarray(gw2), rtol=1e-3,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gb1), np.asarray(gb2), rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_fused_layer_norm_module():
+    m = normalization.FusedLayerNorm(normalized_shape=64)
+    x = jax.random.normal(jax.random.PRNGKey(6), (8, 64))
+    params = m.init(jax.random.PRNGKey(7), x)
+    y = m.apply(params, x)
+    np.testing.assert_allclose(np.asarray(y.mean(-1)), 0.0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y.std(-1)), 1.0, atol=1e-2)
+
+
+def test_fused_rms_norm_module():
+    m = normalization.FusedRMSNorm(normalized_shape=64)
+    x = jax.random.normal(jax.random.PRNGKey(8), (8, 64)) * 5
+    params = m.init(jax.random.PRNGKey(9), x)
+    y = m.apply(params, x)
+    rms = np.sqrt(np.mean(np.asarray(y) ** 2, -1))
+    np.testing.assert_allclose(rms, 1.0, atol=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# xentropy
+# ---------------------------------------------------------------------------
+
+def test_xentropy_matches_reference_math():
+    logits = jax.random.normal(jax.random.PRNGKey(10), (32, 100)) * 4
+    labels = jax.random.randint(jax.random.PRNGKey(11), (32,), 0, 100)
+    losses = softmax_cross_entropy_loss(logits, labels, 0.0)
+    # reference: -log softmax picked
+    x = np.asarray(logits, np.float64)
+    lse = np.log(np.exp(x - x.max(-1, keepdims=True)).sum(-1)) + x.max(-1)
+    want = lse - x[np.arange(32), np.asarray(labels)]
+    np.testing.assert_allclose(np.asarray(losses), want, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_xentropy_label_smoothing():
+    logits = jax.random.normal(jax.random.PRNGKey(12), (16, 50))
+    labels = jax.random.randint(jax.random.PRNGKey(13), (16,), 0, 50)
+    s = 0.1
+    losses = softmax_cross_entropy_loss(logits, labels, s)
+    x = np.asarray(logits, np.float64)
+    lse = np.log(np.exp(x - x.max(-1, keepdims=True)).sum(-1)) + x.max(-1)
+    picked = x[np.arange(16), np.asarray(labels)]
+    want = lse - (1 - s) * picked - s * x.mean(-1)
+    np.testing.assert_allclose(np.asarray(losses), want, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_xentropy_grad_matches_autodiff():
+    logits = jax.random.normal(jax.random.PRNGKey(14), (8, 30))
+    labels = jax.random.randint(jax.random.PRNGKey(15), (8,), 0, 30)
+
+    def fused(lg):
+        return jnp.mean(softmax_cross_entropy_loss(lg, labels, 0.1))
+
+    def plain(lg):
+        lp = jax.nn.log_softmax(lg)
+        onehot = jax.nn.one_hot(labels, 30)
+        soft = 0.9 * onehot + 0.1 / 30
+        return jnp.mean(-jnp.sum(soft * lg, -1)
+                        + jax.nn.logsumexp(lg, -1))
+
+    g1 = jax.grad(fused)(logits)
+    g2 = jax.grad(plain)(logits)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-4,
+                               atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# MLP
+# ---------------------------------------------------------------------------
+
+def test_mlp_matches_dense_stack():
+    import flax.linen as nn
+
+    m = MLP(mlp_sizes=(16, 32, 8), activation="relu")
+    x = jax.random.normal(jax.random.PRNGKey(16), (4, 16))
+    params = m.init(jax.random.PRNGKey(17), x)
+    y = m.apply(params, x)
+
+    w0 = params["params"]["weight_0"]
+    b0 = params["params"]["bias_0"]
+    w1 = params["params"]["weight_1"]
+    b1 = params["params"]["bias_1"]
+    want = jnp.maximum(x @ w0.T + b0, 0) @ w1.T + b1
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_mlp_gradcheck():
+    # reference test_mlp.py:223 runs torch gradcheck; here: fp64 finite
+    # differences vs reverse-mode AD
+    with jax.enable_x64():
+        m = MLP(mlp_sizes=(8, 16, 4), activation="sigmoid")
+        x = jax.random.normal(jax.random.PRNGKey(18), (3, 8), jnp.float64)
+        params = m.init(jax.random.PRNGKey(19), x)
+        params = jax.tree.map(lambda p: p.astype(jnp.float64), params)
+
+        def f(p):
+            return jnp.sum(m.apply(p, x) ** 2)
+
+        from jax.test_util import check_grads
+        check_grads(f, (params,), order=1, modes=["rev"], atol=1e-5,
+                    rtol=1e-5)
+
+
+def test_mlp_no_bias():
+    m = MLP(mlp_sizes=(8, 4), bias=False)
+    x = jnp.ones((2, 8))
+    params = m.init(jax.random.PRNGKey(20), x)
+    assert "bias_0" not in params["params"]
