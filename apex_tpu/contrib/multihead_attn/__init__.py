@@ -1,0 +1,174 @@
+"""Fused multihead attention modules — parity with
+``apex.contrib.multihead_attn`` (SelfMultiheadAttn at
+self_multihead_attn.py:26, EncdecMultiheadAttn, and the fast_* autograd
+functions over the CUTLASS/CUDA kernels). Variant matrix reproduced
+(SURVEY.md §2.2): self/enc-dec x {plain, bias, additive-mask, norm-add
+residual}, plus the standalone masked-softmax-dropout.
+
+``impl='fast'`` runs the Pallas flash kernel (ops/attention.py);
+``impl='default'`` is the plain jnp path — the same two-impl switch as the
+reference modules. Dropout inside attention probs uses the default path
+(Pallas RNG dropout is a later optimization; the reference fast path fuses
+dropout into its softmax kernel, csrc/multihead_attn/dropout.h).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.ops.attention import (
+    attention_reference,
+    flash_attention,
+    ring_self_attention,
+    self_attention,
+)
+
+__all__ = [
+    "SelfMultiheadAttn", "EncdecMultiheadAttn", "masked_softmax_dropout",
+    "self_attention", "flash_attention", "attention_reference",
+    "ring_self_attention",
+]
+
+
+def masked_softmax_dropout(scores: jax.Array, *, mask: Optional[jax.Array]
+                           = None, dropout_rate: float = 0.0,
+                           rng: Optional[jax.Array] = None,
+                           deterministic: bool = True) -> jax.Array:
+    """Standalone fused masked-softmax-dropout (the reference's
+    ``fast_mask_softmax_dropout`` module): additive mask -> fp32 softmax ->
+    dropout. XLA fuses this chain into one pass."""
+    s = scores.astype(jnp.float32)
+    if mask is not None:
+        s = s + mask.astype(jnp.float32)
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout_rate > 0.0 and not deterministic:
+        keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+    return p.astype(scores.dtype)
+
+
+def _split_heads(x, num_heads):
+    b, s, e = x.shape
+    return x.reshape(b, s, num_heads, e // num_heads).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(x):
+    b, h, s, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+
+
+class SelfMultiheadAttn(nn.Module):
+    """``SelfMultiheadAttn(embed_dim, num_heads, dropout, bias,
+    include_norm_add, impl)`` (self_multihead_attn.py:26).
+
+    Input layout: (batch, seq, embed) — batch-first, the TPU-friendly layout
+    (the reference uses seq-first torch convention).
+    ``include_norm_add``: pre-LayerNorm + residual add around attention
+    (the *_norm_add_* kernel variants).
+    """
+
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+    bias: bool = False
+    include_norm_add: bool = False
+    impl: str = "fast"          # 'fast' (Pallas flash) | 'default' (jnp)
+    causal: bool = False
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, *, attn_mask: Optional[jax.Array] = None,
+                 deterministic: bool = True,
+                 dropout_rng: Optional[jax.Array] = None):
+        e, h = self.embed_dim, self.num_heads
+        assert e % h == 0, "embed_dim must divide num_heads"
+        residual = x
+        if self.include_norm_add:
+            x = FusedLayerNorm(normalized_shape=e)(x)
+
+        qkv = nn.Dense(3 * e, use_bias=self.bias, name="in_proj",
+                       dtype=self.dtype)(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = _split_heads(q, h)
+        k = _split_heads(k, h)
+        v = _split_heads(v, h)
+
+        use_fast = (self.impl == "fast" and attn_mask is None
+                    and (self.dropout == 0.0 or deterministic))
+        if use_fast:
+            ctx = flash_attention(q, k, v, self.causal)
+        else:
+            scale = 1.0 / math.sqrt(e // h)
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                           preferred_element_type=jnp.float32) * scale
+            if self.causal:
+                sq, sk = s.shape[-2], s.shape[-1]
+                row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+                col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+                s = jnp.where(col <= row, s, -1e30)
+            p = masked_softmax_dropout(
+                s, mask=attn_mask, dropout_rate=self.dropout,
+                rng=dropout_rng, deterministic=deterministic)
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+        out = nn.Dense(e, use_bias=self.bias, name="out_proj",
+                       dtype=self.dtype)(_merge_heads(ctx).astype(x.dtype))
+        if self.include_norm_add:
+            out = out + residual
+        return out
+
+
+class EncdecMultiheadAttn(nn.Module):
+    """Encoder-decoder attention (encdec_multihead_attn.py): queries from the
+    decoder stream, keys/values projected jointly from the encoder stream."""
+
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+    bias: bool = False
+    include_norm_add: bool = False
+    impl: str = "fast"
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, query, key, *, attn_mask: Optional[jax.Array] = None,
+                 deterministic: bool = True,
+                 dropout_rng: Optional[jax.Array] = None):
+        e, h = self.embed_dim, self.num_heads
+        residual = query
+        if self.include_norm_add:
+            query = FusedLayerNorm(normalized_shape=e)(query)
+
+        q = nn.Dense(e, use_bias=self.bias, name="q_proj",
+                     dtype=self.dtype)(query)
+        kv = nn.Dense(2 * e, use_bias=self.bias, name="kv_proj",
+                      dtype=self.dtype)(key)
+        k, v = jnp.split(kv, 2, axis=-1)
+        q = _split_heads(q, h)
+        k = _split_heads(k, h)
+        v = _split_heads(v, h)
+
+        use_fast = (self.impl == "fast" and attn_mask is None
+                    and (self.dropout == 0.0 or deterministic))
+        if use_fast:
+            ctx = flash_attention(q, k, v, False)
+        else:
+            scale = 1.0 / math.sqrt(e // h)
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                           preferred_element_type=jnp.float32) * scale
+            p = masked_softmax_dropout(
+                s, mask=attn_mask, dropout_rate=self.dropout,
+                rng=dropout_rng, deterministic=deterministic)
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+        out = nn.Dense(e, use_bias=self.bias, name="out_proj",
+                       dtype=self.dtype)(_merge_heads(ctx).astype(query.dtype))
+        if self.include_norm_add:
+            out = out + residual
+        return out

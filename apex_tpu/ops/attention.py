@@ -1,0 +1,275 @@
+"""Attention kernels — the TPU-native counterpart of the reference's fused
+multihead-attention extensions (apex/contrib/csrc/multihead_attn/: CUTLASS
+strided-batched GEMMs + fused softmax headers, softmax.h:2003), redesigned as
+a Pallas flash-attention kernel (blockwise online softmax, never
+materializing the (Sq, Sk) score matrix in HBM), plus:
+
+  * a jnp reference path (the ``impl='default'`` PyTorch path of the
+    reference modules) that also returns the per-row logsumexp, and
+  * **ring attention** for sequence/context parallelism over a mesh axis
+    (``ppermute`` of K/V shards around the ring with numerically-stable
+    partial-softmax merging). The reference has no distributed attention
+    (SURVEY.md §5.7) — this is the long-context capability the TPU framework
+    adds, built on the same blockwise math.
+
+Shapes follow (batch, heads, seq, head_dim) throughout.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+# ---------------------------------------------------------------------------
+# Reference (jnp) attention — also the backward path for the flash kernel
+# ---------------------------------------------------------------------------
+
+def attention_reference(q, k, v, *, bias=None, causal=False,
+                        scale: Optional[float] = None,
+                        return_lse: bool = False):
+    """Plain attention in fp32 softmax (the ``impl='default'`` path of the
+    reference modules, e.g. self_multihead_attn.py:26)."""
+    d = q.shape[-1]
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(col <= row + (sk - sq), s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bhkd->bhqd", (p / l).astype(v.dtype), v,
+                     preferred_element_type=jnp.float32).astype(q.dtype)
+    if return_lse:
+        return out, (m + jnp.log(l))[..., 0]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Flash attention (Pallas forward; recompute backward)
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(scale, causal, s_actual, bq, bk, nk,
+                      q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      acc_scr, m_scr, l_scr):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    q = q_ref[0].astype(jnp.float32)           # (bq, d)
+    k = k_ref[0].astype(jnp.float32)           # (bk, d)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # (bq, bk)
+
+    row = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    col = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    mask = col < s_actual
+    if causal:
+        mask = mask & (col <= row)
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_prev = m_scr[:, :1]                       # (bq, 1)
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new)                      # (bq, bk)
+    corr = jnp.exp(m_prev - m_new)              # (bq, 1)
+    l_new = corr * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    acc_scr[:] = corr * acc_scr[:] + pv
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:, :1] + jnp.log(l))[:, 0]
+
+
+def _flash_fwd(q, k, v, *, causal: bool, scale: float,
+               block_q: int = 256, block_k: int = 256):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    dtype = q.dtype
+
+    # pad head_dim to lane multiple, seq to block multiples
+    dp = ((d + 127) // 128) * 128
+    bq = min(block_q, max(128, 1 << (sq - 1).bit_length()))
+    bq = min(bq, ((sq + 127) // 128) * 128)
+    bk = min(block_k, ((sk + 127) // 128) * 128)
+    sqp = ((sq + bq - 1) // bq) * bq
+    skp = ((sk + bk - 1) // bk) * bk
+
+    def pad3(x, s_to, d_to):
+        return jnp.pad(x, ((0, 0), (0, s_to - x.shape[1]),
+                           (0, d_to - x.shape[2])))
+
+    qf = pad3(q.reshape(b * h, sq, d), sqp, dp)
+    kf = pad3(k.reshape(b * h, sk, d), skp, dp)
+    vf = pad3(v.reshape(b * h, sk, d), skp, dp)
+
+    nq = sqp // bq
+    nk = skp // bk
+    grid = (b * h, nq, nk)
+
+    out, lse = pl.pallas_call(
+        functools.partial(_flash_fwd_kernel, scale, causal, sk, bq, bk, nk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, dp), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, bk, dp), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, bk, dp), lambda bh, iq, ik: (bh, ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, dp), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, bq), lambda bh, iq, ik: (bh, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sqp, dp), dtype),
+            jax.ShapeDtypeStruct((b * h, sqp), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, dp), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qf, kf, vf)
+    out = out[:, :sq, :d].reshape(b, h, sq, d)
+    lse = lse[:, :sq].reshape(b, h, sq)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None):
+    """Flash attention (Pallas fwd). Backward currently recomputes standard
+    attention under XLA (correct; O(S^2) memory only inside the bwd fusion).
+    A Pallas backward kernel is the planned optimization."""
+    scale = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
+    out, _ = _flash_fwd(q, k, v, causal=causal, scale=scale)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale):
+    scale_ = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
+    out, _ = _flash_fwd(q, k, v, causal=causal, scale=scale_)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, scale, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention_reference(
+            q_, k_, v_, causal=causal, scale=scale), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def self_attention(q, k, v, *, causal=False, scale=None, impl="auto"):
+    """Dispatch: Pallas flash on TPU, jnp reference elsewhere/when asked."""
+    if impl == "auto":
+        impl = "flash" if not _interpret() else "default"
+    if impl == "flash":
+        return flash_attention(q, k, v, causal, scale)
+    return attention_reference(q, k, v, causal=causal, scale=scale)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention (sequence parallelism over a mesh axis)
+# ---------------------------------------------------------------------------
+
+def _merge_partials(o1, lse1, o2, lse2):
+    """Numerically-stable merge of two partial attention results."""
+    m = jnp.maximum(lse1, lse2)
+    w1 = jnp.exp(lse1 - m)[..., None]
+    w2 = jnp.exp(lse2 - m)[..., None]
+    o = (o1.astype(jnp.float32) * w1 + o2.astype(jnp.float32) * w2) / \
+        (w1 + w2)
+    lse = m + jnp.log(w1[..., 0] + w2[..., 0])
+    return o, lse
+
+
+def ring_self_attention(q, k, v, axis_name: str, *, causal: bool = False,
+                        scale: Optional[float] = None):
+    """Ring attention: each device holds a sequence shard (B, H, S_local, D);
+    K/V shards rotate around the ring via ``lax.ppermute`` while each device
+    accumulates its queries' attention over every K/V chunk with blockwise
+    stable softmax merging.
+
+    Communication pattern: world-1 ppermute steps over ICI neighbors —
+    the sequence-parallel analog of the reference's NCCL ring allreduce,
+    except the payload is K/V activations (long-context scaling).
+
+    Causal masking uses global positions: query block ``r`` attends to key
+    block ``src`` fully when src < r, diagonally when src == r, not at all
+    when src > r.
+    """
+    world = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    b, h, s_loc, d = q.shape
+    scale_ = (1.0 / math.sqrt(d)) if scale is None else scale
+
+    def chunk_attn(q_, k_, v_, mode):
+        # mode: 0 = full, 1 = causal-diagonal, 2 = skip
+        def full(_):
+            return attention_reference(q_, k_, v_, scale=scale_,
+                                       return_lse=True)
+
+        def diag(_):
+            return attention_reference(q_, k_, v_, causal=True,
+                                       scale=scale_, return_lse=True)
+
+        def skip(_):
+            return (jnp.zeros_like(q_),
+                    jnp.full((b, h, s_loc), NEG_INF, jnp.float32))
+
+        return jax.lax.switch(mode, [full, diag, skip], None)
+
+    def body(i, carry):
+        o, lse, kc, vc = carry
+        src = (rank - i) % world  # which shard we currently hold
+        if causal:
+            mode = jnp.where(src == rank, 1, jnp.where(src < rank, 0, 2))
+        else:
+            mode = jnp.zeros((), jnp.int32)
+        o_i, lse_i = chunk_attn(q, kc, vc, mode)
+        o, lse = _merge_partials(o, lse, o_i, lse_i)
+        perm = [(j, (j + 1) % world) for j in range(world)]
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (o, lse, kc, vc)
+
+    o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    lse0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
+    o, lse, _, _ = jax.lax.fori_loop(0, world, body, (o0, lse0, k, v))
+    return o.astype(q.dtype)

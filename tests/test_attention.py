@@ -1,0 +1,175 @@
+"""Attention tests — port of the reference MHA parity suite
+(apex/contrib/test/: fast impl vs default impl equality) plus ring-attention
+correctness for the added sequence-parallel path."""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import parallel
+from apex_tpu.ops.attention import (attention_reference, flash_attention,
+                                    ring_self_attention)
+from apex_tpu.contrib.multihead_attn import (SelfMultiheadAttn,
+                                             EncdecMultiheadAttn,
+                                             masked_softmax_dropout)
+
+
+def qkv(key, b=2, h=4, s=128, d=64, dtype=jnp.float32):
+    ks = jax.random.split(key, 3)
+    mk = lambda k: jax.random.normal(k, (b, h, s, d), dtype)
+    return mk(ks[0]), mk(ks[1]), mk(ks[2])
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("s", [128, 256, 200])  # 200: padding path
+def test_flash_matches_reference(causal, s):
+    q, k, v = qkv(jax.random.PRNGKey(0), s=s)
+    out_ref = attention_reference(q, k, v, causal=causal)
+    out_flash = flash_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out_flash), np.asarray(out_ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_cross_attention_lengths():
+    # sq != sk
+    q, _, _ = qkv(jax.random.PRNGKey(1), s=128)
+    _, k, v = qkv(jax.random.PRNGKey(2), s=384)
+    out_ref = attention_reference(q, k, v)
+    out_flash = flash_attention(q, k, v, False)
+    np.testing.assert_allclose(np.asarray(out_flash), np.asarray(out_ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_grads_match_reference():
+    q, k, v = qkv(jax.random.PRNGKey(3), s=128)
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3,
+                                   atol=2e-4)
+
+
+def test_flash_bf16():
+    q, k, v = qkv(jax.random.PRNGKey(4), s=128, dtype=jnp.bfloat16)
+    out_ref = attention_reference(q, k, v, causal=True)
+    out_flash = flash_attention(q, k, v, True)
+    assert out_flash.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out_flash, np.float32),
+                               np.asarray(out_ref, np.float32),
+                               rtol=3e-2, atol=3e-2)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention
+# ---------------------------------------------------------------------------
+
+NDEV = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return parallel.make_mesh(axis_names=("seq",))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(mesh, causal):
+    b, h, s, d = 2, 2, NDEV * 32, 32
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(ks[0], (b, h, s, d))
+    k = jax.random.normal(ks[1], (b, h, s, d))
+    v = jax.random.normal(ks[2], (b, h, s, d))
+
+    want = attention_reference(q, k, v, causal=causal)
+
+    def per_device(q_, k_, v_):
+        return ring_self_attention(q_, k_, v_, "seq", causal=causal)
+
+    got = jax.jit(shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(None, None, "seq", None),) * 3,
+        out_specs=P(None, None, "seq", None), check_vma=False))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# Modules (fast vs default impl parity — the reference contrib test shape)
+# ---------------------------------------------------------------------------
+
+def test_self_mha_fast_vs_default():
+    e, h = 64, 4
+    x = jax.random.normal(jax.random.PRNGKey(6), (2, 128, e))
+    m_fast = SelfMultiheadAttn(embed_dim=e, num_heads=h, impl="fast")
+    m_def = SelfMultiheadAttn(embed_dim=e, num_heads=h, impl="default")
+    params = m_fast.init(jax.random.PRNGKey(7), x)
+    y1 = m_fast.apply(params, x)
+    y2 = m_def.apply(params, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_self_mha_norm_add():
+    e, h = 32, 2
+    x = jax.random.normal(jax.random.PRNGKey(8), (2, 64, e))
+    m = SelfMultiheadAttn(embed_dim=e, num_heads=h, include_norm_add=True,
+                          impl="default")
+    params = m.init(jax.random.PRNGKey(9), x)
+    y = m.apply(params, x)
+    assert "FusedLayerNorm_0" in params["params"]
+    # residual: zeroing the attention output path must return x itself
+    zeroed = jax.tree.map(jnp.zeros_like, params)
+    y0 = m.apply(zeroed, x)
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(x), atol=1e-6)
+
+
+def test_self_mha_additive_mask():
+    e, h, s = 32, 2, 16
+    x = jax.random.normal(jax.random.PRNGKey(10), (1, s, e))
+    m = SelfMultiheadAttn(embed_dim=e, num_heads=h, impl="default")
+    params = m.init(jax.random.PRNGKey(11), x)
+    # mask out the second half of keys
+    mask = jnp.where(jnp.arange(s) < s // 2, 0.0, -1e30)[None, None, None, :]
+    y = m.apply(params, x, attn_mask=mask)
+    # equivalent: truncate keys — recompute manually via module on half seq?
+    # instead check masked vs unmasked differ and masked==masked (determinism)
+    y2 = m.apply(params, x, attn_mask=mask)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(y2))
+    y_unmasked = m.apply(params, x)
+    assert not np.allclose(np.asarray(y), np.asarray(y_unmasked))
+
+
+def test_encdec_mha():
+    e, h = 32, 2
+    q = jax.random.normal(jax.random.PRNGKey(12), (2, 24, e))
+    kv = jax.random.normal(jax.random.PRNGKey(13), (2, 48, e))
+    m = EncdecMultiheadAttn(embed_dim=e, num_heads=h, impl="default")
+    params = m.init(jax.random.PRNGKey(14), q, kv)
+    y = m.apply(params, q, kv)
+    assert y.shape == (2, 24, e)
+    m_fast = EncdecMultiheadAttn(embed_dim=e, num_heads=h, impl="fast")
+    y_fast = m_fast.apply(params, q, kv)
+    np.testing.assert_allclose(np.asarray(y_fast), np.asarray(y), rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_masked_softmax_dropout_deterministic():
+    s = jax.random.normal(jax.random.PRNGKey(15), (2, 4, 8, 8))
+    p = masked_softmax_dropout(s)
+    np.testing.assert_allclose(np.asarray(p.sum(-1)), 1.0, rtol=1e-5)
+    rng = jax.random.PRNGKey(16)
+    pd = masked_softmax_dropout(s, dropout_rate=0.5, rng=rng,
+                                deterministic=False)
+    assert float((np.asarray(pd) == 0).mean()) > 0.3
