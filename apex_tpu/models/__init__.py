@@ -1,1 +1,7 @@
-"""apex_tpu.models (placeholder — populated incrementally)."""
+"""apex_tpu.models — model zoo for the BASELINE workloads (ResNet imagenet,
+DCGAN multi-model, BERT pretrain)."""
+
+from apex_tpu.models.resnet import (ResNet, ResNet18, ResNet34, ResNet50,
+                                    ResNet101, ResNet152)
+from apex_tpu.models.dcgan import Generator, Discriminator
+from apex_tpu.models.bert import BertEncoder, bert_base, bert_large

@@ -1,0 +1,78 @@
+"""BERT-style transformer encoder built from the framework's own fused
+pieces (FusedLayerNorm, fused MHA, fused MLP path, xentropy) — the model
+behind the BASELINE "BERT-large pretrain, FusedLAMB + multi_tensor_l2norm
+grad-clip, 32 chips" config. The reference ships no BERT model (apex is an
+extension library); this is the canonical workload its DistributedFusedLAMB
+was built for (distributed_fused_lamb.py BERT-scale docs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
+
+
+class TransformerLayer(nn.Module):
+    hidden: int
+    heads: int
+    mlp_dim: int
+    dropout: float = 0.0
+    impl: str = "fast"
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        h = SelfMultiheadAttn(
+            embed_dim=self.hidden, num_heads=self.heads, bias=True,
+            dropout=self.dropout, impl=self.impl, dtype=self.dtype)(
+                x, deterministic=deterministic)
+        x = FusedLayerNorm(normalized_shape=self.hidden)(x + h)
+        m = nn.Dense(self.mlp_dim, dtype=self.dtype)(x)
+        m = nn.gelu(m)
+        m = nn.Dense(self.hidden, dtype=self.dtype)(m)
+        return FusedLayerNorm(normalized_shape=self.hidden)(x + m)
+
+
+class BertEncoder(nn.Module):
+    """Masked-LM encoder. bert-large: hidden=1024, layers=24, heads=16."""
+
+    vocab_size: int = 30522
+    hidden: int = 1024
+    layers: int = 24
+    heads: int = 16
+    mlp_dim: int = 4096
+    max_len: int = 512
+    dropout: float = 0.0
+    impl: str = "fast"
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, tokens, *, deterministic: bool = True):
+        pos = jnp.arange(tokens.shape[1])
+        x = nn.Embed(self.vocab_size, self.hidden, name="tok_emb")(tokens)
+        x = x + nn.Embed(self.max_len, self.hidden, name="pos_emb")(pos)
+        x = FusedLayerNorm(normalized_shape=self.hidden)(x)
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
+        for _ in range(self.layers):
+            x = TransformerLayer(
+                hidden=self.hidden, heads=self.heads, mlp_dim=self.mlp_dim,
+                dropout=self.dropout, impl=self.impl, dtype=self.dtype)(
+                    x, deterministic=deterministic)
+        logits = nn.Dense(self.vocab_size, dtype=self.dtype,
+                          name="mlm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+def bert_large(**kw) -> BertEncoder:
+    return BertEncoder(hidden=1024, layers=24, heads=16, mlp_dim=4096, **kw)
+
+
+def bert_base(**kw) -> BertEncoder:
+    return BertEncoder(hidden=768, layers=12, heads=12, mlp_dim=3072, **kw)

@@ -1,0 +1,70 @@
+"""DCGAN generator/discriminator in flax (NHWC) — the models behind the
+reference's multi-model/multi-loss amp example (examples/dcgan/main_amp.py:
+two models, two optimizers, three backward passes per step exercising
+``num_losses``/``loss_id`` amp plumbing)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+class Generator(nn.Module):
+    """latent (B, 1, 1, nz) -> image (B, 64, 64, nc)."""
+
+    nz: int = 100
+    ngf: int = 64
+    nc: int = 3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, z, train: bool = True):
+        bn = lambda name: nn.BatchNorm(use_running_average=not train,
+                                       momentum=0.9, dtype=self.dtype,
+                                       name=name)
+        x = nn.ConvTranspose(self.ngf * 8, (4, 4), (1, 1), padding="VALID",
+                             use_bias=False, dtype=self.dtype)(z)
+        x = nn.relu(bn("bn0")(x))                        # 4x4
+        x = nn.ConvTranspose(self.ngf * 4, (4, 4), (2, 2), padding="SAME",
+                             use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(bn("bn1")(x))                        # 8x8
+        x = nn.ConvTranspose(self.ngf * 2, (4, 4), (2, 2), padding="SAME",
+                             use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(bn("bn2")(x))                        # 16x16
+        x = nn.ConvTranspose(self.ngf, (4, 4), (2, 2), padding="SAME",
+                             use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(bn("bn3")(x))                        # 32x32
+        x = nn.ConvTranspose(self.nc, (4, 4), (2, 2), padding="SAME",
+                             use_bias=False, dtype=self.dtype)(x)
+        return jnp.tanh(x)                               # 64x64
+
+
+class Discriminator(nn.Module):
+    """image (B, 64, 64, nc) -> logit (B,)."""
+
+    ndf: int = 64
+    nc: int = 3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        lrelu = lambda x: nn.leaky_relu(x, 0.2)
+        bn = lambda name: nn.BatchNorm(use_running_average=not train,
+                                       momentum=0.9, dtype=self.dtype,
+                                       name=name)
+        x = lrelu(nn.Conv(self.ndf, (4, 4), (2, 2), padding="SAME",
+                          use_bias=False, dtype=self.dtype)(x))     # 32
+        x = lrelu(bn("bn0")(nn.Conv(self.ndf * 2, (4, 4), (2, 2),
+                                    padding="SAME", use_bias=False,
+                                    dtype=self.dtype)(x)))          # 16
+        x = lrelu(bn("bn1")(nn.Conv(self.ndf * 4, (4, 4), (2, 2),
+                                    padding="SAME", use_bias=False,
+                                    dtype=self.dtype)(x)))          # 8
+        x = lrelu(bn("bn2")(nn.Conv(self.ndf * 8, (4, 4), (2, 2),
+                                    padding="SAME", use_bias=False,
+                                    dtype=self.dtype)(x)))          # 4
+        x = nn.Conv(1, (4, 4), (1, 1), padding="VALID", use_bias=False,
+                    dtype=self.dtype)(x)                            # 1x1
+        return x.reshape(x.shape[0]).astype(jnp.float32)

@@ -1,0 +1,140 @@
+"""ResNet v1.5 in flax (NHWC, TPU-native layout) — the model behind the
+reference's flagship config (examples/imagenet/main_amp.py uses torchvision
+resnet; the model itself is standard, re-implemented here for TPU).
+
+Supports swapping the norm layer for :class:`apex_tpu.parallel.SyncBatchNorm`
+(the DDP+SyncBN 8-chip BASELINE config) via ``axis_name``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+ModuleDef = Any
+
+
+class ResNetBlock(nn.Module):
+    filters: int
+    norm: ModuleDef
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), self.strides, padding=[(1, 1), (1, 1)],
+                    use_bias=False, dtype=self.dtype)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding=[(1, 1), (1, 1)],
+                    use_bias=False, dtype=self.dtype)(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), self.strides,
+                               use_bias=False, dtype=self.dtype)(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    norm: ModuleDef
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False,
+                    dtype=self.dtype)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), self.strides,
+                    padding=[(1, 1), (1, 1)], use_bias=False,
+                    dtype=self.dtype)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False,
+                    dtype=self.dtype)(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters * 4, (1, 1), self.strides,
+                               use_bias=False, dtype=self.dtype)(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = None   # set to sync BN stats over a mesh axis
+    bn_momentum: float = 0.1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if self.axis_name is not None:
+            axis_name = self.axis_name
+            bn_momentum = self.bn_momentum
+
+            def norm_def(scale_init=None, name=None):
+                def build(features):
+                    return SyncBatchNorm(
+                        features=features, momentum=bn_momentum,
+                        axis_name=axis_name,
+                        use_running_average=not train)
+                return _DeferredNorm(build, name=name)
+        else:
+            def norm_def(scale_init=nn.initializers.ones, name=None):
+                return nn.BatchNorm(
+                    use_running_average=not train,
+                    momentum=1.0 - self.bn_momentum,  # flax: decay
+                    epsilon=1e-5, dtype=self.dtype,
+                    scale_init=scale_init, name=name)
+
+        x = nn.Conv(self.num_filters, (7, 7), (2, 2),
+                    padding=[(3, 3), (3, 3)], use_bias=False,
+                    dtype=self.dtype, name="conv_init")(x)
+        x = norm_def(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_size in enumerate(self.stage_sizes):
+            for j in range(block_size):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(
+                    self.num_filters * 2 ** i, norm=norm_def,
+                    strides=strides, dtype=self.dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+class _DeferredNorm(nn.Module):
+    """Adapter letting SyncBatchNorm (which needs static ``features``) plug
+    into the norm-factory slot where flax BatchNorm infers features."""
+    build: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        return self.build(x.shape[-1])(x)
+
+
+ResNet18 = functools.partial(ResNet, stage_sizes=[2, 2, 2, 2],
+                             block_cls=ResNetBlock)
+ResNet34 = functools.partial(ResNet, stage_sizes=[3, 4, 6, 3],
+                             block_cls=ResNetBlock)
+ResNet50 = functools.partial(ResNet, stage_sizes=[3, 4, 6, 3],
+                             block_cls=BottleneckBlock)
+ResNet101 = functools.partial(ResNet, stage_sizes=[3, 4, 23, 3],
+                              block_cls=BottleneckBlock)
+ResNet152 = functools.partial(ResNet, stage_sizes=[3, 8, 36, 3],
+                              block_cls=BottleneckBlock)

@@ -1,0 +1,108 @@
+"""Benchmark: ResNet-50 images/sec for a FULL amp training step (forward +
+backward + bucketed grad sync + FusedSGD + loss scaling) on the available
+device — the BASELINE.json headline metric ("ResNet-50 images/sec at amp O2").
+
+On TPU the O2-equivalent level is O5 (bf16 model + fp32 master weights —
+identical mechanics to O2 with bf16 instead of fp16, the fork's own bf16
+opt level, apex/amp/frontend.py:228-246). fp16 O2 is also supported but bf16
+is the MXU-native dtype.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is measured img/s divided by 900 img/s — the commonly reported
+single-V100 ResNet-50 AMP throughput (the reference repo publishes no number,
+BASELINE.md; 900 stands in for the 1-GPU share of the 8xV100 north star).
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+BASELINE_IMG_S = 900.0
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    from apex_tpu import amp, optimizers, parallel, models
+    from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    batch = 256 if on_tpu else 16
+    image = 224 if on_tpu else 64
+    steps, warmup = (30, 5) if on_tpu else (8, 2)
+    opt_level = "O5"
+    log(f"bench: resnet50 amp {opt_level} batch={batch} image={image} "
+        f"on {dev}")
+
+    mesh = parallel.make_mesh(axis_names=("data",))
+    model = models.ResNet50(num_classes=1000)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.ones((2, image, image, 3)), train=False)
+    params32, batch_stats = variables["params"], variables["batch_stats"]
+
+    inner = optimizers.FusedSGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
+    _, aopt = amp.initialize(None, inner, opt_level=opt_level, verbosity=0)
+    params = amp.cast_model(params32, amp.resolve(opt_level))
+    opt_state = aopt.init(params)
+
+    def per_device(params, batch_stats, opt_state, batch):
+        x, y = batch
+
+        def scaled(p):
+            logits, updates = model.apply(
+                {"params": p, "batch_stats": batch_stats}, x, train=True,
+                mutable=["batch_stats"])
+            loss = jnp.mean(softmax_cross_entropy_loss(logits, y))
+            return aopt.scale_loss(loss, opt_state), (loss,
+                                                      updates["batch_stats"])
+
+        grads, (loss, new_bs) = jax.grad(scaled, has_aux=True)(params)
+        grads = parallel.allreduce_gradients(grads, "data")
+        new_params, new_opt_state, _ = aopt.step(grads, params, opt_state)
+        return new_params, new_bs, new_opt_state, jax.lax.pmean(loss, "data")
+
+    rep = P()
+    step_fn = jax.jit(shard_map(
+        per_device, mesh=mesh,
+        in_specs=(rep, rep, rep, (P("data"), P("data"))),
+        out_specs=(rep, rep, rep, rep), check_vma=False))
+
+    shard = NamedSharding(mesh, P("data"))
+    kx, ky = jax.random.split(jax.random.PRNGKey(1))
+    x = jax.device_put(
+        jax.random.normal(kx, (batch, image, image, 3), jnp.float32), shard)
+    y = jax.device_put(
+        jax.random.randint(ky, (batch,), 0, 1000), shard)
+
+    t0 = None
+    for i in range(steps):
+        params, batch_stats, opt_state, loss = step_fn(
+            params, batch_stats, opt_state, (x, y))
+        if i == warmup - 1:
+            jax.block_until_ready(loss)
+            t0 = time.perf_counter()
+            log(f"warmed up after {i + 1} steps, loss={float(loss):.3f}")
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    img_s = batch * (steps - warmup) / dt
+    log(f"{img_s:.1f} img/s ({dt:.2f}s for {steps - warmup} steps)")
+
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec_amp_O5_bf16(O2-equiv)",
+        "value": round(img_s, 1),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,148 @@
+"""DCGAN with amp — the multi-model / multi-optimizer / multi-loss config
+(reference examples/dcgan/main_amp.py:214-253: D-real, D-fake, G losses; two
+optimizers; ``amp.initialize([netD, netG], [optD, optG], num_losses=3)`` and
+three ``scale_loss(..., loss_id=i)`` backwards per iteration).
+
+Here the three losses keep their own scaler states (``num_losses=3``) and the
+D and G updates are two jitted SPMD steps sharing the amp plumbing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu import amp, optimizers, parallel
+from apex_tpu.models import Generator, Discriminator
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--opt-level", default="O4",
+                   choices=["O0", "O1", "O2", "O3", "O4", "O5"])
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--nz", type=int, default=100)
+    p.add_argument("--lr", type=float, default=2e-4)
+    p.add_argument("--beta1", type=float, default=0.5)
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args(argv)
+
+
+def bce_logits(logits, target):
+    # binary cross entropy with logits, mean-reduced (fp32)
+    z = logits.astype(jnp.float32)
+    return jnp.mean(jnp.maximum(z, 0) - z * target +
+                    jnp.log1p(jnp.exp(-jnp.abs(z))))
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    mesh = parallel.make_mesh(axis_names=("data",))
+    netG, netD = Generator(nz=args.nz), Discriminator()
+
+    key = jax.random.PRNGKey(args.seed)
+    kG, kD, key = jax.random.split(key, 3)
+    z0 = jnp.ones((2, 1, 1, args.nz))
+    img0 = jnp.ones((2, 64, 64, 3))
+    varG = netG.init(kG, z0, train=False)
+    varD = netD.init(kD, img0, train=False)
+
+    props = amp.resolve(args.opt_level)
+    # two models, two optimizers, three losses (reference num_losses=3)
+    (applyG, applyD), (aoptG, aoptD) = amp.initialize(
+        [netG.apply, netD.apply],
+        [optimizers.FusedAdam(lr=args.lr, betas=(args.beta1, 0.999)),
+         optimizers.FusedAdam(lr=args.lr, betas=(args.beta1, 0.999))],
+        opt_level=args.opt_level, num_losses=3, verbosity=0)
+
+    pG = amp.cast_model(varG["params"], props)
+    pD = amp.cast_model(varD["params"], props)
+    bsG, bsD = varG["batch_stats"], varD["batch_stats"]
+    stG, stD = aoptG.init(pG), aoptD.init(pD)
+
+    def d_step(pD, bsD, stD, pG, bsG, real, z):
+        """Two D losses (real, fake) with separate loss_ids, one D update —
+        the reference accumulates errD_real+errD_fake grads before optD.step
+        (main_amp.py:224-238)."""
+        fake, _ = applyG({"params": pG, "batch_stats": bsG}, z, train=True,
+                         mutable=["batch_stats"])
+        fake = jax.lax.stop_gradient(fake)
+
+        def loss_real(p):
+            out, new_bs = applyD({"params": p, "batch_stats": bsD}, real,
+                                 train=True, mutable=["batch_stats"])
+            return aoptD.scale_loss(bce_logits(out, 1.0), stD, loss_id=0), \
+                new_bs
+        def loss_fake(p, bs):
+            out, new_bs = applyD({"params": p, "batch_stats": bs}, fake,
+                                 train=True, mutable=["batch_stats"])
+            return aoptD.scale_loss(bce_logits(out, 0.0), stD, loss_id=1), \
+                new_bs
+
+        g_real, new_bs = jax.grad(loss_real, has_aux=True)(pD)
+        g_fake, new_bs = jax.grad(loss_fake, has_aux=True)(
+            pD, new_bs["batch_stats"])
+        # merge the two scaled-grad trees: unscale each by its own loss_id
+        g_real, of0 = aoptD.scaler.unscale(g_real, stD.scaler, 0)
+        g_fake, of1 = aoptD.scaler.unscale(g_fake, stD.scaler, 1)
+        grads = jax.tree.map(lambda a, b: a + b, g_real, g_fake)
+        grads = parallel.allreduce_gradients(grads, "data")
+        # feed pre-unscaled grads through a unit-scale step: emulate by
+        # scaling back with loss 0 scale then stepping with loss_id=0
+        grads = jax.tree.map(
+            lambda g: g * stD.scaler.loss_scale[0].astype(g.dtype), grads)
+        new_pD, new_stD, _ = aoptD.step(grads, pD, stD, loss_id=0)
+        new_stD = new_stD._replace(
+            scaler=aoptD.scaler.update(new_stD.scaler, of1, 1))
+        return new_pD, new_bs["batch_stats"], new_stD
+
+    def g_step(pG, bsG, stG, pD, bsD, z):
+        def loss_g(p):
+            fake, new_bs = applyG({"params": p, "batch_stats": bsG}, z,
+                                  train=True, mutable=["batch_stats"])
+            out, _ = applyD({"params": pD, "batch_stats": bsD}, fake,
+                            train=True, mutable=["batch_stats"])
+            return aoptG.scale_loss(bce_logits(out, 1.0), stG, loss_id=2), \
+                new_bs
+        grads, new_bs = jax.grad(loss_g, has_aux=True)(pG)
+        grads = parallel.allreduce_gradients(grads, "data")
+        new_pG, new_stG, _ = aoptG.step(grads, pG, stG, loss_id=2)
+        return new_pG, new_bs["batch_stats"], new_stG
+
+    rep = P()
+    d_jit = jax.jit(shard_map(
+        d_step, mesh=mesh,
+        in_specs=(rep, rep, rep, rep, rep, P("data"), P("data")),
+        out_specs=(rep, rep, rep), check_vma=False))
+    g_jit = jax.jit(shard_map(
+        g_step, mesh=mesh,
+        in_specs=(rep, rep, rep, rep, rep, P("data")),
+        out_specs=(rep, rep, rep), check_vma=False))
+
+    shard = NamedSharding(mesh, P("data"))
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        key, kz, kr = jax.random.split(key, 3)
+        z = jax.device_put(
+            jax.random.normal(kz, (args.batch_size, 1, 1, args.nz)), shard)
+        real = jax.device_put(
+            jax.random.normal(kr, (args.batch_size, 64, 64, 3)), shard)
+        pD, bsD, stD = d_jit(pD, bsD, stD, pG, bsG, real, z)
+        pG, bsG, stG = g_jit(pG, bsG, stG, pD, bsD, z)
+        if i % 10 == 0:
+            print(f"step {i}: D scale "
+                  f"{[float(s) for s in stD.scaler.loss_scale]}, "
+                  f"G scale {[float(s) for s in stG.scaler.loss_scale]}")
+    jax.block_until_ready(pG)
+    dt = time.perf_counter() - t0
+    print(f"Speed: {args.batch_size * args.steps / dt:.1f} img/s")
+
+
+if __name__ == "__main__":
+    main()
