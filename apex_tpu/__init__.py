@@ -27,4 +27,9 @@ from apex_tpu import parallel
 from apex_tpu import normalization
 from apex_tpu import contrib
 from apex_tpu import fp16_utils
+from apex_tpu import mlp
+from apex_tpu import rnn
+from apex_tpu import reparameterization
+from apex_tpu import sparsity
+from apex_tpu import pyprof
 from apex_tpu import testing

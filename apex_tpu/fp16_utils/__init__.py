@@ -1,1 +1,17 @@
-"""apex_tpu.fp16_utils (placeholder — populated incrementally)."""
+"""apex_tpu.fp16_utils — legacy manual mixed precision (reference L5,
+apex/fp16_utils/: FP16_Optimizer, static/dynamic loss scalers, conversion
+helpers). Deprecated-but-shipped in the reference; provided here for API
+parity. New code should use apex_tpu.amp."""
+
+from apex_tpu.fp16_utils.fp16util import (
+    network_to_half,
+    network_to_bfloat16,
+    convert_network,
+    prep_param_lists,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    clip_grad_norm,
+    to_python_float,
+)
+from apex_tpu.fp16_utils.loss_scaler import LossScaler, DynamicLossScaler
+from apex_tpu.fp16_utils.fp16_optimizer import FP16_Optimizer

@@ -1,0 +1,120 @@
+"""FP16_Optimizer — the legacy manual-mixed-precision wrapper (reference
+apex/fp16_utils/fp16_optimizer.py:13: fp32 master copies, loss scaling,
+``backward``/``update_master_grads``/``clip_master_grads`` surface).
+
+Functional recast: a host-driven eager wrapper around any
+:class:`~apex_tpu.optimizers.base.FusedOptimizer`. For jitted training loops
+use :class:`apex_tpu.amp.AmpOptimizer` — this class exists for users porting
+reference fp16_utils code verbatim.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import ops
+from apex_tpu.fp16_utils.loss_scaler import DynamicLossScaler, LossScaler
+from apex_tpu.fp16_utils.fp16util import (clip_grad_norm,
+                                          master_params_to_model_params)
+
+Tree = Any
+
+
+class FP16_Optimizer:
+    """``FP16_Optimizer(init_optimizer, static_loss_scale=1.0,
+    dynamic_loss_scale=False)`` (fp16_optimizer.py:13-80)."""
+
+    def __init__(self, init_optimizer, model_params: Tree,
+                 static_loss_scale: float = 1.0,
+                 dynamic_loss_scale: bool = False,
+                 dynamic_loss_args: Optional[dict] = None,
+                 verbose: bool = False):
+        self.optimizer = init_optimizer
+        self.model_params = model_params
+        self.master_params = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.float32), model_params)
+        self.opt_state = init_optimizer.init(self.master_params)
+        if dynamic_loss_scale:
+            self.loss_scaler = DynamicLossScaler(**(dynamic_loss_args or {}))
+        else:
+            self.loss_scaler = LossScaler(static_loss_scale)
+        self.overflow = False
+        self._master_grads: Optional[Tree] = None
+        self.verbose = verbose
+
+    @property
+    def loss_scale(self) -> float:
+        return self.loss_scaler.loss_scale
+
+    # -- reference API -----------------------------------------------------
+    def scale_loss(self, loss):
+        """Use as ``grads = jax.grad(lambda p: opt.scale_loss(loss_fn(p)))``
+        — the explicit counterpart of ``optimizer.backward(loss)``
+        (fp16_optimizer.py:373)."""
+        return loss * self.loss_scale
+
+    def backward(self, loss_fn, *args):
+        """Eager convenience: computes scaled grads of ``loss_fn(model_params,
+        *args)`` and stashes them (reference ``backward`` :373)."""
+        grads = jax.grad(
+            lambda p: loss_fn(p, *args) * self.loss_scale)(self.model_params)
+        self.update_master_grads(grads)
+
+    def update_master_grads(self, scaled_grads: Tree) -> None:
+        """Unscale model grads into fp32 master grads + overflow check
+        (reference :436)."""
+        g32 = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32), scaled_grads)
+        unscaled, overflow = ops.multi_tensor_scale(g32, 1.0 / self.loss_scale)
+        self.overflow = bool(overflow)
+        self._master_grads = unscaled
+
+    def clip_master_grads(self, max_norm: float) -> float:
+        """Global-norm clip on the master grads (reference :185)."""
+        if self._master_grads is None:
+            return 0.0
+        self._master_grads, total = clip_grad_norm(self._master_grads,
+                                                   max_norm)
+        return float(total)
+
+    def step(self) -> None:
+        """Skip on overflow, else fused step on masters + copy back
+        (reference step + _master_params_to_model_params)."""
+        self.loss_scaler.update_scale(self.overflow)
+        if self.overflow:
+            if self.verbose:
+                print(f"OVERFLOW! Skipping step, loss scale -> "
+                      f"{self.loss_scale}")
+            self._master_grads = None
+            return
+        assert self._master_grads is not None, \
+            "call update_master_grads (or backward) before step"
+        self.master_params, self.opt_state = self.optimizer.step(
+            self._master_grads, self.master_params, self.opt_state)
+        self.model_params = master_params_to_model_params(
+            self.model_params, self.master_params)
+        self._master_grads = None
+
+    def zero_grad(self) -> None:
+        self._master_grads = None
+
+    # -- checkpointing (reference state_dict/load_state_dict) --------------
+    def state_dict(self) -> dict:
+        return {
+            "loss_scaler": self.loss_scaler.state_dict(),
+            "overflow": self.overflow,
+            "master_params": jax.device_get(self.master_params),
+            "opt_state": jax.device_get(self.opt_state),
+        }
+
+    def load_state_dict(self, d: dict) -> None:
+        self.loss_scaler.load_state_dict(d["loss_scaler"])
+        self.overflow = d["overflow"]
+        self.master_params = jax.tree_util.tree_map(
+            jnp.asarray, d["master_params"])
+        self.opt_state = jax.tree_util.tree_map(jnp.asarray, d["opt_state"])
+        self.model_params = master_params_to_model_params(
+            self.model_params, self.master_params)

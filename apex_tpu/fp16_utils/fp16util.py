@@ -1,0 +1,84 @@
+"""Conversion helpers — parity with apex/fp16_utils/fp16util.py:22-173
+(``network_to_half``, ``convert_network``, ``prep_param_lists``,
+``master_params_to_model_params``, ``clip_grad_norm``), recast for pytrees:
+a "network" is a params pytree; BN params are identified by path (the
+reference checks module classes)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import ops
+from apex_tpu.amp.frontend import is_batchnorm_path
+
+Tree = Any
+
+
+def convert_network(params: Tree, dtype, *,
+                    keep_batchnorm_fp32: bool = True,
+                    bn_predicate: Callable = is_batchnorm_path) -> Tree:
+    """Cast floating leaves to ``dtype``, keeping batchnorm-ish params fp32
+    (fp16util.convert_network/BN_convert_float semantics)."""
+    def cast(path, p):
+        if not jnp.issubdtype(p.dtype, jnp.floating):
+            return p
+        if keep_batchnorm_fp32 and bn_predicate(path):
+            return p.astype(jnp.float32)
+        return p.astype(dtype)
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def network_to_half(params: Tree) -> Tree:
+    """fp16util.network_to_half (:22)."""
+    return convert_network(params, jnp.float16)
+
+
+def network_to_bfloat16(params: Tree) -> Tree:
+    """The fork's bf16 sibling."""
+    return convert_network(params, jnp.bfloat16)
+
+
+def prep_param_lists(params: Tree, flat_master: bool = False,
+                     ) -> Tuple[Tree, Tree]:
+    """(model_params, fp32 master copy); with ``flat_master`` the master is a
+    single flat fp32 bucket (fp16util.prep_param_lists:81-120)."""
+    master = jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.float32), params)
+    if flat_master:
+        buckets, spec = ops.tree_flatten_buckets(master)
+        return params, (buckets, spec)
+    return params, master
+
+
+def master_params_to_model_params(model_params: Tree, master: Tree) -> Tree:
+    """Copy master values into the model dtype (fp16util:129-143). Returns
+    the new model params (functional)."""
+    if isinstance(master, tuple) and len(master) == 2 and \
+            hasattr(master[1], "bucket_specs"):
+        master = ops.tree_unflatten_buckets(*master)
+    return jax.tree_util.tree_map(
+        lambda mp, p: mp.astype(p.dtype), master, model_params)
+
+
+def model_grads_to_master_grads(grads: Tree) -> Tree:
+    """fp32 copies of model grads (fp16util:122-127)."""
+    return jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+
+
+def clip_grad_norm(grads: Tree, max_norm: float,
+                   ) -> Tuple[Tree, jax.Array]:
+    """Global-norm clip (fp16util.clip_grad_norm:146-173). Returns
+    (clipped_grads, total_norm)."""
+    total, _ = ops.multi_tensor_l2norm(grads)
+    coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    clipped = jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * coef).astype(g.dtype), grads)
+    return clipped, total
+
+
+def to_python_float(x) -> float:
+    """fp16util.to_python_float (host sync — use outside jit only)."""
+    return float(jax.device_get(x))

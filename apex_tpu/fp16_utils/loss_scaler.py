@@ -1,0 +1,83 @@
+"""Legacy loss scalers — parity with apex/fp16_utils/loss_scaler.py
+(``LossScaler`` static at :10, ``DynamicLossScaler`` at :47). These are thin
+stateful shells over the functional scaler in apex_tpu.amp.scaler, kept for
+the FP16_Optimizer legacy API. Host-side state; not for use inside jit
+(use amp.LossScaler there)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import ops
+
+
+class LossScaler:
+    """Static scale (reference loss_scaler.py:10-44)."""
+
+    def __init__(self, scale: float = 1.0):
+        self.cur_scale = float(scale)
+
+    @property
+    def loss_scale(self) -> float:
+        return self.cur_scale
+
+    def scale_gradient(self, grads):
+        out, _ = ops.multi_tensor_scale(grads, self.cur_scale)
+        return out
+
+    def unscale(self, grads):
+        out, overflow = ops.multi_tensor_scale(grads, 1.0 / self.cur_scale)
+        return out, bool(overflow)
+
+    def update_scale(self, overflow: bool) -> None:
+        pass  # static
+
+    def state_dict(self):
+        return {"cur_scale": self.cur_scale}
+
+    def load_state_dict(self, d):
+        self.cur_scale = d["cur_scale"]
+
+
+class DynamicLossScaler(LossScaler):
+    """Dynamic scale (reference loss_scaler.py:47-…): x2 growth every
+    ``scale_window`` clean iters, /2 backoff on overflow."""
+
+    def __init__(self, init_scale: float = 2.0 ** 32,
+                 scale_factor: float = 2.0, scale_window: int = 1000,
+                 min_scale: float = 1.0):
+        super().__init__(init_scale)
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_scale = min_scale
+        self.last_overflow_iter = -1
+        self.cur_iter = 0
+
+    def has_overflow(self, grads) -> bool:
+        leaves = jax.tree_util.tree_leaves(grads)
+        for l in leaves:
+            if not bool(jnp.all(jnp.isfinite(l.astype(jnp.float32)))):
+                return True
+        return False
+
+    def update_scale(self, overflow: bool) -> None:
+        if overflow:
+            self.cur_scale = max(self.cur_scale / self.scale_factor,
+                                 self.min_scale)
+            self.last_overflow_iter = self.cur_iter
+        elif (self.cur_iter - self.last_overflow_iter) % \
+                self.scale_window == 0 and self.cur_iter > 0:
+            self.cur_scale *= self.scale_factor
+        self.cur_iter += 1
+
+    def state_dict(self):
+        return {"cur_scale": self.cur_scale, "cur_iter": self.cur_iter,
+                "last_overflow_iter": self.last_overflow_iter}
+
+    def load_state_dict(self, d):
+        self.cur_scale = d["cur_scale"]
+        self.cur_iter = d["cur_iter"]
+        self.last_overflow_iter = d["last_overflow_iter"]

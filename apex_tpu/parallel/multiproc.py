@@ -1,0 +1,50 @@
+"""Launcher analog — the reference ships ``python -m apex.parallel.multiproc``
+(apex/parallel/multiproc.py:12-35), a pre-torchrun one-process-per-GPU
+spawner.
+
+TPU inverts the model: ONE process drives every local chip (SPMD), and
+multi-host pods need one process per host, each calling
+``jax.distributed.initialize``. This module provides that initialization
+hook, so "the launcher" is your scheduler (GKE/xmanager/mpirun) plus::
+
+    python -m apex_tpu.parallel.multiproc train.py --args...
+
+which initializes the distributed runtime from standard env vars
+(COORDINATOR_ADDRESS, NUM_PROCESSES, PROCESS_ID) and then execs the script.
+"""
+
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+
+
+def initialize_distributed() -> None:
+    """Initialize jax.distributed from env vars when present (multi-host);
+    no-op on single host — mirrors the reference's graceful single-GPU path."""
+    import jax
+
+    coord = os.environ.get("COORDINATOR_ADDRESS")
+    nproc = os.environ.get("NUM_PROCESSES")
+    pid = os.environ.get("PROCESS_ID")
+    if coord and nproc and pid:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(nproc),
+            process_id=int(pid))
+
+
+def main() -> None:
+    if len(sys.argv) < 2:
+        print("usage: python -m apex_tpu.parallel.multiproc script.py "
+              "[args...]", file=sys.stderr)
+        sys.exit(1)
+    initialize_distributed()
+    script = sys.argv[1]
+    sys.argv = sys.argv[1:]
+    runpy.run_path(script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

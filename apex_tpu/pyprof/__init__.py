@@ -1,1 +1,18 @@
-"""apex_tpu.pyprof (placeholder — populated incrementally)."""
+"""apex_tpu.pyprof — profiling toolkit (reference apex/pyprof, ~5k LoC of
+NVTX monkey-patching + nvprof sqlite parsing + per-kernel FLOP analysis,
+SURVEY.md §5.1). The TPU-native pipeline:
+
+  1. **annotate** (reference nvtx/nvmarker.py): ``jax.named_scope`` ranges
+     flow into XLA metadata and show up in profiler traces; ``annotate``/
+     ``annotate_module`` wrap functions and flax modules.
+  2. **trace** (reference parse/): ``jax.profiler`` capture to a Perfetto/
+     XPlane trace directory (replaces the nvprof sqlite DB).
+  3. **prof** (reference prof/ 28 analyzer classes): per-computation FLOPs /
+     bytes / arithmetic intensity straight from XLA's own cost model
+     (``compiled.cost_analysis()``) — no hand-written per-op calculators
+     needed; the compiler already knows.
+"""
+
+from apex_tpu.pyprof.annotate import annotate, annotate_module, push, pop
+from apex_tpu.pyprof.prof import analyze, format_report
+from apex_tpu.pyprof.trace import trace, start_trace, stop_trace

@@ -1,0 +1,57 @@
+"""Annotation layer (reference pyprof.nvtx.nvmarker: monkey-patches torch to
+push NVTX ranges with op name + shapes). On TPU, ``jax.named_scope`` attaches
+names to the traced ops so XLA metadata / profiler traces carry them."""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Any, Callable
+
+import jax
+
+_stack: list = []
+
+
+def push(name: str) -> None:
+    """nvtx.range_push analog (usable around eager/host code)."""
+    scope = jax.named_scope(name)
+    scope.__enter__()
+    _stack.append(scope)
+
+
+def pop() -> None:
+    if _stack:
+        _stack.pop().__exit__(None, None, None)
+
+
+def annotate(name_or_fn=None):
+    """Decorator: run the function under a named scope carrying its name and
+    arg shapes/dtypes (the information nvmarker encoded into NVTX ranges)."""
+    def deco(fn, name=None):
+        label = name or getattr(fn, "__name__", "fn")
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with jax.named_scope(label):
+                return fn(*args, **kwargs)
+        return wrapper
+
+    if callable(name_or_fn):
+        return deco(name_or_fn)
+    return lambda fn: deco(fn, name_or_fn)
+
+
+def annotate_module(module):
+    """Wrap a flax module's apply in a named scope per module class (the
+    nn.Module.forward patch of nvmarker)."""
+    name = type(module).__name__
+    orig_apply = module.apply
+
+    @functools.wraps(orig_apply)
+    def apply(*args, **kwargs):
+        with jax.named_scope(name):
+            return orig_apply(*args, **kwargs)
+
+    object.__setattr__(module, "apply", apply)
+    return module

@@ -1,0 +1,61 @@
+"""Op-level efficiency analysis (reference pyprof.prof: 28 hand-written
+per-category FLOP/byte calculators, prof/linear.py, prof/conv.py, ...).
+
+TPU-native: XLA's cost model already computes FLOPs and bytes for every
+compiled computation — ``analyze`` jit-compiles a function and reports
+FLOPs, bytes accessed, arithmetic intensity, and (when available) the
+optimal-seconds estimate, plus peak memory from memory_analysis."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+
+def analyze(fn: Callable, *args, static_argnums=(), **kwargs) -> Dict[str, Any]:
+    """Compile ``fn(*args, **kwargs)`` and return XLA's cost/memory analysis."""
+    compiled = (jax.jit(fn, static_argnums=static_argnums)
+                .lower(*args, **kwargs).compile())
+    cost = compiled.cost_analysis() or {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    out: Dict[str, Any] = {
+        "flops": cost.get("flops"),
+        "bytes_accessed": cost.get("bytes accessed"),
+        "transcendentals": cost.get("transcendentals"),
+        "optimal_seconds": cost.get("optimal_seconds"),
+    }
+    if out["flops"] and out["bytes_accessed"]:
+        out["arithmetic_intensity"] = out["flops"] / out["bytes_accessed"]
+    try:
+        mem = compiled.memory_analysis()
+        out["peak_memory_bytes"] = getattr(mem, "temp_size_in_bytes", None)
+        out["argument_bytes"] = getattr(mem, "argument_size_in_bytes", None)
+        out["output_bytes"] = getattr(mem, "output_size_in_bytes", None)
+    except Exception:
+        pass
+    return out
+
+
+def format_report(stats: Dict[str, Any], *, peak_flops: Optional[float]
+                  = None) -> str:
+    """Readable report; with ``peak_flops`` (e.g. 197e12 for v5e bf16) adds
+    the roofline utilization bound."""
+    lines = []
+    f = stats.get("flops")
+    b = stats.get("bytes_accessed")
+    if f is not None:
+        lines.append(f"flops:            {f:,.0f}")
+    if b is not None:
+        lines.append(f"bytes accessed:   {b:,.0f}")
+    if stats.get("arithmetic_intensity") is not None:
+        lines.append(f"intensity:        "
+                     f"{stats['arithmetic_intensity']:.2f} flop/byte")
+    if stats.get("peak_memory_bytes") is not None:
+        lines.append(f"peak temp memory: {stats['peak_memory_bytes']:,} B")
+    if peak_flops and f:
+        t_compute = f / peak_flops
+        lines.append(f"compute-bound floor: {t_compute * 1e6:.1f} us "
+                     f"@ {peak_flops / 1e12:.0f} TFLOP/s")
+    return "\n".join(lines)

@@ -1,0 +1,28 @@
+"""Trace capture (reference pyprof.parse consumed nvprof sqlite; here the
+XPlane/Perfetto trace from jax.profiler is the artifact — open it with
+TensorBoard or ui.perfetto.dev)."""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+def start_trace(logdir: str = "/tmp/apex_tpu_trace") -> None:
+    jax.profiler.start_trace(logdir)
+
+
+def stop_trace() -> None:
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def trace(logdir: str = "/tmp/apex_tpu_trace"):
+    """``with pyprof.trace("/tmp/t"): step()`` — the cudaProfilerStart/Stop
+    bracket of the reference examples (main_amp.py:330-410)."""
+    start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        stop_trace()

@@ -1,1 +1,78 @@
-"""apex_tpu.reparameterization (placeholder — populated incrementally)."""
+"""apex_tpu.reparameterization — weight normalization (reference
+apex/reparameterization/: ``apply_weight_norm`` via module hooks,
+WeightNorm/Reparameterization classes).
+
+Functional recast: a params-pytree transform. ``weight_norm_init`` splits
+selected kernels into (g, v); ``reparameterize`` reconstitutes
+w = g * v / ||v|| before apply — the same math as the reference's pre-forward
+hook (weight_norm.py), expressed as a pure function the optimizer
+differentiates through.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Tree = Any
+
+_DEFAULT_PAT = re.compile(r"(kernel|weight)", re.IGNORECASE)
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(p, "key", getattr(p, "name", p)))
+                    for p in path)
+
+
+def _norm(v):
+    # norm over all axes except the last (output features) — matching
+    # torch weight_norm's default dim=0 on (out, in) == last-dim features
+    # for flax (in, out) kernels.
+    axes = tuple(range(v.ndim - 1))
+    return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
+
+
+def apply_weight_norm(params: Tree, name_pattern: str = None) -> Tree:
+    """Split matching kernels w into {g: ||w||, v: w} (reference
+    apply_weight_norm, __init__.py:3-49). Returns the reparameterized
+    params tree where each matched leaf becomes {"wn_g", "wn_v"}."""
+    pat = re.compile(name_pattern) if name_pattern else _DEFAULT_PAT
+
+    def split(path, p):
+        if (jnp.issubdtype(p.dtype, jnp.floating) and p.ndim >= 2
+                and pat.search(_path_str(path))):
+            return {"wn_g": _norm(p), "wn_v": p}
+        return p
+
+    return jax.tree_util.tree_map_with_path(split, params)
+
+
+def _is_wn(x):
+    return isinstance(x, dict) and set(x.keys()) == {"wn_g", "wn_v"}
+
+
+def remove_weight_norm(params: Tree) -> Tree:
+    """Collapse (g, v) back to w (reference remove_weight_norm)."""
+    def join(x):
+        if _is_wn(x):
+            return x["wn_g"] * x["wn_v"] / (_norm(x["wn_v"]) + 1e-12)
+        return x
+    return jax.tree_util.tree_map(join, params, is_leaf=_is_wn)
+
+
+def reparameterize(params: Tree) -> Tree:
+    """Reconstitute effective weights for the forward pass — compose as
+    ``model.apply({"params": reparameterize(p)}, x)``; gradients flow to
+    (g, v) (the reference's pre-forward hook, reparameterization.py)."""
+    return remove_weight_norm(params)
+
+
+class WeightNorm:
+    """Class shim mirroring the reference WeightNorm surface."""
+
+    apply = staticmethod(apply_weight_norm)
+    remove = staticmethod(remove_weight_norm)
+    reparameterize = staticmethod(reparameterize)

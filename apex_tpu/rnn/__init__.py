@@ -1,1 +1,184 @@
-"""apex_tpu.rnn (placeholder — populated incrementally)."""
+"""apex_tpu.rnn — RNN cells/stacks (reference apex/RNN: models.py:19-47,
+RNNBackend.py with bidirectionalRNN/stackedRNN, cells.py:12-84 incl. mLSTM).
+
+TPU-native: recurrence via ``lax.scan`` (compiled once, no per-step Python),
+cells as flax modules. Public constructors mirror apex.RNN.models: ``LSTM``,
+``GRU``, ``ReLU``, ``Tanh``, ``mLSTM``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+class RNNCell(nn.Module):
+    """Elman cell with relu/tanh nonlinearity (reference cells RNNReLUCell/
+    RNNTanhCell)."""
+
+    hidden: int
+    nonlinearity: str = "tanh"
+
+    @nn.compact
+    def __call__(self, carry, x):
+        h = carry
+        z = nn.Dense(self.hidden, name="ih")(x) + \
+            nn.Dense(self.hidden, name="hh")(h)
+        act = jnp.tanh if self.nonlinearity == "tanh" else jax.nn.relu
+        h = act(z)
+        return h, h
+
+    def init_carry(self, batch):
+        return jnp.zeros((batch, self.hidden))
+
+
+class LSTMCell(nn.Module):
+    hidden: int
+
+    @nn.compact
+    def __call__(self, carry, x):
+        h, c = carry
+        z = nn.Dense(4 * self.hidden, name="ih")(x) + \
+            nn.Dense(4 * self.hidden, name="hh")(h)
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+
+    def init_carry(self, batch):
+        return (jnp.zeros((batch, self.hidden)),
+                jnp.zeros((batch, self.hidden)))
+
+
+class GRUCell(nn.Module):
+    hidden: int
+
+    @nn.compact
+    def __call__(self, carry, x):
+        h = carry
+        rz = jax.nn.sigmoid(nn.Dense(2 * self.hidden, name="ih_rz")(x) +
+                            nn.Dense(2 * self.hidden, name="hh_rz")(h))
+        r, z = jnp.split(rz, 2, axis=-1)
+        n = jnp.tanh(nn.Dense(self.hidden, name="ih_n")(x) +
+                     r * nn.Dense(self.hidden, name="hh_n")(h))
+        h = (1 - z) * n + z * h
+        return h, h
+
+    def init_carry(self, batch):
+        return jnp.zeros((batch, self.hidden))
+
+
+class mLSTMCell(nn.Module):
+    """Multiplicative LSTM (reference cells.py:12-84 mLSTMRNNCell): the
+    hidden state is modulated by m = (W_mx x) * (W_mh h) before the gates."""
+
+    hidden: int
+
+    @nn.compact
+    def __call__(self, carry, x):
+        h, c = carry
+        m = nn.Dense(self.hidden, use_bias=False, name="mx")(x) * \
+            nn.Dense(self.hidden, use_bias=False, name="mh")(h)
+        z = nn.Dense(4 * self.hidden, name="ih")(x) + \
+            nn.Dense(4 * self.hidden, name="mh_gates")(m)
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+
+    def init_carry(self, batch):
+        return (jnp.zeros((batch, self.hidden)),
+                jnp.zeros((batch, self.hidden)))
+
+
+class RNNLayer(nn.Module):
+    """One (optionally bidirectional) recurrent layer over (B, T, F) via
+    lax.scan (reference bidirectionalRNN, RNNBackend.py:25)."""
+
+    cell_type: str
+    hidden: int
+    bidirectional: bool = False
+    nonlinearity: str = "tanh"
+
+    def _make_cell(self, name):
+        if self.cell_type == "lstm":
+            return LSTMCell(self.hidden, name=name)
+        if self.cell_type == "gru":
+            return GRUCell(self.hidden, name=name)
+        if self.cell_type == "mlstm":
+            return mLSTMCell(self.hidden, name=name)
+        return RNNCell(self.hidden, nonlinearity=self.nonlinearity,
+                       name=name)
+
+    @nn.compact
+    def __call__(self, x, carry=None):
+        batch = x.shape[0]
+        fwd = self._make_cell("fwd")
+        scan = nn.scan(lambda cell, c, xt: cell(c, xt),
+                       variable_broadcast="params",
+                       split_rngs={"params": False},
+                       in_axes=1, out_axes=1)
+        c0 = fwd.init_carry(batch) if carry is None else carry
+        _, out_f = scan(fwd, c0, x)
+        if not self.bidirectional:
+            return out_f
+        bwd = self._make_cell("bwd")
+        c0b = bwd.init_carry(batch)
+        _, out_b = scan(bwd, c0b, x[:, ::-1])
+        return jnp.concatenate([out_f, out_b[:, ::-1]], axis=-1)
+
+
+class StackedRNN(nn.Module):
+    """stackedRNN (RNNBackend.py): n layers with optional dropout between."""
+
+    cell_type: str
+    hidden: int
+    num_layers: int = 1
+    bidirectional: bool = False
+    dropout: float = 0.0
+    nonlinearity: str = "tanh"
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        for i in range(self.num_layers):
+            x = RNNLayer(self.cell_type, self.hidden,
+                         bidirectional=self.bidirectional,
+                         nonlinearity=self.nonlinearity,
+                         name=f"layer_{i}")(x)
+            if self.dropout > 0 and i < self.num_layers - 1:
+                x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
+        return x
+
+
+# -- apex.RNN.models-style constructors (models.py:19-47) -------------------
+
+def LSTM(input_size, hidden_size, num_layers=1, bidirectional=False,
+         dropout=0.0):
+    return StackedRNN("lstm", hidden_size, num_layers, bidirectional,
+                      dropout)
+
+
+def GRU(input_size, hidden_size, num_layers=1, bidirectional=False,
+        dropout=0.0):
+    return StackedRNN("gru", hidden_size, num_layers, bidirectional, dropout)
+
+
+def ReLU(input_size, hidden_size, num_layers=1, bidirectional=False,
+         dropout=0.0):
+    return StackedRNN("rnn", hidden_size, num_layers, bidirectional, dropout,
+                      nonlinearity="relu")
+
+
+def Tanh(input_size, hidden_size, num_layers=1, bidirectional=False,
+         dropout=0.0):
+    return StackedRNN("rnn", hidden_size, num_layers, bidirectional, dropout,
+                      nonlinearity="tanh")
+
+
+def mLSTM(input_size, hidden_size, num_layers=1, bidirectional=False,
+          dropout=0.0):
+    return StackedRNN("mlstm", hidden_size, num_layers, bidirectional,
+                      dropout)

@@ -1,1 +1,134 @@
-"""apex_tpu.sparsity (placeholder — populated incrementally)."""
+"""apex_tpu.sparsity — ASP (Automatic SParsity): 2:4 structured sparsity,
+parity with apex/contrib/sparsity (``ASP`` at asp.py:21,
+``init_model_for_pruning`` at asp.py:28, mask patterns in
+sparse_masklib.py).
+
+Functional recast: masks are a pytree mirroring the params; pruning is
+``params * masks``; the reference's "re-apply masks inside optimizer.step"
+hook becomes a :class:`SparseOptimizer` wrapper whose step re-masks — the
+same invariant (weights stay 2:4 sparse through training) without monkey
+patching.
+
+TPU note: 2:4 sparsity has no TPU hardware acceleration (it targets NVIDIA
+sparse tensor cores); the value preserved here is the *workflow* — train
+dense, prune 2:4, finetune sparse, checkpoint continuity — which is
+hardware-independent.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Tree = Any
+
+
+def m4n2_mask_1d(w: jax.Array) -> jax.Array:
+    """Keep the 2 largest-|w| of every contiguous group of 4 along the last
+    axis (sparse_masklib's m4n2_1d pattern). Last axis must be % 4 == 0."""
+    shape = w.shape
+    g = w.reshape(-1, 4)
+    mag = jnp.abs(g)
+    # rank within each group; keep top-2
+    order = jnp.argsort(mag, axis=1)  # ascending
+    ranks = jnp.zeros_like(order).at[
+        jnp.arange(g.shape[0])[:, None], order].set(
+        jnp.broadcast_to(jnp.arange(4), order.shape))
+    mask = (ranks >= 2).astype(w.dtype)
+    return mask.reshape(shape)
+
+
+def _default_allowed(path, p) -> bool:
+    """Prune 2-D+ kernels whose last dim is a multiple of 4 and that are not
+    norm/bias params (the reference whitelists Linear/Conv weights)."""
+    if p.ndim < 2 or p.shape[-1] % 4 != 0:
+        return False
+    name = "/".join(str(getattr(x, "key", getattr(x, "name", x)))
+                    for x in path).lower()
+    return not any(t in name for t in ("norm", "bn", "bias", "embed"))
+
+
+def compute_sparse_masks(params: Tree,
+                         allowed: Callable = _default_allowed,
+                         pattern: Callable = m4n2_mask_1d) -> Tree:
+    """Masks for every prunable leaf; ones elsewhere (ASP.compute_sparse_masks)."""
+    def mk(path, p):
+        if jnp.issubdtype(p.dtype, jnp.floating) and allowed(path, p):
+            return pattern(p)
+        return jnp.ones_like(p)
+    return jax.tree_util.tree_map_with_path(mk, params)
+
+
+def apply_masks(params: Tree, masks: Tree) -> Tree:
+    return jax.tree_util.tree_map(lambda p, m: p * m.astype(p.dtype),
+                                  params, masks)
+
+
+def sparsity_ratio(params: Tree, masks: Tree) -> float:
+    """Fraction of masked (zeroed) weights across prunable leaves."""
+    zeros = total = 0
+    for m in jax.tree_util.tree_leaves(masks):
+        total += m.size
+        zeros += int(m.size - jnp.sum(m))
+    return zeros / max(total, 1)
+
+
+class SparseOptimizer:
+    """Wraps a FusedOptimizer so each step re-applies the masks — the
+    reference patches ``optimizer.step`` (asp.py hooks); here the wrapper's
+    step composes purely."""
+
+    def __init__(self, inner, masks: Tree):
+        self.inner = inner
+        self.masks = masks
+
+    def init(self, params):
+        return self.inner.init(apply_masks(params, self.masks))
+
+    def step(self, grads, params, state, **kw):
+        # mask grads too so momentum doesn't resurrect pruned weights
+        grads = apply_masks(grads, self.masks)
+        new_p, new_s = self.inner.step(grads, params, state, **kw)
+        return apply_masks(new_p, self.masks), new_s
+
+
+class ASP:
+    """API-shape parity with the reference ASP workflow (asp.py:21-…):
+
+        asp = ASP()
+        params, opt = asp.init_model_for_pruning(params, optimizer)
+        ... train; masks persist via asp.state_dict() ...
+    """
+
+    def __init__(self, mask_calculator: Callable = m4n2_mask_1d,
+                 allowed_layer_names: Optional[str] = None):
+        self.pattern = mask_calculator
+        self._name_re = (re.compile(allowed_layer_names)
+                         if allowed_layer_names else None)
+        self.masks: Optional[Tree] = None
+
+    def _allowed(self, path, p):
+        if self._name_re is not None:
+            name = "/".join(str(getattr(x, "key", getattr(x, "name", x)))
+                            for x in path)
+            if not self._name_re.search(name):
+                return False
+        return _default_allowed(path, p)
+
+    def init_model_for_pruning(self, params: Tree, optimizer=None):
+        self.masks = compute_sparse_masks(params, self._allowed,
+                                          self.pattern)
+        pruned = apply_masks(params, self.masks)
+        if optimizer is None:
+            return pruned
+        return pruned, SparseOptimizer(optimizer, self.masks)
+
+    # checkpoint continuity (reference checkpointing_test_part1/2)
+    def state_dict(self) -> dict:
+        return {"masks": jax.device_get(self.masks)}
+
+    def load_state_dict(self, d: dict) -> None:
+        self.masks = jax.tree_util.tree_map(jnp.asarray, d["masks"])

@@ -1,1 +1,9 @@
-"""apex_tpu.testing (placeholder — populated incrementally)."""
+"""apex_tpu.testing — test gating utilities (reference apex/testing/
+common_utils.py:12-25: TEST_WITH_ROCM / skipIfRocm)."""
+
+from apex_tpu.testing.common_utils import (
+    TEST_WITH_TPU,
+    skipIfNoTpu,
+    skipIfCpu,
+    on_tpu,
+)

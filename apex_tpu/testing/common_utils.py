@@ -1,0 +1,33 @@
+"""Environment gates for tests (reference apex/testing/common_utils.py:1-25:
+``TEST_WITH_ROCM`` env flag + ``skipIfRocm`` decorator). Here the axis is
+CPU-vs-TPU: ``APEX_TPU_TEST_WITH_TPU=1`` opts tests into requiring real
+hardware."""
+
+from __future__ import annotations
+
+import functools
+import os
+import unittest
+
+import jax
+
+TEST_WITH_TPU = os.environ.get("APEX_TPU_TEST_WITH_TPU",
+                               "0").lower() in ("1", "true", "yes")
+
+
+def on_tpu() -> bool:
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def skipIfNoTpu(fn):
+    """Skip unless a TPU backend is present (reference skipIfRocm shape)."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if not on_tpu():
+            raise unittest.SkipTest("test requires TPU")
+        return fn(*args, **kwargs)
+    return wrapper
+
+
+def skipIfCpu(fn):
+    return skipIfNoTpu(fn)

@@ -1,1 +1,1 @@
-"""apex_tpu.utils (placeholder — populated incrementally)."""
+"""apex_tpu.utils — shared small utilities."""

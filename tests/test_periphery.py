@@ -1,0 +1,213 @@
+"""Periphery tests: fp16_utils legacy API, RNN stacks, weight norm
+reparameterization, ASP 2:4 sparsity, pyprof analysis — ports of the
+reference's run_fp16util, RNN usage, and the ASP checkpoint-continuity tests
+(apex/contrib/sparsity/test/checkpointing_test_part1/2.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import fp16_utils, reparameterization, sparsity, pyprof
+from apex_tpu import optimizers
+from apex_tpu import rnn as apex_rnn
+
+
+# ---------------------------------------------------------------------------
+# fp16_utils
+# ---------------------------------------------------------------------------
+
+def test_convert_network_keeps_bn():
+    params = {"Dense_0": {"kernel": jnp.ones((4, 4))},
+              "BatchNorm_0": {"scale": jnp.ones((4,))}}
+    half = fp16_utils.network_to_half(params)
+    assert half["Dense_0"]["kernel"].dtype == jnp.float16
+    assert half["BatchNorm_0"]["scale"].dtype == jnp.float32
+    b16 = fp16_utils.network_to_bfloat16(params)
+    assert b16["Dense_0"]["kernel"].dtype == jnp.bfloat16
+
+
+def test_prep_and_copy_master_params():
+    params = {"w": jnp.ones((8,), jnp.float16)}
+    model, master = fp16_utils.prep_param_lists(params)
+    assert master["w"].dtype == jnp.float32
+    master = {"w": master["w"] * 0.5}
+    model = fp16_utils.master_params_to_model_params(model, master)
+    assert model["w"].dtype == jnp.float16
+    np.testing.assert_allclose(np.asarray(model["w"], np.float32), 0.5)
+
+
+def test_clip_grad_norm():
+    grads = {"a": jnp.full((100,), 3.0), "b": jnp.full((44,), -3.0)}
+    clipped, total = fp16_utils.clip_grad_norm(grads, 1.0)
+    np.testing.assert_allclose(float(total), 3.0 * np.sqrt(144), rtol=1e-5)
+    gnorm_after, _ = __import__("apex_tpu").ops.multi_tensor_l2norm(clipped)
+    np.testing.assert_allclose(float(gnorm_after), 1.0, rtol=1e-4)
+
+
+def test_fp16_optimizer_end_to_end():
+    params = {"w": jnp.ones((16,), jnp.float16)}
+
+    def loss_fn(p, x):
+        return jnp.mean((p["w"].astype(jnp.float32) * x) ** 2)
+
+    opt = fp16_utils.FP16_Optimizer(
+        optimizers.FusedSGD(lr=0.1), params, dynamic_loss_scale=True,
+        dynamic_loss_args={"init_scale": 2.0 ** 8})
+    x = jnp.ones((16,))
+    for _ in range(5):
+        opt.backward(loss_fn, x)
+        opt.step()
+    assert float(jnp.abs(opt.model_params["w"]).max()) < 1.0
+    # checkpoint round-trip
+    sd = opt.state_dict()
+    opt2 = fp16_utils.FP16_Optimizer(
+        optimizers.FusedSGD(lr=0.1), params, dynamic_loss_scale=True)
+    opt2.load_state_dict(sd)
+    np.testing.assert_array_equal(
+        np.asarray(opt2.master_params["w"]),
+        np.asarray(opt.master_params["w"]))
+
+
+def test_fp16_optimizer_overflow_skips():
+    params = {"w": jnp.ones((4,), jnp.float16)}
+    opt = fp16_utils.FP16_Optimizer(
+        optimizers.FusedSGD(lr=0.1), params, dynamic_loss_scale=True,
+        dynamic_loss_args={"init_scale": 4.0})
+    before = np.asarray(opt.master_params["w"]).copy()
+    opt.update_master_grads({"w": jnp.full((4,), np.inf, jnp.float16)})
+    assert opt.overflow
+    opt.step()
+    np.testing.assert_array_equal(np.asarray(opt.master_params["w"]), before)
+    assert opt.loss_scale == 2.0
+
+
+# ---------------------------------------------------------------------------
+# RNN
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("ctor", [apex_rnn.LSTM, apex_rnn.GRU,
+                                  apex_rnn.Tanh, apex_rnn.ReLU,
+                                  apex_rnn.mLSTM])
+def test_rnn_shapes(ctor):
+    m = ctor(input_size=8, hidden_size=16, num_layers=2)
+    x = jax.random.normal(jax.random.PRNGKey(0), (3, 10, 8))
+    params = m.init(jax.random.PRNGKey(1), x)
+    y = m.apply(params, x)
+    assert y.shape == (3, 10, 16)
+
+
+def test_rnn_bidirectional():
+    m = apex_rnn.LSTM(input_size=8, hidden_size=16, bidirectional=True)
+    x = jax.random.normal(jax.random.PRNGKey(2), (3, 10, 8))
+    params = m.init(jax.random.PRNGKey(3), x)
+    y = m.apply(params, x)
+    assert y.shape == (3, 10, 32)
+
+
+def test_rnn_grads_flow():
+    m = apex_rnn.GRU(input_size=4, hidden_size=8)
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 6, 4))
+    params = m.init(jax.random.PRNGKey(5), x)
+    g = jax.grad(lambda p: jnp.sum(m.apply(p, x) ** 2))(params)
+    total = sum(float(jnp.abs(l).sum())
+                for l in jax.tree_util.tree_leaves(g))
+    assert total > 0
+
+
+# ---------------------------------------------------------------------------
+# reparameterization
+# ---------------------------------------------------------------------------
+
+def test_weight_norm_roundtrip():
+    params = {"layer": {"kernel": jax.random.normal(jax.random.PRNGKey(6),
+                                                    (8, 4)),
+                        "bias": jnp.zeros((4,))}}
+    wn = reparameterization.apply_weight_norm(params)
+    assert "wn_g" in wn["layer"]["kernel"]
+    back = reparameterization.remove_weight_norm(wn)
+    np.testing.assert_allclose(np.asarray(back["layer"]["kernel"]),
+                               np.asarray(params["layer"]["kernel"]),
+                               rtol=1e-5, atol=1e-6)
+    # bias untouched
+    assert back["layer"]["bias"].shape == (4,)
+
+
+def test_weight_norm_grad_decomposition():
+    params = {"kernel": jax.random.normal(jax.random.PRNGKey(7), (6, 3))}
+    wn = reparameterization.apply_weight_norm(params)
+    assert set(wn["kernel"].keys()) == {"wn_g", "wn_v"}
+
+    def loss(wnp):
+        w = reparameterization.reparameterize(wnp)["kernel"]
+        return jnp.sum(jnp.sin(w))
+
+    g = jax.grad(loss)(wn)
+    assert g["kernel"]["wn_g"].shape == (1, 3)
+    assert g["kernel"]["wn_v"].shape == (6, 3)
+
+
+# ---------------------------------------------------------------------------
+# sparsity (ASP)
+# ---------------------------------------------------------------------------
+
+def test_m4n2_mask():
+    w = jnp.asarray([[0.1, -0.5, 0.3, 0.01, 1.0, 0.2, -0.8, 0.05]])
+    m = sparsity.m4n2_mask_1d(w)
+    np.testing.assert_array_equal(
+        np.asarray(m), [[0, 1, 1, 0, 1, 0, 1, 0]])
+
+
+def test_asp_workflow_and_checkpoint():
+    params = {"dense": {"kernel": jax.random.normal(jax.random.PRNGKey(8),
+                                                    (16, 8)),
+                        "bias": jnp.ones((8,))},
+              "norm": {"scale": jnp.ones((8,))}}
+    asp = sparsity.ASP()
+    pruned, sopt = asp.init_model_for_pruning(
+        params, optimizers.FusedSGD(lr=0.1))
+    # kernel 50% sparse, bias/norm untouched
+    k = np.asarray(pruned["dense"]["kernel"])
+    assert (k == 0).mean() == 0.5
+    np.testing.assert_array_equal(np.asarray(pruned["norm"]["scale"]), 1.0)
+
+    # sparsity survives optimizer steps
+    st = sopt.init(pruned)
+    g = jax.tree.map(jnp.ones_like, pruned)
+    p2, st = sopt.step(g, pruned, st)
+    k2 = np.asarray(p2["dense"]["kernel"])
+    assert ((k2 == 0) == (k == 0)).all()
+
+    # checkpoint continuity (reference checkpointing_test_part1/2)
+    sd = asp.state_dict()
+    asp2 = sparsity.ASP()
+    asp2.load_state_dict(sd)
+    np.testing.assert_array_equal(
+        np.asarray(asp2.masks["dense"]["kernel"]),
+        np.asarray(asp.masks["dense"]["kernel"]))
+
+
+# ---------------------------------------------------------------------------
+# pyprof
+# ---------------------------------------------------------------------------
+
+def test_pyprof_analyze():
+    def f(a, b):
+        return (a @ b).sum()
+
+    a = jnp.ones((128, 128))
+    stats = pyprof.analyze(f, a, a)
+    # 128^3 * 2 flops for the matmul (+ reduce)
+    assert stats["flops"] is not None and stats["flops"] >= 2 * 128 ** 3
+    report = pyprof.format_report(stats, peak_flops=197e12)
+    assert "flops" in report
+
+
+def test_pyprof_annotate():
+    @pyprof.annotate("my_op")
+    def f(x):
+        return x * 2
+
+    y = jax.jit(f)(jnp.ones((4,)))
+    np.testing.assert_array_equal(np.asarray(y), 2.0)
