@@ -1,0 +1,73 @@
+"""Legacy amp handle API — parity with apex/amp/handle.py:170-281
+(``AmpHandle``/``NoOpHandle`` from the pre-``initialize`` era ``amp.init()``)
+and apex/amp/opt.py:9-103 (``OptimWrapper``). The reference keeps these for
+compatibility and hard-errors old flows toward the new API; we do the same.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.amp import interposition
+from apex_tpu.amp.scaler import LossScaler
+
+
+class AmpHandle:
+    """Returned by the legacy ``amp.init()`` (reference handle.py:170).
+
+    Scoped wrapper over the interposition engine + a host-side loss scaler.
+    Prefer ``amp.initialize``.
+    """
+
+    def __init__(self, loss_scale="dynamic", enable_caching: bool = True,
+                 verbose: bool = False, dtype=jnp.float16):
+        self._enabled = True
+        self._dtype = dtype
+        self._cache_enabled = enable_caching
+        self._scaler = LossScaler(loss_scale)
+        self._scaler_state = self._scaler.init()
+        interposition.enable(dtype)
+
+    def is_active(self) -> bool:
+        return self._enabled
+
+    @property
+    def has_cache(self) -> bool:
+        # trace-time casting is CSE'd by XLA; the cache exists implicitly
+        return self._cache_enabled
+
+    @contextlib.contextmanager
+    def scale_loss(self, loss, optimizer):
+        """Legacy context manager. In JAX the backward pass is explicit, so
+        this hard-errors with migration guidance — exactly how the reference
+        directs old flows to the new API (handle.py:17-28)."""
+        raise RuntimeError(
+            "The legacy amp.init()/handle.scale_loss API cannot express a "
+            "JAX backward pass. Use amp.initialize(...) and "
+            "AmpOptimizer.scale_loss/step instead.")
+
+    def _deactivate(self):
+        self._enabled = False
+        interposition.disable()
+
+
+class NoOpHandle:
+    """reference handle.py:263-281."""
+
+    def is_active(self) -> bool:
+        return False
+
+    def _deactivate(self):
+        pass
+
+
+def init(enabled: bool = True, loss_scale="dynamic",
+         enable_caching: bool = True, verbose: bool = False):
+    """Legacy ``amp.init()`` (reference amp.py:75). Returns a handle that
+    activates O1-style interposition globally."""
+    if not enabled:
+        return NoOpHandle()
+    return AmpHandle(loss_scale, enable_caching, verbose)

@@ -2,3 +2,5 @@
 
 from apex_tpu.contrib import optimizers
 from apex_tpu.contrib import xentropy
+from apex_tpu.contrib import groupbn
+from apex_tpu.contrib import multihead_attn

@@ -1,0 +1,62 @@
+"""Group BatchNorm — parity with ``apex.contrib.groupbn.BatchNorm2d_NHWC``
+(apex/contrib/groupbn/batch_norm.py:7-225 over the ``bnp`` extension):
+NHWC batchnorm whose statistics are exchanged across a small group of
+devices (``bn_group``), built in the reference on CUDA IPC peer memory
+(apex/contrib/csrc/groupbn/ipc.cu:50-132) with occupancy-tuned persistent
+kernels for small per-GPU batches.
+
+On TPU the entire IPC machinery disappears: group stat exchange is a psum
+with ``axis_index_groups`` over ICI — :class:`apex_tpu.parallel.
+SyncBatchNorm` already implements it. This module provides the reference's
+constructor surface (``bn_group``, fused add+relu variants) on top of it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.parallel.mesh import subgroups
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+
+class BatchNorm2d_NHWC(nn.Module):
+    """``BatchNorm2d_NHWC(planes, fuse_relu=False, bn_group=1)``
+    (batch_norm.py:7). ``bn_group > 1`` syncs stats over contiguous groups of
+    that many devices on the ``axis_name`` mesh axis; ``world_size`` only
+    needs to be set when bn_group > 1.
+
+    The fused add+relu variant (``bn_addrelu``, batch_norm.py:55) is the
+    ``residual`` argument + ``fuse_relu`` flag: out = relu(bn(x) + residual)
+    — XLA fuses the chain exactly as the bnp kernels hand-fused it.
+    """
+
+    planes: int
+    fuse_relu: bool = False
+    bn_group: int = 1
+    world_size: Optional[int] = None
+    axis_name: Optional[str] = "data"
+    momentum: float = 0.1
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, residual: Optional[jax.Array] = None,
+                 use_running_average: Optional[bool] = None):
+        groups = None
+        axis = None
+        if self.bn_group > 1:
+            world = self.world_size or jax.device_count()
+            groups = subgroups(world, self.bn_group)
+            axis = self.axis_name
+        y = SyncBatchNorm(
+            features=self.planes, eps=self.eps, momentum=self.momentum,
+            axis_name=axis, axis_index_groups=groups,
+            name="bn")(x, use_running_average=use_running_average)
+        if residual is not None:
+            y = y + residual
+        if self.fuse_relu:
+            y = jax.nn.relu(y)
+        return y

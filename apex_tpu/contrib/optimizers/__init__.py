@@ -1,8 +1,10 @@
-"""apex_tpu.contrib.optimizers — ZeRO-style sharded distributed optimizers
-(reference apex/contrib/optimizers/)."""
+"""apex_tpu.contrib.optimizers — ZeRO-style sharded distributed optimizers +
+deprecated legacy shims (reference apex/contrib/optimizers/)."""
 
 from apex_tpu.contrib.optimizers.zero import (
     DistributedFusedAdam,
     DistributedFusedLAMB,
     ZeroState,
 )
+from apex_tpu.contrib.optimizers import deprecated
+from apex_tpu.contrib.optimizers.deprecated import FP16_Optimizer

@@ -1,0 +1,67 @@
+"""Deprecated contrib fused optimizers — parity with
+apex/contrib/optimizers/{fused_adam,fused_sgd,fused_lamb}.py (the older API
+taking explicit ``grads``/``output_params``/``scale`` step arguments, kept in
+the reference for backward compatibility) and their bundled
+``FP16_Optimizer`` (fp16_optimizer.py:4-243).
+
+These shims delegate to the modern apex_tpu.optimizers implementations while
+honoring the old call signature: ``step(grads=..., output_params=...,
+scale=...)`` where output_params receive the low-precision copy of the
+updated master params (the "fp16 model copy" the old kernels wrote)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import optimizers as _opt
+from apex_tpu.fp16_utils.fp16_optimizer import FP16_Optimizer  # re-export
+
+Tree = Any
+
+
+class _DeprecatedShim:
+    _inner_cls = None
+
+    def __init__(self, params: Tree, *args, **kwargs):
+        kwargs.pop("use_mt", None)
+        kwargs.pop("amp_scale_adjustment", None)
+        self.inner = self._inner_cls(*args, **kwargs)
+        self.params = params
+        self.state = self.inner.init(params)
+
+    def step(self, closure=None, grads: Optional[Tree] = None,
+             output_params: Optional[Tree] = None,
+             scale: float = 1.0, grad_norms=None):
+        """Old-style step: explicit grads, optional fused 1/scale, optional
+        low-precision output copy (contrib fused_adam.py's signature)."""
+        if grads is None:
+            raise ValueError("deprecated contrib optimizers require "
+                             "explicit grads= (as in the reference)")
+        self.params, self.state = self.inner.step(
+            grads, self.params, self.state,
+            grad_scale=jnp.asarray(scale, jnp.float32)
+            if scale != 1.0 else None)
+        if output_params is not None:
+            out = jax.tree_util.tree_map(
+                lambda mp, op: mp.astype(op.dtype), self.params,
+                output_params)
+            return self.params, out
+        return self.params
+
+
+class FusedAdam(_DeprecatedShim):
+    """apex/contrib/optimizers/fused_adam.py (206 LoC) shim."""
+    _inner_cls = _opt.FusedAdam
+
+
+class FusedSGD(_DeprecatedShim):
+    """apex/contrib/optimizers/fused_sgd.py (211 LoC) shim."""
+    _inner_cls = _opt.FusedSGD
+
+
+class FusedLAMB(_DeprecatedShim):
+    """apex/contrib/optimizers/fused_lamb.py (208 LoC) shim."""
+    _inner_cls = _opt.FusedLAMB

@@ -1,0 +1,85 @@
+"""L1-tier tests — port of the reference cross-product harness
+(tests/L1/common/main_amp.py + compare.py:36-46): run a small model with
+``--deterministic`` semantics, dump per-iteration losses, and assert
+
+  * bitwise reproducibility: two identical runs produce IDENTICAL losses
+    (``assert loss_e == loss_p`` in the reference), and
+  * cross-opt-level consistency: every opt level converges on the same
+    problem with losses tracking the fp32 run.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu import amp, optimizers
+
+
+class SmallNet(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(8, (3, 3), padding="SAME")(x)
+        x = nn.BatchNorm(use_running_average=False, name="bn")(x)
+        x = nn.relu(x)
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Dense(32)(x)
+        x = nn.relu(x)
+        return nn.Dense(10)(x)
+
+
+def run_training(opt_level, steps=20, seed=0):
+    jax.config.update("jax_default_matmul_precision", "highest")
+    model = SmallNet()
+    key = jax.random.PRNGKey(seed)
+    x = jax.random.normal(key, (16, 8, 8, 3))
+    y = jax.random.randint(jax.random.PRNGKey(seed + 1), (16,), 0, 10)
+
+    variables = model.init(jax.random.PRNGKey(seed + 2), x)
+    params32, bs = variables["params"], variables["batch_stats"]
+
+    apply_fn, aopt = amp.initialize(
+        model.apply, optimizers.FusedSGD(lr=0.05, momentum=0.9),
+        opt_level=opt_level, verbosity=0)
+    params = amp.cast_model(params32, amp.resolve(opt_level))
+    st = aopt.init(params)
+
+    @jax.jit
+    def step(params, bs, st, x, y):
+        def scaled(p):
+            logits, upd = apply_fn({"params": p, "batch_stats": bs}, x,
+                                   mutable=["batch_stats"])
+            onehot = jax.nn.one_hot(y, 10)
+            loss = -jnp.mean(jnp.sum(
+                onehot * jax.nn.log_softmax(logits.astype(jnp.float32)), -1))
+            return aopt.scale_loss(loss, st), (loss, upd["batch_stats"])
+        grads, (loss, new_bs) = jax.grad(scaled, has_aux=True)(params)
+        new_p, new_st, _ = aopt.step(grads, params, st)
+        return new_p, new_bs, new_st, loss
+
+    losses = []
+    for _ in range(steps):
+        params, bs, st, loss = step(params, bs, st, x, y)
+        losses.append(float(loss))
+    return losses
+
+
+def test_bitwise_reproducibility():
+    # reference compare.py: "assert loss_e == loss_p" — bitwise
+    run1 = run_training("O5")
+    run2 = run_training("O5")
+    assert run1 == run2, "identical seeded runs must match bitwise"
+
+
+@pytest.mark.parametrize("opt_level", ["O1", "O2", "O3", "O4", "O5"])
+def test_opt_level_tracks_fp32(opt_level):
+    base = run_training("O0", steps=20)
+    test = run_training(opt_level, steps=20)
+    # both must converge (loss decreases) and end in the same neighborhood
+    assert base[-1] < base[0]
+    assert test[-1] < test[0]
+    tol = 0.15 if opt_level in ("O2", "O3") else 0.1
+    assert abs(test[-1] - base[-1]) < max(tol, 0.2 * base[-1]), (
+        opt_level, base[-1], test[-1])
