@@ -1,0 +1,127 @@
+// apex_tpu host runtime — native C++ counterpart of the reference's host-side
+// C++ layer. What CUDA Apex did on-device or with pinned-host staging maps on
+// TPU to host-side work feeding the XLA runtime:
+//
+//   * apex_flatten / apex_unflatten: multithreaded gather/scatter of many
+//     tensors into one contiguous buffer — the host analog of apex_C.flatten
+//     (reference csrc/flatten_unflatten.cpp:5-18), used for fast host-side
+//     checkpoint packing and bucket staging before device_put.
+//   * apex_augment_batch / apex_normalize: the input-pipeline hot loop
+//     (crop + horizontal flip + uint8->float normalize) that the reference
+//     examples do with a CUDA side-stream prefetcher
+//     (examples/imagenet/main_amp.py:264-317) and DALI; on TPU this runs on
+//     host cores while the chip computes.
+//
+// Pure C ABI (called via ctypes) — no Python.h dependency, so the build is a
+// single g++ -shared with no host Python coupling.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Simple static work partitioner: run fn(i) for i in [0, n) on t threads.
+template <typename F>
+void parallel_for(int64_t n, int threads, F&& fn) {
+  if (threads <= 1 || n < 2) {
+    for (int64_t i = 0; i < n; ++i) fn(i);
+    return;
+  }
+  std::vector<std::thread> pool;
+  std::atomic<int64_t> next(0);
+  pool.reserve(threads);
+  for (int t = 0; t < threads; ++t) {
+    pool.emplace_back([&] {
+      for (;;) {
+        int64_t i = next.fetch_add(1, std::memory_order_relaxed);
+        if (i >= n) return;
+        fn(i);
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Gather n buffers (srcs[i], nbytes[i]) into dst back-to-back.
+void apex_flatten(const void** srcs, const int64_t* nbytes, int n, void* dst,
+                  int threads) {
+  std::vector<int64_t> offs(n);
+  int64_t off = 0;
+  for (int i = 0; i < n; ++i) {
+    offs[i] = off;
+    off += nbytes[i];
+  }
+  parallel_for(n, threads, [&](int64_t i) {
+    std::memcpy(static_cast<char*>(dst) + offs[i], srcs[i], nbytes[i]);
+  });
+}
+
+// Scatter src back into n buffers.
+void apex_unflatten(const void* src, void** dsts, const int64_t* nbytes,
+                    int n, int threads) {
+  std::vector<int64_t> offs(n);
+  int64_t off = 0;
+  for (int i = 0; i < n; ++i) {
+    offs[i] = off;
+    off += nbytes[i];
+  }
+  parallel_for(n, threads, [&](int64_t i) {
+    std::memcpy(dsts[i], static_cast<const char*>(src) + offs[i], nbytes[i]);
+  });
+}
+
+// uint8 HWC -> float32 HWC with per-channel mean/std, elementwise.
+void apex_normalize_u8_to_f32(const uint8_t* in, float* out, int64_t pixels,
+                              int c, const float* mean, const float* stddev,
+                              int threads) {
+  std::vector<float> inv(c);
+  for (int k = 0; k < c; ++k) inv[k] = 1.0f / stddev[k];
+  parallel_for(pixels, threads <= 0 ? 1 : threads, [&](int64_t p) {
+    const uint8_t* src = in + p * c;
+    float* dst = out + p * c;
+    for (int k = 0; k < c; ++k)
+      dst[k] = (static_cast<float>(src[k]) / 255.0f - mean[k]) * inv[k];
+  });
+}
+
+// Batch crop + horizontal flip + normalize:
+//   in:  (n, h, w, c) uint8
+//   out: (n, oh, ow, c) float32
+//   crop_xy: (n, 2) top-left corners; flip: (n,) 0/1
+void apex_augment_batch(const uint8_t* in, int n, int h, int w, int c,
+                        float* out, int oh, int ow, const int32_t* crop_xy,
+                        const uint8_t* flip, const float* mean,
+                        const float* stddev, int threads) {
+  std::vector<float> inv(c);
+  for (int k = 0; k < c; ++k) inv[k] = 1.0f / stddev[k];
+  const int64_t in_img = static_cast<int64_t>(h) * w * c;
+  const int64_t out_img = static_cast<int64_t>(oh) * ow * c;
+  parallel_for(n, threads, [&](int64_t i) {
+    const uint8_t* img = in + i * in_img;
+    float* dst = out + i * out_img;
+    const int y0 = crop_xy[2 * i];
+    const int x0 = crop_xy[2 * i + 1];
+    const bool fl = flip[i] != 0;
+    for (int y = 0; y < oh; ++y) {
+      const uint8_t* row = img + (static_cast<int64_t>(y0 + y) * w + x0) * c;
+      float* drow = dst + static_cast<int64_t>(y) * ow * c;
+      for (int x = 0; x < ow; ++x) {
+        const uint8_t* px = row + static_cast<int64_t>(x) * c;
+        float* dpx = drow + static_cast<int64_t>(fl ? (ow - 1 - x) : x) * c;
+        for (int k = 0; k < c; ++k)
+          dpx[k] = (static_cast<float>(px[k]) / 255.0f - mean[k]) * inv[k];
+      }
+    }
+  });
+}
+
+int apex_host_runtime_version() { return 1; }
+
+}  // extern "C"

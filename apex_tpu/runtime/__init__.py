@@ -1,0 +1,246 @@
+"""apex_tpu.runtime — native host runtime (C++ via ctypes).
+
+The reference's native layer is CUDA-side (csrc/); the TPU-native equivalent
+of "native code where it matters" is the HOST side: XLA owns the chip, the
+host must keep it fed. This package builds ``csrc/host_runtime.cpp`` into a
+shared library on first import (g++ -O3 -shared, cached) and exposes:
+
+  * :func:`flatten_arrays` / :func:`unflatten_array` — multithreaded host
+    gather/scatter (apex_C.flatten analog, csrc/flatten_unflatten.cpp:5-18)
+    for checkpoint packing and host-side bucket staging.
+  * :func:`augment_batch` — the input-pipeline hot loop (crop+flip+normalize,
+    uint8->f32) replacing the reference's CUDA prefetcher normalization
+    (examples/imagenet/main_amp.py:264-317).
+  * :class:`PrefetchLoader` — background-thread pipeline overlapping host
+    augmentation + device transfer with device compute (the data_prefetcher
+    side-stream analog).
+
+Everything degrades gracefully to numpy if the toolchain is unavailable
+(``native_available()``), mirroring the reference's optional-extension
+design (SURVEY.md §1 L0).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import queue
+import subprocess
+import threading
+from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "..", "csrc", "host_runtime.cpp")
+_LIB_PATH = os.path.join(_HERE, "_libapex_host.so")
+
+_lib = None
+_build_err: Optional[str] = None
+
+
+def _build() -> Optional[str]:
+    try:
+        src_mtime = os.path.getmtime(_SRC)
+        if (os.path.exists(_LIB_PATH)
+                and os.path.getmtime(_LIB_PATH) >= src_mtime):
+            return None
+        cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared",
+               "-fPIC", "-pthread", _SRC, "-o", _LIB_PATH]
+        res = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=120)
+        if res.returncode != 0:
+            return res.stderr[-2000:]
+        return None
+    except Exception as e:  # toolchain missing etc.
+        return str(e)
+
+
+def _load():
+    global _lib, _build_err
+    if _lib is not None or _build_err is not None:
+        return _lib
+    _build_err = _build()
+    if _build_err is None:
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.apex_flatten.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_int]
+        lib.apex_unflatten.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int]
+        lib.apex_normalize_u8_to_f32.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int]
+        lib.apex_augment_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int]
+        lib.apex_host_runtime_version.restype = ctypes.c_int
+        _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _default_threads() -> int:
+    return max(1, (os.cpu_count() or 2) - 1)
+
+
+# ---------------------------------------------------------------------------
+# flatten / unflatten
+# ---------------------------------------------------------------------------
+
+def flatten_arrays(arrays: Sequence[np.ndarray],
+                   threads: Optional[int] = None) -> np.ndarray:
+    """Gather numpy arrays into one contiguous 1-D uint8 buffer."""
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    total = sum(a.nbytes for a in arrays)
+    out = np.empty(total, np.uint8)
+    lib = _load()
+    if lib is None:
+        off = 0
+        for a in arrays:
+            out[off:off + a.nbytes] = a.view(np.uint8).reshape(-1)
+            off += a.nbytes
+        return out
+    n = len(arrays)
+    srcs = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrays])
+    sizes = (ctypes.c_int64 * n)(*[a.nbytes for a in arrays])
+    lib.apex_flatten(srcs, sizes, n, out.ctypes.data,
+                     threads or _default_threads())
+    return out
+
+
+def unflatten_array(flat: np.ndarray, templates: Sequence[np.ndarray],
+                    threads: Optional[int] = None) -> List[np.ndarray]:
+    """Scatter a flat buffer into arrays shaped/dtyped like ``templates``."""
+    outs = [np.empty(t.shape, t.dtype) for t in templates]
+    lib = _load()
+    if lib is None:
+        off = 0
+        for o in outs:
+            o.view(np.uint8).reshape(-1)[:] = flat[off:off + o.nbytes]
+            off += o.nbytes
+        return outs
+    n = len(outs)
+    dsts = (ctypes.c_void_p * n)(*[o.ctypes.data for o in outs])
+    sizes = (ctypes.c_int64 * n)(*[o.nbytes for o in outs])
+    flat = np.ascontiguousarray(flat)
+    lib.apex_unflatten(flat.ctypes.data, dsts, sizes, n,
+                       threads or _default_threads())
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# augmentation
+# ---------------------------------------------------------------------------
+
+IMAGENET_MEAN = np.asarray([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.asarray([0.229, 0.224, 0.225], np.float32)
+
+
+def augment_batch(images: np.ndarray, out_hw: Tuple[int, int],
+                  crop_xy: np.ndarray, flip: np.ndarray,
+                  mean: np.ndarray = IMAGENET_MEAN,
+                  std: np.ndarray = IMAGENET_STD,
+                  threads: Optional[int] = None) -> np.ndarray:
+    """(n,h,w,c) uint8 -> cropped/flipped/normalized (n,oh,ow,c) float32."""
+    assert images.dtype == np.uint8 and images.ndim == 4
+    n, h, w, c = images.shape
+    oh, ow = out_hw
+    images = np.ascontiguousarray(images)
+    crop_xy = np.ascontiguousarray(crop_xy.astype(np.int32))
+    flip = np.ascontiguousarray(flip.astype(np.uint8))
+    mean = np.ascontiguousarray(mean.astype(np.float32))
+    std = np.ascontiguousarray(std.astype(np.float32))
+    out = np.empty((n, oh, ow, c), np.float32)
+    lib = _load()
+    if lib is None:
+        for i in range(n):
+            y0, x0 = crop_xy[i]
+            img = images[i, y0:y0 + oh, x0:x0 + ow].astype(np.float32) / 255.0
+            if flip[i]:
+                img = img[:, ::-1]
+            out[i] = (img - mean) / std
+        return out
+    lib.apex_augment_batch(
+        images.ctypes.data, n, h, w, c, out.ctypes.data, oh, ow,
+        crop_xy.ctypes.data, flip.ctypes.data,
+        mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        threads or _default_threads())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# prefetching loader
+# ---------------------------------------------------------------------------
+
+class PrefetchLoader:
+    """Background-thread prefetcher: pulls host batches from ``source``,
+    applies ``transform`` (e.g. augment_batch + device_put), and keeps
+    ``depth`` ready batches queued — overlapping input processing with device
+    compute like the reference's side-stream data_prefetcher
+    (examples/imagenet/main_amp.py:264-317)."""
+
+    _SENTINEL = object()
+
+    def __init__(self, source: Iterator, transform: Optional[Callable] = None,
+                 depth: int = 2, workers: int = 1):
+        self._source = source
+        self._transform = transform or (lambda x: x)
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._threads = []
+        self._lock = threading.Lock()
+        self._stopped = False
+        self._finished_workers = 0
+        for _ in range(max(1, workers)):
+            t = threading.Thread(target=self._worker, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _worker(self):
+        # Every worker pushes exactly one sentinel on exit; the consumer
+        # finishes only after collecting all of them, so a sentinel can
+        # never overtake another worker's in-flight item.
+        try:
+            while True:
+                with self._lock:
+                    if self._stopped:
+                        return
+                    try:
+                        item = next(self._source)
+                    except StopIteration:
+                        self._stopped = True
+                        return
+                self._q.put(self._transform(item))
+        finally:
+            self._q.put(self._SENTINEL)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            item = self._q.get()
+            if item is self._SENTINEL:
+                self._finished_workers += 1
+                if self._finished_workers >= len(self._threads):
+                    raise StopIteration
+                continue
+            return item
+
+    def close(self):
+        with self._lock:
+            self._stopped = True
+        while not self._q.empty():
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
