@@ -41,16 +41,25 @@ _build_err: Optional[str] = None
 
 def _build() -> Optional[str]:
     try:
-        src_mtime = os.path.getmtime(_SRC)
-        if (os.path.exists(_LIB_PATH)
-                and os.path.getmtime(_LIB_PATH) >= src_mtime):
-            return None
-        cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared",
+        # No -march=native: the .so may be shared across hosts (shared
+        # filesystem, baked image) — ISA-portable code avoids SIGILL
+        # there, and the kernels are memcpy/bandwidth-bound anyway.
+        cmd = ["g++", "-O3", "-std=c++17", "-shared",
                "-fPIC", "-pthread", _SRC, "-o", _LIB_PATH]
+        # Cache key = source mtime + exact compile command, so flag
+        # changes invalidate stale builds too.
+        key = f"{os.path.getmtime(_SRC)}\n{' '.join(cmd)}\n"
+        key_path = _LIB_PATH + ".buildinfo"
+        if os.path.exists(_LIB_PATH) and os.path.exists(key_path):
+            with open(key_path) as f:
+                if f.read() == key:
+                    return None
         res = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=120)
         if res.returncode != 0:
             return res.stderr[-2000:]
+        with open(key_path, "w") as f:
+            f.write(key)
         return None
     except Exception as e:  # toolchain missing etc.
         return str(e)
@@ -119,20 +128,29 @@ def flatten_arrays(arrays: Sequence[np.ndarray],
 
 def unflatten_array(flat: np.ndarray, templates: Sequence[np.ndarray],
                     threads: Optional[int] = None) -> List[np.ndarray]:
-    """Scatter a flat buffer into arrays shaped/dtyped like ``templates``."""
+    """Scatter a flat buffer into arrays shaped/dtyped like ``templates``.
+
+    ``flat`` may be any dtype; it is reinterpreted as raw bytes (so the
+    output of :func:`flatten_arrays` round-trips regardless of view)."""
+    flat = np.ascontiguousarray(flat)
+    flat_u8 = flat.view(np.uint8).reshape(-1)
     outs = [np.empty(t.shape, t.dtype) for t in templates]
+    total = sum(o.nbytes for o in outs)
+    if flat_u8.nbytes < total:
+        raise ValueError(
+            f"flat buffer has {flat_u8.nbytes} bytes but templates need "
+            f"{total}")
     lib = _load()
     if lib is None:
         off = 0
         for o in outs:
-            o.view(np.uint8).reshape(-1)[:] = flat[off:off + o.nbytes]
+            o.view(np.uint8).reshape(-1)[:] = flat_u8[off:off + o.nbytes]
             off += o.nbytes
         return outs
     n = len(outs)
     dsts = (ctypes.c_void_p * n)(*[o.ctypes.data for o in outs])
     sizes = (ctypes.c_int64 * n)(*[o.nbytes for o in outs])
-    flat = np.ascontiguousarray(flat)
-    lib.apex_unflatten(flat.ctypes.data, dsts, sizes, n,
+    lib.apex_unflatten(flat_u8.ctypes.data, dsts, sizes, n,
                        threads or _default_threads())
     return outs
 
@@ -151,12 +169,23 @@ def augment_batch(images: np.ndarray, out_hw: Tuple[int, int],
                   std: np.ndarray = IMAGENET_STD,
                   threads: Optional[int] = None) -> np.ndarray:
     """(n,h,w,c) uint8 -> cropped/flipped/normalized (n,oh,ow,c) float32."""
-    assert images.dtype == np.uint8 and images.ndim == 4
+    if images.dtype != np.uint8 or images.ndim != 4:
+        raise ValueError(
+            f"images must be (n,h,w,c) uint8, got {images.dtype} "
+            f"{images.shape}")
     n, h, w, c = images.shape
     oh, ow = out_hw
     images = np.ascontiguousarray(images)
     crop_xy = np.ascontiguousarray(crop_xy.astype(np.int32))
+    if crop_xy.shape != (n, 2):
+        raise ValueError(f"crop_xy must be ({n}, 2), got {crop_xy.shape}")
+    if (np.any(crop_xy < 0) or np.any(crop_xy[:, 0] + oh > h)
+            or np.any(crop_xy[:, 1] + ow > w)):
+        raise ValueError(
+            f"crop_xy out of range for input {h}x{w} with output {oh}x{ow}")
     flip = np.ascontiguousarray(flip.astype(np.uint8))
+    if flip.shape != (n,):
+        raise ValueError(f"flip must be ({n},), got {flip.shape}")
     mean = np.ascontiguousarray(mean.astype(np.float32))
     std = np.ascontiguousarray(std.astype(np.float32))
     out = np.empty((n, oh, ow, c), np.float32)
@@ -172,6 +201,33 @@ def augment_batch(images: np.ndarray, out_hw: Tuple[int, int],
     lib.apex_augment_batch(
         images.ctypes.data, n, h, w, c, out.ctypes.data, oh, ow,
         crop_xy.ctypes.data, flip.ctypes.data,
+        mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        threads or _default_threads())
+    return out
+
+
+def normalize_u8_to_f32(images: np.ndarray,
+                        mean: np.ndarray = IMAGENET_MEAN,
+                        std: np.ndarray = IMAGENET_STD,
+                        threads: Optional[int] = None) -> np.ndarray:
+    """(..., c) uint8 -> float32 via (x/255 - mean) / std per channel."""
+    if images.dtype != np.uint8 or images.ndim < 1:
+        raise ValueError(
+            f"images must be uint8 with a channel axis, got {images.dtype} "
+            f"{images.shape}")
+    c = images.shape[-1]
+    images = np.ascontiguousarray(images)
+    mean = np.ascontiguousarray(
+        np.broadcast_to(np.asarray(mean, np.float32), (c,)))
+    std = np.ascontiguousarray(
+        np.broadcast_to(np.asarray(std, np.float32), (c,)))
+    lib = _load()
+    if lib is None:
+        return (images.astype(np.float32) / 255.0 - mean) / std
+    out = np.empty(images.shape, np.float32)
+    lib.apex_normalize_u8_to_f32(
+        images.ctypes.data, out.ctypes.data, images.size // c, c,
         mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         threads or _default_threads())
@@ -199,16 +255,31 @@ class PrefetchLoader:
         self._threads = []
         self._lock = threading.Lock()
         self._stopped = False
+        self._closing = False
+        self._error: Optional[BaseException] = None
         self._finished_workers = 0
+        self._exhausted = False
         for _ in range(max(1, workers)):
             t = threading.Thread(target=self._worker, daemon=True)
             t.start()
             self._threads.append(t)
 
+    def _put(self, item) -> None:
+        # Interruptible put: a worker blocked on a full queue must notice
+        # close() and bail out instead of pinning its batch forever.
+        while True:
+            try:
+                self._q.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                if self._closing:
+                    return
+
     def _worker(self):
         # Every worker pushes exactly one sentinel on exit; the consumer
         # finishes only after collecting all of them, so a sentinel can
-        # never overtake another worker's in-flight item.
+        # never overtake another worker's in-flight item. A transform/source
+        # exception is captured and re-raised on the consumer side.
         try:
             while True:
                 with self._lock:
@@ -219,28 +290,45 @@ class PrefetchLoader:
                     except StopIteration:
                         self._stopped = True
                         return
-                self._q.put(self._transform(item))
+                self._put(self._transform(item))
+        except BaseException as e:
+            with self._lock:
+                if self._error is None:
+                    self._error = e
+                self._stopped = True
         finally:
-            self._q.put(self._SENTINEL)
+            self._put(self._SENTINEL)
 
     def __iter__(self):
         return self
 
     def __next__(self):
         while True:
+            if self._exhausted:
+                raise StopIteration
             item = self._q.get()
             if item is self._SENTINEL:
                 self._finished_workers += 1
                 if self._finished_workers >= len(self._threads):
+                    self._exhausted = True
+                    if self._error is not None:
+                        err, self._error = self._error, None
+                        raise err
                     raise StopIteration
                 continue
             return item
 
     def close(self):
+        """Stop the workers and drop queued batches. Safe to call early
+        (mid-iteration); the loader is exhausted afterwards."""
         with self._lock:
             self._stopped = True
-        while not self._q.empty():
+        self._closing = True
+        for t in self._threads:
+            t.join(timeout=5.0)
+        while True:
             try:
                 self._q.get_nowait()
             except queue.Empty:
                 break
+        self._exhausted = True

@@ -65,3 +65,67 @@ def test_prefetch_loader_multiworker_complete():
     out = sorted(loader)
     # multi-worker may reorder but must deliver everything exactly once
     assert out == list(range(50))
+
+
+def test_prefetch_loader_propagates_transform_error():
+    def boom(x):
+        if x == 5:
+            raise RuntimeError("corrupt batch")
+        return x
+
+    loader = runtime.PrefetchLoader(iter(range(20)), transform=boom,
+                                    depth=2, workers=1)
+    with pytest.raises(RuntimeError, match="corrupt batch"):
+        list(loader)
+
+
+def test_prefetch_loader_stopiteration_is_sticky():
+    loader = runtime.PrefetchLoader(iter(range(3)), depth=2, workers=1)
+    assert list(loader) == [0, 1, 2]
+    # a second next() must raise again, not hang
+    with pytest.raises(StopIteration):
+        next(loader)
+
+
+def test_prefetch_loader_early_close_unblocks_workers():
+    loader = runtime.PrefetchLoader(iter(range(1000)), depth=1, workers=3)
+    assert next(loader) is not None
+    loader.close()  # workers blocked in put() must exit
+    for t in loader._threads:
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+    with pytest.raises(StopIteration):
+        next(loader)
+
+
+def test_augment_batch_rejects_out_of_range_crop():
+    imgs = np.zeros((2, 40, 40, 3), np.uint8)
+    bad = np.asarray([[0, 0], [9, 9]], np.int32)  # 9+32 > 40
+    with pytest.raises(ValueError):
+        runtime.augment_batch(imgs, (32, 32), bad, np.zeros(2, np.uint8))
+    with pytest.raises(ValueError):
+        runtime.augment_batch(imgs, (32, 32),
+                              np.asarray([[0, 0], [-1, 0]], np.int32),
+                              np.zeros(2, np.uint8))
+
+
+def test_unflatten_rejects_short_buffer():
+    t = np.zeros((10,), np.float32)
+    with pytest.raises(ValueError):
+        runtime.unflatten_array(np.zeros(10, np.uint8), [t])
+
+
+def test_unflatten_accepts_non_u8_view():
+    arrays = [np.arange(6, dtype=np.float32).reshape(2, 3)]
+    flat_f32 = np.arange(6, dtype=np.float32)  # same bytes, f32 view
+    back = runtime.unflatten_array(flat_f32, arrays)
+    np.testing.assert_array_equal(back[0], arrays[0])
+
+
+def test_normalize_u8_to_f32_matches_numpy():
+    rng = np.random.default_rng(3)
+    imgs = rng.integers(0, 256, (2, 8, 8, 3), dtype=np.uint8)
+    got = runtime.normalize_u8_to_f32(imgs)
+    want = ((imgs.astype(np.float32) / 255.0 - runtime.IMAGENET_MEAN)
+            / runtime.IMAGENET_STD)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
