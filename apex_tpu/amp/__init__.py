@@ -25,9 +25,24 @@ from apex_tpu.amp.interposition import (
     low_prec_function,
     float_function,
 )
+from apex_tpu.amp.scale_loss_api import scale_loss
 
 # Apex-compatible aliases (apex/amp/amp.py:29-71).
 half_function = low_prec_function
 bfloat16_function = low_prec_function
 register_half_function = register_low_prec_function
 register_bfloat16_function = register_low_prec_function
+
+
+def promote_function(fn):
+    """Parity with ``amp.promote_function`` (apex/amp/amp.py:63-66). The
+    reference casts mixed fp16/fp32 args to the widest type because torch
+    errors on mixed-dtype ops (wrap.py:66-92); jnp's binary-op promotion
+    already implements widest-wins, so this is the identity."""
+    return fn
+
+
+def register_promote_function(module, name: str) -> None:
+    """Parity with ``amp.register_promote_function`` (amp.py:67-71): a no-op
+    — see :func:`promote_function`."""
+    return None

@@ -1,0 +1,72 @@
+"""Module-level ``amp.scale_loss`` — parity with the reference's central
+training-loop API (apex/amp/handle.py:16-158)::
+
+    with amp.scale_loss(loss, optimizer, state) as scaled_loss:
+        grads = jax.grad(...)   # differentiate scaled_loss
+
+In the reference, ``scale_loss`` is a context manager whose ``__enter__``
+yields ``loss * loss_scale`` and whose ``__exit__`` unscales gradients,
+updates the dynamic scale, and patches ``optimizer.step`` to skip on overflow
+(handle.py:115-158). In JAX the backward pass is an explicit ``jax.grad``
+call and the unscale/skip logic lives inside the jittable
+:meth:`AmpOptimizer.step <apex_tpu.amp.optimizer.AmpOptimizer.step>`
+(a ``lax.cond``-guarded update — no host sync). So here ``__enter__`` yields
+the scaled loss and ``__exit__`` is a no-op; the exit-time work happens when
+the caller invokes ``optimizer.step`` on the scaled grads.
+
+Usable both as a context manager (reference idiom) and as a plain function
+returning the scaled loss (idiomatic JAX — it is safe to call inside jit).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from apex_tpu.amp.optimizer import AmpOptimizer, AmpOptimizerState
+
+
+class _ScaleLoss:
+    """Dual-use return value: context manager AND array-like."""
+
+    def __init__(self, scaled: jax.Array):
+        self.value = scaled
+
+    # -- context-manager protocol (reference idiom) ------------------------
+    def __enter__(self) -> jax.Array:
+        return self.value
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+    # -- array-like delegation so the bare return also works ---------------
+    def __jax_array__(self) -> jax.Array:
+        return self.value
+
+    def __mul__(self, other):
+        return self.value * other
+
+    __rmul__ = __mul__
+
+    def __repr__(self) -> str:
+        return f"_ScaleLoss({self.value!r})"
+
+
+def scale_loss(loss: jax.Array, optimizer: AmpOptimizer,
+               state: Optional[AmpOptimizerState] = None,
+               *, loss_id: int = 0, model=None, delay_unscale: bool = False,
+               ) -> _ScaleLoss:
+    """Scale ``loss`` by the current loss scale of ``optimizer``.
+
+    ``state`` is the :class:`AmpOptimizerState` carried through the training
+    step (functional analog of the mutable ``_amp_state``). ``model`` and
+    ``delay_unscale`` are accepted for reference-signature parity
+    (handle.py:16-21); unscaling is always deferred to ``optimizer.step``.
+    """
+    if state is None:
+        raise TypeError(
+            "amp.scale_loss requires the AmpOptimizerState: "
+            "amp.scale_loss(loss, optimizer, state). JAX state is explicit — "
+            "there is no global _amp_state to consult.")
+    return _ScaleLoss(optimizer.scale_loss(loss, state, loss_id))

@@ -172,3 +172,9 @@ class EncdecMultiheadAttn(nn.Module):
         if self.include_norm_add:
             out = out + residual
         return out
+
+
+# Reference function-name alias (apex/contrib/multihead_attn exposes the
+# standalone masked-softmax-dropout as fast_mask_softmax_dropout_func).
+fast_mask_softmax_dropout_func = masked_softmax_dropout
+__all__.append("fast_mask_softmax_dropout_func")

@@ -7,4 +7,9 @@ from apex_tpu.contrib.optimizers.zero import (
     ZeroState,
 )
 from apex_tpu.contrib.optimizers import deprecated
-from apex_tpu.contrib.optimizers.deprecated import FP16_Optimizer
+from apex_tpu.contrib.optimizers.deprecated import (
+    FP16_Optimizer,
+    FusedAdam,
+    FusedSGD,
+    FusedLAMB,
+)

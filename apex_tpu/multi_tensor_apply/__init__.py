@@ -1,0 +1,15 @@
+"""apex_tpu.multi_tensor_apply — the L1 kernel-dispatch funnel.
+
+API parity with ``apex.multi_tensor_apply`` (reference
+apex/multi_tensor_apply/__init__.py and multi_tensor_apply.py:3-30): a
+``multi_tensor_applier`` singleton through which the amp scaler, fused
+optimizers, and the parallel layer invoke batched whole-model elementwise
+ops.
+"""
+
+from apex_tpu.multi_tensor_apply.multi_tensor_apply import (
+    MultiTensorApply,
+    multi_tensor_applier,
+)
+
+__all__ = ["MultiTensorApply", "multi_tensor_applier"]

@@ -1,0 +1,65 @@
+"""The multi-tensor dispatch funnel (reference
+apex/multi_tensor_apply/multi_tensor_apply.py:3-30).
+
+The reference's ``multi_tensor_applier(op, noop_flag_buffer, tensor_lists,
+*args)`` chunks a list of CUDA tensors into ``TensorListMetadata`` launches
+(csrc/multi_tensor_apply.cuh:41-142, chunk size 2048*32 set in
+apex/multi_tensor_apply/__init__.py). On TPU the ops are functional
+(apex_tpu/ops/multi_tensor.py): a whole pytree goes in, updated pytrees and a
+device-side ``overflow`` scalar come out, and XLA/Pallas does the batching the
+CUDA chunker did by hand — so the applier is a thin invocation funnel kept for
+API parity and as the single seam where dispatch policy (jnp vs Pallas,
+ops/multi_tensor.py:48-67) is centralized.
+
+Calling convention::
+
+    multi_tensor_applier(op, noop_flag, tensor_lists, *args, **kwargs)
+
+``op`` is any functional multi-tensor op following the package convention
+``op(*trees, *args) -> (*out_trees[, overflow])``; ``tensor_lists`` is the
+sequence of input pytrees (positionally matching the reference's
+``tensor_lists`` argument, minus the output lists — outputs are returned,
+not written in place). ``noop_flag`` may be ``None`` or a boolean device
+scalar; when the op reports overflow the applier ORs it into the returned
+flag, preserving the reference's noop-flag accumulation contract
+(csrc/multi_tensor_scale_kernel.cu:30) without a host sync.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+class MultiTensorApply:
+    """Reference multi_tensor_apply.py:3-30. ``available`` is always True on
+    TPU: there is no optional native extension to probe for (the Pallas/jnp
+    paths are part of the package)."""
+
+    available: bool = True
+    warned: bool = False
+
+    def __init__(self, chunk_size: int = 2048 * 32):
+        # Kept for signature parity; XLA picks its own tiling. The Pallas
+        # bucket path (ops/buckets.py) uses its own TPU-lane-aligned chunking.
+        self.chunk_size = chunk_size
+
+    def __call__(self, op, noop_flag: Optional[jax.Array],
+                 tensor_lists: Sequence[Any], *args, **kwargs):
+        out = op(*tensor_lists, *args, **kwargs)
+        if not isinstance(out, tuple):
+            return out
+        # Ops that report overflow return it as a trailing 0-d bool scalar;
+        # fold it into the caller's noop flag (reference kernels set
+        # *noop_flag=1 on inf/nan and the caller reads it later).
+        last = out[-1]
+        if (noop_flag is not None and hasattr(last, "dtype")
+                and getattr(last, "ndim", None) == 0
+                and jnp.issubdtype(last.dtype, jnp.bool_)):
+            return out[:-1] + (jnp.logical_or(noop_flag, last),)
+        return out
+
+
+multi_tensor_applier = MultiTensorApply(2048 * 32)

@@ -23,6 +23,7 @@ design (SURVEY.md §1 L0).
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import queue
 import subprocess
@@ -46,9 +47,13 @@ def _build() -> Optional[str]:
         # there, and the kernels are memcpy/bandwidth-bound anyway.
         cmd = ["g++", "-O3", "-std=c++17", "-shared",
                "-fPIC", "-pthread", _SRC, "-o", _LIB_PATH]
-        # Cache key = source mtime + exact compile command, so flag
-        # changes invalidate stale builds too.
-        key = f"{os.path.getmtime(_SRC)}\n{' '.join(cmd)}\n"
+        # Cache key = source content hash + exact compile command, so flag
+        # or source changes invalidate stale builds, while cp/docker-COPY
+        # mtime resets do not force a rebuild (the .so may ship in a baked
+        # image whose toolchain is absent).
+        with open(_SRC, "rb") as f:
+            src_digest = hashlib.sha256(f.read()).hexdigest()
+        key = f"{src_digest}\n{' '.join(cmd)}\n"
         key_path = _LIB_PATH + ".buildinfo"
         if os.path.exists(_LIB_PATH) and os.path.exists(key_path):
             with open(key_path) as f:
@@ -265,15 +270,20 @@ class PrefetchLoader:
             self._threads.append(t)
 
     def _put(self, item) -> None:
-        # Interruptible put: a worker blocked on a full queue must notice
-        # close() and bail out instead of pinning its batch forever.
+        # Interruptible put: once close() sets _closing, drop everything —
+        # batches AND sentinels. A batch whose transform outlived close()'s
+        # join timeout must not land after the drain (it would pin host/
+        # device memory for the loader's lifetime), and sentinel accounting
+        # is unnecessary after close() because close() marks the loader
+        # exhausted itself; __next__ polls _exhausted so it cannot strand.
         while True:
+            if self._closing:
+                return
             try:
                 self._q.put(item, timeout=0.1)
                 return
             except queue.Full:
-                if self._closing:
-                    return
+                pass
 
     def _worker(self):
         # Every worker pushes exactly one sentinel on exit; the consumer
@@ -306,7 +316,13 @@ class PrefetchLoader:
         while True:
             if self._exhausted:
                 raise StopIteration
-            item = self._q.get()
+            # Timeout get, re-checking _exhausted: a concurrent close() may
+            # drop in-flight sentinels (see _put), so blocking forever on
+            # the queue could strand the consumer.
+            try:
+                item = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
             if item is self._SENTINEL:
                 self._finished_workers += 1
                 if self._finished_workers >= len(self._threads):
