@@ -49,6 +49,29 @@ class _ScaleLoss:
 
     __rmul__ = __mul__
 
+    def __add__(self, other):
+        return self.value + other
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self.value - other
+
+    def __rsub__(self, other):
+        return other - self.value
+
+    def __truediv__(self, other):
+        return self.value / other
+
+    def __rtruediv__(self, other):
+        return other / self.value
+
+    def __neg__(self):
+        return -self.value
+
+    def __float__(self) -> float:
+        return float(self.value)  # concrete arrays only (not under trace)
+
     def __repr__(self) -> str:
         return f"_ScaleLoss({self.value!r})"
 
@@ -64,9 +87,12 @@ def scale_loss(loss: jax.Array, optimizer: AmpOptimizer,
     ``delay_unscale`` are accepted for reference-signature parity
     (handle.py:16-21); unscaling is always deferred to ``optimizer.step``.
     """
-    if state is None:
+    if not isinstance(state, AmpOptimizerState):
+        # Catches both the missing-state case and reference-style positional
+        # calls where the third argument was loss_id (apex handle.py:16).
         raise TypeError(
-            "amp.scale_loss requires the AmpOptimizerState: "
-            "amp.scale_loss(loss, optimizer, state). JAX state is explicit — "
-            "there is no global _amp_state to consult.")
+            "amp.scale_loss requires the AmpOptimizerState as its third "
+            "argument: amp.scale_loss(loss, optimizer, state[, loss_id=n]). "
+            "JAX state is explicit — there is no global _amp_state to "
+            "consult.")
     return _ScaleLoss(optimizer.scale_loss(loss, state, loss_id))

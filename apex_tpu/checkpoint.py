@@ -1,0 +1,131 @@
+"""Checkpoint / resume — the TPU-native version of the reference's
+checkpointing recipe (SURVEY.md §5.4).
+
+The reference's documented flow (README.md "Checkpointing";
+apex/amp/frontend.py:428-467) is::
+
+    checkpoint = {'model': model.state_dict(),
+                  'optimizer': optimizer.state_dict(),
+                  'amp': amp.state_dict()}
+    torch.save(checkpoint, 'amp_checkpoint.pt')
+    # resume: amp.initialize with the SAME opt_level, then load all three
+
+with two transparency guarantees: (1) O2/O5 checkpoints hold fp32 weights
+even though the live model is half/bf16 (the ``O2StateDictHook`` recast,
+apex/amp/_initialize.py:133-142), and (2) loss-scaler state
+(``loss_scale``/``unskipped``) round-trips so resume is bitwise.
+
+Here the whole training state is one pytree — params + AmpOptimizerState
+(master fp32 weights, fused-optimizer moments, scaler state) + step — so a
+single save captures everything, sharded arrays included:
+
+  * :func:`save` / :func:`restore` — orbax-backed, async-capable, works for
+    arrays sharded over a ``jax.sharding.Mesh`` (each host writes its
+    addressable shards; the TPU analog of rank-0 torch.save).
+  * :func:`save_npz` / :func:`restore_npz` — dependency-light single-host
+    fallback mirroring the reference's optional-extension degradation.
+
+The O2/O5 fp32 guarantee holds structurally: the master weights *are* the
+fp32 copy inside ``AmpOptimizerState.master``, so checkpoints always carry
+fp32 state with no recast hook needed. Exercised by
+tests/test_checkpoint.py (the analog of tests/L0/run_amp/test_checkpointing.py).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+Tree = Any
+
+# orbax (and its tensorstore dependency) costs ~2s to import; load it only
+# when an orbax-backed save/restore is actually requested so plain
+# `import apex_tpu` stays fast.
+_ocp = None
+
+
+def _orbax():
+    global _ocp
+    if _ocp is None:
+        try:
+            import orbax.checkpoint as ocp
+        except ImportError as e:
+            raise ImportError(
+                "orbax-checkpoint is not installed; use save_npz/restore_npz"
+            ) from e
+        _ocp = ocp
+    return _ocp
+
+
+def _checkpointer():
+    return _orbax().PyTreeCheckpointer()
+
+
+def save(path: str, train_state: Tree, *, force: bool = True) -> None:
+    """Save a full training-state pytree (params, AmpOptimizerState, step,
+    ...) to ``path``. Sharded ``jax.Array`` leaves are written distributed:
+    every host persists its addressable shards."""
+    _checkpointer().save(os.path.abspath(path), train_state, force=force)
+
+
+def restore(path: str, template: Optional[Tree] = None) -> Tree:
+    """Restore a pytree saved by :func:`save`.
+
+    ``template`` (a pytree of like-structured arrays or
+    ``jax.ShapeDtypeStruct`` with shardings) restores arrays directly onto
+    their mesh shardings — resume does not need to fit the whole state on
+    one host. Without it, leaves restore as host numpy arrays.
+    """
+    path = os.path.abspath(path)
+    if template is not None:
+        ocp = _orbax()
+        restore_args = jax.tree_util.tree_map(
+            lambda x: ocp.ArrayRestoreArgs(sharding=x.sharding)
+            if hasattr(x, "sharding") else ocp.RestoreArgs(), template)
+        return _checkpointer().restore(
+            path, args=ocp.args.PyTreeRestore(
+                item=template,
+                restore_args=restore_args))
+    return _checkpointer().restore(path)
+
+
+# ---------------------------------------------------------------------------
+# npz fallback (single host, replicated state)
+# ---------------------------------------------------------------------------
+
+def save_npz(path: str, train_state: Tree) -> None:
+    """Single-host fallback: flatten the pytree to host numpy and write one
+    ``.npz`` (the moral equivalent of the reference's ``torch.save``).
+
+    Extension dtypes (bfloat16, fp8 — numpy kind 'V') don't survive the npz
+    format, so they are widened to fp32 on disk; :func:`restore_npz` casts
+    back to the template dtype. Widening is exact, so the round trip stays
+    bitwise — the same fp32-on-disk convention as the reference's O2 hook.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(train_state)
+    arrays = {}
+    for i, leaf in enumerate(leaves):
+        arr = np.asarray(leaf)
+        if arr.dtype.kind == "V":
+            arr = arr.astype(np.float32)
+        arrays[f"leaf_{i}"] = arr
+    np.savez(path, __treedef__=np.frombuffer(
+        repr(treedef).encode(), dtype=np.uint8), **arrays)
+
+
+def restore_npz(path: str, template: Tree) -> Tree:
+    """Restore an ``.npz`` checkpoint into the structure (and dtypes) of
+    ``template`` — the same "re-initialize then load" contract as the
+    reference's resume recipe."""
+    data = np.load(path if str(path).endswith(".npz") else str(path) + ".npz")
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    new_leaves = []
+    for i, leaf in enumerate(leaves):
+        arr = data[f"leaf_{i}"]
+        if hasattr(leaf, "dtype"):
+            arr = arr.astype(leaf.dtype)
+        new_leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)

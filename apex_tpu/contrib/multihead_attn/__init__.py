@@ -174,7 +174,30 @@ class EncdecMultiheadAttn(nn.Module):
         return out
 
 
-# Reference function-name alias (apex/contrib/multihead_attn exposes the
-# standalone masked-softmax-dropout as fast_mask_softmax_dropout_func).
-fast_mask_softmax_dropout_func = masked_softmax_dropout
+def fast_mask_softmax_dropout_func(is_training, heads, inputs, pad_mask,
+                                   mask_additive, dropout_prob, rng=None):
+    """Call-signature parity with the reference's standalone fused
+    masked-softmax-dropout (mask_softmax_dropout_func.py:8:
+    ``forward(is_training, heads, inputs, pad_mask, mask_additive,
+    dropout_prob)``).
+
+    ``inputs`` are attention scores shaped (..., q_len, k_len); ``pad_mask``
+    is added to the scores when ``mask_additive`` else treated as a boolean
+    padding mask (True = masked out). ``rng`` is required when
+    ``is_training`` with nonzero dropout (JAX randomness is explicit).
+    ``heads`` is accepted for signature parity; the array layout already
+    carries the head dimension.
+    """
+    del heads
+    mask = None
+    if pad_mask is not None:
+        if mask_additive:
+            mask = pad_mask
+        else:
+            mask = jnp.where(pad_mask.astype(bool), -jnp.inf, 0.0)
+    return masked_softmax_dropout(inputs, mask=mask,
+                                  dropout_rate=float(dropout_prob), rng=rng,
+                                  deterministic=not is_training)
+
+
 __all__.append("fast_mask_softmax_dropout_func")

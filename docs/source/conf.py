@@ -1,0 +1,23 @@
+# Sphinx configuration for apex_tpu (layout parity with the reference's
+# docs/source/conf.py; sphinx is not baked into the dev image, so docs build
+# in any environment with `pip install sphinx` + `sphinx-build -b html
+# docs/source docs/build`).
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath("../.."))
+
+project = "apex_tpu"
+copyright = "2026"
+author = "apex_tpu contributors"
+release = "0.1.0"
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+]
+
+autodoc_mock_imports = ["jax", "flax", "optax", "orbax", "numpy", "einops"]
+html_theme = "sphinx_rtd_theme" if os.environ.get("APEX_TPU_RTD") else "alabaster"

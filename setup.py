@@ -1,0 +1,40 @@
+"""Package identity + optional native build — parity with the reference's
+setup.py:478-494 (``name='apex'``, ``version='0.1'``) and its opt-in native
+extension flags (setup.py:55-67 ``--cpp_ext``/``--cuda_ext`` etc.).
+
+The TPU compute path needs no build step (JAX/XLA/Pallas compile at trace
+time). The one native component, the C++ host runtime
+(apex_tpu/csrc/host_runtime.cpp: flatten/unflatten, batch augmentation,
+prefetch staging), is JIT-built on first import with a content-hash cache
+(apex_tpu/runtime/__init__.py:42-71) and degrades to numpy when no toolchain
+exists — the same graceful degradation the reference applies to its optional
+extensions (apex/amp/scaler.py:66-80). ``--host_runtime`` pre-builds it at
+install time instead.
+"""
+
+import sys
+
+from setuptools import find_packages, setup
+
+if "--host_runtime" in sys.argv:
+    sys.argv.remove("--host_runtime")
+    sys.path.insert(0, ".")
+    from apex_tpu.runtime import native_available
+
+    if not native_available():
+        raise RuntimeError(
+            "--host_runtime requested but the C++ host runtime failed to "
+            "build; check that g++ is on PATH")
+    print("apex_tpu host runtime built and cached")
+
+setup(
+    name="apex_tpu",
+    version="0.1.0",
+    packages=find_packages(exclude=("tests", "examples")),
+    description=(
+        "TPU-native mixed precision and distributed training framework "
+        "(JAX/XLA/Pallas/pjit) with the capabilities of NVIDIA Apex"),
+    package_data={"apex_tpu": ["csrc/*.cpp"]},
+    install_requires=["jax", "flax", "optax", "numpy", "einops"],
+    python_requires=">=3.9",
+)

@@ -57,14 +57,23 @@ def test_amp_scale_loss_context_manager():
         expected = float(loss) * float(state.scaler.loss_scale[0])
         assert float(scaled) == expected
 
-    # plain-call form also usable (idiomatic JAX)
+    # plain-call form also usable (idiomatic JAX), incl. loss composition
     sl = amp.scale_loss(loss, aopt, state)
     assert float(sl.value) == expected
     assert float(2.0 * sl) == 2.0 * expected
+    assert float(sl + 1.0) == expected + 1.0
+    assert float(1.0 + sl) == expected + 1.0
+    assert float(sl - 1.0) == expected - 1.0
+    assert float(-sl) == -expected
+    assert float(sl / 2.0) == expected / 2.0
+    assert float(sl) == expected
 
     # missing state errors with migration guidance
     with pytest.raises(TypeError):
         amp.scale_loss(loss, aopt)
+    # reference-style positional loss_id as 3rd arg also gets the guidance
+    with pytest.raises(TypeError):
+        amp.scale_loss(loss, aopt, 0)
 
 
 def test_amp_promote_function_identity():
@@ -88,9 +97,29 @@ def test_contrib_deprecated_optimizers_exported():
     assert float(new_params["w"][0]) != 0.0
 
 
-def test_fast_mask_softmax_dropout_alias():
+def test_fast_mask_softmax_dropout_reference_signature():
+    """Positional call parity with the reference
+    (mask_softmax_dropout_func.py:8)."""
     from apex_tpu.contrib import multihead_attn as mha
 
     scores = jnp.zeros((2, 4, 4))
-    p = mha.fast_mask_softmax_dropout_func(scores)
+    # (is_training, heads, inputs, pad_mask, mask_additive, dropout_prob)
+    p = mha.fast_mask_softmax_dropout_func(False, 4, scores, None, False, 0.1)
     assert jnp.allclose(p.sum(-1), 1.0, atol=1e-6)
+
+    # boolean padding mask: masked columns get zero probability
+    pad = jnp.zeros((2, 4, 4), bool).at[:, :, -1].set(True)
+    p = mha.fast_mask_softmax_dropout_func(False, 4, scores, pad, False, 0.0)
+    assert jnp.all(p[:, :, -1] == 0.0)
+    assert jnp.allclose(p.sum(-1), 1.0, atol=1e-6)
+
+    # additive mask path
+    add = jnp.where(pad, -1e9, 0.0)
+    p2 = mha.fast_mask_softmax_dropout_func(False, 4, scores, add, True, 0.0)
+    assert jnp.allclose(p, p2, atol=1e-6)
+
+    # training dropout requires an rng and zeroes some probs
+    rng = jax.random.PRNGKey(0)
+    p3 = mha.fast_mask_softmax_dropout_func(True, 4, scores, None, False,
+                                            0.5, rng=rng)
+    assert bool((p3 == 0.0).any())
