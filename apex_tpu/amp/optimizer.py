@@ -49,8 +49,12 @@ class AmpOptimizer:
     # -- state -------------------------------------------------------------
     def init(self, model_params: Tree) -> AmpOptimizerState:
         if self.properties.master_weights:
+            # copy=True: leaves that are already fp32 (keep_batchnorm_fp32)
+            # must still get their own buffer — astype would alias them with
+            # the model params, breaking buffer donation of (params, state).
             master = jax.tree_util.tree_map(
-                lambda p: p.astype(jnp.float32), model_params)
+                lambda p: jnp.array(p, dtype=jnp.float32, copy=True),
+                model_params)
             inner = self.inner.init(master)
         else:
             master = ()

@@ -122,6 +122,14 @@ def restore_npz(path: str, template: Tree) -> Tree:
     reference's resume recipe."""
     data = np.load(path if str(path).endswith(".npz") else str(path) + ".npz")
     leaves, treedef = jax.tree_util.tree_flatten(template)
+    saved_treedef = bytes(data["__treedef__"]).decode()
+    if saved_treedef != repr(treedef):
+        raise ValueError(
+            "checkpoint structure does not match the template (was it saved "
+            "at a different opt level or with different param groups?):\n"
+            f"  saved:    {saved_treedef}\n  template: {treedef!r}\n"
+            "Re-initialize with the same configuration before loading — the "
+            "same contract as the reference's resume recipe.")
     new_leaves = []
     for i, leaf in enumerate(leaves):
         arr = data[f"leaf_{i}"]

@@ -46,3 +46,74 @@ def subgroups(world_size: int, group_size: int) -> List[List[int]]:
             f"({group_size}) — same contract as create_syncbn_process_group")
     return [list(range(i, i + group_size))
             for i in range(0, world_size, group_size)]
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     local_device_ids=None) -> None:
+    """Multi-host initialization — the analog of the reference's
+    ``torch.distributed.init_process_group('nccl', init_method='env://')``
+    (examples/imagenet/main_amp.py:122-125).
+
+    Delegates to ``jax.distributed.initialize``, which (like env://) reads
+    the coordinator/world/rank from the environment when arguments are None
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID, or the
+    TPU metadata service on Cloud TPU pods). Safe to call once per process
+    before any backend use; a no-op when already initialized or truly
+    single-process (no coordinator configured anywhere).
+    """
+    import os
+    configured = bool(coordinator_address or num_processes is not None
+                      or process_id is not None
+                      or os.environ.get("JAX_COORDINATOR_ADDRESS")
+                      or os.environ.get("COORDINATOR_ADDRESS"))
+    already = getattr(jax._src.distributed.global_state, "client",
+                      None) is not None
+    if already:
+        return
+    # Do NOT probe the backend/platform here: that would initialize the
+    # local backend single-process before initialize() can register the
+    # cluster (the exact "must run before any backend use" hazard).
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id,
+            local_device_ids=local_device_ids)
+    except Exception:
+        if configured:
+            raise  # explicit configuration must not fail silently
+        # unconfigured single-process run (no coordinator anywhere,
+        # no cluster auto-detection): nothing to initialize
+
+
+def hybrid_mesh(ici_axes: Sequence[int], dcn_axes: Sequence[int],
+                axis_names: Sequence[str]) -> Mesh:
+    """Multi-slice mesh laid out so the LAST axes vary fastest within a
+    slice (ICI) and the first axes cross slices (DCN) — put your
+    bandwidth-hungry axis (tensor/sequence parallel, ZeRO shard) on ICI and
+    the gradient-sync data axis on DCN.
+
+    ``ici_axes``/``dcn_axes`` are per-axis sizes with
+    ``prod(ici) = devices per slice`` and ``prod(dcn) = num slices``;
+    ``axis_names`` names the concatenated (dcn + ici) axes. Uses
+    ``mesh_utils.create_hybrid_device_mesh`` for a physical-topology-aware
+    device order on real TPU slices; falls back to a row-major reshape on
+    CPU meshes (tests).
+    """
+    ici_axes, dcn_axes = tuple(ici_axes), tuple(dcn_axes)
+    if len(axis_names) != len(dcn_axes) + len(ici_axes):
+        raise ValueError("axis_names must name every dcn + ici axis")
+    shape = dcn_axes + ici_axes
+    try:
+        from jax.experimental import mesh_utils
+        # create_hybrid_device_mesh takes parallel per-axis (ici, dcn) size
+        # lists of equal length (total per axis = ici[i]*dcn[i]); express
+        # "dcn axes first, then ici axes" by padding each side with 1s.
+        arr = mesh_utils.create_hybrid_device_mesh(
+            (1,) * len(dcn_axes) + ici_axes,
+            dcn_axes + (1,) * len(ici_axes))
+        arr = arr.reshape(shape)
+    except Exception:
+        arr = np.asarray(jax.devices()).reshape(shape)
+    return Mesh(arr, tuple(axis_names))

@@ -82,26 +82,35 @@ def _load():
         return _lib
     _build_err = _build()
     if _build_err is None:
-        lib = ctypes.CDLL(_LIB_PATH)
-        lib.apex_flatten.argtypes = [
-            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
-            ctypes.c_int, ctypes.c_void_p, ctypes.c_int]
-        lib.apex_unflatten.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
-            ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int]
-        lib.apex_normalize_u8_to_f32.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
-            ctypes.c_int]
-        lib.apex_augment_batch.argtypes = [
-            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
-            ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
-            ctypes.c_int]
-        lib.apex_host_runtime_version.restype = ctypes.c_int
-        _lib = lib
+        try:
+            _lib = _bind(ctypes.CDLL(_LIB_PATH))
+        except (OSError, AttributeError) as e:
+            # stale prebuilt .so (missing/renamed symbol, unloadable):
+            # degrade to the numpy path instead of crashing
+            _build_err = f"stale host runtime library: {e}"
+            _lib = None
     return _lib
+
+
+def _bind(lib):
+    lib.apex_flatten.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_int]
+    lib.apex_unflatten.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int]
+    lib.apex_normalize_u8_to_f32.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int]
+    lib.apex_augment_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int]
+    lib.apex_host_runtime_version.restype = ctypes.c_int
+    return lib
 
 
 def native_available() -> bool:

@@ -1,0 +1,144 @@
+"""Optimizer step-time microbenchmark — the second BASELINE.json metric
+("FusedAdam step-time vs torch.optim", BASELINE.md row 3).
+
+Measures one fused optimizer step over a ResNet-50-sized parameter set
+(~25.6M params split across ~161 tensors) for FusedAdam / FusedLAMB /
+FusedSGD, against two references:
+
+  * ``optax.adam`` / ``optax.sgd`` under jit — the JAX-ecosystem baseline,
+  * ``torch.optim.Adam`` (CPU torch is baked into the image) — the
+    reference's own baseline, comparable only on CPU.
+
+Run: ``python benchmarks/bench_optimizers.py [--device cpu|tpu]``.
+Prints one JSON line per (optimizer, impl) pair.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def resnet50_like_shapes():
+    """~25.6M params in realistically mixed tensor shapes/sizes."""
+    shapes = [(64, 3, 7, 7)]
+    for filters, blocks in [(64, 3), (128, 4), (256, 6), (512, 3)]:
+        for b in range(blocks):
+            shapes += [(filters, filters * 4, 1, 1),
+                       (filters, filters, 3, 3),
+                       (filters * 4, filters, 1, 1)]
+            shapes += [(filters * 4,)] * 3  # bn scale-ish
+    shapes += [(1000, 2048), (1000,)]
+    return shapes
+
+
+def make_tree(key, dtype=jnp.float32):
+    params = {}
+    for i, s in enumerate(resnet50_like_shapes()):
+        key, k = jax.random.split(key)
+        params[f"p{i}"] = jax.random.normal(k, s, dtype)
+    return params
+
+
+def time_fn(fn, *args, iters=20, warmup=3):
+    out = None
+    for i in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_fused(name, opt, params, grads, iters):
+    state = opt.init(params)
+
+    @jax.jit
+    def step(g, p, s):
+        return opt.step(g, p, s)
+
+    dt = time_fn(step, grads, params, state, iters=iters)
+    return dt
+
+
+def bench_optax(name, tx, params, grads, iters):
+    import optax
+    state = tx.init(params)
+
+    @jax.jit
+    def step(g, p, s):
+        updates, s = tx.update(g, s, p)
+        return optax.apply_updates(p, updates), s
+
+    dt = time_fn(step, grads, params, state, iters=iters)
+    return dt
+
+
+def bench_torch_adam(shapes, iters):
+    import torch
+    params = [torch.nn.Parameter(torch.randn(*s)) for s in shapes]
+    for p in params:
+        p.grad = torch.randn_like(p)
+    opt = torch.optim.Adam(params, lr=1e-3)
+    for _ in range(3):
+        opt.step()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        opt.step()
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--skip-torch", action="store_true")
+    args = ap.parse_args()
+
+    from apex_tpu import optimizers
+    import optax
+
+    dev = jax.devices()[0].platform
+    key = jax.random.PRNGKey(0)
+    params = make_tree(key)
+    grads = jax.tree_util.tree_map(lambda p: p * 0.01, params)
+    n_params = sum(p.size for p in jax.tree_util.tree_leaves(params))
+
+    results = []
+
+    def rec(opt_name, impl, dt):
+        results.append({"bench": "optimizer_step_time", "optimizer": opt_name,
+                        "impl": impl, "device": dev,
+                        "ms_per_step": round(dt * 1e3, 3),
+                        "n_params": n_params})
+
+    rec("adam", "apex_tpu.FusedAdam",
+        bench_fused("adam", optimizers.FusedAdam(lr=1e-3), params, grads,
+                    args.iters))
+    rec("adam", "optax.adam",
+        bench_optax("adam", optax.adam(1e-3), params, grads, args.iters))
+    rec("lamb", "apex_tpu.FusedLAMB",
+        bench_fused("lamb", optimizers.FusedLAMB(lr=1e-3), params, grads,
+                    args.iters))
+    rec("sgd", "apex_tpu.FusedSGD",
+        bench_fused("sgd", optimizers.FusedSGD(lr=0.1, momentum=0.9),
+                    params, grads, args.iters))
+    rec("sgd", "optax.sgd",
+        bench_optax("sgd", optax.sgd(0.1, momentum=0.9), params, grads,
+                    args.iters))
+    if not args.skip_torch and dev == "cpu":
+        rec("adam", "torch.optim.Adam(cpu)",
+            bench_torch_adam(resnet50_like_shapes(), args.iters))
+
+    for r in results:
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

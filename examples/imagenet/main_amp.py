@@ -118,7 +118,12 @@ def main(argv=None):
           f"global batch {args.batch_size}")
 
     model_cls = ARCHS[args.arch]
+    # Compute dtype follows the opt level's model cast (O2->fp16, O5->bf16):
+    # bf16/fp16 convs on the MXU, while flax BatchNorm keeps fp32 statistics
+    # (= keep_batchnorm_fp32 numerics).
+    compute_dtype = amp.resolve(args.opt_level).cast_model_type
     model = model_cls(num_classes=args.num_classes,
+                      dtype=compute_dtype or jnp.float32,
                       axis_name="data" if args.sync_bn else None)
 
     key = jax.random.PRNGKey(args.seed)
@@ -140,6 +145,8 @@ def main(argv=None):
     step_fn = build_train_step(model, aopt, mesh, args)
     batches = synthetic_batches(jax.random.PRNGKey(args.seed + 1), args,
                                 n_dev)
+    # short runs: keep at least one timed step after warmup
+    args.warmup_steps = min(args.warmup_steps, max(args.steps - 2, 0))
 
     shard = NamedSharding(mesh, P("data"))
     t0 = None

@@ -14,7 +14,7 @@ install time instead.
 
 import sys
 
-from setuptools import find_packages, setup
+from setuptools import setup
 
 if "--host_runtime" in sys.argv:
     sys.argv.remove("--host_runtime")
@@ -27,14 +27,5 @@ if "--host_runtime" in sys.argv:
             "build; check that g++ is on PATH")
     print("apex_tpu host runtime built and cached")
 
-setup(
-    name="apex_tpu",
-    version="0.1.0",
-    packages=find_packages(exclude=("tests", "examples")),
-    description=(
-        "TPU-native mixed precision and distributed training framework "
-        "(JAX/XLA/Pallas/pjit) with the capabilities of NVIDIA Apex"),
-    package_data={"apex_tpu": ["csrc/*.cpp"]},
-    install_requires=["jax", "flax", "optax", "numpy", "einops"],
-    python_requires=">=3.9",
-)
+# All static metadata lives in pyproject.toml (single source of truth).
+setup()

@@ -68,6 +68,7 @@ def test_o5_checkpoint_carries_fp32_master(tmp_path):
 
 
 def test_orbax_roundtrip(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
     aopt, params, state = _make_train_state()
     params, state = _train(aopt, params, state)
     ck = {"params": params, "amp": state, "step": jnp.asarray(3)}
@@ -102,6 +103,7 @@ def test_amp_state_dict_roundtrip():
 def test_orbax_sharded_roundtrip(tmp_path):
     """Save/restore arrays sharded over a mesh — the distributed analog of
     rank-0 torch.save (every host writes its addressable shards)."""
+    pytest.importorskip("orbax.checkpoint")
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
@@ -115,3 +117,13 @@ def test_orbax_sharded_roundtrip(tmp_path):
     assert restored["x"].sharding == sharding
     np.testing.assert_array_equal(np.asarray(restored["x"]),
                                   np.arange(32, dtype=np.float32))
+
+
+def test_npz_structure_mismatch_raises(tmp_path):
+    """Loading into a template with a different tree structure must fail
+    loudly, not silently scramble leaves."""
+    path = str(tmp_path / "ck.npz")
+    checkpoint.save_npz(path, {"a": jnp.ones((2,)), "b": jnp.zeros((3,))})
+    with pytest.raises(ValueError, match="does not match the template"):
+        checkpoint.restore_npz(path, {"a": jnp.ones((2,)),
+                                      "c": jnp.zeros((3,))})

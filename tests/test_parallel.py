@@ -263,3 +263,32 @@ def test_larc_keeps_small_updates():
     # loose rtol: the update (1e-5) is near the fp32 ulp of params (~1e-6)
     np.testing.assert_allclose(float(params["w"][0] - new_p["w"][0]),
                                0.1 * 1e-4, rtol=0.1)
+
+
+def test_hybrid_mesh_cpu_fallback():
+    """hybrid_mesh lays out (dcn..., ici...) axes; on CPU it falls back to a
+    row-major reshape but the axis structure must hold."""
+    from apex_tpu.parallel import hybrid_mesh
+
+    mesh = hybrid_mesh(ici_axes=(4,), dcn_axes=(2,),
+                       axis_names=("data", "model"))
+    assert mesh.shape == {"data": 2, "model": 4}
+    # collectives run over both axes
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def f(x):
+        return jax.lax.psum(x, "model")
+
+    out = jax.jit(shard_map(
+        f, mesh=mesh, in_specs=P("data", "model"),
+        out_specs=P("data", None), check_vma=False))(
+            jnp.ones((2, 4), jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), 4.0)
+
+
+def test_init_distributed_single_process_noop():
+    from apex_tpu.parallel import init_distributed
+
+    init_distributed()  # must not raise or hang on single-process CPU
