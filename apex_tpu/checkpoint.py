@@ -112,8 +112,16 @@ def save_npz(path: str, train_state: Tree) -> None:
         if arr.dtype.kind == "V":
             arr = arr.astype(np.float32)
         arrays[f"leaf_{i}"] = arr
-    np.savez(path, __treedef__=np.frombuffer(
-        repr(treedef).encode(), dtype=np.uint8), **arrays)
+    np.savez(path, __structure__=np.frombuffer(
+        _structure_key(train_state).encode(), dtype=np.uint8), **arrays)
+
+
+def _structure_key(tree: Tree) -> str:
+    """Version-stable structure fingerprint: the flattened key paths (one
+    per leaf, jax.tree_util.keystr) — unlike ``repr(PyTreeDef)``, this does
+    not change with JAX's internal PyTreeDef rendering across releases."""
+    paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return "\n".join(jax.tree_util.keystr(p) for p, _ in paths)
 
 
 def restore_npz(path: str, template: Tree) -> Tree:
@@ -122,12 +130,15 @@ def restore_npz(path: str, template: Tree) -> Tree:
     reference's resume recipe."""
     data = np.load(path if str(path).endswith(".npz") else str(path) + ".npz")
     leaves, treedef = jax.tree_util.tree_flatten(template)
-    saved_treedef = bytes(data["__treedef__"]).decode()
-    if saved_treedef != repr(treedef):
+    key = "__structure__" if "__structure__" in data else "__treedef__"
+    saved = bytes(data[key]).decode()
+    expected = (_structure_key(template) if key == "__structure__"
+                else repr(treedef))  # pre-rename checkpoints
+    if saved != expected:
         raise ValueError(
             "checkpoint structure does not match the template (was it saved "
             "at a different opt level or with different param groups?):\n"
-            f"  saved:    {saved_treedef}\n  template: {treedef!r}\n"
+            f"  saved:    {saved}\n  template: {expected}\n"
             "Re-initialize with the same configuration before loading — the "
             "same contract as the reference's resume recipe.")
     new_leaves = []

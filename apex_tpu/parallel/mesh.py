@@ -68,8 +68,12 @@ def init_distributed(coordinator_address: Optional[str] = None,
                       or process_id is not None
                       or os.environ.get("JAX_COORDINATOR_ADDRESS")
                       or os.environ.get("COORDINATOR_ADDRESS"))
-    already = getattr(jax._src.distributed.global_state, "client",
-                      None) is not None
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        already = bool(is_init())
+    else:  # older jax: fall back to the private client handle
+        already = getattr(jax._src.distributed.global_state, "client",
+                          None) is not None
     if already:
         return
     # Do NOT probe the backend/platform here: that would initialize the
@@ -105,7 +109,15 @@ def hybrid_mesh(ici_axes: Sequence[int], dcn_axes: Sequence[int],
     if len(axis_names) != len(dcn_axes) + len(ici_axes):
         raise ValueError("axis_names must name every dcn + ici axis")
     shape = dcn_axes + ici_axes
-    try:
+    # Topology-aware ordering only exists for real TPU slices; CPU/virtual
+    # meshes (tests) have no slice structure, so a row-major reshape is the
+    # correct layout there. On TPU, configuration errors from
+    # create_hybrid_device_mesh must propagate — a silent fallback would
+    # put the DCN axis on ICI neighbors, the exact pathology this helper
+    # exists to prevent.
+    if jax.devices()[0].platform != "tpu":
+        arr = np.asarray(jax.devices()).reshape(shape)
+    else:
         from jax.experimental import mesh_utils
         # create_hybrid_device_mesh takes parallel per-axis (ici, dcn) size
         # lists of equal length (total per axis = ici[i]*dcn[i]); express
@@ -114,6 +126,4 @@ def hybrid_mesh(ici_axes: Sequence[int], dcn_axes: Sequence[int],
             (1,) * len(dcn_axes) + ici_axes,
             dcn_axes + (1,) * len(ici_axes))
         arr = arr.reshape(shape)
-    except Exception:
-        arr = np.asarray(jax.devices()).reshape(shape)
     return Mesh(arr, tuple(axis_names))

@@ -14,5 +14,6 @@ SURVEY.md §5.1). The TPU-native pipeline:
 """
 
 from apex_tpu.pyprof.annotate import annotate, annotate_module, push, pop
-from apex_tpu.pyprof.prof import analyze, format_report
+from apex_tpu.pyprof.parse import Trace, TraceEvent, categorize, load_trace
+from apex_tpu.pyprof.prof import analyze, format_report, summarize_trace
 from apex_tpu.pyprof.trace import trace, start_trace, stop_trace

@@ -1,0 +1,13 @@
+"""``python -m apex_tpu.pyprof <trace-file-or-logdir>`` — offline per-op
+report (reference: ``python -m apex.pyprof.prof``, prof/__main__.py)."""
+
+import sys
+
+from apex_tpu.pyprof.prof import summarize_trace
+
+if __name__ == "__main__":
+    if len(sys.argv) != 2:
+        print("usage: python -m apex_tpu.pyprof <trace.json[.gz] | logdir>",
+              file=sys.stderr)
+        sys.exit(2)
+    print(summarize_trace(sys.argv[1]))

@@ -1,0 +1,210 @@
+"""Offline trace parsing — the TPU counterpart of ``apex.pyprof.parse``
+(reference: nvprof sqlite DB reader joining kernels to NVTX markers,
+apex/pyprof/parse/parse.py:25-40, parse/kernel.py, parse/db.py).
+
+``jax.profiler`` writes a TensorBoard profile directory containing a
+Chrome-trace JSON (``plugins/profile/<run>/<host>.trace.json.gz``). This
+module reads that artifact into per-event records and aggregates them into
+per-op and per-category tables, which :mod:`apex_tpu.pyprof.prof` turns into
+an efficiency report. No external deps — stdlib json/gzip only.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+__all__ = ["TraceEvent", "Trace", "load_trace", "find_trace_files"]
+
+
+@dataclass
+class TraceEvent:
+    """One complete ('X') event — the analog of the reference's per-kernel
+    row (parse/kernel.py Kernel: name, duration, grid, marker trace)."""
+
+    name: str
+    ts_us: float
+    dur_us: float
+    pid: int
+    tid: int
+    process: str = ""
+    thread: str = ""
+    args: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def on_device(self) -> bool:
+        """True when the event ran on an accelerator lane (XLA ops / TPU
+        core / stream lanes), not in host Python."""
+        p = self.process.lower()
+        t = self.thread.lower()
+        # TPU/GPU lanes: '/device:TPU:0' processes, 'XLA Ops'/'Steps'
+        # threads, stream lanes. XLA-CPU runs ops on 'tf_xla-cpu-codegen'
+        # worker threads (host python lanes stay excluded).
+        return any(k in p or k in t for k in
+                   ("tpu", "gpu", "/device", "xla", "stream", "core"))
+
+    @property
+    def long_name(self) -> str:
+        """The fully-qualified op name (XLA metadata carries the jax
+        named_scope path in args) — the NVTX-marker join of the reference."""
+        for k in ("long_name", "tf_op", "hlo_op", "name"):
+            v = self.args.get(k)
+            if isinstance(v, str) and v:
+                return v
+        return self.name
+
+
+class Trace:
+    """Parsed trace: event list + aggregation helpers."""
+
+    def __init__(self, events: List[TraceEvent]):
+        self.events = events
+
+    def device_events(self) -> List[TraceEvent]:
+        return [e for e in self.events if e.on_device]
+
+    def total_device_time_us(self) -> float:
+        return sum(e.dur_us for e in self.device_events())
+
+    def by_op(self, device_only: bool = True) -> List[Dict[str, Any]]:
+        """Aggregate by op name: count, total/avg us, share of device time —
+        the reference's per-kernel output table (prof/output.py)."""
+        evs = self.device_events() if device_only else self.events
+        agg: Dict[str, Dict[str, Any]] = {}
+        for e in evs:
+            row = agg.setdefault(e.name, {"op": e.name, "count": 0,
+                                          "total_us": 0.0})
+            row["count"] += 1
+            row["total_us"] += e.dur_us
+        total = sum(r["total_us"] for r in agg.values()) or 1.0
+        rows = sorted(agg.values(), key=lambda r: -r["total_us"])
+        for r in rows:
+            r["avg_us"] = r["total_us"] / r["count"]
+            r["pct"] = 100.0 * r["total_us"] / total
+        return rows
+
+    def by_category(self) -> List[Dict[str, Any]]:
+        """Aggregate device time by op category (matmul/conv/...) — the
+        role of the reference's 28 analyzer classes (prof/linear.py,
+        prof/conv.py, prof/pointwise.py, ...), keyed off XLA op names
+        instead of CUDA kernel names."""
+        agg: Dict[str, Dict[str, Any]] = {}
+        for e in self.device_events():
+            cat = categorize(e.name)
+            row = agg.setdefault(cat, {"category": cat, "count": 0,
+                                       "total_us": 0.0})
+            row["count"] += 1
+            row["total_us"] += e.dur_us
+        total = sum(r["total_us"] for r in agg.values()) or 1.0
+        rows = sorted(agg.values(), key=lambda r: -r["total_us"])
+        for r in rows:
+            r["pct"] = 100.0 * r["total_us"] / total
+        return rows
+
+
+# XLA/TPU op-name → category table. Order matters: first match wins
+# (fusions containing a dot keep the 'fusion' bucket only if nothing more
+# specific matches).
+_CATEGORIES: List[Tuple[str, str]] = [
+    # 'convolution' (HLO) / 'conv2d' etc., but NOT 'convert' (dtype cast,
+    # which belongs to pointwise below)
+    (r"(convolution|cudnn|conv\d|depthwise)", "conv"),
+    (r"(dot|matmul|gemm|einsum)", "matmul"),
+    (r"(all-reduce|all-gather|reduce-scatter|collective|permute|"
+     r"psum|send|recv)", "collective"),
+    (r"(copy|transpose|reshape|broadcast|concatenate|slice|pad|gather|"
+     r"scatter|dynamic-update)", "data-movement"),
+    (r"(reduce|sort|cumsum|argmax|argmin|top-k)", "reduction"),
+    (r"(rng|random)", "rng"),
+    (r"(infeed|outfeed|host)", "host-transfer"),
+    (r"(exp|log|tanh|sigmoid|erf|rsqrt|sqrt|power|sin|cos)",
+     "transcendental"),
+    (r"(add|sub|mul|div|max|min|select|compare|and|or|not|convert|"
+     r"clamp|abs|neg|sign|floor|ceil|round)", "pointwise"),
+    (r"fusion", "fusion"),
+]
+
+
+def categorize(op_name: str) -> str:
+    n = op_name.lower()
+    for pat, cat in _CATEGORIES:
+        if re.search(pat, n):
+            return cat
+    return "other"
+
+
+def find_trace_files(logdir: str) -> List[str]:
+    """Locate Chrome-trace JSON(.gz) files under a jax.profiler logdir."""
+    pats = [
+        os.path.join(logdir, "plugins", "profile", "*", "*.trace.json.gz"),
+        os.path.join(logdir, "plugins", "profile", "*", "*.trace.json"),
+        os.path.join(logdir, "*.trace.json.gz"),
+        os.path.join(logdir, "*.json.gz"),
+        os.path.join(logdir, "*.json"),
+    ]
+    out: List[str] = []
+    for p in pats:
+        for f in sorted(glob.glob(p)):
+            if f not in out:
+                out.append(f)
+    return out
+
+
+def _read_json(path: str) -> Any:
+    if path.endswith(".gz"):
+        with gzip.open(path, "rt") as f:
+            return json.load(f)
+    with open(path) as f:
+        return json.load(f)
+
+
+def load_trace(path_or_logdir: str) -> Trace:
+    """Parse a trace file, or the newest one under a profiler logdir."""
+    path = path_or_logdir
+    if os.path.isdir(path):
+        files = find_trace_files(path)
+        if not files:
+            raise FileNotFoundError(
+                f"no trace.json(.gz) under {path_or_logdir!r}; capture one "
+                f"with apex_tpu.pyprof.trace(logdir)")
+        path = max(files, key=os.path.getmtime)
+
+    raw = _read_json(path)
+    raw_events = raw.get("traceEvents", raw if isinstance(raw, list) else [])
+
+    # pass 1: pid/tid → names from metadata events
+    proc_names: Dict[int, str] = {}
+    thread_names: Dict[Tuple[int, int], str] = {}
+    for ev in raw_events:
+        if not isinstance(ev, dict):
+            continue
+        if ev.get("ph") == "M":
+            args = ev.get("args") or {}
+            if ev.get("name") == "process_name":
+                proc_names[ev.get("pid", 0)] = str(args.get("name", ""))
+            elif ev.get("name") == "thread_name":
+                thread_names[(ev.get("pid", 0), ev.get("tid", 0))] = str(
+                    args.get("name", ""))
+
+    # pass 2: complete events
+    events: List[TraceEvent] = []
+    for ev in raw_events:
+        if not isinstance(ev, dict) or ev.get("ph") != "X":
+            continue
+        pid = ev.get("pid", 0)
+        tid = ev.get("tid", 0)
+        events.append(TraceEvent(
+            name=str(ev.get("name", "")),
+            ts_us=float(ev.get("ts", 0.0)),
+            dur_us=float(ev.get("dur", 0.0)),
+            pid=pid, tid=tid,
+            process=proc_names.get(pid, ""),
+            thread=thread_names.get((pid, tid), ""),
+            args=ev.get("args") or {},
+        ))
+    return Trace(events)

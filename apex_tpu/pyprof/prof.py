@@ -38,6 +38,33 @@ def analyze(fn: Callable, *args, static_argnums=(), **kwargs) -> Dict[str, Any]:
     return out
 
 
+def summarize_trace(path_or_logdir: str, *, top: int = 25) -> str:
+    """Offline per-op report from a captured profiler trace — the
+    reference's ``python -m apex.pyprof.prof`` stage (prof/__main__.py:
+    per-kernel table with durations and categories) over the Chrome-trace
+    artifact instead of the nvprof DB."""
+    from apex_tpu.pyprof.parse import load_trace
+
+    tr = load_trace(path_or_logdir)
+    dev = tr.device_events()
+    lines = [
+        f"events: {len(tr.events)} total, {len(dev)} on-device",
+        f"device time: {tr.total_device_time_us() / 1e3:.3f} ms",
+        "",
+        f"{'category':<16}{'count':>8}{'total_us':>14}{'pct':>8}",
+    ]
+    for r in tr.by_category():
+        lines.append(f"{r['category']:<16}{r['count']:>8}"
+                     f"{r['total_us']:>14.1f}{r['pct']:>7.1f}%")
+    lines += ["", f"{'op':<48}{'count':>7}{'total_us':>12}{'avg_us':>10}"
+                  f"{'pct':>7}"]
+    for r in tr.by_op()[:top]:
+        name = r["op"][:47]
+        lines.append(f"{name:<48}{r['count']:>7}{r['total_us']:>12.1f}"
+                     f"{r['avg_us']:>10.1f}{r['pct']:>6.1f}%")
+    return "\n".join(lines)
+
+
 def format_report(stats: Dict[str, Any], *, peak_flops: Optional[float]
                   = None) -> str:
     """Readable report; with ``peak_flops`` (e.g. 197e12 for v5e bf16) adds

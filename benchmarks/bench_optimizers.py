@@ -9,7 +9,8 @@ FusedSGD, against two references:
   * ``torch.optim.Adam`` (CPU torch is baked into the image) — the
     reference's own baseline, comparable only on CPU.
 
-Run: ``python benchmarks/bench_optimizers.py [--device cpu|tpu]``.
+Run: ``python benchmarks/bench_optimizers.py [--iters N] [--skip-torch]``
+(device selection follows JAX_PLATFORMS, as everywhere else).
 Prints one JSON line per (optimizer, impl) pair.
 """
 

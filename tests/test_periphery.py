@@ -211,3 +211,65 @@ def test_pyprof_annotate():
 
     y = jax.jit(f)(jnp.ones((4,)))
     np.testing.assert_array_equal(np.asarray(y), 2.0)
+
+
+def test_pyprof_parse_synthetic(tmp_path):
+    """Chrome-trace parsing: metadata joins, device-lane detection,
+    per-op and per-category aggregation (reference parse/ + prof/)."""
+    import gzip
+    import json
+
+    trace = {"traceEvents": [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 1, "tid": 7, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "pid": 2, "name": "process_name",
+         "args": {"name": "python"}},
+        {"ph": "X", "pid": 1, "tid": 7, "name": "fusion.1",
+         "ts": 0, "dur": 50, "args": {"long_name": "jit(f)/dot_general"}},
+        {"ph": "X", "pid": 1, "tid": 7, "name": "convolution.2",
+         "ts": 60, "dur": 100},
+        {"ph": "X", "pid": 1, "tid": 7, "name": "convolution.2",
+         "ts": 170, "dur": 100},
+        {"ph": "X", "pid": 2, "tid": 1, "name": "host_python_call",
+         "ts": 0, "dur": 1000},
+    ]}
+    p = tmp_path / "t.trace.json.gz"
+    with gzip.open(p, "wt") as f:
+        json.dump(trace, f)
+
+    tr = pyprof.load_trace(str(tmp_path))
+    assert len(tr.events) == 4
+    dev = tr.device_events()
+    assert len(dev) == 3  # host python event excluded
+    assert tr.total_device_time_us() == 250
+    ops = tr.by_op()
+    assert ops[0]["op"] == "convolution.2" and ops[0]["count"] == 2
+    assert abs(ops[0]["pct"] - 80.0) < 1e-6
+    cats = tr.by_category()
+    assert cats[0]["category"] == "conv"
+    assert {"conv", "fusion"} == {c["category"] for c in cats}
+    assert dev[0].long_name == "jit(f)/dot_general"
+
+    report = pyprof.summarize_trace(str(tmp_path))
+    assert "convolution.2" in report and "conv" in report
+
+
+def test_pyprof_categorize():
+    assert pyprof.categorize("fusion.dot.3") == "matmul"
+    assert pyprof.categorize("all-reduce.1") == "collective"
+    assert pyprof.categorize("copy.4") == "data-movement"
+    assert pyprof.categorize("wat") == "other"
+
+
+def test_pyprof_capture_roundtrip(tmp_path):
+    """End-to-end: capture a real jax.profiler trace and parse it back."""
+    logdir = str(tmp_path / "trace")
+    with pyprof.trace(logdir):
+        jax.block_until_ready(jax.jit(lambda x: x @ x)(jnp.ones((64, 64))))
+    from apex_tpu.pyprof.parse import find_trace_files
+    files = find_trace_files(logdir)
+    assert files, "profiler produced no trace file"
+    tr = pyprof.load_trace(logdir)
+    assert len(tr.events) > 0
