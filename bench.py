@@ -84,6 +84,28 @@ def main():
         out_specs=(rep, rep, rep, rep), check_vma=False),
         donate_argnums=(0, 1, 2))
 
+    # Measured loop: `inner_steps` train steps inside ONE jitted lax.scan —
+    # the TPU-native train loop (static-shape, compiler-friendly control
+    # flow). Dispatch cost amortizes over the scan, which matters when the
+    # host drives the chip over a network tunnel.
+    inner_steps = 10 if on_tpu else 2
+
+    def multi_step(params, batch_stats, opt_state, batch):
+        def body(carry, _):
+            p, bs, os_ = carry
+            p, bs, os_, loss = per_device(p, bs, os_, batch)
+            return (p, bs, os_), loss
+        (params, batch_stats, opt_state), losses = jax.lax.scan(
+            body, (params, batch_stats, opt_state), None,
+            length=inner_steps)
+        return params, batch_stats, opt_state, losses[-1]
+
+    multi_fn = jax.jit(shard_map(
+        multi_step, mesh=mesh,
+        in_specs=(rep, rep, rep, (P("data"), P("data"))),
+        out_specs=(rep, rep, rep, rep), check_vma=False),
+        donate_argnums=(0, 1, 2))
+
     shard = NamedSharding(mesh, P("data"))
     kx, ky = jax.random.split(jax.random.PRNGKey(1))
     x = jax.device_put(
@@ -92,18 +114,28 @@ def main():
     y = jax.device_put(
         jax.random.randint(ky, (batch,), 0, 1000), shard)
 
-    t0 = None
-    for i in range(steps):
+    # warmup: compiles both executables and settles the allocator
+    for i in range(warmup):
         params, batch_stats, opt_state, loss = step_fn(
             params, batch_stats, opt_state, (x, y))
-        if i == warmup - 1:
-            jax.block_until_ready(loss)
-            t0 = time.perf_counter()
-            log(f"warmed up after {i + 1} steps, loss={float(loss):.3f}")
+    jax.block_until_ready(loss)
+    log(f"single-step warmup done ({warmup} steps), loss={float(loss):.3f}")
+    params, batch_stats, opt_state, loss = multi_fn(
+        params, batch_stats, opt_state, (x, y))
+    jax.block_until_ready(loss)
+    log("scan executable warmed up")
+
+    outer = max(1, (steps - warmup) // inner_steps)
+    t0 = time.perf_counter()
+    for _ in range(outer):
+        params, batch_stats, opt_state, loss = multi_fn(
+            params, batch_stats, opt_state, (x, y))
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
-    img_s = batch * (steps - warmup) / dt
-    log(f"{img_s:.1f} img/s ({dt:.2f}s for {steps - warmup} steps)")
+    n_steps = outer * inner_steps
+    img_s = batch * n_steps / dt
+    log(f"{img_s:.1f} img/s ({dt:.2f}s for {n_steps} steps, "
+        f"{inner_steps} per dispatch)")
 
     print(json.dumps({
         "metric": "resnet50_train_img_per_sec_amp_O5_bf16(O2-equiv)",
