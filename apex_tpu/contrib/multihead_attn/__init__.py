@@ -27,12 +27,13 @@ from apex_tpu.ops.attention import (
     flash_attention,
     ring_self_attention,
     self_attention,
+    ulysses_self_attention,
 )
 
 __all__ = [
     "SelfMultiheadAttn", "EncdecMultiheadAttn", "masked_softmax_dropout",
     "self_attention", "flash_attention", "attention_reference",
-    "ring_self_attention",
+    "ring_self_attention", "ulysses_self_attention",
 ]
 
 

@@ -21,3 +21,10 @@ from apex_tpu.ops.multi_tensor import (
     multi_tensor_lamb,
     use_pallas,
 )
+from apex_tpu.ops.attention import (
+    attention_reference,
+    flash_attention,
+    ring_self_attention,
+    self_attention,
+    ulysses_self_attention,
+)

@@ -6,11 +6,13 @@ materializing the (Sq, Sk) score matrix in HBM), plus:
 
   * a jnp reference path (the ``impl='default'`` PyTorch path of the
     reference modules) that also returns the per-row logsumexp, and
-  * **ring attention** for sequence/context parallelism over a mesh axis
-    (``ppermute`` of K/V shards around the ring with numerically-stable
-    partial-softmax merging). The reference has no distributed attention
-    (SURVEY.md §5.7) — this is the long-context capability the TPU framework
-    adds, built on the same blockwise math.
+  * two sequence/context-parallel schemes over a mesh axis — **ring
+    attention** (``ppermute`` of K/V shards around the ring with
+    numerically-stable partial-softmax merging) and **Ulysses all-to-all**
+    (re-shard heads↔sequence so each device runs local flash attention on
+    the full sequence). The reference has no distributed attention
+    (SURVEY.md §5.7) — this is the long-context capability the TPU
+    framework adds, built on the same blockwise math.
 
 Shapes follow (batch, heads, seq, head_dim) throughout.
 """
@@ -273,3 +275,44 @@ def ring_self_attention(q, k, v, axis_name: str, *, causal: bool = False,
     lse0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
     o, lse, _, _ = jax.lax.fori_loop(0, world, body, (o0, lse0, k, v))
     return o.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses attention (all-to-all sequence parallelism over a mesh axis)
+# ---------------------------------------------------------------------------
+
+def ulysses_self_attention(q, k, v, axis_name: str, *,
+                           causal: bool = False,
+                           scale: Optional[float] = None,
+                           impl: str = "auto"):
+    """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism: each
+    device holds a sequence shard (B, H, S_local, D); one ``all_to_all``
+    re-shards to (B, H/P, S_global, D) — heads scattered, sequence gathered
+    — so every device runs ordinary *local* attention (the Pallas flash
+    kernel) over the full sequence for its head subset, then a second
+    ``all_to_all`` restores sequence sharding.
+
+    Complementary to :func:`ring_self_attention`: Ulysses moves Q/K/V/O
+    once each (4 all-to-alls per layer, O(B·S·D·H/P) bytes/device) and
+    needs ``num_heads % axis_size == 0``; the ring moves K/V world-1 times
+    but has no head-count constraint and overlaps transfers with compute.
+    On an ICI mesh axis the all-to-all is a single XLA collective.
+
+    Shapes (per device): (B, H, S_local, D) -> (B, H, S_local, D).
+    """
+    world = jax.lax.axis_size(axis_name)
+    h = q.shape[1]
+    if h % world != 0:
+        raise ValueError(
+            f"ulysses needs num_heads ({h}) % axis_size ({world}) == 0 — "
+            f"use ring_self_attention for unconstrained head counts")
+
+    # One stacked collective each way (3x fewer launches than per-tensor):
+    # (3, B, H, S_loc, D) -> (3, B, H/P, S_glob, D): split heads, concat seq
+    qg, kg, vg = jax.lax.all_to_all(
+        jnp.stack([q, k, v]), axis_name, split_axis=2, concat_axis=3,
+        tiled=True)
+    o = self_attention(qg, kg, vg, causal=causal, scale=scale, impl=impl)
+    # (B, H/P, S_glob, D) -> (B, H, S_loc, D)
+    return jax.lax.all_to_all(o, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
