@@ -82,24 +82,16 @@ class ResNet(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        if self.axis_name is not None:
-            axis_name = self.axis_name
-            bn_momentum = self.bn_momentum
-
-            def norm_def(scale_init=None, name=None):
-                def build(features):
-                    return SyncBatchNorm(
-                        features=features, momentum=bn_momentum,
-                        axis_name=axis_name,
-                        use_running_average=not train)
-                return _DeferredNorm(build, name=name)
-        else:
-            def norm_def(scale_init=nn.initializers.ones, name=None):
-                return nn.BatchNorm(
-                    use_running_average=not train,
-                    momentum=1.0 - self.bn_momentum,  # flax: decay
-                    epsilon=1e-5, dtype=self.dtype,
-                    scale_init=scale_init, name=name)
+        # SyncBatchNorm for BOTH paths: with axis_name=None it is a local
+        # fused BatchNorm (one-pass Pallas channel stats, torch momentum/
+        # unbiased-var conventions); with an axis name, stats sync over the
+        # mesh. Stats/normalization stay fp32 (keep_batchnorm_fp32) while
+        # the output re-enters the bf16 compute stream via dtype.
+        def norm_def(scale_init=nn.initializers.ones, name=None):
+            return SyncBatchNorm(
+                momentum=self.bn_momentum, axis_name=self.axis_name,
+                use_running_average=not train, dtype=self.dtype,
+                scale_init=scale_init, name=name)
 
         x = nn.Conv(self.num_filters, (7, 7), (2, 2),
                     padding=[(3, 3), (3, 3)], use_bias=False,
@@ -116,16 +108,6 @@ class ResNet(nn.Module):
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
         return x.astype(jnp.float32)
-
-
-class _DeferredNorm(nn.Module):
-    """Adapter letting SyncBatchNorm (which needs static ``features``) plug
-    into the norm-factory slot where flax BatchNorm infers features."""
-    build: Callable
-
-    @nn.compact
-    def __call__(self, x):
-        return self.build(x.shape[-1])(x)
 
 
 ResNet18 = functools.partial(ResNet, stage_sizes=[2, 2, 2, 2],

@@ -186,3 +186,67 @@ def test_mlp_no_bias():
     x = jnp.ones((2, 8))
     params = m.init(jax.random.PRNGKey(20), x)
     assert "bias_0" not in params["params"]
+
+
+# ---------------------------------------------------------------------------
+# fused channel moments (Pallas BN-stats kernel, reference welford.cu)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("rows,c", [(64, 128), (1000, 256), (8, 128),
+                                    (64, 64), (128, 32)])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_fused_sum_sumsq_matches_jnp(rows, c, dtype):
+    from apex_tpu.ops.pallas_moments import fused_sum_sumsq
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (rows, c), dtype)
+    s, ss = jax.jit(fused_sum_sumsq)(x)
+    x32 = np.asarray(x, np.float32)
+    np.testing.assert_allclose(np.asarray(s), x32.sum(0), rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(ss), (x32 * x32).sum(0),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_fused_sum_sumsq_grads():
+    from apex_tpu.ops.pallas_moments import fused_sum_sumsq
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (96, 128))
+
+    def f(x_):
+        s, ss = fused_sum_sumsq(x_)
+        return jnp.sum(s * 3.0) + jnp.sum(ss * 0.5)
+
+    got = jax.grad(f)(x)
+    want = 3.0 + 2.0 * 0.5 * x  # d/dx [3*sum + 0.5*sumsq]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_local_syncbn_matches_flax_batchnorm():
+    """SyncBatchNorm with axis_name=None (the local fused path that now
+    backs the ResNet models) must match flax nn.BatchNorm in train mode."""
+    import flax.linen as nn
+    from apex_tpu.parallel import SyncBatchNorm
+
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 8, 8, 128))
+
+    ours = SyncBatchNorm(axis_name=None, use_running_average=False)
+    ref = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                       epsilon=1e-5)
+    vo = ours.init(jax.random.PRNGKey(3), x)
+    vr = ref.init(jax.random.PRNGKey(3), x)
+    yo, _ = ours.apply(vo, x, mutable=["batch_stats"])
+    yr, _ = ref.apply(vr, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(yo), np.asarray(yr), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_local_syncbn_scale_init():
+    import flax.linen as nn
+    from apex_tpu.parallel import SyncBatchNorm
+
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 4, 4, 128))
+    m = SyncBatchNorm(axis_name=None, use_running_average=False,
+                      scale_init=nn.initializers.zeros)
+    v = m.init(jax.random.PRNGKey(5), x)
+    np.testing.assert_array_equal(np.asarray(v["params"]["scale"]), 0.0)
