@@ -14,6 +14,7 @@ BASELINE.md; 900 stands in for the 1-GPU share of the 8xV100 north star).
 """
 
 import json
+import os
 import sys
 import time
 
@@ -36,7 +37,7 @@ def main():
 
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
-    batch = 256 if on_tpu else 16
+    batch = int(os.environ.get("BENCH_BATCH", "256")) if on_tpu else 16
     image = 224 if on_tpu else 64
     steps, warmup = (30, 5) if on_tpu else (8, 2)
     opt_level = "O5"
