@@ -35,6 +35,18 @@ def _interpret() -> bool:
     return jax.default_backend() not in ("tpu", "axon")
 
 
+def _pad3(x, s_to, d_to):
+    """Pad (bh, seq, d) to (bh, s_to, d_to)."""
+    return jnp.pad(x, ((0, 0), (0, s_to - x.shape[1]),
+                       (0, d_to - x.shape[2])))
+
+
+def _pad_rowstat(x, s_to, fill=0.0):
+    """Pad a (bh, 1, seq) per-row statistic along seq."""
+    return jnp.pad(x, ((0, 0), (0, 0), (0, s_to - x.shape[2])),
+                   constant_values=fill)
+
+
 # ---------------------------------------------------------------------------
 # Reference (jnp) attention — also the backward path for the flash kernel
 # ---------------------------------------------------------------------------
@@ -81,38 +93,46 @@ def _flash_fwd_kernel(scale, causal, s_actual, bq, bk, nk,
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
 
-    q = q_ref[0].astype(jnp.float32)           # (bq, d)
-    k = k_ref[0].astype(jnp.float32)           # (bk, d)
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale  # (bq, bk)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)           # (bq, d)
+        k = k_ref[0].astype(jnp.float32)           # (bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
 
-    row = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-    col = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    mask = col < s_actual
+        row = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        col = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = col < s_actual
+        if causal:
+            mask = mask & (col <= row)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]                       # (bq, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                      # (bq, bk)
+        corr = jnp.exp(m_prev - m_new)              # (bq, 1)
+        l_new = corr * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_scr[:] = corr * acc_scr[:] + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
     if causal:
-        mask = mask & (col <= row)
-    s = jnp.where(mask, s, NEG_INF)
-
-    m_prev = m_scr[:, :1]                       # (bq, 1)
-    m_cur = jnp.max(s, axis=1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    p = jnp.exp(s - m_new)                      # (bq, bk)
-    corr = jnp.exp(m_prev - m_new)              # (bq, 1)
-    l_new = corr * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
-    pv = jax.lax.dot_general(
-        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    acc_scr[:] = corr * acc_scr[:] + pv
-    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        # blocks entirely above the diagonal contribute nothing (p == 0
+        # leaves the scratch state unchanged) — skip their compute
+        pl.when(ik * bk <= iq * bq + bq - 1)(_compute)
+    else:
+        _compute()
 
     @pl.when(ik == nk - 1)
     def _finalize():
         l = l_scr[:, :1]
         l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:, :1] + jnp.log(l))[:, 0]
+        lse_ref[0, 0] = (m_scr[:, :1] + jnp.log(l))[:, 0]
 
 
 def _flash_fwd(q, k, v, *, causal: bool, scale: float,
@@ -129,13 +149,9 @@ def _flash_fwd(q, k, v, *, causal: bool, scale: float,
     sqp = ((sq + bq - 1) // bq) * bq
     skp = ((sk + bk - 1) // bk) * bk
 
-    def pad3(x, s_to, d_to):
-        return jnp.pad(x, ((0, 0), (0, s_to - x.shape[1]),
-                           (0, d_to - x.shape[2])))
-
-    qf = pad3(q.reshape(b * h, sq, d), sqp, dp)
-    kf = pad3(k.reshape(b * h, sk, d), skp, dp)
-    vf = pad3(v.reshape(b * h, sk, d), skp, dp)
+    qf = _pad3(q.reshape(b * h, sq, d), sqp, dp)
+    kf = _pad3(k.reshape(b * h, sk, d), skp, dp)
+    vf = _pad3(v.reshape(b * h, sk, d), skp, dp)
 
     nq = sqp // bq
     nk = skp // bk
@@ -151,11 +167,14 @@ def _flash_fwd(q, k, v, *, causal: bool, scale: float,
         ],
         out_specs=[
             pl.BlockSpec((1, bq, dp), lambda bh, iq, ik: (bh, iq, 0)),
-            pl.BlockSpec((1, bq), lambda bh, iq, ik: (bh, iq)),
+            # lse rides as (bh, 1, seq): Mosaic requires the last two block
+            # dims be (8k, 128k) or equal to the array dims — (1, bq) over
+            # a (bh, seq) array is neither
+            pl.BlockSpec((1, 1, bq), lambda bh, iq, ik: (bh, 0, iq)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sqp, dp), dtype),
-            jax.ShapeDtypeStruct((b * h, sqp), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, 1, sqp), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, dp), jnp.float32),
@@ -165,16 +184,175 @@ def _flash_fwd(q, k, v, *, causal: bool, scale: float,
         interpret=_interpret(),
     )(qf, kf, vf)
     out = out[:, :sq, :d].reshape(b, h, sq, d)
-    lse = lse[:, :sq].reshape(b, h, sq)
+    lse = lse[:, 0, :sq].reshape(b, h, sq)
     return out, lse
+
+
+def _recompute_p_ds(scale, causal, sq_actual, sk_actual, bq, bk, iq, ik,
+                    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref):
+    """Shared backward recompute: softmax probs from the saved lse plus
+    ds = p * (dP - delta). Used by both the dK/dV and dQ kernels."""
+    q = q_ref[0].astype(jnp.float32)            # (bq, d)
+    k = k_ref[0].astype(jnp.float32)            # (bk, d)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    row = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    col = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    mask = (col < sk_actual) & (row < sq_actual)
+    if causal:
+        mask = mask & (col <= row)
+    lse = lse_ref[0, 0][:, None]                # (bq, 1)
+    p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # (bq, bk)
+    do = do_ref[0].astype(jnp.float32)          # (bq, d)
+    dp = jax.lax.dot_general(
+        do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)     # (bq, bk)
+    delta = delta_ref[0, 0][:, None]            # (bq, 1)
+    ds = p * (dp - delta)
+    return q, k, p, do, ds
+
+
+def _causal_live(causal, iq, ik, bq, bk):
+    """False only for blocks entirely above the causal diagonal."""
+    return (ik * bk <= iq * bq + bq - 1) if causal else None
+
+
+def _flash_bwd_kv_kernel(scale, causal, sq_actual, sk_actual, bq, bk, nq,
+                         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dk_ref, dv_ref, dk_scr, dv_scr):
+    """Grid (bh, ik, iq): accumulate dK/dV for key block ik over all query
+    blocks. p = exp(s - lse); dv += p^T dO; ds = p*(dP - delta);
+    dk += ds^T q * scale."""
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        q, _, p, do, ds = _recompute_p_ds(
+            scale, causal, sq_actual, sk_actual, bq, bk, iq, ik,
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref)
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)     # p^T dO -> (bk, d)
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # ds^T q
+
+    live = _causal_live(causal, iq, ik, bq, bk)
+    pl.when(live)(_compute) if live is not None else _compute()
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_q_kernel(scale, causal, sq_actual, sk_actual, bq, bk, nk,
+                        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dq_ref, dq_scr):
+    """Grid (bh, iq, ik): accumulate dQ for query block iq over all key
+    blocks. dq += ds k * scale."""
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _compute():
+        _, k, _, _, ds = _recompute_p_ds(
+            scale, causal, sq_actual, sk_actual, bq, bk, iq, ik,
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref)
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    live = _causal_live(causal, iq, ik, bq, bk)
+    pl.when(live)(_compute) if live is not None else _compute()
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
+               block_q: int = 256, block_k: int = 256):
+    """Pallas flash backward: O(S) memory (only lse/delta row stats are
+    carried; the (Sq, Sk) score matrix never hits HBM) — the counterpart of
+    the reference's fused MHA backward kernels, reorganized as the
+    dKdV-then-dQ blockwise scheme."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    dtype = q.dtype
+
+    # delta_i = rowsum(dO ⊙ O): the only quantity besides lse the backward
+    # needs from the forward
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                     # (b, h, sq)
+
+    dp_ = ((d + 127) // 128) * 128
+    bq = min(block_q, ((sq + 127) // 128) * 128)
+    bk = min(block_k, ((sk + 127) // 128) * 128)
+    sqp = ((sq + bq - 1) // bq) * bq
+    skp = ((sk + bk - 1) // bk) * bk
+
+    qf = _pad3(q.reshape(b * h, sq, d), sqp, dp_)
+    kf = _pad3(k.reshape(b * h, sk, d), skp, dp_)
+    vf = _pad3(v.reshape(b * h, sk, d), skp, dp_)
+    dof = _pad3(g.reshape(b * h, sq, d), sqp, dp_)
+    # lse/delta ride as (bh, 1, seq) for Mosaic block-shape rules (see
+    # _flash_fwd). Padding rows keep lse finite so exp(s - lse) == 0 there
+    # (s is masked to NEG_INF anyway).
+    lsef = _pad_rowstat(lse.reshape(b * h, 1, sq), sqp, fill=0.0)
+    deltaf = _pad_rowstat(delta.reshape(b * h, 1, sq), sqp)
+
+    nq = sqp // bq
+    nk = skp // bk
+
+    q_spec = pl.BlockSpec((1, bq, dp_), lambda bh, i, j: (bh, j, 0))
+    k_spec = pl.BlockSpec((1, bk, dp_), lambda bh, i, j: (bh, i, 0))
+    row_spec = pl.BlockSpec((1, 1, bq), lambda bh, i, j: (bh, 0, j))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_kv_kernel, scale, causal, sq, sk,
+                          bq, bk, nq),
+        grid=(b * h, nk, nq),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=[pl.BlockSpec((1, bk, dp_), lambda bh, i, j: (bh, i, 0))]
+        * 2,
+        out_shape=[jax.ShapeDtypeStruct((b * h, skp, dp_), dtype)] * 2,
+        scratch_shapes=[pltpu.VMEM((bk, dp_), jnp.float32)] * 2,
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    q_spec2 = pl.BlockSpec((1, bq, dp_), lambda bh, i, j: (bh, i, 0))
+    k_spec2 = pl.BlockSpec((1, bk, dp_), lambda bh, i, j: (bh, j, 0))
+    row_spec2 = pl.BlockSpec((1, 1, bq), lambda bh, i, j: (bh, 0, i))
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_q_kernel, scale, causal, sq, sk,
+                          bq, bk, nk),
+        grid=(b * h, nq, nk),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=pl.BlockSpec((1, bq, dp_), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sqp, dp_), dtype),
+        scratch_shapes=[pltpu.VMEM((bq, dp_), jnp.float32)],
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    dq = dq[:, :sq, :d].reshape(b, h, sq, d)
+    dk = dk[:, :sk, :d].reshape(b, h, sk, d)
+    dv = dv[:, :sk, :d].reshape(b, h, sk, d)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None):
-    """Flash attention (Pallas fwd). Backward currently recomputes standard
-    attention under XLA (correct; O(S^2) memory only inside the bwd fusion).
-    A Pallas backward kernel is the planned optimization."""
+    """Flash attention: Pallas forward AND backward (blockwise, O(S) HBM —
+    the (Sq, Sk) score matrix never materializes in either direction)."""
     scale = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
     out, _ = _flash_fwd(q, k, v, causal=causal, scale=scale)
     return out
@@ -182,16 +360,14 @@ def flash_attention(q, k, v, causal: bool = False,
 
 def _flash_vjp_fwd(q, k, v, causal, scale):
     scale_ = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
-    out, _ = _flash_fwd(q, k, v, causal=causal, scale=scale_)
-    return out, (q, k, v)
+    out, lse = _flash_fwd(q, k, v, causal=causal, scale=scale_)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, scale, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: attention_reference(
-            q_, k_, v_, causal=causal, scale=scale), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    scale_ = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
+    return _flash_bwd(q, k, v, out, lse, g, causal=causal, scale=scale_)
 
 
 flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
