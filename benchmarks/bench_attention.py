@@ -1,0 +1,106 @@
+"""Attention kernel microbenchmark: Pallas flash attention (fwd and
+fwd+bwd) vs the dense jnp reference across sequence lengths — the
+counterpart of the reference's fused-MHA speed claims
+(apex/contrib/csrc/multihead_attn/), measured instead of asserted.
+
+Run: ``python benchmarks/bench_attention.py [--seqs 1024,4096,16384]``.
+Prints one JSON line per (seq, impl, direction). The dense reference is
+skipped where its (S, S) score matrix would not fit (it OOMs or pages
+long before flash does — that asymmetry is the point of the kernel).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def timeit(fn, q, k, v, iters=25):
+    """Time `iters` dependency-chained executions inside ONE jitted
+    lax.scan: each iteration's q depends on the previous output, so the
+    device must run them back to back — independent async dispatches over
+    a remote-device tunnel otherwise report scheduling time, not compute
+    (times that don't scale with s^2 give it away)."""
+    def chained(q_, k_, v_, eps):
+        def body(carry, _):
+            out = fn(carry, k_, v_)
+            leaf = jax.tree_util.tree_leaves(out)[0]
+            # eps is a RUNTIME zero: the multiply can't be constant-folded,
+            # so every iteration's kernel must actually run, while the
+            # carry value stays exactly q
+            return carry + eps * leaf.astype(carry.dtype), ()
+        final, _ = jax.lax.scan(body, q_, None, length=iters)
+        return final
+    run = jax.jit(chained)
+    jax.block_until_ready(run(q, k, v, jnp.zeros((), q.dtype)))  # compile
+    out = run(q, k, v, jnp.float32(1e-29).astype(q.dtype))       # warm the
+    np.asarray(out[0, 0, 0, :1])                                 # timed path
+    # each timed call gets a DISTINCT eps: identical (fn, args) executions
+    # can be served from a result cache by a remote-device transport, which
+    # would time the replay, not the kernels
+    reps = 2
+    t0 = time.perf_counter()
+    for i in range(reps):
+        out = run(q, k, v, jnp.float32(1e-30 * (i + 1)).astype(q.dtype))
+        np.asarray(out[0, 0, 0, :1])               # hard host sync
+    return (time.perf_counter() - t0) / (iters * reps)
+
+
+def main():
+    from apex_tpu.ops.attention import attention_reference, flash_attention
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--seqs", default="1024,4096,8192")
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=64)
+    p.add_argument("--dense-max-seq", type=int, default=4096,
+                   help="skip the dense reference above this length")
+    args = p.parse_args()
+
+    b, h, d = args.batch, args.heads, args.head_dim
+    dtype = jnp.bfloat16
+
+    for s in [int(x) for x in args.seqs.split(",")]:
+        ks = jax.random.split(jax.random.PRNGKey(0), 4)
+        q, k, v, g = (jax.random.normal(kk, (b, h, s, d), dtype)
+                      for kk in ks)
+        # causal attention FLOPs: 2 matmuls * b*h*s^2*d, halved by the mask
+        flops = 2 * 2 * b * h * s * s * d / 2
+
+        impls = {"flash": lambda q_, k_, v_: flash_attention(q_, k_, v_,
+                                                             True)}
+        if s <= args.dense_max_seq:
+            impls["dense"] = lambda q_, k_, v_: attention_reference(
+                q_, k_, v_, causal=True)
+
+        for name, fn in impls.items():
+            t_fwd = timeit(fn, q, k, v)
+
+            def loss(q_, k_, v_):
+                return jnp.sum(fn(q_, k_, v_).astype(jnp.float32) ** 2)
+
+            grad_fn = jax.grad(loss, argnums=(0, 1, 2))
+            t_fb = timeit(grad_fn, q, k, v)
+            for direction, t, mult in (("fwd", t_fwd, 1.0),
+                                       ("fwd+bwd", t_fb, 3.5)):
+                print(json.dumps({
+                    "metric": f"attn_{name}_{direction}_s{s}",
+                    "value": round(t * 1e3, 3),
+                    "unit": "ms",
+                    "tflops": round(flops * mult / t / 1e12, 1),
+                }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
