@@ -62,6 +62,12 @@ def parse_args(argv=None):
     p.add_argument("--keep-batchnorm-fp32", default=None)
     p.add_argument("--prof", action="store_true",
                    help="emit a jax.profiler trace of 10 steps")
+    p.add_argument("--data-pipeline", default="device",
+                   choices=["device", "host"],
+                   help="'device': synthetic batches generated on device; "
+                        "'host': uint8 host images through the C++ runtime "
+                        "(augment_batch + PrefetchLoader, the reference's "
+                        "data_prefetcher path)")
     p.add_argument("--seed", type=int, default=0)
     return p.parse_args(argv)
 
@@ -76,6 +82,37 @@ def synthetic_batches(key, args, n_devices):
                               jnp.float32)
         y = jax.random.randint(ky, (b,), 0, args.num_classes)
         yield x, y
+
+
+def host_pipeline_batches(seed, args, shard):
+    """Host-runtime input pipeline — the reference's data_prefetcher path
+    (examples/imagenet/main_amp.py:264-317: side-stream H2D copy + in-loop
+    crop/flip/normalize) rebuilt on apex_tpu.runtime: uint8 source images
+    -> C++ augment_batch (random crop + flip + normalize, multithreaded)
+    -> background PrefetchLoader overlapping with device compute ->
+    device_put to the data shard. Yields device arrays."""
+    from apex_tpu import runtime
+
+    b, size = args.batch_size, args.image_size
+    src_hw = size + 32  # oversized source, like the resize-then-crop recipe
+    rng = np.random.default_rng(seed)
+
+    def source():
+        while True:
+            imgs = rng.integers(0, 256, (b, src_hw, src_hw, 3), np.uint8)
+            labels = rng.integers(0, args.num_classes, (b,), np.int64)
+            yield imgs, labels
+
+    def transform(item):
+        imgs, labels = item
+        crop = rng.integers(0, src_hw - size + 1, (b, 2))
+        flip = rng.integers(0, 2, (b,))
+        x = runtime.augment_batch(imgs, (size, size), crop, flip)
+        x = jax.device_put(x, shard)
+        y = jax.device_put(labels.astype(np.int32), shard)
+        return x, y
+
+    return runtime.PrefetchLoader(source(), transform, depth=3)
 
 
 def build_train_step(model, aopt, mesh, args):
@@ -143,17 +180,23 @@ def main(argv=None):
     opt_state = aopt.init(params)
 
     step_fn = build_train_step(model, aopt, mesh, args)
-    batches = synthetic_batches(jax.random.PRNGKey(args.seed + 1), args,
-                                n_dev)
     # short runs: keep at least one timed step after warmup
     args.warmup_steps = min(args.warmup_steps, max(args.steps - 2, 0))
 
     shard = NamedSharding(mesh, P("data"))
+    if args.data_pipeline == "host":
+        batches = host_pipeline_batches(args.seed + 1, args, shard)
+    else:
+        batches = synthetic_batches(jax.random.PRNGKey(args.seed + 1),
+                                    args, n_dev)
+    iter_batches = iter(batches)
+
     t0 = None
     for i in range(args.steps):
-        x, y = next(batches)
-        x = jax.device_put(x, shard)
-        y = jax.device_put(y, shard)
+        x, y = next(iter_batches)
+        if args.data_pipeline != "host":
+            x = jax.device_put(x, shard)
+            y = jax.device_put(y, shard)
         if args.prof and i == args.warmup_steps:
             jax.profiler.start_trace("/tmp/apex_tpu_trace")
         params, batch_stats, opt_state, loss, scale = step_fn(
@@ -169,6 +212,8 @@ def main(argv=None):
                   f"loss_scale {float(scale):.1f}")
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
+    if hasattr(batches, "close"):
+        batches.close()
     timed = args.steps - 1 - args.warmup_steps
     img_s = args.batch_size * timed / dt
     print(f"Speed: {img_s:.1f} img/s over {timed} steps "
