@@ -7,9 +7,11 @@ residual}, plus the standalone masked-softmax-dropout.
 
 ``impl='fast'`` runs the Pallas flash kernel (ops/attention.py);
 ``impl='default'`` is the plain jnp path — the same two-impl switch as the
-reference modules. Dropout inside attention probs uses the default path
-(Pallas RNG dropout is a later optimization; the reference fast path fuses
-dropout into its softmax kernel, csrc/multihead_attn/dropout.h).
+reference modules. On the fast path, attention-prob dropout fuses into the
+flash kernels via the deterministic counter mask (the reference fuses
+dropout into its softmax kernel the same way,
+csrc/multihead_attn/dropout.h); each module folds its flax path into the
+seed so stacked layers sharing one dropout_rng still draw distinct masks.
 """
 
 from __future__ import annotations
@@ -52,6 +54,15 @@ def masked_softmax_dropout(scores: jax.Array, *, mask: Optional[jax.Array]
         keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, p.shape)
         p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
     return p.astype(scores.dtype)
+
+
+def _derive_seed(rng, module_path):
+    """Per-module dropout seed: fold the flax module path into the rng so
+    stacked attention layers sharing one dropout_rng draw distinct masks."""
+    import zlib
+    tag = zlib.crc32("/".join(map(str, module_path)).encode()) & 0x7FFFFFFF
+    return jax.random.randint(jax.random.fold_in(rng, tag), (),
+                              0, 2**31 - 1)
 
 
 def _split_heads(x, num_heads):
@@ -100,10 +111,16 @@ class SelfMultiheadAttn(nn.Module):
         k = _split_heads(k, h)
         v = _split_heads(v, h)
 
-        use_fast = (self.impl == "fast" and attn_mask is None
-                    and (self.dropout == 0.0 or deterministic))
+        use_fast = self.impl == "fast" and attn_mask is None
         if use_fast:
-            ctx = flash_attention(q, k, v, self.causal)
+            # dropout fuses into the flash kernels (reference dropout.h);
+            # the seed derives from the module's dropout rng per call
+            rate, seed = 0.0, None
+            if self.dropout > 0.0 and not deterministic:
+                rate = self.dropout
+                seed = _derive_seed(dropout_rng, self.path)
+            ctx = flash_attention(q, k, v, self.causal,
+                                  dropout_rate=rate, dropout_seed=seed)
         else:
             scale = 1.0 / math.sqrt(e // h)
             s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
@@ -155,10 +172,14 @@ class EncdecMultiheadAttn(nn.Module):
         k = _split_heads(k, h)
         v = _split_heads(v, h)
 
-        use_fast = (self.impl == "fast" and attn_mask is None
-                    and (self.dropout == 0.0 or deterministic))
+        use_fast = self.impl == "fast" and attn_mask is None
         if use_fast:
-            ctx = flash_attention(q, k, v, False)
+            rate, seed = 0.0, None
+            if self.dropout > 0.0 and not deterministic:
+                rate = self.dropout
+                seed = _derive_seed(dropout_rng, self.path)
+            ctx = flash_attention(q, k, v, False,
+                                  dropout_rate=rate, dropout_seed=seed)
         else:
             scale = 1.0 / math.sqrt(e // h)
             s = jnp.einsum("bhqd,bhkd->bhqk", q, k,

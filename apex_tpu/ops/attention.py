@@ -25,6 +25,7 @@ from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -47,30 +48,67 @@ def _pad_rowstat(x, s_to, fill=0.0):
                    constant_values=fill)
 
 
+def dropout_keep_mask(seed, bh, row, col, rate: float):
+    """Deterministic counter-based dropout mask: a 32-bit integer mix of
+    (seed, batch-head index, global row, global col) — the fused-dropout
+    counterpart of the reference's Philox-based softmax-dropout kernels
+    (apex/contrib/csrc/multihead_attn/dropout.h), chosen over the TPU PRNG
+    so the SAME mask is computable in the Pallas kernels, the jnp
+    reference, and interpret-mode tests.
+
+    Returns a boolean keep-mask broadcast over ``row``/``col`` (int32
+    arrays of equal shape)."""
+    x = (seed.astype(jnp.int32) * jnp.int32(-1640531527)     # 0x9E3779B9
+         + bh.astype(jnp.int32) * jnp.int32(-2048144789)     # 0x85EBCA6B
+         + row * jnp.int32(-1028477387)                      # 0xC2B2AE35
+         + col * jnp.int32(741103597))
+    x = x ^ (x >> 16)
+    x = x * jnp.int32(2135587861)
+    x = x ^ (x >> 15)
+    x = x * jnp.int32(-1663358717)
+    x = x ^ (x >> 16)
+    threshold = jnp.int32(int((1.0 - rate) * 2147483647))
+    return (x & jnp.int32(0x7FFFFFFF)) < threshold
+
+
 # ---------------------------------------------------------------------------
 # Reference (jnp) attention — also the backward path for the flash kernel
 # ---------------------------------------------------------------------------
 
 def attention_reference(q, k, v, *, bias=None, causal=False,
                         scale: Optional[float] = None,
-                        return_lse: bool = False):
+                        return_lse: bool = False,
+                        dropout_rate: float = 0.0,
+                        dropout_seed=None):
     """Plain attention in fp32 softmax (the ``impl='default'`` path of the
-    reference modules, e.g. self_multihead_attn.py:26)."""
+    reference modules, e.g. self_multihead_attn.py:26). With
+    ``dropout_rate`` > 0 and a ``dropout_seed``, applies the SAME
+    counter-based keep mask as the flash kernels (bit-identical dropout
+    pattern across implementations)."""
     d = q.shape[-1]
+    b, h, sq = q.shape[0], q.shape[1], q.shape[2]
+    sk = k.shape[2]
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if bias is not None:
         s = s + bias.astype(jnp.float32)
     if causal:
-        sq, sk = s.shape[-2], s.shape[-1]
         row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
         s = jnp.where(col <= row + (sk - sq), s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    out = jnp.einsum("bhqk,bhkd->bhqd", (p / l).astype(v.dtype), v,
+    probs = p / l
+    if dropout_rate > 0.0:
+        bh = jnp.arange(b * h, dtype=jnp.int32).reshape(b, h, 1, 1)
+        row = jax.lax.broadcasted_iota(jnp.int32, (1, 1, sq, sk), 2)
+        col = jax.lax.broadcasted_iota(jnp.int32, (1, 1, sq, sk), 3)
+        keep = dropout_keep_mask(jnp.asarray(dropout_seed, jnp.int32), bh,
+                                 row, col, dropout_rate)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v,
                      preferred_element_type=jnp.float32).astype(q.dtype)
     if return_lse:
         return out, (m + jnp.log(l))[..., 0]
@@ -81,9 +119,10 @@ def attention_reference(q, k, v, *, bias=None, causal=False,
 # Flash attention (Pallas forward; recompute backward)
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(scale, causal, s_actual, bq, bk, nk,
-                      q_ref, k_ref, v_ref, o_ref, lse_ref,
+def _flash_fwd_kernel(scale, causal, rate, s_actual, bq, bk, nk,
+                      q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
                       acc_scr, m_scr, l_scr):
+    bh = pl.program_id(0)
     iq = pl.program_id(1)
     ik = pl.program_id(2)
 
@@ -112,9 +151,16 @@ def _flash_fwd_kernel(scale, causal, s_actual, bq, bk, nk,
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)                      # (bq, bk)
         corr = jnp.exp(m_prev - m_new)              # (bq, 1)
+        # normalizer uses UNdropped p (dropout applies to the normalized
+        # probabilities, torch semantics); only the pv accumulation drops
         l_new = corr * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        if rate > 0.0:
+            keep = dropout_keep_mask(seed_ref[0], bh, row, col, rate)
+            p_v = jnp.where(keep, p / (1.0 - rate), 0.0)
+        else:
+            p_v = p
         pv = jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            p_v.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         acc_scr[:] = corr * acc_scr[:] + pv
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
@@ -136,10 +182,14 @@ def _flash_fwd_kernel(scale, causal, s_actual, bq, bk, nk,
 
 
 def _flash_fwd(q, k, v, *, causal: bool, scale: float,
+               dropout_rate: float = 0.0, dropout_seed=None,
                block_q: int = 256, block_k: int = 256):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     dtype = q.dtype
+    seed = jnp.asarray(
+        0 if dropout_seed is None else dropout_seed,
+        jnp.int32).reshape((1,))
 
     # pad head_dim to lane multiple, seq to block multiples
     dp = ((d + 127) // 128) * 128
@@ -158,12 +208,14 @@ def _flash_fwd(q, k, v, *, causal: bool, scale: float,
     grid = (b * h, nq, nk)
 
     out, lse = pl.pallas_call(
-        functools.partial(_flash_fwd_kernel, scale, causal, sk, bq, bk, nk),
+        functools.partial(_flash_fwd_kernel, scale, causal, dropout_rate,
+                          sk, bq, bk, nk),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, dp), lambda bh, iq, ik: (bh, iq, 0)),
             pl.BlockSpec((1, bk, dp), lambda bh, iq, ik: (bh, ik, 0)),
             pl.BlockSpec((1, bk, dp), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, dp), lambda bh, iq, ik: (bh, iq, 0)),
@@ -182,16 +234,22 @@ def _flash_fwd(q, k, v, *, causal: bool, scale: float,
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
         interpret=_interpret(),
-    )(qf, kf, vf)
+    )(qf, kf, vf, seed)
     out = out[:, :sq, :d].reshape(b, h, sq, d)
     lse = lse[:, 0, :sq].reshape(b, h, sq)
     return out, lse
 
 
-def _recompute_p_ds(scale, causal, sq_actual, sk_actual, bq, bk, iq, ik,
-                    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref):
+def _recompute_p_ds(scale, causal, rate, sq_actual, sk_actual, bq, bk,
+                    bh, iq, ik, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, seed_ref):
     """Shared backward recompute: softmax probs from the saved lse plus
-    ds = p * (dP - delta). Used by both the dK/dV and dQ kernels."""
+    ds = p * (dP - delta). Used by both the dK/dV and dQ kernels.
+
+    With dropout (y_i = sum_j p_ij m_ij/keep v_j / l_i): the returned
+    p_drop = p*m/keep feeds dV, and dP picks up the same m/keep factor
+    before the delta subtraction — delta itself is unchanged because
+    sum_k a_ik dP_ik still telescopes to dO.y (see _flash_bwd)."""
     q = q_ref[0].astype(jnp.float32)            # (bq, d)
     k = k_ref[0].astype(jnp.float32)            # (bk, d)
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -207,9 +265,15 @@ def _recompute_p_ds(scale, causal, sq_actual, sk_actual, bq, bk, iq, ik,
     dp = jax.lax.dot_general(
         do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)     # (bq, bk)
+    if rate > 0.0:
+        keep = dropout_keep_mask(seed_ref[0], bh, row, col, rate)
+        p_drop = jnp.where(keep, p / (1.0 - rate), 0.0)
+        dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
+    else:
+        p_drop = p
     delta = delta_ref[0, 0][:, None]            # (bq, 1)
     ds = p * (dp - delta)
-    return q, k, p, do, ds
+    return q, k, p_drop, do, ds
 
 
 def _causal_live(causal, iq, ik, bq, bk):
@@ -217,12 +281,14 @@ def _causal_live(causal, iq, ik, bq, bk):
     return (ik * bk <= iq * bq + bq - 1) if causal else None
 
 
-def _flash_bwd_kv_kernel(scale, causal, sq_actual, sk_actual, bq, bk, nq,
-                         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dk_ref, dv_ref, dk_scr, dv_scr):
+def _flash_bwd_kv_kernel(scale, causal, rate, sq_actual, sk_actual, bq, bk,
+                         nq, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                         delta_ref, seed_ref, dk_ref, dv_ref, dk_scr,
+                         dv_scr):
     """Grid (bh, ik, iq): accumulate dK/dV for key block ik over all query
     blocks. p = exp(s - lse); dv += p^T dO; ds = p*(dP - delta);
     dk += ds^T q * scale."""
+    bh = pl.program_id(0)
     ik = pl.program_id(1)
     iq = pl.program_id(2)
 
@@ -233,8 +299,8 @@ def _flash_bwd_kv_kernel(scale, causal, sq_actual, sk_actual, bq, bk, nq,
 
     def _compute():
         q, _, p, do, ds = _recompute_p_ds(
-            scale, causal, sq_actual, sk_actual, bq, bk, iq, ik,
-            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref)
+            scale, causal, rate, sq_actual, sk_actual, bq, bk, bh, iq, ik,
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref)
         dv_scr[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)     # p^T dO -> (bk, d)
@@ -251,11 +317,12 @@ def _flash_bwd_kv_kernel(scale, causal, sq_actual, sk_actual, bq, bk, nq,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd_q_kernel(scale, causal, sq_actual, sk_actual, bq, bk, nk,
-                        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                        dq_ref, dq_scr):
+def _flash_bwd_q_kernel(scale, causal, rate, sq_actual, sk_actual, bq, bk,
+                        nk, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                        delta_ref, seed_ref, dq_ref, dq_scr):
     """Grid (bh, iq, ik): accumulate dQ for query block iq over all key
     blocks. dq += ds k * scale."""
+    bh = pl.program_id(0)
     iq = pl.program_id(1)
     ik = pl.program_id(2)
 
@@ -265,8 +332,8 @@ def _flash_bwd_q_kernel(scale, causal, sq_actual, sk_actual, bq, bk, nk,
 
     def _compute():
         _, k, _, _, ds = _recompute_p_ds(
-            scale, causal, sq_actual, sk_actual, bq, bk, iq, ik,
-            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref)
+            scale, causal, rate, sq_actual, sk_actual, bq, bk, bh, iq, ik,
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref)
         dq_scr[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -280,6 +347,7 @@ def _flash_bwd_q_kernel(scale, causal, sq_actual, sk_actual, bq, bk, nk,
 
 
 def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
+               dropout_rate: float = 0.0, dropout_seed=None,
                block_q: int = 256, block_k: int = 256):
     """Pallas flash backward: O(S) memory (only lse/delta row stats are
     carried; the (Sq, Sk) score matrix never hits HBM) — the counterpart of
@@ -288,9 +356,13 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
     b, h, sq, d = q.shape
     sk = k.shape[2]
     dtype = q.dtype
+    seed = jnp.asarray(
+        0 if dropout_seed is None else dropout_seed,
+        jnp.int32).reshape((1,))
 
     # delta_i = rowsum(dO ⊙ O): the only quantity besides lse the backward
-    # needs from the forward
+    # needs from the forward. Unchanged under dropout: delta = dO.y =
+    # sum_k a_ik (dO.v_k) with a already carrying the keep mask.
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)                     # (b, h, sq)
 
@@ -317,30 +389,32 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
     k_spec = pl.BlockSpec((1, bk, dp_), lambda bh, i, j: (bh, i, 0))
     row_spec = pl.BlockSpec((1, 1, bq), lambda bh, i, j: (bh, 0, j))
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_kv_kernel, scale, causal, sq, sk,
-                          bq, bk, nq),
+        functools.partial(_flash_bwd_kv_kernel, scale, causal,
+                          dropout_rate, sq, sk, bq, bk, nq),
         grid=(b * h, nk, nq),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec,
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
         out_specs=[pl.BlockSpec((1, bk, dp_), lambda bh, i, j: (bh, i, 0))]
         * 2,
         out_shape=[jax.ShapeDtypeStruct((b * h, skp, dp_), dtype)] * 2,
         scratch_shapes=[pltpu.VMEM((bk, dp_), jnp.float32)] * 2,
         interpret=_interpret(),
-    )(qf, kf, vf, dof, lsef, deltaf)
+    )(qf, kf, vf, dof, lsef, deltaf, seed)
 
     q_spec2 = pl.BlockSpec((1, bq, dp_), lambda bh, i, j: (bh, i, 0))
     k_spec2 = pl.BlockSpec((1, bk, dp_), lambda bh, i, j: (bh, j, 0))
     row_spec2 = pl.BlockSpec((1, 1, bq), lambda bh, i, j: (bh, 0, i))
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_q_kernel, scale, causal, sq, sk,
-                          bq, bk, nk),
+        functools.partial(_flash_bwd_q_kernel, scale, causal,
+                          dropout_rate, sq, sk, bq, bk, nk),
         grid=(b * h, nq, nk),
-        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, row_spec2, row_spec2],
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, row_spec2, row_spec2,
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
         out_specs=pl.BlockSpec((1, bq, dp_), lambda bh, i, j: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sqp, dp_), dtype),
         scratch_shapes=[pltpu.VMEM((bq, dp_), jnp.float32)],
         interpret=_interpret(),
-    )(qf, kf, vf, dof, lsef, deltaf)
+    )(qf, kf, vf, dof, lsef, deltaf, seed)
 
     dq = dq[:, :sq, :d].reshape(b, h, sq, d)
     dk = dk[:, :sk, :d].reshape(b, h, sk, d)
@@ -348,29 +422,51 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention(q, k, v, causal: bool = False,
-                    scale: Optional[float] = None):
-    """Flash attention: Pallas forward AND backward (blockwise, O(S) HBM —
-    the (Sq, Sk) score matrix never materializes in either direction)."""
-    scale = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
-    out, _ = _flash_fwd(q, k, v, causal=causal, scale=scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_attention_core(q, k, v, seed, causal, scale, rate):
+    out, _ = _flash_fwd(q, k, v, causal=causal, scale=scale,
+                        dropout_rate=rate, dropout_seed=seed)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale):
-    scale_ = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
-    out, lse = _flash_fwd(q, k, v, causal=causal, scale=scale_)
-    return out, (q, k, v, out, lse)
+def _flash_vjp_fwd(q, k, v, seed, causal, scale, rate):
+    out, lse = _flash_fwd(q, k, v, causal=causal, scale=scale,
+                          dropout_rate=rate, dropout_seed=seed)
+    return out, (q, k, v, seed, out, lse)
 
 
-def _flash_vjp_bwd(causal, scale, res, g):
-    q, k, v, out, lse = res
-    scale_ = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
-    return _flash_bwd(q, k, v, out, lse, g, causal=causal, scale=scale_)
+def _flash_vjp_bwd(causal, scale, rate, res, g):
+    q, k, v, seed, out, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, causal=causal,
+                            scale=scale, dropout_rate=rate,
+                            dropout_seed=seed)
+    # integer seed: zero-size float0 cotangent
+    dseed = np.zeros(np.shape(seed), jax.dtypes.float0)
+    return dq, dk, dv, dseed
 
 
-flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+_flash_attention_core.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None,
+                    dropout_rate: float = 0.0, dropout_seed=None):
+    """Flash attention: Pallas forward AND backward (blockwise, O(S) HBM —
+    the (Sq, Sk) score matrix never materializes in either direction).
+    ``dropout_rate`` > 0 fuses dropout into the kernels (the reference's
+    fused softmax-dropout, dropout.h) using the deterministic counter mask
+    of :func:`dropout_keep_mask` seeded by ``dropout_seed`` (int32 scalar,
+    traced — a fresh seed per step does not retrace)."""
+    scale = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
+    rate = float(dropout_rate)
+    if rate > 0.0 and dropout_seed is None:
+        raise ValueError(
+            "flash_attention: dropout_rate > 0 requires dropout_seed — "
+            "without a per-step seed the same attention entries would be "
+            "dropped every step of training")
+    seed = jnp.asarray(0 if dropout_seed is None else dropout_seed,
+                       jnp.int32)
+    return _flash_attention_core(q, k, v, seed, causal, scale, rate)
 
 
 def self_attention(q, k, v, *, causal=False, scale=None, impl="auto"):
