@@ -68,6 +68,13 @@ def parse_args(argv=None):
                         "'host': uint8 host images through the C++ runtime "
                         "(augment_batch + PrefetchLoader, the reference's "
                         "data_prefetcher path)")
+    p.add_argument("--checkpoint-path", default=None,
+                   help="save params/batch_stats/opt_state (incl. amp "
+                        "loss-scale state) here after the run")
+    p.add_argument("--resume", default=None,
+                   help="checkpoint to restore before training (the "
+                        "reference's --resume recipe: re-initialize with "
+                        "the same opt_level, then load)")
     p.add_argument("--seed", type=int, default=0)
     return p.parse_args(argv)
 
@@ -179,6 +186,17 @@ def main(argv=None):
     params = amp.cast_model(params32, amp.resolve(args.opt_level))
     opt_state = aopt.init(params)
 
+    if args.resume:
+        from apex_tpu import checkpoint as ckpt
+        train_state = ckpt.restore_npz(
+            args.resume, {"params": params, "batch_stats": batch_stats,
+                          "opt_state": opt_state})
+        params = jax.tree.map(jnp.asarray, train_state["params"])
+        batch_stats = jax.tree.map(jnp.asarray,
+                                   train_state["batch_stats"])
+        opt_state = jax.tree.map(jnp.asarray, train_state["opt_state"])
+        print(f"resumed from {args.resume}")
+
     step_fn = build_train_step(model, aopt, mesh, args)
     # short runs: keep at least one timed step after warmup
     args.warmup_steps = min(args.warmup_steps, max(args.steps - 2, 0))
@@ -214,6 +232,15 @@ def main(argv=None):
     dt = time.perf_counter() - t0
     if hasattr(batches, "close"):
         batches.close()
+    if args.checkpoint_path:
+        from apex_tpu import checkpoint as ckpt
+        # opt_state carries the fp32 masters AND the amp loss-scale state,
+        # so this is the full bitwise-resume bundle (reference README
+        # "Checkpointing": model + optimizer + amp)
+        ckpt.save_npz(args.checkpoint_path,
+                      {"params": params, "batch_stats": batch_stats,
+                       "opt_state": opt_state})
+        print(f"checkpoint saved to {args.checkpoint_path}")
     timed = args.steps - 1 - args.warmup_steps
     img_s = args.batch_size * timed / dt
     print(f"Speed: {img_s:.1f} img/s over {timed} steps "
