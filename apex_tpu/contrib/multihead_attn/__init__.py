@@ -93,6 +93,13 @@ class SelfMultiheadAttn(nn.Module):
     impl: str = "fast"          # 'fast' (Pallas flash) | 'default' (jnp)
     causal: bool = False
     dtype: Any = None
+    # Sequence parallelism: run the attention itself over a mesh axis while
+    # every projection stays local to the sequence shard. 'ring' permutes
+    # K/V around the axis (no head constraint); 'ulysses' all-to-alls
+    # heads<->sequence (num_heads % axis size == 0). The module must be
+    # called under shard_map with the sequence dim sharded on `axis_name`.
+    seq_parallel: Optional[str] = None    # None | 'ring' | 'ulysses'
+    axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, *, attn_mask: Optional[jax.Array] = None,
@@ -110,6 +117,29 @@ class SelfMultiheadAttn(nn.Module):
         q = _split_heads(q, h)
         k = _split_heads(k, h)
         v = _split_heads(v, h)
+
+        if self.seq_parallel is not None:
+            if attn_mask is not None or (
+                    self.dropout > 0.0 and not deterministic):
+                raise NotImplementedError(
+                    "seq_parallel attention supports causal/plain only "
+                    "(no attn_mask, no dropout)")
+            if self.seq_parallel == "ring":
+                ctx = ring_self_attention(q, k, v, self.axis_name,
+                                          causal=self.causal)
+            elif self.seq_parallel == "ulysses":
+                ctx = ulysses_self_attention(q, k, v, self.axis_name,
+                                             causal=self.causal)
+            else:
+                raise ValueError(
+                    f"seq_parallel must be 'ring' or 'ulysses', got "
+                    f"{self.seq_parallel!r}")
+            out = nn.Dense(e, use_bias=self.bias, name="out_proj",
+                           dtype=self.dtype)(
+                _merge_heads(ctx).astype(x.dtype))
+            if self.include_norm_add:
+                out = out + residual
+            return out
 
         use_fast = self.impl == "fast" and attn_mask is None
         if use_fast:

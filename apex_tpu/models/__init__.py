@@ -1,7 +1,8 @@
 """apex_tpu.models — model zoo for the BASELINE workloads (ResNet imagenet,
-DCGAN multi-model, BERT pretrain)."""
+DCGAN multi-model, BERT pretrain) plus the long-context decoder LM."""
 
 from apex_tpu.models.resnet import (ResNet, ResNet18, ResNet34, ResNet50,
                                     ResNet101, ResNet152)
 from apex_tpu.models.dcgan import Generator, Discriminator
 from apex_tpu.models.bert import BertEncoder, bert_base, bert_large
+from apex_tpu.models.gpt import TransformerLM, GPTSmall, GPTTiny

@@ -1,0 +1,96 @@
+"""Decoder-only Transformer LM — the long-context flagship for the
+framework's sequence-parallel stack (the reference has no model zoo or
+distributed attention, SURVEY.md §5.7; this model exists so ring/Ulysses
+attention, flash kernels, FusedLayerNorm, and fused softmax-xentropy have
+an end-to-end consumer, the way examples/imagenet consumes amp+DDP).
+
+Pre-LN blocks: x + Attn(LN(x)), x + MLP(LN(x)). Attention is
+``contrib.multihead_attn.SelfMultiheadAttn`` (Pallas flash, fused
+dropout); with ``seq_parallel='ring'|'ulysses'`` the model runs on
+sequence shards under shard_map — every projection/LN/MLP is per-token
+and stays local, only the attention communicates. Pass ``pos_offset``
+(rank * local_seq) so learned position embeddings see global positions.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
+from apex_tpu.normalization import FusedLayerNorm
+
+
+class Block(nn.Module):
+    embed_dim: int
+    num_heads: int
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    dtype: Any = None
+    seq_parallel: Optional[str] = None
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True,
+                 dropout_rng=None):
+        e = self.embed_dim
+        h = SelfMultiheadAttn(
+            embed_dim=e, num_heads=self.num_heads, dropout=self.dropout,
+            causal=True, dtype=self.dtype, seq_parallel=self.seq_parallel,
+            axis_name=self.axis_name, name="attn")(
+            FusedLayerNorm(normalized_shape=e, name="ln1")(x)
+            .astype(x.dtype),
+            deterministic=deterministic, dropout_rng=dropout_rng)
+        x = x + h
+        y = FusedLayerNorm(normalized_shape=e, name="ln2")(x).astype(x.dtype)
+        y = nn.Dense(self.mlp_ratio * e, dtype=self.dtype, name="fc1")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(e, dtype=self.dtype, name="fc2")(y)
+        return x + y
+
+
+class TransformerLM(nn.Module):
+    """``TransformerLM(vocab, layers, embed_dim, heads)``; __call__ maps
+    (B, S) int tokens -> (B, S, vocab) fp32 logits."""
+
+    vocab_size: int
+    num_layers: int
+    embed_dim: int
+    num_heads: int
+    max_seq: int = 4096
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    dtype: Any = None
+    seq_parallel: Optional[str] = None
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, tokens, *, pos_offset=0, deterministic: bool = True,
+                 dropout_rng=None):
+        b, s = tokens.shape
+        emb = nn.Embed(self.vocab_size, self.embed_dim,
+                       dtype=self.dtype, name="tok_emb")(tokens)
+        pos = pos_offset + jnp.arange(s)
+        emb = emb + nn.Embed(self.max_seq, self.embed_dim,
+                             dtype=self.dtype, name="pos_emb")(pos)[None]
+        x = emb
+        for i in range(self.num_layers):
+            x = Block(self.embed_dim, self.num_heads, self.mlp_ratio,
+                      self.dropout, self.dtype, self.seq_parallel,
+                      self.axis_name, name=f"block_{i}")(
+                x, deterministic=deterministic, dropout_rng=dropout_rng)
+        x = FusedLayerNorm(normalized_shape=self.embed_dim,
+                           name="ln_f")(x).astype(x.dtype)
+        logits = nn.Dense(self.vocab_size, dtype=self.dtype,
+                          name="head")(x)
+        return logits.astype(jnp.float32)
+
+
+GPTSmall = functools.partial(TransformerLM, num_layers=12, embed_dim=768,
+                             num_heads=12)
+GPTTiny = functools.partial(TransformerLM, num_layers=2, embed_dim=128,
+                            num_heads=4)
