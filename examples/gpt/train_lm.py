@@ -1,0 +1,146 @@
+"""Long-context decoder-LM trainer — the sequence-parallel counterpart of
+the imagenet example: amp opt levels + FusedAdam + fused softmax-xentropy,
+with the mesh axis carrying SEQUENCE shards instead of batch shards when
+--seq-parallel is set (ring or ulysses attention; everything else in the
+block is token-local). The reference has no long-context story
+(SURVEY.md §5.7); this trainer is the framework's.
+
+Usage:
+  python examples/gpt/train_lm.py --seq-len 2048 --steps 20
+  python examples/gpt/train_lm.py --seq-parallel ring --seq-len 8192
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from apex_tpu import amp, optimizers, parallel
+from apex_tpu.models import TransformerLM
+from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--vocab", type=int, default=32768)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--embed-dim", type=int, default=256)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--batch-size", type=int, default=4)
+    p.add_argument("--seq-len", type=int, default=2048,
+                   help="GLOBAL sequence length")
+    p.add_argument("--opt-level", default="O5",
+                   choices=["O0", "O1", "O2", "O3", "O4", "O5"])
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup-steps", type=int, default=3)
+    p.add_argument("--seq-parallel", default=None,
+                   choices=[None, "ring", "ulysses"],
+                   help="shard the SEQUENCE over the mesh axis; attention "
+                        "communicates (ring ppermute / ulysses all-to-all),"
+                        " the rest of the block is token-local")
+    p.add_argument("--dropout", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    n_dev = len(jax.devices())
+    axis = "seq" if args.seq_parallel else "data"
+    mesh = parallel.make_mesh(axis_names=(axis,))
+    if args.seq_parallel and args.seq_len % n_dev:
+        raise SystemExit("--seq-len must divide the device count")
+    print(f"devices: {n_dev} ({jax.devices()[0].platform}), "
+          f"axis={axis}, global seq {args.seq_len}")
+
+    compute_dtype = amp.resolve(args.opt_level).cast_model_type
+    model = TransformerLM(
+        vocab_size=args.vocab, num_layers=args.layers,
+        embed_dim=args.embed_dim, num_heads=args.heads,
+        max_seq=args.seq_len, dropout=args.dropout,
+        dtype=compute_dtype or jnp.float32,
+        seq_parallel=args.seq_parallel,
+        axis_name="seq" if args.seq_parallel else None)
+    # params are identical across seq_parallel settings; init a dense twin
+    # (a mesh axis is not bound at init time)
+    init_model = model.clone(seq_parallel=None, axis_name=None)
+
+    key = jax.random.PRNGKey(args.seed)
+    init_tokens = jnp.zeros((1, min(args.seq_len, 128)), jnp.int32)
+    params32 = init_model.init(key, init_tokens)["params"]
+
+    inner = optimizers.FusedAdam(lr=args.lr)
+    _, aopt = amp.initialize(None, inner, opt_level=args.opt_level,
+                             verbosity=0)
+    params = amp.cast_model(params32, amp.resolve(args.opt_level))
+    opt_state = aopt.init(params)
+
+    def per_device(params, opt_state, tokens, rng):
+        if args.seq_parallel:
+            off = jax.lax.axis_index(axis) * tokens.shape[1]
+        else:
+            off = 0
+
+        def scaled(p):
+            logits = model.apply(
+                {"params": p}, tokens, pos_offset=off,
+                deterministic=args.dropout == 0.0, dropout_rng=rng)
+            loss = jnp.mean(softmax_cross_entropy_loss(
+                logits[:, :-1], tokens[:, 1:]))
+            return aopt.scale_loss(loss, opt_state), loss
+
+        grads, loss = jax.grad(scaled, has_aux=True)(params)
+        grads = jax.lax.pmean(grads, axis)
+        new_params, new_opt, _ = aopt.step(grads, params, opt_state)
+        return new_params, new_opt, jax.lax.pmean(loss, axis)
+
+    rep = P()
+    tok_spec = P(None, "seq") if args.seq_parallel else P("data")
+    step_fn = jax.jit(shard_map(
+        per_device, mesh=mesh,
+        in_specs=(rep, rep, tok_spec, rep),
+        out_specs=(rep, rep, rep), check_vma=False),
+        donate_argnums=(0, 1))
+
+    shard = NamedSharding(mesh, tok_spec)
+    batch = args.batch_size if args.seq_parallel else \
+        args.batch_size * n_dev
+    args.warmup_steps = min(args.warmup_steps, max(args.steps - 2, 0))
+
+    rng = np.random.default_rng(args.seed + 1)
+    t0 = None
+    for i in range(args.steps):
+        tokens = jax.device_put(
+            rng.integers(0, args.vocab, (batch, args.seq_len),
+                         np.int32), shard)
+        step_rng = jax.random.PRNGKey(args.seed + 2 + i)
+        params, opt_state, loss = step_fn(params, opt_state, tokens,
+                                          step_rng)
+        if i == args.warmup_steps:
+            jax.block_until_ready(loss)
+            t0 = time.perf_counter()
+        if i % 5 == 0 or i == args.steps - 1:
+            print(f"step {i:4d} loss {float(loss):.4f}")
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    timed = args.steps - 1 - args.warmup_steps
+    tok_s = batch * args.seq_len * timed / dt
+    print(f"Speed: {tok_s:,.0f} tokens/s over {timed} steps "
+          f"(seq_parallel={args.seq_parallel})")
+    return tok_s
+
+
+if __name__ == "__main__":
+    main()
