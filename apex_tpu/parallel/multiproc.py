@@ -36,12 +36,17 @@ def initialize_distributed() -> None:
 
 
 def main() -> None:
-    if len(sys.argv) < 2:
-        print("usage: python -m apex_tpu.parallel.multiproc script.py "
-              "[args...]", file=sys.stderr)
-        sys.exit(1)
-    initialize_distributed()
+    usage = ("usage: python -m apex_tpu.parallel.multiproc script.py "
+             "[args...]")
+    if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help"):
+        print(usage, file=sys.stderr)
+        sys.exit(0 if len(sys.argv) >= 2 else 1)
     script = sys.argv[1]
+    if not os.path.exists(script):
+        print(f"multiproc: no such script: {script}\n{usage}",
+              file=sys.stderr)
+        sys.exit(2)
+    initialize_distributed()
     sys.argv = sys.argv[1:]
     runpy.run_path(script, run_name="__main__")
 
