@@ -1,0 +1,126 @@
+"""BERT pretrain throughput — the BASELINE "BERT-large, FusedLAMB" config
+measured per chip (the reference publishes no number, BASELINE.md row 4).
+
+Full train step: bf16 encoder (flash MHA + FusedLayerNorm) forward, MLM
+fused-xentropy loss, backward, global grad-norm clip via
+multi_tensor_l2norm, FusedLAMB update at amp O5, all inside one jitted
+lax.scan (dispatch-amortized like bench.py).
+
+Run: ``python benchmarks/bench_bert.py [--model large|base] [--seq 128]``.
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    from apex_tpu import amp, optimizers, parallel, models
+    from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="large", choices=["base", "large"])
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--batch", type=int, default=0, help="0: auto")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--inner", type=int, default=5)
+    args = p.parse_args()
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    n_dev = len(jax.devices())
+    vocab = 30522
+    batch = args.batch or ((32 if args.model == "large" else 64)
+                           if on_tpu else 2 * n_dev)
+    if not on_tpu:
+        args.steps, args.inner, args.seq = 4, 2, 64
+
+    mesh = parallel.make_mesh(axis_names=("data",))
+    mk = models.bert_large if args.model == "large" else models.bert_base
+    # off-TPU the Pallas kernels run in interpret mode (pure emulation,
+    # orders of magnitude slow) — use the XLA reference attention there
+    model = mk(vocab_size=vocab, dtype=jnp.bfloat16,
+               impl="fast" if on_tpu else "default")
+    tokens = jnp.zeros((2, args.seq), jnp.int32)
+    params32 = model.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    inner_opt = optimizers.FusedLAMB(lr=4e-3, weight_decay=0.01,
+                                     max_grad_norm=1.0)
+    _, aopt = amp.initialize(None, inner_opt, opt_level="O5", verbosity=0)
+    params = amp.cast_model(params32, amp.resolve("O5"))
+    opt_state = aopt.init(params)
+
+    def per_device(params, opt_state, batch_):
+        toks, labels = batch_
+
+        def scaled(p):
+            logits = model.apply({"params": p}, toks)
+            loss = jnp.mean(softmax_cross_entropy_loss(logits, labels))
+            return aopt.scale_loss(loss, opt_state), loss
+
+        grads, loss = jax.grad(scaled, has_aux=True)(params)
+        grads = parallel.allreduce_gradients(grads, "data")
+        new_p, new_s, _ = aopt.step(grads, params, opt_state)
+        return new_p, new_s, jax.lax.pmean(loss, "data")
+
+    def multi(params, opt_state, batch_):
+        def body(carry, _):
+            p, s = carry
+            p, s, loss = per_device(p, s, batch_)
+            return (p, s), loss
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), None, length=args.inner)
+        return params, opt_state, losses[-1]
+
+    rep = P()
+    fn = jax.jit(shard_map(
+        multi, mesh=mesh, in_specs=(rep, rep, (P("data"), P("data"))),
+        out_specs=(rep, rep, rep), check_vma=False),
+        donate_argnums=(0, 1))
+
+    shard = NamedSharding(mesh, P("data"))
+    kt, kl = jax.random.split(jax.random.PRNGKey(1))
+    toks = jax.device_put(
+        jax.random.randint(kt, (batch, args.seq), 0, vocab), shard)
+    labels = jax.device_put(
+        jax.random.randint(kl, (batch, args.seq), 0, vocab), shard)
+
+    # TWO warm dispatches: the first compiles; the second compiles AGAIN
+    # because donated outputs return with different layouts than the
+    # device_put inputs (jit caches on layouts) — only then is the
+    # executable steady
+    for _ in range(2):
+        params, opt_state, loss = fn(params, opt_state, (toks, labels))
+        float(loss)
+    outer = max(1, args.steps // args.inner)
+    t0 = time.perf_counter()
+    for _ in range(outer):
+        params, opt_state, loss = fn(params, opt_state, (toks, labels))
+    float(loss)   # D2H fetch: the only reliable full sync over the tunnel
+    dt = time.perf_counter() - t0
+    n = outer * args.inner
+    seq_s = batch * n / dt
+    print(json.dumps({
+        "metric": f"bert_{args.model}_pretrain_seq{args.seq}_"
+                  f"lamb_O5_sequences_per_sec",
+        "value": round(seq_s, 1),
+        "unit": "seq/s",
+        "tokens_per_sec": round(seq_s * args.seq, 0),
+    }))
+
+
+if __name__ == "__main__":
+    main()
