@@ -121,9 +121,13 @@ def main():
             params, batch_stats, opt_state, (x, y))
     jax.block_until_ready(loss)
     log(f"single-step warmup done ({warmup} steps), loss={float(loss):.3f}")
-    params, batch_stats, opt_state, loss = multi_fn(
-        params, batch_stats, opt_state, (x, y))
-    jax.block_until_ready(loss)
+    # TWO warm dispatches: donated outputs can return with different
+    # layouts than the device_put inputs, and the second call then
+    # re-compiles (jit caches on layouts) — warm until steady
+    for _ in range(2):
+        params, batch_stats, opt_state, loss = multi_fn(
+            params, batch_stats, opt_state, (x, y))
+        float(loss)
     log("scan executable warmed up")
 
     outer = max(1, (steps - warmup) // inner_steps)
