@@ -82,12 +82,31 @@ class AmpOptimizer:
         """
         props = self.properties
         use_master = props.master_weights
+        # FusedSGD's materialize_master_grads=False fast path
+        # (apex/amp/_process_optimizer.py:258-310): no fp32 master-grad
+        # materialization — the low-precision grads feed the kernel directly
+        # with the unscale fused via grad_scale, and the kernel emits the
+        # low-precision model copy alongside the fp32 master update (the
+        # reference's 4-list multi_tensor_sgd variant).
+        no_materialize = use_master and not getattr(
+            self.inner, "materialize_master_grads", True)
 
-        grads32, overflow = self.scaler.unscale(
-            scaled_grads, state.scaler, loss_id,
-            out_dtype=jnp.float32 if use_master else None)
+        if no_materialize:
+            from apex_tpu import ops
+            overflow = ops.multi_tensor_check_overflow(scaled_grads)
+            grads32 = scaled_grads
+        else:
+            grads32, overflow = self.scaler.unscale(
+                scaled_grads, state.scaler, loss_id,
+                out_dtype=jnp.float32 if use_master else None)
 
         def do_step(_):
+            if no_materialize:
+                new_master, new_inner, new_model = self.inner.step(
+                    grads32, state.master, state.inner,
+                    grad_scale=state.scaler.loss_scale[loss_id],
+                    model_out_template=model_params)
+                return new_model, new_master, new_inner
             target = state.master if use_master else model_params
             new_target, new_inner = self.inner.step(grads32, target,
                                                     state.inner)

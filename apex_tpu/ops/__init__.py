@@ -19,6 +19,7 @@ from apex_tpu.ops.multi_tensor import (
     multi_tensor_adagrad,
     multi_tensor_novograd,
     multi_tensor_lamb,
+    multi_tensor_check_overflow,
     use_pallas,
 )
 from apex_tpu.ops.attention import (

@@ -34,10 +34,17 @@ class BucketSpec:
         return len(self.shapes)
 
 
-def flatten_tensors(tensors: Sequence[jax.Array]) -> Tuple[jax.Array, BucketSpec]:
+def flatten_tensors(tensors: Sequence[jax.Array], align: int = 1,
+                    ) -> Tuple[jax.Array, BucketSpec]:
     """Pack a list of same-dtype arrays into one contiguous 1-D bucket.
 
     Analog of ``apex_C.flatten`` (csrc/flatten_unflatten.cpp:5-10).
+
+    ``align > 1`` starts every tensor at a multiple of ``align`` elements
+    (zero-padded gaps). Segmented Pallas reductions (per-tensor norms, LAMB
+    trust ratios) use lane-aligned buckets so each (sublane, lane) row belongs
+    to exactly one tensor — the TPU layout counterpart of the reference's
+    per-chunk ``tensor_loc`` bookkeeping (csrc/multi_tensor_apply.cuh:72-106).
     """
     if not tensors:
         raise ValueError("flatten_tensors: empty tensor list")
@@ -50,10 +57,24 @@ def flatten_tensors(tensors: Sequence[jax.Array]) -> Tuple[jax.Array, BucketSpec
             )
     shapes = tuple(tuple(t.shape) for t in tensors)
     sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
-    offsets = tuple(int(x) for x in np.cumsum((0,) + sizes[:-1]))
-    flat = jnp.concatenate([t.reshape(-1) for t in tensors])
+    if align <= 1:
+        offsets = tuple(int(x) for x in np.cumsum((0,) + sizes[:-1]))
+        flat = jnp.concatenate([t.reshape(-1) for t in tensors])
+        total = int(sum(sizes))
+    else:
+        offsets_l, parts, pos = [], [], 0
+        for t, size in zip(tensors, sizes):
+            start = ((pos + align - 1) // align) * align
+            if start > pos:
+                parts.append(jnp.zeros((start - pos,), dtype))
+            offsets_l.append(start)
+            parts.append(t.reshape(-1))
+            pos = start + size
+        offsets = tuple(offsets_l)
+        flat = jnp.concatenate(parts)
+        total = pos
     spec = BucketSpec(shapes=shapes, dtype=dtype, offsets=offsets, sizes=sizes,
-                      total=int(sum(sizes)))
+                      total=total)
     return flat, spec
 
 

@@ -4,14 +4,27 @@ reference's ``amp_C`` extension (csrc/amp_C_frontend.cpp:115-136 and the
 
 Two execution paths, selected by :func:`use_pallas`:
 
-  * **jnp path** (always available, used on CPU): pure ``jax.numpy`` tree maps.
+  * **jnp path** (the default everywhere): pure ``jax.numpy`` tree maps.
     Under ``jit`` XLA fuses the whole-model elementwise update into a few
-    fusions, which already captures most of what multi_tensor_apply buys on
-    CUDA (batching thousands of tiny kernels, csrc/multi_tensor_apply.cuh:12).
-  * **Pallas path** (TPU): parameters are packed into flat per-dtype buckets
-    (ops/buckets.py) and a single Pallas kernel per bucket performs the update,
-    mirroring the reference's chunked launches
-    (csrc/multi_tensor_apply.cuh:41-142).
+    fusions, which captures what multi_tensor_apply buys on CUDA (batching
+    thousands of tiny kernels, csrc/multi_tensor_apply.cuh:12) *without* any
+    marshalling.
+  * **Pallas path** (opt-in, ``APEX_TPU_MT_BACKEND=pallas``): parameters are
+    packed into flat per-dtype buckets (ops/buckets.py) and a single Pallas
+    kernel per bucket performs the update, mirroring the reference's chunked
+    launches (csrc/multi_tensor_apply.cuh:41-142).
+
+The default is **jnp on TPU too**, by measurement: on a v5e chip over a
+ResNet-50-sized tree, XLA's fusion beats the Pallas bucket kernels on every
+one of the eight ops (1.4x kernel-only — XLA pipelines a fused elementwise
+loop better than a grid of aliased blocks — and 3-13x end-to-end once the
+per-step bucket flatten/unflatten is counted; see
+``benchmarks/bench_optimizers.py --ops`` and the table in BASELINE.md). The
+CUDA reference needs hand-written multi-tensor kernels because eager torch
+launches one kernel per tensor; XLA's whole-graph fusion is the TPU-native
+answer to the same problem. The Pallas layer is kept complete, parity-tested
+(tests/test_multi_tensor.py, benchmarks/tpu_kernel_check.py) and selectable
+for cases where producer fusion is unavailable.
 
 Overflow contract: the reference kernels set a device-side ``noop_flag`` when
 they see inf/nan (e.g. ScaleFunctor, csrc/multi_tensor_scale_kernel.cu:30).
@@ -52,18 +65,18 @@ def on_tpu() -> bool:
 def use_pallas(*trees: Tree) -> bool:
     """True when the fused Pallas bucket kernels should be used for ``trees``.
 
+    Default **False** (measured: XLA fusion wins on TPU — see module
+    docstring); ``APEX_TPU_MT_BACKEND=pallas`` forces the bucket kernels on.
     fp16 always takes the jnp path: Mosaic (the Pallas TPU compiler) has no
     f16 type, while plain XLA handles f16 storage fine.
     """
-    if _FORCE == "jnp":
+    if _FORCE != "pallas":
         return False
     for t in trees:
         for l in jax.tree_util.tree_leaves(t):
             if l.dtype == jnp.float16:
                 return False
-    if _FORCE == "pallas":
-        return True
-    return on_tpu()
+    return True
 
 
 def _nonfinite(x: jax.Array) -> jax.Array:
@@ -127,9 +140,11 @@ def multi_tensor_l2norm(tree: Tree, per_tensor: bool = False,
     reduction maps to XLA's reduction + a final psum-free scalar add tree).
     Returns ``(global_norm, per_tensor_norms_or_None)`` as fp32.
     """
-    if use_pallas(tree) and not per_tensor:
+    if use_pallas(tree):
         from apex_tpu.ops import pallas_mt
-        return pallas_mt.l2norm_tree(tree), None
+        if not per_tensor:
+            return pallas_mt.l2norm_tree(tree), None
+        return pallas_mt.l2norm_tree_per_tensor(tree)
     leaves = jax.tree_util.tree_leaves(tree)
     sq = [jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves]
     gnorm = jnp.sqrt(functools.reduce(jnp.add, sq, jnp.asarray(0.0, jnp.float32)))
@@ -202,60 +217,99 @@ def multi_tensor_sgd(
     lr: jax.Array, weight_decay: float = 0.0, momentum: float = 0.0,
     dampening: float = 0.0, nesterov: bool = False, first_run: bool = False,
     wd_after_momentum: bool = False, scale: float = 1.0,
-) -> Tuple[Tree, Tree]:
+    model_out_template: Optional[Tree] = None,
+):
     """Fused SGD with momentum/nesterov/weight-decay over a pytree.
 
     Math parity with ``amp_C.multi_tensor_sgd``
-    (csrc/multi_tensor_sgd_kernel.cu:320). ``first_run`` initializes the
-    momentum buffer to the (decayed) grad like torch SGD's lazy init.
-    Returns ``(new_params, new_momentum_buf)``.
+    (csrc/multi_tensor_sgd_kernel.cu:320). ``first_run`` (Python bool or
+    traced bool scalar) initializes the momentum buffer to the (decayed) grad
+    like torch SGD's lazy init. ``model_out_template`` (a pytree giving
+    per-leaf dtypes) requests a fused low-precision model-param copy — the
+    reference kernel's 4-list [grads, master, momentum, fp16 model] variant
+    used by amp FusedSGD with ``materialize_master_grads=False``.
+    Returns ``(new_params, new_momentum_buf[, new_model_copy])``.
     """
+    if momentum_buf is None:
+        momentum_buf = jax.tree_util.tree_map(
+            lambda g: jnp.zeros_like(g, dtype=jnp.float32), grads)
+
+    if use_pallas(grads, params, momentum_buf):
+        from apex_tpu.ops import pallas_mt
+        return pallas_mt.sgd_tree(
+            grads, params, momentum_buf, lr=lr, weight_decay=weight_decay,
+            momentum=momentum, dampening=dampening, nesterov=nesterov,
+            wd_after_momentum=wd_after_momentum, first=first_run, scale=scale,
+            model_out_template=model_out_template)
+
     def upd(g, p, m):
         g32 = g.astype(jnp.float32) * scale
         p32 = p.astype(jnp.float32)
         if weight_decay != 0.0 and not wd_after_momentum:
             g32 = g32 + weight_decay * p32
         if momentum != 0.0:
-            m32 = m.astype(jnp.float32)
-            if first_run:
-                m32 = g32
-            else:
-                m32 = momentum * m32 + (1.0 - dampening) * g32
+            m_steady = momentum * m.astype(jnp.float32) \
+                + (1.0 - dampening) * g32
+            m32 = jnp.where(jnp.asarray(first_run), g32, m_steady)
             d = g32 + momentum * m32 if nesterov else m32
         else:
-            m32 = m.astype(jnp.float32) if m is not None else jnp.zeros_like(g32)
+            m32 = m.astype(jnp.float32)
             d = g32
         if weight_decay != 0.0 and wd_after_momentum:
             d = d + weight_decay * p32
         p32 = p32 - lr * d
-        return p32.astype(p.dtype), m32.astype(m.dtype) if m is not None else m32
+        return p32.astype(p.dtype), m32.astype(m.dtype)
 
-    if momentum_buf is None:
-        momentum_buf = jax.tree_util.tree_map(
-            lambda g: jnp.zeros_like(g, dtype=jnp.float32), grads)
     out = jax.tree_util.tree_map(upd, grads, params, momentum_buf)
     new_p = jax.tree_util.tree_map(lambda t: t[0], out,
                                    is_leaf=lambda t: isinstance(t, tuple))
     new_m = jax.tree_util.tree_map(lambda t: t[1], out,
                                    is_leaf=lambda t: isinstance(t, tuple))
+    if model_out_template is not None:
+        new_model = jax.tree_util.tree_map(
+            lambda p, t: p.astype(t.dtype), new_p, model_out_template)
+        return new_p, new_m, new_model
     return new_p, new_m
+
+
+def multi_tensor_check_overflow(tree: Tree) -> jax.Array:
+    """Reduction-only nonfinite check over a pytree (no output write).
+
+    The amp no-materialize FusedSGD path uses this in place of a full
+    materializing unscale (apex/amp/_process_optimizer.py:258-310 skips master
+    grad creation; the overflow check still runs via multi_tensor_scale's
+    noop flag).
+    """
+    return _tree_overflow(tree)
 
 
 def multi_tensor_adagrad(
     grads: Tree, params: Tree, state_sum: Tree, *,
     lr: jax.Array, epsilon: float = 1e-10, weight_decay: float = 0.0,
+    adagrad_w_mode: bool = False, scale: float = 1.0,
 ) -> Tuple[Tree, Tree]:
-    """Fused Adagrad step (csrc/multi_tensor_adagrad.cu).
+    """Fused Adagrad step (csrc/multi_tensor_adagrad.cu; the ``adagrad_w_mode``
+    decoupled-decay flag mirrors apex/optimizers/fused_adagrad.py:5).
 
     Returns ``(new_params, new_state_sum)``.
     """
+    if use_pallas(grads, params, state_sum):
+        from apex_tpu.ops import pallas_mt
+        return pallas_mt.adagrad_tree(
+            grads, params, state_sum, lr=lr, eps=epsilon,
+            weight_decay=weight_decay, adagrad_w_mode=adagrad_w_mode,
+            scale=scale)
+
     def upd(g, p, h):
-        g32 = g.astype(jnp.float32)
+        g32 = g.astype(jnp.float32) * scale
         p32 = p.astype(jnp.float32)
-        if weight_decay != 0.0:
+        if weight_decay != 0.0 and not adagrad_w_mode:
             g32 = g32 + weight_decay * p32
         h32 = h.astype(jnp.float32) + g32 * g32
-        p32 = p32 - lr * g32 / (jnp.sqrt(h32) + epsilon)
+        u = g32 / (jnp.sqrt(h32) + epsilon)
+        if weight_decay != 0.0 and adagrad_w_mode:
+            u = u + weight_decay * p32
+        p32 = p32 - lr * u
         return p32.astype(p.dtype), h32.astype(h.dtype)
 
     out = jax.tree_util.tree_map(upd, grads, params, state_sum)
@@ -270,39 +324,53 @@ def multi_tensor_novograd(
     grads: Tree, params: Tree, exp_avg: Tree, v_per_tensor: Tree, *,
     lr: jax.Array, beta1: float, beta2: float, eps: float, step: jax.Array,
     weight_decay: float = 0.0, bias_correction: bool = True,
-    norm_type: int = 2, init_v: bool = False,
+    grad_averaging: bool = True, norm_type: int = 2,
+    init_zero: bool = False, first=None, scale: float = 1.0,
 ) -> Tuple[Tree, Tree, Tree]:
     """Fused NovoGrad step (csrc/multi_tensor_novograd.cu,
     signature csrc/amp_C_frontend.cpp:82-96).
 
     NovoGrad's second moment ``v`` is a *per-tensor scalar* tracking the grad
     norm, not an elementwise buffer. ``v_per_tensor`` is a pytree of scalars.
+    ``first`` (bool or traced scalar; defaults to ``step == 1``) selects the
+    v initialization: 0 when ``init_zero`` else the first grad-norm^2 — the
+    reference's ``init_zero`` knob (apex/optimizers/fused_novograd.py).
     Returns ``(new_params, new_exp_avg, new_v)``.
     """
     step = jnp.asarray(step, jnp.float32)
+    if first is None:
+        first = step == 1
     if bias_correction:
         bc1 = 1.0 - jnp.power(jnp.asarray(beta1, jnp.float32), step)
         bc2 = 1.0 - jnp.power(jnp.asarray(beta2, jnp.float32), step)
     else:
         bc1 = jnp.asarray(1.0, jnp.float32)
         bc2 = jnp.asarray(1.0, jnp.float32)
+    beta3 = (1.0 - beta1) if grad_averaging else 1.0
+
+    if norm_type == 2 and use_pallas(grads, params, exp_avg):
+        from apex_tpu.ops import pallas_mt
+        return pallas_mt.novograd_tree(
+            grads, params, exp_avg, v_per_tensor, lr=lr, beta1=beta1,
+            beta2=beta2, beta3=beta3, eps=eps, bc1=bc1, bc2=bc2,
+            weight_decay=weight_decay, init_zero=init_zero, first=first,
+            scale=scale)
 
     def upd(g, p, m, v):
-        g32 = g.astype(jnp.float32)
+        g32 = g.astype(jnp.float32) * scale
         p32 = p.astype(jnp.float32)
         if norm_type == 2:
-            gnorm = jnp.sqrt(jnp.sum(g32 * g32))
+            gn_sq = jnp.sum(g32 * g32)
         else:
-            gnorm = jnp.max(jnp.abs(g32))
-        v32 = jnp.where(jnp.asarray(init_v),
-                        gnorm * gnorm if norm_type == 2 else gnorm,
-                        beta2 * v.astype(jnp.float32) + (1.0 - beta2) *
-                        (gnorm * gnorm if norm_type == 2 else gnorm))
+            gn_sq = jnp.max(jnp.abs(g32))
+        v32 = jnp.where(jnp.asarray(first),
+                        0.0 if init_zero else gn_sq,
+                        beta2 * v.astype(jnp.float32) + (1.0 - beta2) * gn_sq)
         denom = jnp.sqrt(v32 / bc2) + eps
         gn = g32 / denom
         if weight_decay != 0.0:
             gn = gn + weight_decay * p32
-        m32 = beta1 * m.astype(jnp.float32) + (1.0 - beta1) * gn
+        m32 = beta1 * m.astype(jnp.float32) + beta3 * gn
         p32 = p32 - lr * (m32 / bc1)
         return p32.astype(p.dtype), m32.astype(m.dtype), v32.astype(jnp.float32)
 
@@ -323,13 +391,16 @@ def multi_tensor_lamb(
     grad_averaging: bool = True, adam_w_mode: bool = True,
     global_grad_norm: Optional[jax.Array] = None,
     max_grad_norm: float = 0.0, use_nvlamb: bool = False,
+    scale: float = 1.0,
 ) -> Tuple[Tree, Tree, Tree]:
     """Fused one-shot LAMB step (csrc/multi_tensor_lamb.cu:413, signature
     csrc/amp_C_frontend.cpp:98-113): global grad-norm clip, Adam moments, then a
     per-tensor trust ratio ``|p| / |update|`` scaling the learning rate.
 
     ``use_nvlamb`` keeps the trust ratio even for zero-weight-decay tensors
-    (NVLamb variant, apex/optimizers/fused_lamb.py docs).
+    (NVLamb variant, apex/optimizers/fused_lamb.py docs). ``scale`` multiplies
+    grads on the fly (fused amp unscale); a caller-supplied
+    ``global_grad_norm`` must already refer to the scaled grads.
     Returns ``(new_params, new_exp_avg, new_exp_avg_sq)``.
     """
     step = jnp.asarray(step, jnp.float32)
@@ -343,15 +414,25 @@ def multi_tensor_lamb(
 
     # Global grad-norm clipping (stage 1 of csrc/multi_tensor_lamb.cu).
     if global_grad_norm is None:
-        global_grad_norm, _ = multi_tensor_l2norm(grads)
+        gnorm_raw, _ = multi_tensor_l2norm(grads)
+        global_grad_norm = gnorm_raw * scale
     if max_grad_norm > 0.0:
         clip = jnp.where(global_grad_norm > max_grad_norm,
                          global_grad_norm / max_grad_norm, 1.0)
     else:
         clip = jnp.asarray(1.0, jnp.float32)
 
+    if use_pallas(grads, params, exp_avg, exp_avg_sq):
+        from apex_tpu.ops import pallas_mt
+        return pallas_mt.lamb_tree(
+            grads, params, exp_avg, exp_avg_sq,
+            lr=lr, beta1=beta1, beta2=beta2, beta3=beta3, eps=eps,
+            bc1=bc1, bc2=bc2, adam_w_mode=adam_w_mode,
+            weight_decay=weight_decay, inv_clip=scale / clip,
+            use_ratio=(weight_decay != 0.0) or use_nvlamb)
+
     def upd(g, p, m, v):
-        g32 = g.astype(jnp.float32) / clip
+        g32 = g.astype(jnp.float32) * scale / clip
         p32 = p.astype(jnp.float32)
         if not adam_w_mode and weight_decay != 0.0:
             g32 = g32 + weight_decay * p32

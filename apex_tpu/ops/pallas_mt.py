@@ -33,7 +33,10 @@ BLOCK_ROWS = 512  # 512x128 fp32 = 256 KiB per operand block in VMEM
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    # Must agree with multi_tensor._TPU_BACKENDS: an axon-tunneled chip is a
+    # real TPU and must get Mosaic compilation, not interpret mode.
+    from apex_tpu.ops.multi_tensor import _TPU_BACKENDS
+    return jax.default_backend() not in _TPU_BACKENDS
 
 
 def _as_blocked(flat: jax.Array) -> Tuple[jax.Array, int]:
@@ -226,6 +229,372 @@ def adam_flat(g: jax.Array, p: jax.Array, m: jax.Array, v: jax.Array, *,
 
 
 # ---------------------------------------------------------------------------
+# Segmented (per-tensor) reductions over lane-aligned buckets.
+#
+# The reference computes per-tensor norms from a flat bucket with a two-stage
+# kernel: per-chunk partial sums into (tensor, chunk) scratch, then a cleanup
+# reduction (csrc/multi_tensor_l2norm_kernel.cu:197-280). The TPU one-pass
+# equivalent: tensors are packed at LANES-aligned offsets so every (sublane,
+# lane) row of the blocked view belongs to exactly one tensor; the kernel
+# reduces each row (lane axis), then scatters row sums into a (1, T_pad)
+# accumulator via a row->tensor one-hot built from start/end row bounds. The
+# grid is sequential on TPU so the accumulator persists across grid steps, and
+# the O(T) cleanup (sqrt, trust ratios) runs on scalars outside the kernel.
+# ---------------------------------------------------------------------------
+
+def _pad_t(t: int) -> int:
+    return max(LANES, ((t + LANES - 1) // LANES) * LANES)
+
+
+def _seg_bounds(spec) -> Tuple[jax.Array, jax.Array, int]:
+    """Per-tensor [start, end) row bounds of a LANES-aligned bucket, padded to
+    (1, T_pad) int32 for VMEM."""
+    import numpy as np
+    offs = np.asarray(spec.offsets, np.int64)
+    sizes = np.asarray(spec.sizes, np.int64)
+    if (offs % LANES).any():
+        raise ValueError("segmented reduction needs LANES-aligned offsets; "
+                         "flatten with align=LANES")
+    t = len(spec.sizes)
+    t_pad = _pad_t(t)
+    starts = np.zeros((1, t_pad), np.int32)
+    ends = np.zeros((1, t_pad), np.int32)
+    starts[0, :t] = offs // LANES
+    ends[0, :t] = (offs + sizes + LANES - 1) // LANES
+    return jnp.asarray(starts), jnp.asarray(ends), t_pad
+
+
+def _row_onehot(i, starts, ends):
+    """(BLOCK_ROWS, T_pad) {0,1} map of block-local rows to tensors."""
+    r = i * BLOCK_ROWS + jax.lax.broadcasted_iota(
+        jnp.int32, (BLOCK_ROWS, 1), 0)
+    return jnp.logical_and(r >= starts, r < ends).astype(jnp.float32)
+
+
+def _l2norm_seg_kernel(x_ref, starts_ref, ends_ref, acc_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[:].astype(jnp.float32)
+    rowsq = jnp.sum(x * x, axis=1, keepdims=True)          # (BLOCK_ROWS, 1)
+    onehot = _row_onehot(i, starts_ref[:], ends_ref[:])
+    acc_ref[:] += jnp.sum(rowsq * onehot, axis=0, keepdims=True)
+
+
+def l2norm_sq_seg_flat(x: jax.Array, spec) -> jax.Array:
+    """Per-tensor sums of squares of one LANES-aligned bucket -> (T,) fp32."""
+    starts, ends, t_pad = _seg_bounds(spec)
+    xb, _ = _as_blocked(x)
+    grid = xb.shape[0] // BLOCK_ROWS
+    acc = pl.pallas_call(
+        _l2norm_seg_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((1, t_pad), lambda i: (0, 0)),
+            pl.BlockSpec((1, t_pad), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, t_pad), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, t_pad), jnp.float32),
+        interpret=_interpret(),
+    )(xb, starts, ends)
+    return acc[0, :len(spec.sizes)]
+
+
+# ---------------------------------------------------------------------------
+# sgd
+# ---------------------------------------------------------------------------
+
+def _sgd_kernel(use_momentum, nesterov, wd_after_momentum, n_out,
+                c_ref, g_ref, p_ref, m_ref, *out_refs):
+    # c = [lr, weight_decay, momentum, dampening, scale, first]
+    p_out, m_out = out_refs[0], out_refs[1]
+    lr, wd, mom = c_ref[0], c_ref[1], c_ref[2]
+    damp, scale, first = c_ref[3], c_ref[4], c_ref[5]
+    g = g_ref[:].astype(jnp.float32) * scale
+    p = p_ref[:].astype(jnp.float32)
+    if not wd_after_momentum:
+        g = g + wd * p
+    if use_momentum:
+        m_steady = mom * m_ref[:].astype(jnp.float32) + (1.0 - damp) * g
+        m = jnp.where(first > 0, g, m_steady)
+        d = g + mom * m if nesterov else m
+        m_out[:] = m.astype(m_out.dtype)
+    else:
+        m_out[:] = m_ref[:]
+        d = g
+    if wd_after_momentum:
+        d = d + wd * p
+    p_new = p - lr * d
+    p_out[:] = p_new.astype(p_out.dtype)
+    if n_out == 3:
+        out_refs[2][:] = p_new.astype(out_refs[2].dtype)
+
+
+def sgd_flat(g: jax.Array, p: jax.Array, m: jax.Array, *, lr, weight_decay,
+             momentum, dampening, nesterov, wd_after_momentum, first,
+             scale=1.0, model_dtype=None):
+    """Fused SGD on one flat bucket (csrc/multi_tensor_sgd_kernel.cu:320).
+
+    ``model_dtype`` adds a fused low-precision model-param copy output — the
+    reference's 4-list variant used by amp FusedSGD with
+    ``materialize_master_grads=False`` (multi_tensor_sgd_kernel.cu N=4 case).
+    Returns ``(new_p, new_m[, new_model])``.
+    """
+    gb, n = _as_blocked(g)
+    pb, _ = _as_blocked(p)
+    mb, _ = _as_blocked(m)
+    grid = gb.shape[0] // BLOCK_ROWS
+    c = jnp.stack([
+        jnp.asarray(lr, jnp.float32), jnp.asarray(weight_decay, jnp.float32),
+        jnp.asarray(momentum, jnp.float32),
+        jnp.asarray(dampening, jnp.float32),
+        jnp.asarray(scale, jnp.float32),
+        jnp.asarray(first, jnp.float32),
+    ])
+    blk = lambda: pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0))
+    n_out = 3 if model_dtype is not None else 2
+    out_specs = [blk() for _ in range(n_out)]
+    out_shape = [jax.ShapeDtypeStruct(pb.shape, p.dtype),
+                 jax.ShapeDtypeStruct(mb.shape, m.dtype)]
+    if model_dtype is not None:
+        out_shape.append(jax.ShapeDtypeStruct(pb.shape, model_dtype))
+    # Momentum structure is static when momentum is a Python number (the
+    # optimizer hyperparameter case); a traced momentum keeps the buffer live.
+    use_momentum = not (isinstance(momentum, (int, float)) and momentum == 0)
+    outs = pl.pallas_call(
+        functools.partial(_sgd_kernel, use_momentum, bool(nesterov),
+                          bool(wd_after_momentum), n_out),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  blk(), blk(), blk()],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        input_output_aliases={2: 0, 3: 1},
+        interpret=_interpret(),
+    )(c, gb, pb, mb)
+    res = tuple(_unblocked(o, n) for o in outs)
+    return res
+
+
+# ---------------------------------------------------------------------------
+# adagrad
+# ---------------------------------------------------------------------------
+
+def _adagrad_kernel(adagrad_w_mode, c_ref, g_ref, p_ref, h_ref, p_out, h_out):
+    # c = [lr, eps, weight_decay, scale]
+    lr, eps, wd, scale = c_ref[0], c_ref[1], c_ref[2], c_ref[3]
+    g = g_ref[:].astype(jnp.float32) * scale
+    p = p_ref[:].astype(jnp.float32)
+    if not adagrad_w_mode:
+        g = g + wd * p
+    h = h_ref[:].astype(jnp.float32) + g * g
+    u = g / (jnp.sqrt(h) + eps)
+    if adagrad_w_mode:
+        u = u + wd * p
+    p_out[:] = (p - lr * u).astype(p_out.dtype)
+    h_out[:] = h.astype(h_out.dtype)
+
+
+def adagrad_flat(g: jax.Array, p: jax.Array, h: jax.Array, *, lr, eps,
+                 weight_decay, adagrad_w_mode=False, scale=1.0):
+    """Fused Adagrad on one flat bucket (csrc/multi_tensor_adagrad.cu)."""
+    gb, n = _as_blocked(g)
+    pb, _ = _as_blocked(p)
+    hb, _ = _as_blocked(h)
+    grid = gb.shape[0] // BLOCK_ROWS
+    c = jnp.stack([
+        jnp.asarray(lr, jnp.float32), jnp.asarray(eps, jnp.float32),
+        jnp.asarray(weight_decay, jnp.float32),
+        jnp.asarray(scale, jnp.float32),
+    ])
+    blk = lambda: pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0))
+    p2, h2 = pl.pallas_call(
+        functools.partial(_adagrad_kernel, bool(adagrad_w_mode)),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM), blk(), blk(), blk()],
+        out_specs=[blk(), blk()],
+        out_shape=[jax.ShapeDtypeStruct(pb.shape, p.dtype),
+                   jax.ShapeDtypeStruct(hb.shape, h.dtype)],
+        input_output_aliases={2: 0, 3: 1},
+        interpret=_interpret(),
+    )(c, gb, pb, hb)
+    return _unblocked(p2, n), _unblocked(h2, n)
+
+
+# ---------------------------------------------------------------------------
+# lamb — two Pallas passes + scalar cleanup, mirroring the reference's
+# stage structure (csrc/multi_tensor_lamb.cu: moments+update with fused
+# per-chunk norms, cleanup, then ratio apply).
+# ---------------------------------------------------------------------------
+
+def _lamb_stage1_kernel(adam_w_mode, c_ref, g_ref, p_ref, m_ref, v_ref,
+                        starts_ref, ends_ref,
+                        m_out, v_out, u_out, pn_acc, un_acc):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        pn_acc[:] = jnp.zeros_like(pn_acc)
+        un_acc[:] = jnp.zeros_like(un_acc)
+
+    # c = [beta1, beta3, beta2, eps, bc1, bc2, weight_decay, inv_clip]
+    b1, beta3, b2, eps = c_ref[0], c_ref[1], c_ref[2], c_ref[3]
+    bc1, bc2, wd, inv_clip = c_ref[4], c_ref[5], c_ref[6], c_ref[7]
+    g = g_ref[:].astype(jnp.float32) * inv_clip
+    p = p_ref[:].astype(jnp.float32)
+    if not adam_w_mode:
+        g = g + wd * p
+    m = b1 * m_ref[:].astype(jnp.float32) + beta3 * g
+    v = b2 * v_ref[:].astype(jnp.float32) + (1.0 - b2) * g * g
+    u = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+    if adam_w_mode:
+        u = u + wd * p
+    m_out[:] = m.astype(m_out.dtype)
+    v_out[:] = v.astype(v_out.dtype)
+    u_out[:] = u.astype(u_out.dtype)
+    onehot = _row_onehot(i, starts_ref[:], ends_ref[:])
+    pn_acc[:] += jnp.sum(jnp.sum(p * p, axis=1, keepdims=True) * onehot,
+                         axis=0, keepdims=True)
+    un_acc[:] += jnp.sum(jnp.sum(u * u, axis=1, keepdims=True) * onehot,
+                         axis=0, keepdims=True)
+
+
+def _lamb_stage2_kernel(c_ref, p_ref, u_ref, ratios_ref, starts_ref, ends_ref,
+                        p_out):
+    i = pl.program_id(0)
+    onehot = _row_onehot(i, starts_ref[:], ends_ref[:])
+    ratio_row = jnp.sum(onehot * ratios_ref[:], axis=1, keepdims=True)
+    p = p_ref[:].astype(jnp.float32)
+    u = u_ref[:].astype(jnp.float32)
+    p_out[:] = (p - c_ref[0] * ratio_row * u).astype(p_out.dtype)
+
+
+def lamb_flat(g: jax.Array, p: jax.Array, m: jax.Array, v: jax.Array, spec, *,
+              lr, beta1, beta2, beta3, eps, bc1, bc2, adam_w_mode,
+              weight_decay, inv_clip, use_ratio,
+              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused LAMB on one LANES-aligned bucket. Stage 1 computes Adam moments,
+    the raw update, and one-pass segmented p/update norms; scalar cleanup forms
+    per-tensor trust ratios; stage 2 applies ``p -= lr * ratio * u``."""
+    starts, ends, t_pad = _seg_bounds(spec)
+    t = len(spec.sizes)
+    gb, n = _as_blocked(g)
+    pb, _ = _as_blocked(p)
+    mb, _ = _as_blocked(m)
+    vb, _ = _as_blocked(v)
+    grid = gb.shape[0] // BLOCK_ROWS
+    c1 = jnp.stack([
+        jnp.asarray(beta1, jnp.float32), jnp.asarray(beta3, jnp.float32),
+        jnp.asarray(beta2, jnp.float32), jnp.asarray(eps, jnp.float32),
+        jnp.asarray(bc1, jnp.float32), jnp.asarray(bc2, jnp.float32),
+        jnp.asarray(weight_decay, jnp.float32),
+        jnp.asarray(inv_clip, jnp.float32),
+    ])
+    blk = lambda: pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0))
+    seg = lambda: pl.BlockSpec((1, t_pad), lambda i: (0, 0))
+    m2, v2, u, pn_sq, un_sq = pl.pallas_call(
+        functools.partial(_lamb_stage1_kernel, bool(adam_w_mode)),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  blk(), blk(), blk(), blk(), seg(), seg()],
+        out_specs=[blk(), blk(), blk(), seg(), seg()],
+        out_shape=[
+            jax.ShapeDtypeStruct(mb.shape, m.dtype),
+            jax.ShapeDtypeStruct(vb.shape, v.dtype),
+            jax.ShapeDtypeStruct(gb.shape, jnp.float32),
+            jax.ShapeDtypeStruct((1, t_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, t_pad), jnp.float32),
+        ],
+        input_output_aliases={3: 0, 4: 1},
+        interpret=_interpret(),
+    )(c1, gb, pb, mb, vb, starts, ends)
+
+    # Scalar cleanup (the reference's cleanup_v2 + per-tensor ratio logic).
+    p_norms = jnp.sqrt(pn_sq[0, :t])
+    u_norms = jnp.sqrt(un_sq[0, :t])
+    if use_ratio:
+        ratios = jnp.where((p_norms > 0.0) & (u_norms > 0.0),
+                           p_norms / u_norms, 1.0)
+    else:
+        ratios = jnp.ones((t,), jnp.float32)
+    ratios_pad = jnp.zeros((1, t_pad), jnp.float32).at[0, :t].set(ratios)
+
+    p2 = pl.pallas_call(
+        _lamb_stage2_kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  blk(), blk(), seg(), seg(), seg()],
+        out_specs=blk(),
+        out_shape=jax.ShapeDtypeStruct(pb.shape, p.dtype),
+        input_output_aliases={1: 0},
+        interpret=_interpret(),
+    )(jnp.asarray(lr, jnp.float32).reshape(1), pb, u, ratios_pad, starts,
+      ends)
+    return _unblocked(p2, n), _unblocked(m2, n), _unblocked(v2, n)
+
+
+# ---------------------------------------------------------------------------
+# novograd — per-tensor grad-norm pass + fused update pass, mirroring the
+# reference flow (fused_novograd.py: multi_tensor_l2norm per tensor, then
+# csrc/multi_tensor_novograd.cu update with per-tensor denominators).
+# ---------------------------------------------------------------------------
+
+def _novograd_kernel(c_ref, g_ref, p_ref, m_ref, denom_ref, starts_ref,
+                     ends_ref, p_out, m_out):
+    i = pl.program_id(0)
+    # c = [lr, beta1, beta3, bc1, weight_decay, scale]
+    lr, b1, beta3 = c_ref[0], c_ref[1], c_ref[2]
+    bc1, wd, scale = c_ref[3], c_ref[4], c_ref[5]
+    onehot = _row_onehot(i, starts_ref[:], ends_ref[:])
+    denom_row = jnp.sum(onehot * denom_ref[:], axis=1, keepdims=True)
+    denom_row = jnp.where(denom_row > 0.0, denom_row, 1.0)  # padding rows
+    g = g_ref[:].astype(jnp.float32) * scale
+    p = p_ref[:].astype(jnp.float32)
+    gn = g / denom_row + wd * p
+    m = b1 * m_ref[:].astype(jnp.float32) + beta3 * gn
+    p_out[:] = (p - lr * (m / bc1)).astype(p_out.dtype)
+    m_out[:] = m.astype(m_out.dtype)
+
+
+def novograd_flat(g: jax.Array, p: jax.Array, m: jax.Array, denoms: jax.Array,
+                  spec, *, lr, beta1, beta3, bc1, weight_decay, scale=1.0,
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Fused NovoGrad update on one LANES-aligned bucket given per-tensor
+    denominators ``denoms`` (T,). Returns ``(new_p, new_m)``."""
+    starts, ends, t_pad = _seg_bounds(spec)
+    t = len(spec.sizes)
+    gb, n = _as_blocked(g)
+    pb, _ = _as_blocked(p)
+    mb, _ = _as_blocked(m)
+    grid = gb.shape[0] // BLOCK_ROWS
+    c = jnp.stack([
+        jnp.asarray(lr, jnp.float32), jnp.asarray(beta1, jnp.float32),
+        jnp.asarray(beta3, jnp.float32), jnp.asarray(bc1, jnp.float32),
+        jnp.asarray(weight_decay, jnp.float32),
+        jnp.asarray(scale, jnp.float32),
+    ])
+    denoms_pad = jnp.zeros((1, t_pad), jnp.float32).at[0, :t].set(denoms)
+    blk = lambda: pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0))
+    seg = lambda: pl.BlockSpec((1, t_pad), lambda i: (0, 0))
+    p2, m2 = pl.pallas_call(
+        _novograd_kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  blk(), blk(), blk(), seg(), seg(), seg()],
+        out_specs=[blk(), blk()],
+        out_shape=[jax.ShapeDtypeStruct(pb.shape, p.dtype),
+                   jax.ShapeDtypeStruct(mb.shape, m.dtype)],
+        input_output_aliases={2: 0, 3: 1},
+        interpret=_interpret(),
+    )(c, gb, pb, mb, denoms_pad, starts, ends)
+    return _unblocked(p2, n), _unblocked(m2, n)
+
+
+# ---------------------------------------------------------------------------
 # Tree-level wrappers: group leaves by dtype signature, bucket, run kernel.
 # ---------------------------------------------------------------------------
 
@@ -305,3 +674,130 @@ def adam_tree(grads: Tree, params: Tree, exp_avg: Tree, exp_avg_sq: Tree, *,
             new_v[i] = t
     unf = lambda ls: jax.tree_util.tree_unflatten(treedef, ls)
     return unf(new_p), unf(new_m), unf(new_v)
+
+
+def _run_grouped(trees: Sequence[Tree], fn, out_spec_idx: Sequence[int],
+                 align: int = 1):
+    """Bucket aligned leaves of ``trees`` per dtype signature, run
+    ``fn(flat_arrays, specs, idxs) -> tuple of flat outputs`` per group, and
+    unflatten back to trees. Output o is unflattened with the spec of input
+    tree ``out_spec_idx[o]``."""
+    all_leaves, sig_groups = _grouped(trees)
+    treedef = jax.tree_util.tree_structure(trees[0])
+    outs: List[List[Any]] = [[None] * len(all_leaves[0])
+                             for _ in out_spec_idx]
+    for _, idxs in sig_groups.items():
+        flats, specs = [], []
+        for leaves in all_leaves:
+            f, s = _buckets.flatten_tensors([leaves[i] for i in idxs],
+                                            align=align)
+            flats.append(f)
+            specs.append(s)
+        results = fn(flats, specs, idxs)
+        for o, (res, si) in enumerate(zip(results, out_spec_idx)):
+            for i, t in zip(idxs, _buckets.unflatten_tensors(res, specs[si])):
+                outs[o][i] = t
+    unf = lambda ls: jax.tree_util.tree_unflatten(treedef, ls)
+    return tuple(unf(o) for o in outs)
+
+
+def sgd_tree(grads: Tree, params: Tree, momentum_buf: Tree, *, lr,
+             weight_decay, momentum, dampening, nesterov, wd_after_momentum,
+             first, scale=1.0, model_out_template: Optional[Tree] = None):
+    with_model = model_out_template is not None
+
+    def fn(flats, specs, idxs):
+        model_dtype = flats[3].dtype if with_model else None
+        return sgd_flat(
+            flats[0], flats[1], flats[2], lr=lr, weight_decay=weight_decay,
+            momentum=momentum, dampening=dampening, nesterov=nesterov,
+            wd_after_momentum=wd_after_momentum, first=first, scale=scale,
+            model_dtype=model_dtype)
+
+    trees = [grads, params, momentum_buf]
+    if with_model:
+        trees.append(model_out_template)
+        new_p, new_m, new_model = _run_grouped(trees, fn, (1, 2, 3))
+        return new_p, new_m, new_model
+    new_p, new_m = _run_grouped(trees, fn, (1, 2))
+    return new_p, new_m
+
+
+def adagrad_tree(grads: Tree, params: Tree, state_sum: Tree, *, lr, eps,
+                 weight_decay, adagrad_w_mode=False, scale=1.0,
+                 ) -> Tuple[Tree, Tree]:
+    def fn(flats, specs, idxs):
+        return adagrad_flat(
+            flats[0], flats[1], flats[2], lr=lr, eps=eps,
+            weight_decay=weight_decay, adagrad_w_mode=adagrad_w_mode,
+            scale=scale)
+
+    new_p, new_h = _run_grouped([grads, params, state_sum], fn, (1, 2))
+    return new_p, new_h
+
+
+def lamb_tree(grads: Tree, params: Tree, exp_avg: Tree, exp_avg_sq: Tree, *,
+              lr, beta1, beta2, beta3, eps, bc1, bc2, adam_w_mode,
+              weight_decay, inv_clip, use_ratio,
+              ) -> Tuple[Tree, Tree, Tree]:
+    def fn(flats, specs, idxs):
+        return lamb_flat(
+            flats[0], flats[1], flats[2], flats[3], specs[1], lr=lr,
+            beta1=beta1, beta2=beta2, beta3=beta3, eps=eps, bc1=bc1, bc2=bc2,
+            adam_w_mode=adam_w_mode, weight_decay=weight_decay,
+            inv_clip=inv_clip, use_ratio=use_ratio)
+
+    new_p, new_m, new_v = _run_grouped(
+        [grads, params, exp_avg, exp_avg_sq], fn, (1, 2, 3), align=LANES)
+    return new_p, new_m, new_v
+
+
+def novograd_tree(grads: Tree, params: Tree, exp_avg: Tree,
+                  v_per_tensor: Tree, *, lr, beta1, beta2, beta3, eps, bc1,
+                  bc2, weight_decay, init_zero, first, scale=1.0,
+                  ) -> Tuple[Tree, Tree, Tree]:
+    """NovoGrad: per-tensor grad-norm kernel pass, scalar v/denominator
+    cleanup, then the fused update kernel. ``v_per_tensor`` is a pytree of
+    fp32 scalars (one per leaf)."""
+    v_leaves = jax.tree_util.tree_leaves(v_per_tensor)
+    new_v_leaves: List[Any] = [None] * len(v_leaves)
+
+    def fn(flats, specs, idxs):
+        g, p, m = flats[0], flats[1], flats[2]
+        gnorm_sq = l2norm_sq_seg_flat(g, specs[0]) * (
+            jnp.asarray(scale, jnp.float32) ** 2)
+        v_arr = jnp.stack([v_leaves[i] for i in idxs]).astype(jnp.float32)
+        v_new = jnp.where(
+            jnp.asarray(first),
+            0.0 if init_zero else gnorm_sq,
+            beta2 * v_arr + (1.0 - beta2) * gnorm_sq)
+        denoms = jnp.sqrt(v_new / bc2) + eps
+        p2, m2 = novograd_flat(
+            g, p, m, denoms, specs[0], lr=lr, beta1=beta1, beta3=beta3,
+            bc1=bc1, weight_decay=weight_decay, scale=scale)
+        for j, i in enumerate(idxs):
+            new_v_leaves[i] = v_new[j]
+        return p2, m2
+
+    new_p, new_m = _run_grouped(
+        [grads, params, exp_avg], fn, (1, 2), align=LANES)
+    new_v = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(v_per_tensor), new_v_leaves)
+    return new_p, new_m, new_v
+
+
+def l2norm_tree_per_tensor(tree: Tree) -> Tuple[jax.Array, Tree]:
+    """Global + per-tensor L2 norms via the one-pass segmented kernel."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    groups = _buckets.group_by_dtype(leaves)
+    per_leaf: List[Any] = [None] * len(leaves)
+    total = jnp.asarray(0.0, jnp.float32)
+    for _, idxs in groups.items():
+        flat, spec = _buckets.flatten_tensors([leaves[i] for i in idxs],
+                                              align=LANES)
+        sumsq = l2norm_sq_seg_flat(flat, spec)
+        total = total + jnp.sum(sumsq)
+        norms = jnp.sqrt(sumsq)
+        for j, i in enumerate(idxs):
+            per_leaf[i] = norms[j]
+    return jnp.sqrt(total), jax.tree_util.tree_unflatten(treedef, per_leaf)

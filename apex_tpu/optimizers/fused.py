@@ -79,7 +79,8 @@ class FusedSGD(FusedOptimizer):
 
     def __init__(self, lr: Schedule = 1e-3, *, momentum: float = 0.0,
                  dampening: float = 0.0, weight_decay: float = 0.0,
-                 nesterov: bool = False, wd_after_momentum: bool = False):
+                 nesterov: bool = False, wd_after_momentum: bool = False,
+                 materialize_master_grads: bool = True):
         if nesterov and (momentum <= 0 or dampening != 0):
             raise ValueError("Nesterov momentum requires a momentum and zero "
                              "dampening")
@@ -89,6 +90,11 @@ class FusedSGD(FusedOptimizer):
         self.weight_decay = weight_decay
         self.nesterov = nesterov
         self.wd_after_momentum = wd_after_momentum
+        # False selects the amp no-materialize fast path: low-precision grads
+        # feed the kernel directly with the unscale fused, and the kernel
+        # emits the low-precision model copy alongside the fp32 master update
+        # (apex/optimizers/fused_sgd.py:79, _process_optimizer.py:258-310).
+        self.materialize_master_grads = materialize_master_grads
 
     def init(self, params: Tree) -> SGDState:
         return SGDState(
@@ -98,46 +104,23 @@ class FusedSGD(FusedOptimizer):
 
     def step(self, grads: Tree, params: Tree, state: SGDState, *,
              grad_scale: Optional[jax.Array] = None,
-             ) -> Tuple[Tree, SGDState]:
+             model_out_template: Optional[Tree] = None):
         step = state.step + 1
-        scale = 1.0
-        if grad_scale is not None:
-            grads = jax.tree_util.tree_map(
-                lambda g: (g.astype(jnp.float32) / grad_scale).astype(g.dtype),
-                grads)
-        # torch-style lazy momentum init: buf=decayed grad on the first step.
-        # Implemented branchlessly so the jitted step has one trace: on step 1
-        # the momentum buffer is zero, so `momentum*buf` vanishes; matching
-        # torch/apex exactly requires buf_1 = g (not (1-dampening)*g), which a
-        # zero init gets wrong only when dampening != 0 — handled below.
-        first = (step == 1)
-        if self.momentum != 0.0 and self.dampening != 0.0:
-            def upd_first_aware(g, p, m):
-                g32 = g.astype(jnp.float32) * scale
-                p32 = p.astype(jnp.float32)
-                if self.weight_decay != 0.0 and not self.wd_after_momentum:
-                    g32 = g32 + self.weight_decay * p32
-                m_steady = self.momentum * m + (1.0 - self.dampening) * g32
-                m32 = jnp.where(first, g32, m_steady)
-                d = (g32 + self.momentum * m32) if self.nesterov else m32
-                if self.weight_decay != 0.0 and self.wd_after_momentum:
-                    d = d + self.weight_decay * p32
-                p32 = p32 - resolve_lr(self.lr, step) * d
-                return p32.astype(p.dtype), m32
-            out = jax.tree_util.tree_map(
-                upd_first_aware, grads, params, state.momentum_buf)
-            new_p = jax.tree_util.tree_map(
-                lambda t: t[0], out, is_leaf=lambda t: isinstance(t, tuple))
-            new_m = jax.tree_util.tree_map(
-                lambda t: t[1], out, is_leaf=lambda t: isinstance(t, tuple))
-        else:
-            new_p, new_m = ops.multi_tensor_sgd(
-                grads, params, state.momentum_buf,
-                lr=resolve_lr(self.lr, step),
-                weight_decay=self.weight_decay, momentum=self.momentum,
-                dampening=self.dampening, nesterov=self.nesterov,
-                first_run=False, wd_after_momentum=self.wd_after_momentum,
-                scale=scale)
+        scale = 1.0 if grad_scale is None else 1.0 / grad_scale
+        # torch-style lazy momentum init: buf = (decayed) grad on step 1,
+        # selected branchlessly inside the fused kernel.
+        outs = ops.multi_tensor_sgd(
+            grads, params, state.momentum_buf,
+            lr=resolve_lr(self.lr, step),
+            weight_decay=self.weight_decay, momentum=self.momentum,
+            dampening=self.dampening, nesterov=self.nesterov,
+            first_run=(step == 1),
+            wd_after_momentum=self.wd_after_momentum,
+            scale=scale, model_out_template=model_out_template)
+        if model_out_template is not None:
+            new_p, new_m, new_model = outs
+            return new_p, SGDState(step=step, momentum_buf=new_m), new_model
+        new_p, new_m = outs
         return new_p, SGDState(step=step, momentum_buf=new_m)
 
 
@@ -180,10 +163,7 @@ class FusedLAMB(FusedOptimizer):
              grad_scale: Optional[jax.Array] = None,
              ) -> Tuple[Tree, LambState]:
         step = state.step + 1
-        if grad_scale is not None:
-            grads = jax.tree_util.tree_map(
-                lambda g: (g.astype(jnp.float32) / grad_scale).astype(g.dtype),
-                grads)
+        scale = 1.0 if grad_scale is None else 1.0 / grad_scale
         new_p, new_m, new_v = ops.multi_tensor_lamb(
             grads, params, state.exp_avg, state.exp_avg_sq,
             lr=resolve_lr(self.lr, step), beta1=self.betas[0],
@@ -192,7 +172,8 @@ class FusedLAMB(FusedOptimizer):
             weight_decay=self.weight_decay,
             grad_averaging=self.grad_averaging,
             adam_w_mode=self.adam_w_mode,
-            max_grad_norm=self.max_grad_norm, use_nvlamb=self.use_nvlamb)
+            max_grad_norm=self.max_grad_norm, use_nvlamb=self.use_nvlamb,
+            scale=scale)
         return new_p, LambState(step=step, exp_avg=new_m, exp_avg_sq=new_v)
 
 
@@ -235,40 +216,16 @@ class FusedNovoGrad(FusedOptimizer):
              grad_scale: Optional[jax.Array] = None,
              ) -> Tuple[Tree, NovoGradState]:
         step = state.step + 1
-        if grad_scale is not None:
-            grads = jax.tree_util.tree_map(
-                lambda g: (g.astype(jnp.float32) / grad_scale).astype(g.dtype),
-                grads)
-        beta1, beta2 = self.betas
-        if self.bias_correction:
-            bc1 = 1.0 - beta1 ** step.astype(jnp.float32)
-            bc2 = 1.0 - beta2 ** step.astype(jnp.float32)
-        else:
-            bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
-        beta3 = (1.0 - beta1) if self.grad_averaging else 1.0
-        first = (step == 1)
-
-        def upd(g, p, m, v):
-            g32 = g.astype(jnp.float32)
-            p32 = p.astype(jnp.float32)
-            gnorm_sq = jnp.sum(g32 * g32)
-            v_new = jnp.where(
-                first,
-                jnp.where(jnp.asarray(self.init_zero), 0.0, gnorm_sq),
-                beta2 * v + (1.0 - beta2) * gnorm_sq)
-            denom = jnp.sqrt(v_new / bc2) + self.eps
-            gn = g32 / denom
-            if self.weight_decay != 0.0:
-                gn = gn + self.weight_decay * p32
-            m32 = beta1 * m + beta3 * gn
-            p32 = p32 - resolve_lr(self.lr, step) * (m32 / bc1)
-            return p32.astype(p.dtype), m32, v_new
-
-        out = jax.tree_util.tree_map(
-            upd, grads, params, state.exp_avg, state.v)
-        pick = lambda i: jax.tree_util.tree_map(
-            lambda t: t[i], out, is_leaf=lambda t: isinstance(t, tuple))
-        return pick(0), NovoGradState(step=step, exp_avg=pick(1), v=pick(2))
+        scale = 1.0 if grad_scale is None else 1.0 / grad_scale
+        new_p, new_m, new_v = ops.multi_tensor_novograd(
+            grads, params, state.exp_avg, state.v,
+            lr=resolve_lr(self.lr, step), beta1=self.betas[0],
+            beta2=self.betas[1], eps=self.eps, step=step,
+            weight_decay=self.weight_decay,
+            bias_correction=self.bias_correction,
+            grad_averaging=self.grad_averaging, norm_type=self.norm_type,
+            init_zero=self.init_zero, first=(step == 1), scale=scale)
+        return new_p, NovoGradState(step=step, exp_avg=new_m, v=new_v)
 
 
 class AdagradState(NamedTuple):
@@ -297,24 +254,9 @@ class FusedAdagrad(FusedOptimizer):
              grad_scale: Optional[jax.Array] = None,
              ) -> Tuple[Tree, AdagradState]:
         step = state.step + 1
-        if grad_scale is not None:
-            grads = jax.tree_util.tree_map(
-                lambda g: (g.astype(jnp.float32) / grad_scale).astype(g.dtype),
-                grads)
-        lr = resolve_lr(self.lr, step)
-
-        def upd(g, p, h):
-            g32 = g.astype(jnp.float32)
-            p32 = p.astype(jnp.float32)
-            if self.weight_decay != 0.0 and not self.adagrad_w_mode:
-                g32 = g32 + self.weight_decay * p32
-            h32 = h + g32 * g32
-            upd_ = g32 / (jnp.sqrt(h32) + self.eps)
-            if self.weight_decay != 0.0 and self.adagrad_w_mode:
-                upd_ = upd_ + self.weight_decay * p32
-            return (p32 - lr * upd_).astype(p.dtype), h32
-
-        out = jax.tree_util.tree_map(upd, grads, params, state.sum)
-        pick = lambda i: jax.tree_util.tree_map(
-            lambda t: t[i], out, is_leaf=lambda t: isinstance(t, tuple))
-        return pick(0), AdagradState(step=step, sum=pick(1))
+        scale = 1.0 if grad_scale is None else 1.0 / grad_scale
+        new_p, new_h = ops.multi_tensor_adagrad(
+            grads, params, state.sum, lr=resolve_lr(self.lr, step),
+            epsilon=self.eps, weight_decay=self.weight_decay,
+            adagrad_w_mode=self.adagrad_w_mode, scale=scale)
+        return new_p, AdagradState(step=step, sum=new_h)

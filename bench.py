@@ -7,10 +7,18 @@ identical mechanics to O2 with bf16 instead of fp16, the fork's own bf16
 opt level, apex/amp/frontend.py:228-246). fp16 O2 is also supported but bf16
 is the MXU-native dtype.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "mfu",
+"tflops", "model_gflop_per_img"}.
 vs_baseline is measured img/s divided by 900 img/s — the commonly reported
 single-V100 ResNet-50 AMP throughput (the reference repo publishes no number,
 BASELINE.md; 900 stands in for the 1-GPU share of the 8xV100 north star).
+mfu is roofline-honest: model FLOPs are taken from XLA's own cost analysis of
+the compiled train step (MAC=2 convention, the standard MFU accounting), and
+peak from the chip generation (v5e bf16 = 197 TFLOP/s).
+
+BENCH_PROFILE=1 additionally captures a jax.profiler trace of the measured
+loop and writes a per-category/per-op summary via pyprof.summarize_trace to
+benchmarks/trace_summary_resnet50.txt.
 """
 
 import json
@@ -25,6 +33,20 @@ from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 BASELINE_IMG_S = 900.0
+
+# Peak dense bf16 FLOP/s per chip by device_kind substring.
+PEAK_BF16 = [
+    ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5p", 459e12), ("v4", 275e12), ("v6", 918e12),
+]
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, peak in PEAK_BF16:
+        if sub in kind:
+            return peak
+    return float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
 
 
 def log(*a):
@@ -130,24 +152,63 @@ def main():
         float(loss)
     log("scan executable warmed up")
 
+    # Model FLOPs per step from XLA's cost analysis of the compiled step
+    # (the honest numerator for MFU; no hand-assumed GFLOP/img constant).
+    flops_per_step = None
+    try:
+        cost = step_fn.lower(
+            params, batch_stats, opt_state, (x, y)).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops_per_step = float(cost.get("flops", 0.0)) or None
+    except Exception as e:  # cost analysis unavailable on some backends
+        log(f"cost_analysis unavailable: {e}")
+
     outer = max(1, (steps - warmup) // inner_steps)
     t0 = time.perf_counter()
     for _ in range(outer):
         params, batch_stats, opt_state, loss = multi_fn(
             params, batch_stats, opt_state, (x, y))
-    jax.block_until_ready(loss)
+    _ = float(loss)  # D2H fetch: the only trustworthy sync on a remote chip
     dt = time.perf_counter() - t0
     n_steps = outer * inner_steps
     img_s = batch * n_steps / dt
     log(f"{img_s:.1f} img/s ({dt:.2f}s for {n_steps} steps, "
         f"{inner_steps} per dispatch)")
 
-    print(json.dumps({
+    result = {
         "metric": "resnet50_train_img_per_sec_amp_O5_bf16(O2-equiv)",
         "value": round(img_s, 1),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
-    }))
+    }
+    if flops_per_step:
+        achieved = flops_per_step * n_steps / dt
+        result["tflops"] = round(achieved / 1e12, 1)
+        result["model_gflop_per_img"] = round(flops_per_step / batch / 1e9, 2)
+        if on_tpu:
+            result["mfu"] = round(achieved / peak_flops(dev), 3)
+            log(f"MFU {result['mfu']:.1%} ({result['tflops']} TFLOP/s of "
+                f"{peak_flops(dev) / 1e12:.0f} peak, "
+                f"{result['model_gflop_per_img']} GFLOP/img)")
+
+    if os.environ.get("BENCH_PROFILE"):
+        trace_dir = "/tmp/apex_tpu_bench_trace"
+        with jax.profiler.trace(trace_dir):
+            params, batch_stats, opt_state, loss = multi_fn(
+                params, batch_stats, opt_state, (x, y))
+            _ = float(loss)
+        from apex_tpu import pyprof
+        summary = pyprof.summarize_trace(trace_dir)
+        out_path = os.path.join(os.path.dirname(__file__) or ".",
+                                "benchmarks", "trace_summary_resnet50.txt")
+        with open(out_path, "w") as f:
+            f.write(f"# ResNet-50 amp O5 train step, batch={batch}, "
+                    f"{inner_steps} steps per dispatch, {dev}\n")
+            f.write(summary + "\n")
+        log(f"trace summary written to {out_path}")
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -1,28 +1,41 @@
-"""Optimizer step-time microbenchmark — the second BASELINE.json metric
-("FusedAdam step-time vs torch.optim", BASELINE.md row 3).
+"""Optimizer + multi-tensor-op microbenchmarks — the second BASELINE.json
+metric ("FusedAdam step-time vs torch.optim", BASELINE.md row 3) plus the
+per-op jnp-vs-Pallas dispatch table that decides which backend the fused
+optimizers use on TPU.
 
-Measures one fused optimizer step over a ResNet-50-sized parameter set
-(~25.6M params split across ~161 tensors) for FusedAdam / FusedLAMB /
-FusedSGD, against two references:
+Two sections:
 
-  * ``optax.adam`` / ``optax.sgd`` under jit — the JAX-ecosystem baseline,
-  * ``torch.optim.Adam`` (CPU torch is baked into the image) — the
-    reference's own baseline, comparable only on CPU.
+  * ``--ops``: every multi-tensor op (scale / axpby / l2norm global +
+    per-tensor / adam / sgd / adagrad / novograd / lamb) timed under both
+    backends (APEX_TPU_MT_BACKEND jnp vs pallas) over a ResNet-50-sized
+    parameter set. This is the measured basis for ops/multi_tensor.py's
+    dispatch policy (reference analog: the per-kernel L0 benches the CUDA
+    kernels get from nvprof).
+  * default: whole-optimizer step times for FusedAdam/LAMB/SGD vs optax and
+    (CPU only) torch.optim.
 
-Run: ``python benchmarks/bench_optimizers.py [--iters N] [--skip-torch]``
-(device selection follows JAX_PLATFORMS, as everywhere else).
-Prints one JSON line per (optimizer, impl) pair.
+Timing notes (see MEMORY: axon-tpu-benchmarking-pitfalls): K steps run inside
+one jitted ``lax.scan`` chained through the carry (per-dispatch RPC on the
+remote TPU is ~100-400 ms); warm twice (donated-layout recompile); sync via a
+D2H ``float()`` fetch, never ``block_until_ready`` alone.
+
+Run: ``python benchmarks/bench_optimizers.py [--ops] [--iters N]``
+Prints one JSON line per measurement.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def resnet50_like_shapes():
@@ -46,40 +59,167 @@ def make_tree(key, dtype=jnp.float32):
     return params
 
 
-def time_fn(fn, *args, iters=20, warmup=3):
-    out = None
-    for i in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for i in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
-
-
-def bench_fused(name, opt, params, grads, iters):
-    state = opt.init(params)
+def time_scan(step_fn, carry, *, length=20, reps=3):
+    """Time ``length`` chained applications of ``step_fn`` inside one jitted
+    scan. Returns seconds per step (best of ``reps``)."""
 
     @jax.jit
-    def step(g, p, s):
+    def run(c):
+        c, _ = jax.lax.scan(lambda c, _: (step_fn(c), None), c, None,
+                            length=length)
+        return c
+
+    # Warm twice: the first call compiles; the second catches the
+    # donated-output-layout recompile.
+    c = run(carry)
+    c = run(c)
+    _ = float(jax.tree_util.tree_leaves(c)[0].reshape(-1)[0])
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        c = run(c)
+        _ = float(jax.tree_util.tree_leaves(c)[0].reshape(-1)[0])
+        best = min(best, time.perf_counter() - t0)
+    return best / length
+
+
+# ---------------------------------------------------------------------------
+# Per-op table
+# ---------------------------------------------------------------------------
+
+def op_cases(params):
+    """(name, init_carry, step) triples; each step chains through the carry so
+    nothing is loop-invariant."""
+    from apex_tpu import ops
+
+    grads = jax.tree_util.tree_map(lambda p: p * 0.01, params)
+    m = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p), params)
+    v = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p), params)
+    vs = jax.tree_util.tree_map(lambda p: jnp.zeros((), jnp.float32), params)
+
+    def scale_step(t):
+        out, _ = ops.multi_tensor_scale(t, 1.0000001)
+        return out
+
+    def axpby_step(c):
+        x, y = c
+        out, _ = ops.multi_tensor_axpby(0.999, x, 0.001, y)
+        return (out, x)
+
+    def l2norm_step(t):
+        n, _ = ops.multi_tensor_l2norm(t)
+        # Perturb so the norm is not loop-invariant; the extra elementwise
+        # pass is identical for both backends.
+        return jax.tree_util.tree_map(lambda x: x * (1.0 + 1e-20 * n), t)
+
+    def l2norm_pt_step(t):
+        n, per = ops.multi_tensor_l2norm(t, per_tensor=True)
+        return jax.tree_util.tree_map(lambda x, pn: x * (1.0 + 1e-20 * pn),
+                                      t, per)
+
+    def adam_step(c):
+        p, m, v = c
+        g = jax.tree_util.tree_map(lambda x: x * 0.01, p)
+        p, m, v = ops.multi_tensor_adam(
+            g, p, m, v, lr=1e-4, beta1=0.9, beta2=0.999, eps=1e-8, step=3,
+            weight_decay=0.01)
+        return (p, m, v)
+
+    def sgd_step(c):
+        p, m = c
+        g = jax.tree_util.tree_map(lambda x: x * 0.01, p)
+        p, m = ops.multi_tensor_sgd(
+            g, p, m, lr=1e-4, weight_decay=1e-4, momentum=0.9,
+            dampening=0.0, nesterov=False, first_run=False)
+        return (p, m)
+
+    def adagrad_step(c):
+        p, h = c
+        g = jax.tree_util.tree_map(lambda x: x * 0.01, p)
+        p, h = ops.multi_tensor_adagrad(g, p, h, lr=1e-4, weight_decay=1e-4)
+        return (p, h)
+
+    def novograd_step(c):
+        p, m, vv = c
+        g = jax.tree_util.tree_map(lambda x: x * 0.01, p)
+        p, m, vv = ops.multi_tensor_novograd(
+            g, p, m, vv, lr=1e-4, beta1=0.95, beta2=0.98, eps=1e-8, step=3,
+            weight_decay=1e-4, first=False)
+        return (p, m, vv)
+
+    def lamb_step(c):
+        p, m, v = c
+        g = jax.tree_util.tree_map(lambda x: x * 0.01, p)
+        p, m, v = ops.multi_tensor_lamb(
+            g, p, m, v, lr=1e-4, beta1=0.9, beta2=0.999, eps=1e-6, step=3,
+            weight_decay=0.01, max_grad_norm=1.0)
+        return (p, m, v)
+
+    return [
+        ("scale", grads, scale_step),
+        ("axpby", (grads, params), axpby_step),
+        ("l2norm", grads, l2norm_step),
+        ("l2norm_per_tensor", grads, l2norm_pt_step),
+        ("adam", (params, m, v), adam_step),
+        ("sgd", (params, m), sgd_step),
+        ("adagrad", (params, v), adagrad_step),
+        ("novograd", (params, m, vs), novograd_step),
+        ("lamb", (params, m, v), lamb_step),
+    ]
+
+
+def bench_ops(params, iters):
+    from apex_tpu.ops import multi_tensor as mt
+
+    dev = jax.devices()[0].platform
+    n_params = sum(p.size for p in jax.tree_util.tree_leaves(params))
+    rows = []
+    for name, carry, step in op_cases(params):
+        times = {}
+        for backend in ("jnp", "pallas"):
+            if backend == "pallas" and not mt.on_tpu():
+                continue
+            mt._FORCE = backend
+            try:
+                times[backend] = time_scan(step, carry, length=iters)
+            finally:
+                mt._FORCE = "auto"
+        row = {"bench": "multi_tensor_op", "op": name, "device": dev,
+               "n_params": n_params,
+               **{f"{b}_us": round(t * 1e6, 1) for b, t in times.items()}}
+        if len(times) == 2:
+            row["pallas_speedup"] = round(times["jnp"] / times["pallas"], 3)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# Whole-optimizer section
+# ---------------------------------------------------------------------------
+
+def bench_fused(opt, params, grads, iters):
+    state = opt.init(params)
+
+    def step(c):
+        p, s = c
+        g = jax.tree_util.tree_map(lambda x: x * 0.01, p)
         return opt.step(g, p, s)
 
-    dt = time_fn(step, grads, params, state, iters=iters)
-    return dt
+    return time_scan(step, (params, state), length=iters)
 
 
-def bench_optax(name, tx, params, grads, iters):
+def bench_optax(tx, params, grads, iters):
     import optax
     state = tx.init(params)
 
-    @jax.jit
-    def step(g, p, s):
+    def step(c):
+        p, s = c
+        g = jax.tree_util.tree_map(lambda x: x * 0.01, p)
         updates, s = tx.update(g, s, p)
         return optax.apply_updates(p, updates), s
 
-    dt = time_fn(step, grads, params, state, iters=iters)
-    return dt
+    return time_scan(step, (params, state), length=iters)
 
 
 def bench_torch_adam(shapes, iters):
@@ -99,46 +239,45 @@ def bench_torch_adam(shapes, iters):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--ops", action="store_true",
+                    help="run the per-op jnp-vs-Pallas table")
     ap.add_argument("--skip-torch", action="store_true")
     args = ap.parse_args()
+
+    key = jax.random.PRNGKey(0)
+    params = make_tree(key)
+
+    if args.ops:
+        bench_ops(params, args.iters)
+        return
 
     from apex_tpu import optimizers
     import optax
 
     dev = jax.devices()[0].platform
-    key = jax.random.PRNGKey(0)
-    params = make_tree(key)
     grads = jax.tree_util.tree_map(lambda p: p * 0.01, params)
     n_params = sum(p.size for p in jax.tree_util.tree_leaves(params))
 
-    results = []
-
     def rec(opt_name, impl, dt):
-        results.append({"bench": "optimizer_step_time", "optimizer": opt_name,
-                        "impl": impl, "device": dev,
-                        "ms_per_step": round(dt * 1e3, 3),
-                        "n_params": n_params})
+        print(json.dumps(
+            {"bench": "optimizer_step_time", "optimizer": opt_name,
+             "impl": impl, "device": dev, "ms_per_step": round(dt * 1e3, 3),
+             "n_params": n_params}), flush=True)
 
     rec("adam", "apex_tpu.FusedAdam",
-        bench_fused("adam", optimizers.FusedAdam(lr=1e-3), params, grads,
-                    args.iters))
+        bench_fused(optimizers.FusedAdam(lr=1e-3), params, grads, args.iters))
     rec("adam", "optax.adam",
-        bench_optax("adam", optax.adam(1e-3), params, grads, args.iters))
+        bench_optax(optax.adam(1e-3), params, grads, args.iters))
     rec("lamb", "apex_tpu.FusedLAMB",
-        bench_fused("lamb", optimizers.FusedLAMB(lr=1e-3), params, grads,
-                    args.iters))
+        bench_fused(optimizers.FusedLAMB(lr=1e-3), params, grads, args.iters))
     rec("sgd", "apex_tpu.FusedSGD",
-        bench_fused("sgd", optimizers.FusedSGD(lr=0.1, momentum=0.9),
+        bench_fused(optimizers.FusedSGD(lr=0.1, momentum=0.9),
                     params, grads, args.iters))
     rec("sgd", "optax.sgd",
-        bench_optax("sgd", optax.sgd(0.1, momentum=0.9), params, grads,
-                    args.iters))
+        bench_optax(optax.sgd(0.1, momentum=0.9), params, grads, args.iters))
     if not args.skip_torch and dev == "cpu":
         rec("adam", "torch.optim.Adam(cpu)",
             bench_torch_adam(resnet50_like_shapes(), args.iters))
-
-    for r in results:
-        print(json.dumps(r))
 
 
 if __name__ == "__main__":

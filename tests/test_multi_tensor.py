@@ -153,6 +153,141 @@ def test_pallas_adam_flat_matches_jnp():
                                rtol=1e-5, atol=1e-6)
 
 
+MIXED_SHAPES = [(7,), (300, 5), (128,), (2049,), (64, 129)]
+
+
+def mixed_trees(seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 4 * len(MIXED_SHAPES))
+    mk = lambda o: {f"t{j}": jax.random.normal(
+        ks[o * len(MIXED_SHAPES) + j], s, jnp.float32)
+        for j, s in enumerate(MIXED_SHAPES)}
+    g, p = mk(0), mk(1)
+    m = jax.tree_util.tree_map(lambda x: x * 0.1, mk(2))
+    v = jax.tree_util.tree_map(lambda x: jnp.abs(x) * 0.01, mk(3))
+    return g, p, m, v
+
+
+def assert_trees_close(a, b, rtol=1e-5, atol=1e-6):
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(x, np.float32),
+                                   np.asarray(y, np.float32),
+                                   rtol=rtol, atol=atol)
+
+
+def test_pallas_aligned_bucket_roundtrip():
+    from apex_tpu.ops import buckets
+    g, _, _, _ = mixed_trees()
+    leaves = list(g.values())
+    flat, spec = buckets.flatten_tensors(leaves, align=128)
+    assert all(o % 128 == 0 for o in spec.offsets)
+    back = buckets.unflatten_tensors(flat, spec)
+    for orig, got in zip(leaves, back):
+        np.testing.assert_array_equal(np.asarray(orig), np.asarray(got))
+
+
+def test_pallas_l2norm_per_tensor_seg():
+    g, _, _, _ = mixed_trees()
+    gnorm, per = pallas_mt.l2norm_tree_per_tensor(g)
+    flat = np.concatenate([np.asarray(v).ravel() for v in g.values()])
+    np.testing.assert_allclose(float(gnorm), np.linalg.norm(flat), rtol=1e-5)
+    for k in g:
+        np.testing.assert_allclose(float(per[k]),
+                                   np.linalg.norm(np.asarray(g[k])),
+                                   rtol=1e-5)
+
+
+@pytest.mark.parametrize("momentum,dampening,nesterov,wd_after,first", [
+    (0.9, 0.0, False, False, False),
+    (0.9, 0.1, False, True, True),
+    (0.9, 0.0, True, False, False),
+    (0.0, 0.0, False, False, False),
+])
+def test_pallas_sgd_tree_matches_jnp(momentum, dampening, nesterov, wd_after,
+                                     first):
+    from apex_tpu.ops import multi_tensor as mt
+    g, p, m, _ = mixed_trees(1)
+    kw = dict(lr=0.1, weight_decay=0.01, momentum=momentum,
+              dampening=dampening, nesterov=nesterov,
+              wd_after_momentum=wd_after, scale=0.5)
+    got_p, got_m = pallas_mt.sgd_tree(g, p, m, first=first, **kw)
+    ref_p, ref_m = mt.multi_tensor_sgd(g, p, m, first_run=first, **kw)
+    assert_trees_close(got_p, ref_p)
+    assert_trees_close(got_m, ref_m)
+
+
+def test_pallas_sgd_model_copy_output():
+    from apex_tpu.ops import multi_tensor as mt
+    g, p, m, _ = mixed_trees(2)
+    template = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16), p)
+    got_p, got_m, got_model = pallas_mt.sgd_tree(
+        g, p, m, lr=0.1, weight_decay=0.0, momentum=0.9, dampening=0.0,
+        nesterov=False, wd_after_momentum=False, first=False,
+        model_out_template=template)
+    for k in p:
+        assert got_model[k].dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(got_model[k], np.float32),
+            np.asarray(got_p[k].astype(jnp.bfloat16), np.float32))
+
+
+def test_pallas_adagrad_tree_matches_jnp():
+    from apex_tpu.ops import multi_tensor as mt
+    g, p, _, h = mixed_trees(3)
+    kw = dict(weight_decay=0.01)
+    got_p, got_h = pallas_mt.adagrad_tree(g, p, h, lr=0.1, eps=1e-10, **kw)
+    ref_p, ref_h = mt.multi_tensor_adagrad(g, p, h, lr=0.1, epsilon=1e-10,
+                                           **kw)
+    assert_trees_close(got_p, ref_p)
+    assert_trees_close(got_h, ref_h)
+
+
+@pytest.mark.parametrize("use_ratio", [True, False])
+def test_pallas_lamb_tree_matches_jnp(use_ratio):
+    from apex_tpu.ops import multi_tensor as mt
+    g, p, m, v = mixed_trees(4)
+    wd = 0.01 if use_ratio else 0.0
+    got_p, got_m, got_v = pallas_mt.lamb_tree(
+        g, p, m, v, lr=0.01, beta1=0.9, beta2=0.999, beta3=0.1, eps=1e-6,
+        bc1=1 - 0.9 ** 3, bc2=1 - 0.999 ** 3, adam_w_mode=True,
+        weight_decay=wd, inv_clip=1.0, use_ratio=use_ratio)
+    ref_p, ref_m, ref_v = mt.multi_tensor_lamb(
+        g, p, m, v, lr=0.01, beta1=0.9, beta2=0.999, eps=1e-6, step=3,
+        weight_decay=wd, use_nvlamb=use_ratio and wd == 0.0,
+        max_grad_norm=0.0, global_grad_norm=jnp.asarray(0.0))
+    assert_trees_close(got_p, ref_p, rtol=1e-4)
+    assert_trees_close(got_m, ref_m, rtol=1e-4)
+    assert_trees_close(got_v, ref_v, rtol=1e-4, atol=1e-7)
+
+
+@pytest.mark.parametrize("first,init_zero", [(False, False), (True, False),
+                                             (True, True)])
+def test_pallas_novograd_tree_matches_jnp(first, init_zero):
+    from apex_tpu.ops import multi_tensor as mt
+    g, p, m, _ = mixed_trees(5)
+    vs = jax.tree_util.tree_map(lambda x: jnp.asarray(0.5, jnp.float32), g)
+    got_p, got_m, got_v = pallas_mt.novograd_tree(
+        g, p, m, vs, lr=0.01, beta1=0.95, beta2=0.98, beta3=0.05, eps=1e-8,
+        bc1=1 - 0.95 ** 3, bc2=1 - 0.98 ** 3, weight_decay=0.01,
+        init_zero=init_zero, first=first)
+    ref_p, ref_m, ref_v = mt.multi_tensor_novograd(
+        g, p, m, vs, lr=0.01, beta1=0.95, beta2=0.98, eps=1e-8, step=3,
+        weight_decay=0.01, bias_correction=True, grad_averaging=True,
+        init_zero=init_zero, first=first)
+    assert_trees_close(got_p, ref_p, rtol=1e-4)
+    assert_trees_close(got_m, ref_m, rtol=1e-4)
+    for k in g:
+        np.testing.assert_allclose(float(got_v[k]), float(ref_v[k]),
+                                   rtol=1e-5)
+
+
+def test_check_overflow():
+    g, _, _, _ = mixed_trees(6)
+    assert not bool(ops.multi_tensor_check_overflow(g))
+    g["t1"] = g["t1"].at[0, 0].set(float("inf"))
+    assert bool(ops.multi_tensor_check_overflow(g))
+
+
 def test_bucket_roundtrip():
     tree = {"w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
             "b": jnp.ones((5,), jnp.float32),
