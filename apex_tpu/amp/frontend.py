@@ -21,6 +21,7 @@ _initialize.py:227-231).
 from __future__ import annotations
 
 import re
+import warnings
 from typing import Any, Callable, Optional, Sequence, Tuple, Union
 
 import jax
@@ -40,18 +41,7 @@ _BN_PATH_RE = re.compile(r"(batch[_]?norm|(^|[/_.])bn(\d|$|[/_.])|batchstats)",
                          re.IGNORECASE)
 
 
-def _path_str(path) -> str:
-    parts = []
-    for p in path:
-        if hasattr(p, "key"):
-            parts.append(str(p.key))
-        elif hasattr(p, "name"):
-            parts.append(str(p.name))
-        elif hasattr(p, "idx"):
-            parts.append(str(p.idx))
-        else:
-            parts.append(str(p))
-    return "/".join(parts)
+from apex_tpu.utils import path_str as _path_str
 
 
 def is_batchnorm_path(path) -> bool:
@@ -71,15 +61,28 @@ def cast_model(params: Tree,
     if target is None:
         return params
     keep_bn = bool(props.keep_batchnorm_fp32)
+    n_bn = 0
 
     def cast(path, p):
+        nonlocal n_bn
         if not jnp.issubdtype(p.dtype, jnp.floating):
             return p
         if keep_bn and bn_predicate(path):
+            n_bn += 1
             return p.astype(jnp.float32)
         return p.astype(target)
 
-    return jax.tree_util.tree_map_with_path(cast, params)
+    out = jax.tree_util.tree_map_with_path(cast, params)
+    if keep_bn and n_bn == 0:
+        # Name-based matching can silently miss models whose BN params don't
+        # look like BN (the reference keys on module types instead,
+        # fp16util.convert_network) — surface that rather than quietly
+        # running BN in low precision.
+        warnings.warn(
+            "keep_batchnorm_fp32 is set but no batchnorm-like param paths "
+            "matched; if this model has batch norm under different names, "
+            "pass bn_predicate= to amp.cast_model.", stacklevel=2)
+    return out
 
 
 def cast_inputs(tree: Tree, dtype) -> Tree:

@@ -132,6 +132,36 @@ class AmpOptimizer:
                 "loss_scale": new_scaler.loss_scale[loss_id]}
         return new_model, new_state, info
 
+    # -- param groups (add_param_group analog, _process_optimizer.py:411-487)
+    def add_param_group(self, group: dict) -> None:
+        """Append a param group on the wrapped optimizer. For params not yet
+        in the state, follow with ``extend_init``."""
+        self.inner.add_param_group(group)
+
+    def extend_init(self, state: AmpOptimizerState, model_params: Tree,
+                    ) -> AmpOptimizerState:
+        """Grow the state to cover an enlarged ``model_params`` tree,
+        preserving existing master weights and inner state (the reference's
+        add_param_group-with-new-params flow,
+        tests/L0/run_amp/test_add_param_group.py)."""
+        if self.properties.master_weights:
+            fresh_master = jax.tree_util.tree_map(
+                lambda p: jnp.array(p, dtype=jnp.float32, copy=True),
+                model_params)
+            from apex_tpu.optimizers.base import path_str
+            old = {path_str(kp): leaf for kp, leaf in
+                   jax.tree_util.tree_leaves_with_path(state.master)}
+            leaves = jax.tree_util.tree_leaves_with_path(fresh_master)
+            master = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(fresh_master),
+                [old.get(path_str(kp), leaf) for kp, leaf in leaves])
+            inner = self.inner.extend_init(state.inner, master)
+        else:
+            master = ()
+            inner = self.inner.extend_init(state.inner, model_params)
+        return AmpOptimizerState(inner=inner, master=master,
+                                 scaler=state.scaler)
+
     # -- introspection / checkpointing ------------------------------------
     def master_params(self, state: AmpOptimizerState) -> Tree:
         """``amp.master_params(optimizer)`` analog (_amp_state.py:59-68)."""

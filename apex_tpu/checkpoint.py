@@ -144,6 +144,15 @@ def restore_npz(path: str, template: Tree) -> Tree:
     new_leaves = []
     for i, leaf in enumerate(leaves):
         arr = data[f"leaf_{i}"]
+        if (hasattr(leaf, "shape")
+                and tuple(arr.shape) != tuple(leaf.shape)):
+            # The keystr fingerprint doesn't encode leaf shapes, so a
+            # same-paths/different-shapes checkpoint must fail here, not
+            # later at use.
+            raise ValueError(
+                f"checkpoint leaf {i} has shape {tuple(arr.shape)} but the "
+                f"template expects {tuple(leaf.shape)} — the checkpoint was "
+                "saved for a differently-shaped model.")
         if hasattr(leaf, "dtype"):
             arr = arr.astype(leaf.dtype)
         new_leaves.append(arr)

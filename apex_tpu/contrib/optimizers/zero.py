@@ -21,6 +21,13 @@ TPU-native mapping:
   * compressed allgather (e5m2 flag) -> ``allgather_dtype=jnp.bfloat16``
   * step-revert on overflow (revert_method 1-3) -> free: the functional step
     returns the previous state under ``lax.cond`` — nothing to undo.
+  * ``dwu_group_size`` subgroup sharding (state sharded over a subgroup,
+    gradients allreduced across subgroups,
+    distributed_fused_adam.py:251-289) -> a 2-D mesh: state shards over
+    ``axis_name`` (the subgroup) and replicates over ``group_axis`` (the
+    cross-group reduction axis). ``shard_count`` must equal the size of
+    ``axis_name`` and is validated at trace time (a mismatch raises rather
+    than silently mis-sharding).
 
 Usage: ``step`` must run inside shard_map with the flat state sharded::
 
@@ -28,6 +35,18 @@ Usage: ``step`` must run inside shard_map with the flat state sharded::
     state = opt.init(params)                       # flat fp32 arrays
     # in_specs: params replicated P(), state opt.state_pspec()
     new_params, new_state = opt.step(grads, params, state)
+
+Subgroup (dwu_group_size) form on a 2-D mesh ``('replica', 'data')``::
+
+    opt = DistributedFusedAdam(lr=1e-3, axis_name="data",
+                               group_axis="replica", shard_count=4)
+    # state shards over 'data' within each replica group; grads are
+    # reduce-scattered over 'data' then allreduced over 'replica'.
+
+Per-group hyperparameters (``param_groups``, optimizers/base.py) are
+supported for ``lr`` and ``weight_decay``: per-leaf overrides become
+per-element vectors over the flat shard via the same static segment map used
+for the LAMB per-tensor norms. Other overrides raise (no per-element form).
 """
 
 from __future__ import annotations
@@ -67,11 +86,25 @@ class _ZeroBase(FusedOptimizer):
 
     def __init__(self, *, axis_name: str = "data",
                  shard_count: Optional[int] = None,
-                 allgather_dtype=None):
+                 group_axis: Optional[str] = None,
+                 allgather_dtype=None, param_groups=None):
         self.axis_name = axis_name
         self._shard_count = shard_count  # resolved lazily from the mesh
+        # Mesh axis ACROSS which optimizer state is replicated (the
+        # dwu_group_size analog): grads are reduce-scattered over axis_name
+        # (within the subgroup) and allreduced over group_axis.
+        self.group_axis = group_axis
         self.allgather_dtype = allgather_dtype
         self._spec_cache = None
+        self._init_groups(param_groups)
+
+    # Overrides the ZeRO flat-shard math supports per element; anything else
+    # must fail loudly rather than silently using the default.
+    _GROUP_OVERRIDES_SUPPORTED = ("lr", "weight_decay")
+
+    def add_param_group(self, group) -> None:
+        super().add_param_group(group)
+        self._spec_cache = None  # re-pack: the group->tensor map changed
 
     # -- static packing metadata ------------------------------------------
     def _pack(self, params: Tree):
@@ -82,10 +115,31 @@ class _ZeroBase(FusedOptimizer):
         total = int(sum(sizes))
         n = self.shard_count
         padded = ((total + n - 1) // n) * n
+        # Per-tensor param-group assignment (index into override table).
+        group_of_tensor = np.zeros((len(leaves),), np.int32)
+        overrides: list = [{}]
+        if self.param_groups:
+            for g in self.param_groups:
+                unsupported = [k for k in g
+                               if k != "filter"
+                               and k not in self._GROUP_OVERRIDES_SUPPORTED]
+                if unsupported:
+                    raise ValueError(
+                        f"ZeRO param groups support only "
+                        f"{self._GROUP_OVERRIDES_SUPPORTED} overrides; got "
+                        f"{unsupported} (per-element vectors exist only for "
+                        "lr/weight_decay)")
+            for idxs, ov in self.group_assignments(params):
+                gi = 0 if not ov else len(overrides)
+                if ov:
+                    overrides.append(ov)
+                for i in idxs:
+                    group_of_tensor[i] = gi
         self._spec_cache = dict(
             treedef=treedef, shapes=shapes, sizes=sizes,
             offsets=offsets, total=total, padded=padded,
-            dtypes=[l.dtype for l in leaves])
+            dtypes=[l.dtype for l in leaves],
+            group_of_tensor=group_of_tensor, group_overrides=overrides)
         return self._spec_cache
 
     @property
@@ -94,8 +148,25 @@ class _ZeroBase(FusedOptimizer):
             return self._shard_count
         return len(jax.devices())
 
+    def _check_axes(self):
+        """Trace-time validation: shard_count must equal the axis size (the
+        silent-mis-shard hazard the reference's dwu_group_size avoids by
+        construction)."""
+        n = jax.lax.axis_size(self.axis_name)
+        if n != self.shard_count:
+            raise ValueError(
+                f"shard_count={self.shard_count} != size({self.axis_name})="
+                f"{n}. State shards over the full '{self.axis_name}' axis; "
+                "for subgroup sharding (dwu_group_size) put the subgroup on "
+                "its own mesh axis and pass group_axis for the cross-group "
+                "reduction axis.")
+
     def state_pspec(self) -> ZeroState:
-        """PartitionSpecs for shard_map in_specs/out_specs of the state."""
+        """PartitionSpecs for shard_map in_specs/out_specs of the state.
+
+        With ``group_axis`` the state is sharded over ``axis_name`` and
+        replicated over ``group_axis`` — exactly what P(axis_name) means on
+        a 2-D mesh."""
         ax = self.axis_name
         return ZeroState(step=P(), master=P(ax), exp_avg=P(ax),
                          exp_avg_sq=P(ax))
@@ -113,21 +184,30 @@ class _ZeroBase(FusedOptimizer):
 
     # -- collectives -------------------------------------------------------
     def _scatter_grads(self, grads: Tree, spec) -> jax.Array:
-        """Replicated grad tree -> reduced local shard (mean over axis).
+        """Replicated grad tree -> reduced local shard (mean over the full
+        data-parallel world).
 
         The analog of the chunked async reduce_scatter at
-        distributed_fused_adam.py:297-331.
-        """
+        distributed_fused_adam.py:297-331; with ``group_axis`` set this is
+        reduce-scatter within the subgroup + allreduce across subgroups
+        (the dwu_group_size two-level scheme, :251-289)."""
+        self._check_axes()
         flat, _ = _flatten_f32(grads, spec["padded"])
         world = jax.lax.axis_size(self.axis_name)
-        return jax.lax.psum_scatter(
-            flat, self.axis_name, scatter_dimension=0, tiled=True) / world
+        shard = jax.lax.psum_scatter(
+            flat, self.axis_name, scatter_dimension=0, tiled=True)
+        if self.group_axis is not None:
+            shard = jax.lax.psum(shard, self.group_axis)
+            world = world * jax.lax.axis_size(self.group_axis)
+        return shard / world
 
     def _gather_params(self, master_shard: jax.Array, spec,
                        params: Tree) -> Tree:
         """Local updated shard -> replicated param tree (the parameter
         all_gather at distributed_fused_adam.py:392-407; optionally in a
-        compressed dtype like the e5m2 allgather flag)."""
+        compressed dtype like the e5m2 allgather flag). Gathers over
+        ``axis_name`` only — with group_axis, every subgroup already holds
+        identical shards."""
         send = master_shard
         if self.allgather_dtype is not None:
             send = send.astype(self.allgather_dtype)
@@ -146,9 +226,33 @@ class _ZeroBase(FusedOptimizer):
         r = jax.lax.axis_index(self.axis_name)
         return r * k + jnp.arange(k)
 
+    def _shard_segments(self, spec) -> jax.Array:
+        """Per-element tensor index over this device's shard (static tensor
+        offsets -> segment ids; padding tail maps to the last tensor)."""
+        pos = self._shard_positions(spec)
+        bounds = jnp.asarray(np.cumsum(spec["sizes"]), jnp.int32)
+        seg = jnp.searchsorted(bounds, pos, side="right")
+        return jnp.minimum(seg, len(spec["sizes"]) - 1)
+
+    def _hp_elem(self, spec, name: str, default, seg: Optional[jax.Array],
+                 resolve=None):
+        """Per-element hyperparameter over the flat shard: the optimizer
+        default unless param groups override it, in which case a (shard,)
+        vector is gathered through the static tensor->group map."""
+        overrides = spec["group_overrides"]
+        if len(overrides) <= 1 or not any(name in ov for ov in overrides[1:]):
+            return resolve(default) if resolve else default
+        vals = [ov.get(name, default) for ov in overrides]
+        if resolve is not None:
+            vals = [resolve(v) for v in vals]
+        table = jnp.stack([jnp.asarray(v, jnp.float32) for v in vals])
+        group_elem = jnp.asarray(spec["group_of_tensor"])[seg]
+        return table[group_elem]
+
     def global_grad_norm(self, g_shard: jax.Array) -> jax.Array:
         """Sharded L2 norm -> psum (the l2-grad-norm process group,
-        distributed_fused_adam.py:352)."""
+        distributed_fused_adam.py:352). psum over ``axis_name`` only: with
+        group_axis the shards are replicated across subgroups."""
         return jnp.sqrt(jax.lax.psum(jnp.sum(g_shard * g_shard),
                                      self.axis_name))
 
@@ -165,9 +269,12 @@ class DistributedFusedAdam(_ZeroBase):
                  betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-8,
                  adam_w_mode: bool = True, weight_decay: float = 0.0,
                  axis_name: str = "data", shard_count: Optional[int] = None,
-                 allgather_dtype=None):
+                 group_axis: Optional[str] = None, allgather_dtype=None,
+                 param_groups=None):
         super().__init__(axis_name=axis_name, shard_count=shard_count,
-                         allgather_dtype=allgather_dtype)
+                         group_axis=group_axis,
+                         allgather_dtype=allgather_dtype,
+                         param_groups=param_groups)
         self.lr = lr
         self.bias_correction = bias_correction
         self.betas = betas
@@ -189,15 +296,21 @@ class DistributedFusedAdam(_ZeroBase):
         bc1 = 1.0 - b1 ** stepf if self.bias_correction else 1.0
         bc2 = 1.0 - b2 ** stepf if self.bias_correction else 1.0
 
+        seg = self._shard_segments(spec) if self.param_groups else None
+        lr = self._hp_elem(spec, "lr", self.lr, seg,
+                           resolve=lambda l: resolve_lr(l, step))
+        wd = self._hp_elem(spec, "weight_decay", self.weight_decay, seg)
+        wd_active = isinstance(wd, jax.Array) or wd != 0.0
+
         p = state.master
-        if not self.adam_w_mode and self.weight_decay != 0.0:
-            g = g + self.weight_decay * p
+        if not self.adam_w_mode and wd_active:
+            g = g + wd * p
         m = b1 * state.exp_avg + (1.0 - b1) * g
         v = b2 * state.exp_avg_sq + (1.0 - b2) * g * g
         update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
-        if self.adam_w_mode and self.weight_decay != 0.0:
-            update = update + self.weight_decay * p
-        new_master = p - resolve_lr(self.lr, step) * update
+        if self.adam_w_mode and wd_active:
+            update = update + wd * p
+        new_master = p - lr * update
 
         new_params = self._gather_params(new_master, spec, params)
         return new_params, ZeroState(step=step, master=new_master,
@@ -215,9 +328,13 @@ class DistributedFusedLAMB(_ZeroBase):
                  weight_decay: float = 0.01, adam_w_mode: bool = True,
                  grad_averaging: bool = True, max_grad_norm: float = 1.0,
                  use_nvlamb: bool = False, axis_name: str = "data",
-                 shard_count: Optional[int] = None, allgather_dtype=None):
+                 shard_count: Optional[int] = None,
+                 group_axis: Optional[str] = None, allgather_dtype=None,
+                 param_groups=None):
         super().__init__(axis_name=axis_name, shard_count=shard_count,
-                         allgather_dtype=allgather_dtype)
+                         group_axis=group_axis,
+                         allgather_dtype=allgather_dtype,
+                         param_groups=param_groups)
         self.lr = lr
         self.bias_correction = bias_correction
         self.betas = betas
@@ -251,23 +368,26 @@ class DistributedFusedLAMB(_ZeroBase):
         bc2 = 1.0 - b2 ** stepf if self.bias_correction else 1.0
         beta3 = (1.0 - b1) if self.grad_averaging else 1.0
 
+        # Segment ids also drive per-element param-group hyperparameters.
+        pos = self._shard_positions(spec)
+        seg = self._shard_segments(spec)
+        lr = self._hp_elem(spec, "lr", self.lr, seg,
+                           resolve=lambda l: resolve_lr(l, step))
+        wd = self._hp_elem(spec, "weight_decay", self.weight_decay, seg)
+        wd_active = isinstance(wd, jax.Array) or wd != 0.0
+
         p = state.master
-        if not self.adam_w_mode and self.weight_decay != 0.0:
-            g = g + self.weight_decay * p
+        if not self.adam_w_mode and wd_active:
+            g = g + wd * p
         m = b1 * state.exp_avg + beta3 * g
         v = b2 * state.exp_avg_sq + (1.0 - b2) * g * g
         update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
-        if self.adam_w_mode and self.weight_decay != 0.0:
-            update = update + self.weight_decay * p
+        if self.adam_w_mode and wd_active:
+            update = update + wd * p
 
         # Per-tensor norms across shard boundaries: segment ids from static
         # tensor offsets, psum'd partial sums (distributed_lamb's two-stage
         # segmented reduction).
-        pos = self._shard_positions(spec)
-        bounds = jnp.asarray(
-            np.cumsum(spec["sizes"]), jnp.int32)  # tensor end offsets
-        seg = jnp.searchsorted(bounds, pos, side="right")
-        seg = jnp.minimum(seg, num_tensors - 1)  # padding -> last segment
         in_range = pos < spec["total"]
         p_sq = jnp.where(in_range, p * p, 0.0)
         u_sq = jnp.where(in_range, update * update, 0.0)
@@ -278,13 +398,16 @@ class DistributedFusedLAMB(_ZeroBase):
             jax.ops.segment_sum(u_sq, seg, num_segments=num_tensors),
             self.axis_name))
 
-        use_ratio = (self.weight_decay != 0.0) or self.use_nvlamb
-        if use_ratio:
-            ratios = jnp.where((p_norms > 0) & (u_norms > 0),
-                               p_norms / u_norms, 1.0)
-        else:
-            ratios = jnp.ones((num_tensors,), jnp.float32)
-        new_master = p - resolve_lr(self.lr, step) * ratios[seg] * update
+        # Trust-ratio applicability is per tensor: a group with
+        # weight_decay=0 skips the ratio unless NVLamb (fused_lamb.py docs).
+        wd_t = np.array([spec["group_overrides"][gi].get(
+            "weight_decay", self.weight_decay)
+            for gi in spec["group_of_tensor"]], np.float32)
+        use_ratio_t = jnp.asarray((wd_t != 0.0) | self.use_nvlamb)
+        ratios = jnp.where(
+            use_ratio_t & (p_norms > 0) & (u_norms > 0),
+            p_norms / jnp.maximum(u_norms, 1e-38), 1.0)
+        new_master = p - lr * ratios[seg] * update
 
         new_params = self._gather_params(new_master, spec, params)
         return new_params, ZeroState(step=step, master=new_master,

@@ -5,11 +5,30 @@ mutate ``param.data`` (apex/optimizers/*). The TPU-native shape is a pure
 ``step``: ``(grads, params, state) -> (new_params, new_state)`` that jit/pjit
 can trace, donate, and shard. An optax ``GradientTransformation`` view is
 provided for ecosystem interop (``as_optax``).
+
+Param groups: torch optimizers carry per-group hyperparameters
+(``optimizer.param_groups``), and apex amp supports adding groups after
+``amp.initialize`` (apex/amp/_process_optimizer.py:411-487,
+tests/L0/run_amp/test_add_param_group.py). Params live in a pytree here, so a
+group is a *predicate over leaf paths* plus hyperparameter overrides::
+
+    opt = FusedAdam(lr=1e-3, weight_decay=0.01, param_groups=[
+        {"filter": r"(bias|scale|bn)", "weight_decay": 0.0},   # regex, or
+        {"filter": lambda path, leaf: leaf.ndim == 1, "lr": 2e-3},
+    ])
+
+Each leaf joins the first matching group (unmatched leaves use the optimizer's
+defaults). ``add_param_group`` appends a group post-init —
+``extend_init(old_state, new_params)`` then carries existing per-leaf state
+over to an enlarged param tree, which is the functional analog of adding new
+params to a running optimizer.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Optional, Tuple, Union
+import copy
+import re
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -22,19 +41,155 @@ def resolve_lr(lr: Schedule, step: jax.Array) -> jax.Array:
     return jnp.asarray(lr(step) if callable(lr) else lr, jnp.float32)
 
 
+from apex_tpu.utils import path_str  # canonical 'a/b/0/w' keypath renderer
+
+
+def _match(filt, path: str, leaf) -> bool:
+    if callable(filt):
+        return bool(filt(path, leaf))
+    return re.search(filt, path) is not None
+
+
 class FusedOptimizer:
-    """Base class: subclasses implement ``init`` and ``step``."""
+    """Base class: subclasses implement ``init`` and ``_step_dense`` (the
+    whole-tree update) and list their param-mirroring state fields in
+    ``_TREE_FIELDS``; param-group dispatch lives here."""
+
+    # State NamedTuple fields whose pytrees mirror the param tree.
+    _TREE_FIELDS: Tuple[str, ...] = ()
+
+    # Class-level default; instances rebind (never mutate) this list.
+    param_groups: List[Dict[str, Any]] = []
+
+    def _init_groups(self, param_groups) -> None:
+        self.param_groups = [dict(g) for g in (param_groups or [])]
+        for g in self.param_groups:
+            if "filter" not in g:
+                raise ValueError("param group needs a 'filter' (regex or "
+                                 "callable(path, leaf) -> bool)")
+
+    def add_param_group(self, group: Dict[str, Any]) -> None:
+        """Append a param group (the ``optimizer.add_param_group`` analog,
+        apex/amp/_process_optimizer.py:411-487). Takes effect on the next
+        traced step; for params not yet covered by the optimizer state, call
+        ``extend_init``."""
+        group = dict(group)
+        if "filter" not in group:
+            raise ValueError("param group needs a 'filter'")
+        # Rebind rather than mutate: param_groups may be the class default.
+        self.param_groups = self.param_groups + [group]
+
+    def group_assignments(self, params: Tree):
+        """[(leaf_indices, overrides_dict)] — first matching group wins;
+        unmatched leaves form the defaults group (empty overrides)."""
+        leaves = jax.tree_util.tree_leaves_with_path(params)
+        assigned: List[Tuple[List[int], Dict[str, Any]]] = [
+            ([], {k: v for k, v in g.items() if k != "filter"})
+            for g in self.param_groups]
+        default: List[int] = []
+        for i, (kp, leaf) in enumerate(leaves):
+            path = path_str(kp)
+            for gi, g in enumerate(self.param_groups):
+                if _match(g["filter"], path, leaf):
+                    assigned[gi][0].append(i)
+                    break
+            else:
+                default.append(i)
+        out = [(default, {})] if default else []
+        out += [(idxs, ov) for idxs, ov in assigned if idxs]
+        return out
 
     def init(self, params: Tree) -> Any:
         raise NotImplementedError
 
+    def extend_init(self, old_state: Any, new_params: Tree) -> Any:
+        """State for ``new_params``, carrying over per-leaf state wherever the
+        leaf path already existed in ``old_state`` — the functional analog of
+        add_param_group introducing new params mid-training."""
+        fresh = self.init(new_params)
+        merged = {}
+        for f in self._TREE_FIELDS:
+            old_map = {path_str(kp): leaf for kp, leaf in
+                       jax.tree_util.tree_leaves_with_path(
+                           getattr(old_state, f))}
+            fresh_field = getattr(fresh, f)
+            fresh_leaves = jax.tree_util.tree_leaves_with_path(fresh_field)
+            vals = [old_map.get(path_str(kp), leaf)
+                    for kp, leaf in fresh_leaves]
+            merged[f] = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(fresh_field), vals)
+        return fresh._replace(step=old_state.step, **merged)
+
+    # -- the public step: param-group dispatch over _step_dense -------------
     def step(self, grads: Tree, params: Tree, state: Any,
-             *, grad_scale: Optional[jax.Array] = None,
-             ) -> Tuple[Tree, Any]:
+             *, grad_scale: Optional[jax.Array] = None, **kw):
         """Apply one update. ``grad_scale`` (if given) divides grads on the
         fly, fused into the update kernel (the reference fused optimizers'
         ``scale`` argument)."""
+        if not self.param_groups:
+            return self._step_dense(grads, params, state,
+                                    grad_scale=grad_scale, **kw)
+        return self._step_grouped(grads, params, state,
+                                  grad_scale=grad_scale, **kw)
+
+    def _step_dense(self, grads: Tree, params: Tree, state: Any,
+                    *, grad_scale: Optional[jax.Array] = None, **kw):
         raise NotImplementedError
+
+    def _group_shared(self, grads: Tree, grad_scale) -> Dict[str, Any]:
+        """Hook: cross-group quantities forwarded to every group's dense step
+        (e.g. LAMB's global grad norm, which spans all groups)."""
+        return {}
+
+    def _step_grouped(self, grads, params, state, *, grad_scale=None, **kw):
+        groups = self.group_assignments(params)
+        shared = self._group_shared(grads, grad_scale)
+        treedef = jax.tree_util.tree_structure(params)
+        g_leaves = jax.tree_util.tree_leaves(grads)
+        p_leaves = jax.tree_util.tree_leaves(params)
+        state_leaves = {f: jax.tree_util.tree_leaves(getattr(state, f))
+                        for f in self._TREE_FIELDS}
+        model_t = kw.pop("model_out_template", None)
+        model_leaves = (jax.tree_util.tree_leaves(model_t)
+                        if model_t is not None else None)
+
+        new_p: List[Any] = [None] * len(p_leaves)
+        new_state_leaves = {f: [None] * len(p_leaves)
+                            for f in self._TREE_FIELDS}
+        new_model: List[Any] = [None] * len(p_leaves)
+        new_step = None
+        for idxs, overrides in groups:
+            sub = copy.copy(self)
+            sub.param_groups = []
+            for k, v in overrides.items():
+                if not hasattr(sub, k):
+                    raise ValueError(f"unknown param-group override {k!r}")
+                setattr(sub, k, v)
+            sub_state = state._replace(**{
+                f: [state_leaves[f][i] for i in idxs]
+                for f in self._TREE_FIELDS})
+            sub_kw = dict(kw)
+            sub_kw.update(shared)
+            if model_leaves is not None:
+                sub_kw["model_out_template"] = [model_leaves[i] for i in idxs]
+            outs = sub._step_dense(
+                [g_leaves[i] for i in idxs], [p_leaves[i] for i in idxs],
+                sub_state, grad_scale=grad_scale, **sub_kw)
+            sub_p, sub_new_state = outs[0], outs[1]
+            for j, i in enumerate(idxs):
+                new_p[i] = sub_p[j]
+                for f in self._TREE_FIELDS:
+                    new_state_leaves[f][i] = getattr(sub_new_state, f)[j]
+                if model_leaves is not None:
+                    new_model[i] = outs[2][j]
+            new_step = sub_new_state.step
+
+        unf = lambda ls: jax.tree_util.tree_unflatten(treedef, ls)
+        out_state = state._replace(step=new_step, **{
+            f: unf(new_state_leaves[f]) for f in self._TREE_FIELDS})
+        if model_leaves is not None:
+            return unf(new_p), out_state, unf(new_model)
+        return unf(new_p), out_state
 
     # -- optax interop -----------------------------------------------------
     def as_optax(self):

@@ -29,10 +29,12 @@ class FusedAdam(FusedOptimizer):
     ``adam_w_mode`` (decoupled decay), ``bias_correction``, ``amsgrad``
     unsupported exactly as in the reference (raises)."""
 
+    _TREE_FIELDS = ("exp_avg", "exp_avg_sq")
+
     def __init__(self, lr: Schedule = 1e-3, *, bias_correction: bool = True,
                  betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-8,
                  adam_w_mode: bool = True, weight_decay: float = 0.0,
-                 amsgrad: bool = False):
+                 amsgrad: bool = False, param_groups=None):
         if amsgrad:
             raise RuntimeError("FusedAdam does not support the AMSGrad "
                                "variant (parity with fused_adam.py:77-78).")
@@ -42,6 +44,7 @@ class FusedAdam(FusedOptimizer):
         self.eps = eps
         self.adam_w_mode = adam_w_mode
         self.weight_decay = weight_decay
+        self._init_groups(param_groups)
 
     def init(self, params: Tree) -> AdamState:
         zeros = lambda: jax.tree_util.tree_map(
@@ -49,7 +52,7 @@ class FusedAdam(FusedOptimizer):
         return AdamState(step=jnp.zeros((), jnp.int32),
                          exp_avg=zeros(), exp_avg_sq=zeros())
 
-    def step(self, grads: Tree, params: Tree, state: AdamState, *,
+    def _step_dense(self, grads: Tree, params: Tree, state: AdamState, *,
              grad_scale: Optional[jax.Array] = None,
              ) -> Tuple[Tree, AdamState]:
         step = state.step + 1
@@ -77,10 +80,12 @@ class FusedSGD(FusedOptimizer):
     initialization (momentum_buffer = d_p on first step).
     """
 
+    _TREE_FIELDS = ("momentum_buf",)
+
     def __init__(self, lr: Schedule = 1e-3, *, momentum: float = 0.0,
                  dampening: float = 0.0, weight_decay: float = 0.0,
                  nesterov: bool = False, wd_after_momentum: bool = False,
-                 materialize_master_grads: bool = True):
+                 materialize_master_grads: bool = True, param_groups=None):
         if nesterov and (momentum <= 0 or dampening != 0):
             raise ValueError("Nesterov momentum requires a momentum and zero "
                              "dampening")
@@ -90,6 +95,7 @@ class FusedSGD(FusedOptimizer):
         self.weight_decay = weight_decay
         self.nesterov = nesterov
         self.wd_after_momentum = wd_after_momentum
+        self._init_groups(param_groups)
         # False selects the amp no-materialize fast path: low-precision grads
         # feed the kernel directly with the unscale fused, and the kernel
         # emits the low-precision model copy alongside the fp32 master update
@@ -102,7 +108,7 @@ class FusedSGD(FusedOptimizer):
             momentum_buf=jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params))
 
-    def step(self, grads: Tree, params: Tree, state: SGDState, *,
+    def _step_dense(self, grads: Tree, params: Tree, state: SGDState, *,
              grad_scale: Optional[jax.Array] = None,
              model_out_template: Optional[Tree] = None):
         step = state.step + 1
@@ -135,11 +141,14 @@ class FusedLAMB(FusedOptimizer):
     (multi_tensor_l2norm, :123-132), Adam moments, per-tensor trust ratio,
     optional NVLamb variant."""
 
+    _TREE_FIELDS = ("exp_avg", "exp_avg_sq")
+
     def __init__(self, lr: Schedule = 1e-3, *, bias_correction: bool = True,
                  betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-6,
                  weight_decay: float = 0.01, amsgrad: bool = False,
                  adam_w_mode: bool = True, grad_averaging: bool = True,
-                 max_grad_norm: float = 1.0, use_nvlamb: bool = False):
+                 max_grad_norm: float = 1.0, use_nvlamb: bool = False,
+                 param_groups=None):
         if amsgrad:
             raise RuntimeError("FusedLAMB does not support the AMSGrad "
                                "variant (parity with fused_lamb.py).")
@@ -152,6 +161,16 @@ class FusedLAMB(FusedOptimizer):
         self.grad_averaging = grad_averaging
         self.max_grad_norm = max_grad_norm
         self.use_nvlamb = use_nvlamb
+        self._init_groups(param_groups)
+
+    def _group_shared(self, grads, grad_scale):
+        # The grad-norm clip is GLOBAL across param groups (the reference
+        # computes one norm over all groups' grads, fused_lamb.py:123-132),
+        # so compute it once here and forward to every group's step.
+        gnorm, _ = ops.multi_tensor_l2norm(grads)
+        if grad_scale is not None:
+            gnorm = gnorm / grad_scale
+        return {"global_grad_norm": gnorm}
 
     def init(self, params: Tree) -> LambState:
         zeros = lambda: jax.tree_util.tree_map(
@@ -159,8 +178,9 @@ class FusedLAMB(FusedOptimizer):
         return LambState(step=jnp.zeros((), jnp.int32),
                          exp_avg=zeros(), exp_avg_sq=zeros())
 
-    def step(self, grads: Tree, params: Tree, state: LambState, *,
+    def _step_dense(self, grads: Tree, params: Tree, state: LambState, *,
              grad_scale: Optional[jax.Array] = None,
+             global_grad_norm: Optional[jax.Array] = None,
              ) -> Tuple[Tree, LambState]:
         step = state.step + 1
         scale = 1.0 if grad_scale is None else 1.0 / grad_scale
@@ -173,7 +193,7 @@ class FusedLAMB(FusedOptimizer):
             grad_averaging=self.grad_averaging,
             adam_w_mode=self.adam_w_mode,
             max_grad_norm=self.max_grad_norm, use_nvlamb=self.use_nvlamb,
-            scale=scale)
+            scale=scale, global_grad_norm=global_grad_norm)
         return new_p, LambState(step=step, exp_avg=new_m, exp_avg_sq=new_v)
 
 
@@ -188,10 +208,13 @@ class FusedNovoGrad(FusedOptimizer):
     moments from grad norms; ``init_zero`` selects v_0 = 0 vs v_0 = |g_0|^2
     (reference ``init_zero`` arg)."""
 
+    _TREE_FIELDS = ("exp_avg", "v")
+
     def __init__(self, lr: Schedule = 1e-3, *, bias_correction: bool = True,
                  betas: Tuple[float, float] = (0.95, 0.98), eps: float = 1e-8,
                  weight_decay: float = 0.0, grad_averaging: bool = True,
-                 norm_type: int = 2, init_zero: bool = False):
+                 norm_type: int = 2, init_zero: bool = False,
+                 param_groups=None):
         if norm_type not in (2,):
             raise ValueError("FusedNovoGrad supports norm_type=2 (the "
                              "reference kernel also only implements L2)")
@@ -203,6 +226,7 @@ class FusedNovoGrad(FusedOptimizer):
         self.grad_averaging = grad_averaging
         self.norm_type = norm_type
         self.init_zero = init_zero
+        self._init_groups(param_groups)
 
     def init(self, params: Tree) -> NovoGradState:
         return NovoGradState(
@@ -212,7 +236,7 @@ class FusedNovoGrad(FusedOptimizer):
             v=jax.tree_util.tree_map(
                 lambda p: jnp.zeros((), jnp.float32), params))
 
-    def step(self, grads: Tree, params: Tree, state: NovoGradState, *,
+    def _step_dense(self, grads: Tree, params: Tree, state: NovoGradState, *,
              grad_scale: Optional[jax.Array] = None,
              ) -> Tuple[Tree, NovoGradState]:
         step = state.step + 1
@@ -237,12 +261,16 @@ class FusedAdagrad(FusedOptimizer):
     """Adagrad (apex/optimizers/fused_adagrad.py:5,
     kernel csrc/multi_tensor_adagrad.cu)."""
 
+    _TREE_FIELDS = ("sum",)
+
     def __init__(self, lr: Schedule = 1e-2, *, eps: float = 1e-10,
-                 weight_decay: float = 0.0, adagrad_w_mode: bool = False):
+                 weight_decay: float = 0.0, adagrad_w_mode: bool = False,
+                 param_groups=None):
         self.lr = lr
         self.eps = eps
         self.weight_decay = weight_decay
         self.adagrad_w_mode = adagrad_w_mode
+        self._init_groups(param_groups)
 
     def init(self, params: Tree) -> AdagradState:
         return AdagradState(
@@ -250,7 +278,7 @@ class FusedAdagrad(FusedOptimizer):
             sum=jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params))
 
-    def step(self, grads: Tree, params: Tree, state: AdagradState, *,
+    def _step_dense(self, grads: Tree, params: Tree, state: AdagradState, *,
              grad_scale: Optional[jax.Array] = None,
              ) -> Tuple[Tree, AdagradState]:
         step = state.step + 1

@@ -12,6 +12,7 @@ Here the same surgery is a grad transform applied before any
 
 from __future__ import annotations
 
+import copy
 from typing import Any, Optional, Tuple
 
 import jax
@@ -24,25 +25,29 @@ Tree = Any
 
 def larc_transform_grads(grads: Tree, params: Tree, *, lr: jax.Array,
                          trust_coefficient: float = 0.02, clip: bool = True,
-                         eps: float = 1e-8, weight_decay: float = 0.0) -> Tree:
-    """The per-tensor grad surgery of LARC.step (LARC.py:78-107)."""
-    def per_tensor(g, p):
+                         eps: float = 1e-8, weight_decay=0.0) -> Tree:
+    """The per-tensor grad surgery of LARC.step (LARC.py:78-107).
+
+    ``weight_decay`` is a scalar, or a pytree of per-leaf scalars (the
+    param-group case: each leaf's group decay folds into its LARC ratio).
+    """
+    def per_tensor(g, p, wd):
         g32 = g.astype(jnp.float32)
         p32 = p.astype(jnp.float32)
         p_norm = jnp.sqrt(jnp.sum(p32 * p32))
         g_norm = jnp.sqrt(jnp.sum(g32 * g32))
-        ratio = trust_coefficient * p_norm / (
-            g_norm + weight_decay * p_norm + eps)
+        ratio = trust_coefficient * p_norm / (g_norm + wd * p_norm + eps)
         # reference guards p_norm==0 or g_norm==0 -> ratio 1
         ratio = jnp.where((p_norm > 0) & (g_norm > 0), ratio, 1.0)
         if clip:
             ratio = jnp.minimum(ratio / lr, 1.0)
-        out = g32 * ratio
-        if weight_decay != 0.0:
-            out = out + weight_decay * p32 * ratio
+        out = (g32 + wd * p32) * ratio
         return out.astype(g.dtype)
 
-    return jax.tree_util.tree_map(per_tensor, grads, params)
+    if not isinstance(weight_decay, (int, float)):
+        return jax.tree_util.tree_map(per_tensor, grads, params, weight_decay)
+    return jax.tree_util.tree_map(
+        lambda g, p: per_tensor(g, p, weight_decay), grads, params)
 
 
 class LARC(FusedOptimizer):
@@ -64,20 +69,32 @@ class LARC(FusedOptimizer):
              *, grad_scale: Optional[jax.Array] = None):
         step_no = getattr(state, "step", jnp.zeros((), jnp.int32)) + 1
         lr = resolve_lr(getattr(self.inner, "lr", 1.0), step_no)
-        wd = getattr(self.inner, "weight_decay", 0.0)
+        inner = self.inner
+        wd = getattr(inner, "weight_decay", 0.0)
+        if getattr(inner, "param_groups", None):
+            # Per-group weight decay: resolve each leaf's group decay so it
+            # folds into that leaf's LARC ratio, and strip decay from the
+            # stepped copy so the grouped inner step doesn't re-apply it.
+            leaves = jax.tree_util.tree_leaves(params)
+            treedef = jax.tree_util.tree_structure(params)
+            wd_leaves = [wd] * len(leaves)
+            for idxs, ov in inner.group_assignments(params):
+                for i in idxs:
+                    wd_leaves[i] = ov.get("weight_decay", wd)
+            wd = jax.tree_util.tree_unflatten(treedef, wd_leaves)
+            inner = copy.copy(inner)
+            inner.weight_decay = 0.0
+            inner.param_groups = [{**g, "weight_decay": 0.0}
+                                  for g in inner.param_groups]
+        elif wd != 0.0:
+            # Weight decay folds into the LARC-adjusted grad (reference
+            # zeroes the optimizer's own wd during its step, LARC.py:88-92).
+            # Step a shallow copy with wd=0 instead of mutating the inner
+            # optimizer — safe across threads and retraces.
+            inner = copy.copy(inner)
+            inner.weight_decay = 0.0
         grads = larc_transform_grads(
             grads, params, lr=lr,
             trust_coefficient=self.trust_coefficient, clip=self.clip,
             eps=self.eps, weight_decay=wd)
-        # weight decay was folded into the LARC-adjusted grad (reference
-        # zeroes the optimizer's own wd during its step, LARC.py:88-92)
-        saved_wd = getattr(self.inner, "weight_decay", None)
-        if saved_wd is not None:
-            self.inner.weight_decay = 0.0
-        try:
-            out = self.inner.step(grads, params, state,
-                                  grad_scale=grad_scale)
-        finally:
-            if saved_wd is not None:
-                self.inner.weight_decay = saved_wd
-        return out
+        return inner.step(grads, params, state, grad_scale=grad_scale)

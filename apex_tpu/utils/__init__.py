@@ -1,1 +1,27 @@
-"""apex_tpu.utils — shared small utilities."""
+"""apex_tpu.utils — shared small utilities.
+
+``path_str`` is the canonical pytree-keypath renderer used by the param-group
+filters (optimizers/base.py), amp's batchnorm path matching (amp/frontend.py)
+and the checkpoint structure fingerprint — one definition so the 'a/b/0/w'
+path grammar stays consistent everywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+
+def path_str(key_path: Iterable[Any]) -> str:
+    """Render a jax tree key path (DictKey/SequenceKey/GetAttrKey/...) as
+    'a/b/0/w'."""
+    parts = []
+    for k in key_path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)

@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# Quick CI gate — the analog of the reference's extension-build matrix +
+# smoke tier (tests/docker_extension_builds/run.sh, .jenkins/): verify the
+# package imports, the native host runtime builds from source, the graft
+# entry compiles, and the fast test subset passes on the 8-device virtual
+# CPU mesh. Intended budget: < 5 minutes on a laptop-class CPU.
+#
+# Usage: ci/gate.sh [--full]   (--full runs the whole pytest suite, ~10 min)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+
+echo "== 1/4 package import =="
+python -c "
+import jax; jax.config.update('jax_platforms', 'cpu')
+import apex_tpu
+from apex_tpu import amp, optimizers, parallel, ops
+print('apex_tpu imports OK')
+"
+
+echo "== 2/4 native host runtime builds (g++ -O3 -shared) =="
+python -c "
+import jax; jax.config.update('jax_platforms', 'cpu')
+from apex_tpu import runtime
+import numpy as np
+ok = runtime.native_available()
+print('native host runtime:', 'built' if ok else 'UNAVAILABLE (fallback)')
+arrs = [np.ones((3, 4), np.float32), np.zeros((5,), np.float32)]
+flat = runtime.flatten_arrays(arrs)
+back = runtime.unflatten_array(flat, arrs)
+assert all(np.array_equal(a, b) for a, b in zip(arrs, back))
+print('flatten/unflatten path OK')
+assert ok, 'host runtime failed to build — check g++ toolchain'
+"
+
+echo "== 3/4 graft entry compiles (single-device + 8-device dryrun) =="
+python -c "
+import jax; jax.config.update('jax_platforms', 'cpu')
+import __graft_entry__ as ge
+fn, args = ge.entry()
+jax.jit(fn).lower(*args).compile()
+print('entry() compiles')
+ge.dryrun_multichip(8)
+"
+
+echo "== 4/4 pytest =="
+if [[ "${1:-}" == "--full" ]]; then
+    python -m pytest tests/ -q -x
+else
+    # fast subset: kernels, optimizers, amp, param groups, checkpoints
+    python -m pytest tests/test_multi_tensor.py tests/test_optimizers.py \
+        tests/test_amp.py tests/test_param_groups.py tests/test_zero.py \
+        tests/test_checkpoint.py tests/test_runtime.py -q -x
+fi
+
+echo "CI GATE PASSED"

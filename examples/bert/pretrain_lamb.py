@@ -72,17 +72,24 @@ def main(argv=None):
     scaler = amp.LossScaler(props.loss_scale)
     sc_state = scaler.init()
 
+    # The standard BERT recipe: no weight decay on biases and LayerNorm
+    # params (per-group hyperparameters — torch param_groups;
+    # optimizers/base.py path-predicate groups here).
+    no_decay = [{"filter": r"(bias|ln|layer_?norm|scale)",
+                 "weight_decay": 0.0}]
+
     if args.zero:
         zopt = DistributedFusedLAMB(
             lr=args.lr, weight_decay=args.weight_decay,
             max_grad_norm=args.max_grad_norm, axis_name="data",
-            shard_count=n_dev)
+            shard_count=n_dev, param_groups=no_decay)
         zstate = zopt.init(params32)
         zspecs = zopt.state_pspec()
     else:
         lamb = optimizers.FusedLAMB(lr=args.lr,
                                     weight_decay=args.weight_decay,
-                                    max_grad_norm=args.max_grad_norm)
+                                    max_grad_norm=args.max_grad_norm,
+                                    param_groups=no_decay)
         aopt = amp.AmpOptimizer(lamb, props)
         st = aopt.init(params)
 

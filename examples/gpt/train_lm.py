@@ -61,7 +61,7 @@ def main(argv=None):
     axis = "seq" if args.seq_parallel else "data"
     mesh = parallel.make_mesh(axis_names=(axis,))
     if args.seq_parallel and args.seq_len % n_dev:
-        raise SystemExit("--seq-len must divide the device count")
+        raise SystemExit("--seq-len must be divisible by the device count")
     print(f"devices: {n_dev} ({jax.devices()[0].platform}), "
           f"axis={axis}, global seq {args.seq_len}")
 

@@ -155,6 +155,110 @@ def test_amp_step_inside_jit():
     np.testing.assert_allclose(np.asarray(st1.master["w"]), 0.95, rtol=1e-5)
 
 
+# ---------------------------------------------------------------------------
+# Multi-loss scalers (reference
+# tests/L0/run_amp/test_multiple_models_optimizers_losses.py: per-loss
+# scaler independence under num_losses/loss_id)
+# ---------------------------------------------------------------------------
+
+def test_multi_loss_scaler_independence():
+    s = amp.LossScaler("dynamic", num_losses=3)
+    st = s.init()
+    st = s.update(st, jnp.asarray(True), loss_id=0)
+    assert float(st.loss_scale[0]) == 2.0 ** 15      # halved
+    assert float(st.loss_scale[1]) == 2.0 ** 16      # untouched
+    assert float(st.loss_scale[2]) == 2.0 ** 16
+    assert int(st.overflows[0]) == 1
+    assert int(st.overflows[1]) == 0
+
+
+def test_multi_loss_growth_independent():
+    s = amp.LossScaler("dynamic", num_losses=2, scale_window=2,
+                       init_scale=2.0 ** 8)
+    st = s.init()
+    for _ in range(2):
+        st = s.update(st, jnp.asarray(False), loss_id=1)
+    assert float(st.loss_scale[1]) == 2.0 ** 9       # grew after window
+    assert float(st.loss_scale[0]) == 2.0 ** 8       # loss 0 window untouched
+    assert int(st.unskipped[0]) == 0
+
+
+def test_multi_loss_scale_loss_uses_per_loss_scale():
+    s = amp.LossScaler("dynamic", num_losses=2)
+    st = s.init()
+    st = s.update(st, jnp.asarray(True), loss_id=1)  # scale[1] != scale[0]
+    loss = jnp.asarray(2.0)
+    assert float(s.scale_loss(loss, st, 0)) == 2.0 * float(st.loss_scale[0])
+    assert float(s.scale_loss(loss, st, 1)) == 2.0 * float(st.loss_scale[1])
+    assert float(st.loss_scale[0]) != float(st.loss_scale[1])
+
+
+def test_amp_optimizer_multi_loss_overflow_isolation():
+    """Overflow during loss 0's step must not disturb loss 1's scale, and a
+    subsequent loss-1 step must proceed normally (loss_id plumbing through
+    AmpOptimizer.step)."""
+    inner = optimizers.FusedSGD(lr=0.1)
+    aopt = amp.AmpOptimizer(inner, amp.resolve("O2"), num_losses=2)
+    model_params = {"w": jnp.ones((16,), jnp.float16)}
+    st = aopt.init(model_params)
+    s0 = float(st.scaler.loss_scale[0])
+    s1 = float(st.scaler.loss_scale[1])
+
+    bad = {"w": jnp.full((16,), float("inf"), jnp.float16)}
+    p1, st, info = aopt.step(bad, model_params, st, loss_id=0)
+    assert bool(info["overflow"])
+    assert float(st.scaler.loss_scale[0]) == s0 / 2
+    assert float(st.scaler.loss_scale[1]) == s1      # isolated
+    np.testing.assert_array_equal(np.asarray(p1["w"], np.float32),
+                                  np.asarray(model_params["w"], np.float32))
+
+    good = {"w": (jnp.full((16,), 0.01)
+                  * st.scaler.loss_scale[1]).astype(jnp.float16)}
+    p2, st, info = aopt.step(good, p1, st, loss_id=1)
+    assert not bool(info["overflow"])
+    assert float(st.scaler.loss_scale[1]) == s1      # no overflow: unchanged
+    assert float(st.scaler.loss_scale[0]) == s0 / 2  # still halved
+    np.testing.assert_allclose(np.asarray(st.master["w"]), 0.999, rtol=1e-4)
+
+
+def test_multi_loss_three_losses_jit_gan_shape():
+    """DCGAN-shaped flow (examples/dcgan): one discriminator optimizer fed
+    by two losses (real/fake, loss_id 0/1) + one generator optimizer
+    (loss_id 2 on its own scaler) — all steps jitted; per-loss scales evolve
+    independently when one loss overflows."""
+    d_inner = optimizers.FusedSGD(lr=0.05)
+    g_inner = optimizers.FusedSGD(lr=0.05)
+    d_opt = amp.AmpOptimizer(d_inner, amp.resolve("O2"), num_losses=2)
+    g_opt = amp.AmpOptimizer(g_inner, amp.resolve("O2"), num_losses=1)
+    d_params = {"w": jnp.ones((8,), jnp.float16)}
+    g_params = {"w": jnp.ones((8,), jnp.float16)}
+    d_st, g_st = d_opt.init(d_params), g_opt.init(g_params)
+
+    @jax.jit
+    def gan_step(d_params, g_params, d_st, g_st, bad_fake):
+        real_g = {"w": (jnp.full((8,), 0.01)
+                        * d_st.scaler.loss_scale[0]).astype(jnp.float16)}
+        d_params, d_st, _ = d_opt.step(real_g, d_params, d_st, loss_id=0)
+        fake_val = jnp.where(bad_fake, jnp.inf, 0.01)
+        fake_g = {"w": (jnp.full((8,), 1.0) * fake_val
+                        * d_st.scaler.loss_scale[1]).astype(jnp.float16)}
+        d_params, d_st, _ = d_opt.step(fake_g, d_params, d_st, loss_id=1)
+        gen_g = {"w": (jnp.full((8,), 0.01)
+                       * g_st.scaler.loss_scale[0]).astype(jnp.float16)}
+        g_params, g_st, _ = g_opt.step(gen_g, g_params, g_st)
+        return d_params, g_params, d_st, g_st
+
+    s = float(d_st.scaler.loss_scale[0])
+    d_params, g_params, d_st, g_st = gan_step(
+        d_params, g_params, d_st, g_st, jnp.asarray(True))
+    assert float(d_st.scaler.loss_scale[0]) == s        # real loss clean
+    assert float(d_st.scaler.loss_scale[1]) == s / 2    # fake loss overflowed
+    assert float(g_st.scaler.loss_scale[0]) == s        # generator untouched
+    d_params, g_params, d_st, g_st = gan_step(
+        d_params, g_params, d_st, g_st, jnp.asarray(False))
+    assert float(d_st.scaler.loss_scale[1]) == s / 2    # recovered, no growth
+
+
 def test_checkpoint_roundtrip():
     # reference test_checkpointing.py: save/load scaler state preserves scale
     aopt = _mk_amp_opt("O2")
