@@ -73,11 +73,14 @@ def cast_model(params: Tree,
         return p.astype(target)
 
     out = jax.tree_util.tree_map_with_path(cast, params)
-    if keep_bn and n_bn == 0:
+    if (keep_bn and n_bn == 0
+            and getattr(props, "keep_batchnorm_fp32_explicit", False)):
         # Name-based matching can silently miss models whose BN params don't
         # look like BN (the reference keys on module types instead,
         # fp16util.convert_network) — surface that rather than quietly
-        # running BN in low precision.
+        # running BN in low precision. Only when the user asked for
+        # keep_batchnorm_fp32 explicitly: BN-free models under the plain
+        # O2/O5 defaults should not warn.
         warnings.warn(
             "keep_batchnorm_fp32 is set but no batchnorm-like param paths "
             "matched; if this model has batch norm under different names, "

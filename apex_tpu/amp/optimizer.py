@@ -148,7 +148,7 @@ class AmpOptimizer:
             fresh_master = jax.tree_util.tree_map(
                 lambda p: jnp.array(p, dtype=jnp.float32, copy=True),
                 model_params)
-            from apex_tpu.optimizers.base import path_str
+            from apex_tpu.utils import path_str
             old = {path_str(kp): leaf for kp, leaf in
                    jax.tree_util.tree_leaves_with_path(state.master)}
             leaves = jax.tree_util.tree_leaves_with_path(fresh_master)

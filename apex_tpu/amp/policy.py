@@ -42,6 +42,10 @@ class Properties:
     keep_batchnorm_fp32: Optional[bool] = None
     master_weights: bool = False
     loss_scale: LossScaleSpec = 1.0
+    # True when the USER passed keep_batchnorm_fp32 (vs the opt-level
+    # default): gates the zero-BN-matches warning in cast_model so BN-free
+    # models under plain O2/O5 don't warn on every run.
+    keep_batchnorm_fp32_explicit: bool = False
 
     @property
     def compute_dtype(self):
@@ -97,6 +101,7 @@ def resolve(opt_level: str = "O1", *,
         keep_batchnorm_fp32=(base.keep_batchnorm_fp32
                              if keep_batchnorm_fp32 is None
                              else keep_batchnorm_fp32),
+        keep_batchnorm_fp32_explicit=keep_batchnorm_fp32 is not None,
         master_weights=(base.master_weights if master_weights is None
                         else master_weights),
         loss_scale=base.loss_scale if loss_scale is None else loss_scale,

@@ -106,6 +106,18 @@ class _ZeroBase(FusedOptimizer):
         super().add_param_group(group)
         self._spec_cache = None  # re-pack: the group->tensor map changed
 
+    def extend_init(self, old_state, new_params):
+        # The base-class carry-over walks per-leaf _TREE_FIELDS; ZeRO state
+        # is flat sharded arrays (no per-leaf paths), so the inherited
+        # version would silently ZERO the moments and rebuild the master
+        # from the passed params. Fail loudly instead of corrupting
+        # mid-training state.
+        raise NotImplementedError(
+            "extend_init is not supported for ZeRO optimizers: their state "
+            "is flat sharded buffers, not per-leaf trees, so carrying state "
+            "over a param-tree change would require resharding. Re-init "
+            "the optimizer state, or add params before training starts.")
+
     # -- static packing metadata ------------------------------------------
     def _pack(self, params: Tree):
         leaves, treedef = jax.tree_util.tree_flatten(params)

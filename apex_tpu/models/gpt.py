@@ -90,6 +90,48 @@ class TransformerLM(nn.Module):
         return logits.astype(jnp.float32)
 
 
+def next_token_loss(logits, tokens, axis_name: Optional[str] = None):
+    """Mean next-token softmax cross-entropy, identical between the dense
+    and sequence-parallel layouts.
+
+    Dense (``axis_name=None``): ``logits[:, :-1]`` predicts
+    ``tokens[:, 1:]``; mean over B·(S-1) targets.
+
+    Sequence-parallel (called per-shard inside ``shard_map``): each shard's
+    final position predicts the FIRST token of the NEXT shard, ppermuted
+    in — no shard-boundary targets are dropped, unlike a per-shard
+    ``logits[:, :-1]`` vs ``tokens[:, 1:]`` loss. The last global position
+    (which has no next token) is masked out and the mean is normalized by
+    the global target count via ``psum``, so the value equals the dense
+    objective on the gathered sequence.
+    """
+    from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+    if axis_name is None:
+        return jnp.mean(
+            softmax_cross_entropy_loss(logits[:, :-1], tokens[:, 1:]))
+    world = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    s_loc = tokens.shape[1]
+    # device r receives the first token of shard r+1 (source r+1 -> dest r)
+    perm = [((j + 1) % world, j) for j in range(world)]
+    nxt = jax.lax.ppermute(tokens[:, :1], axis_name, perm)
+    targets = jnp.concatenate([tokens[:, 1:], nxt], axis=1)   # (B, S_loc)
+    losses = softmax_cross_entropy_loss(logits, targets)      # (B, S_loc)
+    col = jnp.arange(s_loc)
+    valid = jnp.where((rank == world - 1) & (col == s_loc - 1),
+                      0.0, 1.0)[None, :]
+    den = jax.lax.psum(jnp.sum(valid * jnp.ones_like(losses)), axis_name)
+    local = jnp.sum(losses * valid) / den
+    # Replicated global VALUE, purely-LOCAL grad path: the psum rides
+    # behind stop_gradient so the cotangent never crosses a collective
+    # transpose (whose scaling depends on replication tracking). Each
+    # device's grad is exactly its shard's contribution to the dense
+    # objective — callers psum grads over ``axis_name`` for replicated
+    # params.
+    return local + jax.lax.stop_gradient(
+        jax.lax.psum(local, axis_name) - local)
+
+
 GPTSmall = functools.partial(TransformerLM, num_layers=12, embed_dim=768,
                              num_heads=12)
 GPTTiny = functools.partial(TransformerLM, num_layers=2, embed_dim=128,

@@ -119,7 +119,7 @@ def attention_reference(q, k, v, *, bias=None, causal=False,
 # Flash attention (Pallas forward; recompute backward)
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(scale, causal, rate, s_actual, bq, bk, nk,
+def _flash_fwd_kernel(scale, causal, rate, s_actual, off, bq, bk, nk,
                       q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
                       acc_scr, m_scr, l_scr):
     bh = pl.program_id(0)
@@ -143,7 +143,9 @@ def _flash_fwd_kernel(scale, causal, rate, s_actual, bq, bk, nk,
         col = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         mask = col < s_actual
         if causal:
-            mask = mask & (col <= row)
+            # diagonal anchored at the bottom-right for sq != sk, matching
+            # attention_reference's col <= row + (sk - sq)
+            mask = mask & (col <= row + off)
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[:, :1]                       # (bq, 1)
@@ -169,7 +171,7 @@ def _flash_fwd_kernel(scale, causal, rate, s_actual, bq, bk, nk,
     if causal:
         # blocks entirely above the diagonal contribute nothing (p == 0
         # leaves the scratch state unchanged) — skip their compute
-        pl.when(ik * bk <= iq * bq + bq - 1)(_compute)
+        pl.when(ik * bk <= iq * bq + bq - 1 + off)(_compute)
     else:
         _compute()
 
@@ -209,7 +211,7 @@ def _flash_fwd(q, k, v, *, causal: bool, scale: float,
 
     out, lse = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, scale, causal, dropout_rate,
-                          sk, bq, bk, nk),
+                          sk, sk - sq, bq, bk, nk),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, dp), lambda bh, iq, ik: (bh, iq, 0)),
@@ -258,7 +260,7 @@ def _recompute_p_ds(scale, causal, rate, sq_actual, sk_actual, bq, bk,
     col = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     mask = (col < sk_actual) & (row < sq_actual)
     if causal:
-        mask = mask & (col <= row)
+        mask = mask & (col <= row + (sk_actual - sq_actual))
     lse = lse_ref[0, 0][:, None]                # (bq, 1)
     p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # (bq, bk)
     do = do_ref[0].astype(jnp.float32)          # (bq, d)
@@ -276,9 +278,10 @@ def _recompute_p_ds(scale, causal, rate, sq_actual, sk_actual, bq, bk,
     return q, k, p_drop, do, ds
 
 
-def _causal_live(causal, iq, ik, bq, bk):
-    """False only for blocks entirely above the causal diagonal."""
-    return (ik * bk <= iq * bq + bq - 1) if causal else None
+def _causal_live(causal, iq, ik, bq, bk, off=0):
+    """False only for blocks entirely above the causal diagonal (which sits
+    at col == row + off for cross-length attention)."""
+    return (ik * bk <= iq * bq + bq - 1 + off) if causal else None
 
 
 def _flash_bwd_kv_kernel(scale, causal, rate, sq_actual, sk_actual, bq, bk,
@@ -308,7 +311,7 @@ def _flash_bwd_kv_kernel(scale, causal, rate, sq_actual, sk_actual, bq, bk,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # ds^T q
 
-    live = _causal_live(causal, iq, ik, bq, bk)
+    live = _causal_live(causal, iq, ik, bq, bk, sk_actual - sq_actual)
     pl.when(live)(_compute) if live is not None else _compute()
 
     @pl.when(iq == nq - 1)
@@ -338,7 +341,7 @@ def _flash_bwd_q_kernel(scale, causal, rate, sq_actual, sk_actual, bq, bk,
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
-    live = _causal_live(causal, iq, ik, bq, bk)
+    live = _causal_live(causal, iq, ik, bq, bk, sk_actual - sq_actual)
     pl.when(live)(_compute) if live is not None else _compute()
 
     @pl.when(ik == nk - 1)

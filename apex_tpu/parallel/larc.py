@@ -23,15 +23,17 @@ from apex_tpu.optimizers.base import FusedOptimizer, resolve_lr
 Tree = Any
 
 
-def larc_transform_grads(grads: Tree, params: Tree, *, lr: jax.Array,
+def larc_transform_grads(grads: Tree, params: Tree, *, lr,
                          trust_coefficient: float = 0.02, clip: bool = True,
                          eps: float = 1e-8, weight_decay=0.0) -> Tree:
     """The per-tensor grad surgery of LARC.step (LARC.py:78-107).
 
-    ``weight_decay`` is a scalar, or a pytree of per-leaf scalars (the
-    param-group case: each leaf's group decay folds into its LARC ratio).
+    ``weight_decay`` and ``lr`` are scalars, or pytrees of per-leaf scalars
+    (the param-group case: each leaf's group decay/lr folds into that
+    leaf's LARC ratio — clip divides by the lr the inner step will
+    actually apply to that leaf).
     """
-    def per_tensor(g, p, wd):
+    def per_tensor(g, p, wd, lr_):
         g32 = g.astype(jnp.float32)
         p32 = p.astype(jnp.float32)
         p_norm = jnp.sqrt(jnp.sum(p32 * p32))
@@ -40,14 +42,21 @@ def larc_transform_grads(grads: Tree, params: Tree, *, lr: jax.Array,
         # reference guards p_norm==0 or g_norm==0 -> ratio 1
         ratio = jnp.where((p_norm > 0) & (g_norm > 0), ratio, 1.0)
         if clip:
-            ratio = jnp.minimum(ratio / lr, 1.0)
+            ratio = jnp.minimum(ratio / lr_, 1.0)
         out = (g32 + wd * p32) * ratio
         return out.astype(g.dtype)
 
-    if not isinstance(weight_decay, (int, float)):
-        return jax.tree_util.tree_map(per_tensor, grads, params, weight_decay)
-    return jax.tree_util.tree_map(
-        lambda g, p: per_tensor(g, p, weight_decay), grads, params)
+    treedef = jax.tree_util.tree_structure(grads)
+    n = treedef.num_leaves
+
+    def full_tree(v):
+        # scalar (python number or 0-d array) -> one copy per leaf
+        if isinstance(v, (int, float)) or getattr(v, "ndim", None) == 0:
+            return jax.tree_util.tree_unflatten(treedef, [v] * n)
+        return v
+
+    return jax.tree_util.tree_map(per_tensor, grads, params,
+                                  full_tree(weight_decay), full_tree(lr))
 
 
 class LARC(FusedOptimizer):
@@ -72,16 +81,22 @@ class LARC(FusedOptimizer):
         inner = self.inner
         wd = getattr(inner, "weight_decay", 0.0)
         if getattr(inner, "param_groups", None):
-            # Per-group weight decay: resolve each leaf's group decay so it
-            # folds into that leaf's LARC ratio, and strip decay from the
-            # stepped copy so the grouped inner step doesn't re-apply it.
+            # Per-group weight decay AND lr: resolve each leaf's group
+            # values so they fold into that leaf's LARC ratio (clip must
+            # divide by the lr the inner step applies to that leaf), and
+            # strip decay from the stepped copy so the grouped inner step
+            # doesn't re-apply it.
             leaves = jax.tree_util.tree_leaves(params)
             treedef = jax.tree_util.tree_structure(params)
             wd_leaves = [wd] * len(leaves)
+            lr_leaves = [lr] * len(leaves)
             for idxs, ov in inner.group_assignments(params):
                 for i in idxs:
                     wd_leaves[i] = ov.get("weight_decay", wd)
+                    if "lr" in ov:
+                        lr_leaves[i] = resolve_lr(ov["lr"], step_no)
             wd = jax.tree_util.tree_unflatten(treedef, wd_leaves)
+            lr = jax.tree_util.tree_unflatten(treedef, lr_leaves)
             inner = copy.copy(inner)
             inner.weight_decay = 0.0
             inner.param_groups = [{**g, "weight_decay": 0.0}

@@ -67,7 +67,8 @@ def main(argv=None):
 
     tokens0 = jnp.ones((2, args.seq_len), jnp.int32)
     params32 = model.init(jax.random.PRNGKey(args.seed), tokens0)["params"]
-    props = amp.resolve(args.opt_level)
+    # transformer: no batch norm -> opt out of keep_batchnorm_fp32
+    props = amp.resolve(args.opt_level, keep_batchnorm_fp32=False)
     params = amp.cast_model(params32, props)
     scaler = amp.LossScaler(props.loss_scale)
     sc_state = scaler.init()

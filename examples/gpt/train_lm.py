@@ -28,7 +28,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
 
 from apex_tpu import amp, optimizers, parallel
 from apex_tpu.models import TransformerLM
-from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+from apex_tpu.models.gpt import next_token_loss
 
 
 def parse_args(argv=None):
@@ -84,7 +84,10 @@ def main(argv=None):
     inner = optimizers.FusedAdam(lr=args.lr)
     _, aopt = amp.initialize(None, inner, opt_level=args.opt_level,
                              verbosity=0)
-    params = amp.cast_model(params32, amp.resolve(args.opt_level))
+    # transformer: no batch norm, so opt out of the keep_batchnorm_fp32
+    # default (and its zero-matches warning)
+    params = amp.cast_model(params32, amp.resolve(
+        args.opt_level, keep_batchnorm_fp32=False))
     opt_state = aopt.init(params)
 
     def per_device(params, opt_state, tokens, rng):
@@ -97,12 +100,16 @@ def main(argv=None):
             logits = model.apply(
                 {"params": p}, tokens, pos_offset=off,
                 deterministic=args.dropout == 0.0, dropout_rng=rng)
-            loss = jnp.mean(softmax_cross_entropy_loss(
-                logits[:, :-1], tokens[:, 1:]))
+            loss = next_token_loss(
+                logits, tokens, axis if args.seq_parallel else None)
             return aopt.scale_loss(loss, opt_state), loss
 
         grads, loss = jax.grad(scaled, has_aux=True)(params)
-        grads = jax.lax.pmean(grads, axis)
+        # seq-parallel: the loss is globally normalized (psum inside
+        # next_token_loss), so each device's grad holds only its shard's
+        # contribution — sum, don't average
+        grads = (jax.lax.psum(grads, axis) if args.seq_parallel
+                 else jax.lax.pmean(grads, axis))
         new_params, new_opt, _ = aopt.step(grads, params, opt_state)
         return new_params, new_opt, jax.lax.pmean(loss, axis)
 

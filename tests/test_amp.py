@@ -377,3 +377,16 @@ def test_initialize_trains_tiny_model(opt_level):
         params, st, loss = train_step(params, st, x, y)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.7, (opt_level, losses[0], losses[-1])
+
+
+def test_keep_bn_warning_only_when_explicit():
+    """The zero-BN-matches warning fires only when the USER asked for
+    keep_batchnorm_fp32 — BN-free models under plain O2/O5 defaults must
+    stay silent (r2 review fix)."""
+    import warnings as _w
+    params = {"dense": {"kernel": jnp.ones((4, 4))}}
+    with _w.catch_warnings():
+        _w.simplefilter("error")  # default O5: must NOT warn
+        amp.cast_model(params, amp.resolve("O5"))
+    with pytest.warns(UserWarning, match="batchnorm-like"):
+        amp.cast_model(params, amp.resolve("O5", keep_batchnorm_fp32=True))

@@ -37,12 +37,14 @@ def test_flash_matches_reference(causal, s):
                                rtol=2e-4, atol=2e-4)
 
 
-def test_flash_cross_attention_lengths():
-    # sq != sk
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_cross_attention_lengths(causal):
+    # sq != sk; causal uses the bottom-right-anchored diagonal
+    # (col <= row + sk - sq), same as attention_reference
     q, _, _ = qkv(jax.random.PRNGKey(1), s=128)
     _, k, v = qkv(jax.random.PRNGKey(2), s=384)
-    out_ref = attention_reference(q, k, v)
-    out_flash = flash_attention(q, k, v, False)
+    out_ref = attention_reference(q, k, v, causal=causal)
+    out_flash = flash_attention(q, k, v, causal)
     np.testing.assert_allclose(np.asarray(out_flash), np.asarray(out_ref),
                                rtol=2e-4, atol=2e-4)
 
@@ -250,9 +252,8 @@ def test_ulysses_head_count_check(mesh):
                                    (96, 160)])
 def test_flash_bwd_matches_reference(causal, sq, sk):
     """Pallas backward: dq/dk/dv parity with autodiff of the dense
-    reference, incl. padded (non-multiple-of-128) and cross-length cases."""
-    if causal and sq != sk:
-        pytest.skip("causal cross-length not defined here")
+    reference, incl. padded (non-multiple-of-128) and cross-length cases
+    (causal cross-length uses the bottom-right-anchored diagonal)."""
     ks = jax.random.split(jax.random.PRNGKey(20), 3)
     q = jax.random.normal(ks[0], (2, 2, sq, 64))
     k = jax.random.normal(ks[1], (2, 2, sk, 64))

@@ -14,6 +14,7 @@ from jax.sharding import PartitionSpec as P
 
 from apex_tpu import parallel
 from apex_tpu.models import GPTTiny
+from apex_tpu.models.gpt import next_token_loss
 
 NDEV = 8
 
@@ -78,14 +79,15 @@ def test_lm_seq_parallel_train_step(mesh):
 
         def scaled(p):
             logits = sp.apply({"params": p}, tokens_, pos_offset=off)
-            # next-token loss on the local shard; the cross-shard grad
-            # flow rides the attention collectives' transposes
-            loss = jnp.mean(softmax_cross_entropy_loss(
-                logits[:, :-1], tokens_[:, 1:]))
+            # globally-normalized next-token loss (boundary targets
+            # ppermuted in); cross-shard grad flow rides the attention
+            # collectives' transposes
+            loss = next_token_loss(logits, tokens_, "seq")
             return aopt.scale_loss(loss, opt_state), loss
 
         grads, loss = jax.grad(scaled, has_aux=True)(params)
-        grads = jax.lax.pmean(grads, "seq")
+        # global loss -> each device holds its shard's contribution: sum
+        grads = jax.lax.psum(grads, "seq")
         new_params, new_opt, _ = aopt.step(grads, params, opt_state)
         return new_params, new_opt, jax.lax.pmean(loss, "seq")
 
@@ -145,14 +147,13 @@ def test_lm_2d_mesh_zero_plus_ring():
 
         def loss_fn(p):
             logits = sp.apply({"params": p}, tokens_, pos_offset=off)
-            return jnp.mean(softmax_cross_entropy_loss(
-                logits[:, :-1], tokens_[:, 1:]))
+            return next_token_loss(logits, tokens_, "seq")
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
-        # seq-axis grads: mean over sequence shards (each shard computed a
-        # partial loss); data-axis reduction happens inside the ZeRO
-        # psum_scatter
-        grads = jax.lax.pmean(grads, "seq")
+        # seq-axis grads: the globally-normalized loss leaves each device
+        # holding only its shard's contribution — sum over the seq axis;
+        # data-axis reduction happens inside the ZeRO psum_scatter
+        grads = jax.lax.psum(grads, "seq")
         new_params, new_zstate = zopt.step(grads, params, zstate)
         return new_params, new_zstate, jax.lax.pmean(
             jax.lax.pmean(loss, "seq"), "data")
@@ -170,3 +171,34 @@ def test_lm_2d_mesh_zero_plus_ring():
     p2, z2, loss2 = step(p1, z1, tokens)
     assert np.isfinite(float(loss1)) and np.isfinite(float(loss2))
     assert float(loss2) < float(loss1)
+
+
+def test_next_token_loss_seq_parallel_matches_dense(mesh):
+    """The seq-parallel objective must EQUAL the dense objective — shard
+    boundary targets are ppermuted in, the last global position is masked,
+    and normalization is global (ADVICE r1: a per-shard logits[:, :-1] vs
+    tokens[:, 1:] loss silently drops one target per boundary)."""
+    b, s = 2, NDEV * 16
+    vocab = 64
+    tokens = jax.random.randint(jax.random.PRNGKey(30), (b, s), 0, vocab)
+    logits = jax.random.normal(jax.random.PRNGKey(31), (b, s, vocab))
+
+    dense_val, dense_grad = jax.value_and_grad(
+        lambda lg: next_token_loss(lg, tokens))(logits)
+
+    def per_device(lg, tk):
+        val, grad = jax.value_and_grad(
+            lambda l: next_token_loss(l, tk, "seq"))(lg)
+        return val, grad
+
+    sp_val, sp_grad = jax.jit(shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(None, "seq", None), P(None, "seq")),
+        out_specs=(P(), P(None, "seq", None)), check_vma=False))(
+        logits, tokens)
+
+    np.testing.assert_allclose(float(sp_val), float(dense_val), rtol=1e-6)
+    # each shard's grad slice equals the dense grad slice (grads w.r.t.
+    # logits are local — no cross-shard terms for the loss itself)
+    np.testing.assert_allclose(np.asarray(sp_grad), np.asarray(dense_grad),
+                               rtol=1e-5, atol=1e-7)

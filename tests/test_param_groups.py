@@ -329,3 +329,37 @@ def test_zero_subgroup_mesh_matches_dense():
             want_leaf = want_leaf[getattr(k, "key", k)]
         np.testing.assert_allclose(np.asarray(leaf), np.asarray(want_leaf),
                                    rtol=2e-5, atol=2e-6)
+
+
+def test_larc_respects_group_lr():
+    """LARC's clip divides the trust ratio by the lr the inner step will
+    actually apply per leaf — a group lr override must follow the same
+    trajectory as an ungrouped LARC run at that lr (r2 review fix)."""
+    from apex_tpu.parallel import LARC
+
+    params = {"w": jax.random.normal(jax.random.PRNGKey(11), (32,)),
+              "embed": jax.random.normal(jax.random.PRNGKey(12), (16,))}
+    g = make_grads(jax.random.PRNGKey(91), params)
+
+    grouped = LARC(optimizers.FusedSGD(
+        lr=0.1, param_groups=[{"filter": r"embed", "lr": 1.0}]))
+    st = grouped.init(params)
+    got, _ = grouped.step(g, params, st)
+
+    for lr, key in ((1.0, "embed"), (0.1, "w")):
+        ref = LARC(optimizers.FusedSGD(lr=lr))
+        want, _ = ref.step(g, params, ref.init(params))
+        np.testing.assert_allclose(np.asarray(got[key]),
+                                   np.asarray(want[key]), rtol=1e-6,
+                                   err_msg=f"{key} lr={lr}")
+
+
+def test_zero_extend_init_raises():
+    """ZeRO state is flat sharded buffers; the per-leaf extend_init
+    carry-over cannot apply — must fail loudly, not zero the moments."""
+    params = {"w": jnp.ones((64,))}
+    zopt = DistributedFusedAdam(lr=0.1, axis_name="data")
+    state = zopt.init(params)
+    with pytest.raises(NotImplementedError, match="flat sharded"):
+        zopt.extend_init(state, {"w": jnp.ones((64,)),
+                                 "b": jnp.ones((8,))})
