@@ -91,14 +91,23 @@ class AmpOptimizer:
         no_materialize = use_master and not getattr(
             self.inner, "materialize_master_grads", True)
 
+        # Static loss scale never skips a step (reference update_scale
+        # gates every overflow consequence on self.dynamic,
+        # scaler.py:206-226) — so don't pay for the nonfinite reductions
+        # or the lax.cond at all on the O0/O3/O4/O5 static levels.
+        dynamic = self.scaler.dynamic
         if no_materialize:
             from apex_tpu import ops
-            overflow = ops.multi_tensor_check_overflow(scaled_grads)
+            if dynamic:
+                overflow = ops.multi_tensor_check_overflow(scaled_grads)
+            else:
+                overflow = jnp.zeros((), jnp.bool_)
             grads32 = scaled_grads
         else:
             grads32, overflow = self.scaler.unscale(
                 scaled_grads, state.scaler, loss_id,
-                out_dtype=jnp.float32 if use_master else None)
+                out_dtype=jnp.float32 if use_master else None,
+                check_overflow=dynamic)
 
         def do_step(_):
             if no_materialize:
@@ -119,7 +128,7 @@ class AmpOptimizer:
         def skip(_):
             return model_params, state.master, state.inner
 
-        if props.enabled:
+        if props.enabled and dynamic:
             new_model, new_master, new_inner = jax.lax.cond(
                 overflow, skip, do_step, None)
         else:

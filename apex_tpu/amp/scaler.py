@@ -69,17 +69,29 @@ class LossScaler:
         return loss.astype(jnp.float32) * state.loss_scale[loss_id]
 
     def unscale(self, scaled_grads: Any, state: ScalerState,
-                loss_id: int = 0, *, out_dtype=None) -> Tuple[Any, jax.Array]:
+                loss_id: int = 0, *, out_dtype=None,
+                check_overflow: bool = True) -> Tuple[Any, jax.Array]:
         """Fused grads/scale with nonfinite detection (scaler.py:103-128).
 
         Returns ``(unscaled_grads, overflow)``. ``out_dtype`` optionally casts
         grads (e.g. to fp32 for master-weight steps) before unscaling.
+
+        ``check_overflow=False`` skips the nonfinite reduction entirely and
+        returns a constant-False overflow — the static-scale path, where the
+        reference never consults the overflow buffer (scaler.py:206-226
+        gates on ``self.dynamic``) and a scale of 1.0 skips the multiply
+        too (scaler.py:111-112).
         """
         if out_dtype is not None:
             scaled_grads = jax.tree_util.tree_map(
                 lambda g: g.astype(out_dtype), scaled_grads)
         inv = 1.0 / state.loss_scale[loss_id]
-        return ops.multi_tensor_scale(scaled_grads, inv)
+        if check_overflow:
+            return ops.multi_tensor_scale(scaled_grads, inv)
+        if self.dynamic or self._static_scale != 1.0:
+            scaled_grads = jax.tree_util.tree_map(
+                lambda g: (g * inv).astype(g.dtype), scaled_grads)
+        return scaled_grads, jnp.zeros((), jnp.bool_)
 
     def update(self, state: ScalerState, overflow: jax.Array,
                loss_id: int = 0) -> ScalerState:

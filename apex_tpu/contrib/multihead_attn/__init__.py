@@ -45,15 +45,39 @@ def masked_softmax_dropout(scores: jax.Array, *, mask: Optional[jax.Array]
                            deterministic: bool = True) -> jax.Array:
     """Standalone fused masked-softmax-dropout (the reference's
     ``fast_mask_softmax_dropout`` module): additive mask -> fp32 softmax ->
-    dropout. XLA fuses this chain into one pass."""
+    dropout. XLA fuses this chain into one pass. Boolean masks (True =
+    masked out) convert to -3e4 additive entries, same as the fast path."""
     s = scores.astype(jnp.float32)
     if mask is not None:
+        mask = jnp.asarray(mask)
+        if mask.dtype == jnp.bool_:
+            mask = jnp.where(mask, -3e4, 0.0)
         s = s + mask.astype(jnp.float32)
     p = jax.nn.softmax(s, axis=-1)
     if dropout_rate > 0.0 and not deterministic:
         keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, p.shape)
         p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
     return p.astype(scores.dtype)
+
+
+def _mask_to_bias(attn_mask):
+    """Normalize a module-level ``attn_mask`` (additive, matching
+    masked_softmax_dropout semantics) to the rank-4 (B|1, H|1, Sq|1, Sk)
+    additive bias the attention kernels take. Boolean masks (True = masked
+    out) convert to -3e4 additive entries (the flash kernels' stable mask
+    magnitude; exp(-3e4) == 0)."""
+    if attn_mask is None:
+        return None
+    m = jnp.asarray(attn_mask)
+    if m.dtype == jnp.bool_:
+        m = jnp.where(m, -3e4, 0.0)
+    if m.ndim == 2:            # (sq, sk)
+        return m[None, None]
+    if m.ndim == 3:            # (b, sq, sk) -> broadcast over heads
+        return m[:, None]
+    if m.ndim == 4:
+        return m
+    raise ValueError(f"attn_mask must be rank 2-4, got shape {m.shape}")
 
 
 def _derive_seed(rng, module_path):
@@ -119,17 +143,19 @@ class SelfMultiheadAttn(nn.Module):
         v = _split_heads(v, h)
 
         if self.seq_parallel is not None:
-            if attn_mask is not None or (
-                    self.dropout > 0.0 and not deterministic):
+            if self.dropout > 0.0 and not deterministic:
                 raise NotImplementedError(
-                    "seq_parallel attention supports causal/plain only "
-                    "(no attn_mask, no dropout)")
+                    "seq_parallel attention does not fuse dropout")
+            # attn_mask (if any) must address GLOBAL key columns:
+            # (B|1, H|1, S_local|1, S_global) for ring,
+            # (B|1, H|1, 1, S_global) for ulysses
+            bias = _mask_to_bias(attn_mask)
             if self.seq_parallel == "ring":
                 ctx = ring_self_attention(q, k, v, self.axis_name,
-                                          causal=self.causal)
+                                          causal=self.causal, bias=bias)
             elif self.seq_parallel == "ulysses":
                 ctx = ulysses_self_attention(q, k, v, self.axis_name,
-                                             causal=self.causal)
+                                             causal=self.causal, bias=bias)
             else:
                 raise ValueError(
                     f"seq_parallel must be 'ring' or 'ulysses', got "
@@ -141,16 +167,17 @@ class SelfMultiheadAttn(nn.Module):
                 out = out + residual
             return out
 
-        use_fast = self.impl == "fast" and attn_mask is None
-        if use_fast:
-            # dropout fuses into the flash kernels (reference dropout.h);
-            # the seed derives from the module's dropout rng per call
+        if self.impl == "fast":
+            # dropout AND the additive mask fuse into the flash kernels
+            # (reference dropout.h + *_bias_additive_mask kernels); the
+            # seed derives from the module's dropout rng per call
             rate, seed = 0.0, None
             if self.dropout > 0.0 and not deterministic:
                 rate = self.dropout
                 seed = _derive_seed(dropout_rng, self.path)
             ctx = flash_attention(q, k, v, self.causal,
-                                  dropout_rate=rate, dropout_seed=seed)
+                                  dropout_rate=rate, dropout_seed=seed,
+                                  bias=_mask_to_bias(attn_mask))
         else:
             scale = 1.0 / math.sqrt(e // h)
             s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
@@ -202,14 +229,14 @@ class EncdecMultiheadAttn(nn.Module):
         k = _split_heads(k, h)
         v = _split_heads(v, h)
 
-        use_fast = self.impl == "fast" and attn_mask is None
-        if use_fast:
+        if self.impl == "fast":
             rate, seed = 0.0, None
             if self.dropout > 0.0 and not deterministic:
                 rate = self.dropout
                 seed = _derive_seed(dropout_rng, self.path)
             ctx = flash_attention(q, k, v, False,
-                                  dropout_rate=rate, dropout_seed=seed)
+                                  dropout_rate=rate, dropout_seed=seed,
+                                  bias=_mask_to_bias(attn_mask))
         else:
             scale = 1.0 / math.sqrt(e // h)
             s = jnp.einsum("bhqd,bhkd->bhqk", q, k,

@@ -14,6 +14,7 @@ from apex_tpu.parallel.sync_batchnorm import (
     SyncBatchNorm,
     sync_moments,
     convert_syncbn_model,
+    convert_syncbn_apply,
 )
 from apex_tpu.parallel.larc import LARC, larc_transform_grads
 

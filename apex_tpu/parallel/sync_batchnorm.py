@@ -164,3 +164,39 @@ def convert_syncbn_model(module: nn.Module, *, axis_name: str = "data",
     if changes:
         return module.clone(**changes)
     return module
+
+
+def convert_syncbn_apply(axis_name: str = "data", axis_index_groups=None):
+    """Apply-time SyncBN conversion for ANY flax model — including
+    ``@nn.compact`` ones whose submodules :func:`convert_syncbn_model`
+    cannot reach (they only exist during apply). The other half of the
+    reference's ``convert_syncbn_model`` coverage
+    (apex/parallel/__init__.py:21-56 walks arbitrary torch module trees).
+
+    Returns a context manager (a flax method interceptor) under which every
+    ``nn.BatchNorm.__call__`` syncs its batch statistics over ``axis_name``
+    (flax BatchNorm natively understands ``axis_name``/``axis_index_groups``
+    — the interceptor just switches them on), keeping the model's own flax
+    BN conventions and its exact variable tree (checkpoints stay
+    compatible)::
+
+        with parallel.convert_syncbn_apply("data"):
+            logits, upd = model.apply(variables, x, mutable=["batch_stats"])
+
+    Use inside shard_map (where ``axis_name`` is bound); init the model
+    OUTSIDE the context. Assumes equal per-device batch sizes (flax BN
+    pmeans the moments); for differing per-rank batches use
+    :class:`SyncBatchNorm`, which psums counts.
+    """
+    def interceptor(next_fn, args, kwargs, context):
+        m = context.module
+        if (isinstance(m, nn.BatchNorm)
+                and context.method_name == "__call__"
+                and getattr(m, "axis_name", None) is None):
+            # bound per-apply instance; BatchNorm natively syncs when
+            # axis_name is set
+            object.__setattr__(m, "axis_name", axis_name)
+            object.__setattr__(m, "axis_index_groups", axis_index_groups)
+        return next_fn(*args, **kwargs)
+
+    return nn.intercept_methods(interceptor)

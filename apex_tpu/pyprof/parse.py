@@ -68,13 +68,59 @@ class Trace:
     def device_events(self) -> List[TraceEvent]:
         return [e for e in self.events if e.on_device]
 
+    def leaf_device_events(self) -> List[TraceEvent]:
+        """Innermost per-op device events only — two container classes are
+        excluded (the r1 ResNet-50 summary counted both, inflating 'other'
+        to 50%):
+
+        * container LANES: TPU traces carry whole-dispatch events
+          (``jit_<fn>``, ``while`` bodies, module/step spans) on separate
+          'Steps' / 'XLA Modules' lanes; when an 'XLA Ops' lane exists,
+          only op/stream lanes are counted;
+        * container EVENTS: an event with a strictly-nested event on its
+          own (pid, tid) lane is an enclosing span, not a kernel.
+
+        Note the remaining per-op durations may legitimately OVERLAP
+        (compute vs DMA units run concurrently), so their sum can exceed
+        step wall time — that is op accounting, not double counting."""
+        evs = self.device_events()
+        threads = {e.thread.lower() for e in evs}
+        if any("xla ops" in t for t in threads):
+            evs = [e for e in evs
+                   if "xla ops" in e.thread.lower()
+                   or "stream" in e.thread.lower()]
+        out: List[TraceEvent] = []
+        lanes: Dict[Tuple[int, int], List[TraceEvent]] = {}
+        for e in evs:
+            lanes.setdefault((e.pid, e.tid), []).append(e)
+        for evs in lanes.values():
+            evs.sort(key=lambda ev: (ev.ts_us, -ev.dur_us))
+            stack: List[list] = []   # [event, has_child]
+
+            def pop_leafward():
+                ev, has_child = stack.pop()
+                if not has_child:
+                    out.append(ev)
+
+            for e in evs:
+                while stack and e.ts_us >= (stack[-1][0].ts_us
+                                            + stack[-1][0].dur_us - 1e-6):
+                    pop_leafward()
+                if stack:
+                    stack[-1][1] = True
+                stack.append([e, False])
+            while stack:
+                pop_leafward()
+        return out
+
     def total_device_time_us(self) -> float:
-        return sum(e.dur_us for e in self.device_events())
+        return sum(e.dur_us for e in self.leaf_device_events())
 
     def by_op(self, device_only: bool = True) -> List[Dict[str, Any]]:
         """Aggregate by op name: count, total/avg us, share of device time —
-        the reference's per-kernel output table (prof/output.py)."""
-        evs = self.device_events() if device_only else self.events
+        the reference's per-kernel output table (prof/output.py). Container
+        events are excluded (see :meth:`leaf_device_events`)."""
+        evs = self.leaf_device_events() if device_only else self.events
         agg: Dict[str, Dict[str, Any]] = {}
         for e in evs:
             row = agg.setdefault(e.name, {"op": e.name, "count": 0,
@@ -94,7 +140,7 @@ class Trace:
         prof/conv.py, prof/pointwise.py, ...), keyed off XLA op names
         instead of CUDA kernel names."""
         agg: Dict[str, Dict[str, Any]] = {}
-        for e in self.device_events():
+        for e in self.leaf_device_events():
             cat = categorize(e.name)
             row = agg.setdefault(cat, {"category": cat, "count": 0,
                                        "total_us": 0.0})

@@ -47,9 +47,18 @@ def summarize_trace(path_or_logdir: str, *, top: int = 25) -> str:
 
     tr = load_trace(path_or_logdir)
     dev = tr.device_events()
+    # wall time of the dispatch from the Steps/Modules container lanes (op
+    # durations overlap across units, so their sum exceeds wall time)
+    wall = [e for e in dev if e.thread.lower() in ("steps", "xla modules")]
     lines = [
         f"events: {len(tr.events)} total, {len(dev)} on-device",
-        f"device time: {tr.total_device_time_us() / 1e3:.3f} ms",
+        f"op time (overlapping units): "
+        f"{tr.total_device_time_us() / 1e3:.3f} ms",
+    ]
+    if wall:
+        lines.append(
+            f"step wall time: {max(e.dur_us for e in wall) / 1e3:.3f} ms")
+    lines += [
         "",
         f"{'category':<16}{'count':>8}{'total_us':>14}{'pct':>8}",
     ]

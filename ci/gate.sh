@@ -47,7 +47,9 @@ ge.dryrun_multichip(8)
 
 echo "== 4/4 pytest =="
 if [[ "${1:-}" == "--full" ]]; then
-    python -m pytest tests/ -q -x
+    # full suite + the complete L1 cross-product matrix (reference
+    # tests/L1/cross_product{,_distributed}/run.sh)
+    APEX_TPU_L1_FULL=1 python -m pytest tests/ -q -x
 else
     # fast subset: kernels, optimizers, amp, param groups, checkpoints
     python -m pytest tests/test_multi_tensor.py tests/test_optimizers.py \

@@ -357,3 +357,167 @@ def test_self_mha_fast_dropout_trains():
     assert np.isfinite(np.asarray(y_tr1)).all()
     assert not np.allclose(np.asarray(y_tr1), np.asarray(y_tr2))
     assert not np.allclose(np.asarray(y_tr1), np.asarray(y_det))
+
+
+# ---------------------------------------------------------------------------
+# Fused additive-mask / bias (reference *_bias_additive_mask kernels)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [(2, 4, 128, 128), (2, 1, 1, 128),
+                                   (1, 4, 128, 128), (1, 1, 1, 128)])
+def test_flash_bias_matches_reference(causal, shape):
+    """Additive score bias fused into the flash kernels: fwd + grads match
+    the dense reference for full, pad-mask, and broadcast bias shapes."""
+    q, k, v = qkv(jax.random.PRNGKey(40), s=128)
+    bias = jax.random.normal(jax.random.PRNGKey(41), shape) * 2.0
+    bias = jnp.where(bias > 1.5, -3e4, bias)  # some fully-masked entries
+    g = jax.random.normal(jax.random.PRNGKey(42), q.shape)
+
+    out_ref = attention_reference(q, k, v, bias=bias, causal=causal)
+    out_fl = flash_attention(q, k, v, causal, bias=bias)
+    np.testing.assert_allclose(np.asarray(out_fl), np.asarray(out_ref),
+                               rtol=2e-4, atol=2e-4)
+
+    _, vjp_fl = jax.vjp(
+        lambda a, b, c: flash_attention(a, b, c, causal, bias=bias), q, k, v)
+    _, vjp_ref = jax.vjp(
+        lambda a, b, c: attention_reference(a, b, c, bias=bias,
+                                            causal=causal), q, k, v)
+    # atol 2e-3: f32 carries ~2e-3 exponent precision at the -3e4 mask
+    # magnitude, so reconstructed probs near masked entries wobble slightly
+    for got, want in zip(vjp_fl(g), vjp_ref(g)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=3e-3, atol=2e-3)
+
+
+def test_flash_bias_clamps_huge_masks():
+    """-1e9-style masks are clamped to -3e4 in-kernel (f32 lse precision);
+    the result matches the reference with the clamped mask."""
+    q, k, v = qkv(jax.random.PRNGKey(43), s=128)
+    bias = jnp.where(jnp.arange(128) < 64, 0.0, -1e9)[None, None, None, :]
+    want = attention_reference(q, k, v, bias=jnp.maximum(bias, -3e4))
+    got = flash_attention(q, k, v, bias=bias)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_self_mha_masked_fast_path():
+    """A masked SelfMultiheadAttn(impl='fast') must match impl='default'
+    exactly (VERDICT r1 #5: masks no longer bail out of the flash path)."""
+    e, h, s = 64, 4, 128
+    x = jax.random.normal(jax.random.PRNGKey(44), (2, s, e))
+    mask = jnp.where(jnp.arange(s) < s - 32, 0.0, -3e4)[None, None, None, :]
+    m_fast = SelfMultiheadAttn(embed_dim=e, num_heads=h, impl="fast")
+    m_def = SelfMultiheadAttn(embed_dim=e, num_heads=h, impl="default")
+    params = m_fast.init(jax.random.PRNGKey(45), x)
+    y1 = m_fast.apply(params, x, attn_mask=mask)
+    y2 = m_def.apply(params, x, attn_mask=mask)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=2e-4,
+                               atol=2e-4)
+    # boolean masks (True = masked) behave like the additive -3e4 mask on
+    # BOTH impls (r2 review: the default path must not add bool as +1.0)
+    bmask = (jnp.arange(s) >= s - 32)[None, None, None, :]
+    y3 = m_fast.apply(params, x, attn_mask=bmask)
+    np.testing.assert_allclose(np.asarray(y3), np.asarray(y1), rtol=1e-5,
+                               atol=1e-6)
+    y4 = m_def.apply(params, x, attn_mask=bmask)
+    np.testing.assert_allclose(np.asarray(y4), np.asarray(y2), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_encdec_mha_masked_fast_path():
+    e, h = 32, 2
+    q = jax.random.normal(jax.random.PRNGKey(46), (2, 24, e))
+    kv = jax.random.normal(jax.random.PRNGKey(47), (2, 48, e))
+    mask = jnp.where(jnp.arange(48) < 40, 0.0, -3e4)[None, None, None, :]
+    m_def = EncdecMultiheadAttn(embed_dim=e, num_heads=h, impl="default")
+    m_fast = EncdecMultiheadAttn(embed_dim=e, num_heads=h, impl="fast")
+    params = m_def.init(jax.random.PRNGKey(48), q, kv)
+    want = m_def.apply(params, q, kv, attn_mask=mask)
+    got = m_fast.apply(params, q, kv, attn_mask=mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4,
+                               atol=2e-4)
+
+
+@pytest.mark.parametrize("scheme", ["ring", "ulysses"])
+def test_seq_parallel_masked_matches_dense(mesh, scheme):
+    """Masked sequence-parallel attention (key-padding bias with GLOBAL
+    columns) matches dense masked attention."""
+    b, h, s, d = 2, 8, NDEV * 16, 32
+    ks = jax.random.split(jax.random.PRNGKey(50), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d)) for kk in ks)
+    bias = jnp.where(jnp.arange(s) < s - 48, 0.0, -3e4)[None, None, None, :]
+    bias = jnp.broadcast_to(bias, (b, 1, 1, s))
+
+    want = attention_reference(q, k, v, bias=bias)
+
+    def per_device(q_, k_, v_):
+        if scheme == "ring":
+            return ring_self_attention(q_, k_, v_, "seq", bias=bias)
+        return ulysses_self_attention(q_, k_, v_, "seq", bias=bias)
+
+    got = jax.jit(shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(None, None, "seq", None),) * 3,
+        out_specs=P(None, None, "seq", None), check_vma=False))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention composed with the flash kernels (VERDICT r1 #6)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_matches_dense(mesh, causal):
+    """impl='flash' ring: Pallas chunks + global-lse ring backward must
+    match dense attention in value AND grads on the 8-device mesh."""
+    b, h, s, d = 1, 2, NDEV * 32, 32
+    ks = jax.random.split(jax.random.PRNGKey(60), 4)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d)) for kk in ks[:3])
+    g = jax.random.normal(ks[3], (b, h, s, d))
+
+    want, vjp_ref = jax.vjp(
+        lambda a, bb, c: attention_reference(a, bb, c, causal=causal),
+        q, k, v)
+    want_grads = vjp_ref(g)
+
+    def per_device(q_, k_, v_, g_):
+        out, vjp = jax.vjp(
+            lambda a, bb, c: ring_self_attention(
+                a, bb, c, "seq", causal=causal, impl="flash"), q_, k_, v_)
+        return (out,) + vjp(g_)
+
+    spec = P(None, None, "seq", None)
+    got, *got_grads = jax.jit(shard_map(
+        per_device, mesh=mesh, in_specs=(spec,) * 4,
+        out_specs=(spec,) * 4, check_vma=False))(q, k, v, g)
+
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+    for gg, ww in zip(got_grads, want_grads):
+        np.testing.assert_allclose(np.asarray(gg), np.asarray(ww),
+                                   rtol=3e-3, atol=5e-4)
+
+
+def test_ring_flash_masked(mesh):
+    """Ring flash with a key-padding bias (global columns)."""
+    b, h, s, d = 1, 2, NDEV * 16, 32
+    ks = jax.random.split(jax.random.PRNGKey(61), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d)) for kk in ks)
+    bias = jnp.where(jnp.arange(s) < s - 40, 0.0, -3e4)[None, None, None, :]
+    bias = jnp.broadcast_to(bias, (b, 1, 1, s))
+
+    want = attention_reference(q, k, v, bias=bias)
+
+    def per_device(q_, k_, v_):
+        return ring_self_attention(q_, k_, v_, "seq", bias=bias,
+                                   impl="flash")
+
+    spec = P(None, None, "seq", None)
+    got = jax.jit(shard_map(
+        per_device, mesh=mesh, in_specs=(spec,) * 3,
+        out_specs=spec, check_vma=False))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)

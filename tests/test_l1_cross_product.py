@@ -1,0 +1,251 @@
+"""L1 cross-product harness — the full analog of the reference's L1 tier
+(tests/L1/common/{main_amp.py,run_test.sh:30-60,compare.py:36-46} plus
+tests/L1/cross_product{,_distributed}/run.sh):
+
+  * a REAL ResNet-18 (narrow filters for CI budget) trained
+    deterministically, per-iteration loss dump,
+  * the config matrix opt-level x keep_batchnorm_fp32 x loss-scale,
+  * bitwise reproducibility between identical runs (the reference's
+    ``assert loss_e == loss_p``),
+  * every config's trajectory tracking the O0 fp32 baseline,
+  * the same configs under x8-device DDP + SyncBN (cross_product_distributed)
+    with DDP-vs-single-device consistency on the same global batch,
+  * stored-baseline mode: APEX_TPU_L1_BASELINE=path dumps (if absent) or
+    bitwise-compares (if present) the loss table — the --use_baseline flow.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import amp, optimizers, parallel
+from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+from apex_tpu.models.resnet import ResNet18
+
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+STEPS = 6
+BATCH = 8          # global batch, split over devices in the DDP variant
+NUM_CLASSES = 10
+
+# The matrix (reference run_test.sh:30-60 sweeps O0-O3 x keep_batchnorm x
+# loss-scale; we add the fork's O4/O5 bf16 levels). keep_batchnorm_fp32
+# only composes with cast levels (O2/O3/O5 — policy check, as in the
+# reference); static loss-scale with the fp16 scaled levels.
+#
+# Each cell compiles its own ResNet-18 train step (~80 s on XLA-CPU), so
+# the default run covers the core subset and APEX_TPU_L1_FULL=1 unlocks
+# the full cross product — the same split as the reference, whose L1 tier
+# runs from run_test.sh rather than the default unit pass.
+FULL = bool(os.environ.get("APEX_TPU_L1_FULL"))
+full_only = pytest.mark.skipif(
+    not FULL, reason="full L1 cross product: set APEX_TPU_L1_FULL=1")
+
+MATRIX_CORE = [
+    # (opt_level, keep_bn override, loss_scale override)
+    ("O0", None, None),
+    ("O2", None, None),
+    ("O5", None, None),
+]
+MATRIX_FULL = [
+    ("O1", None, None),
+    ("O3", None, None),
+    ("O4", None, None),
+    ("O2", False, None),
+    ("O3", True, None),
+    ("O5", False, None),
+    ("O1", None, 128.0),
+    ("O2", None, 128.0),
+]
+MATRIX = MATRIX_CORE + [pytest.param(*c, marks=full_only)
+                        for c in MATRIX_FULL]
+
+
+def _data(seed=0):
+    x = jax.random.normal(jax.random.PRNGKey(seed), (BATCH, 32, 32, 3),
+                          jnp.float32)
+    y = jax.random.randint(jax.random.PRNGKey(seed + 1), (BATCH,), 0,
+                           NUM_CLASSES)
+    return x, y
+
+
+def run_config(opt_level, keep_bn=None, loss_scale=None, n_devices=1,
+               steps=STEPS, seed=0):
+    """Train the narrow ResNet-18 for ``steps`` and return the per-iteration
+    loss list — the harness's analog of main_amp.py's loss dump.
+
+    Matmul precision is pinned to 'highest' (the harness's --deterministic
+    analog) for the run and RESTORED after — other suites' tolerances are
+    tuned under the default precision and must not inherit this setting
+    when the whole suite runs in one process (ci/gate.sh --full).
+    """
+    prev = jax.config.jax_default_matmul_precision
+    jax.config.update("jax_default_matmul_precision", "highest")
+    try:
+        return _run_config_inner(opt_level, keep_bn, loss_scale, n_devices,
+                                 steps, seed)
+    finally:
+        jax.config.update("jax_default_matmul_precision", prev)
+
+
+def _run_config_inner(opt_level, keep_bn, loss_scale, n_devices, steps,
+                      seed):
+    props = amp.resolve(opt_level, keep_batchnorm_fp32=keep_bn,
+                        loss_scale=loss_scale)
+    mesh = parallel.make_mesh([n_devices], ("data",),
+                              devices=jax.devices()[:n_devices])
+    model = ResNet18(num_classes=NUM_CLASSES, num_filters=8,
+                     dtype=props.cast_model_type or jnp.float32,
+                     axis_name="data" if n_devices > 1 else None)
+
+    x, y = _data(seed)
+    variables = model.init(jax.random.PRNGKey(seed + 2), x[:1])
+    params32, batch_stats = variables["params"], variables["batch_stats"]
+
+    inner = optimizers.FusedSGD(lr=0.05, momentum=0.9)
+    _, aopt = amp.initialize(None, inner, opt_level=opt_level,
+                             keep_batchnorm_fp32=keep_bn,
+                             loss_scale=loss_scale, verbosity=0)
+    params = amp.cast_model(params32, props)
+    opt_state = aopt.init(params)
+
+    def per_device(params, batch_stats, opt_state, batch):
+        xb, yb = batch
+
+        def scaled(p):
+            logits, upd = model.apply(
+                {"params": p, "batch_stats": batch_stats}, xb, train=True,
+                mutable=["batch_stats"])
+            loss = jnp.mean(softmax_cross_entropy_loss(logits, yb))
+            return aopt.scale_loss(loss, opt_state), (loss,
+                                                      upd["batch_stats"])
+
+        grads, (loss, new_bs) = jax.grad(scaled, has_aux=True)(params)
+        # predivide by world (reference gradient_predivide_factor): summing
+        # fp16 SCALED grads across devices overflows at high loss scales —
+        # without this the O2 run skips 5 steps on 8 devices vs 1 on one
+        # device. Total averaging is unchanged (predivide w, postdivide 1).
+        grads = parallel.allreduce_gradients(
+            grads, "data",
+            gradient_predivide_factor=jax.lax.axis_size("data"))
+        new_bs = jax.tree.map(lambda s: jax.lax.pmean(s, "data"), new_bs)
+        loss = jax.lax.pmean(loss, "data")
+        new_params, new_opt, _ = aopt.step(grads, params, opt_state)
+        return new_params, new_bs, new_opt, loss
+
+    rep = P()
+    step_fn = jax.jit(shard_map(
+        per_device, mesh=mesh,
+        in_specs=(rep, rep, rep, (P("data"), P("data"))),
+        out_specs=(rep, rep, rep, rep), check_vma=False))
+
+    losses = []
+    for _ in range(steps):
+        params, batch_stats, opt_state, loss = step_fn(
+            params, batch_stats, opt_state, (x, y))
+        losses.append(float(loss))
+    return losses
+
+
+# Single runs are cached across tests (the O0 baseline etc.); the bitwise
+# test bypasses the cache to genuinely run twice.
+_CACHE = {}
+
+
+def cached_run(*key):
+    if key not in _CACHE:
+        _CACHE[key] = run_config(*key)
+    return _CACHE[key]
+
+
+@pytest.mark.parametrize("opt_level,keep_bn,loss_scale", MATRIX)
+def test_config_tracks_fp32_baseline(opt_level, keep_bn, loss_scale):
+    """Every matrix config converges and its final loss tracks the O0 run
+    (the reference compares every cross-product cell against baselines)."""
+    base = cached_run("O0", None, None, 1)
+    got = cached_run(opt_level, keep_bn, loss_scale, 1)
+    assert all(np.isfinite(got)), (opt_level, got)
+    assert got[-1] < got[0], f"{opt_level} did not converge: {got}"
+    tol = 0.25 if opt_level in ("O2", "O3") else 0.15
+    # dynamic fp16 scaling correctly skips the first step(s) while the
+    # 2^16 init scale calms down (reference behavior: overflow -> skip +
+    # halve), so the trajectory may lag the fp32 one by a step — compare
+    # against the closest tail point.
+    best = min(abs(got[-1] - b) for b in base[-2:])
+    assert best < max(tol, 0.25 * base[-1]), (
+        opt_level, keep_bn, loss_scale, base[-2:], got[-1])
+
+
+@pytest.mark.parametrize("opt_level",
+                         ["O5", pytest.param("O2", marks=full_only)])
+def test_bitwise_reproducibility(opt_level):
+    """compare.py:36-46: two identical runs must produce IDENTICAL losses,
+    bitwise — exercised on the master-weight levels where the amp machinery
+    is deepest."""
+    run_e = run_config(opt_level)
+    run_p = run_config(opt_level)
+    assert run_e == run_p, (run_e, run_p)
+
+
+@pytest.mark.parametrize(
+    "opt_level,keep_bn,loss_scale",
+    [("O5", None, None),
+     pytest.param("O0", None, None, marks=full_only),
+     pytest.param("O2", None, None, marks=full_only),
+     pytest.param("O2", None, 128.0, marks=full_only)])
+def test_distributed_cross_product(opt_level, keep_bn, loss_scale):
+    """cross_product_distributed: the same configs under 8-device DDP +
+    SyncBN. With the same GLOBAL batch, the distributed run must track the
+    single-device run (SyncBN makes the BN math identical; only reduction
+    order differs)."""
+    single = cached_run(opt_level, keep_bn, loss_scale, 1)
+    dist = cached_run(opt_level, keep_bn, loss_scale, 8)
+    assert all(np.isfinite(dist))
+
+    # Dynamic fp16 scaling may skip MORE leading steps distributed than
+    # single-device: with 1 sample/device, per-SAMPLE grads at scale 2^16
+    # overflow in the backward where the 8-sample mean does not — faithful
+    # reference behavior (each rank skips on its own overflow), so align
+    # the post-recovery trajectories instead of step indices.
+    def strip_skips(tr):
+        i = 0
+        while i + 1 < len(tr) and tr[i + 1] == tr[0]:
+            i += 1
+        return tr[i:]
+
+    s, d = strip_skips(single), strip_skips(dist)
+    n = min(len(s), len(d))
+    assert n >= 2, (single, dist)
+    rtol = 1e-4 if opt_level in ("O0",) else 2e-2
+    np.testing.assert_allclose(d[:n], s[:n], rtol=rtol, atol=1e-3,
+                               err_msg=f"{opt_level} DDP vs single")
+
+
+@full_only
+def test_distributed_bitwise_reproducibility():
+    """The DDP run itself is deterministic bitwise across executions."""
+    run_e = run_config("O5", n_devices=8)
+    run_p = run_config("O5", n_devices=8)
+    assert run_e == run_p
+
+
+def test_stored_baseline_roundtrip(tmp_path):
+    """--use_baseline flow: dump the loss table, then compare bitwise."""
+    path = os.environ.get("APEX_TPU_L1_BASELINE") or str(
+        tmp_path / "l1_baseline.json")
+    table = {f"{lvl}/kb={kb}/ls={ls}": cached_run(lvl, kb, ls, 1)
+             for lvl, kb, ls in [("O0", None, None), ("O5", None, None)]}
+    if not os.path.exists(path):
+        with open(path, "w") as f:
+            json.dump(table, f)
+    with open(path) as f:
+        stored = json.load(f)
+    for cfg, losses in table.items():
+        assert stored[cfg] == losses, (
+            f"config {cfg} diverged from the stored baseline at {path}")

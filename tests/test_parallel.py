@@ -365,3 +365,58 @@ def test_groupbn_subgroup_stats(mesh):
     # both groups whitened to ~zero mean despite the +10 shift
     assert abs(y[:4].mean()) < 0.05
     assert abs(y[4:].mean()) < 0.05
+
+
+def test_convert_syncbn_apply_compact_model(mesh):
+    """convert_syncbn_apply: apply-time interception reaches BatchNorms
+    inside @nn.compact models (which convert_syncbn_model cannot rewrite).
+    With stats synced, an 8-device run on batch shards must match the
+    dense run on the global batch."""
+    import flax.linen as nn
+
+    class CompactNet(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(16)(x)
+            x = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                             name="bn")(x)
+            return nn.relu(x)
+
+    model = CompactNet()
+    x = jax.random.normal(jax.random.PRNGKey(70), (16, 8))
+    variables = model.init(jax.random.PRNGKey(71), x)
+
+    want, want_upd = model.apply(variables, x, mutable=["batch_stats"])
+
+    def per_device(x_):
+        with parallel.convert_syncbn_apply("data"):
+            y, upd = model.apply(variables, x_, mutable=["batch_stats"])
+        return y, upd["batch_stats"]
+
+    got, got_bs = jax.jit(shard_map(
+        per_device, mesh=mesh, in_specs=(P("data"),),
+        out_specs=(P("data"), P()), check_vma=False))(x)
+
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        got_bs, want_upd["batch_stats"])
+
+
+def test_convert_syncbn_apply_noop_outside_mesh():
+    """Without the context, the same compact model keeps local (unsynced)
+    stats — the interceptor is strictly opt-in."""
+    import flax.linen as nn
+
+    class CompactNet(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.BatchNorm(use_running_average=False, name="bn")(x)
+
+    model = CompactNet()
+    x = jax.random.normal(jax.random.PRNGKey(72), (8, 4))
+    variables = model.init(jax.random.PRNGKey(73), x)
+    y, _ = model.apply(variables, x, mutable=["batch_stats"])
+    assert np.isfinite(np.asarray(y)).all()
