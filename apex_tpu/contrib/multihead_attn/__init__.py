@@ -25,6 +25,7 @@ import flax.linen as nn
 
 from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.ops.attention import (
+    MASK_BIAS,
     attention_reference,
     flash_attention,
     ring_self_attention,
@@ -46,12 +47,13 @@ def masked_softmax_dropout(scores: jax.Array, *, mask: Optional[jax.Array]
     """Standalone fused masked-softmax-dropout (the reference's
     ``fast_mask_softmax_dropout`` module): additive mask -> fp32 softmax ->
     dropout. XLA fuses this chain into one pass. Boolean masks (True =
-    masked out) convert to -3e4 additive entries, same as the fast path."""
+    masked out) convert to MASK_BIAS additive entries, same as the fast
+    path."""
     s = scores.astype(jnp.float32)
     if mask is not None:
         mask = jnp.asarray(mask)
         if mask.dtype == jnp.bool_:
-            mask = jnp.where(mask, -3e4, 0.0)
+            mask = jnp.where(mask, MASK_BIAS, 0.0)
         s = s + mask.astype(jnp.float32)
     p = jax.nn.softmax(s, axis=-1)
     if dropout_rate > 0.0 and not deterministic:
@@ -64,13 +66,13 @@ def _mask_to_bias(attn_mask):
     """Normalize a module-level ``attn_mask`` (additive, matching
     masked_softmax_dropout semantics) to the rank-4 (B|1, H|1, Sq|1, Sk)
     additive bias the attention kernels take. Boolean masks (True = masked
-    out) convert to -3e4 additive entries (the flash kernels' stable mask
-    magnitude; exp(-3e4) == 0)."""
+    out) convert to MASK_BIAS additive entries (the flash kernels' stable
+    mask magnitude; exp(MASK_BIAS) == 0)."""
     if attn_mask is None:
         return None
     m = jnp.asarray(attn_mask)
     if m.dtype == jnp.bool_:
-        m = jnp.where(m, -3e4, 0.0)
+        m = jnp.where(m, MASK_BIAS, 0.0)
     if m.ndim == 2:            # (sq, sk)
         return m[None, None]
     if m.ndim == 3:            # (b, sq, sk) -> broadcast over heads
