@@ -259,6 +259,22 @@ def _bias_spec(info, bq, bk, *, row_id, col_id):
     return pl.BlockSpec((1, bq if per_row else 1, bk), index)
 
 
+def _pick_block(pref: int, s: int) -> int:
+    """Largest block size <= ``pref`` whose block-rounded padding stays
+    within 15% of the minimal 128-aligned padding. Big blocks are faster
+    (the kernels are VPU-bound; fewer grid steps amortize per-step
+    overhead) but rounding a length just past a large-block multiple would
+    nearly double the computed/padded area — e.g. sk=1088 at block 1024
+    pads to 2048; this picks 256 (pads to 1280) instead."""
+    sp_min = ((s + 127) // 128) * 128
+    pref = min(pref, sp_min)
+    best = 128
+    for cand in (256, 512, 1024):
+        if cand <= pref and -(-s // cand) * cand <= sp_min * 1.15:
+            best = cand
+    return max(128, min(best, pref))
+
+
 def _flash_fwd(q, k, v, *, causal: bool, scale: float,
                dropout_rate: float = 0.0, dropout_seed=None,
                bias=None, block_q: int = 512, block_k: int = 1024):
@@ -277,9 +293,8 @@ def _flash_fwd(q, k, v, *, causal: bool, scale: float,
 
     # pad head_dim to lane multiple, seq to block multiples
     dp = ((d + 127) // 128) * 128
-    bq = min(block_q, max(128, 1 << (sq - 1).bit_length()))
-    bq = min(bq, ((sq + 127) // 128) * 128)
-    bk = min(block_k, ((sk + 127) // 128) * 128)
+    bq = _pick_block(block_q, sq)
+    bk = _pick_block(block_k, sk)
     sqp = ((sq + bq - 1) // bq) * bq
     skp = ((sk + bk - 1) // bk) * bk
 
@@ -478,8 +493,8 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
                     axis=-1)                     # (b, h, sq)
 
     dp_ = ((d + 127) // 128) * 128
-    bq = min(block_q, ((sq + 127) // 128) * 128)
-    bk = min(block_k, ((sk + 127) // 128) * 128)
+    bq = _pick_block(block_q, sq)
+    bk = _pick_block(block_k, sk)
     sqp = ((sq + bq - 1) // bq) * bq
     skp = ((sk + bk - 1) // bk) * bk
 

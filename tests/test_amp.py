@@ -390,3 +390,100 @@ def test_keep_bn_warning_only_when_explicit():
         amp.cast_model(params, amp.resolve("O5"))
     with pytest.warns(UserWarning, match="batchnorm-like"):
         amp.cast_model(params, amp.resolve("O5", keep_batchnorm_fp32=True))
+
+
+@pytest.mark.parametrize("opt_level", ["O1", "O2", "O5"])
+def test_two_models_two_optimizers_joint_equals_separate(opt_level):
+    """The heart of the reference's 764-line cross-product test
+    (tests/L0/run_amp/test_multiple_models_optimizers_losses.py): training
+    two models jointly — each with its own optimizer and loss — must be
+    BITWISE identical to training each alone, across opt levels."""
+    def make(seed):
+        w = jax.random.normal(jax.random.PRNGKey(seed), (8, 8))
+        props = amp.resolve(opt_level)
+        p32 = {"w": w}
+        p = amp.cast_model(p32, props)
+        inner = optimizers.FusedSGD(lr=0.1, momentum=0.9)
+        aopt = amp.AmpOptimizer(inner, props)
+        return p, aopt, aopt.init(p)
+
+    x = jax.random.normal(jax.random.PRNGKey(9), (4, 8))
+
+    def loss_fn(p, shift):
+        y = x.astype(p["w"].dtype) @ p["w"]
+        return jnp.mean((y.astype(jnp.float32) - shift) ** 2)
+
+    def step(p, aopt, st, shift):
+        def scaled(pp):
+            return aopt.scale_loss(loss_fn(pp, shift), st)
+        grads = jax.grad(scaled)(p)
+        new_p, new_st, _ = aopt.step(grads, p, st)
+        return new_p, new_st
+
+    # joint: interleave the two models' steps in one loop
+    pa, oa, sa = make(1)
+    pb, ob, sb = make(2)
+    for _ in range(5):
+        pa, sa = step(pa, oa, sa, 1.0)
+        pb, sb = step(pb, ob, sb, -1.0)
+
+    # separate runs, same seeds
+    pa2, oa2, sa2 = make(1)
+    for _ in range(5):
+        pa2, sa2 = step(pa2, oa2, sa2, 1.0)
+    pb2, ob2, sb2 = make(2)
+    for _ in range(5):
+        pb2, sb2 = step(pb2, ob2, sb2, -1.0)
+
+    np.testing.assert_array_equal(np.asarray(pa["w"], np.float32),
+                                  np.asarray(pa2["w"], np.float32))
+    np.testing.assert_array_equal(np.asarray(pb["w"], np.float32),
+                                  np.asarray(pb2["w"], np.float32))
+    if oa.properties.master_weights:
+        np.testing.assert_array_equal(np.asarray(sa.master["w"]),
+                                      np.asarray(sa2.master["w"]))
+
+
+@pytest.mark.parametrize("opt_level", ["O2", "O5"])
+def test_one_optimizer_two_models_shared_step(opt_level):
+    """One optimizer driving the concatenated params of two models (the
+    reference's shared-optimizer rows): the shared step must equal per-model
+    steps when the losses are independent (disjoint grad support)."""
+    props = amp.resolve(opt_level)
+    w1 = jax.random.normal(jax.random.PRNGKey(3), (6, 6))
+    w2 = jax.random.normal(jax.random.PRNGKey(4), (6, 6))
+    both32 = {"m1": {"w": w1}, "m2": {"w": w2}}
+    both = amp.cast_model(both32, props)
+    inner = optimizers.FusedSGD(lr=0.1, momentum=0.9)
+    aopt = amp.AmpOptimizer(inner, props)
+    st = aopt.init(both)
+
+    x = jax.random.normal(jax.random.PRNGKey(5), (4, 6))
+
+    def scaled(p):
+        y1 = x.astype(p["m1"]["w"].dtype) @ p["m1"]["w"]
+        y2 = x.astype(p["m2"]["w"].dtype) @ p["m2"]["w"]
+        loss = (jnp.mean(y1.astype(jnp.float32) ** 2)
+                + jnp.mean(y2.astype(jnp.float32) ** 2))
+        return aopt.scale_loss(loss, st)
+
+    grads = jax.grad(scaled)(both)
+    new_both, _, _ = aopt.step(grads, both, st)
+
+    # reference: stepping each model alone with its own optimizer
+    for name in ("m1", "m2"):
+        solo = {"w": both[name]["w"]}
+        solo_opt = amp.AmpOptimizer(
+            optimizers.FusedSGD(lr=0.1, momentum=0.9), props)
+        solo_st = solo_opt.init(solo)
+
+        def scaled_solo(p):
+            y = x.astype(p["w"].dtype) @ p["w"]
+            return solo_opt.scale_loss(
+                jnp.mean(y.astype(jnp.float32) ** 2), solo_st)
+
+        g = jax.grad(scaled_solo)(solo)
+        new_solo, _, _ = solo_opt.step(g, solo, solo_st)
+        np.testing.assert_array_equal(
+            np.asarray(new_both[name]["w"], np.float32),
+            np.asarray(new_solo["w"], np.float32))
