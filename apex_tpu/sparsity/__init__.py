@@ -17,34 +17,167 @@ hardware-independent.
 
 from __future__ import annotations
 
+import functools
 import re
 from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 Tree = Any
 
 
-def m4n2_mask_1d(w: jax.Array) -> jax.Array:
-    """Keep the 2 largest-|w| of every contiguous group of 4 along the last
-    axis (sparse_masklib's m4n2_1d pattern). Last axis must be % 4 == 0."""
+def mn_mask_1d(w: jax.Array, m: int = 4, n: int = 2) -> jax.Array:
+    """Keep the ``n`` largest-|w| of every contiguous group of ``m`` along
+    the last axis — sparse_masklib's ``mn_1d_best`` (its exhaustive
+    pattern-argmax over all C(m,n) patterns is exactly top-n by magnitude,
+    so the TPU form is a vectorized rank test). Last axis must be
+    % ``m`` == 0."""
     shape = w.shape
-    g = w.reshape(-1, 4)
+    g = w.reshape(-1, m)
     mag = jnp.abs(g)
-    # rank within each group; keep top-2
     order = jnp.argsort(mag, axis=1)  # ascending
     ranks = jnp.zeros_like(order).at[
         jnp.arange(g.shape[0])[:, None], order].set(
-        jnp.broadcast_to(jnp.arange(4), order.shape))
-    mask = (ranks >= 2).astype(w.dtype)
+        jnp.broadcast_to(jnp.arange(m), order.shape))
+    mask = (ranks >= m - n).astype(w.dtype)
     return mask.reshape(shape)
 
 
+def m4n2_mask_1d(w: jax.Array) -> jax.Array:
+    """sparse_masklib's ``m4n2_1d``: 2-of-4 along the last axis."""
+    return mn_mask_1d(w, 4, 2)
+
+
+@functools.lru_cache(maxsize=None)
+def _valid_2d_patterns(m: int, n: int):
+    """All m x m 0/1 patterns with exactly n per row AND <= n per column
+    (reference compute_valid_2d_patterns; for 4:2 there are 90). Built in
+    numpy once — a static (P, m*m) table baked into the jitted program."""
+    import itertools
+    base = sorted(set(itertools.permutations([1] * n + [0] * (m - n))))
+    valid = [p for p in itertools.product(base, repeat=m)
+             if all(sum(col) <= n for col in zip(*p))]
+    return np.asarray(valid, np.float32).reshape(len(valid), m * m)
+
+
+def _to_2d_blocks(w: jax.Array, m: int):
+    r, c = w.shape
+    if r % m or c % m:
+        raise ValueError(
+            f"2d m:n masking needs both dims % {m} == 0; got {w.shape}")
+    # (r//m, m, c//m, m) -> (r//m, c//m, m, m) -> (B, m*m)
+    return (w.reshape(r // m, m, c // m, m).transpose(0, 2, 1, 3)
+            .reshape(-1, m * m))
+
+
+def _from_2d_blocks(blocks: jax.Array, shape, m: int):
+    r, c = shape
+    return (blocks.reshape(r // m, c // m, m, m).transpose(0, 2, 1, 3)
+            .reshape(r, c))
+
+
+def mn_mask_2d_best(w: jax.Array, m: int = 4, n: int = 2) -> jax.Array:
+    """Exhaustive 2d m:n mask (sparse_masklib ``mn_2d_best``): every m x m
+    block gets the valid pattern (n per row AND per column — so the
+    TRANSPOSED weight is also m:n sparse, the DGRAD property) maximizing
+    the kept |w| sum. One (B, m²) x (m², P) matmul + argmax — MXU-friendly,
+    no per-block loops."""
+    patterns = jnp.asarray(_valid_2d_patterns(m, n))      # (P, m*m)
+    blocks = _to_2d_blocks(jnp.abs(w.astype(jnp.float32)), m)
+    scores = blocks @ patterns.T                          # (B, P)
+    best = jnp.argmax(scores, axis=1)
+    mask = patterns[best]                                 # (B, m*m)
+    return _from_2d_blocks(mask, w.shape, m).astype(w.dtype)
+
+
+def m4n2_mask_2d_best(w: jax.Array) -> jax.Array:
+    return mn_mask_2d_best(w, 4, 2)
+
+
+def mn_mask_2d_greedy(w: jax.Array, m: int = 4, n: int = 2) -> jax.Array:
+    """Greedy 2d m:n mask (sparse_masklib ``mn_2d_greedy``): visit each
+    block's entries in descending |w| order, keep while the row/column
+    quotas allow. The per-block sequential scan becomes a fori_loop over
+    the m² ranked positions, vectorized across all blocks."""
+    blocks = _to_2d_blocks(jnp.abs(w.astype(jnp.float32)), m)  # (B, m*m)
+    nb = blocks.shape[0]
+    order = jnp.argsort(-blocks, axis=1)                  # descending
+    bidx = jnp.arange(nb)
+
+    def body(t, carry):
+        mask, rows, cols = carry
+        idx = order[:, t]                                 # (B,)
+        r = idx // m
+        c = idx % m
+        ok = (rows[bidx, r] < n) & (cols[bidx, c] < n)
+        mask = mask.at[bidx, idx].set(ok.astype(mask.dtype))
+        rows = rows.at[bidx, r].add(ok.astype(jnp.int32))
+        cols = cols.at[bidx, c].add(ok.astype(jnp.int32))
+        return mask, rows, cols
+
+    mask0 = jnp.zeros((nb, m * m), jnp.float32)
+    quota = jnp.zeros((nb, m), jnp.int32)
+    mask, _, _ = jax.lax.fori_loop(0, m * m, body, (mask0, quota, quota))
+    return _from_2d_blocks(mask, w.shape, m).astype(w.dtype)
+
+
+def m4n2_mask_2d_greedy(w: jax.Array) -> jax.Array:
+    return mn_mask_2d_greedy(w, 4, 2)
+
+
+_PATTERNS = {
+    "m4n2_1d": m4n2_mask_1d,
+    "m4n2_2d_best": m4n2_mask_2d_best,
+    "m4n2_2d_greedy": m4n2_mask_2d_greedy,
+}
+
+
+def dispatch_ranks(fn: Callable, w: jax.Array) -> jax.Array:
+    """Apply a 2d mask pattern to a rank-1..4 tensor (the rank-dispatch of
+    sparse_masklib ``create_mask``): 1d masks as one row; 2d as-is; 3d
+    (batch, in, out) flattens leading dims and prunes the last dim (the
+    reference's bmm branch); 4d convs — flax layout (h, w, in, out) —
+    prune along the INPUT-channel dim, matching the reference's permute of
+    torch's (out, in, h, w) to put the reduction dim last."""
+    shape = w.shape
+    if w.ndim == 1:
+        return fn(w.reshape(1, -1)).reshape(shape)
+    if w.ndim == 2:
+        return fn(w)
+    if w.ndim == 3:
+        return fn(w.reshape(shape[0] * shape[1], shape[2])).reshape(shape)
+    if w.ndim == 4:
+        t = w.transpose(0, 1, 3, 2).reshape(-1, shape[2])
+        m = fn(t).reshape(shape[0], shape[1], shape[3], shape[2])
+        return m.transpose(0, 1, 3, 2)
+    raise ValueError(f"sparsity masks support rank 1-4, got shape {shape}")
+
+
+def create_mask(w: jax.Array, pattern: str = "m4n2_1d",
+                density: float = 0.5) -> jax.Array:
+    """Rank-dispatching mask construction (sparse_masklib ``create_mask``).
+    ``density`` is accepted for signature parity (2:4 is the hardware
+    pattern)."""
+    del density
+    fn = _PATTERNS.get(pattern)
+    if fn is None:
+        raise ValueError(
+            f"unknown sparsity pattern {pattern!r}; options: "
+            f"{sorted(_PATTERNS)}")
+    return dispatch_ranks(fn, w)
+
+
 def _default_allowed(path, p) -> bool:
-    """Prune 2-D+ kernels whose last dim is a multiple of 4 and that are not
-    norm/bias params (the reference whitelists Linear/Conv weights)."""
-    if p.ndim < 2 or p.shape[-1] % 4 != 0:
+    """Prune 2-D+ kernels whose pruned axis is a multiple of 4 and that are
+    not norm/bias params (the reference whitelists Linear/Conv weights).
+    The pruned axis is the last dim for ranks 2-3 and the input-channel
+    dim (axis 2, flax conv layout) for rank 4 — see dispatch_ranks."""
+    if p.ndim < 2:
+        return False
+    prune_axis = 2 if p.ndim == 4 else -1
+    if p.shape[prune_axis] % 4 != 0:
         return False
     name = "/".join(str(getattr(x, "key", getattr(x, "name", x)))
                     for x in path).lower()
@@ -54,10 +187,13 @@ def _default_allowed(path, p) -> bool:
 def compute_sparse_masks(params: Tree,
                          allowed: Callable = _default_allowed,
                          pattern: Callable = m4n2_mask_1d) -> Tree:
-    """Masks for every prunable leaf; ones elsewhere (ASP.compute_sparse_masks)."""
+    """Masks for every prunable leaf; ones elsewhere (ASP.compute_sparse_masks).
+    Leaves route through :func:`dispatch_ranks`, so any pattern —
+    including the 2d block calculators — applies to rank-1..4 leaves
+    (conv kernels prune along the input-channel dim)."""
     def mk(path, p):
         if jnp.issubdtype(p.dtype, jnp.floating) and allowed(path, p):
-            return pattern(p)
+            return dispatch_ranks(pattern, p)
         return jnp.ones_like(p)
     return jax.tree_util.tree_map_with_path(mk, params)
 

@@ -487,3 +487,92 @@ def test_one_optimizer_two_models_shared_step(opt_level):
         np.testing.assert_array_equal(
             np.asarray(new_both[name]["w"], np.float32),
             np.asarray(new_solo["w"], np.float32))
+
+
+# ---------------------------------------------------------------------------
+# Per-entry cast sweeps (reference test_basic_casts.py exercises EVERY
+# whitelist/blacklist entry)
+# ---------------------------------------------------------------------------
+
+_LOW_PREC_CASES = {
+    ("jax.numpy", "matmul"): lambda jnp_, a, b: jnp_.matmul(a, b),
+    ("jax.numpy", "dot"): lambda jnp_, a, b: jnp_.dot(a, b),
+    ("jax.numpy", "vdot"): lambda jnp_, a, b: jnp_.vdot(a, b),
+    ("jax.numpy", "inner"): lambda jnp_, a, b: jnp_.inner(a, b),
+    ("jax.numpy", "tensordot"): lambda jnp_, a, b: jnp_.tensordot(a, b, 1),
+    ("jax.numpy", "einsum"): lambda jnp_, a, b: jnp_.einsum("ij,jk->ik",
+                                                            a, b),
+    ("jax.lax", "dot"): lambda jnp_, a, b: jax.lax.dot(a, b),
+}
+
+
+@pytest.mark.parametrize("entry", sorted(_LOW_PREC_CASES),
+                         ids=lambda e: f"{e[0]}.{e[1]}")
+def test_autocast_each_whitelist_entry(entry):
+    """Every LOW_PREC (whitelist) table entry with a callable jnp-level
+    surface casts fp32 inputs down under autocast (test_basic_casts.py
+    analog; conv entries are covered by the flax-Conv integration test)."""
+    fn = _LOW_PREC_CASES[entry]
+    a = jnp.ones((4, 4), jnp.float32)
+    b = jnp.ones((4, 4), jnp.float32)
+    with amp.autocast(jnp.bfloat16):
+        out = fn(jnp, a, b)
+    assert out.dtype == jnp.bfloat16, entry
+
+
+_FP32_CASES = {
+    ("jax.nn", "softmax"): lambda x: jax.nn.softmax(x),
+    ("jax.nn", "log_softmax"): lambda x: jax.nn.log_softmax(x),
+    ("jax.nn", "logsumexp"): lambda x: jax.nn.logsumexp(x),
+    ("jax.scipy.special", "logsumexp"):
+        lambda x: jax.scipy.special.logsumexp(x),
+    ("jax.numpy", "exp"): lambda x: jnp.exp(x),
+    ("jax.numpy", "expm1"): lambda x: jnp.expm1(x),
+    ("jax.numpy", "log"): lambda x: jnp.log(jnp.abs(x) + 1),
+    ("jax.numpy", "log10"): lambda x: jnp.log10(jnp.abs(x) + 1),
+    ("jax.numpy", "log1p"): lambda x: jnp.log1p(jnp.abs(x)),
+    ("jax.numpy", "log2"): lambda x: jnp.log2(jnp.abs(x) + 1),
+    ("jax.numpy", "power"): lambda x: jnp.power(jnp.abs(x) + 1, 2.0),
+    ("jax.numpy", "float_power"): lambda x: jnp.float_power(
+        jnp.abs(x) + 1, 2.0),
+    ("jax.numpy", "cosh"): lambda x: jnp.cosh(x),
+    ("jax.numpy", "sinh"): lambda x: jnp.sinh(x),
+    ("jax.numpy", "tan"): lambda x: jnp.tan(x),
+    ("jax.numpy", "reciprocal"): lambda x: jnp.reciprocal(x + 2),
+    ("jax.lax", "rsqrt"): lambda x: jax.lax.rsqrt(jnp.abs(x) + 1),
+    ("jax.lax", "erf_inv"): lambda x: jax.lax.erf_inv(x * 0.1),
+    ("jax.numpy", "sum"): lambda x: jnp.sum(x),
+    ("jax.numpy", "prod"): lambda x: jnp.prod(x),
+    ("jax.numpy", "cumsum"): lambda x: jnp.cumsum(x),
+    ("jax.numpy", "cumprod"): lambda x: jnp.cumprod(x),
+    ("jax.numpy", "mean"): lambda x: jnp.mean(x),
+    ("jax.numpy", "var"): lambda x: jnp.var(x),
+    ("jax.numpy", "std"): lambda x: jnp.std(x),
+}
+
+
+@pytest.mark.parametrize("entry", sorted(_FP32_CASES),
+                         ids=lambda e: f"{e[0]}.{e[1]}")
+def test_autocast_each_blacklist_entry(entry):
+    """Every FP32 (blacklist) entry computes in fp32 under autocast even
+    with low-precision inputs — and the table stays in sync with this
+    sweep."""
+    fn = _FP32_CASES[entry]
+    x = jnp.linspace(0.1, 1.0, 16, dtype=jnp.bfloat16)
+    with amp.autocast(jnp.bfloat16):
+        out = fn(x)
+    assert out.dtype == jnp.float32, entry
+
+
+def test_cast_tables_fully_swept():
+    """Every policy-table entry is either in a sweep above or explicitly
+    accounted for (the conv/dot_general funnel entries are exercised via
+    flax Dense/Conv integration tests)."""
+    from apex_tpu.amp import lists
+    covered_low = set(_LOW_PREC_CASES)
+    funnel = {("jax.lax", "dot_general"),
+              ("jax.lax", "conv_general_dilated"),
+              ("jax.lax", "conv_with_general_padding"),
+              ("jax.lax", "conv")}
+    assert set(map(tuple, lists.LOW_PREC_FUNCS)) == covered_low | funnel
+    assert set(map(tuple, lists.FP32_FUNCS)) == set(_FP32_CASES)
