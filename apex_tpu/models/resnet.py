@@ -83,10 +83,12 @@ class ResNet(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = True):
         # SyncBatchNorm for BOTH paths: with axis_name=None it is a local
-        # fused BatchNorm (one-pass Pallas channel stats, torch momentum/
-        # unbiased-var conventions); with an axis name, stats sync over the
-        # mesh. Stats/normalization stay fp32 (keep_batchnorm_fp32) while
-        # the output re-enters the bf16 compute stream via dtype.
+        # fused BatchNorm (XLA-fused stats — measured faster than the
+        # opt-in Pallas stats kernel inside a full train step, see
+        # BASELINE.md dispatch-policy table — with torch momentum/
+        # unbiased-var conventions); with an axis name, stats sync over
+        # the mesh. Stats/normalization stay fp32 (keep_batchnorm_fp32)
+        # while the output re-enters the bf16 compute stream via dtype.
         def norm_def(scale_init=nn.initializers.ones, name=None):
             return SyncBatchNorm(
                 momentum=self.bn_momentum, axis_name=self.axis_name,

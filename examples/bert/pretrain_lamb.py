@@ -25,6 +25,12 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+# allow running this file directly: put the repo root on sys.path
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
 from apex_tpu import amp, optimizers, parallel
 from apex_tpu.contrib.optimizers import DistributedFusedLAMB
 from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
@@ -138,6 +144,8 @@ def main(argv=None):
 
     shard = NamedSharding(mesh, P("data"))
     key = jax.random.PRNGKey(args.seed + 1)
+    # time steady-state steps only (first iteration compiles)
+    warmup = min(2, max(args.steps - 1, 0))
     t0 = time.perf_counter()
     for i in range(args.steps):
         key, k1, k2 = jax.random.split(key, 3)
@@ -152,13 +160,16 @@ def main(argv=None):
                                                      sc_state, batch)
         else:
             params, st, loss = step_fn(params, st, batch)
+        if i + 1 == warmup:
+            jax.block_until_ready(loss)
+            t0 = time.perf_counter()
         if i % 5 == 0 or i == args.steps - 1:
             print(f"step {i:4d} mlm_loss {float(loss):.4f}")
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
-    tok_s = args.batch_size * args.seq_len * args.steps / dt
+    tok_s = args.batch_size * args.seq_len * (args.steps - warmup) / dt
     print(f"Speed: {tok_s:,.0f} tokens/s "
-          f"({args.model}, zero={args.zero})")
+          f"({args.model}, zero={args.zero}, excl. {warmup} warmup steps)")
 
 
 if __name__ == "__main__":

@@ -17,6 +17,12 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+# allow running this file directly: put the repo root on sys.path
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
 from apex_tpu import amp, optimizers, parallel
 from apex_tpu.models import Generator, Discriminator
 
@@ -126,6 +132,9 @@ def main(argv=None):
         out_specs=(rep, rep, rep), check_vma=False))
 
     shard = NamedSharding(mesh, P("data"))
+    # time steady-state steps only — the first iterations compile both
+    # jitted programs
+    warmup = min(3, max(args.steps - 1, 0))
     t0 = time.perf_counter()
     for i in range(args.steps):
         key, kz, kr = jax.random.split(key, 3)
@@ -135,13 +144,17 @@ def main(argv=None):
             jax.random.normal(kr, (args.batch_size, 64, 64, 3)), shard)
         pD, bsD, stD = d_jit(pD, bsD, stD, pG, bsG, real, z)
         pG, bsG, stG = g_jit(pG, bsG, stG, pD, bsD, z)
+        if i + 1 == warmup:
+            jax.block_until_ready(pG)
+            t0 = time.perf_counter()
         if i % 10 == 0:
             print(f"step {i}: D scale "
                   f"{[float(s) for s in stD.scaler.loss_scale]}, "
                   f"G scale {[float(s) for s in stG.scaler.loss_scale]}")
     jax.block_until_ready(pG)
     dt = time.perf_counter() - t0
-    print(f"Speed: {args.batch_size * args.steps / dt:.1f} img/s")
+    print(f"Speed: {args.batch_size * (args.steps - warmup) / dt:.1f} img/s "
+          f"(excl. {warmup} warmup steps)")
 
 
 if __name__ == "__main__":

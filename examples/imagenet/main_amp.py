@@ -28,6 +28,12 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+# allow running this file directly: put the repo root on sys.path
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
 from apex_tpu import amp, optimizers, parallel
 from apex_tpu import models
 from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
