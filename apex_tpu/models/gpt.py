@@ -15,6 +15,7 @@ and stays local, only the attention communicates. Pass ``pos_offset``
 from __future__ import annotations
 
 import functools
+import math
 from typing import Any, Optional
 
 import jax
@@ -67,10 +68,15 @@ class TransformerLM(nn.Module):
     dtype: Any = None
     seq_parallel: Optional[str] = None
     axis_name: Optional[str] = None
+    # Rematerialize each block in the backward (jax.checkpoint): activation
+    # memory drops from O(layers * S * D) to O(S * D), trading one extra
+    # forward per block — the standard long-context lever (SURVEY.md §7:
+    # "use jax.checkpoint / rematerialisation to trade FLOPs for memory").
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens, *, pos_offset=0, deterministic: bool = True,
-                 dropout_rng=None):
+                 dropout_rng=None, return_hidden: bool = False):
         b, s = tokens.shape
         emb = nn.Embed(self.vocab_size, self.embed_dim,
                        dtype=self.dtype, name="tok_emb")(tokens)
@@ -78,16 +84,64 @@ class TransformerLM(nn.Module):
         emb = emb + nn.Embed(self.max_seq, self.embed_dim,
                              dtype=self.dtype, name="pos_emb")(pos)[None]
         x = emb
+        block_cls = nn.remat(Block) if self.remat else Block
         for i in range(self.num_layers):
-            x = Block(self.embed_dim, self.num_heads, self.mlp_ratio,
-                      self.dropout, self.dtype, self.seq_parallel,
-                      self.axis_name, name=f"block_{i}")(
+            x = block_cls(self.embed_dim, self.num_heads, self.mlp_ratio,
+                          self.dropout, self.dtype, self.seq_parallel,
+                          self.axis_name, name=f"block_{i}")(
                 x, deterministic=deterministic, dropout_rng=dropout_rng)
         x = FusedLayerNorm(normalized_shape=self.embed_dim,
                            name="ln_f")(x).astype(x.dtype)
+        if return_hidden:
+            # final hidden states for chunked_next_token_loss: the LM head
+            # runs per sequence chunk there, so the full (S, vocab) logits
+            # never materialize (at 128k x 32k-vocab, fp32 logits alone
+            # are ~17 GB — the single-chip context cap without chunking)
+            return x
         logits = nn.Dense(self.vocab_size, dtype=self.dtype,
                           name="head")(x)
         return logits.astype(jnp.float32)
+
+
+def _shifted_targets(tokens, axis_name: Optional[str]):
+    """(targets, valid, den): next-token targets with the shard-boundary
+    shift, the validity mask (the last GLOBAL position has no target), and
+    the global target count. Dense: targets[:, i] = tokens[:, i+1], last
+    column invalid. Seq-parallel: each shard's final position predicts the
+    FIRST token of the NEXT shard (ppermuted in)."""
+    b, s_loc = tokens.shape
+    if axis_name is None:
+        targets = jnp.concatenate(
+            [tokens[:, 1:], tokens[:, :1]], axis=1)
+        col = jnp.arange(s_loc)
+        valid = jnp.broadcast_to(
+            jnp.where(col == s_loc - 1, 0.0, 1.0)[None, :], (b, s_loc))
+        return targets, valid, jnp.asarray(b * (s_loc - 1), jnp.float32)
+    world = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    # device r receives the first token of shard r+1 (source r+1 -> dest r)
+    perm = [((j + 1) % world, j) for j in range(world)]
+    nxt = jax.lax.ppermute(tokens[:, :1], axis_name, perm)
+    targets = jnp.concatenate([tokens[:, 1:], nxt], axis=1)   # (B, S_loc)
+    col = jnp.arange(s_loc)
+    valid = jnp.broadcast_to(
+        jnp.where((rank == world - 1) & (col == s_loc - 1),
+                  0.0, 1.0)[None, :], (b, s_loc))
+    den = jax.lax.psum(jnp.sum(valid), axis_name)
+    return targets, valid, den
+
+
+def _globalize(local, axis_name: Optional[str]):
+    """Replicated global VALUE, purely-LOCAL grad path: the psum rides
+    behind stop_gradient so the cotangent never crosses a collective
+    transpose (whose scaling depends on replication tracking). Each
+    device's grad is exactly its shard's contribution to the dense
+    objective — callers psum grads over ``axis_name`` for replicated
+    params."""
+    if axis_name is None:
+        return local
+    return local + jax.lax.stop_gradient(
+        jax.lax.psum(local, axis_name) - local)
 
 
 def next_token_loss(logits, tokens, axis_name: Optional[str] = None):
@@ -106,30 +160,54 @@ def next_token_loss(logits, tokens, axis_name: Optional[str] = None):
     objective on the gathered sequence.
     """
     from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
-    if axis_name is None:
-        return jnp.mean(
-            softmax_cross_entropy_loss(logits[:, :-1], tokens[:, 1:]))
-    world = jax.lax.axis_size(axis_name)
-    rank = jax.lax.axis_index(axis_name)
-    s_loc = tokens.shape[1]
-    # device r receives the first token of shard r+1 (source r+1 -> dest r)
-    perm = [((j + 1) % world, j) for j in range(world)]
-    nxt = jax.lax.ppermute(tokens[:, :1], axis_name, perm)
-    targets = jnp.concatenate([tokens[:, 1:], nxt], axis=1)   # (B, S_loc)
-    losses = softmax_cross_entropy_loss(logits, targets)      # (B, S_loc)
-    col = jnp.arange(s_loc)
-    valid = jnp.where((rank == world - 1) & (col == s_loc - 1),
-                      0.0, 1.0)[None, :]
-    den = jax.lax.psum(jnp.sum(valid * jnp.ones_like(losses)), axis_name)
+    targets, valid, den = _shifted_targets(tokens, axis_name)
+    losses = softmax_cross_entropy_loss(logits, targets)
     local = jnp.sum(losses * valid) / den
-    # Replicated global VALUE, purely-LOCAL grad path: the psum rides
-    # behind stop_gradient so the cotangent never crosses a collective
-    # transpose (whose scaling depends on replication tracking). Each
-    # device's grad is exactly its shard's contribution to the dense
-    # objective — callers psum grads over ``axis_name`` for replicated
-    # params.
-    return local + jax.lax.stop_gradient(
-        jax.lax.psum(local, axis_name) - local)
+    return _globalize(local, axis_name)
+
+
+def chunked_next_token_loss(hidden, head_params, tokens, *,
+                            chunk: int = 8192,
+                            axis_name: Optional[str] = None):
+    """:func:`next_token_loss` without ever materializing the full
+    (S, vocab) logits: the LM head matmul + softmax-xentropy run per
+    sequence chunk inside a ``jax.checkpoint``-wrapped ``lax.scan`` body,
+    so peak memory is O(chunk·vocab) forward AND backward (the backward
+    recomputes each chunk's logits). At 128k context x 32k vocab, fp32
+    logits alone are ~17 GB — past a single chip's HBM; chunking removes
+    that cap.
+
+    ``hidden``: (B, S, D) final hidden states
+    (``model.apply(..., return_hidden=True)``). ``head_params``: the head
+    Dense params dict ({'kernel': (D, vocab)[, 'bias': (vocab,)]}).
+    Same dense/seq-parallel target shifting as :func:`next_token_loss`.
+    """
+    from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+    b, s, d = hidden.shape
+    if s % chunk:
+        chunk = math.gcd(s, chunk)
+    n = s // chunk
+    targets, valid, den = _shifted_targets(tokens, axis_name)
+
+    hid = hidden.reshape(b, n, chunk, d).transpose(1, 0, 2, 3)
+    tgt = targets.reshape(b, n, chunk).transpose(1, 0, 2)
+    val = valid.reshape(b, n, chunk).transpose(1, 0, 2)
+    kernel = head_params["kernel"]
+    bias = head_params.get("bias")
+
+    @jax.checkpoint
+    def body(acc, xs):
+        h_c, t_c, v_c = xs
+        logits = h_c @ kernel.astype(h_c.dtype)
+        if bias is not None:
+            logits = logits + bias.astype(logits.dtype)
+        losses = softmax_cross_entropy_loss(
+            logits.astype(jnp.float32), t_c)
+        return acc + jnp.sum(losses * v_c), None
+
+    num, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                          (hid, tgt, val))
+    return _globalize(num / den, axis_name)
 
 
 GPTSmall = functools.partial(TransformerLM, num_layers=12, embed_dim=768,

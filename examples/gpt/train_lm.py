@@ -28,7 +28,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
 
 from apex_tpu import amp, optimizers, parallel
 from apex_tpu.models import TransformerLM
-from apex_tpu.models.gpt import next_token_loss
+from apex_tpu.models.gpt import chunked_next_token_loss, next_token_loss
 
 
 def parse_args(argv=None):
@@ -51,6 +51,16 @@ def parse_args(argv=None):
                         "communicates (ring ppermute / ulysses all-to-all),"
                         " the rest of the block is token-local")
     p.add_argument("--dropout", type=float, default=0.0)
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize blocks in the backward "
+                        "(jax.checkpoint): O(S*D) activation memory "
+                        "instead of O(layers*S*D) — for very long "
+                        "contexts on one chip")
+    p.add_argument("--loss-chunk", type=int, default=0,
+                   help="compute the LM head + xentropy per sequence "
+                        "chunk of this size (never materializing the "
+                        "(S, vocab) logits — at 128k x 32k vocab those "
+                        "are ~17 GB); 0 = full logits")
     p.add_argument("--seed", type=int, default=0)
     return p.parse_args(argv)
 
@@ -72,7 +82,8 @@ def main(argv=None):
         max_seq=args.seq_len, dropout=args.dropout,
         dtype=compute_dtype or jnp.float32,
         seq_parallel=args.seq_parallel,
-        axis_name="seq" if args.seq_parallel else None)
+        axis_name="seq" if args.seq_parallel else None,
+        remat=args.remat)
     # params are identical across seq_parallel settings; init a dense twin
     # (a mesh axis is not bound at init time)
     init_model = model.clone(seq_parallel=None, axis_name=None)
@@ -96,12 +107,22 @@ def main(argv=None):
         else:
             off = 0
 
+        loss_axis = axis if args.seq_parallel else None
+
         def scaled(p):
-            logits = model.apply(
-                {"params": p}, tokens, pos_offset=off,
-                deterministic=args.dropout == 0.0, dropout_rng=rng)
-            loss = next_token_loss(
-                logits, tokens, axis if args.seq_parallel else None)
+            if args.loss_chunk:
+                hidden = model.apply(
+                    {"params": p}, tokens, pos_offset=off,
+                    deterministic=args.dropout == 0.0, dropout_rng=rng,
+                    return_hidden=True)
+                loss = chunked_next_token_loss(
+                    hidden, p["head"], tokens, chunk=args.loss_chunk,
+                    axis_name=loss_axis)
+            else:
+                logits = model.apply(
+                    {"params": p}, tokens, pos_offset=off,
+                    deterministic=args.dropout == 0.0, dropout_rng=rng)
+                loss = next_token_loss(logits, tokens, loss_axis)
             return aopt.scale_loss(loss, opt_state), loss
 
         grads, loss = jax.grad(scaled, has_aux=True)(params)

@@ -202,3 +202,71 @@ def test_next_token_loss_seq_parallel_matches_dense(mesh):
     # logits are local — no cross-shard terms for the loss itself)
     np.testing.assert_allclose(np.asarray(sp_grad), np.asarray(dense_grad),
                                rtol=1e-5, atol=1e-7)
+
+
+def test_lm_remat_matches_no_remat():
+    """remat=True (jax.checkpoint per block) must not change values or
+    grads — only the backward's memory/recompute schedule."""
+    tokens = jax.random.randint(jax.random.PRNGKey(40), (2, 64), 0, 256)
+    m = GPTTiny(vocab_size=256, max_seq=64)
+    mr = GPTTiny(vocab_size=256, max_seq=64, remat=True)
+    v = m.init(jax.random.PRNGKey(41), tokens)
+
+    def loss(mod, p):
+        lg = mod.apply({"params": p}, tokens)
+        return next_token_loss(lg, tokens)
+
+    l1, g1 = jax.value_and_grad(lambda p: loss(m, p))(v["params"])
+    l2, g2 = jax.value_and_grad(lambda p: loss(mr, p))(v["params"])
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7), g1, g2)
+
+
+def test_chunked_next_token_loss_matches_dense():
+    """chunked_next_token_loss (per-chunk head + xent under
+    jax.checkpoint) must equal next_token_loss on full logits — value and
+    grads — in both dense and seq-parallel layouts."""
+    from apex_tpu.models.gpt import chunked_next_token_loss
+
+    tokens = jax.random.randint(jax.random.PRNGKey(50), (2, 64), 0, 256)
+    m = GPTTiny(vocab_size=256, max_seq=64)
+    v = m.init(jax.random.PRNGKey(51), tokens)
+
+    def full(p):
+        return next_token_loss(m.apply({"params": p}, tokens), tokens)
+
+    def chunked(p):
+        hid = m.apply({"params": p}, tokens, return_hidden=True)
+        return chunked_next_token_loss(hid, p["head"], tokens, chunk=16)
+
+    l1, g1 = jax.value_and_grad(full)(v["params"])
+    l2, g2 = jax.value_and_grad(chunked)(v["params"])
+    np.testing.assert_allclose(float(l2), float(l1), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6), g2, g1)
+
+
+def test_chunked_loss_seq_parallel(mesh):
+    from apex_tpu.models.gpt import chunked_next_token_loss
+
+    b, s, d, vocab = 2, NDEV * 16, 32, 64
+    tokens = jax.random.randint(jax.random.PRNGKey(52), (b, s), 0, vocab)
+    hidden = jax.random.normal(jax.random.PRNGKey(53), (b, s, d))
+    head = {"kernel": jax.random.normal(jax.random.PRNGKey(54), (d, vocab))
+            * 0.1, "bias": jnp.zeros((vocab,))}
+
+    want = float(next_token_loss(
+        hidden @ head["kernel"] + head["bias"], tokens))
+
+    def per_device(h_, t_):
+        return chunked_next_token_loss(h_, head, t_, chunk=8,
+                                       axis_name="seq")
+
+    got = jax.jit(shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(None, "seq", None), P(None, "seq")),
+        out_specs=P(), check_vma=False))(hidden, tokens)
+    np.testing.assert_allclose(float(got), want, rtol=1e-5)
