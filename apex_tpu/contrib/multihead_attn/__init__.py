@@ -73,13 +73,15 @@ def _mask_to_bias(attn_mask):
     m = jnp.asarray(attn_mask)
     if m.dtype == jnp.bool_:
         m = jnp.where(m, MASK_BIAS, 0.0)
+    if m.ndim == 1:            # (sk,) key-padding -> broadcast everywhere
+        return m[None, None, None]
     if m.ndim == 2:            # (sq, sk)
         return m[None, None]
     if m.ndim == 3:            # (b, sq, sk) -> broadcast over heads
         return m[:, None]
     if m.ndim == 4:
         return m
-    raise ValueError(f"attn_mask must be rank 2-4, got shape {m.shape}")
+    raise ValueError(f"attn_mask must be rank 1-4, got shape {m.shape}")
 
 
 def _derive_seed(rng, module_path):
@@ -189,8 +191,11 @@ class SelfMultiheadAttn(nn.Module):
                 row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
                 col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
                 s = jnp.where(col <= row, s, -1e30)
+            # Same rank normalization as the fast path: a rank-3 (b, sq, sk)
+            # mask gains the head axis instead of broadcasting against it
+            # (ADVICE r2: the raw add raised or silently misaligned b vs h).
             p = masked_softmax_dropout(
-                s, mask=attn_mask, dropout_rate=self.dropout,
+                s, mask=_mask_to_bias(attn_mask), dropout_rate=self.dropout,
                 rng=dropout_rng, deterministic=deterministic)
             ctx = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
@@ -244,7 +249,7 @@ class EncdecMultiheadAttn(nn.Module):
             s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                            preferred_element_type=jnp.float32) * scale
             p = masked_softmax_dropout(
-                s, mask=attn_mask, dropout_rate=self.dropout,
+                s, mask=_mask_to_bias(attn_mask), dropout_rate=self.dropout,
                 rng=dropout_rng, deterministic=deterministic)
             ctx = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 

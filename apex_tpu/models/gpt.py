@@ -34,10 +34,18 @@ class Block(nn.Module):
     dtype: Any = None
     seq_parallel: Optional[str] = None
     axis_name: Optional[str] = None
+    # ``deterministic`` can be fixed at construction time so that under
+    # ``nn.remat`` it never becomes a traced argument (a traced bool cannot
+    # drive the Python-level dropout branch in SelfMultiheadAttn). The
+    # call-time kwarg still works for the non-remat path and wins when given.
+    deterministic: Optional[bool] = None
 
     @nn.compact
-    def __call__(self, x, *, deterministic: bool = True,
+    def __call__(self, x, *, deterministic: Optional[bool] = None,
                  dropout_rng=None):
+        det = self.deterministic if deterministic is None else deterministic
+        if det is None:
+            det = True
         e = self.embed_dim
         h = SelfMultiheadAttn(
             embed_dim=e, num_heads=self.num_heads, dropout=self.dropout,
@@ -45,7 +53,7 @@ class Block(nn.Module):
             axis_name=self.axis_name, name="attn")(
             FusedLayerNorm(normalized_shape=e, name="ln1")(x)
             .astype(x.dtype),
-            deterministic=deterministic, dropout_rng=dropout_rng)
+            deterministic=det, dropout_rng=dropout_rng)
         x = x + h
         y = FusedLayerNorm(normalized_shape=e, name="ln2")(x).astype(x.dtype)
         y = nn.Dense(self.mlp_ratio * e, dtype=self.dtype, name="fc1")(y)
@@ -84,12 +92,15 @@ class TransformerLM(nn.Module):
         emb = emb + nn.Embed(self.max_seq, self.embed_dim,
                              dtype=self.dtype, name="pos_emb")(pos)[None]
         x = emb
+        # deterministic is baked into the module (static) rather than passed
+        # per call: under nn.remat a call kwarg is traced, and a traced bool
+        # cannot select the dropout branch (ADVICE r2: remat+dropout crash).
         block_cls = nn.remat(Block) if self.remat else Block
         for i in range(self.num_layers):
             x = block_cls(self.embed_dim, self.num_heads, self.mlp_ratio,
                           self.dropout, self.dtype, self.seq_parallel,
-                          self.axis_name, name=f"block_{i}")(
-                x, deterministic=deterministic, dropout_rng=dropout_rng)
+                          self.axis_name, deterministic=deterministic,
+                          name=f"block_{i}")(x, dropout_rng=dropout_rng)
         x = FusedLayerNorm(normalized_shape=self.embed_dim,
                            name="ln_f")(x).astype(x.dtype)
         if return_hidden:
@@ -184,10 +195,20 @@ def chunked_next_token_loss(hidden, head_params, tokens, *,
     """
     from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
     b, s, d = hidden.shape
-    if s % chunk:
-        chunk = math.gcd(s, chunk)
-    n = s // chunk
     targets, valid, den = _shifted_targets(tokens, axis_name)
+    chunk = min(chunk, s)
+    if s % chunk:
+        # Pad the sequence to a whole number of chunks instead of shrinking
+        # the chunk (a gcd fallback degrades to chunk=1 for prime S, turning
+        # the scan into S tiny head matmuls). Padded positions carry
+        # valid=0, so they contribute nothing; ``den`` above is already the
+        # unpadded target count.
+        pad = chunk - s % chunk
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        valid = jnp.pad(valid, ((0, 0), (0, pad)))
+        s = s + pad
+    n = s // chunk
 
     hid = hidden.reshape(b, n, chunk, d).transpose(1, 0, 2, 3)
     tgt = targets.reshape(b, n, chunk).transpose(1, 0, 2)

@@ -426,6 +426,31 @@ def test_self_mha_masked_fast_path():
                                atol=1e-6)
 
 
+@pytest.mark.parametrize("b", [2, 4])  # b=4=h: the silent-misalignment case
+def test_self_mha_rank3_mask_both_impls(b):
+    """A rank-3 (b, sq, sk) attn_mask must mean the same thing on both
+    impls: broadcast over HEADS (ADVICE r2: the default path added it raw,
+    raising a broadcast error — or, when b == h, silently aligning the
+    batch dim against the heads dim)."""
+    e, h, s = 64, 4, 32
+    x = jax.random.normal(jax.random.PRNGKey(60), (b, s, e))
+    # per-BATCH additive mask: distinct rows so a b-vs-h mixup changes values
+    mask = jnp.where(
+        jnp.arange(s)[None, None, :] < (s - 8 * jnp.arange(1, b + 1))[:, None, None],
+        0.0, -3e4)
+    m_fast = SelfMultiheadAttn(embed_dim=e, num_heads=h, impl="fast")
+    m_def = SelfMultiheadAttn(embed_dim=e, num_heads=h, impl="default")
+    params = m_fast.init(jax.random.PRNGKey(61), x)
+    y_fast = m_fast.apply(params, x, attn_mask=mask)
+    y_def = m_def.apply(params, x, attn_mask=mask)
+    np.testing.assert_allclose(np.asarray(y_fast), np.asarray(y_def),
+                               rtol=2e-4, atol=2e-4)
+    # and it must equal the explicit rank-4 head-broadcast form
+    y_r4 = m_def.apply(params, x, attn_mask=mask[:, None])
+    np.testing.assert_allclose(np.asarray(y_def), np.asarray(y_r4),
+                               rtol=1e-6, atol=1e-7)
+
+
 def test_encdec_mha_masked_fast_path():
     e, h = 32, 2
     q = jax.random.normal(jax.random.PRNGKey(46), (2, 24, e))

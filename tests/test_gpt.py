@@ -224,6 +224,59 @@ def test_lm_remat_matches_no_remat():
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7), g1, g2)
 
 
+def test_lm_remat_composes_with_dropout():
+    """remat + dropout>0 must train (ADVICE r2: nn.remat turned the
+    ``deterministic`` kwarg into a tracer and the dropout branch crashed).
+    With the same dropout rng, remat and no-remat draw identical masks, so
+    values and grads must match exactly."""
+    tokens = jax.random.randint(jax.random.PRNGKey(60), (2, 64), 0, 256)
+    m = GPTTiny(vocab_size=256, max_seq=64, dropout=0.1)
+    mr = GPTTiny(vocab_size=256, max_seq=64, dropout=0.1, remat=True)
+    v = m.init(jax.random.PRNGKey(61), tokens)
+    rng = jax.random.PRNGKey(62)
+
+    def loss(mod, p):
+        lg = mod.apply({"params": p}, tokens, deterministic=False,
+                       dropout_rng=rng)
+        return next_token_loss(lg, tokens)
+
+    l1, g1 = jax.value_and_grad(lambda p: loss(m, p))(v["params"])
+    l2, g2 = jax.value_and_grad(lambda p: loss(mr, p))(v["params"])
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7), g1, g2)
+
+
+def test_chunked_loss_ragged_seq_pads():
+    """S not divisible by chunk pads the tail instead of shrinking the
+    chunk (ADVICE r2: the gcd fallback degraded to chunk=1 for prime S).
+    Value and grads must still match the dense loss."""
+    from apex_tpu.models.gpt import chunked_next_token_loss
+
+    b, s, d, vocab = 2, 61, 32, 64   # s prime: old gcd fallback -> chunk=1
+    tokens = jax.random.randint(jax.random.PRNGKey(70), (b, s), 0, vocab)
+    hidden = jax.random.normal(jax.random.PRNGKey(71), (b, s, d))
+    head = {"kernel": jax.random.normal(jax.random.PRNGKey(72), (d, vocab))
+            * 0.1, "bias": jnp.zeros((vocab,))}
+
+    def dense(h_):
+        return next_token_loss(h_ @ head["kernel"] + head["bias"], tokens)
+
+    def chunked(h_):
+        return chunked_next_token_loss(h_, head, tokens, chunk=16)
+
+    l1, g1 = jax.value_and_grad(dense)(hidden)
+    l2, g2 = jax.value_and_grad(chunked)(hidden)
+    np.testing.assert_allclose(float(l2), float(l1), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g2), np.asarray(g1),
+                               rtol=2e-5, atol=1e-6)
+    # scan length is ceil(s/chunk), not s (the degraded-chunk failure mode)
+    jaxpr = jax.make_jaxpr(chunked)(hidden)
+    scans = [e for e in jaxpr.jaxpr.eqns if e.primitive.name == "scan"]
+    assert scans and scans[0].params["length"] == 4
+
+
 def test_chunked_next_token_loss_matches_dense():
     """chunked_next_token_loss (per-chunk head + xent under
     jax.checkpoint) must equal next_token_loss on full logits — value and
