@@ -71,23 +71,36 @@ class ZeroState(NamedTuple):
     exp_avg_sq: jax.Array  # (padded_total,) f32 — shard over axis
 
 
-def _flatten_f32(tree: Tree, pad_to: int) -> Tuple[jax.Array, Any]:
-    leaves, treedef = jax.tree_util.tree_flatten(tree)
+def _bucket_flat(leaves, idxs, pad_to: int) -> jax.Array:
+    """Concat ONLY the given leaves (f32, raveled) and zero-pad to pad_to.
+    Keeping the concat per bucket — not per tree — is what lets each
+    bucket's reduce-scatter depend on a prefix of backward instead of all
+    of it (the reference's chunked async reduce_scatter overlap,
+    distributed_fused_adam.py:297-331)."""
     flat = jnp.concatenate(
-        [l.astype(jnp.float32).reshape(-1) for l in leaves])
+        [leaves[i].astype(jnp.float32).reshape(-1) for i in idxs])
     n = flat.shape[0]
     if pad_to > n:
         flat = jnp.pad(flat, (0, pad_to - n))
-    return flat, treedef
+    return flat
 
 
 class _ZeroBase(FusedOptimizer):
-    """Shared flatten/scatter/gather plumbing."""
+    """Shared flatten/scatter/gather plumbing.
+
+    State layout: params partition into contiguous-leaf *buckets* of at
+    most ``chunk_elements`` elements; each bucket pads to a multiple of
+    ``shard_count`` and shards over ``axis_name``. A device's local state
+    is the concatenation of its shard of every bucket, so the global flat
+    array (what ``P(axis_name)`` sees) is bucket-shard-interleaved — init,
+    scatter, gather, and the position/segment maps all speak this layout.
+    """
 
     def __init__(self, *, axis_name: str = "data",
                  shard_count: Optional[int] = None,
                  group_axis: Optional[str] = None,
-                 allgather_dtype=None, param_groups=None):
+                 allgather_dtype=None, param_groups=None,
+                 chunk_elements: int = 2 ** 23):
         self.axis_name = axis_name
         self._shard_count = shard_count  # resolved lazily from the mesh
         # Mesh axis ACROSS which optimizer state is replicated (the
@@ -95,6 +108,10 @@ class _ZeroBase(FusedOptimizer):
         # (within the subgroup) and allreduced over group_axis.
         self.group_axis = group_axis
         self.allgather_dtype = allgather_dtype
+        # Bucket capacity (elements) for the overlap-friendly chunked
+        # reduce-scatter/all-gather (reference dwu chunking,
+        # distributed_fused_adam.py:297-331). <=0: one whole-tree bucket.
+        self.chunk_elements = chunk_elements
         self._spec_cache = None
         self._init_groups(param_groups)
 
@@ -126,7 +143,20 @@ class _ZeroBase(FusedOptimizer):
         offsets = np.cumsum([0] + sizes[:-1])
         total = int(sum(sizes))
         n = self.shard_count
-        padded = ((total + n - 1) // n) * n
+        # Contiguous-leaf buckets of at most chunk_elements each; a single
+        # oversize leaf forms its own bucket (leaves never split).
+        runs = _buckets.partition_by_capacity(sizes, self.chunk_elements)
+        buckets = []
+        for idxs in runs:
+            size_b = int(sum(sizes[i] for i in idxs))
+            padded_b = ((size_b + n - 1) // n) * n
+            buckets.append(dict(
+                idxs=tuple(idxs),
+                start=int(offsets[idxs[0]]),   # canonical flat offset
+                size=size_b,
+                padded=padded_b,
+                k=padded_b // n))              # local shard elements
+        padded = int(sum(b["padded"] for b in buckets))
         # Per-tensor param-group assignment (index into override table).
         group_of_tensor = np.zeros((len(leaves),), np.int32)
         overrides: list = [{}]
@@ -149,7 +179,7 @@ class _ZeroBase(FusedOptimizer):
                     group_of_tensor[i] = gi
         self._spec_cache = dict(
             treedef=treedef, shapes=shapes, sizes=sizes,
-            offsets=offsets, total=total, padded=padded,
+            offsets=offsets, total=total, padded=padded, buckets=buckets,
             dtypes=[l.dtype for l in leaves],
             group_of_tensor=group_of_tensor, group_overrides=overrides)
         return self._spec_cache
@@ -185,11 +215,21 @@ class _ZeroBase(FusedOptimizer):
 
     # -- state -------------------------------------------------------------
     def init(self, params: Tree) -> ZeroState:
+        """Build the GLOBAL state arrays in the bucket-shard-interleaved
+        layout: global[r*K : (r+1)*K] is device r's shard, itself the
+        concat of that device's slice of every bucket. Sharding the result
+        with ``P(axis_name)`` therefore hands each device exactly the
+        slices ``step`` expects."""
         spec = self._pack(params)
-        flat, _ = _flatten_f32(params, spec["padded"])
+        leaves = jax.tree_util.tree_leaves(params)
+        n = self.shard_count
+        cols = [_bucket_flat(leaves, b["idxs"], b["padded"])
+                .reshape(n, b["k"]) for b in spec["buckets"]]
+        master = (cols[0] if len(cols) == 1
+                  else jnp.concatenate(cols, axis=1)).reshape(-1)
         return ZeroState(
             step=jnp.zeros((), jnp.int32),
-            master=flat,
+            master=master,
             exp_avg=jnp.zeros((spec["padded"],), jnp.float32),
             exp_avg_sq=jnp.zeros((spec["padded"],), jnp.float32),
         )
@@ -200,43 +240,63 @@ class _ZeroBase(FusedOptimizer):
         data-parallel world).
 
         The analog of the chunked async reduce_scatter at
-        distributed_fused_adam.py:297-331; with ``group_axis`` set this is
-        reduce-scatter within the subgroup + allreduce across subgroups
-        (the dwu_group_size two-level scheme, :251-289)."""
+        distributed_fused_adam.py:297-331 — and, as of r3, with the same
+        overlap property: each bucket's psum_scatter consumes a concat of
+        only that bucket's leaves, so XLA can issue it as soon as those
+        gradients exist. With ``group_axis`` set this is reduce-scatter
+        within the subgroup + allreduce across subgroups (the
+        dwu_group_size two-level scheme, :251-289)."""
         self._check_axes()
-        flat, _ = _flatten_f32(grads, spec["padded"])
+        leaves = jax.tree_util.tree_leaves(grads)
         world = jax.lax.axis_size(self.axis_name)
-        shard = jax.lax.psum_scatter(
-            flat, self.axis_name, scatter_dimension=0, tiled=True)
         if self.group_axis is not None:
-            shard = jax.lax.psum(shard, self.group_axis)
             world = world * jax.lax.axis_size(self.group_axis)
+        shards = []
+        for b in spec["buckets"]:
+            flat = _bucket_flat(leaves, b["idxs"], b["padded"])
+            sh = jax.lax.psum_scatter(
+                flat, self.axis_name, scatter_dimension=0, tiled=True)
+            if self.group_axis is not None:
+                sh = jax.lax.psum(sh, self.group_axis)
+            shards.append(sh)
+        shard = shards[0] if len(shards) == 1 else jnp.concatenate(shards)
         return shard / world
 
     def _gather_params(self, master_shard: jax.Array, spec,
                        params: Tree) -> Tree:
         """Local updated shard -> replicated param tree (the parameter
         all_gather at distributed_fused_adam.py:392-407; optionally in a
-        compressed dtype like the e5m2 allgather flag). Gathers over
-        ``axis_name`` only — with group_axis, every subgroup already holds
-        identical shards."""
-        send = master_shard
-        if self.allgather_dtype is not None:
-            send = send.astype(self.allgather_dtype)
-        flat = jax.lax.all_gather(send, self.axis_name, tiled=True)
-        leaves = []
-        for off, size, shape, dt in zip(spec["offsets"], spec["sizes"],
-                                        spec["shapes"], spec["dtypes"]):
-            leaves.append(
-                jax.lax.dynamic_slice_in_dim(flat, int(off), size)
-                .reshape(shape).astype(dt))
+        compressed dtype like the e5m2 allgather flag). One all_gather per
+        bucket: XLA can overlap a bucket's gather with the unflatten (and
+        the next step's forward) of previously gathered buckets. Gathers
+        over ``axis_name`` only — with group_axis, every subgroup already
+        holds identical shards."""
+        leaves: list = [None] * len(spec["sizes"])
+        off = 0
+        for b in spec["buckets"]:
+            piece = jax.lax.slice_in_dim(master_shard, off, off + b["k"])
+            off += b["k"]
+            if self.allgather_dtype is not None:
+                piece = piece.astype(self.allgather_dtype)
+            flat = jax.lax.all_gather(piece, self.axis_name, tiled=True)
+            for i in b["idxs"]:
+                rel = int(spec["offsets"][i]) - b["start"]
+                leaves[i] = (
+                    jax.lax.slice_in_dim(flat, rel, rel + spec["sizes"][i])
+                    .reshape(spec["shapes"][i]).astype(spec["dtypes"][i]))
         return jax.tree_util.tree_unflatten(spec["treedef"], leaves)
 
     def _shard_positions(self, spec) -> jax.Array:
-        """Global flat indices covered by this device's shard."""
-        k = spec["padded"] // jax.lax.axis_size(self.axis_name)
+        """CANONICAL flat index (tensor-order concat, no padding) of each
+        element of this device's shard; bucket-padding elements map to the
+        out-of-range sentinel ``total`` so ``pos < total`` masks them."""
         r = jax.lax.axis_index(self.axis_name)
-        return r * k + jnp.arange(k)
+        parts = []
+        for b in spec["buckets"]:
+            q = r * b["k"] + jnp.arange(b["k"])
+            parts.append(jnp.where(q < b["size"], b["start"] + q,
+                                   spec["total"]))
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
 
     def _shard_segments(self, spec) -> jax.Array:
         """Per-element tensor index over this device's shard (static tensor
@@ -282,11 +342,12 @@ class DistributedFusedAdam(_ZeroBase):
                  adam_w_mode: bool = True, weight_decay: float = 0.0,
                  axis_name: str = "data", shard_count: Optional[int] = None,
                  group_axis: Optional[str] = None, allgather_dtype=None,
-                 param_groups=None):
+                 param_groups=None, chunk_elements: int = 2 ** 23):
         super().__init__(axis_name=axis_name, shard_count=shard_count,
                          group_axis=group_axis,
                          allgather_dtype=allgather_dtype,
-                         param_groups=param_groups)
+                         param_groups=param_groups,
+                         chunk_elements=chunk_elements)
         self.lr = lr
         self.bias_correction = bias_correction
         self.betas = betas
@@ -342,11 +403,12 @@ class DistributedFusedLAMB(_ZeroBase):
                  use_nvlamb: bool = False, axis_name: str = "data",
                  shard_count: Optional[int] = None,
                  group_axis: Optional[str] = None, allgather_dtype=None,
-                 param_groups=None):
+                 param_groups=None, chunk_elements: int = 2 ** 23):
         super().__init__(axis_name=axis_name, shard_count=shard_count,
                          group_axis=group_axis,
                          allgather_dtype=allgather_dtype,
-                         param_groups=param_groups)
+                         param_groups=param_groups,
+                         chunk_elements=chunk_elements)
         self.lr = lr
         self.bias_correction = bias_correction
         self.betas = betas

