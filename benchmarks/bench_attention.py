@@ -25,35 +25,50 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def timeit(fn, q, k, v, iters=25):
-    """Time `iters` dependency-chained executions inside ONE jitted
-    lax.scan: each iteration's q depends on the previous output, so the
-    device must run them back to back — independent async dispatches over
-    a remote-device tunnel otherwise report scheduling time, not compute
-    (times that don't scale with s^2 give it away)."""
-    def chained(q_, k_, v_, eps):
-        def body(carry, _):
-            out = fn(carry, k_, v_)
-            leaf = jax.tree_util.tree_leaves(out)[0]
-            # eps is a RUNTIME zero: the multiply can't be constant-folded,
-            # so every iteration's kernel must actually run, while the
-            # carry value stays exactly q
-            return carry + eps * leaf.astype(carry.dtype), ()
-        final, _ = jax.lax.scan(body, q_, None, length=iters)
-        return final
-    run = jax.jit(chained)
-    jax.block_until_ready(run(q, k, v, jnp.zeros((), q.dtype)))  # compile
-    out = run(q, k, v, jnp.float32(1e-29).astype(q.dtype))       # warm the
-    np.asarray(out[0, 0, 0, :1])                                 # timed path
-    # each timed call gets a DISTINCT eps: identical (fn, args) executions
-    # can be served from a result cache by a remote-device transport, which
-    # would time the replay, not the kernels
-    reps = 2
-    t0 = time.perf_counter()
-    for i in range(reps):
-        out = run(q, k, v, jnp.float32(1e-30 * (i + 1)).astype(q.dtype))
-        np.asarray(out[0, 0, 0, :1])               # hard host sync
-    return (time.perf_counter() - t0) / (iters * reps)
+def timeit(fn, q, k, v, iters=(5, 55)):
+    """Per-iteration DEVICE time via two dependency-chained lax.scan runs
+    of different lengths: slope = (t_long - t_short) / (n_long - n_short).
+
+    Each iteration's q depends on the previous output, so the device runs
+    them back to back — independent async dispatches over a remote-device
+    tunnel otherwise report scheduling time, not compute. The two-length
+    slope then cancels the PER-DISPATCH overhead as well: over the axon
+    tunnel a single executable launch + sync costs ~120 ms wall
+    regardless of scan length (measured r3, jax.profiler trace: device
+    busy 53 ms of 174 ms wall for 25 fwd iters), which at fixed iters
+    silently added ~4.8 ms/iter to every r2 kernel number."""
+    def chained(n):
+        def run(q_, k_, v_, eps):
+            def body(carry, _):
+                out = fn(carry, k_, v_)
+                leaf = jax.tree_util.tree_leaves(out)[0]
+                # eps is a RUNTIME zero: the multiply can't be constant-
+                # folded, so every iteration's kernel must actually run,
+                # while the carry value stays exactly q
+                return carry + eps * leaf.astype(carry.dtype), ()
+            final, _ = jax.lax.scan(body, q_, None, length=n)
+            return final
+        return jax.jit(run)
+
+    n_short, n_long = iters
+
+    def measure(run, eps_base):
+        jax.block_until_ready(run(q, k, v, jnp.zeros((), q.dtype)))
+        out = run(q, k, v, jnp.float32(eps_base).astype(q.dtype))
+        np.asarray(out[0, 0, 0, :1])                 # warm the timed path
+        # each timed call gets a DISTINCT eps: identical (fn, args)
+        # executions can be served from a result cache by a remote-device
+        # transport, which would time the replay, not the kernels
+        reps, t0 = 2, time.perf_counter()
+        for i in range(reps):
+            out = run(q, k, v,
+                      jnp.float32(eps_base * (i + 2)).astype(q.dtype))
+            np.asarray(out[0, 0, 0, :1])             # hard host sync
+        return (time.perf_counter() - t0) / reps
+
+    t_short = measure(chained(n_short), 1e-30)
+    t_long = measure(chained(n_long), 1e-29)
+    return (t_long - t_short) / (n_long - n_short)
 
 
 def main():
