@@ -71,6 +71,31 @@ class BottleneckBlock(nn.Module):
         return nn.relu(residual + y)
 
 
+def space_to_depth(x: jax.Array, block: int = 2) -> jax.Array:
+    """(N, H, W, C) -> (N, H/b, W/b, b*b*C), depth ordered (row-in-block,
+    col-in-block, channel). The standard TPU input transform: a stride-2
+    conv on a C=3 image keeps only 3 of 128 MXU lanes busy; after
+    space-to-depth the stem contracts over b*b*... channels instead."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        n, h // block, w // block, block * block * c)
+
+
+def conv7_to_s2d_kernel(k7: jax.Array) -> jax.Array:
+    """Map a (7, 7, C, O) stride-2 stem kernel to the exactly-equivalent
+    (4, 4, 4C, O) kernel for the ``space_to_depth`` stem (block 2).
+
+    out[p,q] = sum_{u,v,c} k7[u,v,c] x[2p-3+u, 2q-3+v, c]: pad the kernel
+    to 8x8 with a zero top row/left column (u' = u+1, so 2p-4+u'), split
+    u' = 2a+i into block index a and in-block row i, and the sum becomes a
+    4x4 stride-1 conv over s2d blocks p-2..p+1 — i.e. padding (2, 1)."""
+    k8 = jnp.pad(k7, ((1, 0), (1, 0), (0, 0), (0, 0)))
+    c, o = k7.shape[2], k7.shape[3]
+    return (k8.reshape(4, 2, 4, 2, c, o).transpose(0, 2, 1, 3, 4, 5)
+            .reshape(4, 4, 4 * c, o))
+
+
 class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     block_cls: ModuleDef
@@ -79,6 +104,10 @@ class ResNet(nn.Module):
     dtype: Any = jnp.float32
     axis_name: Optional[str] = None   # set to sync BN stats over a mesh axis
     bn_momentum: float = 0.1
+    # "conv7": the reference 7x7/2 stem. "space_to_depth": the TPU MLPerf
+    # stem — input space-to-depth (2x2 blocks) + an equivalent 4x4/1 conv
+    # (see conv7_to_s2d_kernel for the exact weight correspondence).
+    stem: str = "conv7"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -95,9 +124,18 @@ class ResNet(nn.Module):
                 use_running_average=not train, dtype=self.dtype,
                 scale_init=scale_init, name=name)
 
-        x = nn.Conv(self.num_filters, (7, 7), (2, 2),
-                    padding=[(3, 3), (3, 3)], use_bias=False,
-                    dtype=self.dtype, name="conv_init")(x)
+        if self.stem == "space_to_depth":
+            x = space_to_depth(x, 2)
+            x = nn.Conv(self.num_filters, (4, 4), (1, 1),
+                        padding=[(2, 1), (2, 1)], use_bias=False,
+                        dtype=self.dtype, name="conv_init")(x)
+        elif self.stem == "conv7":
+            x = nn.Conv(self.num_filters, (7, 7), (2, 2),
+                        padding=[(3, 3), (3, 3)], use_bias=False,
+                        dtype=self.dtype, name="conv_init")(x)
+        else:
+            raise ValueError(f"stem must be 'conv7' or 'space_to_depth', "
+                             f"got {self.stem!r}")
         x = norm_def(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

@@ -38,6 +38,50 @@ def analyze(fn: Callable, *args, static_argnums=(), **kwargs) -> Dict[str, Any]:
     return out
 
 
+# Peak dense bf16 FLOP/s per chip by device_kind substring (roofline
+# denominator for MFU; override with APEX_TPU_PEAK_FLOPS for new chips).
+PEAK_BF16 = [
+    ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5p", 459e12), ("v4", 275e12), ("v6", 918e12),
+]
+
+
+def device_peak_flops(device=None) -> float:
+    """Peak dense bf16 FLOP/s of ``device`` (default: first local device).
+    Unlisted chips fall back to APEX_TPU_PEAK_FLOPS (or the legacy
+    BENCH_PEAK_FLOPS) and finally the v5e figure."""
+    import os
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, peak in PEAK_BF16:
+        if sub in kind:
+            return peak
+    return float(os.environ.get("APEX_TPU_PEAK_FLOPS",
+                                os.environ.get("BENCH_PEAK_FLOPS", 197e12)))
+
+
+def xla_flops(jitted_fn, *args, **kwargs) -> Optional[float]:
+    """Model FLOPs of one execution of a jitted function, from XLA's cost
+    analysis of the compiled executable — the honest MFU numerator (no
+    hand-assumed per-model GFLOP constants). Returns None (with a stderr
+    note) where the backend exposes no cost model or the args mismatch.
+
+    Note: ``lower().compile()`` is an AOT compile that bypasses the jit
+    dispatch cache — call this BEFORE the timed region (XLA's own compile
+    cache usually makes the second compile of an identical program cheap,
+    but that is backend-dependent)."""
+    import sys
+    try:
+        cost = jitted_fn.lower(*args, **kwargs).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        return float(cost.get("flops", 0.0)) or None
+    except Exception as e:
+        print(f"pyprof.xla_flops: cost analysis unavailable: {e!r}",
+              file=sys.stderr)
+        return None
+
+
 def summarize_trace(path_or_logdir: str, *, top: int = 25) -> str:
     """Offline per-op report from a captured profiler trace — the
     reference's ``python -m apex.pyprof.prof`` stage (prof/__main__.py:

@@ -34,19 +34,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 BASELINE_IMG_S = 900.0
 
-# Peak dense bf16 FLOP/s per chip by device_kind substring.
-PEAK_BF16 = [
-    ("v5 lite", 197e12), ("v5e", 197e12),
-    ("v5p", 459e12), ("v4", 275e12), ("v6", 918e12),
-]
-
 
 def peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "").lower()
-    for sub, peak in PEAK_BF16:
-        if sub in kind:
-            return peak
-    return float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
+    from apex_tpu.pyprof import device_peak_flops
+    return device_peak_flops(device)
 
 
 def log(*a):
@@ -74,7 +65,13 @@ def main():
     # numerics of apex O2/O5). Model weights are the bf16 replicas from
     # amp.cast_model; fp32 masters live in the optimizer state.
     compute_dtype = jnp.bfloat16
-    model = models.ResNet50(num_classes=1000, dtype=compute_dtype)
+    # BENCH_STEM=s2d swaps the 7x7/2 stem for the space-to-depth 4x4/1
+    # form (the TPU MLPerf input transform; exact-equivalence mapping in
+    # models.resnet.conv7_to_s2d_kernel).
+    stem = ("space_to_depth" if os.environ.get("BENCH_STEM") == "s2d"
+            else "conv7")
+    model = models.ResNet50(num_classes=1000, dtype=compute_dtype,
+                            stem=stem)
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.ones((2, image, image, 3)), train=False)
     params32, batch_stats = variables["params"], variables["batch_stats"]
@@ -156,15 +153,9 @@ def main():
 
     # Model FLOPs per step from XLA's cost analysis of the compiled step
     # (the honest numerator for MFU; no hand-assumed GFLOP/img constant).
-    flops_per_step = None
-    try:
-        cost = step_fn.lower(
-            params, batch_stats, opt_state, (x, y)).compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
-        flops_per_step = float(cost.get("flops", 0.0)) or None
-    except Exception as e:  # cost analysis unavailable on some backends
-        log(f"cost_analysis unavailable: {e}")
+    from apex_tpu import pyprof
+    flops_per_step = pyprof.xla_flops(step_fn, params, batch_stats,
+                                      opt_state, (x, y))
 
     outer = max(1, (steps - warmup) // inner_steps)
     t0 = time.perf_counter()

@@ -105,6 +105,10 @@ def main():
     for _ in range(2):
         params, opt_state, loss = fn(params, opt_state, (toks, labels))
         float(loss)
+    # cost analysis BEFORE the timed region (AOT compile; see
+    # pyprof.xla_flops note)
+    from apex_tpu import pyprof
+    flops_dispatch = pyprof.xla_flops(fn, params, opt_state, (toks, labels))
     outer = max(1, args.steps // args.inner)
     t0 = time.perf_counter()
     for _ in range(outer):
@@ -113,13 +117,21 @@ def main():
     dt = time.perf_counter() - t0
     n = outer * args.inner
     seq_s = batch * n / dt
-    print(json.dumps({
+    rec = {
         "metric": f"bert_{args.model}_pretrain_seq{args.seq}_"
                   f"lamb_O5_sequences_per_sec",
         "value": round(seq_s, 1),
         "unit": "seq/s",
         "tokens_per_sec": round(seq_s * args.seq, 0),
-    }))
+    }
+    # Roofline position from XLA cost analysis, like bench.py (VERDICT r2
+    # weak #4: every committed benchmark self-reports MFU).
+    if flops_dispatch:
+        achieved = flops_dispatch * outer / dt
+        rec["tflops"] = round(achieved / 1e12, 1)
+        if on_tpu:
+            rec["mfu"] = round(achieved / pyprof.device_peak_flops(), 3)
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

@@ -250,3 +250,34 @@ def test_local_syncbn_scale_init():
                       scale_init=nn.initializers.zeros)
     v = m.init(jax.random.PRNGKey(5), x)
     np.testing.assert_array_equal(np.asarray(v["params"]["scale"]), 0.0)
+
+
+def test_resnet_s2d_stem_matches_conv7():
+    """stem='space_to_depth' with conv7_to_s2d_kernel-mapped weights must
+    reproduce the 7x7/2 stem exactly (the TPU MLPerf input transform is a
+    re-parameterization, not a different function — VERDICT r2 #2)."""
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu.models import ResNet18
+    from apex_tpu.models.resnet import conv7_to_s2d_kernel, space_to_depth
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 64, 3))
+    m7 = ResNet18(num_classes=10)
+    ms = ResNet18(num_classes=10, stem="space_to_depth")
+    v7 = m7.init(jax.random.PRNGKey(1), x, train=False)
+
+    params_s2d = dict(v7["params"])
+    params_s2d["conv_init"] = {
+        "kernel": conv7_to_s2d_kernel(v7["params"]["conv_init"]["kernel"])}
+    y7 = m7.apply({"params": v7["params"],
+                   "batch_stats": v7["batch_stats"]}, x, train=False)
+    ys = ms.apply({"params": params_s2d,
+                   "batch_stats": v7["batch_stats"]}, x, train=False)
+    np.testing.assert_allclose(np.asarray(ys), np.asarray(y7),
+                               rtol=1e-4, atol=1e-4)
+
+    # the transform itself: block (i, j) of pixel (2p+i, 2q+j) lands at
+    # depth (i*2 + j)*C + c
+    s2d = space_to_depth(x, 2)
+    np.testing.assert_array_equal(np.asarray(s2d[:, 3, 5, 3:6]),
+                                  np.asarray(x[:, 6, 11, :]))
