@@ -16,15 +16,16 @@ Two execution paths, selected by :func:`use_pallas`:
 
 The default is **jnp on TPU too**, by measurement: on a v5e chip over a
 ResNet-50-sized tree, XLA's fusion beats the Pallas bucket kernels on every
-one of the eight ops (1.4x kernel-only — XLA pipelines a fused elementwise
-loop better than a grid of aliased blocks — and 3-13x end-to-end once the
-per-step bucket flatten/unflatten is counted; see
-``benchmarks/bench_optimizers.py --ops`` and the table in BASELINE.md). The
+one of the eight ops — 3-13x with per-step tree<->bucket marshalling, and
+still 1.4-1.9x in the Pallas kernels' best case, persistent-bucket state
+with zero marshalling (r3, ``optimizers.BucketedOptimizer``; full table in
+BASELINE.md). The Pallas mt layer is therefore an ARCHIVED
+documented-negative-result: complete, parity-tested
+(tests/test_multi_tensor.py, benchmarks/tpu_kernel_check.py), selectable
+via ``APEX_TPU_MT_BACKEND=pallas``, and in no shipped default path. The
 CUDA reference needs hand-written multi-tensor kernels because eager torch
 launches one kernel per tensor; XLA's whole-graph fusion is the TPU-native
-answer to the same problem. The Pallas layer is kept complete, parity-tested
-(tests/test_multi_tensor.py, benchmarks/tpu_kernel_check.py) and selectable
-for cases where producer fusion is unavailable.
+answer to the same problem.
 
 Overflow contract: the reference kernels set a device-side ``noop_flag`` when
 they see inf/nan (e.g. ScaleFunctor, csrc/multi_tensor_scale_kernel.cu:30).

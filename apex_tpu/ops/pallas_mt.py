@@ -12,6 +12,12 @@ SMEM/VMEM outputs that persist across grid steps.
 Layout: a flat bucket of N elements is zero-padded to a multiple of
 ``BLOCK_ROWS * 128`` and viewed as (rows, 128) so the VPU sees full
 (sublane, lane) tiles.
+
+STATUS (r3): ARCHIVED — documented negative result. Measured on v5e these
+kernels lose to XLA's whole-graph elementwise fusion by 1.4-1.9x even in
+their best case (persistent-bucket operands, zero marshalling; BASELINE.md
+table). They remain complete, parity-tested, and selectable via
+``APEX_TPU_MT_BACKEND=pallas``, but no shipped default path runs them.
 """
 
 from __future__ import annotations

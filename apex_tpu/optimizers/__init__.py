@@ -8,3 +8,4 @@ from apex_tpu.optimizers.fused import (
     FusedNovoGrad, NovoGradState,
     FusedAdagrad, AdagradState,
 )
+from apex_tpu.optimizers.bucketed import BucketedOptimizer

@@ -1,0 +1,118 @@
+"""Persistent-bucket dense optimizer mode (VERDICT r3 #4) — the ZeRO
+state layout without the sharding.
+
+BASELINE.md's r2 analysis attributed most of the Pallas multi-tensor
+kernels' 3-13x end-to-end loss to per-step tree<->bucket marshalling
+(161 leaves x 7 operand trees for Adam). This wrapper removes the
+marshalling from the *steady state*: parameters and optimizer state live
+as ONE flat bucket per dtype ACROSS steps (the pointer-list persistence
+of csrc/multi_tensor_apply.cuh:16-142, expressed as persistent arrays).
+Per step only two tree conversions remain, both unavoidable:
+
+  * ``unflatten(pb)`` — the tree view of the params for the forward;
+  * ``flatten(grads)`` — one concat per dtype of the incoming grad tree.
+
+Because a list of flat buckets is itself a pytree, the wrapped fused
+optimizer's elementwise math runs on it unchanged — under either
+multi-tensor backend (jnp fusion or the Pallas bucket kernels, which see
+pre-flattened operands and skip their own packing).
+
+Only elementwise-uniform optimizers can run on buckets: FusedLAMB's
+per-tensor trust ratios and FusedNovoGrad's per-tensor second moments
+would silently become per-BUCKET quantities, so those raise — use the
+ZeRO optimizers (contrib.optimizers), whose segmented reductions keep
+per-tensor semantics over flat shards. Param groups likewise need the
+per-element segment machinery and raise here.
+
+Usage::
+
+    opt = BucketedOptimizer(FusedAdam(lr=1e-3))
+    pb, state = opt.init(params)          # flat per-dtype buckets
+    for batch in data:
+        grads = jax.grad(loss)(opt.unflatten(pb), batch)
+        pb, state = opt.step(opt.flatten(grads), pb, state)
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+
+from apex_tpu.ops import buckets as _buckets
+from apex_tpu.optimizers.fused import (FusedAdagrad, FusedAdam, FusedLAMB,
+                                       FusedNovoGrad, FusedSGD)
+
+Tree = Any
+
+# Optimizers whose update is the same elementwise function for every
+# element (no per-tensor reductions) — safe to run on concatenated
+# buckets.
+_ELEMENTWISE = (FusedAdam, FusedSGD, FusedAdagrad)
+
+
+class BucketedOptimizer:
+    """Persistent-bucket wrapper around an elementwise fused optimizer."""
+
+    def __init__(self, inner):
+        if isinstance(inner, (FusedLAMB, FusedNovoGrad)):
+            raise ValueError(
+                f"{type(inner).__name__} computes per-tensor reductions "
+                "(trust ratios / per-tensor moments) that would become "
+                "per-bucket on flat state; use the ZeRO optimizers "
+                "(apex_tpu.contrib.optimizers), whose segmented "
+                "reductions keep per-tensor semantics on flat shards")
+        if not isinstance(inner, _ELEMENTWISE):
+            raise ValueError(
+                f"BucketedOptimizer supports {[c.__name__ for c in _ELEMENTWISE]}; "
+                f"got {type(inner).__name__}")
+        if inner.param_groups:
+            raise ValueError(
+                "BucketedOptimizer does not support param groups (per-group "
+                "hyperparameters need per-element vectors over the bucket; "
+                "the ZeRO optimizers implement that)")
+        self.inner = inner
+        self._tspec: Optional[_buckets.TreeBucketSpec] = None
+
+    # -- layout -------------------------------------------------------------
+    def flatten(self, tree: Tree) -> List[jax.Array]:
+        """Tree -> per-dtype flat buckets (grads, once per step). The first
+        call (via ``init``) fixes the layout; later trees must match it."""
+        bs, tspec = _buckets.tree_flatten_buckets(tree)
+        if self._tspec is None:
+            self._tspec = tspec
+        elif (tspec.treedef != self._tspec.treedef
+              or tspec.leaf_dtypes != self._tspec.leaf_dtypes
+              or tuple(s.shapes for s in tspec.bucket_specs)
+              != tuple(s.shapes for s in self._tspec.bucket_specs)):
+            raise ValueError(
+                "tree structure/dtypes/shapes changed since init — re-init "
+                "the BucketedOptimizer (bucket layout is static)")
+        return bs
+
+    def unflatten(self, bucket_params: Sequence[jax.Array]) -> Tree:
+        """Buckets -> the param tree view (for the forward pass)."""
+        if self._tspec is None:
+            raise ValueError("call init() first")
+        return _buckets.tree_unflatten_buckets(bucket_params, self._tspec)
+
+    # -- optimizer protocol over buckets -------------------------------------
+    def init(self, params: Tree) -> Tuple[List[jax.Array], Any]:
+        """-> (bucket_params, state); state arrays are flat buckets too."""
+        pb = self.flatten(params)
+        return pb, self.inner.init(pb)
+
+    def step(self, grad_buckets: Sequence[jax.Array],
+             bucket_params: Sequence[jax.Array], state: Any, *,
+             grad_scale: Optional[jax.Array] = None, **kw):
+        """One update entirely on flat buckets — zero tree marshalling."""
+        if self.inner.param_groups:
+            # a later inner.add_param_group would otherwise silently route
+            # through _step_grouped, whose path filters would match flat-
+            # bucket list indices instead of the original leaf names
+            raise ValueError(
+                "param groups were added to the wrapped optimizer after "
+                "BucketedOptimizer construction; group filters cannot "
+                "address leaves inside flat buckets")
+        return self.inner.step(list(grad_buckets), list(bucket_params),
+                               state, grad_scale=grad_scale, **kw)
