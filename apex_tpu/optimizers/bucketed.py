@@ -98,7 +98,9 @@ class BucketedOptimizer:
 
     # -- optimizer protocol over buckets -------------------------------------
     def init(self, params: Tree) -> Tuple[List[jax.Array], Any]:
-        """-> (bucket_params, state); state arrays are flat buckets too."""
+        """-> (bucket_params, state); state arrays are flat buckets too.
+        Re-initializing establishes a fresh layout."""
+        self._tspec = None
         pb = self.flatten(params)
         return pb, self.inner.init(pb)
 

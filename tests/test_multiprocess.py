@@ -1,0 +1,101 @@
+"""TRUE multi-process distributed test (VERDICT r3 #5): 2 subprocesses x 4
+XLA-CPU devices run ``multiproc.initialize_distributed`` -> jax.distributed
+-> one DDP+ZeRO step over the GLOBAL 8-device mesh, and must agree with
+each other AND with the same program on this process's single-process
+8-device virtual mesh — the analog of the reference's launched tier
+(tests/distributed/DDP/ddp_race_condition_test.py,
+tests/L1/cross_product_distributed/run.sh)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+WORKER = os.path.join(os.path.dirname(__file__), "distributed_worker.py")
+KEYS = ("grad_norm", "param_sum", "param_norm", "master_psum")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(pid: int, port: int, nproc: int, local_dev: int):
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=f"--xla_force_host_platform_device_count={local_dev}",
+        COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+        NUM_PROCESSES=str(nproc),
+        PROCESS_ID=str(pid),
+    )
+    return subprocess.Popen(
+        [sys.executable, WORKER, "--global-devices",
+         str(nproc * local_dev)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def _parse(stdout: str):
+    for line in stdout.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    return None
+
+
+def test_two_process_ddp_zero_matches_single_process():
+    nproc, local_dev = 2, 4
+    port = _free_port()
+    try:
+        procs = [_spawn(i, port, nproc, local_dev) for i in range(nproc)]
+    except OSError as e:  # platform forbids subprocess
+        pytest.skip(f"cannot spawn subprocesses: {e}")
+
+    # Drain both workers' pipes CONCURRENTLY: the processes are coupled by
+    # collectives, and a sequential communicate() would stop reading the
+    # other worker's pipes — if that one fills its ~64 KB stderr buffer it
+    # blocks mid-step and deadlocks both until the timeout.
+    import concurrent.futures as cf
+    with cf.ThreadPoolExecutor(len(procs)) as ex:
+        futs = [ex.submit(p.communicate, timeout=600) for p in procs]
+        results = []
+        for p, f in zip(procs, futs):
+            try:
+                results.append(f.result())
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail("multi-process worker timed out "
+                            "(coordination hang?)")
+
+    outs = []
+    for p, (stdout, stderr) in zip(procs, results):
+        assert p.returncode == 0, (
+            f"worker failed (rc={p.returncode}):\n{stderr[-3000:]}")
+        out = _parse(stdout)
+        assert out is not None, f"no RESULT line in worker stdout:\n{stdout}"
+        outs.append(out)
+
+    # both processes see the full global mesh and identical replicated
+    # results (cross-process collectives actually ran)
+    for out in outs:
+        assert out["local_devices"] == local_dev
+    for k in KEYS:
+        np.testing.assert_allclose(outs[0][k], outs[1][k], rtol=1e-6)
+
+    # ... and the 2x4-process program equals the 8-device single-process
+    # program (this pytest process's virtual mesh, set up by conftest)
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("distributed_worker",
+                                                  WORKER)
+    w = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(w)
+    want = w.run(nproc * local_dev)
+    for k in KEYS:
+        np.testing.assert_allclose(outs[0][k], want[k], rtol=1e-5,
+                                   err_msg=f"{k} differs between 2-process "
+                                   "and single-process execution")
