@@ -15,6 +15,7 @@ from apex_tpu.amp.frontend import (
     load_state_dict,
     master_params,
     is_batchnorm_path,
+    bn_predicate_from_model,
 )
 from apex_tpu.amp.handle import init, AmpHandle, NoOpHandle
 from apex_tpu.amp.interposition import (

@@ -881,7 +881,11 @@ def ring_self_attention(q, k, v, axis_name: str, *, causal: bool = False,
     ``bias`` is a per-device additive score bias with GLOBAL key columns:
     shape broadcastable to (B, H, S_local, S_global) — e.g. a replicated
     key-padding mask (B, 1, 1, S_global). Each ring step slices the
-    arriving chunk's column window.
+    arriving chunk's column window. The bias is a CONSTANT
+    (stop_gradient) on the flash path — no dbias is accumulated around
+    the ring — so a LEARNED score bias must use the dense path
+    (``impl='default'`` here, or attention_reference; see
+    docs/source/advanced.rst "Attention masks vs learned biases").
 
     ``impl='flash'`` composes the Pallas flash kernels into the ring (each
     chunk runs blockwise, O(S_loc·d) memory, with a global-lse ring

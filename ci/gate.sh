@@ -12,7 +12,7 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "== 1/4 package import =="
+echo "== 1/5 package import =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import apex_tpu
@@ -20,7 +20,7 @@ from apex_tpu import amp, optimizers, parallel, ops
 print('apex_tpu imports OK')
 "
 
-echo "== 2/4 native host runtime builds (g++ -O3 -shared) =="
+echo "== 2/5 native host runtime builds (g++ -O3 -shared) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 from apex_tpu import runtime
@@ -35,7 +35,7 @@ print('flatten/unflatten path OK')
 assert ok, 'host runtime failed to build — check g++ toolchain'
 "
 
-echo "== 3/4 graft entry compiles (single-device + 8-device dryrun) =="
+echo "== 3/5 graft entry compiles (single-device + 8-device dryrun) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import __graft_entry__ as ge
@@ -45,7 +45,50 @@ print('entry() compiles')
 ge.dryrun_multichip(8)
 "
 
-echo "== 4/4 pytest =="
+echo "== 4/5 package install (wheel build + clean --target install) =="
+# The reference gates on Docker extension builds
+# (tests/docker_extension_builds/run.sh); the TPU analog: build the wheel
+# from pyproject.toml, install it into an empty --target dir, and import
+# from THERE (cwd outside the checkout) — catches packaging regressions
+# (missing subpackages, lost csrc package-data). --no-deps/
+# --no-build-isolation keep it hermetic (deps are baked into the image,
+# zero network).
+INST_DIR="$(mktemp -d)"
+trap 'rm -rf "$INST_DIR" build apex_tpu.egg-info' EXIT
+# stale build/lib can re-package deleted files and mask exactly the
+# regressions this stage exists to catch
+rm -rf build apex_tpu.egg-info
+pip wheel -q --no-deps --no-build-isolation -w "$INST_DIR/dist" .
+pip install -q --no-deps --target "$INST_DIR/pkg" "$INST_DIR"/dist/apex_tpu-*.whl
+(cd "$INST_DIR" && PYTHONPATH="$INST_DIR/pkg" python -c "
+import jax; jax.config.update('jax_platforms', 'cpu')
+import os
+import apex_tpu
+p = os.path.dirname(apex_tpu.__file__)
+assert 'pkg' in p.split(os.sep), f'imported checkout, not the install: {p}'
+# the JIT-built C++ host runtime must find its csrc/ inside the wheel
+from apex_tpu import runtime
+assert os.path.exists(os.path.join(p, 'csrc', 'host_runtime.cpp')), \
+    'csrc package-data missing from the installed package'
+# compile smoke from the INSTALLED package
+import jax.numpy as jnp
+from apex_tpu import amp, optimizers
+from apex_tpu.models import GPTTiny
+from apex_tpu.models.gpt import next_token_loss
+toks = jnp.zeros((1, 16), jnp.int32)
+m = GPTTiny(vocab_size=64, max_seq=16)
+params = m.init(jax.random.PRNGKey(0), toks)['params']
+opt = optimizers.FusedAdam(lr=1e-3)
+state = opt.init(params)
+def step(p, s):
+    l, g = jax.value_and_grad(
+        lambda p: next_token_loss(m.apply({'params': p}, toks), toks))(p)
+    return opt.step(g, p, s)
+jax.jit(step).lower(params, state).compile()
+print('installed-package train step compiles')
+")
+
+echo "== 5/5 pytest =="
 if [[ "${1:-}" == "--full" ]]; then
     # full suite + the complete L1 cross-product matrix (reference
     # tests/L1/cross_product{,_distributed}/run.sh)
