@@ -51,8 +51,14 @@ def is_batchnorm_path(path) -> bool:
 def _is_bn_module(m) -> bool:
     import flax.linen as nn
     from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+    # isinstance covers flax BN / SyncBatchNorm and subclasses; the name
+    # check catches third-party BN types but must match the WHOLE class
+    # name (BatchNorm, SyncBatchNorm2d, ...) — a substring test would pin
+    # composite blocks like ConvBatchNormAct, whose subtree holds non-BN
+    # params, entirely fp32
     return (isinstance(m, (nn.BatchNorm, SyncBatchNorm))
-            or "batchnorm" in type(m).__name__.lower())
+            or re.fullmatch(r"(?i)(sync)?batch_?norm\w{0,4}",
+                            type(m).__name__) is not None)
 
 
 def bn_predicate_from_model(module, *init_args, **init_kwargs) -> Callable:
@@ -94,8 +100,11 @@ def bn_predicate_from_model(module, *init_args, **init_kwargs) -> Callable:
         if root_is_bn:
             # the traced model IS a batchnorm: every param is BN state
             return True
-        p = _path_str(path)
-        return any(p == pre or p.startswith(pre + "/") for pre in prefixes) \
+        # '/a/b/' segment containment rather than a pure prefix test: the
+        # casted tree may be rooted above 'params' (e.g. the full
+        # variables dict), shifting every path one level deeper
+        p = "/" + _path_str(path) + "/"
+        return any("/" + pre + "/" in p for pre in prefixes) \
             or is_batchnorm_path(path)
 
     predicate.bn_module_paths = frozenset(prefixes)  # introspection/tests

@@ -69,7 +69,13 @@ def xla_flops(jitted_fn, *args, **kwargs) -> Optional[float]:
     Note: ``lower().compile()`` is an AOT compile that bypasses the jit
     dispatch cache — call this BEFORE the timed region (XLA's own compile
     cache usually makes the second compile of an identical program cheap,
-    but that is backend-dependent)."""
+    but that is backend-dependent).
+
+    CAVEAT: XLA's cost model counts a while/scan BODY ONCE regardless of
+    trip count (verified r3) — analyze a single-step program, not a
+    multi-step scan dispatch, or you under-report by the scan length.
+    Pallas kernels appear as custom calls with approximate or zero FLOPs;
+    attention-heavy models under-report accordingly."""
     import sys
     try:
         cost = jitted_fn.lower(*args, **kwargs).compile().cost_analysis()

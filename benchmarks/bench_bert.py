@@ -36,8 +36,12 @@ def main():
     p.add_argument("--model", default="large", choices=["base", "large"])
     p.add_argument("--seq", type=int, default=128)
     p.add_argument("--batch", type=int, default=0, help="0: auto")
-    p.add_argument("--steps", type=int, default=20)
-    p.add_argument("--inner", type=int, default=5)
+    # 25 steps per dispatch x 4 dispatches: at seq-128 a 5-step dispatch is
+    # ~0.5 s of device work and the measurement drowns in tunnel dispatch
+    # jitter (observed 89-336 seq/s run-to-run on identical code, r3);
+    # this config repeats within ~2%.
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--inner", type=int, default=25)
     args = p.parse_args()
 
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -105,10 +109,15 @@ def main():
     for _ in range(2):
         params, opt_state, loss = fn(params, opt_state, (toks, labels))
         float(loss)
-    # cost analysis BEFORE the timed region (AOT compile; see
-    # pyprof.xla_flops note)
+    # cost analysis BEFORE the timed region, on a SINGLE-step program:
+    # XLA's cost model counts a while/scan body ONCE regardless of trip
+    # count, so analyzing the scan dispatch under-reports by args.inner
     from apex_tpu import pyprof
-    flops_dispatch = pyprof.xla_flops(fn, params, opt_state, (toks, labels))
+    one_step = jax.jit(shard_map(
+        per_device, mesh=mesh, in_specs=(rep, rep, (P("data"), P("data"))),
+        out_specs=(rep, rep, rep), check_vma=False))
+    flops_step = pyprof.xla_flops(one_step, params, opt_state,
+                                  (toks, labels))
     outer = max(1, args.steps // args.inner)
     t0 = time.perf_counter()
     for _ in range(outer):
@@ -126,11 +135,15 @@ def main():
     }
     # Roofline position from XLA cost analysis, like bench.py (VERDICT r2
     # weak #4: every committed benchmark self-reports MFU).
-    if flops_dispatch:
-        achieved = flops_dispatch * outer / dt
+    if flops_step:
+        achieved = flops_step * n / dt
         rec["tflops"] = round(achieved / 1e12, 1)
         if on_tpu:
             rec["mfu"] = round(achieved / pyprof.device_peak_flops(), 3)
+            # cost analysis sees the flash kernels as custom calls with
+            # ~zero FLOPs; tiny at seq 128, but a floor nonetheless
+            rec["flops_note"] = ("cost-analysis floor (excl. Pallas "
+                                 "in-kernel FLOPs)")
     print(json.dumps(rec))
 
 
