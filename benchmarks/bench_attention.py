@@ -25,50 +25,67 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def timeit(fn, q, k, v, iters=(5, 55)):
-    """Per-iteration DEVICE time via two dependency-chained lax.scan runs
-    of different lengths: slope = (t_long - t_short) / (n_long - n_short).
+def timeit(fn, q, k, v, iters=40):
+    """Per-iteration DEVICE time of ``iters`` dependency-chained
+    executions inside one jitted lax.scan.
 
-    Each iteration's q depends on the previous output, so the device runs
-    them back to back — independent async dispatches over a remote-device
-    tunnel otherwise report scheduling time, not compute. The two-length
-    slope then cancels the PER-DISPATCH overhead as well: over the axon
-    tunnel a single executable launch + sync costs ~120 ms wall
-    regardless of scan length (measured r3, jax.profiler trace: device
-    busy 53 ms of 174 ms wall for 25 fwd iters), which at fixed iters
-    silently added ~4.8 ms/iter to every r2 kernel number."""
+    Primary clock: ``jax.profiler`` device time of the traced dispatch —
+    deterministic, and immune to the axon tunnel's per-dispatch overhead
+    (~120 ms wall per launch+sync REGARDLESS of scan length, measured r3:
+    device busy 53 ms of 174 ms wall for 25 fwd iters; r2's fixed-iters
+    wall-clock silently carried ~4.8 ms/iter of it, and the r3 two-length
+    slope variant still jittered ±2x at sub-ms workloads). Falls back to
+    a two-length wall-clock slope where the trace has no device events.
+
+    The carry chain (each iteration's q depends on the previous output)
+    keeps the device executing back to back; eps is a RUNTIME value so no
+    iteration can be constant-folded, and distinct eps per timed call
+    defeats any transport-level result replay."""
+    import shutil
+    import tempfile
+
     def chained(n):
         def run(q_, k_, v_, eps):
             def body(carry, _):
                 out = fn(carry, k_, v_)
                 leaf = jax.tree_util.tree_leaves(out)[0]
-                # eps is a RUNTIME zero: the multiply can't be constant-
-                # folded, so every iteration's kernel must actually run,
-                # while the carry value stays exactly q
                 return carry + eps * leaf.astype(carry.dtype), ()
             final, _ = jax.lax.scan(body, q_, None, length=n)
             return final
         return jax.jit(run)
 
-    n_short, n_long = iters
+    run = chained(iters)
+    jax.block_until_ready(run(q, k, v, jnp.zeros((), q.dtype)))
+    out = run(q, k, v, jnp.float32(1e-30).astype(q.dtype))
+    np.asarray(out[0, 0, 0, :1])                     # warm the timed path
 
-    def measure(run, eps_base):
-        jax.block_until_ready(run(q, k, v, jnp.zeros((), q.dtype)))
-        out = run(q, k, v, jnp.float32(eps_base).astype(q.dtype))
-        np.asarray(out[0, 0, 0, :1])                 # warm the timed path
-        # each timed call gets a DISTINCT eps: identical (fn, args)
-        # executions can be served from a result cache by a remote-device
-        # transport, which would time the replay, not the kernels
-        reps, t0 = 2, time.perf_counter()
-        for i in range(reps):
-            out = run(q, k, v,
-                      jnp.float32(eps_base * (i + 2)).astype(q.dtype))
+    td = tempfile.mkdtemp(prefix="bench_attn_trace_")
+    try:
+        with jax.profiler.trace(td):
+            out = run(q, k, v, jnp.float32(2e-30).astype(q.dtype))
             np.asarray(out[0, 0, 0, :1])             # hard host sync
-        return (time.perf_counter() - t0) / reps
+        from apex_tpu.pyprof.parse import load_trace
+        dev_us = load_trace(td).total_device_time_us()
+    except Exception:
+        dev_us = 0.0
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+    if dev_us > 0:
+        return dev_us / iters / 1e6
 
-    t_short = measure(chained(n_short), 1e-30)
-    t_long = measure(chained(n_long), 1e-29)
-    return (t_long - t_short) / (n_long - n_short)
+    # fallback: wall-clock slope between two scan lengths
+    def measure(r, eps_base):
+        jax.block_until_ready(r(q, k, v, jnp.zeros((), q.dtype)))
+        np.asarray(r(q, k, v,
+                     jnp.float32(eps_base).astype(q.dtype))[0, 0, 0, :1])
+        t0 = time.perf_counter()
+        np.asarray(r(q, k, v,
+                     jnp.float32(eps_base * 2).astype(q.dtype))[0, 0, 0, :1])
+        return time.perf_counter() - t0
+
+    t_short = measure(chained(5), 1e-30)
+    t_long = measure(run, 1e-29)
+    return max(t_long - t_short, 1e-9) / (iters - 5)
 
 
 def main():
