@@ -15,6 +15,7 @@ SURVEY.md §5.1). The TPU-native pipeline:
 
 from apex_tpu.pyprof.annotate import annotate, annotate_module, push, pop
 from apex_tpu.pyprof.parse import Trace, TraceEvent, categorize, load_trace
-from apex_tpu.pyprof.prof import (analyze, device_peak_flops, format_report,
+from apex_tpu.pyprof.prof import (analyze, device_peak_flops,
+                                  device_time_of, format_report,
                                   summarize_trace, xla_flops)
 from apex_tpu.pyprof.trace import trace, start_trace, stop_trace

@@ -41,9 +41,6 @@ def timeit(fn, q, k, v, iters=40):
     keeps the device executing back to back; eps is a RUNTIME value so no
     iteration can be constant-folded, and distinct eps per timed call
     defeats any transport-level result replay."""
-    import shutil
-    import tempfile
-
     def chained(n):
         def run(q_, k_, v_, eps):
             def body(carry, _):
@@ -54,24 +51,20 @@ def timeit(fn, q, k, v, iters=40):
             return final
         return jax.jit(run)
 
+    from apex_tpu import pyprof
+
     run = chained(iters)
     jax.block_until_ready(run(q, k, v, jnp.zeros((), q.dtype)))
     out = run(q, k, v, jnp.float32(1e-30).astype(q.dtype))
     np.asarray(out[0, 0, 0, :1])                     # warm the timed path
 
-    td = tempfile.mkdtemp(prefix="bench_attn_trace_")
-    try:
-        with jax.profiler.trace(td):
-            out = run(q, k, v, jnp.float32(2e-30).astype(q.dtype))
-            np.asarray(out[0, 0, 0, :1])             # hard host sync
-        from apex_tpu.pyprof.parse import load_trace
-        dev_us = load_trace(td).total_device_time_us()
-    except Exception:
-        dev_us = 0.0
-    finally:
-        shutil.rmtree(td, ignore_errors=True)
-    if dev_us > 0:
-        return dev_us / iters / 1e6
+    def once():
+        out = run(q, k, v, jnp.float32(2e-30).astype(q.dtype))
+        np.asarray(out[0, 0, 0, :1])                 # hard host sync
+
+    dev_s = pyprof.device_time_of(once)
+    if dev_s > 0:
+        return dev_s / iters
 
     # fallback: wall-clock slope between two scan lengths
     def measure(r, eps_base):
