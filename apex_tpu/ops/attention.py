@@ -550,8 +550,9 @@ def _flash_bwd_q_kernel(scale, causal, rate, sq_actual, sk_actual, bq, bk,
 def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
                dropout_rate: float = 0.0, dropout_seed=None,
                bias=None, block_q: int = 1024, block_k: int = 1024):
-    # (1024, 1024) re-measured r3 with profiler device time: fwd+bwd
-    # 3.97 ms vs 4.30 at r2's (512, 512) (s=4096, d=64, v5e).
+    # (1024, 1024) re-measured r3 with profiler device time and FULL
+    # gradients (dq+dk+dv — see BASELINE.md r3 roofline note #5): fwd+bwd
+    # 6.00 ms vs 6.54 at r2's (512, 512) (s=4096, d=64, v5e).
     """Pallas flash backward: O(S) memory (only lse/delta row stats are
     carried; the (Sq, Sk) score matrix never hits HBM) — the counterpart of
     the reference's fused MHA backward kernels, reorganized as the

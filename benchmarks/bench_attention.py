@@ -45,8 +45,17 @@ def timeit(fn, q, k, v, iters=40):
         def run(q_, k_, v_, eps):
             def body(carry, _):
                 out = fn(carry, k_, v_)
-                leaf = jax.tree_util.tree_leaves(out)[0]
-                return carry + eps * leaf.astype(carry.dtype), ()
+                # the carry must consume EVERY output: chaining through
+                # leaves[0] alone let XLA dead-code-eliminate the dK/dV
+                # backward kernel inside the scan, silently timing
+                # fwd + dQ only (r3 finding — every earlier fwd+bwd
+                # number had this hole)
+                leaves = [l.astype(carry.dtype)
+                          for l in jax.tree_util.tree_leaves(out)]
+                acc = leaves[0]
+                for l in leaves[1:]:
+                    acc = acc + l
+                return carry + eps * acc, ()
             final, _ = jax.lax.scan(body, q_, None, length=n)
             return final
         return jax.jit(run)
