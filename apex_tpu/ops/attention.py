@@ -416,8 +416,9 @@ def _recompute_p_ds(scale, causal, rate, sq_actual, sk_actual, bq, bk,
 
     Exponentials run through exp2 like the forward (pre-folded scale when
     no bias; natural-scale with conversion at the exp otherwise). The ROW
-    padding mask is never needed: padded dO/delta rows are zero, which
-    zeroes every dv/dk contribution, and padded k rows are zero, which
+    padding mask is never needed: padded lse rows are filled with +1e30
+    (see _flash_bwd) so p is exactly 0 there in both score scales, padded
+    dO/delta rows are zero besides, and padded k rows are zero, which
     zeroes dq contributions (outputs at padded positions are cropped).
     The COLUMN mask survives only for ragged sk (``pad_cols``) — zero-
     padded k makes s=0 there, and a fully-bias-masked row's lse ~ -3e4
@@ -547,16 +548,112 @@ def _flash_bwd_q_kernel(scale, causal, rate, sq_actual, sk_actual, bq, bk,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
+def _flash_bwd_fused_kernel(scale, causal, rate, sq_actual, sk_actual, bq,
+                            bk, nq, nk, has_bias, pad_cols, *refs):
+    """Single-sweep backward, grid (bh, ik, iq): the VPU-bound softmax
+    recompute (s → p → dP → ds) runs ONCE per (iq, ik) block pair and
+    feeds all three gradients — dV/dK accumulate in per-key-block scratch
+    (finalized when the inner query sweep ends), dQ accumulates in a
+    persistent full-sequence f32 scratch at row offset iq·bq (TPU grids
+    execute sequentially, so revisits across the outer ik sweeps are
+    ordered) and is written out during the LAST key sweep. Matches the
+    reference's one-backward-per-module design
+    (apex/contrib/csrc/multihead_attn/self_multihead_attn_cuda.cu) where
+    a single backward launch produces all input grads; the two-pass
+    variant below recomputed the softmax chain twice."""
+    if has_bias:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref, b_ref,
+         dk_ref, dv_ref, dq_ref, dk_scr, dv_scr, dq_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref,
+         dk_ref, dv_ref, dq_ref, dk_scr, dv_scr, dq_scr) = refs
+        b_ref = None
+    bh = pl.program_id(0)
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init_kv():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(ik == 0)
+    def _init_q():
+        dq_scr[pl.ds(iq * bq, bq), :] = jnp.zeros(
+            (bq, dq_scr.shape[1]), jnp.float32)
+
+    def _compute(masked):
+        q, kblk, p, do, ds = _recompute_p_ds(
+            scale, causal, rate, sq_actual, sk_actual, bq, bk, bh, iq, ik,
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref,
+            b_ref, masked=masked, pad_cols=pad_cols)
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # p^T dO -> (bk, d)
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # ds^T q
+        dq_scr[pl.ds(iq * bq, bq), :] += jax.lax.dot_general(
+            ds, kblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # ds k -> (bq, d)
+
+    _mask_variants(causal, pad_cols, iq, ik, bq, bk,
+                   sk_actual - sq_actual, nk, _compute)
+
+    @pl.when(iq == nq - 1)
+    def _finalize_kv():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+    @pl.when(ik == nk - 1)
+    def _finalize_q():
+        dq_ref[0] = dq_scr[pl.ds(iq * bq, bq), :].astype(dq_ref.dtype)
+
+
+# The fused backward's dQ scratch holds the whole padded query sequence in
+# f32 VMEM (sqp × dp × 4 bytes). v5e VMEM is ~16 MB/core and the kernel
+# also lives with its block buffers and (bq, bk) f32 score temporaries, so
+# beyond this budget the two-pass backward takes over (long-context
+# shapes: 131k rides two-pass; 4k–16k ride fused).
+_FUSED_BWD_DQ_SCRATCH_BYTES = 8 * 2 ** 20
+# Block tunings, overridable for sweeps: fused needs narrower query blocks
+# than r3's two-pass (1024, 1024) to leave VMEM room for the dq scratch.
+_FUSED_BLOCK_Q = 512
+_FUSED_BLOCK_K = 1024
+_BWD_BLOCK_Q = 1024
+_BWD_BLOCK_K = 1024
+
+
+def _fused_bwd_plan(sq: int, d: int) -> Tuple[bool, int]:
+    """(fused?, block_q cap) for a backward at this shape — the single
+    owner of the fused-vs-two-pass dispatch criterion, shared by
+    _flash_bwd and the benchmarks (so achieved-FLOP accounting can't
+    drift from the path the kernel actually takes). r4 v5e sweep (d=64):
+    scratch <=4 MB runs (512, 1024); larger scratch halves block_q (the
+    8 MB s=16384 scratch + 512-wide blocks exceed scoped VMEM)."""
+    dp_ = ((d + 127) // 128) * 128
+    scratch_bytes = (((sq + 127) // 128) * 128) * dp_ * 4
+    fused = scratch_bytes <= _FUSED_BWD_DQ_SCRATCH_BYTES
+    bq_cap = _FUSED_BLOCK_Q if scratch_bytes <= 4 * 2 ** 20 \
+        else _FUSED_BLOCK_Q // 2
+    return fused, bq_cap
+
+
 def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
                dropout_rate: float = 0.0, dropout_seed=None,
-               bias=None, block_q: int = 1024, block_k: int = 1024):
-    # (1024, 1024) re-measured r3 with profiler device time and FULL
-    # gradients (dq+dk+dv — see BASELINE.md r3 roofline note #5): fwd+bwd
-    # 6.00 ms vs 6.54 at r2's (512, 512) (s=4096, d=64, v5e).
+               bias=None, block_q: Optional[int] = None,
+               block_k: Optional[int] = None):
     """Pallas flash backward: O(S) memory (only lse/delta row stats are
     carried; the (Sq, Sk) score matrix never hits HBM) — the counterpart of
-    the reference's fused MHA backward kernels, reorganized as the
-    dKdV-then-dQ blockwise scheme."""
+    the reference's fused MHA backward kernels. Default: a single fused
+    sweep computing dq+dk+dv with one softmax recompute per block pair
+    (_flash_bwd_fused_kernel); sequences whose full-seq dq scratch would
+    blow VMEM (_fused_bwd_plan) fall back to the dKdV-then-dQ two-pass
+    scheme at r3's (1024, 1024) tuning."""
+    if block_q is None:
+        block_q = _BWD_BLOCK_Q
+    if block_k is None:
+        block_k = _BWD_BLOCK_K
     b, h, sq, d = q.shape
     sk = k.shape[2]
     dtype = q.dtype
@@ -571,6 +668,12 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
                     axis=-1)                     # (b, h, sq)
 
     dp_ = ((d + 127) // 128) * 128
+    # Fused-vs-two-pass decision precedes block choice (each path has its
+    # own tuning): fused iff the 128-aligned full-seq dq scratch fits.
+    fused, bq_cap = _fused_bwd_plan(sq, d)
+    if fused:
+        block_q = min(block_q, bq_cap)
+        block_k = min(block_k, _FUSED_BLOCK_K)
     bq = _pick_block(block_q, sq)
     bk = _pick_block(block_k, sk)
     sqp = ((sq + bq - 1) // bq) * bq
@@ -581,11 +684,14 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
     vf = _pad3(v.reshape(b * h, sk, d), skp, dp_)
     dof = _pad3(g.reshape(b * h, sq, d), sqp, dp_)
     # lse/delta ride as (bh, 1, seq) for Mosaic block-shape rules (see
-    # _flash_fwd). Padded rows carry lse=0 (finite), so p there is ~1, NOT
-    # 0 — harmless because padded dO/delta rows are zero (kills their
-    # dv/dk/ds terms) and padded outputs are cropped; see _recompute_p_ds.
-    # Changing the dO padding or this fill value breaks that invariant.
-    lsef = _pad_rowstat(lse.reshape(b * h, 1, sq), sqp, fill=0.0)
+    # _flash_fwd). Padded rows fill with a huge POSITIVE lse so the
+    # recomputed p = exp2((s - lse)·log2e) is EXACTLY 0 there in both the
+    # base-2 and bias paths. (A 0.0 fill relied on zero-padded dO/delta to
+    # cancel p≈1 terms — but on the bias path a padded row's s equals the
+    # raw bias, and a positive additive bias > ~88 made p overflow to inf,
+    # whose inf·0 products NaN'd the whole dk/dv block whenever sq wasn't
+    # a block multiple.)
+    lsef = _pad_rowstat(lse.reshape(b * h, 1, sq), sqp, fill=-NEG_INF)
     deltaf = _pad_rowstat(delta.reshape(b * h, 1, sq), sqp)
 
     nq = sqp // bq
@@ -605,6 +711,39 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
     q_spec = pl.BlockSpec((1, bq, dp_), lambda bh, i, j: (bh, j, 0))
     k_spec = pl.BlockSpec((1, bk, dp_), lambda bh, i, j: (bh, i, 0))
     row_spec = pl.BlockSpec((1, 1, bq), lambda bh, i, j: (bh, 0, j))
+
+    if fused:
+        # One sweep, all three grads: the softmax recompute chain (the
+        # kernel's VPU bottleneck) runs once per block pair instead of
+        # twice. dq rides a persistent (sqp, dp) f32 scratch.
+        dk, dv, dq = pl.pallas_call(
+            functools.partial(_flash_bwd_fused_kernel, scale, causal,
+                              dropout_rate, sq, sk, bq, bk, nq, nk,
+                              has_bias, skp != sk),
+            grid=(b * h, nk, nq),
+            in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec,
+                      pl.BlockSpec(memory_space=pltpu.SMEM),
+                      *kv_bias_specs],
+            out_specs=[
+                pl.BlockSpec((1, bk, dp_), lambda bh, i, j: (bh, i, 0)),
+                pl.BlockSpec((1, bk, dp_), lambda bh, i, j: (bh, i, 0)),
+                pl.BlockSpec((1, bq, dp_), lambda bh, i, j: (bh, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b * h, skp, dp_), dtype),
+                jax.ShapeDtypeStruct((b * h, skp, dp_), dtype),
+                jax.ShapeDtypeStruct((b * h, sqp, dp_), dtype),
+            ],
+            scratch_shapes=[pltpu.VMEM((bk, dp_), jnp.float32),
+                            pltpu.VMEM((bk, dp_), jnp.float32),
+                            pltpu.VMEM((sqp, dp_), jnp.float32)],
+            interpret=_interpret(),
+        )(qf, kf, vf, dof, lsef, deltaf, seed, *bias_ops)
+        dq = dq[:, :sq, :d].reshape(b, h, sq, d)
+        dk = dk[:, :sk, :d].reshape(b, h, sk, d)
+        dv = dv[:, :sk, :d].reshape(b, h, sk, d)
+        return dq, dk, dv
+
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_kv_kernel, scale, causal,
                           dropout_rate, sq, sk, bq, bk, nq, nk, has_bias,

@@ -120,12 +120,15 @@ def main():
 
         # Per-impl fwd+bwd matmul counts (vs 2 for the fwd alone):
         #   dense autodiff: fwd 2 + bwd 5 (dV, dP, dQ, dK + the saved-P
-        #     reuse) = 7 -> 3.5x; flash recomputes scores in BOTH backward
-        #     passes: kv pass 4 (S, dV, dP, dK) + q pass 3 (S, dP, dQ)
-        #     + fwd 2 = 9 -> 4.5x. "model" additionally reports the
-        #     algorithmic (impl-independent, dense-autodiff) FLOP rate so
-        #     the two impls stay comparable on one axis.
-        fb_mult = {"dense": 3.5, "flash": 4.5}
+        #     reuse) = 7 -> 3.5x; fused flash backward (r4): ONE recompute
+        #     sweep, bwd 5 (S, dP, dV, dK, dQ) + fwd 2 = 7 -> 3.5x; the
+        #     long-context two-pass fallback recomputes scores in BOTH
+        #     backward passes: kv 4 + q 3 + fwd 2 = 9 -> 4.5x. "model"
+        #     additionally reports the algorithmic (impl-independent,
+        #     dense-autodiff) FLOP rate so impls stay comparable.
+        import apex_tpu.ops.attention as A
+        flash_fused = A._fused_bwd_plan(s, d)[0]
+        fb_mult = {"dense": 3.5, "flash": 3.5 if flash_fused else 4.5}
 
         for name, fn in impls.items():
             t_fwd = timeit(fn, q, k, v)

@@ -1,0 +1,70 @@
+"""One-off block sweep for the fused flash backward (r4 tuning).
+
+Sweeps (_FUSED_BLOCK_Q, _FUSED_BLOCK_K) and prints device-time
+fwd+bwd per iteration at the benchmark shape. VMEM-OOM combos are
+reported and skipped.
+
+Run: python benchmarks/sweep_fused_bwd.py [--seqs 4096] [--blocks ...]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from bench_attention import timeit  # noqa: E402
+
+import apex_tpu.ops.attention as A  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seqs", default="4096")
+    p.add_argument("--blocks",
+                   default="256,1024;512,512;512,1024;1024,512;1024,1024")
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=64)
+    args = p.parse_args()
+
+    b, h, d = args.batch, args.heads, args.head_dim
+    for s in [int(x) for x in args.seqs.split(",")]:
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.random.normal(kk, (b, h, s, d), jnp.bfloat16)
+                   for kk in ks)
+        flops = 2 * 2 * b * h * s * s * d / 2  # causal model FLOPs (fwd)
+
+        def loss(q_, k_, v_):
+            return jnp.sum(A.flash_attention(q_, k_, v_, True)
+                           .astype(jnp.float32) ** 2)
+
+        grad_fn = jax.grad(loss, argnums=(0, 1, 2))
+
+        for combo in args.blocks.split(";"):
+            bq, bk = (int(x) for x in combo.split(","))
+            A._FUSED_BLOCK_Q, A._FUSED_BLOCK_K = bq, bk
+            # _flash_bwd halves the requested bq when the dq scratch
+            # exceeds 4 MB — report the EFFECTIVE blocks, not the request
+            fused, bq_cap = A._fused_bwd_plan(s, d)
+            bq_eff = min(bq, bq_cap)
+            try:
+                t = timeit(grad_fn, q, k, v)
+            except Exception as e:  # VMEM OOM etc.
+                print(json.dumps({"s": s, "bq": bq_eff, "bk": bk,
+                                  "error": str(e)[:120]}), flush=True)
+                continue
+            print(json.dumps({
+                "s": s, "bq": bq_eff, "bk": bk, "fused": fused,
+                "fwd_bwd_ms": round(t * 1e3, 3),
+                "tflops_model": round(flops * 3.5 / t / 1e12, 1),
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -118,6 +118,10 @@ def main():
         v = jax.random.normal(ks[2], (b, h, sk, d), jnp.bfloat16)
         bias = (jax.random.normal(ks[3], bias_shape) * 2.0
                 if bias_shape else None)
+        if bias_shape and "posbias" in name:
+            # large POSITIVE additive bias: the r3 padded-lse bug overflowed
+            # p to inf on padded query rows when sq wasn't a block multiple
+            bias = jnp.abs(bias) + 100.0
         gg = jax.random.normal(ks[4], (b, h, sq, d), jnp.bfloat16)
 
         def run(fn):
@@ -143,6 +147,17 @@ def main():
     attn_cmp("flash_bias", True, 512, 512,
              bias_shape=(2, 1, 1, 512), rtol=6e-2, atol=6e-2)
     attn_cmp("flash_dropout", True, 512, 512, rate=0.3)
+    # ragged sq + positive bias: padded-lse regression (r3 ADVICE medium)
+    attn_cmp("flash_posbias_ragged", False, 200, 200,
+             bias_shape=(1, 1, 200, 200), rtol=6e-2, atol=6e-2)
+    # force the two-pass long-context fallback on hardware too
+    import apex_tpu.ops.attention as _A
+    _saved = _A._FUSED_BWD_DQ_SCRATCH_BYTES
+    _A._FUSED_BWD_DQ_SCRATCH_BYTES = 0
+    try:
+        attn_cmp("flash_two_pass_fallback", True, 1024, 1024)
+    finally:
+        _A._FUSED_BWD_DQ_SCRATCH_BYTES = _saved
 
     print("ALL TPU KERNEL CHECKS PASSED")
 

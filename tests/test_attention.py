@@ -391,6 +391,53 @@ def test_flash_bias_matches_reference(causal, shape):
                                    rtol=3e-3, atol=2e-3)
 
 
+def test_flash_bias_ragged_sq_positive_bias_grads_finite():
+    """Regression (r3 ADVICE): with sq NOT a block multiple and a large
+    POSITIVE additive bias, the backward's padded query rows used to
+    reconstruct p = exp(bias - 0) = inf from the 0.0-filled lse pad,
+    NaN-ing the whole dk/dv block. Padded lse rows now fill with +1e30 so
+    p is exactly 0 there; grads must be finite and match the reference."""
+    sq = 200  # not a multiple of any block size
+    ks = jax.random.split(jax.random.PRNGKey(50), 3)
+    q = jax.random.normal(ks[0], (1, 2, sq, 64))
+    k = jax.random.normal(ks[1], (1, 2, sq, 64))
+    v = jax.random.normal(ks[2], (1, 2, sq, 64))
+    g = jax.random.normal(jax.random.PRNGKey(51), q.shape)
+    # additive bias well past the f32 exp overflow point (~88)
+    bias = jnp.full((1, 1, sq, sq), 100.0)
+
+    _, vjp_fl = jax.vjp(
+        lambda a, b, c: flash_attention(a, b, c, bias=bias), q, k, v)
+    _, vjp_ref = jax.vjp(
+        lambda a, b, c: attention_reference(a, b, c, bias=bias), q, k, v)
+    for got, want in zip(vjp_fl(g), vjp_ref(g)):
+        assert np.isfinite(np.asarray(got)).all()
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=3e-3, atol=2e-3)
+
+
+def test_flash_bwd_two_pass_fallback_matches_reference(monkeypatch):
+    """Long-context shapes fall back to the two-pass (dKdV then dQ)
+    backward when the fused kernel's full-seq dq scratch would blow VMEM.
+    Force the fallback at a small shape and check full grad parity so the
+    two-pass path stays covered."""
+    import apex_tpu.ops.attention as A
+
+    monkeypatch.setattr(A, "_FUSED_BWD_DQ_SCRATCH_BYTES", 0)
+    ks = jax.random.split(jax.random.PRNGKey(52), 3)
+    q = jax.random.normal(ks[0], (2, 2, 200, 64))
+    k = jax.random.normal(ks[1], (2, 2, 200, 64))
+    v = jax.random.normal(ks[2], (2, 2, 200, 64))
+    g = jax.random.normal(jax.random.PRNGKey(53), q.shape)
+    _, vjp_fl = jax.vjp(
+        lambda a, b, c: flash_attention(a, b, c, True), q, k, v)
+    _, vjp_ref = jax.vjp(
+        lambda a, b, c: attention_reference(a, b, c, causal=True), q, k, v)
+    for got, want in zip(vjp_fl(g), vjp_ref(g)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-3, atol=2e-4)
+
+
 def test_flash_bias_clamps_huge_masks():
     """-1e9-style masks are clamped to -3e4 in-kernel (f32 lse precision);
     the result matches the reference with the clamped mask."""
