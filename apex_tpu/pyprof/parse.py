@@ -114,7 +114,20 @@ class Trace:
         return out
 
     def total_device_time_us(self) -> float:
+        """Leaf device time summed across ALL device lanes — on an
+        N-device dispatch this is aggregate device-seconds (~N× per-chip
+        busy time); divide by :meth:`device_lane_count` for a per-chip
+        figure (device_time_of does)."""
         return sum(e.dur_us for e in self.leaf_device_events())
+
+    def device_lane_count(self) -> int:
+        """Distinct accelerator processes contributing leaf events — the
+        divisor that turns aggregate device-seconds into per-chip busy
+        time on multi-device dispatches."""
+        procs = {e.process for e in self.leaf_device_events()
+                 if any(k in e.process.lower()
+                        for k in ("tpu", "gpu", "/device"))}
+        return max(1, len(procs))
 
     def by_op(self, device_only: bool = True) -> List[Dict[str, Any]]:
         """Aggregate by op name: count, total/avg us, share of device time —

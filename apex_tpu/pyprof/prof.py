@@ -88,14 +88,20 @@ def xla_flops(jitted_fn, *args, **kwargs) -> Optional[float]:
         return None
 
 
-def device_time_of(run_and_sync: Callable[[], None]) -> float:
-    """Total DEVICE time (seconds) of ``run_and_sync()`` under a
-    jax.profiler trace — the reliable kernel clock over a remote-TPU
-    tunnel, where one dispatch+sync costs ~120 ms wall regardless of the
-    work inside (r3 finding; wall clocks at ~1 ms workloads are ~85%
-    dispatch overhead). Returns 0.0 (with a stderr note) when the trace
-    yields no device events — callers must fall back to wall clock AND
-    disclose the clock source, or the two become indistinguishable."""
+def device_time_of(run_and_sync: Callable[[], None], *,
+                   per_device: bool = True) -> float:
+    """DEVICE time (seconds) of ``run_and_sync()`` under a jax.profiler
+    trace — the reliable kernel clock over a remote-TPU tunnel, where one
+    dispatch+sync costs ~120 ms wall regardless of the work inside (r3
+    finding; wall clocks at ~1 ms workloads are ~85% dispatch overhead).
+
+    ``per_device`` (default) divides the summed leaf device time by the
+    number of distinct device lanes in the trace, so a multi-chip
+    dispatch reports per-chip busy time rather than aggregate
+    device-seconds (~N× per-chip — r3 ADVICE); single-device callers are
+    unaffected (divisor 1). Returns 0.0 (with a stderr note) when the
+    trace yields no device events — callers must fall back to wall clock
+    AND disclose the clock source, or the two become indistinguishable."""
     import shutil
     import sys
     import tempfile
@@ -104,7 +110,9 @@ def device_time_of(run_and_sync: Callable[[], None]) -> float:
         with jax.profiler.trace(td):
             run_and_sync()
         from apex_tpu.pyprof.parse import load_trace
-        return load_trace(td).total_device_time_us() / 1e6
+        trace = load_trace(td)
+        div = trace.device_lane_count() if per_device else 1
+        return trace.total_device_time_us() / 1e6 / div
     except Exception as e:
         print(f"pyprof.device_time_of: trace unavailable ({e!r}); "
               "fall back to wall clock", file=sys.stderr)

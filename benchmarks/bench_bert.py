@@ -118,6 +118,35 @@ def main():
         out_specs=(rep, rep, rep), check_vma=False))
     flops_step = pyprof.xla_flops(one_step, params, opt_state,
                                   (toks, labels))
+    # True MFU numerator (VERDICT r3 weak #2): cost analysis reports the
+    # flash MHA custom calls as ~0 FLOPs — add the analytic per-layer
+    # attention model FLOPs (dense-autodiff accounting) when the fast
+    # path is in use, turning the old ">= floor" into a real value.
+    att_flops = 0.0
+    from apex_tpu.ops.attention import _interpret, attention_model_flops
+    # gate on the kernel-dispatch predicate: only an opaque (real-Mosaic)
+    # flash call is invisible to cost analysis; interpret mode lowers to
+    # countable HLO and adding analytic FLOPs would double-count
+    if flops_step and model.impl == "fast" and not _interpret():
+        att_flops = model.layers * attention_model_flops(
+            batch, model.heads, args.seq, args.seq,
+            model.hidden // model.heads, training=True)
+        flops_step += att_flops
+
+    # Primary clock: profiler device time of one inner-steps dispatch
+    # (immune to the ~120 ms/dispatch tunnel tax, like bench.py r4).
+    seq_s_dev = 0.0
+    if on_tpu:
+        def once():
+            nonlocal params, opt_state
+            params, opt_state, loss = fn(params, opt_state,
+                                         (toks, labels))
+            float(loss)
+
+        dev_s = pyprof.device_time_of(once)
+        if dev_s > 0:
+            seq_s_dev = batch * args.inner / dev_s
+
     outer = max(1, args.steps // args.inner)
     t0 = time.perf_counter()
     for _ in range(outer):
@@ -125,25 +154,29 @@ def main():
     float(loss)   # D2H fetch: the only reliable full sync over the tunnel
     dt = time.perf_counter() - t0
     n = outer * args.inner
-    seq_s = batch * n / dt
+    seq_s_wall = batch * n / dt
+    seq_s = seq_s_dev if seq_s_dev > 0 else seq_s_wall
     rec = {
         "metric": f"bert_{args.model}_pretrain_seq{args.seq}_"
                   f"lamb_O5_sequences_per_sec",
         "value": round(seq_s, 1),
         "unit": "seq/s",
         "tokens_per_sec": round(seq_s * args.seq, 0),
+        "clock": "device" if seq_s_dev > 0 else "wall",
+        "wall_seq_s": round(seq_s_wall, 1),
     }
     # Roofline position from XLA cost analysis, like bench.py (VERDICT r2
     # weak #4: every committed benchmark self-reports MFU).
     if flops_step:
-        achieved = flops_step * n / dt
+        achieved = flops_step * seq_s / batch
         rec["tflops"] = round(achieved / 1e12, 1)
         if on_tpu:
             rec["mfu"] = round(achieved / pyprof.device_peak_flops(), 3)
-            # cost analysis sees the flash kernels as custom calls with
-            # ~zero FLOPs; tiny at seq 128, but a floor nonetheless
-            rec["flops_note"] = ("cost-analysis floor (excl. Pallas "
-                                 "in-kernel FLOPs)")
+            rec["flops_note"] = (
+                "numerator = XLA cost analysis of the non-Pallas graph "
+                f"+ analytic attention model FLOPs "
+                f"({att_flops / 1e9:.1f} GF/step across the flash MHA "
+                "calls, dense-autodiff accounting)")
     print(json.dumps(rec))
 
 
