@@ -847,6 +847,27 @@ def flash_attention(q, k, v, causal: bool = False,
                                  rate, has_bias)
 
 
+def attention_model_flops(b, h, sq, sk, d, *, causal=False,
+                          training=True) -> float:
+    """Analytic MODEL FLOPs of one attention call under the standard
+    dense-autodiff accounting (MAC=2): forward QK^T + PV = 2 matmuls of
+    2·b·h·sq·sk·d each; training adds the 4-matmul backward (dV = P^T dO,
+    dP = dO V^T, dQ = dS K, dK = dS^T Q — the softmax backward dS is
+    elementwise) for 6 total, the usual backward-is-2x-forward count;
+    causal masking halves the useful area.
+
+    This is the MFU numerator for attention-heavy benches: XLA cost
+    analysis sees Pallas kernels as ~0-FLOP custom calls, so benches add
+    this per flash call to turn "MFU floor" disclaimers into real,
+    regression-trackable values. Impl-independent by design — the flash
+    backward's in-kernel score recompute is deliberately NOT counted,
+    matching the model-FLOPs convention of the cost-analysis numerator
+    used for the non-Pallas graph (bench.py)."""
+    mm = 2.0 * b * h * sq * sk * d
+    f = (6.0 if training else 2.0) * mm
+    return f / 2 if causal else f
+
+
 def self_attention(q, k, v, *, causal=False, scale=None, impl="auto",
                    bias=None):
     """Dispatch: Pallas flash on TPU, jnp reference elsewhere/when asked."""

@@ -119,16 +119,20 @@ def main():
                 q_, k_, v_, causal=True)
 
         # Per-impl fwd+bwd matmul counts (vs 2 for the fwd alone):
-        #   dense autodiff: fwd 2 + bwd 5 (dV, dP, dQ, dK + the saved-P
-        #     reuse) = 7 -> 3.5x; fused flash backward (r4): ONE recompute
-        #     sweep, bwd 5 (S, dP, dV, dK, dQ) + fwd 2 = 7 -> 3.5x; the
-        #     long-context two-pass fallback recomputes scores in BOTH
-        #     backward passes: kv 4 + q 3 + fwd 2 = 9 -> 4.5x. "model"
+        #   dense autodiff: fwd 2 + bwd 4 (dV = P^T dO, dP = dO V^T,
+        #     dQ = dS K, dK = dS^T Q; softmax bwd is elementwise) = 6
+        #     -> 3.0x (r4 fix: the r3 comment claimed a phantom 5th
+        #     "saved-P reuse" matmul, inflating dense/model rates 7/6);
+        #   fused flash backward (r4): ONE recompute sweep, bwd 5
+        #     (S, dP, dV, dK, dQ) + fwd 2 = 7 -> 3.5x; the long-context
+        #     two-pass fallback recomputes scores in BOTH backward
+        #     passes: kv 4 + q 3 + fwd 2 = 9 -> 4.5x. "model"
         #     additionally reports the algorithmic (impl-independent,
-        #     dense-autodiff) FLOP rate so impls stay comparable.
+        #     dense-autodiff, 6-matmul) FLOP rate so impls stay
+        #     comparable on one axis.
         import apex_tpu.ops.attention as A
         flash_fused = A._fused_bwd_plan(s, d)[0]
-        fb_mult = {"dense": 3.5, "flash": 3.5 if flash_fused else 4.5}
+        fb_mult = {"dense": 3.0, "flash": 3.5 if flash_fused else 4.5}
 
         for name, fn in impls.items():
             t_fwd = timeit(fn, q, k, v)
@@ -148,8 +152,8 @@ def main():
                 }
                 if direction == "fwd+bwd":
                     # impl-independent model-FLOPs rate (dense-autodiff
-                    # count) for cross-impl comparison
-                    rec["tflops_model"] = round(flops * 3.5 / t / 1e12, 1)
+                    # 6-matmul count) for cross-impl comparison
+                    rec["tflops_model"] = round(flops * 3.0 / t / 1e12, 1)
                 print(json.dumps(rec), flush=True)
 
 

@@ -62,7 +62,7 @@ def main():
             print(json.dumps({
                 "s": s, "bq": bq_eff, "bk": bk, "fused": fused,
                 "fwd_bwd_ms": round(t * 1e3, 3),
-                "tflops_model": round(flops * 3.5 / t / 1e12, 1),
+                "tflops_model": round(flops * 3.0 / t / 1e12, 1),
             }), flush=True)
 
 

@@ -121,40 +121,103 @@ def main(argv=None):
         new_pG, new_stG, _ = aoptG.step(grads, pG, stG, loss_id=2)
         return new_pG, new_bs["batch_stats"], new_stG
 
-    rep = P()
-    d_jit = jax.jit(shard_map(
-        d_step, mesh=mesh,
-        in_specs=(rep, rep, rep, rep, rep, P("data"), P("data")),
-        out_specs=(rep, rep, rep), check_vma=False))
-    g_jit = jax.jit(shard_map(
-        g_step, mesh=mesh,
-        in_specs=(rep, rep, rep, rep, rep, P("data")),
-        out_specs=(rep, rep, rep), check_vma=False))
+    def gan_step(carry, xs):
+        """One GAN iteration — D update (both losses) then G update
+        against the UPDATED discriminator, the reference's sequential
+        order (main_amp.py:224-253)."""
+        pD, bsD, stD, pG, bsG, stG = carry
+        real, z = xs
+        pD, bsD, stD = d_step(pD, bsD, stD, pG, bsG, real, z)
+        pG, bsG, stG = g_step(pG, bsG, stG, pD, bsD, z)
+        return (pD, bsD, stD, pG, bsG, stG), ()
 
-    shard = NamedSharding(mesh, P("data"))
-    # time steady-state steps only — the first iterations compile both
-    # jitted programs
-    warmup = min(3, max(args.steps - 1, 0))
+    # Both model updates run inside ONE jitted lax.scan per dispatch —
+    # the per-step two-dispatch form left the wall number tunnel-bound
+    # (1,033-1,680 img/s on identical code, r3; VERDICT r3 next #3).
+    # Per-step noise/real batches ride as stacked scan xs.
+    rep = P()
+    on_tpu = jax.devices()[0].platform != "cpu"
+    inner = max(1, min(25 if on_tpu else 2, args.steps))
+    xs_spec = P(None, "data")
+
+    def multi(carry, reals, zs):
+        return jax.lax.scan(gan_step, carry, (reals, zs))[0]
+
+    multi_jit = jax.jit(shard_map(
+        multi, mesh=mesh,
+        in_specs=((rep,) * 6, xs_spec, xs_spec),
+        out_specs=(rep,) * 6, check_vma=False), donate_argnums=(0,))
+
+    shard = NamedSharding(mesh, xs_spec)
+
+    def sample(key):
+        kz, kr = jax.random.split(key)
+        zs = jax.device_put(jax.random.normal(
+            kz, (inner, args.batch_size, 1, 1, args.nz)), shard)
+        reals = jax.device_put(jax.random.normal(
+            kr, (inner, args.batch_size, 64, 64, 3)), shard)
+        return reals, zs
+
+    carry = (pD, bsD, stD, pG, bsG, stG)
+    # warm twice: first compiles; donated outputs can return with layouts
+    # differing from the device_put inputs, recompiling once more
+    for _ in range(2):
+        key, k = jax.random.split(key)
+        carry = multi_jit(carry, *sample(k))
+    jax.block_until_ready(carry[0])
+
+    # model FLOPs for MFU from XLA cost analysis of a SINGLE gan_step
+    # (cost analysis counts a scan body once); DCGAN is all convs — no
+    # Pallas custom calls — so the count is complete
+    from apex_tpu import pyprof
+    one = jax.jit(shard_map(
+        lambda c, r, z: gan_step(c, (r, z))[0], mesh=mesh,
+        in_specs=((rep,) * 6, P("data"), P("data")),
+        out_specs=(rep,) * 6, check_vma=False))
+    # avals suffice: xla_flops only lowers/compiles, never executes
+    r1 = jax.ShapeDtypeStruct((args.batch_size, 64, 64, 3), jnp.float32)
+    z1 = jax.ShapeDtypeStruct((args.batch_size, 1, 1, args.nz),
+                              jnp.float32)
+    flops_step = pyprof.xla_flops(one, carry, r1, z1)
+
+    # primary clock: profiler device time of one inner-step dispatch
+    img_s_dev = 0.0
+    if on_tpu:
+        def once():
+            nonlocal carry, key
+            key, k = jax.random.split(key)
+            carry = multi_jit(carry, *sample(k))
+            jax.block_until_ready(carry[0])
+
+        dev_s = pyprof.device_time_of(once)
+        if dev_s > 0:
+            img_s_dev = args.batch_size * inner / dev_s
+
+    outer = max(1, args.steps // inner)
     t0 = time.perf_counter()
-    for i in range(args.steps):
-        key, kz, kr = jax.random.split(key, 3)
-        z = jax.device_put(
-            jax.random.normal(kz, (args.batch_size, 1, 1, args.nz)), shard)
-        real = jax.device_put(
-            jax.random.normal(kr, (args.batch_size, 64, 64, 3)), shard)
-        pD, bsD, stD = d_jit(pD, bsD, stD, pG, bsG, real, z)
-        pG, bsG, stG = g_jit(pG, bsG, stG, pD, bsD, z)
-        if i + 1 == warmup:
-            jax.block_until_ready(pG)
-            t0 = time.perf_counter()
-        if i % 10 == 0:
-            print(f"step {i}: D scale "
-                  f"{[float(s) for s in stD.scaler.loss_scale]}, "
-                  f"G scale {[float(s) for s in stG.scaler.loss_scale]}")
-    jax.block_until_ready(pG)
+    for _ in range(outer):
+        key, k = jax.random.split(key)
+        carry = multi_jit(carry, *sample(k))
+    jax.block_until_ready(carry[0])
     dt = time.perf_counter() - t0
-    print(f"Speed: {args.batch_size * (args.steps - warmup) / dt:.1f} img/s "
-          f"(excl. {warmup} warmup steps)")
+    pD, bsD, stD, pG, bsG, stG = carry
+    print(f"final: D scale {[float(s) for s in stD.scaler.loss_scale]}, "
+          f"G scale {[float(s) for s in stG.scaler.loss_scale]}")
+    img_s_wall = args.batch_size * outer * inner / dt
+    img_s = img_s_dev if img_s_dev > 0 else img_s_wall
+    import json
+    rec = {"metric": f"dcgan_train_img_per_sec_amp_{args.opt_level}",
+           "value": round(img_s, 1), "unit": "img/s",
+           "clock": "device" if img_s_dev > 0 else "wall",
+           "wall_img_s": round(img_s_wall, 1)}
+    if flops_step:
+        achieved = flops_step * img_s / args.batch_size
+        rec["tflops"] = round(achieved / 1e12, 1)
+        if on_tpu:
+            rec["mfu"] = round(
+                achieved / pyprof.device_peak_flops(), 3)
+    print(json.dumps(rec))
+    print(f"Speed: {img_s:.1f} img/s ({inner} steps/dispatch)")
 
 
 if __name__ == "__main__":
