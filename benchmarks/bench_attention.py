@@ -109,8 +109,13 @@ def main():
         ks = jax.random.split(jax.random.PRNGKey(0), 4)
         q, k, v, g = (jax.random.normal(kk, (b, h, s, d), dtype)
                       for kk in ks)
-        # causal attention FLOPs: 2 matmuls * b*h*s^2*d, halved by the mask
-        flops = 2 * 2 * b * h * s * s * d / 2
+        # model-FLOP convention lives in ONE place (attention.py helper):
+        # fwd = 2 matmuls * 2*b*h*s^2*d, halved by the causal mask
+        from apex_tpu.ops.attention import attention_model_flops
+        flops = attention_model_flops(b, h, s, s, d, causal=True,
+                                      training=False)
+        flops_train = attention_model_flops(b, h, s, s, d, causal=True,
+                                            training=True)
 
         impls = {"flash": lambda q_, k_, v_: flash_attention(q_, k_, v_,
                                                              True)}
@@ -151,9 +156,10 @@ def main():
                     "tflops_achieved": round(flops * mult / t / 1e12, 1),
                 }
                 if direction == "fwd+bwd":
-                    # impl-independent model-FLOPs rate (dense-autodiff
-                    # 6-matmul count) for cross-impl comparison
-                    rec["tflops_model"] = round(flops * 3.0 / t / 1e12, 1)
+                    # impl-independent model-FLOPs rate (the helper's
+                    # dense-autodiff count) for cross-impl comparison
+                    rec["tflops_model"] = round(
+                        flops_train / t / 1e12, 1)
                 print(json.dumps(rec), flush=True)
 
 

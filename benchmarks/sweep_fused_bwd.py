@@ -38,7 +38,8 @@ def main():
         ks = jax.random.split(jax.random.PRNGKey(0), 3)
         q, k, v = (jax.random.normal(kk, (b, h, s, d), jnp.bfloat16)
                    for kk in ks)
-        flops = 2 * 2 * b * h * s * s * d / 2  # causal model FLOPs (fwd)
+        flops_train = A.attention_model_flops(b, h, s, s, d, causal=True,
+                                              training=True)
 
         def loss(q_, k_, v_):
             return jnp.sum(A.flash_attention(q_, k_, v_, True)
@@ -62,7 +63,7 @@ def main():
             print(json.dumps({
                 "s": s, "bq": bq_eff, "bk": bk, "fused": fused,
                 "fwd_bwd_ms": round(t * 1e3, 3),
-                "tflops_model": round(flops * 3.0 / t / 1e12, 1),
+                "tflops_model": round(flops_train / t / 1e12, 1),
             }), flush=True)
 
 

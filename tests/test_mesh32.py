@@ -1,0 +1,56 @@
+"""The 32-device north-star topology, built virtually (VERDICT r3 next
+#5): BASELINE row 4 is "BERT-large FusedLAMB, 32 chips"; the conftest
+pins this pytest process to 8 virtual devices, so the 32-device mesh runs
+in a subprocess with its own ``--xla_force_host_platform_device_count``.
+
+What it proves: the ZeRO-LAMB step (DistributedFusedLAMB — the analog of
+the reference's apex/contrib/optimizers/distributed_fused_lamb.py)
+compiles, shards its state 32 ways, and reproduces the dense FusedLAMB
+trajectory on the real bert-large leaf structure at that width.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+WORKER = os.path.join(os.path.dirname(__file__), "mesh32_worker.py")
+
+
+def _parse(stdout: str):
+    for line in stdout.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    return None
+
+
+def test_bert_shaped_zero_lamb_on_32_device_mesh():
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=32",
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, WORKER], env=env, capture_output=True,
+            text=True, timeout=900)
+    except OSError as e:
+        pytest.skip(f"cannot spawn subprocess: {e}")
+
+    assert proc.returncode == 0, (
+        f"32-device worker failed (rc={proc.returncode}):\n"
+        f"{proc.stderr[-3000:]}")
+    out = _parse(proc.stdout)
+    assert out is not None, f"no RESULT line:\n{proc.stdout}"
+
+    assert out["world"] == 32
+    # real bert-large leaf structure: 24 layers x (QKV + out-proj + 2 LN +
+    # 2 MLP matmuls, each with bias) + embeddings + final LN = 294 leaves
+    assert out["n_leaves"] >= 290, out
+    # sharded 32 ways: each device holds exactly padded/32 master elems
+    assert out["num_shards"] == 32, out
+    assert out["master_shard_elems"] * 32 == out["master_global_elems"], out
+    # trajectory parity with the dense optimizer (3 LAMB steps)
+    assert out["max_diff_vs_dense"] < 3e-5, out

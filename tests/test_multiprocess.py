@@ -16,7 +16,10 @@ import numpy as np
 import pytest
 
 WORKER = os.path.join(os.path.dirname(__file__), "distributed_worker.py")
-KEYS = ("grad_norm", "param_sum", "param_norm", "master_psum")
+KEYS = ("grad_norm", "param_sum", "param_norm", "master_psum",
+        # hybrid dwu_group_size form: (group=2, data=4) mesh whose
+        # cross-group allreduce axis SPANS the two processes
+        "hyb_param_sum", "hyb_param_norm", "hyb_master_psum")
 
 
 def _free_port() -> int:
@@ -99,3 +102,8 @@ def test_two_process_ddp_zero_matches_single_process():
         np.testing.assert_allclose(outs[0][k], want[k], rtol=1e-5,
                                    err_msg=f"{k} differs between 2-process "
                                    "and single-process execution")
+    # hybrid step numerically equals the dense FusedAdam step on the
+    # mean gradient (sum-of-params anchor; leaf-wise parity is covered
+    # single-process) in BOTH processes
+    for out in outs:
+        assert out["hyb_dense_diff"] < 1e-3, out
