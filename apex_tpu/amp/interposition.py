@@ -40,8 +40,16 @@ def _active_dtype():
     return getattr(_state, "cast_dtype", None)
 
 
+
+
 def _cast_tree(args, kwargs, convert):
     def conv(x):
+        # NOT dtype objects: np scalar TYPES expose a .dtype class attr,
+        # so a dtype argument (e.g. preferred_element_type=jnp.float32)
+        # would otherwise be "cast" — x.astype on a class raises (r4 fix,
+        # surfaced by the convergence gate's O1 ResNet run)
+        if isinstance(x, (type, jnp.dtype)):
+            return x
         if isinstance(x, (jax.Array, jnp.ndarray)) or hasattr(x, "dtype"):
             try:
                 dt = jnp.dtype(x.dtype)
@@ -160,7 +168,17 @@ def autocast(dtype=jnp.bfloat16):
 
 @contextlib.contextmanager
 def disable_casts():
-    """Parity with ``amp.disable_casts`` (apex/amp/handle.py:48-56)."""
+    """Parity with ``amp.disable_casts`` (apex/amp/handle.py:48-56).
+
+    Also the kernel-tracing guard: the Pallas ops wrap their
+    pallas_call-invoking entry points in this (ops/_amp_guard.no_amp) —
+    the patched jax.lax.dot_general is GLOBAL, so without it an amp-O1
+    model would have its flash kernels' INTERNAL f32 operands cast to
+    f16 inside the Mosaic kernel body (Mosaic has no f16 → compile
+    error; under O4 the same path silently degrades in-kernel precision
+    to bf16). Kernels own their precision schedule; amp governs the
+    graph around them (r4 fix, surfaced by the convergence gate's O1
+    GPT config)."""
     prev = _active_dtype()
     _state.cast_dtype = None
     try:

@@ -30,8 +30,15 @@ def _norm_size(normalized_shape: Shape) -> int:
     return int(np.prod(tuple(normalized_shape)))
 
 
-def _use_pallas(d: int) -> bool:
+def _use_pallas(d: int, dtype=None) -> bool:
     import os
+    # Mosaic has no f16: fp16 activations (amp O1/O2 interposition) ride
+    # the XLA fallback, which is f32 internally anyway — the same policy
+    # as ops/multi_tensor's fp16-routes-to-jnp (r4: surfaced by the
+    # convergence gate's O1 GPT run; overrides APEX_TPU_MT_BACKEND=pallas)
+    if dtype is not None and jnp.dtype(dtype) == jnp.float16 \
+            and jax.default_backend() in ("tpu", "axon"):
+        return False
     force = os.environ.get("APEX_TPU_MT_BACKEND", "auto")
     if force == "jnp":
         return False
@@ -82,7 +89,7 @@ def layer_norm(x: jax.Array, weight: Optional[jax.Array] = None,
     b = (jnp.zeros((d,), jnp.float32) if bias is None
          else bias.reshape(-1).astype(jnp.float32))
 
-    if _use_pallas(d):
+    if _use_pallas(d, x2d.dtype):
         y2d = _layer_norm_pallas(x2d, w, b, eps)
     else:
         x32 = x2d.astype(jnp.float32)

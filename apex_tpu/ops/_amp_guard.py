@@ -1,0 +1,30 @@
+"""Kernel-tracing guard against amp interposition.
+
+amp O1/O4 patch ``jax.lax.dot_general`` (and friends) GLOBALLY, and
+Pallas kernel bodies are traced at pallas_call time — inside the amp
+context of a model forward. Without suspension a kernel's INTERNAL f32
+MXU operands get cast to the amp dtype in-kernel: f16 does not even
+compile under Mosaic, and bf16 would silently override the kernel's own
+precision schedule. Every Pallas module decorates its
+pallas_call-invoking entry points with :func:`no_amp` so the hazard is
+closed as a CLASS, not per-kernel (r4; surfaced by the convergence
+gate's O1 GPT config).
+
+Lives in ops (not amp) so ops modules can import it at module level —
+amp.scaler imports ops, so the reverse import must stay lazy.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def no_amp(fn):
+    """Run ``fn`` (a Pallas kernel-wrapper entry point) with amp
+    interposition casting suspended for its dynamic extent."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        from apex_tpu.amp.interposition import disable_casts
+        with disable_casts():
+            return fn(*args, **kwargs)
+    return wrapper

@@ -29,6 +29,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from apex_tpu.ops._amp_guard import no_amp as _no_amp
+
 NEG_INF = -1e30
 LOG2E = 1.4426950408889634   # log2(e): softmax runs in base-2 (exp2 is the
 LN2 = 0.6931471805599453     # VPU-native exponential; exp costs an extra
@@ -333,6 +335,7 @@ def _pick_block(pref: int, s: int) -> int:
     return max(128, min(best, pref))
 
 
+@_no_amp
 def _flash_fwd(q, k, v, *, causal: bool, scale: float,
                dropout_rate: float = 0.0, dropout_seed=None,
                bias=None, block_q: int = 1024, block_k: int = 1024):
@@ -639,6 +642,7 @@ def _fused_bwd_plan(sq: int, d: int) -> Tuple[bool, int]:
     return fused, bq_cap
 
 
+@_no_amp
 def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
                dropout_rate: float = 0.0, dropout_seed=None,
                bias=None, block_q: Optional[int] = None,
@@ -843,6 +847,18 @@ def flash_attention(q, k, v, causal: bool = False,
         bias_arr = jax.lax.stop_gradient(jnp.asarray(bias))
     else:
         bias_arr = jnp.zeros((1, 1, 1, 1), jnp.float32)
+    # Mosaic has no f16 (fp16 amp levels O1/O2 cast q/k/v to float16):
+    # run the kernels in bf16 and cast back — the in-kernel softmax/lse
+    # chain is f32 either way, so only the MXU operand dtype changes.
+    # The cast sits OUTSIDE the custom_vjp, so autodiff casts the f16
+    # cotangents the same way (the fp16 analog of multi_tensor's
+    # fp16-routes-to-jnp policy; interpret mode runs f16 natively).
+    if q.dtype == jnp.float16 and not _interpret():
+        out = _flash_attention_core(
+            q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+            v.astype(jnp.bfloat16), bias_arr, seed, causal, scale, rate,
+            has_bias)
+        return out.astype(jnp.float16)
     return _flash_attention_core(q, k, v, bias_arr, seed, causal, scale,
                                  rate, has_bias)
 
@@ -1072,6 +1088,13 @@ def ring_self_attention(q, k, v, axis_name: str, *, causal: bool = False,
         has_bias = bias is not None
         bias_arr = (jax.lax.stop_gradient(bias) if has_bias
                     else jnp.zeros((1, 1, 1, 1), jnp.float32))
+        if q.dtype == jnp.float16 and not _interpret():
+            # Mosaic has no f16 — bf16 reroute, see flash_attention
+            o = _ring_flash_core(
+                q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+                v.astype(jnp.bfloat16), bias_arr, axis_name, causal,
+                scale_, has_bias)
+            return o.astype(jnp.float16)
         return _ring_flash_core(q, k, v, bias_arr, axis_name, causal,
                                 scale_, has_bias)
 

@@ -22,6 +22,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from apex_tpu.ops._amp_guard import no_amp as _no_amp
+
 LANES = 128
 VMEM_BUDGET = 4 * 1024 * 1024  # per operand block
 
@@ -55,6 +57,7 @@ def _ln_fwd_kernel(eps, x_ref, w_ref, b_ref, y_ref, mu_ref, rstd_ref):
     rstd_ref[:] = rstd
 
 
+@_no_amp
 def ln_fwd(x2d: jax.Array, w: jax.Array, b: jax.Array, eps: float
            ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     n, d = x2d.shape
@@ -111,6 +114,7 @@ def _ln_bwd_kernel(x_ref, w_ref, mu_ref, rstd_ref, dy_ref,
     db_ref[:] += jnp.sum(dy, axis=0, keepdims=True)
 
 
+@_no_amp
 def ln_bwd(x2d, w, mu, rstd, dy2d):
     n, d = x2d.shape
     rows = _rows_per_block(d)

@@ -25,6 +25,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from apex_tpu.ops._amp_guard import no_amp as _no_amp
+
 LANES = 128
 VMEM_BUDGET = 4 * 1024 * 1024
 
@@ -79,6 +81,7 @@ def _moments_kernel(nblocks, rows_actual, br, x_ref, s_ref, ss_ref,
         ss_ref[:] = acc_ss[:]
 
 
+@_no_amp
 def _moments_2d(x2d: jax.Array) -> Tuple[jax.Array, jax.Array]:
     n, c = x2d.shape
     if c % LANES != 0:  # narrow-C fold (see supported())
@@ -107,6 +110,7 @@ def _moments_2d(x2d: jax.Array) -> Tuple[jax.Array, jax.Array]:
 
 
 @jax.custom_vjp
+@_no_amp
 def fused_sum_sumsq(x2d: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """One-pass per-channel (sum, sum_sq) over a (rows, C) array, fp32
     accumulation regardless of input dtype. C must be a lane multiple

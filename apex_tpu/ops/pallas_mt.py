@@ -30,6 +30,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from apex_tpu.ops._amp_guard import no_amp as _no_amp
+
 from apex_tpu.ops import buckets as _buckets
 
 Tree = Any
@@ -77,6 +79,7 @@ def _scale_kernel(scale_ref, x_ref, y_ref, of_ref):
     of_ref[0, 0] = jnp.maximum(of_ref[0, 0], bad.astype(jnp.int32))
 
 
+@_no_amp
 def scale_flat(x: jax.Array, scale: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Fused out = x*scale + nonfinite detect on one flat bucket."""
     xb, n = _as_blocked(x)
@@ -120,6 +123,7 @@ def _axpby_kernel(ab_ref, x_ref, y_ref, out_ref, of_ref):
     of_ref[0, 0] = jnp.maximum(of_ref[0, 0], bad.astype(jnp.int32))
 
 
+@_no_amp
 def axpby_flat(a, x: jax.Array, b, y: jax.Array) -> Tuple[jax.Array, jax.Array]:
     xb, n = _as_blocked(x)
     yb, _ = _as_blocked(y)
@@ -161,6 +165,7 @@ def _l2norm_kernel(x_ref, acc_ref):
     acc_ref[0, 0] += jnp.sum(x * x)
 
 
+@_no_amp
 def l2norm_sq_flat(x: jax.Array) -> jax.Array:
     """Sum of squares of one flat bucket (fp32 scalar)."""
     xb, _ = _as_blocked(x)
@@ -201,6 +206,7 @@ def _adam_kernel(adam_w_mode, c_ref, g_ref, p_ref, m_ref, v_ref,
     v_out[:] = v.astype(v_out.dtype)
 
 
+@_no_amp
 def adam_flat(g: jax.Array, p: jax.Array, m: jax.Array, v: jax.Array, *,
               lr, beta1, beta2, eps, bc1, bc2, adam_w_mode, weight_decay,
               inv_scale=None) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -290,6 +296,7 @@ def _l2norm_seg_kernel(x_ref, starts_ref, ends_ref, acc_ref):
     acc_ref[:] += jnp.sum(rowsq * onehot, axis=0, keepdims=True)
 
 
+@_no_amp
 def l2norm_sq_seg_flat(x: jax.Array, spec) -> jax.Array:
     """Per-tensor sums of squares of one LANES-aligned bucket -> (T,) fp32."""
     starts, ends, t_pad = _seg_bounds(spec)
@@ -340,6 +347,7 @@ def _sgd_kernel(use_momentum, nesterov, wd_after_momentum, n_out,
         out_refs[2][:] = p_new.astype(out_refs[2].dtype)
 
 
+@_no_amp
 def sgd_flat(g: jax.Array, p: jax.Array, m: jax.Array, *, lr, weight_decay,
              momentum, dampening, nesterov, wd_after_momentum, first,
              scale=1.0, model_dtype=None):
@@ -405,6 +413,7 @@ def _adagrad_kernel(adagrad_w_mode, c_ref, g_ref, p_ref, h_ref, p_out, h_out):
     h_out[:] = h.astype(h_out.dtype)
 
 
+@_no_amp
 def adagrad_flat(g: jax.Array, p: jax.Array, h: jax.Array, *, lr, eps,
                  weight_decay, adagrad_w_mode=False, scale=1.0):
     """Fused Adagrad on one flat bucket (csrc/multi_tensor_adagrad.cu)."""
@@ -479,6 +488,7 @@ def _lamb_stage2_kernel(c_ref, p_ref, u_ref, ratios_ref, starts_ref, ends_ref,
     p_out[:] = (p - c_ref[0] * ratio_row * u).astype(p_out.dtype)
 
 
+@_no_amp
 def lamb_flat(g: jax.Array, p: jax.Array, m: jax.Array, v: jax.Array, spec, *,
               lr, beta1, beta2, beta3, eps, bc1, bc2, adam_w_mode,
               weight_decay, inv_clip, use_ratio,
@@ -566,6 +576,7 @@ def _novograd_kernel(c_ref, g_ref, p_ref, m_ref, denom_ref, starts_ref,
     m_out[:] = m.astype(m_out.dtype)
 
 
+@_no_amp
 def novograd_flat(g: jax.Array, p: jax.Array, m: jax.Array, denoms: jax.Array,
                   spec, *, lr, beta1, beta3, bc1, weight_decay, scale=1.0,
                   ) -> Tuple[jax.Array, jax.Array]:

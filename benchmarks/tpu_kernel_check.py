@@ -108,21 +108,21 @@ def main():
     from apex_tpu.ops.attention import attention_reference, flash_attention
 
     def attn_cmp(name, causal, sq, sk, bias_shape=None, rate=0.0,
-                 rtol=2e-2, atol=2e-2):
+                 rtol=2e-2, atol=2e-2, dtype=jnp.bfloat16):
         import zlib
         ks = jax.random.split(
             jax.random.PRNGKey(zlib.crc32(name.encode()) % 2**31), 5)
         b, h, d = 2, 2, 64
-        q = jax.random.normal(ks[0], (b, h, sq, d), jnp.bfloat16)
-        k = jax.random.normal(ks[1], (b, h, sk, d), jnp.bfloat16)
-        v = jax.random.normal(ks[2], (b, h, sk, d), jnp.bfloat16)
+        q = jax.random.normal(ks[0], (b, h, sq, d), dtype)
+        k = jax.random.normal(ks[1], (b, h, sk, d), dtype)
+        v = jax.random.normal(ks[2], (b, h, sk, d), dtype)
         bias = (jax.random.normal(ks[3], bias_shape) * 2.0
                 if bias_shape else None)
         if bias_shape and "posbias" in name:
             # large POSITIVE additive bias: the r3 padded-lse bug overflowed
             # p to inf on padded query rows when sq wasn't a block multiple
             bias = jnp.abs(bias) + 100.0
-        gg = jax.random.normal(ks[4], (b, h, sq, d), jnp.bfloat16)
+        gg = jax.random.normal(ks[4], (b, h, sq, d), dtype)
 
         def run(fn):
             out, vjp = jax.vjp(
@@ -150,6 +150,10 @@ def main():
     # ragged sq + positive bias: padded-lse regression (r3 ADVICE medium)
     attn_cmp("flash_posbias_ragged", False, 200, 200,
              bias_shape=(1, 1, 200, 200), rtol=6e-2, atol=6e-2)
+    # fp16 inputs (amp O1/O2): Mosaic has no f16 — the bf16 reroute must
+    # keep fwd+grads finite and near the (f16-run) jnp reference
+    attn_cmp("flash_fp16_reroute", True, 512, 512, dtype=jnp.float16,
+             rtol=6e-2, atol=6e-2)
     # force the two-pass long-context fallback on hardware too
     import apex_tpu.ops.attention as _A
     _saved = _A._FUSED_BWD_DQ_SCRATCH_BYTES

@@ -1,0 +1,40 @@
+"""Convergence gate, CPU tier (VERDICT r3 next #7): the stack must
+OPTIMIZE — several-hundred-step memorization on fixed synthetic data —
+not merely step 20 times like the L1 trajectory tier. Full-size on-chip
+runs live in ``benchmarks/convergence_gate.py`` (endpoints recorded in
+BASELINE.md); this runs its ``--quick`` tier: ResNet-18 to 100% train
+accuracy and GPTTiny to near-zero loss at O1 and O5."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+GATE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks", "convergence_gate.py")
+
+
+def test_quick_convergence_gate():
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=1")
+    try:
+        proc = subprocess.run(
+            [sys.executable, GATE, "--quick"], env=env,
+            capture_output=True, text=True, timeout=1200)
+    except OSError as e:
+        pytest.skip(f"cannot spawn subprocess: {e}")
+
+    recs = [json.loads(l) for l in proc.stdout.splitlines()
+            if l.startswith("{")]
+    assert proc.returncode == 0, (
+        f"gate failed (rc={proc.returncode}):\n{proc.stdout}\n"
+        f"{proc.stderr[-2000:]}")
+    assert len(recs) == 4, recs  # 2 models x 2 opt levels
+    for r in recs:
+        assert r["ok"], r
+        assert r["loss_last10_mean"] < r["loss_thresh"], r
+    accs = [r["final_train_acc"] for r in recs
+            if "final_train_acc" in r]
+    assert accs and all(a >= 0.99 for a in accs), recs
