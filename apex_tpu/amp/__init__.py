@@ -16,6 +16,7 @@ from apex_tpu.amp.frontend import (
     master_params,
     is_batchnorm_path,
     bn_predicate_from_model,
+    bn_predicate_from_batch_stats,
 )
 from apex_tpu.amp.handle import init, AmpHandle, NoOpHandle
 from apex_tpu.amp.interposition import (

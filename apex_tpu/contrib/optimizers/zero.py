@@ -203,6 +203,49 @@ class _ZeroBase(FusedOptimizer):
                 "its own mesh axis and pass group_axis for the cross-group "
                 "reduction axis.")
 
+    def layout_fingerprint(self, params: Tree) -> dict:
+        """The facts that determine ZeroState's flat layout (r3 ADVICE:
+        the bucket-shard-interleaved layout depends on chunk_elements /
+        shard_count / the leaf structure, and a checkpoint saved under a
+        DIFFERENT layout restores into a scrambled master with no error —
+        nothing in the arrays records the layout). Save this next to the
+        state (plain dict of ints — any checkpointer can carry it) and
+        call :meth:`check_layout` after restore."""
+        # Always pack THESE params — the cache may hold an earlier tree's
+        # spec, and a fingerprint of the wrong tree defeats the guard
+        # (_pack is idempotent host-side bookkeeping).
+        spec = self._pack(params)
+        import zlib
+        structure = repr((tuple(spec["shapes"]),
+                          jax.tree_util.tree_structure(params)))
+        return {
+            "chunk_elements": int(self.chunk_elements),
+            "shard_count": int(self.shard_count),
+            "total": int(spec["total"]),
+            "padded": int(spec["padded"]),
+            "n_buckets": len(spec["buckets"]),
+            # leaf ORDER and shapes determine the interleaved layout even
+            # when the aggregate counts coincide (two equal-size layers
+            # swapped, a transposed kernel, ...)
+            "structure_crc32": int(zlib.crc32(structure.encode())),
+        }
+
+    def check_layout(self, saved: dict, params: Tree) -> None:
+        """Raise if a restored ZeroState's recorded layout differs from
+        the layout THIS optimizer would use for ``params`` — the loud
+        failure that replaces silent master/moment scrambling when
+        chunk_elements / shard_count changed between save and load."""
+        current = self.layout_fingerprint(params)
+        bad = {k: (saved.get(k), v) for k, v in current.items()
+               if saved.get(k) != v}
+        if bad:
+            raise ValueError(
+                "ZeroState layout mismatch — the checkpoint was saved "
+                "under a different flat layout and would restore "
+                f"scrambled. saved vs current: {bad}. Re-create the "
+                "optimizer with the saved chunk_elements/shard_count, or "
+                "re-initialize the state from params.")
+
     def state_pspec(self) -> ZeroState:
         """PartitionSpecs for shard_map in_specs/out_specs of the state.
 

@@ -630,3 +630,94 @@ def test_bn_predicate_from_model_type_keyed():
     m2 = SyncNet()
     pred2 = amp.bn_predicate_from_model(m2, jax.random.PRNGKey(0), x)
     assert pred2.bn_module_paths == frozenset({"tracker"})
+
+
+def test_cast_model_variables_dict_auto_bn_detection():
+    """VERDICT r3 next #8: with the model in hand — the full variables
+    dict — oddly-named BN stays fp32 under O2/O5 WITHOUT any user
+    action: every module path holding batch_stats is typed as BN
+    (amp.bn_predicate_from_batch_stats), no regex, no trace."""
+    import flax.linen as nn
+
+    class WeirdNet(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = nn.Dense(8, name="proj")(x)
+            x = nn.BatchNorm(use_running_average=not train,
+                             name="stats_gadget")(x)
+            return nn.Dense(4, name="head")(x)
+
+    x = jnp.ones((2, 8))
+    variables = WeirdNet().init(jax.random.PRNGKey(0), x)
+
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        cast = amp.cast_model(
+            variables, amp.resolve("O5", keep_batchnorm_fp32=True))
+    # params cast; the oddly-named BN kept fp32 by TYPE (batch_stats)
+    assert cast["params"]["stats_gadget"]["scale"].dtype == jnp.float32
+    assert cast["params"]["stats_gadget"]["bias"].dtype == jnp.float32
+    assert cast["params"]["proj"]["kernel"].dtype == jnp.bfloat16
+    assert cast["params"]["head"]["kernel"].dtype == jnp.bfloat16
+    # stats returned unconverted (always fp32)
+    assert cast["batch_stats"]["stats_gadget"]["mean"].dtype == jnp.float32
+
+    # the standalone predicate is exported and introspectable
+    pred = amp.bn_predicate_from_batch_stats(variables["batch_stats"])
+    assert pred.bn_module_paths == frozenset({"stats_gadget"})
+    assert pred(("stats_gadget", "scale"))
+    assert not pred(("proj", "kernel"))
+
+    # a bare params tree (no model in hand) still rides the regex path
+    bare = amp.cast_model(
+        variables["params"], amp.resolve("O5"))
+    assert bare["proj"]["kernel"].dtype == jnp.bfloat16
+
+
+def test_cast_model_frozen_variables_and_root_bn():
+    """Review follow-ups: FrozenDict variables take the auto-BN path
+    (Mapping, not dict), and a bare-BatchNorm model's single-segment
+    batch_stats mark the ROOT as BN."""
+    import flax
+    import flax.linen as nn
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = nn.Dense(8, name="proj")(x)
+            return nn.BatchNorm(use_running_average=not train,
+                                name="odd_stats")(x)
+
+    x = jnp.ones((2, 8))
+    frozen = flax.core.freeze(Net().init(jax.random.PRNGKey(0), x))
+    cast = amp.cast_model(frozen, amp.resolve("O5"))
+    assert isinstance(cast, type(frozen))
+    assert cast["params"]["odd_stats"]["scale"].dtype == jnp.float32
+    assert cast["params"]["proj"]["kernel"].dtype == jnp.bfloat16
+    assert cast["batch_stats"]["odd_stats"]["mean"].dtype == jnp.float32
+
+    # root module IS the batchnorm: batch_stats has single-segment paths
+    bn = nn.BatchNorm(use_running_average=True)
+    v = bn.init(jax.random.PRNGKey(1), x)
+    pred = amp.bn_predicate_from_batch_stats(v["batch_stats"])
+    assert pred(("scale",)) and pred(("bias",))
+    cast2 = amp.cast_model(v, amp.resolve("O5", keep_batchnorm_fp32=True))
+    assert cast2["params"]["scale"].dtype == jnp.float32
+
+
+def test_zero_fingerprint_catches_leaf_structure_swap():
+    """Aggregate counts can coincide while the interleaved layout
+    differs: swapping two equal-sized leaves must still fail the guard."""
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+
+    a = {"w1": jnp.ones((4, 4)), "w2": jnp.zeros((16,)),
+         "z": jnp.ones((3,))}
+    # same sizes, different leaf order/shapes
+    b = {"w1": jnp.ones((16,)), "w2": jnp.zeros((4, 4)),
+         "z": jnp.ones((3,))}
+    opt = DistributedFusedAdam(lr=1e-3, shard_count=1, chunk_elements=8)
+    fp = opt.layout_fingerprint(a)
+    opt.check_layout(fp, a)
+    with pytest.raises(ValueError, match="layout mismatch"):
+        opt.check_layout(fp, b)

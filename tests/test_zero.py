@@ -257,3 +257,34 @@ def test_zero_chunked_collective_structure(mesh):
         check_vma=False)).lower(params, params, state).as_text()
     assert len(re.findall(r"reduce_scatter", low)) == n_buckets
     assert len(re.findall(r'"stablehlo.all_gather"', low)) == n_buckets
+
+
+def test_zero_layout_fingerprint_guards_restore(mesh):
+    """r3 ADVICE: ZeroState's flat layout depends on chunk_elements /
+    shard_count and nothing in the arrays records it — a checkpoint
+    restored under a different layout scrambles silently. The
+    fingerprint + check_layout pair makes that a loud failure."""
+    params = tree_params(jax.random.PRNGKey(9))
+    opt = DistributedFusedAdam(lr=1e-2, axis_name="data", shard_count=NDEV,
+                               chunk_elements=128)
+    fp = opt.layout_fingerprint(params)
+    assert fp["shard_count"] == NDEV and fp["chunk_elements"] == 128
+    assert fp["padded"] >= fp["total"] > 0 and fp["n_buckets"] >= 2
+
+    # same config: passes
+    opt.check_layout(fp, params)
+    # a JSON round-trip (how checkpoints would carry it): still passes
+    import json as _json
+    opt.check_layout(_json.loads(_json.dumps(fp)), params)
+
+    # different chunk_elements (the r3 layout change): loud failure
+    opt2 = DistributedFusedAdam(lr=1e-2, axis_name="data",
+                                shard_count=NDEV, chunk_elements=2 ** 23)
+    with pytest.raises(ValueError, match="layout mismatch"):
+        opt2.check_layout(fp, params)
+
+    # different shard_count: loud failure
+    opt3 = DistributedFusedAdam(lr=1e-2, axis_name="data", shard_count=4,
+                                chunk_elements=128)
+    with pytest.raises(ValueError, match="layout mismatch"):
+        opt3.check_layout(fp, params)
