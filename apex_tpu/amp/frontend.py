@@ -172,12 +172,15 @@ def cast_model(params: Tree,
     # the params subtree. Mapping, not dict: flax FrozenDict variables
     # (flax.core.freeze / older flax) must take this path too — treating
     # them as a bare params tree would cast batch_stats to low precision
-    # and miss the typed BN detection entirely.
+    # and miss the typed BN detection entirely. ANY top-level "params"
+    # key selects this path (so {'params', 'cache'} returns cache
+    # unconverted rather than casting it); the pathological bare params
+    # tree containing a top-level MODULE literally named "params" must
+    # cast its subtrees separately.
     import collections.abc
     if (isinstance(params, collections.abc.Mapping)
             and not isinstance(params, jnp.ndarray)
-            and "params" in params
-            and ("batch_stats" in params or len(params) == 1)):
+            and "params" in params):
         pred = bn_predicate
         if pred is None and "batch_stats" in params:
             pred = bn_predicate_from_batch_stats(params["batch_stats"])

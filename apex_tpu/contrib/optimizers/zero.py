@@ -212,22 +212,32 @@ class _ZeroBase(FusedOptimizer):
         state (plain dict of ints — any checkpointer can carry it) and
         call :meth:`check_layout` after restore."""
         # Always pack THESE params — the cache may hold an earlier tree's
-        # spec, and a fingerprint of the wrong tree defeats the guard
-        # (_pack is idempotent host-side bookkeeping).
-        spec = self._pack(params)
+        # spec, and a fingerprint of the wrong tree defeats the guard —
+        # but restore the cache afterwards: _pack overwrites it, and
+        # fingerprinting a CANDIDATE tree must not poison the spec a live
+        # step() will reuse for the training tree.
+        prev = self._spec_cache
+        try:
+            spec = self._pack(params)
+        finally:
+            self._spec_cache = prev
         import zlib
-        structure = repr((tuple(spec["shapes"]),
-                          jax.tree_util.tree_structure(params)))
+
+        from apex_tpu.utils import path_str
+        # leaf ORDER and shapes determine the interleaved layout even
+        # when the aggregate counts coincide (two equal-size layers
+        # swapped, a transposed kernel, ...). Hash canonical
+        # (path, shape) pairs — NOT PyTreeDef repr, whose format is not
+        # stable across jax versions.
+        pairs = [(path_str(p), tuple(l.shape)) for p, l in
+                 jax.tree_util.tree_flatten_with_path(params)[0]]
         return {
             "chunk_elements": int(self.chunk_elements),
             "shard_count": int(self.shard_count),
             "total": int(spec["total"]),
             "padded": int(spec["padded"]),
             "n_buckets": len(spec["buckets"]),
-            # leaf ORDER and shapes determine the interleaved layout even
-            # when the aggregate counts coincide (two equal-size layers
-            # swapped, a transposed kernel, ...)
-            "structure_crc32": int(zlib.crc32(structure.encode())),
+            "structure_crc32": int(zlib.crc32(repr(pairs).encode())),
         }
 
     def check_layout(self, saved: dict, params: Tree) -> None:

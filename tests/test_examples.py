@@ -63,3 +63,17 @@ def test_gpt_example_smoke(sp):
         argv += ["--seq-parallel", sp]
     tok_s = _run("examples/gpt/train_lm.py", argv)
     assert tok_s > 0
+
+
+@pytest.mark.parametrize("sp", [None, "ring"])
+def test_gpt_example_scan_mode_smoke(sp):
+    """--scan N: dispatch-proof mode (N steps per jitted scan dispatch,
+    on-device token generation) must train on both the dense and the
+    seq-parallel paths."""
+    argv = ["--vocab", "512", "--layers", "2", "--embed-dim", "128",
+            "--heads", "8", "--batch-size", "1", "--seq-len", "128",
+            "--steps", "4", "--scan", "2"]
+    if sp:
+        argv += ["--seq-parallel", sp]
+    tok_s = _run("examples/gpt/train_lm.py", argv)
+    assert tok_s > 0
