@@ -32,8 +32,14 @@ def _interpret() -> bool:
     return jax.default_backend() not in ("tpu", "axon")
 
 
-def _rows_per_block(d: int) -> int:
-    rows = max(8, min(1024, VMEM_BUDGET // (4 * d)))
+def _rows_per_block(d: int, arrays: int = 1) -> int:
+    """Row-block height for a VMEM budget of ``VMEM_BUDGET`` bytes per
+    ``arrays`` live (rows, d) f32 working arrays. The BACKWARD passes
+    ``arrays=2``: its kernel keeps ~6 live row-blocks (x, dy, xhat, wdy,
+    dx + casts) vs the forward's ~2, and at d=768 the shared 1024-row
+    block blew the 16 MB scoped VMEM limit by 3.3 MB (r4, surfaced by a
+    GPT-small 16k run)."""
+    rows = max(8, min(1024, VMEM_BUDGET // (4 * d * arrays)))
     return (rows // 8) * 8
 
 
@@ -117,7 +123,7 @@ def _ln_bwd_kernel(x_ref, w_ref, mu_ref, rstd_ref, dy_ref,
 @_no_amp
 def ln_bwd(x2d, w, mu, rstd, dy2d):
     n, d = x2d.shape
-    rows = _rows_per_block(d)
+    rows = _rows_per_block(d, arrays=2)
     padded = ((n + rows - 1) // rows) * rows
     if padded != n:
         x2d = jnp.pad(x2d, ((0, padded - n), (0, 0)))
