@@ -128,6 +128,16 @@ class SelfMultiheadAttn(nn.Module):
     # called under shard_map with the sequence dim sharded on `axis_name`.
     seq_parallel: Optional[str] = None    # None | 'ring' | 'ulysses'
     axis_name: Optional[str] = None
+    # Megatron-style tensor parallelism (parallel/tensor_parallel.py):
+    # constructed with num_heads = H // tp and head-sharded params, the
+    # module brackets its column->row parallel region with the f/g
+    # conjugate collectives over this axis. Mutually exclusive with
+    # seq_parallel (which shards the SEQUENCE, not the heads).
+    tensor_parallel_axis: Optional[str] = None
+    # tp degree: column-parallel layer widths are divided by this (flax
+    # validates param shapes at apply, so the local module must declare
+    # the LOCAL feature sizes). num_heads must also be the local count.
+    tensor_parallel_size: int = 1
 
     @nn.compact
     def __call__(self, x, *, attn_mask: Optional[jax.Array] = None,
@@ -135,11 +145,33 @@ class SelfMultiheadAttn(nn.Module):
                  dropout_rng: Optional[jax.Array] = None):
         e, h = self.embed_dim, self.num_heads
         assert e % h == 0, "embed_dim must divide num_heads"
+        if self.tensor_parallel_axis and self.seq_parallel:
+            raise NotImplementedError(
+                "tensor_parallel_axis and seq_parallel are mutually "
+                "exclusive on one module — put them on different mesh "
+                "axes via separate modules/layers")
+        if self.tensor_parallel_size > 1:
+            if e % self.tensor_parallel_size:
+                raise ValueError(
+                    f"tensor_parallel_size ({self.tensor_parallel_size}) "
+                    f"must divide embed_dim ({e}) — silent floor "
+                    "division would mis-size the local projections")
+            if self.dropout > 0.0 and not deterministic:
+                raise NotImplementedError(
+                    "tensor-parallel attention does not yet fold the TP "
+                    "rank into the dropout mask — every rank would drop "
+                    "the SAME pattern on its head shard, silently "
+                    "diverging from the dense model; train with "
+                    "dropout=0 under tensor parallelism")
         residual = x
         if self.include_norm_add:
             x = FusedLayerNorm(normalized_shape=e)(x)
 
-        qkv = nn.Dense(3 * e, use_bias=self.bias, name="in_proj",
+        if self.tensor_parallel_axis:
+            from apex_tpu.parallel.tensor_parallel import tp_region_enter
+            x = tp_region_enter(x, self.tensor_parallel_axis)
+        qkv = nn.Dense(3 * e // self.tensor_parallel_size,
+                       use_bias=self.bias, name="in_proj",
                        dtype=self.dtype)(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = _split_heads(q, h)
@@ -183,7 +215,10 @@ class SelfMultiheadAttn(nn.Module):
                                   dropout_rate=rate, dropout_seed=seed,
                                   bias=_mask_to_bias(attn_mask))
         else:
-            scale = 1.0 / math.sqrt(e // h)
+            # per-head dim from the ACTUAL q shape: under tensor
+            # parallelism the local projection width is 3e/tp, and
+            # e // num_heads_local would over-count the head dim
+            scale = 1.0 / math.sqrt(q.shape[-1])
             s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                            preferred_element_type=jnp.float32) * scale
             if self.causal:
@@ -199,8 +234,17 @@ class SelfMultiheadAttn(nn.Module):
                 rng=dropout_rng, deterministic=deterministic)
             ctx = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
-        out = nn.Dense(e, use_bias=self.bias, name="out_proj",
-                       dtype=self.dtype)(_merge_heads(ctx).astype(x.dtype))
+        ctx2d = _merge_heads(ctx).astype(x.dtype)
+        if self.tensor_parallel_axis:
+            # row-parallel out projection: partial matmul -> g psum ->
+            # bias added once (RowParallelDense; same param tree as Dense)
+            from apex_tpu.parallel.tensor_parallel import RowParallelDense
+            out = RowParallelDense(e, self.tensor_parallel_axis,
+                                   use_bias=self.bias, dtype=self.dtype,
+                                   name="out_proj")(ctx2d)
+        else:
+            out = nn.Dense(e, use_bias=self.bias, name="out_proj",
+                           dtype=self.dtype)(ctx2d)
         if self.include_norm_add:
             out = out + residual
         return out
@@ -245,7 +289,9 @@ class EncdecMultiheadAttn(nn.Module):
                                   dropout_rate=rate, dropout_seed=seed,
                                   bias=_mask_to_bias(attn_mask))
         else:
-            scale = 1.0 / math.sqrt(e // h)
+            # per-head dim from the ACTUAL q shape (no tensor-parallel
+            # support in this class — see SelfMultiheadAttn)
+            scale = 1.0 / math.sqrt(q.shape[-1])
             s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                            preferred_element_type=jnp.float32) * scale
             p = masked_softmax_dropout(

@@ -33,6 +33,12 @@ class Block(nn.Module):
     dtype: Any = None
     seq_parallel: Optional[str] = None
     axis_name: Optional[str] = None
+    # Megatron-style tensor parallelism over a mesh axis: heads shard in
+    # attention, the MLP runs column(fc1)->row(fc2) parallel, and the
+    # block pays exactly two psums (after out_proj, after fc2) — see
+    # parallel/tensor_parallel.py for the param layout helpers.
+    tensor_parallel_axis: Optional[str] = None
+    tensor_parallel_size: int = 1
     # ``deterministic`` can be fixed at construction time so that under
     # ``nn.remat`` it never becomes a traced argument (a traced bool cannot
     # drive the Python-level dropout branch in SelfMultiheadAttn). The
@@ -49,15 +55,34 @@ class Block(nn.Module):
         h = SelfMultiheadAttn(
             embed_dim=e, num_heads=self.num_heads, dropout=self.dropout,
             causal=True, dtype=self.dtype, seq_parallel=self.seq_parallel,
-            axis_name=self.axis_name, name="attn")(
+            axis_name=self.axis_name,
+            tensor_parallel_axis=self.tensor_parallel_axis,
+            tensor_parallel_size=self.tensor_parallel_size,
+            name="attn")(
             FusedLayerNorm(normalized_shape=e, name="ln1")(x)
             .astype(x.dtype),
             deterministic=det, dropout_rng=dropout_rng)
         x = x + h
         y = FusedLayerNorm(normalized_shape=e, name="ln2")(x).astype(x.dtype)
-        y = nn.Dense(self.mlp_ratio * e, dtype=self.dtype, name="fc1")(y)
-        y = nn.gelu(y)
-        y = nn.Dense(e, dtype=self.dtype, name="fc2")(y)
+        if self.tensor_parallel_axis:
+            from apex_tpu.parallel.tensor_parallel import (
+                RowParallelDense, tp_region_enter)
+            if (self.mlp_ratio * e) % self.tensor_parallel_size:
+                raise ValueError(
+                    f"tensor_parallel_size ({self.tensor_parallel_size}) "
+                    f"must divide the mlp width ({self.mlp_ratio * e})")
+            y = tp_region_enter(y, self.tensor_parallel_axis)
+            y = nn.Dense(self.mlp_ratio * e // self.tensor_parallel_size,
+                         dtype=self.dtype, name="fc1")(y)
+            y = nn.gelu(y)
+            # row-parallel: partial matmul -> g psum -> bias added once
+            y = RowParallelDense(e, self.tensor_parallel_axis,
+                                 dtype=self.dtype, name="fc2")(y)
+        else:
+            y = nn.Dense(self.mlp_ratio * e, dtype=self.dtype,
+                         name="fc1")(y)
+            y = nn.gelu(y)
+            y = nn.Dense(e, dtype=self.dtype, name="fc2")(y)
         return x + y
 
 
@@ -75,6 +100,8 @@ class TransformerLM(nn.Module):
     dtype: Any = None
     seq_parallel: Optional[str] = None
     axis_name: Optional[str] = None
+    tensor_parallel_axis: Optional[str] = None
+    tensor_parallel_size: int = 1
     # Rematerialize each block in the backward (jax.checkpoint): activation
     # memory drops from O(layers * S * D) to O(S * D), trading one extra
     # forward per block — the standard long-context lever (SURVEY.md §7:
@@ -98,7 +125,10 @@ class TransformerLM(nn.Module):
         for i in range(self.num_layers):
             x = block_cls(self.embed_dim, self.num_heads, self.mlp_ratio,
                           self.dropout, self.dtype, self.seq_parallel,
-                          self.axis_name, deterministic=deterministic,
+                          self.axis_name,
+                          tensor_parallel_axis=self.tensor_parallel_axis,
+                          tensor_parallel_size=self.tensor_parallel_size,
+                          deterministic=deterministic,
                           name=f"block_{i}")(x, dropout_rng=dropout_rng)
         x = FusedLayerNorm(normalized_shape=self.embed_dim,
                            name="ln_f")(x).astype(x.dtype)

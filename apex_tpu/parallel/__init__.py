@@ -21,3 +21,11 @@ from apex_tpu.parallel.larc import LARC, larc_transform_grads
 # create_syncbn_process_group analog (apex/parallel/__init__.py:58-95):
 # rank subsets are plain axis_index_groups lists on TPU.
 create_syncbn_process_group = subgroups
+from apex_tpu.parallel import tensor_parallel
+from apex_tpu.parallel.tensor_parallel import (
+    tp_region_enter,
+    tp_region_exit,
+    tp_shard_lm_params,
+    tp_unshard_lm_params,
+    lm_tp_pspecs,
+)

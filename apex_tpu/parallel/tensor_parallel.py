@@ -1,0 +1,213 @@
+"""Megatron-style tensor parallelism for the transformer stack — the
+intra-layer model-parallel scheme (Shoeybi et al. 2019) mapped to a
+``jax.sharding.Mesh`` axis: attention shards by HEADS, the MLP shards
+column-then-row, and each block pays exactly two all-reduces (one after
+the attention out-projection, one after fc2), riding ICI as XLA
+collectives. The reference framework has no tensor parallelism
+(SURVEY.md §2.4 counts DP / ZeRO / subgroups; this is additive TPU-first
+capability like ring/Ulysses sequence parallelism) — the design follows
+the public scaling-book recipe: pick a mesh, shard the params, let the
+two f/g conjugate collectives carry the math.
+
+Usage (composable with a data axis; see tests/test_tensor_parallel.py)::
+
+    mesh = parallel.make_mesh((d_dp, d_tp), ("data", "model"))
+    params = model_dense.init(key, tokens)["params"]      # dense twin
+    params = tp.tp_shard_lm_params(params, tp=d_tp)       # qkv permute
+    specs  = tp.lm_tp_pspecs(params, axis="model")        # P() tree
+    local  = model.clone(num_heads=H // d_tp,
+                         tensor_parallel_axis="model",
+                         tensor_parallel_size=d_tp)
+    # under shard_map(in_specs=(specs, ...)) each device applies `local`
+    # with its param shards; f/g insert the two per-block collectives.
+
+The f/g pair are CONJUGATE collectives (Megatron's f and g): ``f`` is
+identity forward / psum backward (entering a column-parallel region:
+activations are replicated, each device's dx is a partial sum over its
+kernel columns), ``g`` is psum forward / identity backward (leaving a
+row-parallel region: outputs are partial sums, the incoming cotangent is
+already replicated). Both are custom_vjp: under ``shard_map(...,
+check_vma=False)`` a plain ``lax.psum`` transposes to another psum,
+over-counting replicated cotangents by the axis size.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax.linen.dtypes import promote_dtype
+from jax.sharding import PartitionSpec as P
+
+Tree = Any
+
+
+# ---------------------------------------------------------------------------
+# f / g conjugate collectives
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_region_enter(x, axis_name: str):
+    """Megatron ``f``: identity forward, psum backward — marks replicated
+    activations entering a column-parallel layer."""
+    return x
+
+
+def _enter_fwd(x, axis_name):
+    return x, None
+
+
+def _enter_bwd(axis_name, _, ct):
+    return (jax.lax.psum(ct, axis_name),)
+
+
+tp_region_enter.defvjp(_enter_fwd, _enter_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_region_exit(x, axis_name: str):
+    """Megatron ``g``: psum forward, identity backward — reduces the
+    partial sums leaving a row-parallel layer."""
+    return jax.lax.psum(x, axis_name)
+
+
+def _exit_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _exit_bwd(axis_name, _, ct):
+    return (ct,)
+
+
+tp_region_exit.defvjp(_exit_fwd, _exit_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Row-parallel linear: matmul -> psum -> bias, param-tree-compatible with
+# nn.Dense
+# ---------------------------------------------------------------------------
+
+class RowParallelDense(nn.Module):
+    """Megatron RowParallelLinear: each device matmuls its INPUT-dim
+    shard of the kernel, the partial sums all-reduce (``g``), and the
+    bias is added ONCE after the reduction — never scale a replicated
+    bias by 1/tp instead: adaptive optimizers (Adam) step the scaled
+    bias at full lr, silently diverging from the dense trajectory (r4
+    finding, caught by the 2-D train-step parity test).
+
+    Param names/shapes match ``nn.Dense`` (``kernel``, ``bias``), so a
+    dense twin's tree shards straight in with no re-mapping.
+
+    NOTE on init: the supported flow inits the DENSE twin and shards via
+    :func:`tp_shard_lm_params` (module docstring). A direct
+    ``local_model.init`` draws this kernel over the LOCAL fan-in
+    (fan/tp), i.e. sqrt(tp) larger init std than the dense layer —
+    fine for shape probing, not for dense-equivalent training from
+    scratch."""
+
+    features: int
+    axis_name: str
+    dtype: Any = None
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (x.shape[-1], self.features))
+        bias = (self.param("bias", nn.initializers.zeros_init(),
+                           (self.features,))
+                if self.use_bias else None)
+        x, kernel, bias = promote_dtype(x, kernel, bias,
+                                        dtype=self.dtype)
+        y = x @ kernel
+        y = tp_region_exit(y, self.axis_name)
+        if bias is not None:
+            y = y + bias
+        return y
+
+
+# ---------------------------------------------------------------------------
+# Param layout: permutation + PartitionSpecs for the TransformerLM tree
+# ---------------------------------------------------------------------------
+
+def _permute_qkv(arr, tp: int, *, inverse: bool = False):
+    """The fused in_proj holds columns ``[Q | K | V]`` (each e wide,
+    head-major). Sharding that contiguously would hand device 0 all of Q
+    and part of K — so permute to per-GROUP ``[Q_p | K_p | V_p]`` blocks:
+    device p's contiguous chunk then splits into its own heads' q/k/v
+    thirds exactly like the dense module's ``jnp.split(qkv, 3)``."""
+    e3 = arr.shape[-1]
+    e = e3 // 3
+    lead = arr.shape[:-1]
+    # forward: (…, 3, tp, e/tp) -> (…, tp, 3, e/tp); inverse swaps back
+    a = arr.reshape(*lead, *((3, tp) if not inverse else (tp, 3)),
+                    e // tp)
+    a = jnp.swapaxes(a, -3, -2)
+    return a.reshape(*lead, e3)
+
+
+def tp_shard_lm_params(params: Tree, tp: int) -> Tree:
+    """Re-lay out a DENSE TransformerLM param tree for ``tp``-way head
+    sharding: every block's fused qkv kernel/bias columns permute to the
+    per-group ``[Q_p|K_p|V_p]`` layout (see :func:`_permute_qkv`).
+    Row-parallel layers need no value changes — under TP they run as
+    :class:`RowParallelDense`, which adds the (replicated, unscaled)
+    bias once after the ``g`` reduction. Inverse:
+    :func:`tp_unshard_lm_params` (checkpoint interop). The arrays stay
+    GLOBAL; shard them with :func:`lm_tp_pspecs` via device_put or
+    shard_map in_specs."""
+    return _map_blocks(params, tp, inverse=False)
+
+
+def tp_unshard_lm_params(params: Tree, tp: int) -> Tree:
+    """Undo :func:`tp_shard_lm_params` (gathered params -> dense
+    layout)."""
+    return _map_blocks(params, tp, inverse=True)
+
+
+def _map_blocks(params: Tree, tp: int, *, inverse: bool) -> Tree:
+    out = {}
+    for name, sub in params.items():
+        if name.startswith("block_"):
+            sub = dict(sub)
+            attn = dict(sub["attn"])
+            proj = dict(attn["in_proj"])
+            proj["kernel"] = _permute_qkv(proj["kernel"], tp,
+                                          inverse=inverse)
+            if "bias" in proj:
+                proj["bias"] = _permute_qkv(proj["bias"], tp,
+                                            inverse=inverse)
+            attn["in_proj"] = proj
+            sub["attn"] = attn
+        out[name] = sub
+    return out
+
+
+def lm_tp_pspecs(params: Tree, axis: str = "model") -> Tree:
+    """PartitionSpec tree for a (permuted) TransformerLM param tree:
+    column-parallel kernels shard their OUTPUT dim (in_proj, fc1),
+    row-parallel kernels their INPUT dim (out_proj, fc2 — head-major ctx
+    features make out_proj's row blocks contiguous per device, no
+    permutation needed); embeddings, layer norms, and the LM head stay
+    replicated."""
+    col_k, row_k = P(None, axis), P(axis, None)
+
+    def spec(path_names, leaf):
+        names = [str(getattr(p, "key", getattr(p, "name", p)))
+                 for p in path_names]
+        if not any(n.startswith("block_") for n in names):
+            return P()
+        joined = "/".join(names)
+        if "in_proj" in joined or "fc1" in joined:
+            return col_k if leaf.ndim == 2 else P(axis)
+        if "out_proj" in joined or "fc2" in joined:
+            # bias replicated and UNSCALED: RowParallelDense adds it
+            # once AFTER the g reduction (never pre-scale by 1/tp — see
+            # the RowParallelDense docstring's Adam-divergence warning)
+            return row_k if leaf.ndim == 2 else P()
+        return P()  # ln1/ln2 scales etc.
+
+    return jax.tree_util.tree_map_with_path(spec, params)

@@ -1,0 +1,192 @@
+"""Megatron-style tensor parallelism (parallel/tensor_parallel.py):
+head-sharded attention + column/row-parallel MLP over a mesh axis must
+reproduce the dense model exactly — forward, gradients, and a full
+FusedAdam train step — including composed with a data axis on a 2-D
+mesh. Additive capability (the reference has no tensor parallelism);
+the scheme is the standard Megatron f/g two-collective block."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu import optimizers, parallel
+from apex_tpu.models import TransformerLM
+from apex_tpu.models.gpt import next_token_loss
+from apex_tpu.parallel import (lm_tp_pspecs, tp_shard_lm_params,
+                               tp_unshard_lm_params)
+
+V, L, E, H, S, B = 64, 2, 64, 8, 32, 2
+TP = 4
+
+
+def _models():
+    dense = TransformerLM(vocab_size=V, num_layers=L, embed_dim=E,
+                          num_heads=H, max_seq=S)
+    local = dense.clone(num_heads=H // TP, tensor_parallel_axis="model",
+                        tensor_parallel_size=TP)
+    return dense, local
+
+
+def _data(key):
+    return jax.random.randint(key, (B, S), 0, V)
+
+
+def test_qkv_permute_roundtrip():
+    k = jax.random.normal(jax.random.PRNGKey(0), (E, 3 * E))
+    from apex_tpu.parallel.tensor_parallel import _permute_qkv
+    back = _permute_qkv(_permute_qkv(k, TP), TP, inverse=True)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(k))
+    # device p's chunk of the permuted kernel is [Q_p | K_p | V_p]
+    perm = _permute_qkv(k, TP)
+    w = 3 * E // TP
+    p0 = perm[:, :w]
+    np.testing.assert_array_equal(
+        np.asarray(p0[:, : w // 3]), np.asarray(k[:, : E // TP]))      # Q_0
+    np.testing.assert_array_equal(
+        np.asarray(p0[:, w // 3: 2 * w // 3]),
+        np.asarray(k[:, E: E + E // TP]))                              # K_0
+
+
+def test_tp_shard_roundtrip():
+    dense, _ = _models()
+    params = dense.init(jax.random.PRNGKey(0), _data(
+        jax.random.PRNGKey(1)))["params"]
+    back = tp_unshard_lm_params(tp_shard_lm_params(params, TP), TP)
+    for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(params)[0],
+            jax.tree_util.tree_flatten_with_path(back)[0]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, err_msg=str(pa))
+
+
+@pytest.fixture(scope="module")
+def tp_mesh():
+    return parallel.make_mesh((TP,), ("model",),
+                              devices=jax.devices()[:TP])
+
+
+def _tp_apply(local, mesh, params_tp, specs, tokens, grad=False):
+    def per_device(p, toks):
+        def loss_fn(pp):
+            logits = local.apply({"params": pp}, toks)
+            return next_token_loss(logits, toks)
+
+        if grad:
+            loss, grads = jax.value_and_grad(loss_fn)(p)
+            return loss, grads
+        return local.apply({"params": p}, toks)
+
+    out_specs = (P(), specs) if grad else P()
+    fn = jax.jit(shard_map(
+        per_device, mesh=mesh, in_specs=(specs, P()),
+        out_specs=out_specs, check_vma=False))
+    return fn(params_tp, tokens)
+
+
+def test_tp_forward_matches_dense(tp_mesh):
+    dense, local = _models()
+    tokens = _data(jax.random.PRNGKey(1))
+    params = dense.init(jax.random.PRNGKey(0), tokens)["params"]
+    want = dense.apply({"params": params}, tokens)
+
+    params_tp = tp_shard_lm_params(params, TP)
+    specs = lm_tp_pspecs(params_tp)
+    params_tp = jax.device_put(params_tp, jax.tree_util.tree_map(
+        lambda sp: NamedSharding(tp_mesh, sp), specs))
+    got = _tp_apply(local, tp_mesh, params_tp, specs, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_tp_grads_match_dense(tp_mesh):
+    """Every param grad — including the sharded qkv/fc kernels — must
+    equal the dense grad's corresponding shard (the f/g custom vjps:
+    a plain psum would over-count replicated cotangents by TP)."""
+    dense, local = _models()
+    tokens = _data(jax.random.PRNGKey(2))
+    params = dense.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    def dense_loss(p):
+        return next_token_loss(dense.apply({"params": p}, tokens), tokens)
+
+    want_loss, want_grads = jax.value_and_grad(dense_loss)(params)
+    # compare in the TP layout: permute the dense grads the same way
+    # (pure permutation — row-parallel biases are unscaled since
+    # RowParallelDense adds them once after the g reduction)
+    want_grads = tp_shard_lm_params(want_grads, TP)
+
+    params_tp = tp_shard_lm_params(params, TP)
+    specs = lm_tp_pspecs(params_tp)
+    params_tp = jax.device_put(params_tp, jax.tree_util.tree_map(
+        lambda sp: NamedSharding(tp_mesh, sp), specs))
+    got_loss, got_grads = _tp_apply(local, tp_mesh, params_tp, specs,
+                                    tokens, grad=True)
+
+    np.testing.assert_allclose(float(got_loss), float(want_loss),
+                               rtol=1e-5)
+    for (pa, g), (_, w) in zip(
+            jax.tree_util.tree_flatten_with_path(got_grads)[0],
+            jax.tree_util.tree_flatten_with_path(want_grads)[0]):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-4, atol=2e-5,
+                                   err_msg=str(pa))
+
+
+def test_tp_train_step_2d_mesh_matches_dense():
+    """2-D (data x model) mesh: per-device grads pmean over 'data' and
+    stay local over 'model'; one FusedAdam step must track the dense
+    single-device step on the same global batch."""
+    d_dp = 2
+    tp = TP // 2
+    mesh2 = parallel.make_mesh((d_dp, tp), ("data", "model"),
+                               devices=jax.devices()[:d_dp * tp])
+    dense = TransformerLM(vocab_size=V, num_layers=L, embed_dim=E,
+                          num_heads=H, max_seq=S)
+    local = dense.clone(num_heads=H // tp, tensor_parallel_axis="model",
+                        tensor_parallel_size=tp)
+
+    tokens = _data(jax.random.PRNGKey(3))  # global batch B
+    params = dense.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    def dense_loss(p):
+        return next_token_loss(dense.apply({"params": p}, tokens), tokens)
+
+    _, dgrads = jax.value_and_grad(dense_loss)(params)
+    opt = optimizers.FusedAdam(lr=1e-3)
+    want, _ = opt.step(dgrads, params, opt.init(params))
+    want = tp_shard_lm_params(want, tp)
+
+    params_tp = tp_shard_lm_params(params, tp)
+    specs = lm_tp_pspecs(params_tp)
+    params_tp = jax.device_put(params_tp, jax.tree_util.tree_map(
+        lambda sp: NamedSharding(mesh2, sp), specs))
+
+    def per_device(p, toks, st):
+        def loss_fn(pp):
+            logits = local.apply({"params": pp}, toks)
+            return next_token_loss(logits, toks)
+
+        grads = jax.grad(loss_fn)(p)
+        grads = jax.lax.pmean(grads, "data")   # dp average, tp-local
+        return opt.step(grads, p, st)
+
+    # AdamState(step, exp_avg, exp_avg_sq): moments mirror the param
+    # sharding leaf-for-leaf, the step scalar is replicated
+    st = opt.init(params_tp)
+    st_specs = type(st)(step=P(), exp_avg=specs, exp_avg_sq=specs)
+    fn = jax.jit(shard_map(
+        per_device, mesh=mesh2,
+        in_specs=(specs, P("data"), st_specs),
+        out_specs=(specs, st_specs), check_vma=False))
+    got, _ = fn(params_tp, tokens, st)
+
+    for (pa, g), (_, w) in zip(
+            jax.tree_util.tree_flatten_with_path(got)[0],
+            jax.tree_util.tree_flatten_with_path(want)[0]):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=3e-4, atol=3e-5,
+                                   err_msg=str(pa))
