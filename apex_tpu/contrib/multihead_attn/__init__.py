@@ -93,6 +93,17 @@ def _derive_seed(rng, module_path):
                               0, 2**31 - 1)
 
 
+def _tp_dropout_rng(rng, axis_name):
+    """Fold the tensor-parallel rank into the dropout rng. Without this
+    every TP rank draws the SAME mask for its head shard (same rng, same
+    module path, same local shape), correlating dropout across the head
+    groups — the per-rank masks must be independent draws. No-op outside
+    TP or without an rng."""
+    if axis_name is None or rng is None:
+        return rng
+    return jax.random.fold_in(rng, jax.lax.axis_index(axis_name))
+
+
 def _split_heads(x, num_heads):
     b, s, e = x.shape
     return x.reshape(b, s, num_heads, e // num_heads).transpose(0, 2, 1, 3)
@@ -156,13 +167,10 @@ class SelfMultiheadAttn(nn.Module):
                     f"tensor_parallel_size ({self.tensor_parallel_size}) "
                     f"must divide embed_dim ({e}) — silent floor "
                     "division would mis-size the local projections")
-            if self.dropout > 0.0 and not deterministic:
-                raise NotImplementedError(
-                    "tensor-parallel attention does not yet fold the TP "
-                    "rank into the dropout mask — every rank would drop "
-                    "the SAME pattern on its head shard, silently "
-                    "diverging from the dense model; train with "
-                    "dropout=0 under tensor parallelism")
+            # dropout under TP folds the rank into the rng below —
+            # otherwise every rank would draw the SAME mask for its
+            # head shard (per-rank masks are independent, like any
+            # re-seeded dropout; the dense-parity tests use dropout=0)
         residual = x
         if self.include_norm_add:
             x = FusedLayerNorm(normalized_shape=e)(x)
@@ -210,7 +218,10 @@ class SelfMultiheadAttn(nn.Module):
             rate, seed = 0.0, None
             if self.dropout > 0.0 and not deterministic:
                 rate = self.dropout
-                seed = _derive_seed(dropout_rng, self.path)
+                seed = _derive_seed(
+                    _tp_dropout_rng(dropout_rng,
+                                    self.tensor_parallel_axis),
+                    self.path)
             ctx = flash_attention(q, k, v, self.causal,
                                   dropout_rate=rate, dropout_seed=seed,
                                   bias=_mask_to_bias(attn_mask))
@@ -231,7 +242,9 @@ class SelfMultiheadAttn(nn.Module):
             # (ADVICE r2: the raw add raised or silently misaligned b vs h).
             p = masked_softmax_dropout(
                 s, mask=_mask_to_bias(attn_mask), dropout_rate=self.dropout,
-                rng=dropout_rng, deterministic=deterministic)
+                rng=_tp_dropout_rng(dropout_rng,
+                                    self.tensor_parallel_axis),
+                deterministic=deterministic)
             ctx = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
         ctx2d = _merge_heads(ctx).astype(x.dtype)
