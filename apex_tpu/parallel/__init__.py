@@ -29,3 +29,11 @@ from apex_tpu.parallel.tensor_parallel import (
     tp_unshard_lm_params,
     lm_tp_pspecs,
 )
+from apex_tpu.parallel import pipeline
+from apex_tpu.parallel.pipeline import (
+    pipeline_apply,
+    psum_input_grads,
+    lm_stack_blocks,
+    lm_unstack_blocks,
+    stacked_block_pspecs,
+)

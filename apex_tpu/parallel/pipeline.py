@@ -1,0 +1,148 @@
+"""GPipe-style pipeline parallelism over a mesh axis — the fourth
+classic parallelism axis alongside data (DDP/ZeRO), tensor
+(tensor_parallel.py), and sequence (ring/Ulysses). The reference
+framework has none of these beyond data parallelism; this follows the
+standard TPU formulation: each device on the ``pipe`` axis owns a STAGE
+(a contiguous run of transformer blocks, params stacked on a leading
+dim), microbatches tick through the pipeline inside one ``lax.scan``,
+and activations hop stage-to-stage via ``ppermute`` — compiler-visible
+control flow, no host scheduling. Backward needs no hand-written
+schedule: autodiff transposes the ppermute shifts into reverse shifts,
+yielding the classic GPipe backward automatically.
+
+Schedule: M microbatches, P stages → M + P - 1 ticks (the standard
+fill/drain bubble; efficiency M / (M + P - 1)). Per tick every device
+applies its stage to its live slot, results shift one stage right,
+stage 0 injects the next microbatch, and the last stage banks finished
+microbatches into the output buffer.
+
+Scope: the block stack only. Embeddings run before the pipeline
+(replicated compute; only stage 0's result is injected), so their grads
+land on stage 0 alone — reassemble with :func:`psum_input_grads`. The
+final norm/head run AFTER the pipeline on the psum-broadcast outputs,
+so their grads come out replicated already: do NOT psum those (it would
+multiply them by the stage count; see the psum_input_grads docstring).
+
+See ``tests/test_pipeline.py`` for the dense-parity harness and
+``lm_stack_blocks`` / ``lm_unstack_blocks`` for the TransformerLM param
+plumbing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+Tree = Any
+
+
+def pipeline_apply(stage_apply: Callable[[Tree, jax.Array], jax.Array],
+                   stage_params: Tree, microbatches: jax.Array,
+                   axis_name: str = "pipe") -> jax.Array:
+    """Run ``microbatches`` (leading dim M) through the pipeline.
+
+    ``stage_apply(stage_params, x)`` applies THIS device's stage (e.g. a
+    ``lax.scan`` over its stacked blocks) to one microbatch activation.
+    ``stage_params`` is the device-local stage slice (shard the stacked
+    tree's leading dim over ``axis_name`` before shard_map).
+
+    Returns the last stage's outputs, shape = microbatches.shape, valid
+    on EVERY device (psum-broadcast off the last stage so the caller's
+    loss runs replicated). Differentiable end-to-end.
+    """
+    world = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    ticks = m + world - 1
+    right = [(i, i + 1) for i in range(world - 1)]
+
+    def body(carry, tick):
+        buf, outs = carry
+        # 1. stage 0 injects this tick's microbatch (zeros once all M
+        #    are in flight; that trailing garbage reaches the last stage
+        #    only at tick >= M + world - 1, past the end of the loop)
+        in_idx = jnp.clip(tick, 0, m - 1)
+        inject = jnp.where(
+            tick < m,
+            jax.lax.dynamic_index_in_dim(microbatches, in_idx,
+                                         keepdims=False),
+            jnp.zeros_like(buf))
+        buf = jnp.where(rank == 0, inject, buf)
+        # 2. every stage processes its live slot (fill-phase zeros
+        #    produce garbage that the banking guard below never stores)
+        y = stage_apply(stage_params, buf)
+        # 3. the last stage banks a finished microbatch once the
+        #    pipeline is full: microbatch k arrives at tick k + world - 1
+        out_idx = jnp.clip(tick - (world - 1), 0, m - 1)
+        bank = jnp.where(
+            (rank == world - 1) & (tick >= world - 1),
+            y, jax.lax.dynamic_index_in_dim(outs, out_idx,
+                                            keepdims=False))
+        outs = jax.lax.dynamic_update_index_in_dim(outs, bank, out_idx, 0)
+        # 4. shift one stage right (stage 0's next slot is overwritten
+        #    by the next injection)
+        buf = jax.lax.ppermute(y, axis_name, right)
+        return (buf, outs), ()
+
+    buf0 = jnp.zeros_like(microbatches[0])
+    outs0 = jnp.zeros_like(microbatches)
+    (_, outs), _ = jax.lax.scan(body, (buf0, outs0), jnp.arange(ticks))
+    # outputs live on the last stage only — broadcast so every device
+    # can run the (replicated) head/loss. psum-forward / IDENTITY-
+    # backward (tensor_parallel's g collective): every rank computes the
+    # same downstream loss, so a plain psum's transpose would deliver
+    # world× the cotangent to the last stage (check_vma=False psum
+    # transposes to psum).
+    from apex_tpu.parallel.tensor_parallel import tp_region_exit
+    return tp_region_exit(
+        jnp.where(rank == world - 1, outs, jnp.zeros_like(outs)),
+        axis_name)
+
+
+def psum_input_grads(grads: Tree, axis_name: str = "pipe") -> Tree:
+    """Sum INPUT-side param grads (embeddings — anything computed
+    BEFORE :func:`pipeline_apply`) across the pipe axis: the inject
+    ``where`` zeroes every rank's input path except stage 0's, so the
+    psum of (rank-0 grad, zeros, ...) reassembles the full gradient on
+    every rank. Do NOT apply this to output-side params (final norm /
+    LM head): they run on the psum-broadcast outputs, so their grads
+    come out replicated already — summing would multiply them by the
+    stage count."""
+    return jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g, axis_name), grads)
+
+
+# ---------------------------------------------------------------------------
+# TransformerLM param plumbing
+# ---------------------------------------------------------------------------
+
+def lm_stack_blocks(params: Tree) -> tuple[Tree, Tree]:
+    """Split a TransformerLM param tree into (stacked_blocks, rest):
+    ``block_0..block_{L-1}`` leaves stack on a new leading dim (length
+    L), everything else (embeddings, ``ln_f``, ``head``) passes through.
+    Shard the stacked tree's leading dim with ``P(axis)`` so each pipe
+    rank holds its stage's L/P consecutive blocks."""
+    blocks = sorted((k for k in params if k.startswith("block_")),
+                    key=lambda k: int(k.split("_")[1]))
+    rest = {k: v for k, v in params.items() if not k.startswith("block_")}
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *[params[k] for k in blocks])
+    return stacked, rest
+
+
+def lm_unstack_blocks(stacked: Tree, rest: Tree) -> Tree:
+    """Inverse of :func:`lm_stack_blocks`."""
+    n = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    out = dict(rest)
+    for i in range(n):
+        out[f"block_{i}"] = jax.tree_util.tree_map(
+            lambda x: x[i], stacked)
+    return out
+
+
+def stacked_block_pspecs(stacked: Tree, axis: str = "pipe") -> Tree:
+    """P(axis) on every stacked-block leaf's leading dim."""
+    return jax.tree_util.tree_map(lambda _: P(axis), stacked)
