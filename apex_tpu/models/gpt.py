@@ -39,6 +39,14 @@ class Block(nn.Module):
     # parallel/tensor_parallel.py for the param layout helpers.
     tensor_parallel_axis: Optional[str] = None
     tensor_parallel_size: int = 1
+    # Mixture-of-Experts MLP (Switch/GShard; parallel/expert_parallel.py):
+    # moe_num_experts > 0 replaces this block's dense MLP with MoEMLP;
+    # experts optionally shard over an expert_parallel mesh axis.
+    moe_num_experts: int = 0
+    moe_num_selected: int = 2
+    moe_capacity_factor: float = 1.25
+    expert_parallel_axis: Optional[str] = None
+    expert_parallel_size: int = 1
     # ``deterministic`` can be fixed at construction time so that under
     # ``nn.remat`` it never becomes a traced argument (a traced bool cannot
     # drive the Python-level dropout branch in SelfMultiheadAttn). The
@@ -64,7 +72,28 @@ class Block(nn.Module):
             deterministic=det, dropout_rng=dropout_rng)
         x = x + h
         y = FusedLayerNorm(normalized_shape=e, name="ln2")(x).astype(x.dtype)
-        if self.tensor_parallel_axis:
+        if self.moe_num_experts:
+            from apex_tpu.parallel.expert_parallel import MoEMLP
+            if (self.tensor_parallel_axis is not None
+                    and self.tensor_parallel_axis
+                    == self.expert_parallel_axis):
+                raise ValueError(
+                    "tensor_parallel_axis and expert_parallel_axis must "
+                    "be DIFFERENT mesh axes: EP assumes tokens are "
+                    "sharded over its axis, but inside a TP region "
+                    "activations are replicated over the model axis")
+            # TP attention composes with an MoE MLP: the attn half above
+            # already sharded heads over the model axis; the expert
+            # exchange runs over its own axis
+            y = MoEMLP(embed_dim=e, num_experts=self.moe_num_experts,
+                       mlp_ratio=self.mlp_ratio,
+                       num_selected=self.moe_num_selected,
+                       capacity_factor=self.moe_capacity_factor,
+                       dtype=self.dtype,
+                       axis_name=self.expert_parallel_axis,
+                       expert_parallel_size=self.expert_parallel_size,
+                       name="moe")(y)
+        elif self.tensor_parallel_axis:
             from apex_tpu.parallel.tensor_parallel import (
                 RowParallelDense, tp_region_enter)
             if (self.mlp_ratio * e) % self.tensor_parallel_size:
@@ -102,6 +131,15 @@ class TransformerLM(nn.Module):
     axis_name: Optional[str] = None
     tensor_parallel_axis: Optional[str] = None
     tensor_parallel_size: int = 1
+    # MoE: every ``moe_every``-th block swaps its dense MLP for a
+    # moe_num_experts-way MoEMLP (Switch places MoE in alternating
+    # blocks; moe_every=1 makes every block sparse)
+    moe_num_experts: int = 0
+    moe_every: int = 2
+    moe_num_selected: int = 2
+    moe_capacity_factor: float = 1.25
+    expert_parallel_axis: Optional[str] = None
+    expert_parallel_size: int = 1
     # Rematerialize each block in the backward (jax.checkpoint): activation
     # memory drops from O(layers * S * D) to O(S * D), trading one extra
     # forward per block — the standard long-context lever (SURVEY.md §7:
@@ -111,6 +149,15 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, *, pos_offset=0, deterministic: bool = True,
                  dropout_rng=None, return_hidden: bool = False):
+        if (self.moe_num_experts and self.tensor_parallel_axis is not None
+                and self.tensor_parallel_axis == self.expert_parallel_axis):
+            # checked here (before any block) so the error beats the
+            # attention TP psum's unbound-axis failure under init
+            raise ValueError(
+                "tensor_parallel_axis and expert_parallel_axis must be "
+                "DIFFERENT mesh axes: EP assumes tokens are sharded over "
+                "its axis, but inside a TP region activations are "
+                "replicated over the model axis")
         b, s = tokens.shape
         emb = nn.Embed(self.vocab_size, self.embed_dim,
                        dtype=self.dtype, name="tok_emb")(tokens)
@@ -123,11 +170,19 @@ class TransformerLM(nn.Module):
         # cannot select the dropout branch (ADVICE r2: remat+dropout crash).
         block_cls = nn.remat(Block) if self.remat else Block
         for i in range(self.num_layers):
+            moe = (self.moe_num_experts
+                   if self.moe_num_experts
+                   and i % self.moe_every == self.moe_every - 1 else 0)
             x = block_cls(self.embed_dim, self.num_heads, self.mlp_ratio,
                           self.dropout, self.dtype, self.seq_parallel,
                           self.axis_name,
                           tensor_parallel_axis=self.tensor_parallel_axis,
                           tensor_parallel_size=self.tensor_parallel_size,
+                          moe_num_experts=moe,
+                          moe_num_selected=self.moe_num_selected,
+                          moe_capacity_factor=self.moe_capacity_factor,
+                          expert_parallel_axis=self.expert_parallel_axis,
+                          expert_parallel_size=self.expert_parallel_size,
                           deterministic=deterministic,
                           name=f"block_{i}")(x, dropout_rng=dropout_rng)
         x = FusedLayerNorm(normalized_shape=self.embed_dim,

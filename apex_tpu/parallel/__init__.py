@@ -29,6 +29,14 @@ from apex_tpu.parallel.tensor_parallel import (
     tp_unshard_lm_params,
     lm_tp_pspecs,
 )
+from apex_tpu.parallel import expert_parallel
+from apex_tpu.parallel.expert_parallel import (
+    MoEMLP,
+    top_k_routing,
+    lm_moe_pspecs,
+    moe_sync_grads,
+    moe_aux_total,
+)
 from apex_tpu.parallel import pipeline
 from apex_tpu.parallel.pipeline import (
     pipeline_apply,

@@ -1,0 +1,286 @@
+"""Expert parallelism — Switch/GShard-style Mixture-of-Experts mapped to a
+``jax.sharding.Mesh`` axis. The reference framework has no MoE (SURVEY.md
+§2.4 counts DP / ZeRO / subgroups); this is additive TPU-first capability
+like ring/Ulysses sequence parallelism and Megatron tensor parallelism,
+completing the dp/sp/tp/pp/ep axis set.
+
+TPU-first design choices:
+
+- **Einsum dispatch** (GShard): routing materializes one-hot
+  dispatch/combine tensors and moves tokens with ``nec,nm->ecm`` /
+  ``nec,ecm->nm`` einsums — large static-shape matmuls the MXU tiles,
+  instead of the CUDA-style gather/scatter with dynamic token counts
+  (data-dependent shapes cannot compile under jit).
+- **Fixed capacity**: every expert processes exactly ``C`` token slots
+  (``ceil(k·N·capacity_factor/E)`` rounded up to a multiple of 8 for
+  sublane alignment); overflow tokens are dropped (combine weight 0) and
+  their residual path carries them, exactly the Switch Transformer
+  contract.
+- **all_to_all over the expert axis**: with experts sharded
+  ``P('expert', ...)`` and tokens batch-sharded over the same axis, the
+  local ``(E, C, M)`` dispatch buffer is exchanged with ONE tiled
+  ``lax.all_to_all`` (split experts, concat capacity) so each device
+  receives its own experts' slots from every peer — the XLA collective
+  rides ICI; the reverse all_to_all is its exact transpose, so expert-
+  kernel gradients arrive complete without any extra collective.
+
+Usage (see tests/test_moe.py, ``__graft_entry__.dryrun_multichip`` part 8)::
+
+    mesh   = parallel.make_mesh((ep,), ("expert",))
+    dense  = TransformerLM(..., moe_num_experts=E)          # global twin
+    params = dense.init(key, tokens)["params"]              # (E, ...) experts
+    specs  = lm_moe_pspecs(params, axis="expert")
+    local  = dense.clone(expert_parallel_axis="expert",
+                         expert_parallel_size=ep)
+    # under shard_map(in_specs=(specs, P("expert"))) each device applies
+    # `local` with its (E/ep, ...) expert shard; after backward, psum the
+    # replicated-param grads only (moe_sync_grads).
+
+Auxiliary losses (Switch §2.2 / ST-MoE z-loss) are sown into the
+``intermediates`` collection — pull them with
+``model.apply(..., mutable=["intermediates"])`` and add
+``moe_aux_total(...)`` to the objective as-is: it already applies the
+standard coefficients (balance 1e-2, the Switch default; z-loss 1e-3)
+— pass ``balance_coef``/``z_coef`` to override, never scale its result
+again.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+Tree = Any
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def top_k_routing(probs, k: int, capacity: int):
+    """Greedy top-``k`` token→expert assignment with per-expert capacity.
+
+    ``probs``: (N, E) router probabilities (fp32). Returns
+    ``(dispatch, combine, fraction)``:
+
+    - ``dispatch`` (N, E, C) 0/1 — token n occupies slot c of expert e.
+      Slots fill in choice-priority order (all first choices before any
+      second choice, GShard §3.2), tokens beyond ``capacity`` drop out.
+    - ``combine`` (N, E, C) — dispatch scaled by the gate weight. For
+      k=1 the weight is the raw top-1 probability (Switch); for k>1 the
+      selected probabilities renormalize to sum to 1 per token.
+    - ``fraction`` (E,) — fraction of tokens whose FIRST choice is each
+      expert (the ``f_e`` of the Switch balance loss).
+    """
+    n, e = probs.shape
+    remaining = probs
+    onehots, gates = [], []
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)
+        oh = jax.nn.one_hot(idx, e, dtype=probs.dtype)       # (N, E)
+        gates.append(jnp.sum(probs * oh, axis=-1))           # (N,)
+        onehots.append(oh)
+        remaining = remaining * (1.0 - oh)
+
+    if k > 1:
+        denom = sum(gates) + 1e-9
+        gates = [g / denom for g in gates]
+
+    # Slot positions: cumulative count of earlier claims on the same
+    # expert, earlier choices (across ALL tokens) before later ones.
+    claimed = jnp.zeros((e,), probs.dtype)
+    dispatch = jnp.zeros((n, e, capacity), probs.dtype)
+    combine = jnp.zeros((n, e, capacity), probs.dtype)
+    for oh, gate in zip(onehots, gates):
+        pos_in_e = jnp.cumsum(oh, axis=0) - oh + claimed[None, :]  # (N, E)
+        pos = jnp.sum(pos_in_e * oh, axis=-1).astype(jnp.int32)  # (N,)
+        keep = (pos < capacity).astype(probs.dtype)
+        slot = jax.nn.one_hot(pos, capacity, dtype=probs.dtype)  # (N, C)
+        d = (oh * keep[:, None])[:, :, None] * slot[:, None, :]
+        dispatch = dispatch + d
+        combine = combine + gate[:, None, None] * d
+        claimed = claimed + jnp.sum(oh, axis=0)
+
+    return dispatch, combine, jnp.mean(onehots[0], axis=0)
+
+
+class MoEMLP(nn.Module):
+    """Drop-in MoE replacement for a transformer block's dense MLP.
+
+    ``num_experts`` is GLOBAL; with ``expert_parallel_size=ep`` this
+    module holds the LOCAL ``num_experts/ep`` expert shard (leading
+    param dim) and exchanges tokens over ``axis_name`` — init the dense
+    twin (``ep=1``) and shard with :func:`lm_moe_pspecs`, the same flow
+    as tensor parallelism. The router always computes in fp32 (amp casts
+    disabled): top-k selection on half-precision logits is the classic
+    MoE instability.
+    """
+
+    embed_dim: int
+    num_experts: int
+    mlp_ratio: int = 4
+    num_selected: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = None
+    axis_name: Optional[str] = None
+    expert_parallel_size: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, m = x.shape
+        n = b * s
+        e = self.num_experts
+        ep = self.expert_parallel_size
+        if e % ep:
+            raise ValueError(
+                f"expert_parallel_size ({ep}) must divide "
+                f"num_experts ({e})")
+        if self.num_selected > e:
+            # with k > E the second argmax would re-pick an
+            # already-claimed expert at a real gate weight, silently
+            # double-filling its capacity
+            raise ValueError(
+                f"num_selected ({self.num_selected}) must be <= "
+                f"num_experts ({e})")
+        e_loc = e // ep
+        hidden = self.mlp_ratio * m
+        capacity = _round_up(
+            max(8, math.ceil(self.num_selected * n
+                             * self.capacity_factor / e)), 8)
+
+        xf = x.reshape(n, m)
+        router = self.param("router", nn.initializers.lecun_normal(),
+                            (m, e))
+
+        from apex_tpu.ops._amp_guard import no_amp
+
+        @no_amp
+        def route(xf32, r32):
+            logits = xf32 @ r32                              # (N, E)
+            probs = jax.nn.softmax(logits, axis=-1)
+            dispatch, combine, fraction = top_k_routing(
+                probs, self.num_selected, capacity)
+            # Switch balance loss: E * sum_e f_e * P_e  (==1 balanced);
+            # ST-MoE router z-loss: mean(logsumexp(logits)^2)
+            aux = e * jnp.sum(fraction * jnp.mean(probs, axis=0))
+            z = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+            return dispatch, combine, aux, z
+
+        dispatch, combine, aux, z = route(
+            xf.astype(jnp.float32), router.astype(jnp.float32))
+        if self.axis_name is not None and ep > 1:
+            # Sown VALUE is the shard-mean (GShard computes the balance
+            # term per routing group and averages); the grad path stays
+            # local — the pmean rides behind stop_gradient because under
+            # shard_map(check_vma=False) a differentiated psum transposes
+            # to another psum, over-counting replicated cotangents by the
+            # axis size (same hazard tensor_parallel's f/g guard against).
+            # Each device's aux grad is its shard's contribution; the
+            # trainer's moe_sync_grads psum completes it, exactly like
+            # the CE loss path.
+            aux = aux + jax.lax.stop_gradient(
+                jax.lax.pmean(aux, self.axis_name) - aux)
+            z = z + jax.lax.stop_gradient(
+                jax.lax.pmean(z, self.axis_name) - z)
+        self.sow("intermediates", "moe_aux_loss", aux)
+        self.sow("intermediates", "moe_router_z_loss", z)
+
+        cdt = x.dtype if self.dtype is None else self.dtype
+        expert_in = jnp.einsum("nec,nm->ecm", dispatch.astype(cdt),
+                               xf.astype(cdt))               # (E, C, M)
+        if self.axis_name is not None and ep > 1:
+            # (E, C, M) -> (E/ep, ep*C, M): send each peer its experts'
+            # slots, receive my experts' slots from every peer
+            expert_in = jax.lax.all_to_all(
+                expert_in, self.axis_name, split_axis=0, concat_axis=1,
+                tiled=True)
+
+        wi = self.param("wi", nn.initializers.lecun_normal(),
+                        (e_loc, m, hidden))
+        bi = self.param("bi", nn.initializers.zeros_init(),
+                        (e_loc, hidden))
+        wo = self.param("wo", nn.initializers.lecun_normal(),
+                        (e_loc, hidden, m))
+        bo = self.param("bo", nn.initializers.zeros_init(),
+                        (e_loc, m))
+        h = jnp.einsum("ecm,emh->ech", expert_in, wi.astype(cdt))
+        h = nn.gelu(h + bi.astype(cdt)[:, None, :])
+        out = jnp.einsum("ech,ehm->ecm", h, wo.astype(cdt))
+        out = out + bo.astype(cdt)[:, None, :]
+
+        if self.axis_name is not None and ep > 1:
+            out = jax.lax.all_to_all(
+                out, self.axis_name, split_axis=1, concat_axis=0,
+                tiled=True)                                  # (E, C, M)
+        y = jnp.einsum("nec,ecm->nm", combine.astype(cdt), out)
+        return y.reshape(b, s, m).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Param layout + grad sync helpers
+# ---------------------------------------------------------------------------
+
+_EXPERT_LEAVES = ("wi", "bi", "wo", "bo")
+
+
+def lm_moe_pspecs(params: Tree, axis: str = "expert") -> Tree:
+    """PartitionSpec tree for a TransformerLM (or bare MoEMLP) param
+    tree: expert-stacked leaves (``wi/bi/wo/bo`` under a ``moe`` module)
+    shard their leading expert dim over ``axis``; the router and every
+    non-MoE param stay replicated."""
+
+    def spec(path_names, leaf):
+        names = [str(getattr(p, "key", getattr(p, "name", p)))
+                 for p in path_names]
+        # "moe" parent inside a TransformerLM tree; a bare MoEMLP tree
+        # has the expert leaves at the root
+        in_moe = "moe" in names or len(names) == 1
+        if in_moe and names[-1] in _EXPERT_LEAVES:
+            return P(axis, *([None] * (leaf.ndim - 1)))
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def moe_sync_grads(grads: Tree, specs: Tree, axis: str) -> Tree:
+    """Cross-device gradient sync for the EP layout: replicated-param
+    grads psum over ``axis`` (each device computed only its token
+    shard's contribution); expert-sharded grads pass through — the
+    backward all_to_all already accumulated every shard's contribution
+    into the owning device (its transpose is the forward exchange)."""
+    return jax.tree_util.tree_map(
+        lambda g, sp: g if (len(sp) > 0 and sp[0] is not None)
+        else jax.lax.psum(g, axis),
+        grads, specs, is_leaf=lambda t: isinstance(t, P))
+
+
+def moe_aux_total(intermediates: Tree, *, balance_coef: float = 1e-2,
+                  z_coef: float = 1e-3):
+    """Weighted sum of every sown MoE auxiliary loss (mean across MoE
+    blocks, Switch convention): ``balance_coef * mean(aux) +
+    z_coef * mean(z)``. Returns 0.0 when the tree holds none (dense
+    model), so trainers can add it unconditionally."""
+    aux, z = [], []
+
+    def visit(path_names, leaf):
+        names = [str(getattr(p, "key", getattr(p, "name", p)))
+                 for p in path_names]
+        vals = leaf if isinstance(leaf, (tuple, list)) else (leaf,)
+        if any(n == "moe_aux_loss" for n in names):
+            aux.extend(vals)
+        elif any(n == "moe_router_z_loss" for n in names):
+            z.extend(vals)
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, intermediates)
+    total = jnp.zeros((), jnp.float32)
+    if aux:
+        total = total + balance_coef * sum(aux) / len(aux)
+    if z:
+        total = total + z_coef * sum(z) / len(z)
+    return total
