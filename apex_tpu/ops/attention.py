@@ -474,17 +474,32 @@ def _recompute_p_ds(scale, causal, rate, sq_actual, sk_actual, bq, bk,
 
 
 def _flash_bwd_kv_kernel(scale, causal, rate, sq_actual, sk_actual, bq, bk,
-                         nq, nk, has_bias, pad_cols, *refs):
+                         nq, nk, has_bias, pad_cols, bias_grad,
+                         db_per_row, *refs):
     """Grid (bh, ik, iq): accumulate dK/dV for key block ik over all query
     blocks. p = exp2(s2 - lse2); dv += p^T dO; ds = p*(dP - delta);
-    dk += ds^T q * scale."""
+    dk += ds^T q * scale. With ``bias_grad``, ds IS dbias for this
+    (iq, ik) block (s = scale·qkᵀ + bias, so ∂L/∂bias = ∂L/∂s): a
+    row-varying bias writes it straight out (each block pair is visited
+    once); a row-BROADCAST bias (sqb == 1, e.g. a learned column bias)
+    accumulates the column sums in a (1, bk) scratch over the inner iq
+    sweep — the dk_scr pattern — so only an O(sk) plane ever reaches
+    HBM."""
     if has_bias:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref, b_ref,
-         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+         *rest) = refs
     else:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref,
-         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+         *rest) = refs
         b_ref = None
+    db_scr = None
+    if bias_grad and not db_per_row:
+        dk_ref, dv_ref, db_ref, dk_scr, dv_scr, db_scr = rest
+    elif bias_grad:
+        dk_ref, dv_ref, db_ref, dk_scr, dv_scr = rest
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
+        db_ref = None
     bh = pl.program_id(0)
     ik = pl.program_id(1)
     iq = pl.program_id(2)
@@ -493,6 +508,13 @@ def _flash_bwd_kv_kernel(scale, causal, rate, sq_actual, sk_actual, bq, bk,
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
+        if db_scr is not None:
+            db_scr[:] = jnp.zeros_like(db_scr)
+
+    if bias_grad and db_per_row:
+        # causal-skipped blocks never run _compute; their dbias is zero,
+        # and a pure-write output must still be written every grid step
+        db_ref[0] = jnp.zeros((bq, bk), db_ref.dtype)
 
     def _compute(masked):
         q, _, p, do, ds = _recompute_p_ds(
@@ -505,6 +527,10 @@ def _flash_bwd_kv_kernel(scale, causal, rate, sq_actual, sk_actual, bq, bk,
         dk_scr[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # ds^T q
+        if bias_grad and db_per_row:
+            db_ref[0] = ds.astype(db_ref.dtype)
+        elif bias_grad:
+            db_scr[:] += jnp.sum(ds, axis=0, keepdims=True)
 
     _mask_variants(causal, pad_cols, iq, ik, bq, bk,
                    sk_actual - sq_actual, nk, _compute)
@@ -513,6 +539,8 @@ def _flash_bwd_kv_kernel(scale, causal, rate, sq_actual, sk_actual, bq, bk,
     def _finalize():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+        if db_scr is not None:
+            db_ref[0] = db_scr[:].astype(db_ref.dtype)
 
 
 def _flash_bwd_q_kernel(scale, causal, rate, sq_actual, sk_actual, bq, bk,
@@ -552,7 +580,8 @@ def _flash_bwd_q_kernel(scale, causal, rate, sq_actual, sk_actual, bq, bk,
 
 
 def _flash_bwd_fused_kernel(scale, causal, rate, sq_actual, sk_actual, bq,
-                            bk, nq, nk, has_bias, pad_cols, *refs):
+                            bk, nq, nk, has_bias, pad_cols, bias_grad,
+                            db_per_row, *refs):
     """Single-sweep backward, grid (bh, ik, iq): the VPU-bound softmax
     recompute (s → p → dP → ds) runs ONCE per (iq, ik) block pair and
     feeds all three gradients — dV/dK accumulate in per-key-block scratch
@@ -566,11 +595,20 @@ def _flash_bwd_fused_kernel(scale, causal, rate, sq_actual, sk_actual, bq,
     variant below recomputed the softmax chain twice."""
     if has_bias:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref, b_ref,
-         dk_ref, dv_ref, dq_ref, dk_scr, dv_scr, dq_scr) = refs
+         *rest) = refs
     else:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref,
-         dk_ref, dv_ref, dq_ref, dk_scr, dv_scr, dq_scr) = refs
+         *rest) = refs
         b_ref = None
+    db_scr = None
+    if bias_grad and not db_per_row:
+        (dk_ref, dv_ref, dq_ref, db_ref,
+         dk_scr, dv_scr, dq_scr, db_scr) = rest
+    elif bias_grad:
+        dk_ref, dv_ref, dq_ref, db_ref, dk_scr, dv_scr, dq_scr = rest
+    else:
+        dk_ref, dv_ref, dq_ref, dk_scr, dv_scr, dq_scr = rest
+        db_ref = None
     bh = pl.program_id(0)
     ik = pl.program_id(1)
     iq = pl.program_id(2)
@@ -579,11 +617,18 @@ def _flash_bwd_fused_kernel(scale, causal, rate, sq_actual, sk_actual, bq,
     def _init_kv():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
+        if db_scr is not None:
+            db_scr[:] = jnp.zeros_like(db_scr)
 
     @pl.when(ik == 0)
     def _init_q():
         dq_scr[pl.ds(iq * bq, bq), :] = jnp.zeros(
             (bq, dq_scr.shape[1]), jnp.float32)
+
+    if bias_grad and db_per_row:
+        # see _flash_bwd_kv_kernel: skipped causal blocks still need a
+        # written (zero) dbias block
+        db_ref[0] = jnp.zeros((bq, bk), db_ref.dtype)
 
     def _compute(masked):
         q, kblk, p, do, ds = _recompute_p_ds(
@@ -599,6 +644,10 @@ def _flash_bwd_fused_kernel(scale, causal, rate, sq_actual, sk_actual, bq,
         dq_scr[pl.ds(iq * bq, bq), :] += jax.lax.dot_general(
             ds, kblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # ds k -> (bq, d)
+        if bias_grad and db_per_row:
+            db_ref[0] = ds.astype(db_ref.dtype)
+        elif bias_grad:
+            db_scr[:] += jnp.sum(ds, axis=0, keepdims=True)
 
     _mask_variants(causal, pad_cols, iq, ik, bq, bk,
                    sk_actual - sq_actual, nk, _compute)
@@ -607,6 +656,8 @@ def _flash_bwd_fused_kernel(scale, causal, rate, sq_actual, sk_actual, bq,
     def _finalize_kv():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+        if db_scr is not None:
+            db_ref[0] = db_scr[:].astype(db_ref.dtype)
 
     @pl.when(ik == nk - 1)
     def _finalize_q():
@@ -646,7 +697,7 @@ def _fused_bwd_plan(sq: int, d: int) -> Tuple[bool, int]:
 def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
                dropout_rate: float = 0.0, dropout_seed=None,
                bias=None, block_q: Optional[int] = None,
-               block_k: Optional[int] = None):
+               block_k: Optional[int] = None, bias_grad: bool = False):
     """Pallas flash backward: O(S) memory (only lse/delta row stats are
     carried; the (Sq, Sk) score matrix never hits HBM) — the counterpart of
     the reference's fused MHA backward kernels. Default: a single fused
@@ -671,6 +722,8 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)                     # (b, h, sq)
 
+    if bias_grad and bias is None:
+        raise ValueError("bias_grad=True requires a bias")
     dp_ = ((d + 127) // 128) * 128
     # Fused-vs-two-pass decision precedes block choice (each path has its
     # own tuning): fused iff the 128-aligned full-seq dq scratch fits.
@@ -678,6 +731,14 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
     if fused:
         block_q = min(block_q, bq_cap)
         block_k = min(block_k, _FUSED_BLOCK_K)
+    db_per_row = bias_grad and bias.shape[2] != 1
+    if db_per_row:
+        # the (bq, bk) f32 dbias output block shares the same VMEM budget
+        # as the score temporaries; 512-wide caps keep it at <=1 MB.
+        # Row-broadcast biases skip this: their dbias lives in a (1, bk)
+        # scratch, no plane and no cap.
+        block_q = min(block_q, 512)
+        block_k = min(block_k, 512)
     bq = _pick_block(block_q, sq)
     bk = _pick_block(block_k, sk)
     sqp = ((sq + bq - 1) // bq) * bq
@@ -716,14 +777,31 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
     k_spec = pl.BlockSpec((1, bk, dp_), lambda bh, i, j: (bh, i, 0))
     row_spec = pl.BlockSpec((1, 1, bq), lambda bh, i, j: (bh, 0, j))
 
+    # dbias output: for a row-varying bias, the (sqp, skp) score-grad
+    # plane (rows from the iq grid dim — 2 on the kv/fused grid — cols
+    # from ik, dim 1); for a row-broadcast bias, only the in-kernel
+    # row-reduced (1, skp) plane (O(sk), not O(sq·sk) — flash's O(S)
+    # memory survives a learned column bias). Remaining broadcast dims
+    # (batch/head — the bh grid dim is outermost, so its revisits are
+    # non-consecutive and cannot accumulate in-kernel) reduce in
+    # _reduce_dbias afterwards.
+    db_specs, db_shapes, db_scratch = [], [], []
+    if bias_grad and db_per_row:
+        db_specs = [pl.BlockSpec((1, bq, bk), lambda bh, i, j: (bh, j, i))]
+        db_shapes = [jax.ShapeDtypeStruct((b * h, sqp, skp), jnp.float32)]
+    elif bias_grad:
+        db_specs = [pl.BlockSpec((1, 1, bk), lambda bh, i, j: (bh, 0, i))]
+        db_shapes = [jax.ShapeDtypeStruct((b * h, 1, skp), jnp.float32)]
+        db_scratch = [pltpu.VMEM((1, bk), jnp.float32)]
+
     if fused:
         # One sweep, all three grads: the softmax recompute chain (the
         # kernel's VPU bottleneck) runs once per block pair instead of
         # twice. dq rides a persistent (sqp, dp) f32 scratch.
-        dk, dv, dq = pl.pallas_call(
+        dk, dv, dq, *db = pl.pallas_call(
             functools.partial(_flash_bwd_fused_kernel, scale, causal,
                               dropout_rate, sq, sk, bq, bk, nq, nk,
-                              has_bias, skp != sk),
+                              has_bias, skp != sk, bias_grad, db_per_row),
             grid=(b * h, nk, nq),
             in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec,
                       pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -732,33 +810,42 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
                 pl.BlockSpec((1, bk, dp_), lambda bh, i, j: (bh, i, 0)),
                 pl.BlockSpec((1, bk, dp_), lambda bh, i, j: (bh, i, 0)),
                 pl.BlockSpec((1, bq, dp_), lambda bh, i, j: (bh, j, 0)),
+                *db_specs,
             ],
             out_shape=[
                 jax.ShapeDtypeStruct((b * h, skp, dp_), dtype),
                 jax.ShapeDtypeStruct((b * h, skp, dp_), dtype),
                 jax.ShapeDtypeStruct((b * h, sqp, dp_), dtype),
+                *db_shapes,
             ],
             scratch_shapes=[pltpu.VMEM((bk, dp_), jnp.float32),
                             pltpu.VMEM((bk, dp_), jnp.float32),
-                            pltpu.VMEM((sqp, dp_), jnp.float32)],
+                            pltpu.VMEM((sqp, dp_), jnp.float32),
+                            *db_scratch],
             interpret=_interpret(),
         )(qf, kf, vf, dof, lsef, deltaf, seed, *bias_ops)
         dq = dq[:, :sq, :d].reshape(b, h, sq, d)
         dk = dk[:, :sk, :d].reshape(b, h, sk, d)
         dv = dv[:, :sk, :d].reshape(b, h, sk, d)
+        if bias_grad:
+            rows = sq if db_per_row else 1
+            return dq, dk, dv, \
+                db[0][:, :rows, :sk].reshape(b, h, rows, sk)
         return dq, dk, dv
 
-    dk, dv = pl.pallas_call(
+    dk, dv, *db = pl.pallas_call(
         functools.partial(_flash_bwd_kv_kernel, scale, causal,
                           dropout_rate, sq, sk, bq, bk, nq, nk, has_bias,
-                          skp != sk),
+                          skp != sk, bias_grad, db_per_row),
         grid=(b * h, nk, nq),
         in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec,
                   pl.BlockSpec(memory_space=pltpu.SMEM), *kv_bias_specs],
         out_specs=[pl.BlockSpec((1, bk, dp_), lambda bh, i, j: (bh, i, 0))]
-        * 2,
-        out_shape=[jax.ShapeDtypeStruct((b * h, skp, dp_), dtype)] * 2,
-        scratch_shapes=[pltpu.VMEM((bk, dp_), jnp.float32)] * 2,
+        * 2 + db_specs,
+        out_shape=[jax.ShapeDtypeStruct((b * h, skp, dp_), dtype)] * 2
+        + db_shapes,
+        scratch_shapes=[pltpu.VMEM((bk, dp_), jnp.float32)] * 2
+        + db_scratch,
         interpret=_interpret(),
     )(qf, kf, vf, dof, lsef, deltaf, seed, *bias_ops)
 
@@ -781,34 +868,56 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
     dq = dq[:, :sq, :d].reshape(b, h, sq, d)
     dk = dk[:, :sk, :d].reshape(b, h, sk, d)
     dv = dv[:, :sk, :d].reshape(b, h, sk, d)
+    if bias_grad:
+        rows = sq if db_per_row else 1
+        return dq, dk, dv, db[0][:, :rows, :sk].reshape(b, h, rows, sk)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _reduce_dbias(db_full, bias):
+    """Reduce the full-rank (b, h, sq, sk) f32 score grad to the bias's
+    broadcast shape (summing over dims the bias broadcast), cast to the
+    bias dtype — the cotangent custom_vjp must return."""
+    axes = tuple(i for i, (dbd, bd)
+                 in enumerate(zip(db_full.shape, bias.shape)) if bd == 1
+                 and dbd != 1)
+    if axes:
+        db_full = jnp.sum(db_full, axis=axes, keepdims=True)
+    return db_full.astype(bias.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
 def _flash_attention_core(q, k, v, bias, seed, causal, scale, rate,
-                          has_bias):
+                          has_bias, bias_grad):
     out, _ = _flash_fwd(q, k, v, causal=causal, scale=scale,
                         dropout_rate=rate, dropout_seed=seed,
                         bias=bias if has_bias else None)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, bias, seed, causal, scale, rate, has_bias):
+def _flash_vjp_fwd(q, k, v, bias, seed, causal, scale, rate, has_bias,
+                   bias_grad):
     out, lse = _flash_fwd(q, k, v, causal=causal, scale=scale,
                           dropout_rate=rate, dropout_seed=seed,
                           bias=bias if has_bias else None)
     return out, (q, k, v, bias, seed, out, lse)
 
 
-def _flash_vjp_bwd(causal, scale, rate, has_bias, res, g):
+def _flash_vjp_bwd(causal, scale, rate, has_bias, bias_grad, res, g):
     q, k, v, bias, seed, out, lse = res
-    dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, causal=causal,
-                            scale=scale, dropout_rate=rate,
-                            dropout_seed=seed,
-                            bias=bias if has_bias else None)
-    # bias is a mask/additive constant (the public wrapper stop_gradients
-    # it); integer seed: zero-size float0 cotangent
+    grads = _flash_bwd(q, k, v, out, lse, g, causal=causal,
+                       scale=scale, dropout_rate=rate,
+                       dropout_seed=seed,
+                       bias=bias if has_bias else None,
+                       bias_grad=bias_grad and has_bias)
+    # integer seed: zero-size float0 cotangent
     dseed = np.zeros(np.shape(seed), jax.dtypes.float0)
+    if bias_grad and has_bias:
+        dq, dk, dv, db = grads
+        return dq, dk, dv, _reduce_dbias(db, bias), dseed
+    # bias is a mask/additive constant (the public wrapper stop_gradients
+    # it unless trainable_bias)
+    dq, dk, dv = grads
     return dq, dk, dv, jnp.zeros_like(bias), dseed
 
 
@@ -818,7 +927,7 @@ _flash_attention_core.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
                     dropout_rate: float = 0.0, dropout_seed=None,
-                    bias=None):
+                    bias=None, trainable_bias: bool = False):
     """Flash attention: Pallas forward AND backward (blockwise, O(S) HBM —
     the (Sq, Sk) score matrix never materializes in either direction).
     ``dropout_rate`` > 0 fuses dropout into the kernels (the reference's
@@ -831,8 +940,17 @@ def flash_attention(q, k, v, causal: bool = False,
     *_bias_additive_mask and masked_softmax kernels
     (self_multihead_attn_bias_additive_mask_cuda.cu). Broadcast dims stay
     broadcast in HBM (a (b, 1, 1, sk) pad mask costs O(b·sk), not
-    O(b·h·sq·sk)). Treated as a constant (stop_gradient): masks are data;
-    for a LEARNED score bias use the dense reference path."""
+    O(b·h·sq·sk)). By default the bias is a constant (stop_gradient):
+    masks are data. ``trainable_bias=True`` makes it a LEARNED score bias
+    (T5 relative bias, learned ALiBi, ...): the backward kernel emits the
+    per-block score grad ds = p·(dP − Δ) as a fourth output (each block
+    pair is visited once — a pure write, no extra matmuls) and the
+    cotangent reduces over the bias's broadcast dims. Cost: O(sq·sk) f32
+    HBM traffic for a bias that VARIES over query rows — inherent to a
+    full-rank bias grad, the same cost the dense path pays; a
+    row-broadcast bias (e.g. a learned column bias, sqb == 1) reduces
+    rows in-kernel and writes only an O(sk) plane, keeping flash's O(S)
+    memory."""
     scale = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
     rate = float(dropout_rate)
     if rate > 0.0 and dropout_seed is None:
@@ -843,8 +961,11 @@ def flash_attention(q, k, v, causal: bool = False,
     seed = jnp.asarray(0 if dropout_seed is None else dropout_seed,
                        jnp.int32)
     has_bias = bias is not None
+    bias_grad = bool(trainable_bias) and has_bias
     if has_bias:
-        bias_arr = jax.lax.stop_gradient(jnp.asarray(bias))
+        bias_arr = jnp.asarray(bias)
+        if not bias_grad:
+            bias_arr = jax.lax.stop_gradient(bias_arr)
     else:
         bias_arr = jnp.zeros((1, 1, 1, 1), jnp.float32)
     # Mosaic has no f16 (fp16 amp levels O1/O2 cast q/k/v to float16):
@@ -857,10 +978,10 @@ def flash_attention(q, k, v, causal: bool = False,
         out = _flash_attention_core(
             q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
             v.astype(jnp.bfloat16), bias_arr, seed, causal, scale, rate,
-            has_bias)
+            has_bias, bias_grad)
         return out.astype(jnp.float16)
     return _flash_attention_core(q, k, v, bias_arr, seed, causal, scale,
-                                 rate, has_bias)
+                                 rate, has_bias, bias_grad)
 
 
 def attention_model_flops(b, h, sq, sk, d, *, causal=False,
@@ -885,12 +1006,15 @@ def attention_model_flops(b, h, sq, sk, d, *, causal=False,
 
 
 def self_attention(q, k, v, *, causal=False, scale=None, impl="auto",
-                   bias=None):
-    """Dispatch: Pallas flash on TPU, jnp reference elsewhere/when asked."""
+                   bias=None, trainable_bias=False):
+    """Dispatch: Pallas flash on TPU, jnp reference elsewhere/when asked.
+    (The reference path always differentiates ``bias``;
+    ``trainable_bias`` controls the flash kernels' dbias emission.)"""
     if impl == "auto":
         impl = "flash" if not _interpret() else "default"
     if impl == "flash":
-        return flash_attention(q, k, v, causal, scale, bias=bias)
+        return flash_attention(q, k, v, causal, scale, bias=bias,
+                               trainable_bias=trainable_bias)
     return attention_reference(q, k, v, causal=causal, scale=scale,
                                bias=bias)
 
@@ -969,20 +1093,23 @@ def _ring_flash_fwd(q, k, v, bias, axis_name, causal, scale):
     return o.astype(q.dtype), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _ring_flash_core(q, k, v, bias, axis_name, causal, scale, has_bias):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _ring_flash_core(q, k, v, bias, axis_name, causal, scale, has_bias,
+                     bias_grad):
     o, _ = _ring_flash_fwd(q, k, v, bias if has_bias else None,
                            axis_name, causal, scale)
     return o
 
 
-def _ring_flash_vjp_fwd(q, k, v, bias, axis_name, causal, scale, has_bias):
+def _ring_flash_vjp_fwd(q, k, v, bias, axis_name, causal, scale, has_bias,
+                        bias_grad):
     o, lse = _ring_flash_fwd(q, k, v, bias if has_bias else None,
                              axis_name, causal, scale)
     return o, (q, k, v, bias, o, lse)
 
 
-def _ring_flash_vjp_bwd(axis_name, causal, scale, has_bias, res, g):
+def _ring_flash_vjp_bwd(axis_name, causal, scale, has_bias, bias_grad,
+                        res, g):
     """Ring backward: a second ring pass with the GLOBAL lse (saved) and
     global delta (recomputed per chunk inside _flash_bwd from the global
     out/g rows), so per-chunk p = exp(s - lse_global) sums to the exact
@@ -990,50 +1117,86 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, has_bias, res, g):
     after `world` steps each device holds the full gradient for its own
     chunk — one extra ppermute pair per step, still O(S_loc) memory."""
     q, k, v, bias, o, lse = res
+    bias_arr = bias
     bias = bias if has_bias else None
     world = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
-    _, _, s_loc, _ = q.shape
+    b, h, s_loc, _ = q.shape
+    want_db = bias_grad and has_bias
+    db_chunk_shape = None
+    if want_db:
+        bb, hb, sqb, _ = bias_arr.shape
+        db_chunk_shape = (bb, hb, sqb, s_loc)
 
     def chunk_bwd(kc, vc, mode, bias_c):
+        def grads(causal_c):
+            out = _flash_bwd(q, kc, vc, o, lse, g, causal=causal_c,
+                             scale=scale, bias=bias_c,
+                             bias_grad=want_db)
+            if want_db:
+                dq_i, dk_i, dv_i, db_full = out
+                # reduce the (b, h, s_loc, s_loc) score grad to this
+                # chunk's bias column window at the bias's broadcast
+                # shape (rows are this device's local queries)
+                axes = tuple(i for i, bd in enumerate(db_chunk_shape)
+                             if bd == 1 and db_full.shape[i] != 1)
+                db_i = (jnp.sum(db_full, axis=axes, keepdims=True)
+                        if axes else db_full)
+                return dq_i, dk_i, dv_i, db_i
+            return out
+
         def full(_):
-            return _flash_bwd(q, kc, vc, o, lse, g, causal=False,
-                              scale=scale, bias=bias_c)
+            return grads(False)
 
         def diag(_):
-            return _flash_bwd(q, kc, vc, o, lse, g, causal=True,
-                              scale=scale, bias=bias_c)
+            return grads(True)
 
         def skip(_):
-            return (jnp.zeros_like(q), jnp.zeros_like(kc),
+            zero = (jnp.zeros_like(q), jnp.zeros_like(kc),
                     jnp.zeros_like(vc))
+            if want_db:
+                return zero + (jnp.zeros(db_chunk_shape, jnp.float32),)
+            return zero
 
         return jax.lax.switch(mode, [full, diag, skip], None)
 
     def body(i, carry):
-        dq, kc, vc, dkc, dvc = carry
+        dq, kc, vc, dkc, dvc, dbb = carry
         src = (rank - i) % world
-        dq_i, dk_i, dv_i = chunk_bwd(
+        out_i = chunk_bwd(
             kc, vc, _ring_mode(causal, src, rank),
             _ring_bias_chunk(bias, src, s_loc))
+        dq_i, dk_i, dv_i = out_i[:3]
         dq = dq + dq_i.astype(jnp.float32)
         dkc = dkc + dk_i.astype(jnp.float32)
         dvc = dvc + dv_i.astype(jnp.float32)
+        if want_db:
+            # each source chunk's column window is visited exactly once
+            dbb = jax.lax.dynamic_update_slice_in_dim(
+                dbb, out_i[3], src * s_loc, axis=3)
         perm = _ring_perm(world)
         kc = jax.lax.ppermute(kc, axis_name, perm)
         vc = jax.lax.ppermute(vc, axis_name, perm)
         dkc = jax.lax.ppermute(dkc, axis_name, perm)
         dvc = jax.lax.ppermute(dvc, axis_name, perm)
-        return (dq, kc, vc, dkc, dvc)
+        return (dq, kc, vc, dkc, dvc, dbb)
 
     dq0 = jnp.zeros(q.shape, jnp.float32)
     dk0 = jnp.zeros(k.shape, jnp.float32)
     dv0 = jnp.zeros(v.shape, jnp.float32)
-    dq, _, _, dk, dv = jax.lax.fori_loop(
-        0, world, body, (dq0, k, v, dk0, dv0))
+    db0 = (jnp.zeros(bias_arr.shape, jnp.float32) if want_db
+           else jnp.zeros((1,), jnp.float32))
+    dq, _, _, dk, dv, dbb = jax.lax.fori_loop(
+        0, world, body, (dq0, k, v, dk0, dv0, db0))
+    if want_db:
+        # LOCAL contribution (this device's query rows): for a bias
+        # replicated across the ring, psum the grad over the axis
+        dbias = dbb.astype(bias_arr.dtype)
+    else:
+        dbias = (jnp.zeros_like(bias_arr) if has_bias
+                 else jnp.zeros((1, 1, 1, 1), jnp.float32))
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
-            jnp.zeros_like(bias) if has_bias else
-            jnp.zeros((1, 1, 1, 1), jnp.float32))
+            dbias)
 
 
 _ring_flash_core.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
@@ -1041,7 +1204,7 @@ _ring_flash_core.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
 
 def ring_self_attention(q, k, v, axis_name: str, *, causal: bool = False,
                         scale: Optional[float] = None, bias=None,
-                        impl: str = "auto"):
+                        impl: str = "auto", trainable_bias: bool = False):
     """Ring attention: each device holds a sequence shard (B, H, S_local, D);
     K/V shards rotate around the ring via ``lax.ppermute`` while each device
     accumulates its queries' attention over every K/V chunk with blockwise
@@ -1058,10 +1221,14 @@ def ring_self_attention(q, k, v, axis_name: str, *, causal: bool = False,
     ``bias`` is a per-device additive score bias with GLOBAL key columns:
     shape broadcastable to (B, H, S_local, S_global) — e.g. a replicated
     key-padding mask (B, 1, 1, S_global). Each ring step slices the
-    arriving chunk's column window. The bias is a CONSTANT
-    (stop_gradient) on the flash path — no dbias is accumulated around
-    the ring — so a LEARNED score bias must use the dense path
-    (``impl='default'`` here, or attention_reference; see
+    arriving chunk's column window. By default the bias is a CONSTANT
+    (stop_gradient) on the flash path; ``trainable_bias=True`` makes it
+    learned — each ring step's flash backward also emits that chunk's
+    score grad, written into the bias's column window (every window is
+    visited exactly once). The returned dbias is this device's LOCAL
+    contribution (its query rows); for a bias REPLICATED across the
+    ring, ``psum`` the grad over ``axis_name`` (the same contract as
+    every replicated-param grad in this framework; see
     docs/source/advanced.rst "Attention masks vs learned biases").
 
     ``impl='flash'`` composes the Pallas flash kernels into the ring (each
@@ -1086,17 +1253,20 @@ def ring_self_attention(q, k, v, axis_name: str, *, causal: bool = False,
         impl = "flash" if not _interpret() else "default"
     if impl == "flash":
         has_bias = bias is not None
-        bias_arr = (jax.lax.stop_gradient(bias) if has_bias
-                    else jnp.zeros((1, 1, 1, 1), jnp.float32))
+        bias_grad = bool(trainable_bias) and has_bias
+        if has_bias:
+            bias_arr = bias if bias_grad else jax.lax.stop_gradient(bias)
+        else:
+            bias_arr = jnp.zeros((1, 1, 1, 1), jnp.float32)
         if q.dtype == jnp.float16 and not _interpret():
             # Mosaic has no f16 — bf16 reroute, see flash_attention
             o = _ring_flash_core(
                 q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
                 v.astype(jnp.bfloat16), bias_arr, axis_name, causal,
-                scale_, has_bias)
+                scale_, has_bias, bias_grad)
             return o.astype(jnp.float16)
         return _ring_flash_core(q, k, v, bias_arr, axis_name, causal,
-                                scale_, has_bias)
+                                scale_, has_bias, bias_grad)
 
     def chunk_attn(q_, k_, v_, mode, bias_c):
         # mode: 0 = full, 1 = causal-diagonal, 2 = skip
@@ -1140,7 +1310,8 @@ def ring_self_attention(q, k, v, axis_name: str, *, causal: bool = False,
 def ulysses_self_attention(q, k, v, axis_name: str, *,
                            causal: bool = False,
                            scale: Optional[float] = None,
-                           impl: str = "auto", bias=None):
+                           impl: str = "auto", bias=None,
+                           trainable_bias: bool = False):
     """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism: each
     device holds a sequence shard (B, H, S_local, D); one ``all_to_all``
     re-shards to (B, H/P, S_global, D) — heads scattered, sequence gathered
@@ -1190,8 +1361,12 @@ def ulysses_self_attention(q, k, v, axis_name: str, *,
     qg, kg, vg = jax.lax.all_to_all(
         jnp.stack([q, k, v]), axis_name, split_axis=2, concat_axis=3,
         tiled=True)
+    # trainable_bias: the flash dbias flows back through the head slice's
+    # autodiff transpose (dynamic_update_slice); a head-broadcast bias's
+    # grad is this device's LOCAL (head-subset) contribution — psum over
+    # the axis for a replicated bias, as with the ring
     o = self_attention(qg, kg, vg, causal=causal, scale=scale, impl=impl,
-                       bias=bias)
+                       bias=bias, trainable_bias=trainable_bias)
     # (B, H/P, S_glob, D) -> (B, H, S_loc, D)
     return jax.lax.all_to_all(o, axis_name, split_axis=2, concat_axis=1,
                               tiled=True)

@@ -108,7 +108,8 @@ def main():
     from apex_tpu.ops.attention import attention_reference, flash_attention
 
     def attn_cmp(name, causal, sq, sk, bias_shape=None, rate=0.0,
-                 rtol=2e-2, atol=2e-2, dtype=jnp.bfloat16):
+                 rtol=2e-2, atol=2e-2, dtype=jnp.bfloat16,
+                 trainable_bias=False):
         import zlib
         ks = jax.random.split(
             jax.random.PRNGKey(zlib.crc32(name.encode()) % 2**31), 5)
@@ -123,6 +124,23 @@ def main():
             # p to inf on padded query rows when sq wasn't a block multiple
             bias = jnp.abs(bias) + 100.0
         gg = jax.random.normal(ks[4], (b, h, sq, d), dtype)
+
+        if trainable_bias:
+            # differentiate w.r.t. the bias too: the dbias-emitting kernel
+            # variants must compile and match under real Mosaic
+            def run(fn):
+                out, vjp = jax.vjp(
+                    lambda a, b2, c, bb: fn(a, b2, c, bb), q, k, v, bias)
+                return (out, *vjp(gg))
+
+            got = run(lambda a, b2, c, bb: flash_attention(
+                a, b2, c, causal, bias=bb, dropout_rate=rate,
+                dropout_seed=7 if rate else None, trainable_bias=True))
+            want = run(lambda a, b2, c, bb: attention_reference(
+                a, b2, c, causal=causal, bias=bb, dropout_rate=rate,
+                dropout_seed=7 if rate else None))
+            cmp(name, got, want, rtol=rtol, atol=atol)
+            return
 
         def run(fn):
             out, vjp = jax.vjp(
@@ -154,12 +172,23 @@ def main():
     # keep fwd+grads finite and near the (f16-run) jnp reference
     attn_cmp("flash_fp16_reroute", True, 512, 512, dtype=jnp.float16,
              rtol=6e-2, atol=6e-2)
+    # learned score bias: the dbias-emitting fused kernel (full-rank and
+    # broadcast shapes, causal skip-blocks zero-written, ragged rows)
+    attn_cmp("flash_dbias_full", True, 512, 512,
+             bias_shape=(2, 2, 512, 512), trainable_bias=True,
+             rtol=6e-2, atol=6e-2)
+    attn_cmp("flash_dbias_broadcast_ragged", True, 200, 200,
+             bias_shape=(1, 2, 1, 200), trainable_bias=True,
+             rtol=6e-2, atol=6e-2)
     # force the two-pass long-context fallback on hardware too
     import apex_tpu.ops.attention as _A
     _saved = _A._FUSED_BWD_DQ_SCRATCH_BYTES
     _A._FUSED_BWD_DQ_SCRATCH_BYTES = 0
     try:
         attn_cmp("flash_two_pass_fallback", True, 1024, 1024)
+        attn_cmp("flash_dbias_two_pass", True, 512, 512,
+                 bias_shape=(2, 1, 512, 512), trainable_bias=True,
+                 rtol=6e-2, atol=6e-2)
     finally:
         _A._FUSED_BWD_DQ_SCRATCH_BYTES = _saved
 

@@ -593,3 +593,135 @@ def test_ring_flash_masked(mesh):
         out_specs=spec, check_vma=False))(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# Trainable (learned) score bias: dbias emission from the flash backward
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [(2, 4, 128, 128), (1, 4, 1, 128),
+                                   (2, 1, 128, 128), (1, 1, 1, 128)])
+def test_flash_trainable_bias_matches_reference(causal, shape):
+    """trainable_bias=True: the kernels' emitted dbias (reduced over the
+    bias's broadcast dims) matches differentiating the dense reference;
+    q/k/v grads are unchanged by the flag."""
+    q, k, v = qkv(jax.random.PRNGKey(70), s=128)
+    bias = jax.random.normal(jax.random.PRNGKey(71), shape)
+    g = jax.random.normal(jax.random.PRNGKey(72), q.shape)
+
+    _, vjp_fl = jax.vjp(
+        lambda a, b, c, bb: flash_attention(
+            a, b, c, causal, bias=bb, trainable_bias=True), q, k, v, bias)
+    _, vjp_ref = jax.vjp(
+        lambda a, b, c, bb: attention_reference(
+            a, b, c, bias=bb, causal=causal), q, k, v, bias)
+    for got, want in zip(vjp_fl(g), vjp_ref(g)):
+        assert got.shape == want.shape
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=3e-3, atol=2e-3)
+
+
+def test_flash_trainable_bias_ragged_cross_lengths():
+    """dbias with sq != sk, neither a block multiple (padded rows AND
+    ragged columns), causal bottom-right diagonal."""
+    ks = jax.random.split(jax.random.PRNGKey(73), 3)
+    sq, sk, d = 190, 250, 64
+    q = jax.random.normal(ks[0], (1, 2, sq, d))
+    k = jax.random.normal(ks[1], (1, 2, sk, d))
+    v = jax.random.normal(ks[2], (1, 2, sk, d))
+    bias = jax.random.normal(jax.random.PRNGKey(74), (1, 2, sq, sk))
+    g = jax.random.normal(jax.random.PRNGKey(75), q.shape)
+
+    _, vjp_fl = jax.vjp(
+        lambda bb: flash_attention(q, k, v, True, bias=bb,
+                                   trainable_bias=True), bias)
+    _, vjp_ref = jax.vjp(
+        lambda bb: attention_reference(q, k, v, bias=bb, causal=True),
+        bias)
+    np.testing.assert_allclose(np.asarray(vjp_fl(g)[0]),
+                               np.asarray(vjp_ref(g)[0]),
+                               rtol=3e-3, atol=2e-3)
+
+
+def test_flash_trainable_bias_with_dropout():
+    """dbias under fused dropout: ds picks up the same keep/rate factor
+    as dP — parity vs the jnp reference using the SAME counter mask."""
+    q, k, v = qkv(jax.random.PRNGKey(76), s=128)
+    bias = jax.random.normal(jax.random.PRNGKey(77), (1, 4, 128, 128))
+    g = jax.random.normal(jax.random.PRNGKey(78), q.shape)
+    rate, seed = 0.3, 11
+
+    _, vjp_fl = jax.vjp(
+        lambda bb: flash_attention(q, k, v, True, dropout_rate=rate,
+                                   dropout_seed=seed, bias=bb,
+                                   trainable_bias=True), bias)
+    _, vjp_ref = jax.vjp(
+        lambda bb: attention_reference(q, k, v, causal=True,
+                                       dropout_rate=rate,
+                                       dropout_seed=seed, bias=bb), bias)
+    np.testing.assert_allclose(np.asarray(vjp_fl(g)[0]),
+                               np.asarray(vjp_ref(g)[0]),
+                               rtol=3e-3, atol=2e-3)
+
+
+def test_flash_trainable_bias_two_pass_fallback(monkeypatch):
+    """The two-pass backward's kv kernel emits the same dbias when the
+    fused kernel's dq scratch would blow VMEM."""
+    import apex_tpu.ops.attention as A
+
+    monkeypatch.setattr(A, "_FUSED_BWD_DQ_SCRATCH_BYTES", 0)
+    q, k, v = qkv(jax.random.PRNGKey(79), s=200)
+    bias = jax.random.normal(jax.random.PRNGKey(80), (2, 1, 200, 200))
+    g = jax.random.normal(jax.random.PRNGKey(81), q.shape)
+    _, vjp_fl = jax.vjp(
+        lambda bb: flash_attention(q, k, v, True, bias=bb,
+                                   trainable_bias=True), bias)
+    _, vjp_ref = jax.vjp(
+        lambda bb: attention_reference(q, k, v, bias=bb, causal=True),
+        bias)
+    np.testing.assert_allclose(np.asarray(vjp_fl(g)[0]),
+                               np.asarray(vjp_ref(g)[0]),
+                               rtol=3e-3, atol=2e-3)
+
+
+def test_flash_constant_bias_still_zero_grad():
+    """Default (trainable_bias=False) keeps the mask-is-data contract:
+    zero bias cotangent."""
+    q, k, v = qkv(jax.random.PRNGKey(82), s=128)
+    bias = jax.random.normal(jax.random.PRNGKey(83), (1, 1, 128, 128))
+    _, vjp_fl = jax.vjp(
+        lambda bb: flash_attention(q, k, v, bias=bb), bias)
+    db = vjp_fl(jnp.ones(q.shape))[0]
+    assert float(jnp.max(jnp.abs(db))) == 0.0
+
+
+def test_ring_trainable_bias_matches_dense(mesh):
+    """Ring flash with a LEARNED bias replicated across the ring: each
+    device's dbias is its query rows' contribution; the psum over the
+    axis equals the dense reference's bias grad."""
+    b, h, s, d = 1, 2, NDEV * 16, 32
+    ks = jax.random.split(jax.random.PRNGKey(84), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d)) for kk in ks)
+    bias = jax.random.normal(jax.random.PRNGKey(85), (1, h, 1, s))
+    g = jax.random.normal(jax.random.PRNGKey(86), q.shape)
+
+    _, vjp_ref = jax.vjp(
+        lambda bb: attention_reference(q, k, v, bias=bb, causal=True),
+        bias)
+    want = vjp_ref(g)[0]
+
+    def per_device(q_, k_, v_, g_):
+        def f(bb):
+            return ring_self_attention(q_, k_, v_, "seq", causal=True,
+                                       bias=bb, impl="flash",
+                                       trainable_bias=True)
+        _, vjp = jax.vjp(f, bias)
+        return jax.lax.psum(vjp(g_)[0], "seq")
+
+    spec = P(None, None, "seq", None)
+    got = jax.jit(shard_map(
+        per_device, mesh=mesh, in_specs=(spec,) * 4,
+        out_specs=P(), check_vma=False))(q, k, v, g)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-3, atol=2e-3)
