@@ -37,6 +37,7 @@ __all__ = [
     "SelfMultiheadAttn", "EncdecMultiheadAttn", "masked_softmax_dropout",
     "self_attention", "flash_attention", "attention_reference",
     "ring_self_attention", "ulysses_self_attention",
+    "RelativePositionBias", "relative_position_bucket",
 ]
 
 
@@ -82,6 +83,63 @@ def _mask_to_bias(attn_mask):
     if m.ndim == 4:
         return m
     raise ValueError(f"attn_mask must be rank 1-4, got shape {m.shape}")
+
+
+def relative_position_bucket(rel_pos, *, bidirectional: bool,
+                             num_buckets: int, max_distance: int):
+    """T5-style log-spaced relative-position bucketing (Raffel et al.
+    2020 §2.1): exact buckets up to ``num_buckets//2`` positions back,
+    then logarithmically coarser out to ``max_distance``, everything
+    further sharing the last bucket. ``rel_pos = k_pos - q_pos``
+    (negative = key in the past). Unidirectional (causal) variants give
+    future positions bucket 0 — pair with a causal mask so they never
+    contribute."""
+    n = -rel_pos                      # positive = distance into the past
+    off = jnp.zeros_like(n)
+    if bidirectional:
+        num_buckets //= 2
+        off = jnp.where(n < 0, num_buckets, 0)
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    # log-spaced tail: bucket grows with log(distance), clamped to last
+    big = max_exact + (
+        jnp.log(jnp.maximum(n, 1).astype(jnp.float32) / max_exact)
+        / math.log(max_distance / max_exact)
+        * (num_buckets - max_exact)).astype(jnp.int32)
+    big = jnp.minimum(big, num_buckets - 1)
+    return off + jnp.where(n < max_exact, n, big)
+
+
+class RelativePositionBias(nn.Module):
+    """Learned T5-style relative position bias: a (num_buckets, heads)
+    embedding table indexed by the bucketed (sq, sk) relative-position
+    matrix → additive score bias (1, heads, sq, sk). Trains through the
+    flash kernels via ``trainable_bias=True`` (the bucket gather's
+    transpose is a segment-sum, so the O(sk)-or-O(sq·sk) kernel dbias
+    reduces onto the tiny table). The reference has no relative-bias
+    module (its *_bias_* kernels take constant masks); this consumes the
+    r4 dbias emission the way T5/ALiBi-family models need."""
+
+    num_heads: int
+    num_buckets: int = 32
+    max_distance: int = 128
+    bidirectional: bool = False
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, sq: int, sk: int, *, q_offset=0, k_offset=0):
+        table = self.param("rel_bias", nn.initializers.normal(0.02),
+                           (self.num_buckets, self.num_heads))
+        rel = (k_offset + jnp.arange(sk))[None, :] \
+            - (q_offset + jnp.arange(sq))[:, None]
+        buckets = relative_position_bucket(
+            rel, bidirectional=self.bidirectional,
+            num_buckets=self.num_buckets, max_distance=self.max_distance)
+        bias = table[buckets]                       # (sq, sk, h)
+        return bias.transpose(2, 0, 1)[None].astype(
+            self.dtype or jnp.float32)              # (1, h, sq, sk)
 
 
 def _derive_seed(rng, module_path):
@@ -149,6 +207,12 @@ class SelfMultiheadAttn(nn.Module):
     # validates param shapes at apply, so the local module must declare
     # the LOCAL feature sizes). num_heads must also be the local count.
     tensor_parallel_size: int = 1
+    # Learned T5-style relative position bias (RelativePositionBias):
+    # trains through the flash kernels via trainable_bias=True (r4 dbias
+    # emission). Composes additively with attn_mask.
+    relative_bias: bool = False
+    relative_bias_buckets: int = 32
+    relative_bias_max_distance: int = 128
 
     @nn.compact
     def __call__(self, x, *, attn_mask: Optional[jax.Array] = None,
@@ -156,6 +220,12 @@ class SelfMultiheadAttn(nn.Module):
                  dropout_rng: Optional[jax.Array] = None):
         e, h = self.embed_dim, self.num_heads
         assert e % h == 0, "embed_dim must divide num_heads"
+        if self.relative_bias and self.seq_parallel:
+            raise NotImplementedError(
+                "relative_bias under seq_parallel needs global-position "
+                "offsets threaded through the module — compute the bias "
+                "externally (RelativePositionBias(q_offset=rank*s_loc)) "
+                "and pass it as attn_mask, or use the dense path")
         if self.tensor_parallel_axis and self.seq_parallel:
             raise NotImplementedError(
                 "tensor_parallel_axis and seq_parallel are mutually "
@@ -211,6 +281,17 @@ class SelfMultiheadAttn(nn.Module):
                 out = out + residual
             return out
 
+        bias = _mask_to_bias(attn_mask)
+        if self.relative_bias:
+            # TP note: the table is per-LOCAL-head (h is the local count
+            # under tensor parallelism), so it shards with the heads
+            rel = RelativePositionBias(
+                num_heads=h, num_buckets=self.relative_bias_buckets,
+                max_distance=self.relative_bias_max_distance,
+                bidirectional=not self.causal, dtype=self.dtype,
+                name="rel_bias")(q.shape[2], k.shape[2])
+            bias = rel if bias is None else bias + rel
+
         if self.impl == "fast":
             # dropout AND the additive mask fuse into the flash kernels
             # (reference dropout.h + *_bias_additive_mask kernels); the
@@ -224,7 +305,8 @@ class SelfMultiheadAttn(nn.Module):
                     self.path)
             ctx = flash_attention(q, k, v, self.causal,
                                   dropout_rate=rate, dropout_seed=seed,
-                                  bias=_mask_to_bias(attn_mask))
+                                  bias=bias,
+                                  trainable_bias=self.relative_bias)
         else:
             # per-head dim from the ACTUAL q shape: under tensor
             # parallelism the local projection width is 3e/tp, and
@@ -241,7 +323,7 @@ class SelfMultiheadAttn(nn.Module):
             # mask gains the head axis instead of broadcasting against it
             # (ADVICE r2: the raw add raised or silently misaligned b vs h).
             p = masked_softmax_dropout(
-                s, mask=_mask_to_bias(attn_mask), dropout_rate=self.dropout,
+                s, mask=bias, dropout_rate=self.dropout,
                 rng=_tp_dropout_rng(dropout_rng,
                                     self.tensor_parallel_axis),
                 deterministic=deterministic)

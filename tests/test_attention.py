@@ -725,3 +725,85 @@ def test_ring_trainable_bias_matches_dense(mesh):
         out_specs=P(), check_vma=False))(q, k, v, g)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=3e-3, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# Learned relative position bias (T5-style, consumes trainable_bias)
+# ---------------------------------------------------------------------------
+
+def test_relative_position_bucket_properties():
+    from apex_tpu.contrib.multihead_attn import relative_position_bucket
+    nb, md = 32, 128
+    rel = jnp.arange(-300, 301)  # k_pos - q_pos
+    bu = relative_position_bucket(rel, bidirectional=False,
+                                  num_buckets=nb, max_distance=md)
+    bu = np.asarray(bu)
+    assert bu.min() >= 0 and bu.max() < nb
+    # future keys (rel > 0) all collapse to bucket 0 (causal pairing)
+    assert (bu[rel > 0] == 0).all()
+    # exact buckets for small distances: distance d -> bucket d
+    for d in range(nb // 2):
+        assert bu[np.where(np.asarray(rel) == -d)[0][0]] == d
+    # distances past max_distance share the last bucket
+    assert bu[0] == nb - 1 and bu[np.asarray(rel) == -md + 1][0] <= nb - 1
+    bb = np.asarray(relative_position_bucket(
+        rel, bidirectional=True, num_buckets=nb, max_distance=md))
+    # bidirectional: past in [0, nb/2), future in [nb/2, nb)
+    assert bb[rel < 0].max() < nb // 2 <= bb[rel > 0].min()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_self_mha_relative_bias_fast_matches_default(causal):
+    """The learned rel-pos bias trains identically through the flash
+    kernels (trainable_bias dbias path) and the dense softmax: outputs
+    and ALL grads — including the bias table's — match."""
+    e, h, s = 64, 4, 96
+    x = jax.random.normal(jax.random.PRNGKey(90), (2, s, e))
+
+    def build(impl):
+        return SelfMultiheadAttn(embed_dim=e, num_heads=h, causal=causal,
+                                 relative_bias=True, impl=impl)
+
+    params = build("fast").init(jax.random.PRNGKey(91), x)["params"]
+    assert "rel_bias" in params
+
+    outs, grads = {}, {}
+    for impl in ("fast", "default"):
+        m = build(impl)
+
+        def loss(p, xx):
+            return jnp.sum(m.apply({"params": p}, xx) ** 2)
+
+        outs[impl] = m.apply({"params": params}, x)
+        grads[impl] = jax.grad(loss)(params, x)
+
+    np.testing.assert_allclose(np.asarray(outs["fast"]),
+                               np.asarray(outs["default"]),
+                               rtol=2e-4, atol=2e-4)
+    flat_f, _ = jax.tree_util.tree_flatten_with_path(grads["fast"])
+    flat_d, _ = jax.tree_util.tree_flatten_with_path(grads["default"])
+    for (pf, gf), (_, gd) in zip(flat_f, flat_d):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gd), rtol=3e-3, atol=2e-3,
+            err_msg=str(pf))
+    table_grad = grads["fast"]["rel_bias"]["rel_bias"]
+    assert float(jnp.max(jnp.abs(table_grad))) > 0
+
+
+def test_self_mha_relative_bias_composes_with_mask():
+    e, h, s = 32, 2, 64
+    x = jax.random.normal(jax.random.PRNGKey(92), (1, s, e))
+    mask = jnp.where(jnp.arange(s) < s - 10, 0.0, -3e4)[None, None, None]
+    m = SelfMultiheadAttn(embed_dim=e, num_heads=h, relative_bias=True,
+                          impl="fast")
+    params = m.init(jax.random.PRNGKey(93), x)["params"]
+    out = m.apply({"params": params}, x, attn_mask=mask)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_self_mha_relative_bias_rejects_seq_parallel():
+    m = SelfMultiheadAttn(embed_dim=32, num_heads=2, relative_bias=True,
+                          seq_parallel="ring", axis_name="seq")
+    x = jnp.zeros((1, 16, 32))
+    with pytest.raises(NotImplementedError, match="relative_bias"):
+        m.init(jax.random.PRNGKey(0), x)
