@@ -61,6 +61,11 @@ def parse_args(argv=None):
                         "chunk of this size (never materializing the "
                         "(S, vocab) logits — at 128k x 32k vocab those "
                         "are ~17 GB); 0 = full logits")
+    p.add_argument("--moe", type=int, default=0,
+                   help="Mixture-of-Experts: every other block's MLP "
+                        "becomes this many experts (Switch/GShard, "
+                        "top-2, einsum dispatch); the balance + "
+                        "router-z losses join the objective")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--scan", type=int, default=1,
                    help=">1: dispatch-proof mode — N steps per jitted "
@@ -87,6 +92,7 @@ def main(argv=None):
         dtype=compute_dtype or jnp.float32,
         seq_parallel=args.seq_parallel,
         axis_name="seq" if args.seq_parallel else None,
+        moe_num_experts=args.moe,
         remat=args.remat)
     # params are identical across seq_parallel settings; init a dense twin
     # (a mesh axis is not bound at init time)
@@ -114,19 +120,24 @@ def main(argv=None):
         loss_axis = axis if args.seq_parallel else None
 
         def scaled(p):
+            mutable = ["intermediates"] if args.moe else []
             if args.loss_chunk:
-                hidden = model.apply(
+                hidden, inter = model.apply(
                     {"params": p}, tokens, pos_offset=off,
                     deterministic=args.dropout == 0.0, dropout_rng=rng,
-                    return_hidden=True)
+                    return_hidden=True, mutable=mutable)
                 loss = chunked_next_token_loss(
                     hidden, p["head"], tokens, chunk=args.loss_chunk,
                     axis_name=loss_axis)
             else:
-                logits = model.apply(
+                logits, inter = model.apply(
                     {"params": p}, tokens, pos_offset=off,
-                    deterministic=args.dropout == 0.0, dropout_rng=rng)
+                    deterministic=args.dropout == 0.0, dropout_rng=rng,
+                    mutable=mutable)
                 loss = next_token_loss(logits, tokens, loss_axis)
+            if args.moe:
+                from apex_tpu.parallel import moe_aux_total
+                loss = loss + moe_aux_total(inter["intermediates"])
             return aopt.scale_loss(loss, opt_state), loss
 
         grads, loss = jax.grad(scaled, has_aux=True)(params)

@@ -77,3 +77,13 @@ def test_gpt_example_scan_mode_smoke(sp):
         argv += ["--seq-parallel", sp]
     tok_s = _run("examples/gpt/train_lm.py", argv)
     assert tok_s > 0
+
+
+def test_gpt_example_moe_smoke():
+    """--moe N: alternating Switch-MoE blocks with the balance +
+    router-z losses in the objective, scan dispatch mode."""
+    tok_s = _run("examples/gpt/train_lm.py",
+                 ["--vocab", "512", "--layers", "2", "--embed-dim", "128",
+                  "--heads", "8", "--batch-size", "1", "--seq-len", "128",
+                  "--steps", "4", "--scan", "2", "--moe", "4"])
+    assert tok_s > 0
