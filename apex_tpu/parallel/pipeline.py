@@ -143,6 +143,16 @@ def lm_unstack_blocks(stacked: Tree, rest: Tree) -> Tree:
     return out
 
 
-def stacked_block_pspecs(stacked: Tree, axis: str = "pipe") -> Tree:
-    """P(axis) on every stacked-block leaf's leading dim."""
-    return jax.tree_util.tree_map(lambda _: P(axis), stacked)
+def stacked_block_pspecs(stacked: Tree, axis: str = "pipe",
+                         inner_specs: Tree = None) -> Tree:
+    """P(axis) on every stacked-block leaf's leading dim. For 3-D
+    composition (pipe × tensor parallelism) pass ``inner_specs`` — a
+    ONE-block PartitionSpec tree (e.g. ``lm_tp_pspecs(params)['block_0']``,
+    identical across blocks): each stacked leaf gets
+    ``P(axis, *inner_spec)``, sharding the stage dim over ``axis`` and
+    the original dims over the tensor axis."""
+    if inner_specs is None:
+        return jax.tree_util.tree_map(lambda _: P(axis), stacked)
+    return jax.tree_util.tree_map(
+        lambda _, sp: P(axis, *sp), stacked, inner_specs,
+        is_leaf=lambda t: isinstance(t, P))

@@ -10,7 +10,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax import shard_map
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from apex_tpu import parallel
 from apex_tpu.models import TransformerLM
@@ -128,3 +128,113 @@ def test_pipeline_forward_and_grads_match_dense(pipe_mesh):
             jax.tree_util.tree_flatten_with_path(want_rest)[0]):
         np.testing.assert_allclose(np.asarray(g), np.asarray(w),
                                    rtol=3e-4, atol=3e-5, err_msg=str(pa))
+
+
+# ---------------------------------------------------------------------------
+# 3-D composition: data x tensor x pipeline parallelism in ONE train step
+# ---------------------------------------------------------------------------
+
+def test_3d_dp_tp_pp_grads_match_dense():
+    """(data=2, model=2, pipe=2) mesh: batch shards over data, heads and
+    MLP shard over model (Megatron f/g inside each block), the block
+    stack shards into stages over pipe (GPipe microbatch ticks). Forward
+    loss and EVERY param grad must match the dense single-device model:
+    stacked block grads pmean over data only (local-complete over
+    model/pipe), embedding grads additionally psum over pipe (inject
+    zeroing), head/ln_f grads replicated off the psum-broadcast
+    outputs."""
+    import numpy as np
+    from apex_tpu.models import TransformerLM
+    from apex_tpu.models.gpt import Block, next_token_loss
+    from apex_tpu.parallel import (lm_stack_blocks, lm_tp_pspecs,
+                                   lm_unstack_blocks, pipeline_apply,
+                                   psum_input_grads, tp_shard_lm_params,
+                                   tp_unshard_lm_params,
+                                   stacked_block_pspecs)
+
+    d_dp = d_tp = d_pp = 2
+    e, heads, s, vocab, layers = 32, 4, 16, 64, 4
+    m_micro, mb = 2, 1                    # 2 microbatches of 1 per device
+    b_loc = m_micro * mb
+    b_glob = b_loc * d_dp
+    dense = TransformerLM(vocab_size=vocab, num_layers=layers,
+                          embed_dim=e, num_heads=heads, max_seq=s)
+    toks = jax.random.randint(jax.random.PRNGKey(20), (b_glob, s), 0,
+                              vocab)
+    params = dense.init(jax.random.PRNGKey(21), toks)["params"]
+
+    def dense_loss(p):
+        return next_token_loss(dense.apply({"params": p}, toks), toks)
+
+    want_loss, want_grads = jax.value_and_grad(dense_loss)(params)
+
+    # ---- shard: qkv permute for TP, stack blocks for PP
+    params_tp = tp_shard_lm_params(params, d_tp)
+    stacked, rest = lm_stack_blocks(params_tp)
+    tp_specs = lm_tp_pspecs(params_tp, axis="model")
+    sspecs = stacked_block_pspecs(stacked, axis="pipe",
+                                  inner_specs=tp_specs["block_0"])
+    rest_specs = jax.tree_util.tree_map(lambda _: P(), rest)
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(d_dp, d_tp, d_pp),
+                ("data", "model", "pipe"))
+
+    def per_device(stk, rst, t):
+        x = rst["tok_emb"]["embedding"][t] \
+            + rst["pos_emb"]["embedding"][jnp.arange(s)][None]
+        micro = x.reshape(m_micro, mb, s, e)
+
+        def stage(sp, hbuf):
+            def body(hh, pp):
+                out = Block(e, heads // d_tp, name="b",
+                            tensor_parallel_axis="model",
+                            tensor_parallel_size=d_tp).apply(
+                    {"params": pp}, hh)
+                return out, ()
+            return jax.lax.scan(body, hbuf, sp)[0]
+
+        y = pipeline_apply(stage, stk, micro, "pipe")
+        hid = y.reshape(b_loc, s, e)
+        from apex_tpu.normalization import FusedLayerNorm
+        hid = FusedLayerNorm(normalized_shape=e, name="ln_f").apply(
+            {"params": rst["ln_f"]}, hid)
+        logits = (hid @ rst["head"]["kernel"]
+                  + rst["head"]["bias"]).astype(jnp.float32)
+        return next_token_loss(logits, t)
+
+    def grad_step(stk, rst, t):
+        loss, (g_stk, g_rst) = jax.value_and_grad(
+            per_device, argnums=(0, 1))(stk, rst, t)
+        loss = jax.lax.pmean(loss, "data")
+        # data axis: every param saw only this shard's batch
+        g_stk = jax.lax.pmean(g_stk, "data")
+        g_rst = jax.lax.pmean(g_rst, "data")
+        # pipe axis: embeddings fed stage 0 only
+        emb_g = psum_input_grads(
+            {"tok_emb": g_rst["tok_emb"], "pos_emb": g_rst["pos_emb"]},
+            "pipe")
+        g_rst = {**g_rst, **emb_g}
+        return loss, g_stk, g_rst
+
+    f = jax.jit(shard_map(
+        grad_step, mesh=mesh,
+        in_specs=(sspecs, rest_specs, P("data")),
+        out_specs=(P(), sspecs, rest_specs), check_vma=False))
+    stacked = jax.device_put(stacked, jax.tree_util.tree_map(
+        lambda sp: NamedSharding(mesh, sp), sspecs))
+    loss, g_stk, g_rst = f(
+        stacked, rest,
+        jax.device_put(toks, NamedSharding(mesh, P("data"))))
+
+    np.testing.assert_allclose(float(loss), float(want_loss),
+                               rtol=2e-5, atol=1e-6)
+    got = tp_unshard_lm_params(
+        lm_unstack_blocks(jax.device_get(g_stk), jax.device_get(g_rst)),
+        d_tp)
+    flat_got, _ = jax.tree_util.tree_flatten_with_path(got)
+    flat_want, _ = jax.tree_util.tree_flatten_with_path(want_grads)
+    assert len(flat_got) == len(flat_want)
+    for (pg, gg), (_, gw) in zip(flat_got, flat_want):
+        np.testing.assert_allclose(
+            np.asarray(gg), np.asarray(gw), rtol=2e-4, atol=2e-5,
+            err_msg=str(pg))
