@@ -96,15 +96,20 @@ def train_resnet(opt_level: str, steps: int, inner: int, *,
 
 
 def train_gpt(opt_level: str, steps: int, inner: int, *, seq: int,
-              batch: int):
+              batch: int, moe: int = 0):
     from apex_tpu import amp, optimizers
     from apex_tpu.models import GPTTiny
     from apex_tpu.models.gpt import next_token_loss
+    from apex_tpu.parallel import moe_aux_total
 
     vocab = 256
     toks = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
                               vocab)
-    model = GPTTiny(vocab_size=vocab, max_seq=seq)
+    # moe > 0: Switch-MoE MLP in the second block — gates the fp32
+    # no_amp router + dispatch einsums + balance loss through the SAME
+    # memorization bar (the O1 config additionally proves the router
+    # matmul stays out of the fp16 interposition)
+    model = GPTTiny(vocab_size=vocab, max_seq=seq, moe_num_experts=moe)
     params32 = model.init(jax.random.PRNGKey(2), toks[:1])["params"]
     apply_fn, aopt = amp.initialize(
         model.apply, optimizers.FusedAdam(lr=3e-3),
@@ -116,8 +121,14 @@ def train_gpt(opt_level: str, steps: int, inner: int, *, seq: int,
         p, s = carry
 
         def scaled(pp):
-            logits = apply_fn({"params": pp}, toks)
-            loss = next_token_loss(logits, toks)
+            if moe:
+                logits, inter = apply_fn({"params": pp}, toks,
+                                         mutable=["intermediates"])
+                loss = (next_token_loss(logits, toks)
+                        + moe_aux_total(inter["intermediates"]))
+            else:
+                logits = apply_fn({"params": pp}, toks)
+                loss = next_token_loss(logits, toks)
             return aopt.scale_loss(loss, s), loss
 
         grads, loss = jax.grad(scaled, has_aux=True)(p)
@@ -184,6 +195,9 @@ def main(argv=None):
                     loss_thresh=0.05, acc_thresh=0.99)
         losses, _ = train_gpt(lvl, steps, inner, **gpt_cfg)
         ok &= check("gpt_memorize", lvl, losses, None,
+                    loss_thresh=0.1, acc_thresh=None)
+        losses, _ = train_gpt(lvl, steps, inner, moe=4, **gpt_cfg)
+        ok &= check("gpt_moe_memorize", lvl, losses, None,
                     loss_thresh=0.1, acc_thresh=None)
     if not ok:
         sys.exit(1)
