@@ -378,3 +378,132 @@ def test_lm_moe_under_remat():
     assert np.isfinite(float(loss))
     assert float(jnp.max(jnp.abs(
         grads["block_1"]["moe"]["wi"]))) > 0
+
+
+# ---------------------------------------------------------------------------
+# 5. EP x ZeRO composition (DeepSpeed-MoE-style expert+data parallelism)
+# ---------------------------------------------------------------------------
+
+def _pop_expert_leaves(params):
+    """Split a TransformerLM tree into (rest, experts): the expert-stacked
+    wi/bi/wo/bo leaves of every MoE block move to a flat dict keyed by
+    (block, leaf); the router and everything else stay."""
+    rest = {k: (dict(v) if isinstance(v, dict) else v)
+            for k, v in params.items()}
+    experts = {}
+    for bk, sub in rest.items():
+        if isinstance(sub, dict) and "moe" in sub:
+            moe = dict(sub["moe"])
+            for leaf in ("wi", "bi", "wo", "bo"):
+                experts[(bk, leaf)] = moe.pop(leaf)
+            sub["moe"] = moe
+    return rest, experts
+
+
+def _merge_expert_leaves(rest, experts):
+    out = {k: (dict(v) if isinstance(v, dict) else v)
+           for k, v in rest.items()}
+    for (bk, leaf), val in experts.items():
+        moe = dict(out[bk]["moe"])
+        moe[leaf] = val
+        out[bk] = {**out[bk], "moe": moe}
+    return out
+
+
+def test_ep_zero_composition_matches_dense_adam():
+    """(data=2, expert=2) mesh: tokens shard over BOTH axes, experts
+    exchange over 'expert', and the optimizer composes DeepSpeed-MoE
+    style — ZeRO (DistributedFusedAdam over 'data') for the dense
+    params, whose state is replicated waste otherwise, while expert
+    params step locally (their state is already distributed by EP).
+    One step must match dense FusedAdam on the global objective."""
+    from apex_tpu import optimizers
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+    from apex_tpu.models import TransformerLM
+    from apex_tpu.models.gpt import next_token_loss
+
+    d_dp = d_ep = 2
+    e, heads, s, vocab, exp = 32, 4, 16, 64, 2
+    dense = TransformerLM(vocab_size=vocab, num_layers=2, embed_dim=e,
+                          num_heads=heads, max_seq=s,
+                          moe_num_experts=exp,
+                          moe_capacity_factor=float(exp) * 2)
+    n_shard = d_dp * d_ep
+    toks = jax.random.randint(jax.random.PRNGKey(30), (n_shard, s), 0,
+                              vocab)
+    params = dense.init(jax.random.PRNGKey(31), toks)["params"]
+
+    # ---- reference: dense FusedAdam on the global mean objective
+    def dense_loss(p):
+        logits, _ = dense.apply({"params": p}, toks,
+                                mutable=["intermediates"])
+        return next_token_loss(logits, toks)
+
+    ref_opt = optimizers.FusedAdam(lr=1e-3)
+    ref_state = ref_opt.init(params)
+    want, _ = ref_opt.step(jax.grad(dense_loss)(params), params,
+                           ref_state)
+
+    # ---- EP x ZeRO
+    local = dense.clone(expert_parallel_axis="expert",
+                        expert_parallel_size=d_ep)
+    especs = lm_moe_pspecs(params, axis="expert")
+    rest, experts = _pop_expert_leaves(params)
+    exp_specs = {k: especs[k[0]]["moe"][k[1]] for k in experts}
+    zopt = DistributedFusedAdam(lr=1e-3, axis_name="data",
+                                shard_count=d_dp,
+                                chunk_elements=2 ** 12)
+    eopt = optimizers.FusedAdam(lr=1e-3)
+    zstate = zopt.init(rest)
+    zspecs = zopt.state_pspec()
+    estate = eopt.init(experts)
+    est_specs = type(estate)(step=P(), exp_avg=exp_specs,
+                             exp_avg_sq=exp_specs)
+    mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(d_dp, d_ep),
+                ("data", "expert"))
+
+    def step(rest_, experts_, zst, est, t):
+        def loss_fn(r_, x_):
+            p = _merge_expert_leaves(r_, x_)
+            logits, _ = local.apply({"params": p}, t,
+                                    mutable=["intermediates"])
+            # contribution to the global mean over all 4 shards
+            return next_token_loss(logits, t) / n_shard
+
+        (g_rest, g_exp) = jax.grad(loss_fn, argnums=(0, 1))(
+            rest_, experts_)
+        # dense params: sum the expert-axis contributions here; the
+        # ZeRO psum_scatter performs the data-axis sum + shard
+        g_rest = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, "expert"), g_rest)
+        new_rest, new_zst = zopt.step(g_rest, rest_, zst)
+        # expert params: backward all_to_all completed the expert-axis
+        # accumulation; only the data-axis sum remains, state local
+        g_exp = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, "data"), g_exp)
+        new_exp, new_est = eopt.step(g_exp, experts_, est)
+        return new_rest, new_exp, new_zst, new_est
+
+    rep = jax.tree_util.tree_map(lambda _: P(), rest)
+    f = jax.jit(shard_map(
+        step, mesh=mesh,
+        in_specs=(rep, exp_specs, zspecs, est_specs,
+                  P(("data", "expert"))),
+        out_specs=(rep, exp_specs, zspecs, est_specs),
+        check_vma=False))
+    put = lambda tree, specs: jax.device_put(
+        tree, jax.tree_util.tree_map(
+            lambda sp: NamedSharding(mesh, sp), specs))
+    new_rest, new_exp, _, _ = f(
+        rest, put(experts, exp_specs), put(zstate, zspecs), estate,
+        jax.device_put(toks, NamedSharding(mesh, P(("data", "expert")))))
+
+    got = _merge_expert_leaves(jax.device_get(new_rest),
+                               jax.device_get(new_exp))
+    flat_got, _ = jax.tree_util.tree_flatten_with_path(got)
+    flat_want, _ = jax.tree_util.tree_flatten_with_path(want)
+    assert len(flat_got) == len(flat_want)
+    for (pg, gg), (_, gw) in zip(flat_got, flat_want):
+        np.testing.assert_allclose(
+            np.asarray(gg), np.asarray(gw), rtol=5e-4, atol=1e-6,
+            err_msg=str(pg))
