@@ -1,0 +1,91 @@
+"""Price the trainable-bias (dbias) feature on the chip: fwd+bwd device
+time at the flash benchmark shape, across bias modes. The dbias plane is
+pure extra HBM traffic (no extra matmuls — ds is already computed), so
+the expected costs are ~0 for a row-broadcast bias (O(sk) plane) and the
+O(sq·sk) f32 plane write + broadcast reduction for a full-rank bias.
+
+Run: ``python benchmarks/bench_dbias.py [--seq 4096]``. One JSON line
+per mode; results recorded in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench_attention import timeit  # noqa: E402
+
+
+def main():
+    from apex_tpu.ops.attention import flash_attention
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=4096)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--head-dim", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=40)
+    args = ap.parse_args()
+
+    b, h, s, d = args.batch, args.heads, args.seq, args.head_dim
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d), jnp.bfloat16)
+               for kk in ks)
+
+    modes = {
+        "no_bias": (None, False),
+        "constant_rowbcast": ((1, h, 1, s), False),
+        "trainable_rowbcast": ((1, h, 1, s), True),
+        "constant_fullrank": ((1, h, s, s), False),
+        "trainable_fullrank": ((1, h, s, s), True),
+    }
+    for name, (shape, trainable) in modes.items():
+
+        def grads(q_, k_, v_):
+            # bias/cotangent are generated IN-TRACE from tiny key
+            # constants: a closure-captured (1, h, s, s) f32 array would
+            # embed a ~512 MB literal into the program shipped over the
+            # axon remote-compile tunnel (observed: the request dies
+            # with "response body closed")
+            gg = jax.random.normal(jax.random.PRNGKey(1), (b, h, s, d),
+                                   jnp.float32)
+
+            def f(a, bb, c, bi):
+                return jnp.vdot(
+                    flash_attention(a, bb, c, True, bias=bi,
+                                    trainable_bias=trainable).astype(
+                        jnp.float32), gg)
+
+            if shape is None:
+                dq, dk, dv = jax.grad(
+                    lambda a, bb, c: f(a, bb, c, None),
+                    argnums=(0, 1, 2))(q_, k_, v_)
+                return dq, dk, dv
+            bias = jax.random.normal(jax.random.PRNGKey(7), shape,
+                                     jnp.float32)
+            dq, dk, dv, db = jax.grad(f, argnums=(0, 1, 2, 3))(
+                q_, k_, v_, bias)
+            # fold db into a consumed scalar so timeit's carry chain
+            # (which adds leaves of the carry's shape) keeps it live
+            return dq + (jnp.sum(db) * 1e-30).astype(dq.dtype), dk, dv
+
+        print(f"# compiling {name} ...", file=sys.stderr, flush=True)
+        t = timeit(grads, q, k, v, iters=args.iters)
+        print(json.dumps({
+            "bench": "dbias_price", "mode": name,
+            "bias_shape": list(shape) if shape else None,
+            "seq": s, "fwd_bwd_ms": round(t * 1e3, 3),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
