@@ -213,6 +213,15 @@ class SelfMultiheadAttn(nn.Module):
     relative_bias: bool = False
     relative_bias_buckets: int = 32
     relative_bias_max_distance: int = 128
+    # Autoregressive KV-cache decoding (models.gpt.generate): K/V land
+    # in a ("cache", ...) variable collection sized decode_max_len, the
+    # causal mask offsets by the running cache index, and attention is a
+    # plain einsum against the cache (a 1-token query has no use for the
+    # flash kernels; the read of the cache is the cost). Static shapes
+    # throughout: every step attends over the full decode_max_len
+    # window, masked — the TPU-native decode formulation.
+    decode: bool = False
+    decode_max_len: int = 0
 
     @nn.compact
     def __call__(self, x, *, attn_mask: Optional[jax.Array] = None,
@@ -255,6 +264,48 @@ class SelfMultiheadAttn(nn.Module):
         q = _split_heads(q, h)
         k = _split_heads(k, h)
         v = _split_heads(v, h)
+
+        if self.decode:
+            if (self.seq_parallel or self.tensor_parallel_axis
+                    or self.relative_bias or attn_mask is not None):
+                raise NotImplementedError(
+                    "decode mode currently supports the plain causal "
+                    "self-attention configuration")
+            if self.decode_max_len <= 0:
+                raise ValueError(
+                    "decode=True needs decode_max_len (cache size)")
+            b_, _, s_cur, hd = q.shape
+            ck = self.variable(
+                "cache", "cached_key", jnp.zeros,
+                (b_, h, self.decode_max_len, hd), k.dtype)
+            cv = self.variable(
+                "cache", "cached_value", jnp.zeros,
+                (b_, h, self.decode_max_len, hd), v.dtype)
+            ci = self.variable(
+                "cache", "cache_index",
+                lambda: jnp.zeros((), jnp.int32))
+            idx = ci.value
+            k_all = jax.lax.dynamic_update_slice(
+                ck.value, k, (0, 0, idx, 0))
+            v_all = jax.lax.dynamic_update_slice(
+                cv.value, v, (0, 0, idx, 0))
+            ck.value, cv.value = k_all, v_all
+            ci.value = idx + s_cur
+            scale = 1.0 / math.sqrt(hd)
+            s_mat = jnp.einsum(
+                "bhqd,bhkd->bhqk", q, k_all,
+                preferred_element_type=jnp.float32) * scale
+            col = jnp.arange(self.decode_max_len)[None, :]
+            row = idx + jnp.arange(s_cur)[:, None]
+            s_mat = jnp.where(col <= row, s_mat, -1e30)
+            p = jax.nn.softmax(s_mat, axis=-1).astype(v_all.dtype)
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", p, v_all)
+            out = nn.Dense(e, use_bias=self.bias, name="out_proj",
+                           dtype=self.dtype)(
+                _merge_heads(ctx).astype(x.dtype))
+            if self.include_norm_add:
+                out = out + residual
+            return out
 
         if self.seq_parallel is not None:
             if self.dropout > 0.0 and not deterministic:

@@ -47,6 +47,9 @@ class Block(nn.Module):
     moe_capacity_factor: float = 1.25
     expert_parallel_axis: Optional[str] = None
     expert_parallel_size: int = 1
+    # KV-cache decode (see SelfMultiheadAttn.decode / gpt.generate)
+    decode: bool = False
+    decode_max_len: int = 0
     # ``deterministic`` can be fixed at construction time so that under
     # ``nn.remat`` it never becomes a traced argument (a traced bool cannot
     # drive the Python-level dropout branch in SelfMultiheadAttn). The
@@ -66,6 +69,7 @@ class Block(nn.Module):
             axis_name=self.axis_name,
             tensor_parallel_axis=self.tensor_parallel_axis,
             tensor_parallel_size=self.tensor_parallel_size,
+            decode=self.decode, decode_max_len=self.decode_max_len,
             name="attn")(
             FusedLayerNorm(normalized_shape=e, name="ln1")(x)
             .astype(x.dtype),
@@ -131,6 +135,13 @@ class TransformerLM(nn.Module):
     axis_name: Optional[str] = None
     tensor_parallel_axis: Optional[str] = None
     tensor_parallel_size: int = 1
+    # KV-cache autoregressive decoding: clone the trained model with
+    # ``decode=True`` (``decode_max_len`` defaults to max_seq) and drive
+    # it with :func:`generate` — the prompt prefills the cache in ONE
+    # forward (chunked write at the running index), then each new token
+    # is a 1-token step attending over the cache
+    decode: bool = False
+    decode_max_len: int = 0
     # MoE: every ``moe_every``-th block swaps its dense MLP for a
     # moe_num_experts-way MoEMLP (Switch places MoE in alternating
     # blocks; moe_every=1 makes every block sparse)
@@ -178,6 +189,9 @@ class TransformerLM(nn.Module):
                           self.axis_name,
                           tensor_parallel_axis=self.tensor_parallel_axis,
                           tensor_parallel_size=self.tensor_parallel_size,
+                          decode=self.decode,
+                          decode_max_len=(self.decode_max_len
+                                          or self.max_seq),
                           moe_num_experts=moe,
                           moe_num_selected=self.moe_num_selected,
                           moe_capacity_factor=self.moe_capacity_factor,
@@ -313,6 +327,68 @@ def chunked_next_token_loss(hidden, head_params, tokens, *,
     num, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
                           (hid, tgt, val))
     return _globalize(num / den, axis_name)
+
+
+def generate(model: TransformerLM, params, prompt, max_new_tokens: int,
+             *, temperature: float = 0.0, rng=None,
+             decode_max_len: int = 0):
+    """Autoregressive KV-cache generation. ``prompt``: (B, S_p) int32.
+    Returns (B, S_p + max_new_tokens) — the prompt with the generated
+    continuation appended. ``temperature=0`` is greedy argmax; otherwise
+    categorical sampling at that temperature (``rng`` required).
+
+    TPU-native decode: the prompt prefills every layer's K/V cache in
+    ONE full forward (a chunked ``dynamic_update_slice`` at the running
+    cache index), then each new token runs a 1-token step inside a
+    ``lax.scan`` — static shapes, every step attends over the full
+    ``decode_max_len`` window under the index-offset causal mask. Wrap
+    in ``jax.jit`` for dispatch-free loops (examples/gpt/train_lm.py
+    ``--generate`` does, and measures tokens/s).
+
+    The reference framework has no generation/inference story (it is a
+    training-utilities library); this is additive, like the model zoo
+    it serves.
+    """
+    b, s_p = prompt.shape
+    total = s_p + max_new_tokens
+    max_len = decode_max_len or model.max_seq
+    if total > max_len:
+        raise ValueError(
+            f"prompt ({s_p}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds the cache ({max_len})")
+    dec = model.clone(decode=True, decode_max_len=max_len, dropout=0.0,
+                      remat=False)
+
+    def sample(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        return jax.random.categorical(
+            key, logits / temperature, axis=-1).astype(prompt.dtype)
+
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature > 0 requires rng")
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+
+    # prefill: one forward over the whole prompt, cache written
+    logits, vs = dec.apply({"params": params}, prompt,
+                           mutable=["cache"])
+    keys = jax.random.split(rng, max_new_tokens + 1)
+    tok = sample(logits[:, -1], keys[0])
+
+    def step(carry, xs):
+        cache, tok = carry
+        i, key = xs
+        lg, v2 = dec.apply({"params": params, "cache": cache},
+                           tok[:, None], pos_offset=s_p + i,
+                           mutable=["cache"])
+        nxt = sample(lg[:, -1], key)
+        return (v2["cache"], nxt), tok
+
+    (_, _), toks = jax.lax.scan(
+        step, (vs["cache"], tok),
+        (jnp.arange(max_new_tokens), keys[1:]))
+    # ys[i] is the token at position s_p + i -> (B, max_new_tokens)
+    return jnp.concatenate([prompt, toks.T.astype(prompt.dtype)], axis=1)
 
 
 GPTSmall = functools.partial(TransformerLM, num_layers=12, embed_dim=768,

@@ -66,6 +66,11 @@ def parse_args(argv=None):
                         "becomes this many experts (Switch/GShard, "
                         "top-2, einsum dispatch); the balance + "
                         "router-z losses join the objective")
+    p.add_argument("--generate", type=int, default=0,
+                   help="inference mode: greedy-generate this many "
+                        "tokens per sequence with the KV-cache decode "
+                        "path and report decode tokens/s (no training)")
+    p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--scan", type=int, default=1,
                    help=">1: dispatch-proof mode — N steps per jitted "
@@ -74,8 +79,52 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
+def _run_generate(args):
+    """KV-cache decode throughput: one jitted generate() call scans
+    max_new 1-token steps after a single prefill forward — static
+    shapes, one dispatch for the whole continuation."""
+    from apex_tpu import amp, pyprof
+    from apex_tpu.models import TransformerLM
+    from apex_tpu.models.gpt import generate
+
+    compute_dtype = amp.resolve(args.opt_level).cast_model_type
+    total = args.prompt_len + args.generate
+    model = TransformerLM(
+        vocab_size=args.vocab, num_layers=args.layers,
+        embed_dim=args.embed_dim, num_heads=args.heads,
+        max_seq=total, dtype=compute_dtype or jnp.float32)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(args.seed), (args.batch_size,
+                                        args.prompt_len), 0, args.vocab)
+    params = model.init(jax.random.PRNGKey(args.seed + 1),
+                        prompt[:, :8])["params"]
+    params = amp.cast_model(params, amp.resolve(
+        args.opt_level, keep_batchnorm_fp32=False))
+
+    fn = jax.jit(lambda p, t: generate(model, p, t, args.generate))
+    out = fn(params, prompt)
+    jax.block_until_ready(out)
+
+    def once():
+        np.asarray(fn(params, prompt)[0, -1:])
+
+    dev_s = pyprof.device_time_of(once)
+    t0 = time.perf_counter()
+    once()
+    wall = time.perf_counter() - t0
+    t = dev_s if dev_s > 0 else wall
+    tok_s = args.batch_size * args.generate / t
+    print(f"Decode: {tok_s:,.0f} tokens/s (batch {args.batch_size}, "
+          f"prompt {args.prompt_len} + {args.generate} new, "
+          f"{'device' if dev_s > 0 else 'wall'} clock; wall "
+          f"{args.batch_size * args.generate / wall:,.0f})")
+    return tok_s
+
+
 def main(argv=None):
     args = parse_args(argv)
+    if args.generate:
+        return _run_generate(args)
     n_dev = len(jax.devices())
     axis = "seq" if args.seq_parallel else "data"
     mesh = parallel.make_mesh(axis_names=(axis,))

@@ -87,3 +87,13 @@ def test_gpt_example_moe_smoke():
                   "--heads", "8", "--batch-size", "1", "--seq-len", "128",
                   "--steps", "4", "--scan", "2", "--moe", "4"])
     assert tok_s > 0
+
+
+def test_gpt_example_generate_smoke():
+    """--generate: KV-cache decode path (prefill + scanned 1-token
+    steps) produces a throughput number."""
+    tok_s = _run("examples/gpt/train_lm.py",
+                 ["--vocab", "128", "--layers", "1", "--embed-dim", "64",
+                  "--heads", "4", "--batch-size", "1",
+                  "--prompt-len", "8", "--generate", "8"])
+    assert tok_s > 0
