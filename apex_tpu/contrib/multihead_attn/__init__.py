@@ -266,11 +266,15 @@ class SelfMultiheadAttn(nn.Module):
         v = _split_heads(v, h)
 
         if self.decode:
-            if (self.seq_parallel or self.tensor_parallel_axis
-                    or self.relative_bias or attn_mask is not None):
+            # tensor parallelism composes: heads (and the KV cache) are
+            # already sharded by the local in_proj above; only the
+            # out_proj changes to its row-parallel form below
+            if (self.seq_parallel or self.relative_bias
+                    or attn_mask is not None):
                 raise NotImplementedError(
                     "decode mode currently supports the plain causal "
-                    "self-attention configuration")
+                    "self-attention configuration (+ tensor "
+                    "parallelism)")
             if self.decode_max_len <= 0:
                 raise ValueError(
                     "decode=True needs decode_max_len (cache size)")
@@ -300,9 +304,16 @@ class SelfMultiheadAttn(nn.Module):
             s_mat = jnp.where(col <= row, s_mat, -1e30)
             p = jax.nn.softmax(s_mat, axis=-1).astype(v_all.dtype)
             ctx = jnp.einsum("bhqk,bhkd->bhqd", p, v_all)
-            out = nn.Dense(e, use_bias=self.bias, name="out_proj",
-                           dtype=self.dtype)(
-                _merge_heads(ctx).astype(x.dtype))
+            ctx2 = _merge_heads(ctx).astype(x.dtype)
+            if self.tensor_parallel_axis:
+                from apex_tpu.parallel.tensor_parallel import \
+                    RowParallelDense
+                out = RowParallelDense(
+                    e, self.tensor_parallel_axis, use_bias=self.bias,
+                    dtype=self.dtype, name="out_proj")(ctx2)
+            else:
+                out = nn.Dense(e, use_bias=self.bias, name="out_proj",
+                               dtype=self.dtype)(ctx2)
             if self.include_norm_add:
                 out = out + residual
             return out
