@@ -409,7 +409,14 @@ class SelfMultiheadAttn(nn.Module):
 
 class EncdecMultiheadAttn(nn.Module):
     """Encoder-decoder attention (encdec_multihead_attn.py): queries from the
-    decoder stream, keys/values projected jointly from the encoder stream."""
+    decoder stream, keys/values projected jointly from the encoder stream.
+
+    ``decode=True`` (seq2seq inference): the PROJECTED encoder K/V are
+    computed once — on the first call, which must pass ``key`` — and
+    cached in the ``"cache"`` collection; every later decoder step may
+    pass ``key=None`` and attends its (typically 1-token) query against
+    the cached heads. Cross-attention needs no causal mask or index:
+    the cache is static for the whole generation."""
 
     embed_dim: int
     num_heads: int
@@ -418,9 +425,11 @@ class EncdecMultiheadAttn(nn.Module):
     include_norm_add: bool = False
     impl: str = "fast"
     dtype: Any = None
+    decode: bool = False
 
     @nn.compact
-    def __call__(self, query, key, *, attn_mask: Optional[jax.Array] = None,
+    def __call__(self, query, key=None, *,
+                 attn_mask: Optional[jax.Array] = None,
                  deterministic: bool = True,
                  dropout_rng: Optional[jax.Array] = None):
         e, h = self.embed_dim, self.num_heads
@@ -430,14 +439,36 @@ class EncdecMultiheadAttn(nn.Module):
 
         q = nn.Dense(e, use_bias=self.bias, name="q_proj",
                      dtype=self.dtype)(query)
-        kv = nn.Dense(2 * e, use_bias=self.bias, name="kv_proj",
-                      dtype=self.dtype)(key)
-        k, v = jnp.split(kv, 2, axis=-1)
         q = _split_heads(q, h)
-        k = _split_heads(k, h)
-        v = _split_heads(v, h)
+        kv_proj = nn.Dense(2 * e, use_bias=self.bias, name="kv_proj",
+                           dtype=self.dtype)
+        if self.decode:
+            have = self.has_variable("cache", "encdec_key")
+            if not have and key is None:
+                raise ValueError(
+                    "EncdecMultiheadAttn(decode=True): the first call "
+                    "must pass the encoder stream (key=...) to fill "
+                    "the cross-attention cache")
+            if key is not None and not have:
+                kv = kv_proj(key)
+                k0, v0 = (  # noqa: F841 — captured by the init lambdas
+                    _split_heads(x_, h) for x_ in jnp.split(kv, 2, -1))
+            else:
+                k0 = v0 = None
+            ck = self.variable("cache", "encdec_key", lambda: k0)
+            cv = self.variable("cache", "encdec_value", lambda: v0)
+            k, v = ck.value, cv.value
+        else:
+            if key is None:
+                raise ValueError("key (encoder stream) is required")
+            kv = kv_proj(key)
+            k, v = jnp.split(kv, 2, axis=-1)
+            k = _split_heads(k, h)
+            v = _split_heads(v, h)
 
-        if self.impl == "fast":
+        # decode always takes the dense path: a 1-token query pads to a
+        # full 128-row flash block for nothing
+        if self.impl == "fast" and not self.decode:
             rate, seed = 0.0, None
             if self.dropout > 0.0 and not deterministic:
                 rate = self.dropout

@@ -839,3 +839,37 @@ def test_ulysses_trainable_bias_matches_dense(mesh):
         out_specs=P(), check_vma=False))(q, k, v, g)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=3e-3, atol=2e-3)
+
+
+def test_encdec_decode_cache_matches_full():
+    """Enc-dec decode: the projected encoder K/V are cached on the
+    first call; later 1-token steps with key=None match recomputing the
+    full cross-attention."""
+    e, h = 32, 4
+    enc = jax.random.normal(jax.random.PRNGKey(94), (2, 10, e))
+    dec_in = jax.random.normal(jax.random.PRNGKey(95), (2, 5, e))
+    m = EncdecMultiheadAttn(embed_dim=e, num_heads=h)
+    params = m.init(jax.random.PRNGKey(96), dec_in, enc)["params"]
+    want = m.apply({"params": params}, dec_in, enc)
+
+    md = EncdecMultiheadAttn(embed_dim=e, num_heads=h, decode=True)
+    # first call fills the cache (and answers for its own queries)
+    out0, vs = md.apply({"params": params}, dec_in[:, :1], enc,
+                        mutable=["cache"])
+    np.testing.assert_allclose(np.asarray(out0), np.asarray(want[:, :1]),
+                               rtol=2e-4, atol=2e-4)
+    cache = vs["cache"]
+    for i in range(1, 5):
+        out_i, vs = md.apply({"params": params, "cache": cache},
+                             dec_in[:, i:i + 1], mutable=["cache"])
+        cache = vs["cache"]
+        np.testing.assert_allclose(
+            np.asarray(out_i), np.asarray(want[:, i:i + 1]),
+            rtol=2e-4, atol=2e-4, err_msg=f"step {i}")
+
+
+def test_encdec_decode_requires_encoder_on_first_call():
+    m = EncdecMultiheadAttn(embed_dim=16, num_heads=2, decode=True)
+    x = jnp.zeros((1, 1, 16))
+    with pytest.raises(ValueError, match="first call"):
+        m.init(jax.random.PRNGKey(0), x)
