@@ -330,12 +330,16 @@ def chunked_next_token_loss(hidden, head_params, tokens, *,
 
 
 def generate(model: TransformerLM, params, prompt, max_new_tokens: int,
-             *, temperature: float = 0.0, rng=None,
-             decode_max_len: int = 0):
+             *, temperature: float = 0.0, rng=None, top_k: int = 0,
+             top_p: float = 0.0, decode_max_len: int = 0):
     """Autoregressive KV-cache generation. ``prompt``: (B, S_p) int32.
     Returns (B, S_p + max_new_tokens) — the prompt with the generated
     continuation appended. ``temperature=0`` is greedy argmax; otherwise
-    categorical sampling at that temperature (``rng`` required).
+    categorical sampling at that temperature (``rng`` required),
+    optionally truncated: ``top_k`` keeps the k highest logits,
+    ``top_p`` nucleus-truncates to the smallest set with cumulative
+    probability ≥ p (both static-shape: a sort + threshold mask, never
+    a dynamic gather).
 
     TPU-native decode: the prompt prefills every layer's K/V cache in
     ONE full forward (a chunked ``dynamic_update_slice`` at the running
@@ -362,8 +366,24 @@ def generate(model: TransformerLM, params, prompt, max_new_tokens: int,
     def sample(logits, key):
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        logits = logits.astype(jnp.float32) / temperature
+        if top_k > 0:
+            kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p > 0.0:
+            srt = jnp.sort(logits, axis=-1)[..., ::-1]
+            cum = jnp.cumsum(jax.nn.softmax(srt, axis=-1), axis=-1)
+            # smallest prefix with cumulative prob >= p stays: the
+            # cutoff logit is the last sorted entry whose PRECEDING
+            # cumulative mass is still < p
+            keep = jnp.concatenate(
+                [jnp.ones_like(cum[..., :1], bool), cum[..., :-1] < top_p],
+                axis=-1)
+            cutoff = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1,
+                             keepdims=True)
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
         return jax.random.categorical(
-            key, logits / temperature, axis=-1).astype(prompt.dtype)
+            key, logits, axis=-1).astype(prompt.dtype)
 
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature > 0 requires rng")

@@ -449,3 +449,32 @@ def test_tp_decode_matches_dense_decode():
                                rtol=2e-4, atol=2e-4)
     np.testing.assert_allclose(np.asarray(lg2), np.asarray(want_step),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_generate_top_k_and_top_p():
+    """top_k=1 must reduce to greedy regardless of temperature; top_p
+    truncation keeps outputs inside the nucleus (valid tokens only)."""
+    import numpy as np
+    from apex_tpu.models import TransformerLM
+    from apex_tpu.models.gpt import generate
+
+    lm = TransformerLM(vocab_size=41, num_layers=1, embed_dim=16,
+                       num_heads=2, max_seq=16)
+    prompt = jax.random.randint(jax.random.PRNGKey(8), (2, 4), 0, 41)
+    params = lm.init(jax.random.PRNGKey(9), prompt)["params"]
+
+    greedy = generate(lm, params, prompt, 6)
+    topk1 = generate(lm, params, prompt, 6, temperature=1.5,
+                     rng=jax.random.PRNGKey(10), top_k=1)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(topk1))
+
+    out = generate(lm, params, prompt, 6, temperature=1.0,
+                   rng=jax.random.PRNGKey(11), top_p=0.9)
+    arr = np.asarray(out)
+    assert arr.shape == (2, 10)
+    assert (0 <= arr).all() and (arr < 41).all()
+    # tiny top_p -> only the argmax survives the nucleus -> greedy
+    tp_small = generate(lm, params, prompt, 6, temperature=1.0,
+                        rng=jax.random.PRNGKey(12), top_p=1e-6)
+    np.testing.assert_array_equal(np.asarray(greedy),
+                                  np.asarray(tp_small))
