@@ -151,6 +151,10 @@ class TransformerLM(nn.Module):
     moe_capacity_factor: float = 1.25
     expert_parallel_axis: Optional[str] = None
     expert_parallel_size: int = 1
+    # Tie the LM head to the token embedding (logits = h @ E^T, no
+    # separate head kernel/bias) — the standard weight-tying lever:
+    # at 32k vocab x 768 it removes a 25M-param matrix
+    tie_embeddings: bool = False
     # Rematerialize each block in the backward (jax.checkpoint): activation
     # memory drops from O(layers * S * D) to O(S * D), trading one extra
     # forward per block — the standard long-context lever (SURVEY.md §7:
@@ -170,8 +174,9 @@ class TransformerLM(nn.Module):
                 "its axis, but inside a TP region activations are "
                 "replicated over the model axis")
         b, s = tokens.shape
-        emb = nn.Embed(self.vocab_size, self.embed_dim,
-                       dtype=self.dtype, name="tok_emb")(tokens)
+        tok_emb = nn.Embed(self.vocab_size, self.embed_dim,
+                           dtype=self.dtype, name="tok_emb")
+        emb = tok_emb(tokens)
         pos = pos_offset + jnp.arange(s)
         emb = emb + nn.Embed(self.max_seq, self.embed_dim,
                              dtype=self.dtype, name="pos_emb")(pos)[None]
@@ -205,10 +210,15 @@ class TransformerLM(nn.Module):
             # final hidden states for chunked_next_token_loss: the LM head
             # runs per sequence chunk there, so the full (S, vocab) logits
             # never materialize (at 128k x 32k-vocab, fp32 logits alone
-            # are ~17 GB — the single-chip context cap without chunking)
+            # are ~17 GB — the single-chip context cap without chunking).
+            # Tied models pass {"kernel": params["tok_emb"]["embedding"].T}
+            # as the chunked head params.
             return x
-        logits = nn.Dense(self.vocab_size, dtype=self.dtype,
-                          name="head")(x)
+        if self.tie_embeddings:
+            logits = tok_emb.attend(x)     # h @ E^T, shared table
+        else:
+            logits = nn.Dense(self.vocab_size, dtype=self.dtype,
+                              name="head")(x)
         return logits.astype(jnp.float32)
 
 

@@ -478,3 +478,41 @@ def test_generate_top_k_and_top_p():
                         rng=jax.random.PRNGKey(12), top_p=1e-6)
     np.testing.assert_array_equal(np.asarray(greedy),
                                   np.asarray(tp_small))
+
+
+def test_tie_embeddings():
+    """Tied LM head: no separate head params, logits = h @ E^T, the
+    shared table receives grads from BOTH uses, and training/decoding
+    paths all work."""
+    import numpy as np
+    from apex_tpu.models import TransformerLM
+    from apex_tpu.models.gpt import (chunked_next_token_loss, generate,
+                                     next_token_loss)
+
+    lm = TransformerLM(vocab_size=37, num_layers=1, embed_dim=16,
+                       num_heads=2, max_seq=12, tie_embeddings=True)
+    toks = jax.random.randint(jax.random.PRNGKey(13), (2, 8), 0, 37)
+    params = lm.init(jax.random.PRNGKey(14), toks)["params"]
+    assert "head" not in params
+
+    logits = lm.apply({"params": params}, toks)
+    hid = lm.apply({"params": params}, toks, return_hidden=True)
+    want = hid.astype(jnp.float32) @ np.asarray(
+        params["tok_emb"]["embedding"]).T.astype(np.float32)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+    g = jax.grad(lambda p: next_token_loss(
+        lm.apply({"params": p}, toks), toks))(params)
+    assert float(jnp.max(jnp.abs(g["tok_emb"]["embedding"]))) > 0
+
+    # chunked loss with the transposed shared table
+    loss_full = next_token_loss(logits, toks)
+    loss_chunk = chunked_next_token_loss(
+        hid, {"kernel": params["tok_emb"]["embedding"].T}, toks,
+        chunk=4)
+    np.testing.assert_allclose(float(loss_chunk), float(loss_full),
+                               rtol=1e-5)
+
+    out = generate(lm, params, toks[:, :4], 4)
+    assert out.shape == (2, 8)
