@@ -1020,6 +1020,128 @@ def self_attention(q, k, v, *, causal=False, scale=None, impl="auto",
 
 
 # ---------------------------------------------------------------------------
+# Decode attention (KV-cache inference) — ARCHIVED NEGATIVE RESULT
+# ---------------------------------------------------------------------------
+# A fused Pallas step-attention kernel loses to XLA's einsum chain on
+# this hardware and stays OFF every shipped path (SelfMultiheadAttn's
+# decode branch uses the einsum). Measured (v5e, b=8 h=12 d=64 bf16,
+# device time per call, 200-iter chained scans):
+#   L=640:  einsum 24.9 us (~1.26x the 19.7 us cache-read floor);
+#           fused, (128, d) blocks, grid (96, 5): 120.5 us
+#           (tiny 16 KB DMAs + 480 grid steps of overhead);
+#           fused, whole-cache (640, d) block, grid (96,): 36.3 us
+#           (~16 us of residual per-grid-step overhead).
+#   L=4096: einsum 151 us (~1.2x floor); fused-as-wrapped 764 us (the
+#           d=64 -> 128 lane pad in the wrapper copies the 50 MB cache
+#           every call).
+# The in-model decode gap (per-op ~31 us in the trace vs ~12 us
+# isolated) is XLA scheduling inside the 12-layer scan body, not op
+# inefficiency — a kernel cannot buy it back. Kept parity-tested
+# (tests/test_attention.py, tpu_kernel_check) per the repo's
+# measured-negative-result doctrine (compare BASELINE.md's Pallas-mt
+# table).
+
+def _decode_attn_kernel(scale, bq, bl, nl, *refs):
+    """Grid (bh, il): one small query block (the current decode step's
+    ≤8 tokens, row-padded) against the full KV cache, blockwise online
+    softmax in base 2. Validity comes from the SMEM ``index``
+    scalar: query row r may attend cache columns col <= index + r.
+    Blocks entirely past index + bq - 1 skip their compute."""
+    q_ref, k_ref, v_ref, idx_ref, o_ref, acc_scr, m_scr, l_scr = refs
+    il = pl.program_id(1)
+    idx = idx_ref[0]
+
+    @pl.when(il == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    @pl.when(il * bl <= idx + bq - 1)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * (scale * LOG2E)   # (bq, d)
+        k = k_ref[0].astype(jnp.float32)                     # (bl, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bq, bl)
+        row = jax.lax.broadcasted_iota(jnp.int32, (bq, bl), 0)
+        col = il * bl + jax.lax.broadcasted_iota(jnp.int32, (bq, bl), 1)
+        s = jnp.where(col <= idx + row, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp2(s - m_new)
+        corr = jnp.exp2(m_prev - m_new)
+        l_scr[:, :1] = corr * l_scr[:, :1] \
+            + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_scr[:] = corr * acc_scr[:] + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(il == nl - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        o_ref[0] = (acc_scr[:] / jnp.where(l == 0.0, 1.0, l)).astype(
+            o_ref.dtype)
+
+
+@_no_amp
+def decode_attention(q, k_cache, v_cache, index, *,
+                     scale: Optional[float] = None,
+                     block_l: int = 1024):
+    """Fused KV-cache attention for autoregressive decoding — archived
+    negative result, see the section comment above; the shipped decode
+    path is the einsum in ``SelfMultiheadAttn``.
+
+    ``q``: (B, H, S_cur, D) — the current step's queries (S_cur ≤ 8:
+    single-token decode or a small speculative chunk). ``k_cache`` /
+    ``v_cache``: (B, H, L, D) with the step's tokens ALREADY written at
+    rows ``index .. index + S_cur - 1``; ``index`` is the scalar int32
+    start position (query row r attends cache cols ≤ index + r —
+    identical semantics to the einsum path in
+    ``SelfMultiheadAttn.decode``). The feature dim should be 128-aligned
+    (the decode cache is allocated padded; zero feature columns change
+    nothing) — otherwise this wrapper pads, which copies the cache and
+    defeats the point. Returns (B, H, S_cur, D)."""
+    b, h, sc, d = q.shape
+    if sc > 8:
+        raise ValueError(
+            f"decode_attention is the ≤8-token step kernel (got "
+            f"S_cur={sc}); run prefill through flash_attention")
+    L = k_cache.shape[2]
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    dp = ((d + 127) // 128) * 128
+    bq = 8
+    bl = _pick_block(block_l, L)
+    lp = ((L + bl - 1) // bl) * bl
+    nl = lp // bl
+
+    qf = _pad3(q.reshape(b * h, sc, d), bq, dp)
+    kf = _pad3(k_cache.reshape(b * h, L, d), lp, dp)
+    vf = _pad3(v_cache.reshape(b * h, L, d), lp, dp)
+    idx = jnp.asarray(index, jnp.int32).reshape((1,))
+
+    out = pl.pallas_call(
+        functools.partial(_decode_attn_kernel, scale, bq, bl, nl),
+        grid=(b * h, nl),
+        in_specs=[
+            pl.BlockSpec((1, bq, dp), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, bl, dp), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, bl, dp), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, dp), lambda bh, i: (bh, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, bq, dp), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, dp), jnp.float32),
+                        pltpu.VMEM((bq, 128), jnp.float32),
+                        pltpu.VMEM((bq, 128), jnp.float32)],
+        interpret=_interpret(),
+    )(qf, kf, vf, idx)
+    return out[:, :sc, :d].reshape(b, h, sc, d)
+
+
+# ---------------------------------------------------------------------------
 # Ring attention (sequence parallelism over a mesh axis)
 # ---------------------------------------------------------------------------
 

@@ -172,6 +172,26 @@ def main():
     # keep fwd+grads finite and near the (f16-run) jnp reference
     attn_cmp("flash_fp16_reroute", True, 512, 512, dtype=jnp.float16,
              rtol=6e-2, atol=6e-2)
+    # fused KV-cache decode step kernel vs the masked-einsum reference
+    from apex_tpu.ops.attention import decode_attention
+    kd = jax.random.split(jax.random.PRNGKey(5), 3)
+    kc = jax.random.normal(kd[0], (2, 4, 640, 128), jnp.bfloat16)
+    vc = jax.random.normal(kd[1], (2, 4, 640, 128), jnp.bfloat16)
+    for idx, sc in ((0, 1), (130, 1), (250, 8)):
+        qd = jax.random.normal(jax.random.fold_in(kd[2], idx),
+                               (2, 4, sc, 128), jnp.bfloat16)
+        got = decode_attention(qd, kc, vc, idx)
+        import math as _m
+        s = jnp.einsum("bhqd,bhkd->bhqk", qd, kc,
+                       preferred_element_type=jnp.float32) / _m.sqrt(128)
+        col = jnp.arange(640)[None, :]
+        rowi = idx + jnp.arange(sc)[:, None]
+        s = jnp.where(col <= rowi, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(vc.dtype)
+        want = jnp.einsum("bhqk,bhkd->bhqd", p, vc)
+        cmp(f"decode_attn_idx{idx}_sc{sc}", got, want,
+            rtol=2e-2, atol=2e-2)
+
     # learned score bias: the dbias-emitting fused kernel (full-rank and
     # broadcast shapes, causal skip-blocks zero-written, ragged rows)
     attn_cmp("flash_dbias_full", True, 512, 512,

@@ -873,3 +873,29 @@ def test_encdec_decode_requires_encoder_on_first_call():
     x = jnp.zeros((1, 1, 16))
     with pytest.raises(ValueError, match="first call"):
         m.init(jax.random.PRNGKey(0), x)
+
+
+def test_decode_attention_kernel_matches_einsum():
+    """Fused decode kernel vs the masked einsum across fill levels,
+    step widths, and a cache length that needs block padding."""
+    from apex_tpu.ops.attention import decode_attention
+
+    b, h, L, d = 2, 3, 200, 128
+    ks = jax.random.split(jax.random.PRNGKey(97), 3)
+    kc = jax.random.normal(ks[0], (b, h, L, d))
+    vc = jax.random.normal(ks[1], (b, h, L, d))
+    for idx, sc in ((0, 1), (5, 1), (63, 8), (197, 3), (0, 8)):
+        q = jax.random.normal(jax.random.fold_in(ks[2], idx),
+                              (b, h, sc, d))
+        got = decode_attention(q, kc, vc, idx)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kc,
+                       preferred_element_type=jnp.float32) \
+            / math.sqrt(d)
+        col = jnp.arange(L)[None, :]
+        row = idx + jnp.arange(sc)[:, None]
+        s = jnp.where(col <= row, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        want = jnp.einsum("bhqk,bhkd->bhqd", p, vc)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=f"idx={idx} sc={sc}")
