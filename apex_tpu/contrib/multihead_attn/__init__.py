@@ -270,11 +270,15 @@ class SelfMultiheadAttn(nn.Module):
             # already sharded by the local in_proj above; only the
             # out_proj changes to its row-parallel form below
             if (self.seq_parallel or self.relative_bias
-                    or attn_mask is not None):
+                    or attn_mask is not None or not self.causal
+                    or (self.dropout > 0.0 and not deterministic)):
+                # causal=False would silently decode causally anyway,
+                # and active dropout would silently be dropped — loud
+                # failure beats quiet divergence from the train path
                 raise NotImplementedError(
                     "decode mode currently supports the plain causal "
-                    "self-attention configuration (+ tensor "
-                    "parallelism)")
+                    "deterministic self-attention configuration "
+                    "(+ tensor parallelism)")
             if self.decode_max_len <= 0:
                 raise ValueError(
                     "decode=True needs decode_max_len (cache size)")
@@ -456,7 +460,17 @@ class EncdecMultiheadAttn(nn.Module):
                     "EncdecMultiheadAttn(decode=True): the first call "
                     "must pass the encoder stream (key=...) to fill "
                     "the cross-attention cache")
-            if key is not None and not have:
+            if have and key is not None:
+                # silently attending a STALE cache while the caller
+                # hands over a fresh encoder stream would be quiet
+                # garbage — switching source sequences needs a fresh
+                # cache dict
+                raise ValueError(
+                    "EncdecMultiheadAttn(decode=True): the "
+                    "cross-attention cache is already filled; pass "
+                    "key=None for decode steps (re-initialize the "
+                    "cache to switch encoder streams)")
+            if key is not None:
                 kv = kv_proj(key)
                 k0, v0 = (  # noqa: F841 — captured by the init lambdas
                     _split_heads(x_, h) for x_ in jnp.split(kv, 2, -1))

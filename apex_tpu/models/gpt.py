@@ -370,6 +370,13 @@ def generate(model: TransformerLM, params, prompt, max_new_tokens: int,
         raise ValueError(
             f"prompt ({s_p}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds the cache ({max_len})")
+    if total > model.max_seq:
+        # positions past max_seq would clamp into the last learned
+        # position embedding under jit — silent garbage, not an error
+        raise ValueError(
+            f"prompt ({s_p}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds the model's position table (max_seq="
+            f"{model.max_seq})")
     dec = model.clone(decode=True, decode_max_len=max_len, dropout=0.0,
                       remat=False)
 
@@ -402,8 +409,8 @@ def generate(model: TransformerLM, params, prompt, max_new_tokens: int,
     # prefill: one forward over the whole prompt, cache written
     logits, vs = dec.apply({"params": params}, prompt,
                            mutable=["cache"])
-    keys = jax.random.split(rng, max_new_tokens + 1)
-    tok = sample(logits[:, -1], keys[0])
+    keys = jax.random.split(rng, max_new_tokens)
+    tok0 = sample(logits[:, -1], keys[0])
 
     def step(carry, xs):
         cache, tok = carry
@@ -412,13 +419,17 @@ def generate(model: TransformerLM, params, prompt, max_new_tokens: int,
                            tok[:, None], pos_offset=s_p + i,
                            mutable=["cache"])
         nxt = sample(lg[:, -1], key)
-        return (v2["cache"], nxt), tok
+        return (v2["cache"], nxt), nxt
 
+    # max_new - 1 steps: tok0 (position s_p) came from the prefill
+    # logits, step i emits position s_p + i + 1 — no wasted final
+    # forward whose sample would be discarded
     (_, _), toks = jax.lax.scan(
-        step, (vs["cache"], tok),
-        (jnp.arange(max_new_tokens), keys[1:]))
-    # ys[i] is the token at position s_p + i -> (B, max_new_tokens)
-    return jnp.concatenate([prompt, toks.T.astype(prompt.dtype)], axis=1)
+        step, (vs["cache"], tok0),
+        (jnp.arange(max_new_tokens - 1), keys[1:]))
+    gen = jnp.concatenate(
+        [tok0[:, None], toks.T.astype(prompt.dtype)], axis=1)
+    return jnp.concatenate([prompt, gen], axis=1)
 
 
 GPTSmall = functools.partial(TransformerLM, num_layers=12, embed_dim=768,

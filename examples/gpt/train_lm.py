@@ -87,12 +87,18 @@ def _run_generate(args):
     from apex_tpu.models import TransformerLM
     from apex_tpu.models.gpt import generate
 
+    if args.seq_parallel or args.remat or args.loss_chunk:
+        raise SystemExit(
+            "--generate is a single-device inference mode: "
+            "--seq-parallel/--remat/--loss-chunk do not apply (the "
+            "number would describe a different model than the flags)")
     compute_dtype = amp.resolve(args.opt_level).cast_model_type
     total = args.prompt_len + args.generate
     model = TransformerLM(
         vocab_size=args.vocab, num_layers=args.layers,
         embed_dim=args.embed_dim, num_heads=args.heads,
-        max_seq=total, dtype=compute_dtype or jnp.float32)
+        max_seq=total, moe_num_experts=args.moe,
+        dtype=compute_dtype or jnp.float32)
     prompt = jax.random.randint(
         jax.random.PRNGKey(args.seed), (args.batch_size,
                                         args.prompt_len), 0, args.vocab)

@@ -899,3 +899,17 @@ def test_decode_attention_kernel_matches_einsum():
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-4, atol=2e-4,
                                    err_msg=f"idx={idx} sc={sc}")
+
+
+def test_encdec_decode_rejects_stale_cache_swap():
+    """Passing a fresh encoder stream once the cache is filled must
+    raise, not silently attend the stale keys."""
+    e, h = 16, 2
+    enc = jax.random.normal(jax.random.PRNGKey(98), (1, 6, e))
+    x = jnp.zeros((1, 1, e))
+    m = EncdecMultiheadAttn(embed_dim=e, num_heads=h, decode=True)
+    params = m.init(jax.random.PRNGKey(99), x, enc)["params"]
+    _, vs = m.apply({"params": params}, x, enc, mutable=["cache"])
+    with pytest.raises(ValueError, match="already filled"):
+        m.apply({"params": params, "cache": vs["cache"]}, x, enc,
+                mutable=["cache"])

@@ -516,3 +516,30 @@ def test_tie_embeddings():
 
     out = generate(lm, params, toks[:, :4], 4)
     assert out.shape == (2, 8)
+
+
+def test_generate_rejects_overflowing_position_table():
+    from apex_tpu.models import TransformerLM
+    from apex_tpu.models.gpt import generate
+
+    lm = TransformerLM(vocab_size=17, num_layers=1, embed_dim=16,
+                       num_heads=2, max_seq=8)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    params = lm.init(jax.random.PRNGKey(0), prompt)["params"]
+    with pytest.raises(ValueError, match="position table"):
+        generate(lm, params, prompt, 100, decode_max_len=200)
+
+
+def test_decode_rejects_noncausal_and_active_dropout():
+    from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
+
+    x = jnp.zeros((1, 1, 16))
+    m = SelfMultiheadAttn(embed_dim=16, num_heads=2, decode=True,
+                          decode_max_len=8, causal=False)
+    with pytest.raises(NotImplementedError):
+        m.init(jax.random.PRNGKey(0), x)
+    m2 = SelfMultiheadAttn(embed_dim=16, num_heads=2, decode=True,
+                           decode_max_len=8, causal=True, dropout=0.3)
+    with pytest.raises(NotImplementedError):
+        m2.init(jax.random.PRNGKey(0), x, deterministic=False,
+                dropout_rng=jax.random.PRNGKey(1))
