@@ -114,6 +114,73 @@ def run(expected_devices: int):
     out = fn(params)
     res = {k: float(v) for k, v in out.items()}
     res.update(run_hybrid(world))
+    res.update(run_moe(world))
+    return res
+
+
+def run_moe(world: int):
+    """Expert parallelism ACROSS process boundaries: a ('expert',) axis
+    of the full global size, so the MoE token all_to_all (the one
+    collective the DDP/ZeRO parts don't exercise) crosses the two
+    processes in the 2x4 launch. One EP forward + synced grad step from
+    replicated inputs (local shards sliced in-graph, same trick as
+    local_zero_state); returns replicated scalars keyed moe_*, plus a
+    moe_dense_diff anchor against the single-device dense module."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu import parallel
+    from apex_tpu.parallel.expert_parallel import (
+        MoEMLP, lm_moe_pspecs, moe_sync_grads)
+
+    m = 16
+    b, s = world, 4
+    x = jax.random.normal(jax.random.PRNGKey(8), (b, s, m))
+    dense = MoEMLP(embed_dim=m, num_experts=world, mlp_ratio=2,
+                   num_selected=2, capacity_factor=float(world))
+    params = dense.init(jax.random.PRNGKey(9), x)["params"]
+    specs = lm_moe_pspecs(params, axis="expert")
+    local = dense.clone(axis_name="expert", expert_parallel_size=world)
+    mesh = parallel.make_mesh((world,), ("expert",))
+
+    def per_device(p, xx):
+        rank = jax.lax.axis_index("expert")
+        p_loc = jax.tree_util.tree_map(
+            lambda leaf, sp: (jax.lax.dynamic_slice_in_dim(
+                leaf, rank * (leaf.shape[0] // world),
+                leaf.shape[0] // world, axis=0)
+                if len(sp) > 0 and sp[0] is not None else leaf),
+            p, specs)
+        x_loc = jax.lax.dynamic_slice_in_dim(xx, rank, 1, axis=0)
+
+        def loss(pl):
+            y, _ = local.apply({"params": pl}, x_loc,
+                               mutable=["intermediates"])
+            return jnp.sum(y * y), y
+
+        (val, y), g = jax.value_and_grad(loss, has_aux=True)(p_loc)
+        g = moe_sync_grads(g, specs, "expert")
+        return {
+            "moe_out_sum": jax.lax.psum(jnp.sum(y), "expert"),
+            "moe_out_norm": jnp.sqrt(jax.lax.psum(val, "expert")),
+            "moe_router_gnorm": jnp.sqrt(jnp.sum(
+                g["router"].astype(jnp.float32) ** 2)),
+        }
+
+    fn = jax.jit(shard_map(
+        per_device, mesh=mesh, in_specs=(P(), P()),
+        out_specs={k: P() for k in ("moe_out_sum", "moe_out_norm",
+                                    "moe_router_gnorm")},
+        check_vma=False))
+    out = fn(params, x)
+    res = {k: float(v) for k, v in out.items()}
+
+    y_ref, _ = dense.apply({"params": params}, x,
+                           mutable=["intermediates"])
+    res["moe_dense_diff"] = float(jnp.abs(
+        jnp.sum(y_ref) - out["moe_out_sum"]))
     return res
 
 

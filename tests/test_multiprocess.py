@@ -19,7 +19,10 @@ WORKER = os.path.join(os.path.dirname(__file__), "distributed_worker.py")
 KEYS = ("grad_norm", "param_sum", "param_norm", "master_psum",
         # hybrid dwu_group_size form: (group=2, data=4) mesh whose
         # cross-group allreduce axis SPANS the two processes
-        "hyb_param_sum", "hyb_param_norm", "hyb_master_psum")
+        "hyb_param_sum", "hyb_param_norm", "hyb_master_psum",
+        # expert parallelism: the MoE token all_to_all over the global
+        # ('expert',) axis crosses the process boundary
+        "moe_out_sum", "moe_out_norm", "moe_router_gnorm")
 
 
 def _free_port() -> int:
@@ -107,3 +110,6 @@ def test_two_process_ddp_zero_matches_single_process():
     # single-process) in BOTH processes
     for out in outs:
         assert out["hyb_dense_diff"] < 1e-3, out
+    # EP forward across processes equals the single-device dense module
+    for out in outs:
+        assert out["moe_dense_diff"] < 1e-3, out
