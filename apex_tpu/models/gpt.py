@@ -341,7 +341,8 @@ def chunked_next_token_loss(hidden, head_params, tokens, *,
 
 def generate(model: TransformerLM, params, prompt, max_new_tokens: int,
              *, temperature: float = 0.0, rng=None, top_k: int = 0,
-             top_p: float = 0.0, decode_max_len: int = 0):
+             top_p: float = 0.0, eos_token_id: Optional[int] = None,
+             pad_token_id: int = 0, decode_max_len: int = 0):
     """Autoregressive KV-cache generation. ``prompt``: (B, S_p) int32.
     Returns (B, S_p + max_new_tokens) — the prompt with the generated
     continuation appended. ``temperature=0`` is greedy argmax; otherwise
@@ -349,7 +350,10 @@ def generate(model: TransformerLM, params, prompt, max_new_tokens: int,
     optionally truncated: ``top_k`` keeps the k highest logits,
     ``top_p`` nucleus-truncates to the smallest set with cumulative
     probability ≥ p (both static-shape: a sort + threshold mask, never
-    a dynamic gather).
+    a dynamic gather). With ``eos_token_id``, sequences that emit EOS
+    fill their remaining positions with ``pad_token_id`` (the scan
+    shape stays static — finished sequences keep stepping but their
+    outputs are masked, the standard jit-compatible early-stop).
 
     TPU-native decode: the prompt prefills every layer's K/V cache in
     ONE full forward (a chunked ``dynamic_update_slice`` at the running
@@ -411,21 +415,27 @@ def generate(model: TransformerLM, params, prompt, max_new_tokens: int,
                            mutable=["cache"])
     keys = jax.random.split(rng, max_new_tokens)
     tok0 = sample(logits[:, -1], keys[0])
+    done0 = (jnp.zeros((b,), bool) if eos_token_id is None
+             else tok0 == eos_token_id)
 
     def step(carry, xs):
-        cache, tok = carry
+        cache, tok, done = carry
         i, key = xs
         lg, v2 = dec.apply({"params": params, "cache": cache},
                            tok[:, None], pos_offset=s_p + i,
                            mutable=["cache"])
         nxt = sample(lg[:, -1], key)
-        return (v2["cache"], nxt), nxt
+        if eos_token_id is not None:
+            nxt = jnp.where(done, jnp.asarray(pad_token_id, nxt.dtype),
+                            nxt)
+            done = done | (nxt == eos_token_id)
+        return (v2["cache"], nxt, done), nxt
 
     # max_new - 1 steps: tok0 (position s_p) came from the prefill
     # logits, step i emits position s_p + i + 1 — no wasted final
     # forward whose sample would be discarded
-    (_, _), toks = jax.lax.scan(
-        step, (vs["cache"], tok0),
+    _, toks = jax.lax.scan(
+        step, (vs["cache"], tok0, done0),
         (jnp.arange(max_new_tokens - 1), keys[1:]))
     gen = jnp.concatenate(
         [tok0[:, None], toks.T.astype(prompt.dtype)], axis=1)

@@ -543,3 +543,28 @@ def test_decode_rejects_noncausal_and_active_dropout():
     with pytest.raises(NotImplementedError):
         m2.init(jax.random.PRNGKey(0), x, deterministic=False,
                 dropout_rng=jax.random.PRNGKey(1))
+
+
+def test_generate_eos_pads_finished_sequences():
+    """Once a sequence emits eos_token_id, all its later positions are
+    pad_token_id (static-shape early stop)."""
+    import numpy as np
+    from apex_tpu.models import TransformerLM
+    from apex_tpu.models.gpt import generate
+
+    lm = TransformerLM(vocab_size=23, num_layers=1, embed_dim=16,
+                       num_heads=2, max_seq=20)
+    prompt = jax.random.randint(jax.random.PRNGKey(15), (3, 4), 0, 23)
+    params = lm.init(jax.random.PRNGKey(16), prompt)["params"]
+    greedy = np.asarray(generate(lm, params, prompt, 12))
+    # pick the token the first sequence greedily emits at step 2 as EOS
+    eos = int(greedy[0, 4 + 2])
+    out = np.asarray(generate(lm, params, prompt, 12, eos_token_id=eos,
+                              pad_token_id=22))
+    for row in out:
+        gen = row[4:]
+        hits = np.where(gen == eos)[0]
+        if len(hits):
+            assert (gen[hits[0] + 1:] == 22).all()
+    # the first sequence definitely hit EOS at step 2
+    assert (out[0, 4 + 3:] == 22).all()
