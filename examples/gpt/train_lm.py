@@ -71,6 +71,11 @@ def parse_args(argv=None):
                         "tokens per sequence with the KV-cache decode "
                         "path and report decode tokens/s (no training)")
     p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="sampling temperature for --generate "
+                        "(0 = greedy)")
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--top-p", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--scan", type=int, default=1,
                    help=">1: dispatch-proof mode — N steps per jitted "
@@ -107,7 +112,10 @@ def _run_generate(args):
     params = amp.cast_model(params, amp.resolve(
         args.opt_level, keep_batchnorm_fp32=False))
 
-    fn = jax.jit(lambda p, t: generate(model, p, t, args.generate))
+    fn = jax.jit(lambda p, t: generate(
+        model, p, t, args.generate, temperature=args.temperature,
+        top_k=args.top_k, top_p=args.top_p,
+        rng=jax.random.PRNGKey(args.seed + 2)))
     out = fn(params, prompt)
     jax.block_until_ready(out)
 
