@@ -38,6 +38,7 @@ __all__ = [
     "self_attention", "flash_attention", "attention_reference",
     "ring_self_attention", "ulysses_self_attention",
     "RelativePositionBias", "relative_position_bucket",
+    "alibi_bias", "alibi_slopes",
 ]
 
 
@@ -140,6 +141,33 @@ class RelativePositionBias(nn.Module):
         bias = table[buckets]                       # (sq, sk, h)
         return bias.transpose(2, 0, 1)[None].astype(
             self.dtype or jnp.float32)              # (1, h, sq, sk)
+
+
+def alibi_slopes(num_heads: int):
+    """ALiBi head slopes (Press et al. 2022): the geometric sequence
+    2^(-8/n), 2^(-16/n), ... For non-power-of-two head counts the
+    published recipe interleaves the next power's odd steps; this uses
+    the plain geometric form, which preserves the coverage property."""
+    return jnp.asarray([2.0 ** (-8.0 * (i + 1) / num_heads)
+                        for i in range(num_heads)], jnp.float32)
+
+
+def alibi_bias(num_heads: int, sk: int, *, slopes=None):
+    """ALiBi attention bias in COLUMN form, shape (1, H, 1, sk).
+
+    ALiBi's score penalty -slope·(i-j) is row-shift-equivalent to
+    +slope·j under softmax (each query row's shift -slope·i cancels in
+    the row normalization), so for CAUSAL attention the bias collapses
+    from a (sq, sk) plane to one broadcast column vector — which rides
+    the flash kernels' cheap row-broadcast path (and, with
+    ``trainable_bias=True`` for learned slopes, the in-kernel-reduced
+    O(sk) dbias; see BASELINE.md's dbias price table). Only valid with
+    causal masking: a non-causal row would see rewarded FUTURE columns
+    instead of masked ones. Pass learned ``slopes`` (H,) to
+    differentiate through them."""
+    s = alibi_slopes(num_heads) if slopes is None else slopes
+    cols = jnp.arange(sk, dtype=jnp.float32)
+    return (s[:, None] * cols[None, :])[None, :, None, :]
 
 
 def _derive_seed(rng, module_path):

@@ -913,3 +913,37 @@ def test_encdec_decode_rejects_stale_cache_swap():
     with pytest.raises(ValueError, match="already filled"):
         m.apply({"params": params, "cache": vs["cache"]}, x, enc,
                 mutable=["cache"])
+
+
+def test_alibi_column_form_matches_full_penalty():
+    """The (1, H, 1, sk) column bias equals the textbook -slope*(i-j)
+    penalty under causal softmax (row shifts cancel), on flash AND
+    reference paths; learned slopes differentiate through
+    trainable_bias."""
+    from apex_tpu.contrib.multihead_attn import alibi_bias, alibi_slopes
+
+    b, h, s, d = 2, 4, 96, 32
+    q, k, v = qkv(jax.random.PRNGKey(100), b=b, h=h, s=s, d=d)
+    slopes = alibi_slopes(h)
+    col = alibi_bias(h, s)
+    # textbook full form: -m * (i - j) on the causal triangle
+    i = jnp.arange(s)[:, None].astype(jnp.float32)
+    j = jnp.arange(s)[None, :].astype(jnp.float32)
+    full = (-slopes[:, None, None] * (i - j))[None]
+
+    want = attention_reference(q, k, v, causal=True, bias=full)
+    got_ref = attention_reference(q, k, v, causal=True, bias=col)
+    got_fl = flash_attention(q, k, v, True, bias=col)
+    np.testing.assert_allclose(np.asarray(got_ref), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(got_fl), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss(sl):
+        from apex_tpu.contrib.multihead_attn import alibi_bias as ab
+        return jnp.sum(flash_attention(
+            q, k, v, True, bias=ab(h, s, slopes=sl),
+            trainable_bias=True) ** 2)
+
+    g = jax.grad(loss)(slopes)
+    assert g.shape == (h,) and float(jnp.max(jnp.abs(g))) > 0
