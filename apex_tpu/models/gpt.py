@@ -50,6 +50,15 @@ class Block(nn.Module):
     # KV-cache decode (see SelfMultiheadAttn.decode / gpt.generate)
     decode: bool = False
     decode_max_len: int = 0
+    # Learned attention position biases (SelfMultiheadAttn): T5-style
+    # relative_bias and/or ALiBi — both train through the flash kernels'
+    # dbias emission and decode through the cache path (the bias columns
+    # are sliced at the running cache index).
+    relative_bias: bool = False
+    relative_bias_buckets: int = 32
+    relative_bias_max_distance: int = 128
+    alibi: bool = False
+    alibi_learned: bool = False
     # ``deterministic`` can be fixed at construction time so that under
     # ``nn.remat`` it never becomes a traced argument (a traced bool cannot
     # drive the Python-level dropout branch in SelfMultiheadAttn). The
@@ -70,6 +79,10 @@ class Block(nn.Module):
             tensor_parallel_axis=self.tensor_parallel_axis,
             tensor_parallel_size=self.tensor_parallel_size,
             decode=self.decode, decode_max_len=self.decode_max_len,
+            relative_bias=self.relative_bias,
+            relative_bias_buckets=self.relative_bias_buckets,
+            relative_bias_max_distance=self.relative_bias_max_distance,
+            alibi=self.alibi, alibi_learned=self.alibi_learned,
             name="attn")(
             FusedLayerNorm(normalized_shape=e, name="ln1")(x)
             .astype(x.dtype),
@@ -151,6 +164,16 @@ class TransformerLM(nn.Module):
     moe_capacity_factor: float = 1.25
     expert_parallel_axis: Optional[str] = None
     expert_parallel_size: int = 1
+    # Learned attention position biases, every block (see Block). With
+    # either on, the learned ABSOLUTE position embedding defaults off
+    # (T5 / ALiBi convention: position information lives entirely in
+    # the attention bias; override with learned_pos_emb=True).
+    relative_bias: bool = False
+    relative_bias_buckets: int = 32
+    relative_bias_max_distance: int = 128
+    alibi: bool = False
+    alibi_learned: bool = False
+    learned_pos_emb: Optional[bool] = None
     # Tie the LM head to the token embedding (logits = h @ E^T, no
     # separate head kernel/bias) — the standard weight-tying lever:
     # at 32k vocab x 768 it removes a 25M-param matrix
@@ -177,9 +200,14 @@ class TransformerLM(nn.Module):
         tok_emb = nn.Embed(self.vocab_size, self.embed_dim,
                            dtype=self.dtype, name="tok_emb")
         emb = tok_emb(tokens)
-        pos = pos_offset + jnp.arange(s)
-        emb = emb + nn.Embed(self.max_seq, self.embed_dim,
-                             dtype=self.dtype, name="pos_emb")(pos)[None]
+        pos_emb = (not (self.relative_bias or self.alibi)
+                   if self.learned_pos_emb is None
+                   else self.learned_pos_emb)
+        if pos_emb:
+            pos = pos_offset + jnp.arange(s)
+            emb = emb + nn.Embed(self.max_seq, self.embed_dim,
+                                 dtype=self.dtype,
+                                 name="pos_emb")(pos)[None]
         x = emb
         # deterministic is baked into the module (static) rather than passed
         # per call: under nn.remat a call kwarg is traced, and a traced bool
@@ -197,6 +225,12 @@ class TransformerLM(nn.Module):
                           decode=self.decode,
                           decode_max_len=(self.decode_max_len
                                           or self.max_seq),
+                          relative_bias=self.relative_bias,
+                          relative_bias_buckets=self.relative_bias_buckets,
+                          relative_bias_max_distance=(
+                              self.relative_bias_max_distance),
+                          alibi=self.alibi,
+                          alibi_learned=self.alibi_learned,
                           moe_num_experts=moe,
                           moe_num_selected=self.moe_num_selected,
                           moe_capacity_factor=self.moe_capacity_factor,
@@ -368,15 +402,31 @@ def generate(model: TransformerLM, params, prompt, max_new_tokens: int,
     it serves.
     """
     b, s_p = prompt.shape
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if temperature <= 0.0 and (top_k > 0 or top_p > 0.0):
+        # the greedy branch never reaches the truncation logic — silently
+        # ignoring the flags would misreport what was sampled (ADVICE r4)
+        raise ValueError(
+            "top_k/top_p require temperature > 0 (temperature<=0 is "
+            "greedy argmax, where truncation has no effect)")
     total = s_p + max_new_tokens
     max_len = decode_max_len or model.max_seq
     if total > max_len:
         raise ValueError(
             f"prompt ({s_p}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds the cache ({max_len})")
-    if total > model.max_seq:
+    pos_table_active = (not (model.relative_bias or model.alibi)
+                        if model.learned_pos_emb is None
+                        else model.learned_pos_emb)
+    if total > model.max_seq and pos_table_active:
         # positions past max_seq would clamp into the last learned
-        # position embedding under jit — silent garbage, not an error
+        # position embedding under jit — silent garbage, not an error.
+        # Bias-positioned models (rel-bias/ALiBi without a position
+        # table) have no such bound: length extrapolation past the
+        # training max_seq is exactly their advertised capability, so
+        # only decode_max_len caps them.
         raise ValueError(
             f"prompt ({s_p}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds the model's position table (max_seq="

@@ -1160,6 +1160,29 @@ def _ring_perm(world):
     return [(j, (j + 1) % world) for j in range(world)]
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _psum_cotangent(x, axis_name):
+    """Identity whose COTANGENT psums over ``axis_name``: wrapping a
+    replicated operand makes its grad the full cross-device sum instead
+    of the local contribution — the correct-by-default form for a
+    ring-replicated learned bias (ADVICE r4: the local-grad convention
+    is a silent-undertraining footgun since the non-ring flash path
+    needs no psum). Works for any impl: the wrapper sits OUTSIDE the
+    attention computation."""
+    return x
+
+
+def _psum_cot_fwd(x, axis_name):
+    return x, None
+
+
+def _psum_cot_bwd(axis_name, _res, g):
+    return (jax.lax.psum(g, axis_name),)
+
+
+_psum_cotangent.defvjp(_psum_cot_fwd, _psum_cot_bwd)
+
+
 def _ring_mode(causal, src, rank):
     """0 = full chunk, 1 = causal diagonal chunk, 2 = skip (future)."""
     if causal:
@@ -1311,8 +1334,9 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, has_bias, bias_grad,
     dq, _, _, dk, dv, dbb = jax.lax.fori_loop(
         0, world, body, (dq0, k, v, dk0, dv0, db0))
     if want_db:
-        # LOCAL contribution (this device's query rows): for a bias
-        # replicated across the ring, psum the grad over the axis
+        # LOCAL contribution (this device's query rows): the public
+        # wrapper's replicated_bias option layers the psum on top via
+        # _psum_cotangent — this core always stays local
         dbias = dbb.astype(bias_arr.dtype)
     else:
         dbias = (jnp.zeros_like(bias_arr) if has_bias
@@ -1326,7 +1350,8 @@ _ring_flash_core.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
 
 def ring_self_attention(q, k, v, axis_name: str, *, causal: bool = False,
                         scale: Optional[float] = None, bias=None,
-                        impl: str = "auto", trainable_bias: bool = False):
+                        impl: str = "auto", trainable_bias: bool = False,
+                        replicated_bias: bool = False):
     """Ring attention: each device holds a sequence shard (B, H, S_local, D);
     K/V shards rotate around the ring via ``lax.ppermute`` while each device
     accumulates its queries' attention over every K/V chunk with blockwise
@@ -1349,8 +1374,10 @@ def ring_self_attention(q, k, v, axis_name: str, *, causal: bool = False,
     score grad, written into the bias's column window (every window is
     visited exactly once). The returned dbias is this device's LOCAL
     contribution (its query rows); for a bias REPLICATED across the
-    ring, ``psum`` the grad over ``axis_name`` (the same contract as
-    every replicated-param grad in this framework; see
+    ring, either pass ``replicated_bias=True`` (the backward psums the
+    grad over ``axis_name`` in-place — correct by default for the
+    common replicated-param case) or ``psum`` the grad yourself (the
+    same contract as every replicated-param grad in this framework; see
     docs/source/advanced.rst "Attention masks vs learned biases").
 
     ``impl='flash'`` composes the Pallas flash kernels into the ring (each
@@ -1370,6 +1397,8 @@ def ring_self_attention(q, k, v, axis_name: str, *, causal: bool = False,
                 "ring attention bias must be rank-4 (B, H|1, S_local|1, "
                 f"S_global={world * s_loc}); got shape "
                 f"{getattr(bias, 'shape', None)}")
+        if replicated_bias and trainable_bias:
+            bias = _psum_cotangent(bias, axis_name)
 
     if impl == "auto":
         impl = "flash" if not _interpret() else "default"

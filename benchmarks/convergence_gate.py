@@ -96,7 +96,7 @@ def train_resnet(opt_level: str, steps: int, inner: int, *,
 
 
 def train_gpt(opt_level: str, steps: int, inner: int, *, seq: int,
-              batch: int, moe: int = 0):
+              batch: int, moe: int = 0, rel_bias: bool = False):
     from apex_tpu import amp, optimizers
     from apex_tpu.models import GPTTiny
     from apex_tpu.models.gpt import next_token_loss
@@ -109,7 +109,12 @@ def train_gpt(opt_level: str, steps: int, inner: int, *, seq: int,
     # no_amp router + dispatch einsums + balance loss through the SAME
     # memorization bar (the O1 config additionally proves the router
     # matmul stays out of the fp16 interposition)
-    model = GPTTiny(vocab_size=vocab, max_seq=seq, moe_num_experts=moe)
+    # rel_bias: T5 relative position bias in every attention layer
+    # (r5) — the ONLY position information (no absolute table), so
+    # memorization proves the flash dbias path actually carries the
+    # training signal end-to-end, not just module-level parity
+    model = GPTTiny(vocab_size=vocab, max_seq=seq, moe_num_experts=moe,
+                    relative_bias=rel_bias)
     params32 = model.init(jax.random.PRNGKey(2), toks[:1])["params"]
     apply_fn, aopt = amp.initialize(
         model.apply, optimizers.FusedAdam(lr=3e-3),
@@ -198,6 +203,10 @@ def main(argv=None):
                     loss_thresh=0.1, acc_thresh=None)
         losses, _ = train_gpt(lvl, steps, inner, moe=4, **gpt_cfg)
         ok &= check("gpt_moe_memorize", lvl, losses, None,
+                    loss_thresh=0.1, acc_thresh=None)
+        losses, _ = train_gpt(lvl, steps, inner, rel_bias=True,
+                              **gpt_cfg)
+        ok &= check("gpt_relbias_memorize", lvl, losses, None,
                     loss_thresh=0.1, acc_thresh=None)
     if not ok:
         sys.exit(1)

@@ -61,6 +61,20 @@ def parse_args(argv=None):
                         "chunk of this size (never materializing the "
                         "(S, vocab) logits — at 128k x 32k vocab those "
                         "are ~17 GB); 0 = full logits")
+    p.add_argument("--relative-bias", action="store_true",
+                   help="T5-style learned relative position bias in "
+                        "every attention layer (trains through the "
+                        "flash kernels' dbias emission; replaces the "
+                        "absolute position embedding); --generate "
+                        "decodes through the same bias, sliced at the "
+                        "cache index")
+    p.add_argument("--alibi", action="store_true",
+                   help="ALiBi column-form position bias (fixed "
+                        "published slopes; replaces the absolute "
+                        "position embedding); works with --generate")
+    p.add_argument("--alibi-learned", action="store_true",
+                   help="with --alibi: make the slopes a trained param "
+                        "(rides the O(sk) row-broadcast dbias path)")
     p.add_argument("--moe", type=int, default=0,
                    help="Mixture-of-Experts: every other block's MLP "
                         "becomes this many experts (Switch/GShard, "
@@ -103,6 +117,8 @@ def _run_generate(args):
         vocab_size=args.vocab, num_layers=args.layers,
         embed_dim=args.embed_dim, num_heads=args.heads,
         max_seq=total, moe_num_experts=args.moe,
+        relative_bias=args.relative_bias, alibi=args.alibi,
+        alibi_learned=args.alibi_learned,
         dtype=compute_dtype or jnp.float32)
     prompt = jax.random.randint(
         jax.random.PRNGKey(args.seed), (args.batch_size,
@@ -148,6 +164,11 @@ def main(argv=None):
           f"axis={axis}, global seq {args.seq_len}")
 
     compute_dtype = amp.resolve(args.opt_level).cast_model_type
+    if (args.relative_bias or args.alibi) and args.seq_parallel:
+        raise SystemExit(
+            "--relative-bias/--alibi under --seq-parallel need the "
+            "bias computed with global positions outside the module "
+            "(see SelfMultiheadAttn) — not wired in this trainer")
     model = TransformerLM(
         vocab_size=args.vocab, num_layers=args.layers,
         embed_dim=args.embed_dim, num_heads=args.heads,
@@ -156,6 +177,8 @@ def main(argv=None):
         seq_parallel=args.seq_parallel,
         axis_name="seq" if args.seq_parallel else None,
         moe_num_experts=args.moe,
+        relative_bias=args.relative_bias, alibi=args.alibi,
+        alibi_learned=args.alibi_learned,
         remat=args.remat)
     # params are identical across seq_parallel settings; init a dense twin
     # (a mesh axis is not bound at init time)

@@ -947,3 +947,101 @@ def test_alibi_column_form_matches_full_penalty():
 
     g = jax.grad(loss)(slopes)
     assert g.shape == (h,) and float(jnp.max(jnp.abs(g))) > 0
+
+
+def test_alibi_slopes_interleaved_non_pow2():
+    """Non-power-of-two head counts follow the published interleaved
+    recipe (closest lower power's geometric slopes + every other slope
+    of the doubled sequence) so weights match externally-trained ALiBi
+    checkpoints, e.g. BLOOM-style (ADVICE r4)."""
+    from apex_tpu.contrib.multihead_attn import alibi_slopes
+
+    got = np.asarray(alibi_slopes(12))
+    geo8 = [2.0 ** (-8.0 * (i + 1) / 8) for i in range(8)]
+    geo16 = [2.0 ** (-8.0 * (i + 1) / 16) for i in range(16)]
+    want = np.asarray(geo8 + geo16[0::2][:4], np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # power-of-two counts keep the plain geometric sequence
+    np.testing.assert_allclose(
+        np.asarray(alibi_slopes(8)), np.asarray(geo8, np.float32),
+        rtol=1e-6)
+
+
+@pytest.mark.parametrize("learned", [False, True])
+def test_self_mha_alibi_fast_matches_default(learned):
+    """The module-level alibi option: fast (flash, trainable_bias dbias
+    when learned) and default (dense softmax) paths agree on outputs
+    and all grads; learned slopes appear as the "alibi_slopes" param
+    and receive nonzero grad."""
+    e, h, s = 64, 4, 96
+    x = jax.random.normal(jax.random.PRNGKey(101), (2, s, e))
+
+    def build(impl):
+        return SelfMultiheadAttn(embed_dim=e, num_heads=h, causal=True,
+                                 alibi=True, alibi_learned=learned,
+                                 impl=impl)
+
+    params = build("fast").init(jax.random.PRNGKey(102), x)["params"]
+    assert ("alibi_slopes" in params) == learned
+
+    outs, grads = {}, {}
+    for impl in ("fast", "default"):
+        m = build(impl)
+
+        def loss(p, xx):
+            return jnp.sum(m.apply({"params": p}, xx) ** 2)
+
+        outs[impl] = m.apply({"params": params}, x)
+        grads[impl] = jax.grad(loss)(params, x)
+
+    np.testing.assert_allclose(np.asarray(outs["fast"]),
+                               np.asarray(outs["default"]),
+                               rtol=2e-4, atol=2e-4)
+    flat_f, _ = jax.tree_util.tree_flatten_with_path(grads["fast"])
+    flat_d, _ = jax.tree_util.tree_flatten_with_path(grads["default"])
+    for (pf, gf), (_, gd) in zip(flat_f, flat_d):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gd), rtol=3e-3, atol=2e-3,
+            err_msg=str(pf))
+    if learned:
+        sg = grads["fast"]["alibi_slopes"]
+        assert float(jnp.max(jnp.abs(sg))) > 0
+
+
+def test_self_mha_alibi_requires_causal():
+    m = SelfMultiheadAttn(embed_dim=32, num_heads=2, alibi=True,
+                          causal=False)
+    with pytest.raises(ValueError, match="causal"):
+        m.init(jax.random.PRNGKey(0), jnp.zeros((1, 16, 32)))
+
+
+def test_ring_replicated_bias_flag_matches_manual_psum(mesh):
+    """replicated_bias=True folds the cross-ring psum into the bias
+    cotangent — identical to the manual-psum convention, correct by
+    default for a ring-replicated learned bias (ADVICE r4)."""
+    b, h, s, d = 1, 2, NDEV * 16, 32
+    ks = jax.random.split(jax.random.PRNGKey(103), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d)) for kk in ks)
+    bias = jax.random.normal(jax.random.PRNGKey(104), (1, h, 1, s))
+    g = jax.random.normal(jax.random.PRNGKey(105), q.shape)
+
+    _, vjp_ref = jax.vjp(
+        lambda bb: attention_reference(q, k, v, bias=bb, causal=True),
+        bias)
+    want = vjp_ref(g)[0]
+
+    def per_device(q_, k_, v_, g_):
+        def f(bb):
+            return ring_self_attention(q_, k_, v_, "seq", causal=True,
+                                       bias=bb, impl="flash",
+                                       trainable_bias=True,
+                                       replicated_bias=True)
+        _, vjp = jax.vjp(f, bias)
+        return vjp(g_)[0]        # no manual psum — the flag does it
+
+    spec = P(None, None, "seq", None)
+    got = jax.jit(shard_map(
+        per_device, mesh=mesh, in_specs=(spec,) * 4,
+        out_specs=P(), check_vma=False))(q, k, v, g)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-3, atol=2e-3)

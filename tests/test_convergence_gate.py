@@ -31,7 +31,7 @@ def test_quick_convergence_gate():
     assert proc.returncode == 0, (
         f"gate failed (rc={proc.returncode}):\n{proc.stdout}\n"
         f"{proc.stderr[-2000:]}")
-    assert len(recs) == 6, recs  # 3 models (incl. MoE) x 2 opt levels
+    assert len(recs) == 8, recs  # 4 configs (MoE, rel-bias) x 2 levels
     for r in recs:
         assert r["ok"], r
         assert r["loss_last10_mean"] < r["loss_thresh"], r
