@@ -1,0 +1,51 @@
+# Container recipe for apex_tpu — the counterpart of the reference
+# framework's Dockerfile / examples/docker (which install the CUDA
+# extension build on top of an NVIDIA PyTorch base image). The TPU-native
+# analog layers the pure-Python package + its g++-built host runtime on
+# top of a JAX TPU base image.
+#
+# NOTE: written and structured for TPU VMs but UNVERIFIED — the build
+# environment this repo ships from cannot run docker. Treat it as the
+# documented install contract (identical steps to ci/gate.sh stage 4,
+# which IS exercised every round: pip wheel install + import + smoke).
+#
+# Build:
+#   docker build -t apex_tpu .
+# On a Cloud TPU VM the base image must carry libtpu; either use a
+# TPU-ready JAX image as BASE_IMAGE or install jax[tpu] in it:
+#   docker build --build-arg BASE_IMAGE=python:3.12-slim -t apex_tpu .
+
+ARG BASE_IMAGE=python:3.12-slim
+FROM ${BASE_IMAGE}
+
+# g++ builds the native host runtime (apex_tpu/csrc/host_runtime.cpp) at
+# first import; bake the toolchain in so the build happens here, not at
+# container start
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    g++ git && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/apex_tpu
+COPY . .
+
+# jax[tpu] resolves libtpu on TPU VMs; on other hosts JAX falls back to
+# CPU and the framework runs its interpret-mode paths (the test tier)
+RUN pip install --no-cache-dir "jax[tpu]" \
+    -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    || pip install --no-cache-dir jax
+RUN pip install --no-cache-dir flax optax numpy einops pytest
+RUN pip install --no-cache-dir .
+
+# smoke: import + native runtime build + a tiny end-to-end step (the
+# same assertions as ci/gate.sh stages 1-2)
+RUN python -c "\
+import jax; \
+import apex_tpu; \
+from apex_tpu import amp, optimizers, parallel, runtime; \
+import numpy as np; \
+arrs = [np.ones((3, 4), np.float32), np.zeros((5,), np.float32)]; \
+flat = runtime.flatten_arrays(arrs); \
+back = runtime.unflatten_array(flat, arrs); \
+assert all(np.array_equal(a, b) for a, b in zip(arrs, back)); \
+print('apex_tpu container smoke OK')"
+
+WORKDIR /workspace

@@ -50,6 +50,7 @@ class Block(nn.Module):
     # KV-cache decode (see SelfMultiheadAttn.decode / gpt.generate)
     decode: bool = False
     decode_max_len: int = 0
+    decode_impl: str = "einsum"
     # Learned attention position biases (SelfMultiheadAttn): T5-style
     # relative_bias and/or ALiBi — both train through the flash kernels'
     # dbias emission and decode through the cache path (the bias columns
@@ -79,6 +80,7 @@ class Block(nn.Module):
             tensor_parallel_axis=self.tensor_parallel_axis,
             tensor_parallel_size=self.tensor_parallel_size,
             decode=self.decode, decode_max_len=self.decode_max_len,
+            decode_impl=self.decode_impl,
             relative_bias=self.relative_bias,
             relative_bias_buckets=self.relative_bias_buckets,
             relative_bias_max_distance=self.relative_bias_max_distance,
@@ -152,9 +154,12 @@ class TransformerLM(nn.Module):
     # ``decode=True`` (``decode_max_len`` defaults to max_seq) and drive
     # it with :func:`generate` — the prompt prefills the cache in ONE
     # forward (chunked write at the running index), then each new token
-    # is a 1-token step attending over the cache
+    # is a 1-token step attending over the cache. ``decode_impl``:
+    # 'einsum' (XLA chain) or 'fused' (one Pallas call per step
+    # attention — see SelfMultiheadAttn.decode_impl).
     decode: bool = False
     decode_max_len: int = 0
+    decode_impl: str = "einsum"
     # MoE: every ``moe_every``-th block swaps its dense MLP for a
     # moe_num_experts-way MoEMLP (Switch places MoE in alternating
     # blocks; moe_every=1 makes every block sparse)
@@ -225,6 +230,7 @@ class TransformerLM(nn.Module):
                           decode=self.decode,
                           decode_max_len=(self.decode_max_len
                                           or self.max_seq),
+                          decode_impl=self.decode_impl,
                           relative_bias=self.relative_bias,
                           relative_bias_buckets=self.relative_bias_buckets,
                           relative_bias_max_distance=(

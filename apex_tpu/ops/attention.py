@@ -684,13 +684,70 @@ def _fused_bwd_plan(sq: int, d: int) -> Tuple[bool, int]:
     _flash_bwd and the benchmarks (so achieved-FLOP accounting can't
     drift from the path the kernel actually takes). r4 v5e sweep (d=64):
     scratch <=4 MB runs (512, 1024); larger scratch halves block_q (the
-    8 MB s=16384 scratch + 512-wide blocks exceed scoped VMEM)."""
+    8 MB s=16384 scratch + 512-wide blocks exceed scoped VMEM).
+
+    Shapes past the scratch cap no longer mean two-pass outright:
+    dropout-free, bias-free backwards run the SEGMENTED fused scheme
+    (_flash_bwd_segmented) — query rows split into scratch-sized
+    segments, one fused sweep each; two-pass remains only for
+    dropout/bias at such lengths (their kernels index GLOBAL rows)."""
     dp_ = ((d + 127) // 128) * 128
     scratch_bytes = (((sq + 127) // 128) * 128) * dp_ * 4
     fused = scratch_bytes <= _FUSED_BWD_DQ_SCRATCH_BYTES
     bq_cap = _FUSED_BLOCK_Q if scratch_bytes <= 4 * 2 ** 20 \
         else _FUSED_BLOCK_Q // 2
     return fused, bq_cap
+
+
+def _segment_rows(d: int) -> int:
+    """Largest 128-aligned query-segment length whose dq scratch fits
+    the fused kernel's VMEM budget (16,384 rows at d<=128)."""
+    dp_ = ((d + 127) // 128) * 128
+    return max(128, (_FUSED_BWD_DQ_SCRATCH_BYTES // (dp_ * 4))
+               // 128 * 128)
+
+
+def _flash_bwd_segmented(q, k, v, out, lse, g, *, causal, scale,
+                         block_q, block_k):
+    """Fused single-sweep backward for sequences whose full-seq dq
+    scratch exceeds the VMEM budget (>16k rows at d<=128; VERDICT r4
+    next #3): the query rows split into scratch-sized segments, each
+    running the fused kernel against only the keys its causal window
+    reaches (k/v sliced to q0 + L + sk - sq columns), with the
+    per-segment dK/dV partials accumulated in f32 at the JAX level.
+    The VPU-bound softmax recompute chain runs ONCE per block pair —
+    the whole point of the fused kernel — where the two-pass scheme ran
+    it twice; the price is O(n_segments) extra dK/dV HBM read+write
+    traffic for the accumulation, a bandwidth cost an order below the
+    kernel's own block streaming at these lengths. Dropout / bias /
+    dbias shapes keep the two-pass fallback: their in-kernel counter
+    and BlockSpecs index GLOBAL query rows, which a row-sliced segment
+    call would silently mis-address (dropout masks would decorrelate
+    from the forward's)."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    seg = _segment_rows(d)
+    dq_parts = []
+    dk_acc = jnp.zeros((b, h, sk, d), jnp.float32)
+    dv_acc = jnp.zeros((b, h, sk, d), jnp.float32)
+    for q0 in range(0, sq, seg):
+        n = min(seg, sq - q0)
+        # rows q0..q0+n-1 attend cols <= row + (sk - sq) (bottom-right
+        # anchored diagonal) -> the slice preserves the offset exactly
+        sk_eff = min(sk, q0 + n + sk - sq) if causal else sk
+        if sk_eff <= 0:   # fully-masked rows (causal, sk < sq head)
+            dq_parts.append(jnp.zeros_like(q[:, :, q0:q0 + n]))
+            continue
+        dq_i, dk_i, dv_i = _flash_bwd(
+            q[:, :, q0:q0 + n], k[:, :, :sk_eff], v[:, :, :sk_eff],
+            out[:, :, q0:q0 + n], lse[:, :, q0:q0 + n],
+            g[:, :, q0:q0 + n], causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k)
+        dq_parts.append(dq_i)
+        dk_acc = dk_acc.at[:, :, :sk_eff].add(dk_i.astype(jnp.float32))
+        dv_acc = dv_acc.at[:, :, :sk_eff].add(dv_i.astype(jnp.float32))
+    dq = jnp.concatenate(dq_parts, axis=2)
+    return dq, dk_acc.astype(k.dtype), dv_acc.astype(v.dtype)
 
 
 @_no_amp
@@ -711,6 +768,13 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
         block_k = _BWD_BLOCK_K
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    if (not _fused_bwd_plan(sq, d)[0] and dropout_rate == 0.0
+            and bias is None and sq > _segment_rows(d)):
+        # scratch-overflow shapes without dropout/bias: segmented fused
+        # sweeps instead of the two-pass recompute-twice scheme
+        return _flash_bwd_segmented(q, k, v, out, lse, g, causal=causal,
+                                    scale=scale, block_q=block_q,
+                                    block_k=block_k)
     dtype = q.dtype
     seed = jnp.asarray(
         0 if dropout_seed is None else dropout_seed,
@@ -1090,9 +1154,17 @@ def _decode_attn_kernel(scale, bq, bl, nl, *refs):
 def decode_attention(q, k_cache, v_cache, index, *,
                      scale: Optional[float] = None,
                      block_l: int = 1024):
-    """Fused KV-cache attention for autoregressive decoding — archived
-    negative result, see the section comment above; the shipped decode
-    path is the einsum in ``SelfMultiheadAttn``.
+    """Fused KV-cache attention for autoregressive decoding: one Pallas
+    call computes score+softmax+context over both caches — no XLA
+    scheduling boundary between the two reductions (the r4 trace showed
+    the einsum pair running ~2.4x slower in-model than isolated; a
+    single custom call is opaque to that scheduling). Archived as a
+    negative result in r4 — but that verdict was poisoned by the
+    wrapper's d=64→128 pad, which COPIED the whole cache every call
+    (764 µs at L=4096). r5: the caches pass through at native d
+    whenever Mosaic's block rules allow (last block dim equal to the
+    array dim), so d=64 runs copy-free; see the r5 decode section of
+    BASELINE.md for the re-measure.
 
     ``q``: (B, H, S_cur, D) — the current step's queries (S_cur ≤ 8:
     single-token decode or a small speculative chunk). ``k_cache`` /
@@ -1100,10 +1172,7 @@ def decode_attention(q, k_cache, v_cache, index, *,
     rows ``index .. index + S_cur - 1``; ``index`` is the scalar int32
     start position (query row r attends cache cols ≤ index + r —
     identical semantics to the einsum path in
-    ``SelfMultiheadAttn.decode``). The feature dim should be 128-aligned
-    (the decode cache is allocated padded; zero feature columns change
-    nothing) — otherwise this wrapper pads, which copies the cache and
-    defeats the point. Returns (B, H, S_cur, D)."""
+    ``SelfMultiheadAttn.decode``). Returns (B, H, S_cur, D)."""
     b, h, sc, d = q.shape
     if sc > 8:
         raise ValueError(
@@ -1111,9 +1180,20 @@ def decode_attention(q, k_cache, v_cache, index, *,
             f"S_cur={sc}); run prefill through flash_attention")
     L = k_cache.shape[2]
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
-    dp = ((d + 127) // 128) * 128
+    # native-d blocks when legal (d a lane multiple, or the whole array
+    # minor dim — Mosaic accepts block minor == array minor): the r4
+    # archived verdict paid a full-cache pad COPY here at d=64
+    dp = d if (d % 128 == 0 or d in (64, 32, 16, 8)) \
+        else ((d + 127) // 128) * 128
     bq = 8
+    # block must DIVIDE the cache length or _pad3 below copies both
+    # caches every step (the exact cost the native-d fix removed on the
+    # other axis): take the largest 128-multiple divisor; only a
+    # non-128-multiple L (callers should allocate rounded; the module
+    # does) falls back to the padding path
     bl = _pick_block(block_l, L)
+    if L % 128 == 0:
+        bl = next(b for b in range(bl, 127, -128) if L % b == 0)
     lp = ((L + bl - 1) // bl) * bl
     nl = lp // bl
 

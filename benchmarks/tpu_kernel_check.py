@@ -211,6 +211,15 @@ def main():
                  rtol=6e-2, atol=6e-2)
     finally:
         _A._FUSED_BWD_DQ_SCRATCH_BYTES = _saved
+    # segmented fused backward (r5 >16k path) on hardware: 512-row
+    # segments with genuinely-fused sub-sweeps, causal window trimming
+    # + a ragged final segment
+    _A._FUSED_BWD_DQ_SCRATCH_BYTES = 512 * 128 * 4
+    try:
+        attn_cmp("flash_segmented_causal", True, 1536, 1536)
+        attn_cmp("flash_segmented_ragged", True, 1400, 1400)
+    finally:
+        _A._FUSED_BWD_DQ_SCRATCH_BYTES = _saved
 
     print("ALL TPU KERNEL CHECKS PASSED")
 

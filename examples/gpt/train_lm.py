@@ -85,6 +85,11 @@ def parse_args(argv=None):
                         "tokens per sequence with the KV-cache decode "
                         "path and report decode tokens/s (no training)")
     p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--decode-impl", default="einsum",
+                   choices=["einsum", "fused"],
+                   help="step-attention backend for --generate: XLA "
+                        "einsum chain or the single fused Pallas call "
+                        "(see BASELINE.md decode section)")
     p.add_argument("--temperature", type=float, default=0.0,
                    help="sampling temperature for --generate "
                         "(0 = greedy)")
@@ -119,6 +124,7 @@ def _run_generate(args):
         max_seq=total, moe_num_experts=args.moe,
         relative_bias=args.relative_bias, alibi=args.alibi,
         alibi_learned=args.alibi_learned,
+        decode_impl=args.decode_impl,
         dtype=compute_dtype or jnp.float32)
     prompt = jax.random.randint(
         jax.random.PRNGKey(args.seed), (args.batch_size,
@@ -250,7 +256,7 @@ def main(argv=None):
 
     if args.scan > 1:
         return _run_scan_mode(args, mesh, axis, per_device, step_fn,
-                              params, opt_state, batch)
+                              params, opt_state, batch, model)
 
     rng = np.random.default_rng(args.seed + 1)
     t0 = None
@@ -308,7 +314,7 @@ def main(argv=None):
 
 
 def _run_scan_mode(args, mesh, axis, per_device, step_fn, params,
-                   opt_state, batch):
+                   opt_state, batch, model=None):
     """Dispatch-proof throughput mode (r4): ``--scan N`` runs N train
     steps per jitted lax.scan dispatch with ON-DEVICE token generation —
     each device draws its own shard of fresh tokens from a folded key
@@ -399,6 +405,34 @@ def _run_scan_mode(args, mesh, axis, per_device, step_fn, params,
             msg += f", {mfu:.1%} MFU"
         msg += (" (cost analysis + analytic attention model FLOPs)"
                 if flash_opaque else " (cost-analysis count)")
+    if args.moe and on_tpu:
+        # Dense-equivalent MFU (VERDICT r4 weak #4): the cost-analysis
+        # numerator counts the one-hot dispatch/combine einsums — real
+        # MXU work, but not "useful model FLOPs" under standard MoE
+        # accounting. This numerator is the ACTIVE path only, analytic
+        # standard accounting: 24e^2/token/layer dense (qkv 6e^2 +
+        # attn-out 2e^2 + mlp 16e^2), MoE blocks replace the 16e^2 MLP
+        # with num_selected x 16e^2 expert passes, + untied head
+        # 2*e*vocab, x3 training, + the analytic attention FLOPs.
+        # selection/placement read from the CONSTRUCTED model, not
+        # re-derived literals — accounting must track the model run
+        e = args.embed_dim
+        sel = model.moe_num_selected
+        every = model.moe_every
+        n_moe = sum(1 for i in range(args.layers)
+                    if i % every == every - 1)
+        per_tok = (args.layers * 24 * e * e
+                   + n_moe * (sel - 1) * 16 * e * e
+                   + 2 * e * args.vocab)
+        de_flops = 3.0 * batch * args.seq_len * per_tok \
+            + args.layers * attention_model_flops(
+                batch, args.heads, args.seq_len, args.seq_len,
+                args.embed_dim // args.heads, causal=True, training=True)
+        de_rate = de_flops * tok_s / (batch * args.seq_len)
+        msg += (f"; dense-equivalent {de_rate / 1e12:.1f} TFLOP/s, "
+                f"{de_rate / pyprof.device_peak_flops():.1%} MFU "
+                "(active-path analytic accounting, dispatch/combine "
+                "einsums excluded)")
     print(msg)
     return tok_s
 

@@ -417,10 +417,10 @@ def test_flash_bias_ragged_sq_positive_bias_grads_finite():
 
 
 def test_flash_bwd_two_pass_fallback_matches_reference(monkeypatch):
-    """Long-context shapes fall back to the two-pass (dKdV then dQ)
-    backward when the fused kernel's full-seq dq scratch would blow VMEM.
-    Force the fallback at a small shape and check full grad parity so the
-    two-pass path stays covered."""
+    """Scratch-overflow shapes: with budget 0 the segmented wrapper
+    engages (sq > 128-row segments) and its sub-calls — still over
+    budget — take the two-pass (dKdV then dQ) scheme, so this covers
+    both the segmentation arithmetic and the two-pass kernels."""
     import apex_tpu.ops.attention as A
 
     monkeypatch.setattr(A, "_FUSED_BWD_DQ_SCRATCH_BYTES", 0)
@@ -435,6 +435,64 @@ def test_flash_bwd_two_pass_fallback_matches_reference(monkeypatch):
         lambda a, b, c: attention_reference(a, b, c, causal=True), q, k, v)
     for got, want in zip(vjp_fl(g), vjp_ref(g)):
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-3, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal,sq,sk", [
+    (True, 640, 640),     # 256-row segments, causal column trimming
+    (False, 640, 640),    # non-causal: every segment sees all keys
+    (True, 600, 600),     # ragged final segment + ragged blocks
+    (True, 640, 896),     # cross-length, bottom-right diagonal
+])
+def test_flash_bwd_segmented_matches_reference(monkeypatch, causal, sq,
+                                               sk):
+    """>16k sequences run scratch-sized SEGMENTED fused sweeps (VERDICT
+    r4 next #3). Shrink the scratch budget so 256-row segments engage at
+    test size with each sub-call genuinely on the fused kernel, and
+    check full grad parity incl. the causal key-window trimming."""
+    import apex_tpu.ops.attention as A
+
+    monkeypatch.setattr(A, "_FUSED_BWD_DQ_SCRATCH_BYTES", 256 * 128 * 4)
+    assert A._segment_rows(64) == 256
+    ks = jax.random.split(jax.random.PRNGKey(54), 3)
+    q = jax.random.normal(ks[0], (2, 2, sq, 64))
+    k = jax.random.normal(ks[1], (2, 2, sk, 64))
+    v = jax.random.normal(ks[2], (2, 2, sk, 64))
+    g = jax.random.normal(jax.random.PRNGKey(55), q.shape)
+    _, vjp_fl = jax.vjp(
+        lambda a, b, c: flash_attention(a, b, c, causal), q, k, v)
+    _, vjp_ref = jax.vjp(
+        lambda a, b, c: attention_reference(a, b, c, causal=causal),
+        q, k, v)
+    for got, want in zip(vjp_fl(g), vjp_ref(g)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_flash_bwd_segmented_sq_gt_sk_matches_unsegmented(monkeypatch):
+    """sq > sk causal (leading rows fully masked): flash's convention
+    zeroes dead rows where the jnp reference degenerates to uniform
+    attention — so the segmented path (whose sk_eff<=0 skip mirrors the
+    kernels' causal block skip) is held to the UNSEGMENTED flash
+    backward, its actual semantic contract."""
+    import apex_tpu.ops.attention as A
+
+    ks = jax.random.split(jax.random.PRNGKey(56), 3)
+    q = jax.random.normal(ks[0], (2, 2, 896, 64))
+    k = jax.random.normal(ks[1], (2, 2, 640, 64))
+    v = jax.random.normal(ks[2], (2, 2, 640, 64))
+    g = jax.random.normal(jax.random.PRNGKey(57), q.shape)
+
+    def grads():
+        _, vjp = jax.vjp(
+            lambda a, b, c: flash_attention(a, b, c, True), q, k, v)
+        return vjp(g)
+
+    want = grads()
+    monkeypatch.setattr(A, "_FUSED_BWD_DQ_SCRATCH_BYTES", 256 * 128 * 4)
+    got = grads()
+    for a, b in zip(got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-3, atol=2e-4)
 
 
@@ -880,25 +938,30 @@ def test_decode_attention_kernel_matches_einsum():
     step widths, and a cache length that needs block padding."""
     from apex_tpu.ops.attention import decode_attention
 
-    b, h, L, d = 2, 3, 200, 128
-    ks = jax.random.split(jax.random.PRNGKey(97), 3)
-    kc = jax.random.normal(ks[0], (b, h, L, d))
-    vc = jax.random.normal(ks[1], (b, h, L, d))
-    for idx, sc in ((0, 1), (5, 1), (63, 8), (197, 3), (0, 8)):
-        q = jax.random.normal(jax.random.fold_in(ks[2], idx),
-                              (b, h, sc, d))
-        got = decode_attention(q, kc, vc, idx)
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, kc,
-                       preferred_element_type=jnp.float32) \
-            / math.sqrt(d)
-        col = jnp.arange(L)[None, :]
-        row = idx + jnp.arange(sc)[:, None]
-        s = jnp.where(col <= row, s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        want = jnp.einsum("bhqk,bhkd->bhqd", p, vc)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                                   rtol=2e-4, atol=2e-4,
-                                   err_msg=f"idx={idx} sc={sc}")
+    # L=200: non-128-multiple exercises the padding fallback; L=1920:
+    # 128-multiple but not a power-of-two block multiple — the divisor
+    # search must pick a block that divides it (640), never padding
+    # (which would COPY both caches every step); d=64: native-d blocks
+    for L, d in ((200, 128), (1920, 64)):
+        ks = jax.random.split(jax.random.PRNGKey(97), 3)
+        b, h = 2, 3
+        kc = jax.random.normal(ks[0], (b, h, L, d))
+        vc = jax.random.normal(ks[1], (b, h, L, d))
+        for idx, sc in ((0, 1), (5, 1), (63, 8), (L - 3, 3), (0, 8)):
+            q = jax.random.normal(jax.random.fold_in(ks[2], idx),
+                                  (b, h, sc, d))
+            got = decode_attention(q, kc, vc, idx)
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, kc,
+                           preferred_element_type=jnp.float32) \
+                / math.sqrt(d)
+            col = jnp.arange(L)[None, :]
+            row = idx + jnp.arange(sc)[:, None]
+            s = jnp.where(col <= row, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            want = jnp.einsum("bhqk,bhkd->bhqd", p, vc)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=2e-4, atol=2e-4,
+                                       err_msg=f"L={L} idx={idx} sc={sc}")
 
 
 def test_encdec_decode_rejects_stale_cache_swap():
