@@ -271,14 +271,15 @@ class SelfMultiheadAttn(nn.Module):
     # window, masked — the TPU-native decode formulation.
     decode: bool = False
     decode_max_len: int = 0
-    # Step-attention backend for decode mode: 'einsum' (XLA chain — r4
-    # default) or 'fused' (ops.attention.decode_attention — ONE Pallas
-    # call for score+softmax+context over both caches, so the two cache
-    # reductions never straddle an XLA scheduling boundary; r5
-    # re-measure after removing the d=64 pad copy that poisoned the r4
-    # verdict). 'fused' serves plain-config steps (S_cur <= 8, no
-    # bias, not fp16); prefill and bias configs ride the einsum.
-    decode_impl: str = "einsum"
+    # Step-attention backend for decode mode: 'einsum' (XLA chain),
+    # 'fused' (ops.attention.decode_attention — one Pallas call per
+    # step with dead-block DMA elision, so only the live cache prefix
+    # moves from HBM), or 'auto' (default): fused for caches >= 2048
+    # rows — measured +97% at L=4096 (BASELINE.md r5 decode section) —
+    # einsum below, where the whole cache is one block and elision has
+    # nothing to skip. 'fused' serves plain-config steps (S_cur <= 8,
+    # no bias, not fp16); prefill and bias configs ride the einsum.
+    decode_impl: str = "auto"
 
     def _alibi_column_bias(self, h, sk):
         """(1, h, 1, sk) ALiBi column bias; learned slopes become the
@@ -374,18 +375,35 @@ class SelfMultiheadAttn(nn.Module):
             if self.decode_max_len <= 0:
                 raise ValueError(
                     "decode=True needs decode_max_len (cache size)")
-            if self.decode_impl not in ("einsum", "fused"):
+            if self.decode_impl not in ("auto", "einsum", "fused"):
                 raise ValueError(
-                    f"decode_impl must be 'einsum' or 'fused', got "
-                    f"{self.decode_impl!r}")
+                    f"decode_impl must be 'auto', 'einsum' or 'fused', "
+                    f"got {self.decode_impl!r}")
+            impl = self.decode_impl
+            if impl == "auto":
+                # measured crossover (BASELINE.md r5 decode section):
+                # elision pays once the cache spans multiple blocks
+                impl = ("fused" if self.decode_max_len >= 2048
+                        else "einsum")
             b_, _, s_cur, hd = q.shape
-            # fused kernel: cache rows round up to the 128-row block
-            # grid so the kernel never pads (a pad would COPY the
-            # cache every step — the exact cost that produced the r4
-            # negative verdict); masking makes the extra rows inert
-            max_len = (-(-self.decode_max_len // 128) * 128
-                       if self.decode_impl == "fused"
-                       else self.decode_max_len)
+            from apex_tpu.ops.attention import decode_native_head_dim
+            if impl == "fused" and not decode_native_head_dim(hd):
+                # a non-native head dim (e.g. 96) would re-pay the
+                # full-cache pad copy every step — the exact r4
+                # pathology; the einsum is strictly faster there
+                impl = "einsum"
+            # fused kernel: cache rows round up to the kernel's block
+            # grid so it never pads (a pad would COPY the cache every
+            # step); 512-multiples past 1024 rows keep the divisor-only
+            # block search away from the measured-worst tiny blocks
+            # (a bare 128-multiple like 2176 = 128*17 would force
+            # bl=128: 120.5 us vs 36.3 us whole-cache at L=640, r4
+            # sweep). Masking makes the extra rows inert.
+            if impl == "fused":
+                unit = 512 if self.decode_max_len > 1024 else 128
+                max_len = -(-self.decode_max_len // unit) * unit
+            else:
+                max_len = self.decode_max_len
             ck = self.variable(
                 "cache", "cached_key", jnp.zeros,
                 (b_, h, max_len, hd), k.dtype)
@@ -417,7 +435,7 @@ class SelfMultiheadAttn(nn.Module):
             # two cache reductions (r5; measured in BASELINE.md's decode
             # section). Prefill (s_cur > 8), bias configs, and fp16
             # (no Mosaic f16) take the einsum.
-            use_fused = (self.decode_impl == "fused" and s_cur <= 8
+            use_fused = (impl == "fused" and s_cur <= 8
                          and not (self.relative_bias or self.alibi)
                          and q.dtype != jnp.float16)
             if use_fused:

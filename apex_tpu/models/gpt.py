@@ -50,7 +50,7 @@ class Block(nn.Module):
     # KV-cache decode (see SelfMultiheadAttn.decode / gpt.generate)
     decode: bool = False
     decode_max_len: int = 0
-    decode_impl: str = "einsum"
+    decode_impl: str = "auto"
     # Learned attention position biases (SelfMultiheadAttn): T5-style
     # relative_bias and/or ALiBi — both train through the flash kernels'
     # dbias emission and decode through the cache path (the bias columns
@@ -159,7 +159,7 @@ class TransformerLM(nn.Module):
     # attention — see SelfMultiheadAttn.decode_impl).
     decode: bool = False
     decode_max_len: int = 0
-    decode_impl: str = "einsum"
+    decode_impl: str = "auto"
     # MoE: every ``moe_every``-th block swaps its dense MLP for a
     # moe_num_experts-way MoEMLP (Switch places MoE in alternating
     # blocks; moe_every=1 makes every block sparse)

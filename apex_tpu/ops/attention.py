@@ -1084,34 +1084,41 @@ def self_attention(q, k, v, *, causal=False, scale=None, impl="auto",
 
 
 # ---------------------------------------------------------------------------
-# Decode attention (KV-cache inference) — ARCHIVED NEGATIVE RESULT
+# Decode attention (KV-cache inference) — fused step kernel
 # ---------------------------------------------------------------------------
-# A fused Pallas step-attention kernel loses to XLA's einsum chain on
-# this hardware and stays OFF every shipped path (SelfMultiheadAttn's
-# decode branch uses the einsum). Measured (v5e, b=8 h=12 d=64 bf16,
-# device time per call, 200-iter chained scans):
-#   L=640:  einsum 24.9 us (~1.26x the 19.7 us cache-read floor);
-#           fused, (128, d) blocks, grid (96, 5): 120.5 us
-#           (tiny 16 KB DMAs + 480 grid steps of overhead);
-#           fused, whole-cache (640, d) block, grid (96,): 36.3 us
-#           (~16 us of residual per-grid-step overhead).
-#   L=4096: einsum 151 us (~1.2x floor); fused-as-wrapped 764 us (the
-#           d=64 -> 128 lane pad in the wrapper copies the 50 MB cache
-#           every call).
-# The in-model decode gap (per-op ~31 us in the trace vs ~12 us
-# isolated) is XLA scheduling inside the 12-layer scan body, not op
-# inefficiency — a kernel cannot buy it back. Kept parity-tested
-# (tests/test_attention.py, tpu_kernel_check) per the repo's
-# measured-negative-result doctrine (compare BASELINE.md's Pallas-mt
-# table).
+# History: archived in r4 as a negative result on isolated numbers
+# (v5e, b=8 h=12 d=64 bf16, device time per call):
+#   L=640:  einsum 24.9 us; fused (128, d) blocks 120.5 us (tiny DMAs
+#           + 480 grid steps of overhead); whole-cache block 36.3 us.
+#   L=4096: einsum 151 us; fused-as-wrapped 764 us — but that number
+#           was the WRAPPER's d=64 -> 128 lane pad copying the 50 MB
+#           cache every call, not the kernel.
+# r5 re-opened it with three fixes: native-d blocks (no pad copy),
+# divisor-only block choice (no row-pad copy), and dead-block DMA
+# elision via scalar-prefetched index maps (dead grid steps clamp to
+# the last live block; consecutive identical indices skip the fetch,
+# so only the LIVE cache prefix moves from HBM). In-model (12-layer
+# GPT-small decode scan, batch 8, device clock, BASELINE.md r5 decode
+# section): L=4096 caches decode +97% over the einsum path; short
+# caches (<~2k rows, where the whole cache is one block and there is
+# nothing to elide) stay marginally einsum-favored, so the module's
+# 'auto' policy picks by cache length. The r4 "XLA scheduling" theory
+# for the in-model gap was wrong — the fused kernel suffered the same
+# in-model degradation; the recoverable cost was dead-row bandwidth.
+# Parity coverage: tests/test_attention.py (padding fallback + divisor
+# shapes) and tpu_kernel_check's decode cases on real hardware.
 
 def _decode_attn_kernel(scale, bq, bl, nl, *refs):
     """Grid (bh, il): one small query block (the current decode step's
     ≤8 tokens, row-padded) against the full KV cache, blockwise online
-    softmax in base 2. Validity comes from the SMEM ``index``
-    scalar: query row r may attend cache columns col <= index + r.
-    Blocks entirely past index + bq - 1 skip their compute."""
-    q_ref, k_ref, v_ref, idx_ref, o_ref, acc_scr, m_scr, l_scr = refs
+    softmax in base 2. Validity comes from the scalar-prefetched
+    ``index``: query row r may attend cache columns col <= index + r.
+    Blocks entirely past index + bq - 1 skip their compute — AND their
+    DMAs: the BlockSpec index maps clamp dead steps to the last live
+    block, so consecutive same-index fetches are elided by the
+    pipeline (r5; only the LIVE prefix of the cache moves from HBM,
+    which is the whole bandwidth story of a step that does ~0 FLOPs)."""
+    idx_ref, q_ref, k_ref, v_ref, o_ref, acc_scr, m_scr, l_scr = refs
     il = pl.program_id(1)
     idx = idx_ref[0]
 
@@ -1150,6 +1157,16 @@ def _decode_attn_kernel(scale, bq, bl, nl, *refs):
             o_ref.dtype)
 
 
+def decode_native_head_dim(d: int) -> bool:
+    """True when decode_attention moves the caches WITHOUT a pad copy at
+    this head dim (128-multiples, or a power-of-two minor dim Mosaic
+    accepts as block minor == array minor). The module's fused-impl
+    gating consults this — a non-native d (e.g. 96) must ride the
+    einsum, or every step would re-pay the full-cache pad copy that
+    produced the r4 negative verdict."""
+    return d % 128 == 0 or d in (64, 32, 16, 8)
+
+
 @_no_amp
 def decode_attention(q, k_cache, v_cache, index, *,
                      scale: Optional[float] = None,
@@ -1183,17 +1200,21 @@ def decode_attention(q, k_cache, v_cache, index, *,
     # native-d blocks when legal (d a lane multiple, or the whole array
     # minor dim — Mosaic accepts block minor == array minor): the r4
     # archived verdict paid a full-cache pad COPY here at d=64
-    dp = d if (d % 128 == 0 or d in (64, 32, 16, 8)) \
-        else ((d + 127) // 128) * 128
+    dp = d if decode_native_head_dim(d) else ((d + 127) // 128) * 128
     bq = 8
     # block must DIVIDE the cache length or _pad3 below copies both
     # caches every step (the exact cost the native-d fix removed on the
-    # other axis): take the largest 128-multiple divisor; only a
-    # non-128-multiple L (callers should allocate rounded; the module
-    # does) falls back to the padding path
-    bl = _pick_block(block_l, L)
+    # other axis): take the LARGEST 128-multiple divisor <= block_l —
+    # big blocks matter doubly here (the archived r4 sweep measured
+    # 120.5 us at (128, d) blocks vs 36.3 us whole-cache at L=640: tiny
+    # DMAs + per-grid-step overhead). Only a non-128-multiple L
+    # (callers should allocate rounded; the module does) falls back to
+    # the padding path via _pick_block.
     if L % 128 == 0:
-        bl = next(b for b in range(bl, 127, -128) if L % b == 0)
+        start = max(128, min(block_l, L) // 128 * 128)
+        bl = next(b for b in range(start, 127, -128) if L % b == 0)
+    else:
+        bl = _pick_block(block_l, L)
     lp = ((L + bl - 1) // bl) * bl
     nl = lp // bl
 
@@ -1202,22 +1223,31 @@ def decode_attention(q, k_cache, v_cache, index, *,
     vf = _pad3(v_cache.reshape(b * h, L, d), lp, dp)
     idx = jnp.asarray(index, jnp.int32).reshape((1,))
 
+    def kv_index(bh, il, idx_ref):
+        # dead blocks (entirely past the live prefix) clamp to the last
+        # live block: consecutive identical indices elide the DMA
+        last = jnp.minimum((idx_ref[0] + bq - 1) // bl, nl - 1)
+        return (bh, jnp.minimum(il, last), 0)
+
     out = pl.pallas_call(
         functools.partial(_decode_attn_kernel, scale, bq, bl, nl),
-        grid=(b * h, nl),
-        in_specs=[
-            pl.BlockSpec((1, bq, dp), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, bl, dp), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, bl, dp), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-        ],
-        out_specs=pl.BlockSpec((1, bq, dp), lambda bh, i: (bh, 0, 0)),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * h, nl),
+            in_specs=[
+                pl.BlockSpec((1, bq, dp), lambda bh, i, idx_ref:
+                             (bh, 0, 0)),
+                pl.BlockSpec((1, bl, dp), kv_index),
+                pl.BlockSpec((1, bl, dp), kv_index),
+            ],
+            out_specs=pl.BlockSpec((1, bq, dp), lambda bh, i, idx_ref:
+                                   (bh, 0, 0)),
+            scratch_shapes=[pltpu.VMEM((bq, dp), jnp.float32),
+                            pltpu.VMEM((bq, 128), jnp.float32),
+                            pltpu.VMEM((bq, 128), jnp.float32)]),
         out_shape=jax.ShapeDtypeStruct((b * h, bq, dp), q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, dp), jnp.float32),
-                        pltpu.VMEM((bq, 128), jnp.float32),
-                        pltpu.VMEM((bq, 128), jnp.float32)],
         interpret=_interpret(),
-    )(qf, kf, vf, idx)
+    )(idx, qf, kf, vf)
     return out[:, :sc, :d].reshape(b, h, sc, d)
 
 

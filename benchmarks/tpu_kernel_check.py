@@ -172,25 +172,29 @@ def main():
     # keep fwd+grads finite and near the (f16-run) jnp reference
     attn_cmp("flash_fp16_reroute", True, 512, 512, dtype=jnp.float16,
              rtol=6e-2, atol=6e-2)
-    # fused KV-cache decode step kernel vs the masked-einsum reference
+    # fused KV-cache decode step kernel vs the masked-einsum reference:
+    # d=128 (lane-multiple) AND d=64 (the shipped GPT-small geometry —
+    # native-d blocks, block minor == array minor, (8, 64) f32 scratch)
     from apex_tpu.ops.attention import decode_attention
-    kd = jax.random.split(jax.random.PRNGKey(5), 3)
-    kc = jax.random.normal(kd[0], (2, 4, 640, 128), jnp.bfloat16)
-    vc = jax.random.normal(kd[1], (2, 4, 640, 128), jnp.bfloat16)
-    for idx, sc in ((0, 1), (130, 1), (250, 8)):
-        qd = jax.random.normal(jax.random.fold_in(kd[2], idx),
-                               (2, 4, sc, 128), jnp.bfloat16)
-        got = decode_attention(qd, kc, vc, idx)
-        import math as _m
-        s = jnp.einsum("bhqd,bhkd->bhqk", qd, kc,
-                       preferred_element_type=jnp.float32) / _m.sqrt(128)
-        col = jnp.arange(640)[None, :]
-        rowi = idx + jnp.arange(sc)[:, None]
-        s = jnp.where(col <= rowi, s, -1e30)
-        p = jax.nn.softmax(s, axis=-1).astype(vc.dtype)
-        want = jnp.einsum("bhqk,bhkd->bhqd", p, vc)
-        cmp(f"decode_attn_idx{idx}_sc{sc}", got, want,
-            rtol=2e-2, atol=2e-2)
+    import math as _m
+    for dd in (128, 64):
+        kd = jax.random.split(jax.random.PRNGKey(5), 3)
+        kc = jax.random.normal(kd[0], (2, 4, 640, dd), jnp.bfloat16)
+        vc = jax.random.normal(kd[1], (2, 4, 640, dd), jnp.bfloat16)
+        for idx, sc in ((0, 1), (130, 1), (250, 8)):
+            qd = jax.random.normal(jax.random.fold_in(kd[2], idx),
+                                   (2, 4, sc, dd), jnp.bfloat16)
+            got = decode_attention(qd, kc, vc, idx)
+            s = jnp.einsum("bhqd,bhkd->bhqk", qd, kc,
+                           preferred_element_type=jnp.float32) \
+                / _m.sqrt(dd)
+            col = jnp.arange(640)[None, :]
+            rowi = idx + jnp.arange(sc)[:, None]
+            s = jnp.where(col <= rowi, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(vc.dtype)
+            want = jnp.einsum("bhqk,bhkd->bhqd", p, vc)
+            cmp(f"decode_attn_d{dd}_idx{idx}_sc{sc}", got, want,
+                rtol=2e-2, atol=2e-2)
 
     # learned score bias: the dbias-emitting fused kernel (full-rank and
     # broadcast shapes, causal skip-blocks zero-written, ragged rows)
@@ -200,10 +204,16 @@ def main():
     attn_cmp("flash_dbias_broadcast_ragged", True, 200, 200,
              bias_shape=(1, 2, 1, 200), trainable_bias=True,
              rtol=6e-2, atol=6e-2)
-    # force the two-pass long-context fallback on hardware too
+    # force the PURE two-pass fallback on hardware (bias/dropout shapes
+    # still take it at long lengths): budget 0 kills the fused plan and
+    # the unreachable segment length keeps the r5 segmented wrapper out
+    # — without that, the no-bias case would segment into 128-row
+    # slices and never exercise two-pass at multi-block query geometry
     import apex_tpu.ops.attention as _A
     _saved = _A._FUSED_BWD_DQ_SCRATCH_BYTES
+    _saved_seg = _A._segment_rows
     _A._FUSED_BWD_DQ_SCRATCH_BYTES = 0
+    _A._segment_rows = lambda d: 1 << 30
     try:
         attn_cmp("flash_two_pass_fallback", True, 1024, 1024)
         attn_cmp("flash_dbias_two_pass", True, 512, 512,
@@ -211,6 +221,7 @@ def main():
                  rtol=6e-2, atol=6e-2)
     finally:
         _A._FUSED_BWD_DQ_SCRATCH_BYTES = _saved
+        _A._segment_rows = _saved_seg
     # segmented fused backward (r5 >16k path) on hardware: 512-row
     # segments with genuinely-fused sub-sweeps, causal window trimming
     # + a ragged final segment

@@ -85,8 +85,8 @@ def parse_args(argv=None):
                         "tokens per sequence with the KV-cache decode "
                         "path and report decode tokens/s (no training)")
     p.add_argument("--prompt-len", type=int, default=128)
-    p.add_argument("--decode-impl", default="einsum",
-                   choices=["einsum", "fused"],
+    p.add_argument("--decode-impl", default="auto",
+                   choices=["auto", "einsum", "fused"],
                    help="step-attention backend for --generate: XLA "
                         "einsum chain or the single fused Pallas call "
                         "(see BASELINE.md decode section)")

@@ -417,13 +417,15 @@ def test_flash_bias_ragged_sq_positive_bias_grads_finite():
 
 
 def test_flash_bwd_two_pass_fallback_matches_reference(monkeypatch):
-    """Scratch-overflow shapes: with budget 0 the segmented wrapper
-    engages (sq > 128-row segments) and its sub-calls — still over
-    budget — take the two-pass (dKdV then dQ) scheme, so this covers
-    both the segmentation arithmetic and the two-pass kernels."""
+    """PURE two-pass (dKdV then dQ) coverage at multi-block query
+    geometry: budget 0 kills the fused plan and the unreachable segment
+    length keeps the r5 segmented wrapper out (bias/dropout shapes
+    still take this path at long lengths; segmentation has its own
+    tests below)."""
     import apex_tpu.ops.attention as A
 
     monkeypatch.setattr(A, "_FUSED_BWD_DQ_SCRATCH_BYTES", 0)
+    monkeypatch.setattr(A, "_segment_rows", lambda d: 1 << 30)
     ks = jax.random.split(jax.random.PRNGKey(52), 3)
     q = jax.random.normal(ks[0], (2, 2, 200, 64))
     k = jax.random.normal(ks[1], (2, 2, 200, 64))
