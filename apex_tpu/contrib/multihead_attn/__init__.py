@@ -275,7 +275,8 @@ class SelfMultiheadAttn(nn.Module):
     # 'fused' (ops.attention.decode_attention — one Pallas call per
     # step with dead-block DMA elision, so only the live cache prefix
     # moves from HBM), or 'auto' (default): fused for caches >= 2048
-    # rows — measured +97% at L=4096 (BASELINE.md r5 decode section) —
+    # rows — measured +22% on deep-cache steps / +54% over a full
+    # 4096-token-cache generation (BASELINE.md r5 decode section) —
     # einsum below, where the whole cache is one block and elision has
     # nothing to skip. 'fused' serves plain-config steps (S_cur <= 8,
     # no bias, not fp16); prefill and bias configs ride the einsum.
@@ -387,10 +388,17 @@ class SelfMultiheadAttn(nn.Module):
                         else "einsum")
             b_, _, s_cur, hd = q.shape
             from apex_tpu.ops.attention import decode_native_head_dim
-            if impl == "fused" and not decode_native_head_dim(hd):
-                # a non-native head dim (e.g. 96) would re-pay the
-                # full-cache pad copy every step — the exact r4
-                # pathology; the einsum is strictly faster there
+            if impl == "fused" and (
+                    not decode_native_head_dim(hd)
+                    or self.relative_bias or self.alibi
+                    or q.dtype == jnp.float16):
+                # configs the kernel can't serve demote HERE, before
+                # the cache is sized: a non-native head dim (e.g. 96)
+                # would re-pay the full-cache pad copy every step (the
+                # exact r4 pathology), and bias/fp16 steps would ride
+                # the einsum anyway — over a cache rounded up for a
+                # kernel that never runs (~25% dead-row bandwidth at
+                # decode_max_len=2050)
                 impl = "einsum"
             # fused kernel: cache rows round up to the kernel's block
             # grid so it never pads (a pad would COPY the cache every
@@ -435,9 +443,9 @@ class SelfMultiheadAttn(nn.Module):
             # two cache reductions (r5; measured in BASELINE.md's decode
             # section). Prefill (s_cur > 8), bias configs, and fp16
             # (no Mosaic f16) take the einsum.
-            use_fused = (impl == "fused" and s_cur <= 8
-                         and not (self.relative_bias or self.alibi)
-                         and q.dtype != jnp.float16)
+            # bias/fp16/odd-head-dim configs were demoted to einsum at
+            # impl resolution above; only prefill-width calls remain
+            use_fused = impl == "fused" and s_cur <= 8
             if use_fused:
                 from apex_tpu.ops.attention import decode_attention
                 ctx = decode_attention(q, k_all, v_all, idx, scale=scale)

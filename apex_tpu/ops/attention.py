@@ -1099,7 +1099,8 @@ def self_attention(q, k, v, *, causal=False, scale=None, impl="auto",
 # the last live block; consecutive identical indices skip the fetch,
 # so only the LIVE cache prefix moves from HBM). In-model (12-layer
 # GPT-small decode scan, batch 8, device clock, BASELINE.md r5 decode
-# section): L=4096 caches decode +97% over the einsum path; short
+# section): L=4096 caches decode +22% (deep steps, device clock)
+# to +54% (full generation, wall A/B) over the einsum path; short
 # caches (<~2k rows, where the whole cache is one block and there is
 # nothing to elide) stay marginally einsum-favored, so the module's
 # 'auto' policy picks by cache length. The r4 "XLA scheduling" theory
