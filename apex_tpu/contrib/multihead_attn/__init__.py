@@ -298,12 +298,13 @@ class SelfMultiheadAttn(nn.Module):
                  dropout_rng: Optional[jax.Array] = None):
         e, h = self.embed_dim, self.num_heads
         assert e % h == 0, "embed_dim must divide num_heads"
-        if self.relative_bias and self.seq_parallel:
+        if self.relative_bias and self.seq_parallel == "ulysses":
             raise NotImplementedError(
-                "relative_bias under seq_parallel needs global-position "
-                "offsets threaded through the module — compute the bias "
-                "externally (RelativePositionBias(q_offset=rank*s_loc)) "
-                "and pass it as attn_mask, or use the dense path")
+                "relative_bias under ulysses: the all-to-all re-shards "
+                "to full-sequence/head-subset, where only column "
+                "(q-broadcast) biases apply — use seq_parallel='ring' "
+                "(supported: the bias is built per-shard with global "
+                "query offsets) or alibi (column form)")
         if self.alibi_learned and not self.alibi:
             # a dead flag would silently train WITHOUT ALiBi (no slopes
             # param, absolute embeddings instead) — same loud-failure
@@ -316,11 +317,6 @@ class SelfMultiheadAttn(nn.Module):
                 "alibi=True requires causal=True: the column-form bias "
                 "is only softmax-equivalent to the (i-j) penalty under "
                 "causal masking (future columns would be REWARDED)")
-        if self.alibi and self.seq_parallel:
-            raise NotImplementedError(
-                "alibi under seq_parallel: compute the column bias "
-                "externally (alibi_bias(h, S_global)) and pass it as "
-                "attn_mask — the key columns there are global already")
         if self.alibi and self.tensor_parallel_axis:
             raise NotImplementedError(
                 "alibi under tensor parallelism needs the GLOBAL-head "
@@ -499,12 +495,42 @@ class SelfMultiheadAttn(nn.Module):
             # (B|1, H|1, S_local|1, S_global) for ring,
             # (B|1, H|1, 1, S_global) for ulysses
             bias = _mask_to_bias(attn_mask)
+            # Learned position biases compose with sequence parallelism
+            # (r5): the bias is built per-shard with GLOBAL positions —
+            # this device's query rows sit at rank*s_loc, key columns
+            # are global. The table/slopes params are replicated across
+            # the axis, and each device's dbias is its LOCAL (query
+            # rows' / head subset's) contribution — exactly the
+            # framework's replicated-param grad convention, so the
+            # trainer's existing cross-axis grad psum finishes the job
+            # (no replicated_bias psum here: it would double-count).
+            world = jax.lax.axis_size(self.axis_name)
+            s_glob = world * q.shape[2]
+            learned = False
+            if self.relative_bias:     # ring-only (validated above)
+                rel = RelativePositionBias(
+                    num_heads=h, num_buckets=self.relative_bias_buckets,
+                    max_distance=self.relative_bias_max_distance,
+                    bidirectional=not self.causal, dtype=self.dtype,
+                    name="rel_bias")(
+                    q.shape[2], s_glob,
+                    q_offset=jax.lax.axis_index(self.axis_name)
+                    * q.shape[2])
+                bias = rel if bias is None else bias + rel
+                learned = True
+            if self.alibi:             # column form: ring AND ulysses
+                ab = self._alibi_column_bias(h, s_glob)
+                bias = ab if bias is None else bias + ab
+                learned = learned or self.alibi_learned
             if self.seq_parallel == "ring":
                 ctx = ring_self_attention(q, k, v, self.axis_name,
-                                          causal=self.causal, bias=bias)
+                                          causal=self.causal, bias=bias,
+                                          trainable_bias=learned)
             elif self.seq_parallel == "ulysses":
                 ctx = ulysses_self_attention(q, k, v, self.axis_name,
-                                             causal=self.causal, bias=bias)
+                                             causal=self.causal,
+                                             bias=bias,
+                                             trainable_bias=learned)
             else:
                 raise ValueError(
                     f"seq_parallel must be 'ring' or 'ulysses', got "

@@ -170,11 +170,11 @@ def main(argv=None):
           f"axis={axis}, global seq {args.seq_len}")
 
     compute_dtype = amp.resolve(args.opt_level).cast_model_type
-    if (args.relative_bias or args.alibi) and args.seq_parallel:
+    if args.relative_bias and args.seq_parallel == "ulysses":
         raise SystemExit(
-            "--relative-bias/--alibi under --seq-parallel need the "
-            "bias computed with global positions outside the module "
-            "(see SelfMultiheadAttn) — not wired in this trainer")
+            "--relative-bias needs --seq-parallel ring (or dense): "
+            "after the ulysses all-to-all only column biases apply "
+            "(the module would raise the same at first apply)")
     model = TransformerLM(
         vocab_size=args.vocab, num_layers=args.layers,
         embed_dim=args.embed_dim, num_heads=args.heads,

@@ -861,11 +861,14 @@ def test_self_mha_relative_bias_composes_with_mask():
     assert np.isfinite(np.asarray(out)).all()
 
 
-def test_self_mha_relative_bias_rejects_seq_parallel():
+def test_self_mha_relative_bias_rejects_ulysses():
+    """Ring composes with relative_bias (r5); ulysses cannot — after
+    its all-to-all only column biases apply to the head-subset/full-seq
+    layout, so the module still fails loudly there."""
     m = SelfMultiheadAttn(embed_dim=32, num_heads=2, relative_bias=True,
-                          seq_parallel="ring", axis_name="seq")
+                          seq_parallel="ulysses", axis_name="seq")
     x = jnp.zeros((1, 16, 32))
-    with pytest.raises(NotImplementedError, match="relative_bias"):
+    with pytest.raises(NotImplementedError, match="ulysses"):
         m.init(jax.random.PRNGKey(0), x)
 
 
