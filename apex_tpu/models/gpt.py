@@ -444,21 +444,38 @@ def generate(model: TransformerLM, params, prompt, max_new_tokens: int,
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
         logits = logits.astype(jnp.float32) / temperature
-        if top_k > 0:
-            kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
-            logits = jnp.where(logits < kth, -jnp.inf, logits)
-        if top_p > 0.0:
+        if top_k > 0 or top_p > 0.0:
+            # ONE descending sort serves both truncations (the r4 code
+            # sorted the 32k-entry vocab twice when both were on —
+            # each sort is the dominant per-step sampling cost, see
+            # BASELINE.md's sampled-decode price): top-k keeps logits
+            # >= the k-th sorted entry; top-p's nucleus is computed on
+            # the POST-top-k distribution (same semantics as the
+            # sequential form) by masking sorted entries past k before
+            # the cumulative softmax.
             srt = jnp.sort(logits, axis=-1)[..., ::-1]
-            cum = jnp.cumsum(jax.nn.softmax(srt, axis=-1), axis=-1)
-            # smallest prefix with cumulative prob >= p stays: the
-            # cutoff logit is the last sorted entry whose PRECEDING
-            # cumulative mass is still < p
-            keep = jnp.concatenate(
-                [jnp.ones_like(cum[..., :1], bool), cum[..., :-1] < top_p],
-                axis=-1)
-            cutoff = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1,
-                             keepdims=True)
-            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+            thresh = jnp.full_like(logits[..., :1], -jnp.inf)
+            if top_k > 0:
+                thresh = srt[..., top_k - 1][..., None]
+                # VALUE-based masking, not positional: entries TIED
+                # with the k-th value all survive top-k (that is what
+                # `logits < kth` downstream keeps), so they must also
+                # carry their mass into the nucleus softmax — a
+                # positional pos<k mask would drop tied mass and move
+                # the top-p cutoff on quantized/saturated logits
+                srt = jnp.where(srt >= thresh, srt, -jnp.inf)
+            if top_p > 0.0:
+                cum = jnp.cumsum(jax.nn.softmax(srt, axis=-1), axis=-1)
+                # smallest prefix with cumulative prob >= p stays: the
+                # cutoff logit is the last sorted entry whose PRECEDING
+                # cumulative mass is still < p
+                keep = jnp.concatenate(
+                    [jnp.ones_like(cum[..., :1], bool),
+                     cum[..., :-1] < top_p], axis=-1)
+                cutoff = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1,
+                                 keepdims=True)
+                thresh = jnp.maximum(thresh, cutoff)
+            logits = jnp.where(logits < thresh, -jnp.inf, logits)
         return jax.random.categorical(
             key, logits, axis=-1).astype(prompt.dtype)
 

@@ -544,6 +544,28 @@ def test_generate_top_k_and_top_p():
                         rng=jax.random.PRNGKey(12), top_p=1e-6)
     np.testing.assert_array_equal(np.asarray(greedy),
                                   np.asarray(tp_small))
+    # COMBINED top_k + top_p (r5 single-sort path): must match the
+    # sequential two-sort reference — top-k truncation first, nucleus
+    # computed on the truncated distribution
+    logits = lm.apply({"params": params}, prompt)[:, -1].astype(
+        jnp.float32) / 1.3
+    kth = jnp.sort(logits, axis=-1)[..., -5][..., None]
+    ref = jnp.where(logits < kth, -jnp.inf, logits)
+    srt = jnp.sort(ref, axis=-1)[..., ::-1]
+    cum = jnp.cumsum(jax.nn.softmax(srt, axis=-1), axis=-1)
+    keep = jnp.concatenate(
+        [jnp.ones_like(cum[..., :1], bool), cum[..., :-1] < 0.7],
+        axis=-1)
+    cutoff = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1,
+                     keepdims=True)
+    ref = jnp.where(ref < cutoff, -jnp.inf, ref)
+    # same rng -> same categorical draw iff the truncated logits match
+    a = generate(lm, params, prompt, 1, temperature=1.3,
+                 rng=jax.random.PRNGKey(13), top_k=5, top_p=0.7)
+    want_tok = jax.random.categorical(
+        jax.random.split(jax.random.PRNGKey(13), 1)[0], ref, axis=-1)
+    np.testing.assert_array_equal(np.asarray(a[:, -1]),
+                                  np.asarray(want_tok))
 
 
 def test_tie_embeddings():
