@@ -279,7 +279,9 @@ class SelfMultiheadAttn(nn.Module):
     # 4096-token-cache generation (BASELINE.md r5 decode section) —
     # einsum below, where the whole cache is one block and elision has
     # nothing to skip. 'fused' serves plain-config steps (S_cur <= 8,
-    # no bias, not fp16); prefill and bias configs ride the einsum.
+    # no bias, not fp16); bias-config steps ride the einsum, and a
+    # FRESH-cache prefill (idx provably 0) runs blockwise flash over
+    # the local k/v when impl='fast' (einsum otherwise).
     decode_impl: str = "auto"
 
     def _alibi_column_bias(self, h, sk):
@@ -372,6 +374,24 @@ class SelfMultiheadAttn(nn.Module):
             if self.decode_max_len <= 0:
                 raise ValueError(
                     "decode=True needs decode_max_len (cache size)")
+            # Before the cache variables are created: a FRESH cache
+            # proves this is the first (prefill) call with idx == 0 —
+            # attention then only spans the tokens in hand, so it can
+            # run the blockwise flash kernel on the LOCAL k/v instead
+            # of the einsum over the full cache window (which
+            # materializes an (s_p, max_len) score matrix and reads
+            # max_len-s_p rows of zeros; at prompt 3584 / cache 4096
+            # that plane alone is ~5.6 GB f32 at batch 8). Gated on
+            # impl == 'fast' — 'default' remains the zero-Pallas
+            # escape hatch at every call. Caveat: callers following
+            # the init-then-apply recipe (passing init()'s zero cache
+            # into the prefill apply) present a cache collection, so
+            # fresh is False and prefill takes the einsum — start the
+            # prefill WITHOUT a "cache" collection (as gpt.generate
+            # does) to get the flash path; idx is traced, so the
+            # module cannot branch on it being 0.
+            fresh = (not self.has_variable("cache", "cached_key")
+                     and self.impl == "fast")
             if self.decode_impl not in ("auto", "einsum", "fused"):
                 raise ValueError(
                     f"decode_impl must be 'auto', 'einsum' or 'fused', "
@@ -437,12 +457,31 @@ class SelfMultiheadAttn(nn.Module):
             # scan (r4 trace). 'fused': one pad-free Pallas call for the
             # whole step attention — no scheduling boundary between the
             # two cache reductions (r5; measured in BASELINE.md's decode
-            # section). Prefill (s_cur > 8), bias configs, and fp16
-            # (no Mosaic f16) take the einsum.
+            # section). Non-fresh prefill-width calls (s_cur > 8 with an
+            # existing cache), bias-config steps, and fp16 (no Mosaic
+            # f16) take the einsum; fresh prefill takes flash above.
             # bias/fp16/odd-head-dim configs were demoted to einsum at
             # impl resolution above; only prefill-width calls remain
             use_fused = impl == "fused" and s_cur <= 8
-            if use_fused:
+            if fresh:
+                # prefill: plain causal flash over the local k/v (the
+                # cache above was just written from exactly these
+                # tokens at idx=0); biases are the train-path form at
+                # sq = sk = s_cur — constants here, nothing trains in
+                # decode. fp16 rides flash's bf16 reroute.
+                bias0 = None
+                if self.relative_bias:
+                    bias0 = RelativePositionBias(
+                        num_heads=h,
+                        num_buckets=self.relative_bias_buckets,
+                        max_distance=self.relative_bias_max_distance,
+                        bidirectional=False, dtype=jnp.float32,
+                        name="rel_bias")(s_cur, s_cur)
+                if self.alibi:
+                    ab = self._alibi_column_bias(h, s_cur)
+                    bias0 = ab if bias0 is None else bias0 + ab
+                ctx = flash_attention(q, k, v, True, bias=bias0)
+            elif use_fused:
                 from apex_tpu.ops.attention import decode_attention
                 ctx = decode_attention(q, k_all, v_all, idx, scale=scale)
             else:

@@ -752,7 +752,7 @@ def test_alibi_learned_requires_alibi():
 def test_fused_decode_impl_matches_einsum():
     """decode_impl='fused' (single Pallas step-attention call, 128-row
     rounded cache) reproduces the einsum path's generate() output
-    exactly at the logits level — prefill rides the einsum in both."""
+    exactly at the logits level — fresh prefill rides flash in both."""
     import numpy as np
     from apex_tpu.models import TransformerLM
     from apex_tpu.models.gpt import generate
