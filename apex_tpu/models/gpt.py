@@ -155,8 +155,9 @@ class TransformerLM(nn.Module):
     # it with :func:`generate` — the prompt prefills the cache in ONE
     # forward (chunked write at the running index), then each new token
     # is a 1-token step attending over the cache. ``decode_impl``:
-    # 'einsum' (XLA chain) or 'fused' (one Pallas call per step
-    # attention — see SelfMultiheadAttn.decode_impl).
+    # 'auto' (default: by cache length) | 'einsum' (XLA chain) |
+    # 'fused' (one Pallas call per step with dead-block DMA elision —
+    # see SelfMultiheadAttn.decode_impl).
     decode: bool = False
     decode_max_len: int = 0
     decode_impl: str = "auto"
