@@ -468,9 +468,14 @@ def test_generate_sampling_shapes_and_determinism():
         generate(lm, params, prompt, 100)
 
 
-def test_tp_decode_matches_dense_decode():
+@pytest.mark.parametrize("decode_impl", ["einsum", "fused"])
+def test_tp_decode_matches_dense_decode(decode_impl):
     """Tensor-parallel decode: head-sharded KV caches on a 2-way model
-    axis reproduce the dense decode logits (prefill + 1-token step)."""
+    axis reproduce the dense decode logits (prefill + 1-token step) on
+    BOTH step backends — 'fused' feeds the elision kernel per-device
+    (local head count, local cache); the cache-shape assertion proves
+    the fused path actually resolved (its cache rounds to the 128-row
+    block grid), so a silent demotion to einsum fails loudly."""
     import numpy as np
     from jax import shard_map
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -493,24 +498,29 @@ def test_tp_decode_matches_dense_decode():
 
     params_tp = tp_shard_lm_params(params, tp)
     specs = lm_tp_pspecs(params_tp, axis="model")
-    local = dec.clone(num_heads=heads // tp,
+    local = dec.clone(num_heads=heads // tp, decode_impl=decode_impl,
                       tensor_parallel_axis="model",
                       tensor_parallel_size=tp)
     mesh = Mesh(np.asarray(jax.devices()[:tp]), ("model",))
 
     def run(p, t):
         lg1, vs_ = local.apply({"params": p}, t, mutable=["cache"])
+        cache_rows = vs_["cache"]["block_0"]["attn"][
+            "cached_key"].shape[2]
         lg2, _ = local.apply(
             {"params": p, "cache": vs_["cache"]},
             jnp.full((2, 1), 5, t.dtype), pos_offset=8,
             mutable=["cache"])
-        return lg1, lg2
+        return lg1, lg2, cache_rows
 
-    lg1, lg2 = jax.jit(shard_map(
+    lg1, lg2, cache_rows = jax.jit(shard_map(
         run, mesh=mesh, in_specs=(specs, P()),
-        out_specs=(P(), P()), check_vma=False))(
+        out_specs=(P(), P(), P()), check_vma=False))(
         jax.device_put(params_tp, jax.tree_util.tree_map(
             lambda sp: NamedSharding(mesh, sp), specs)), toks)
+    # einsum keeps decode_max_len; fused rounds to the block grid —
+    # the observable proof of which backend resolved
+    assert cache_rows == (16 if decode_impl == "einsum" else 128)
     np.testing.assert_allclose(np.asarray(lg1), np.asarray(want_pre),
                                rtol=2e-4, atol=2e-4)
     np.testing.assert_allclose(np.asarray(lg2), np.asarray(want_step),
