@@ -483,6 +483,12 @@ class SelfMultiheadAttn(nn.Module):
                 ctx = flash_attention(q, k, v, True, bias=bias0)
             elif use_fused:
                 from apex_tpu.ops.attention import decode_attention
+                # default 1024-row blocks; a cache/4 block (512 at the
+                # L=2048 crossover, for finer dead-prefix elision)
+                # measured WORSE in-model — 5,437 vs 5,777 tok/s at
+                # L=2048 batch 8 — the smaller DMAs and extra grid
+                # steps cost more than the finer skipping saves
+                # (recorded negative result, r5)
                 ctx = decode_attention(q, k_all, v_all, idx, scale=scale)
             else:
                 s_mat = jnp.einsum(
