@@ -20,6 +20,7 @@ Reference layer map: see SURVEY.md at the repo root; top-level wiring mirrors
 
 __version__ = "0.1.0"
 
+from apex_tpu import _compat  # noqa: F401  (installs jax version shims)
 from apex_tpu import checkpoint
 from apex_tpu import ops
 from apex_tpu import multi_tensor_apply

@@ -1,0 +1,59 @@
+"""JAX version-compat shims.
+
+The framework targets the current JAX surface (``jax.shard_map`` with
+``check_vma=``, ``jax.lax.axis_size``); older releases (<= 0.4.x) ship the
+same machinery under earlier names (``jax.experimental.shard_map`` with
+``check_rep=``, no ``axis_size``). :func:`install` bridges the gap by
+adding the modern names onto the ``jax`` namespace when — and only when —
+they are missing, so the package, the graft entry, and the test suite run
+unchanged on both. On a current JAX this is a no-op.
+
+Installed automatically on ``import apex_tpu`` (and importable standalone
+for scripts that touch ``jax.shard_map`` before the package: put
+``import apex_tpu`` above ``from jax import shard_map``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def _axis_size(axis_name):
+    """``jax.lax.axis_size`` for releases that predate it: the size of a
+    bound named mesh axis is the concrete value of ``psum(1, axis)``."""
+    try:
+        return jax.lax.psum(1, axis_name)
+    except NameError as e:
+        # keep the modern API's error shape: unbound name -> NameError
+        raise NameError(f"unbound axis name: {axis_name}") from e
+
+
+def install() -> None:
+    """Idempotently add missing modern-JAX names. Safe to call many times."""
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = _axis_size
+
+    try:
+        jax.enable_x64
+    except AttributeError:
+        # modern jax.enable_x64 is the old experimental context manager
+        from jax.experimental import enable_x64
+        jax.enable_x64 = enable_x64
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        @functools.wraps(_shard_map)
+        def shard_map(f, *args, **kwargs):
+            # modern spelling of the replication check is check_vma;
+            # 0.4.x calls it check_rep
+            if "check_vma" in kwargs:
+                kwargs["check_rep"] = kwargs.pop("check_vma")
+            return _shard_map(f, *args, **kwargs)
+
+        jax.shard_map = shard_map
+
+
+install()

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import flax.linen as nn
 
 from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.parallel.mesh import bound_axis_size
 from apex_tpu.ops.attention import (
     MASK_BIAS,
     attention_reference,
@@ -549,7 +550,7 @@ class SelfMultiheadAttn(nn.Module):
             # framework's replicated-param grad convention, so the
             # trainer's existing cross-axis grad psum finishes the job
             # (no replicated_bias psum here: it would double-count).
-            world = jax.lax.axis_size(self.axis_name)
+            world = bound_axis_size(self.axis_name)
             s_glob = world * q.shape[2]
             learned = False
             if self.relative_bias:     # ring-only (validated above)

@@ -60,6 +60,7 @@ from jax.sharding import PartitionSpec as P
 
 from apex_tpu.ops import buckets as _buckets
 from apex_tpu.optimizers.base import FusedOptimizer, Schedule, resolve_lr
+from apex_tpu.parallel.mesh import bound_axis_size
 
 Tree = Any
 
@@ -194,7 +195,7 @@ class _ZeroBase(FusedOptimizer):
         """Trace-time validation: shard_count must equal the axis size (the
         silent-mis-shard hazard the reference's dwu_group_size avoids by
         construction)."""
-        n = jax.lax.axis_size(self.axis_name)
+        n = bound_axis_size(self.axis_name)
         if n != self.shard_count:
             raise ValueError(
                 f"shard_count={self.shard_count} != size({self.axis_name})="
@@ -301,9 +302,9 @@ class _ZeroBase(FusedOptimizer):
         dwu_group_size two-level scheme, :251-289)."""
         self._check_axes()
         leaves = jax.tree_util.tree_leaves(grads)
-        world = jax.lax.axis_size(self.axis_name)
+        world = bound_axis_size(self.axis_name)
         if self.group_axis is not None:
-            world = world * jax.lax.axis_size(self.group_axis)
+            world = world * bound_axis_size(self.group_axis)
         shards = []
         for b in spec["buckets"]:
             flat = _bucket_flat(leaves, b["idxs"], b["padded"])

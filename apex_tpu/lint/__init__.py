@@ -1,0 +1,29 @@
+"""apex_tpu.lint — static trace-safety, dtype-policy, and collective-
+consistency analysis for TPU training code.
+
+Two passes (see docs/lint.md for the rule catalog):
+
+* AST (``APX0xx``): trace hazards readable from source — Python control
+  flow on traced values, concretization, impure state under ``jit``,
+  train steps that forget buffer donation, hardcoded dtype literals that
+  bypass the ``amp.policy`` tables.
+* jaxpr (``APX1xx``): properties of the lowered program — O4/O5 matmul
+  dtype conformance, collective axis-name/axis_index_groups consistency
+  against the mesh, Pallas (8, 128) block tiling.
+
+Run ``python -m apex_tpu.lint apex_tpu/ --strict`` (the CI gate does),
+or lint your own train step programmatically::
+
+    from apex_tpu import lint
+    findings = lint.check_entry(step_fn, args, name="train_step",
+                                mesh_axes=("data",), opt_level="O5")
+
+Suppress a finding in place with ``# apexlint: disable=APX00N -- why``.
+"""
+
+from apex_tpu.lint.rules import RULES, Rule
+from apex_tpu.lint.report import Finding
+from apex_tpu.lint.ast_checks import check_source
+from apex_tpu.lint.jaxpr_checks import (EntrySpec, builtin_entries,
+                                        check_entry, run_entries)
+from apex_tpu.lint.cli import main, run

@@ -1,0 +1,14 @@
+import os
+import sys
+
+from apex_tpu.lint.cli import main
+
+try:
+    rc = main()
+    sys.stdout.flush()
+except BrokenPipeError:
+    # downstream pipe closed early (e.g. `| head`): not a lint failure;
+    # re-point stdout at devnull so the interpreter's exit flush is quiet
+    os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    rc = 0
+sys.exit(rc)

@@ -1,0 +1,296 @@
+"""AST pass: source-level trace hazards (rules APX001-APX005).
+
+The pass is deliberately heuristic-but-precise: every rule is scoped so
+that a firing is near-certainly a real hazard (Python control flow on a
+``jnp``/``lax`` expression, ``.item()`` in a kernel, stdlib RNG under
+``jit``), at the cost of missing exotic spellings. False negatives are
+cheap — the jaxpr pass and the test suite back this one up; false
+positives cost a suppression comment in someone else's diff.
+
+Traced-context detection: a function is considered traced when it
+
+  * is decorated with ``jax.jit`` / ``pjit`` / ``jax.pmap`` (directly, as
+    a decorator-factory call, or via ``functools.partial(jax.jit, ...)``),
+  * is passed (by name, lambda, or ``functools.partial``) to ``jax.jit``,
+    ``jax.pmap``, ``pjit``, ``shard_map``, or ``pl.pallas_call``, or
+  * is defined inside a traced function (closures trace with the parent).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from apex_tpu.lint.report import Finding
+
+_TRACING_CALLS = {"jit", "pjit", "pmap", "shard_map", "pallas_call"}
+# call roots whose results are traced arrays: `if jnp.any(...)` et al.
+_ARRAY_ROOTS = ("jnp.", "jax.", "lax.")
+_IMPURE_PREFIXES = ("random.", "np.random.", "numpy.random.",
+                    "time.", "datetime.")
+_LOWP_DTYPE_ATTRS = {"jnp.float16", "jnp.bfloat16", "jnp.half",
+                     "jax.numpy.float16", "jax.numpy.bfloat16",
+                     "np.float16", "numpy.float16", "np.half"}
+_LOWP_DTYPE_STRS = {"float16", "bfloat16"}
+_DTYPE_ARG_CALLS = {"asarray", "array", "zeros", "ones", "full", "empty",
+                    "zeros_like", "ones_like", "full_like"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.lax.psum' for an Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _call_tail(call: ast.Call) -> Optional[str]:
+    name = _dotted(call.func)
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def _traced_operand_names(call: ast.Call) -> Iterable[ast.AST]:
+    """The function operand(s) a tracing call traces: first positional
+    arg, unwrapping ``functools.partial(fn, ...)``."""
+    if not call.args:
+        return []
+    arg = call.args[0]
+    if isinstance(arg, ast.Call) and _call_tail(arg) == "partial" and arg.args:
+        return [arg.args[0]]
+    return [arg]
+
+
+class _TracedCollector(ast.NodeVisitor):
+    """Find names/nodes of functions that end up traced."""
+
+    def __init__(self):
+        self.traced_names: Set[str] = set()
+        self.traced_nodes: List[ast.AST] = []   # lambdas marked in place
+
+    def _is_tracer(self, func: ast.AST) -> bool:
+        name = _dotted(func)
+        return bool(name) and name.rsplit(".", 1)[-1] in _TRACING_CALLS
+
+    def _decorator_traces(self, dec: ast.AST) -> bool:
+        if _dotted(dec) and _dotted(dec).rsplit(".", 1)[-1] in _TRACING_CALLS:
+            return True
+        if isinstance(dec, ast.Call):
+            if self._is_tracer(dec.func):
+                return True            # @functools.partial(jax.jit, ...)
+            if (_call_tail(dec) == "partial" and dec.args
+                    and self._is_tracer(dec.args[0])):
+                return True
+        return False
+
+    def visit_FunctionDef(self, node):
+        if any(self._decorator_traces(d) for d in node.decorator_list):
+            self.traced_names.add(node.name)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        if self._is_tracer(node.func):
+            for operand in _traced_operand_names(node):
+                if isinstance(operand, ast.Name):
+                    self.traced_names.add(operand.id)
+                elif isinstance(operand, ast.Lambda):
+                    self.traced_nodes.append(operand)
+                elif isinstance(operand, ast.Call):
+                    # jax.jit(shard_map(step, ...)) — trace the inner fn
+                    if self._is_tracer(operand.func):
+                        for inner in _traced_operand_names(operand):
+                            if isinstance(inner, ast.Name):
+                                self.traced_names.add(inner.id)
+        self.generic_visit(node)
+
+
+def _expr_has_array_call(expr: ast.AST) -> bool:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call):
+            name = _dotted(sub.func)
+            if name and (name.startswith(_ARRAY_ROOTS)):
+                return True
+    return False
+
+
+def _names_in(expr: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+class _TracedBodyChecker:
+    """APX001/002/003 inside one traced function (incl. nested defs)."""
+
+    def __init__(self, path: str, findings: List[Finding]):
+        self.path = path
+        self.findings = findings
+
+    def _emit(self, rule, node, msg):
+        self.findings.append(Finding(rule, self.path, node.lineno, msg))
+
+    def check(self, fn: ast.AST, params: Set[str]):
+        own = set(params)
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)):
+            a = fn.args
+            own |= {x.arg for x in (a.posonlyargs + a.args + a.kwonlyargs)}
+            if a.vararg:
+                own.add(a.vararg.arg)
+            if a.kwarg:
+                own.add(a.kwarg.arg)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            self._walk(stmt, own)
+
+    def _walk(self, node: ast.AST, params: Set[str]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            self.check(node, params)    # nested defs trace with the parent
+            return
+        if isinstance(node, (ast.If, ast.While)):
+            if _expr_has_array_call(node.test):
+                self._emit(
+                    "APX001", node,
+                    "Python control flow on a traced jax/jnp expression — "
+                    "this concretizes the value at trace time; use "
+                    "jax.lax.cond / jax.lax.while_loop / jnp.where")
+        if isinstance(node, ast.Global):
+            self._emit(
+                "APX003", node,
+                "`global` statement inside traced code — mutable Python "
+                "state is baked in at trace time and will not update "
+                "across steps")
+        if isinstance(node, ast.Call):
+            self._check_call(node, params)
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, params)
+
+    def _check_call(self, node: ast.Call, params: Set[str]):
+        name = _dotted(node.func) or ""
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+            self._emit(
+                "APX002", node,
+                ".item() inside traced code concretizes a traced array — "
+                "it fails under jit (or silently blocks on TPU)")
+        elif (isinstance(node.func, ast.Name)
+              and node.func.id in ("float", "int", "bool")
+              and len(node.args) == 1
+              and (_names_in(node.args[0]) & params)):
+            self._emit(
+                "APX002", node,
+                f"{node.func.id}() on a traced argument concretizes it at "
+                "trace time — keep it an array (astype) or pass it as a "
+                "static argument")
+        elif (name.rsplit(".", 1)[-1] in ("asarray", "array")
+              and name.startswith(("np.", "numpy."))
+              and node.args and (_names_in(node.args[0]) & params)):
+            self._emit(
+                "APX002", node,
+                "np.asarray/np.array on a traced argument pulls it to the "
+                "host at trace time — use jnp instead")
+        if name.startswith(_IMPURE_PREFIXES):
+            self._emit(
+                "APX003", node,
+                f"call to `{name}` inside traced code — Python-side "
+                "RNG/clock values are constants baked into the compiled "
+                "program; use jax.random with an explicit key")
+
+
+def _check_jit_donation(tree: ast.Module, path: str,
+                        findings: List[Finding]):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func) or ""
+        if name.rsplit(".", 1)[-1] not in ("jit", "pjit"):
+            continue
+        target = None
+        for operand in _traced_operand_names(node):
+            if isinstance(operand, ast.Name):
+                target = operand.id
+            elif isinstance(operand, ast.Call) and _call_tail(operand) in (
+                    "shard_map",):
+                inner = _traced_operand_names(operand)
+                if inner and isinstance(inner[0], ast.Name):
+                    target = inner[0].id
+        if not target:
+            continue
+        low = target.lower()
+        if "step" not in low and "train" not in low:
+            continue
+        kw = {k.arg for k in node.keywords}
+        if not kw & {"donate_argnums", "donate_argnames"}:
+            findings.append(Finding(
+                "APX004", path, node.lineno,
+                f"jax.jit({target}) looks like a train step but donates "
+                "no buffers — without donate_argnums the params/optimizer "
+                "state double-buffer in HBM"))
+
+
+def _check_dtype_literals(tree: ast.Module, path: str,
+                          findings: List[Finding]):
+    norm = path.replace("\\", "/")
+    if any(part in norm for part in ("/amp/", "/fp16_utils/", "/lint/")):
+        return   # the policy tables / fp16 master-weight utils ARE the policy
+
+    def is_lowp(node: ast.AST) -> bool:
+        d = _dotted(node)
+        if d in _LOWP_DTYPE_ATTRS:
+            return True
+        return (isinstance(node, ast.Constant)
+                and node.value in _LOWP_DTYPE_STRS)
+
+    def emit(node):
+        findings.append(Finding(
+            "APX005", path, node.lineno,
+            "hardcoded low-precision dtype literal — the compute dtype "
+            "is an amp.policy decision (opt_levels[...].compute_dtype); "
+            "hardcoding it bypasses O0-O5 selection"))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func) or ""
+        tail = name.rsplit(".", 1)[-1]
+        if tail == "astype" and node.args and is_lowp(node.args[0]):
+            emit(node)
+            continue
+        if (tail in _DTYPE_ARG_CALLS and len(node.args) >= 2
+                and is_lowp(node.args[1])):
+            emit(node)
+            continue
+        for k in node.keywords:
+            if k.arg == "dtype" and is_lowp(k.value):
+                emit(node)
+                break
+
+
+def check_source(path: str, text: str) -> List[Finding]:
+    """Run all AST rules over one source file."""
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:
+        return [Finding("APX000", path, e.lineno or 0,
+                        f"file does not parse: {e.msg}")]
+    findings: List[Finding] = []
+
+    collector = _TracedCollector()
+    collector.visit(tree)
+
+    checker = _TracedBodyChecker(path, findings)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in collector.traced_names:
+                checker.check(node, set())
+    for node in collector.traced_nodes:
+        checker.check(node, set())
+
+    _check_jit_donation(tree, path, findings)
+    _check_dtype_literals(tree, path, findings)
+    # a def nested in a traced fn AND independently marked traced is
+    # visited twice; findings are value-equal, so dedup preserves order
+    return list(dict.fromkeys(findings))

@@ -1,0 +1,122 @@
+"""Command-line front end: ``python -m apex_tpu.lint <paths>``.
+
+Exit codes: 0 clean (suppressed findings are clean), 1 findings at error
+severity (or any finding under ``--strict``), 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List, Sequence
+
+from apex_tpu.lint import ast_checks, jaxpr_checks, report
+from apex_tpu.lint.rules import RULES
+
+
+def _collect_py_files(paths: Sequence[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git",
+                                            "build", ".ipynb_checkpoints")]
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames)
+                             if f.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+        else:
+            raise SystemExit(f"apexlint: not a .py file or directory: {p}")
+    return files
+
+
+def _relpath(p: str) -> str:
+    try:
+        rel = os.path.relpath(p)
+        return p if rel.startswith("..") else rel
+    except ValueError:
+        return p
+
+
+def run(paths: Sequence[str], *, jaxpr: bool = True,
+        select: Sequence[str] = (), ignore: Sequence[str] = ()):
+    """Lint ``paths``; returns (active_findings, suppressed_findings)."""
+    findings: List[report.Finding] = []
+    sources: Dict[str, List[str]] = {}
+
+    for f in _collect_py_files(paths):
+        rel = _relpath(f)
+        with open(f, encoding="utf-8") as fh:
+            text = fh.read()
+        sources[rel] = text.splitlines()
+        for finding in ast_checks.check_source(rel, text):
+            findings.append(finding)
+
+    if jaxpr:
+        for finding in jaxpr_checks.run_entries():
+            rel = _relpath(finding.path)
+            finding = report.Finding(finding.rule_id, rel, finding.line,
+                                     finding.message)
+            if rel not in sources and os.path.exists(rel):
+                with open(rel, encoding="utf-8") as fh:
+                    sources[rel] = fh.read().splitlines()
+            findings.append(finding)
+
+    findings = list(dict.fromkeys(findings))    # drop exact duplicates
+    if select:
+        findings = [f for f in findings if f.rule_id in set(select)]
+    if ignore:
+        findings = [f for f in findings if f.rule_id not in set(ignore)]
+    return report.apply_suppressions(findings, sources)
+
+
+def main(argv: Sequence[str] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m apex_tpu.lint",
+        description="Static trace-safety / dtype-policy / collective-"
+                    "consistency analyzer for apex_tpu code.")
+    ap.add_argument("paths", nargs="*", default=[],
+                    help="files or directories to lint")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero on warnings too, not just errors")
+    ap.add_argument("--format", choices=("text", "github"), default="text",
+                    help="output style; github emits ::error/::warning "
+                         "annotation lines")
+    ap.add_argument("--select", default="",
+                    help="comma list of rule IDs to run (default: all)")
+    ap.add_argument("--ignore", default="",
+                    help="comma list of rule IDs to skip")
+    ap.add_argument("--no-jaxpr", action="store_true",
+                    help="skip the jaxpr entry-point pass (AST only)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES.values():
+            print(f"{r.id}  {r.severity:7s} {r.name}: {r.summary}")
+        return 0
+    if not args.paths:
+        ap.print_usage()
+        return 2
+
+    select = [s.strip().upper() for s in args.select.split(",") if s.strip()]
+    ignore = [s.strip().upper() for s in args.ignore.split(",") if s.strip()]
+    for rid in select + ignore:
+        if rid not in RULES:
+            print(f"apexlint: unknown rule id {rid!r}", file=sys.stderr)
+            return 2
+
+    active, suppressed = run(args.paths, jaxpr=not args.no_jaxpr,
+                             select=select, ignore=ignore)
+    out = report.render(active, suppressed, args.format)
+    if out:
+        print(out)
+    return report.exit_code(active, strict=args.strict)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

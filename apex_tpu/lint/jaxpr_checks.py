@@ -1,0 +1,475 @@
+"""jaxpr pass: lower registered entry points and check what the AST can't
+see (rules APX101-APX105).
+
+Where the AST pass reads source, this pass reads the *program*: each
+registered entry point (the graft entry, a model forward+loss, an
+optimizer update step, the distributed train steps) is traced with
+``jax.make_jaxpr`` — no execution, no devices needed beyond trace-time —
+and the equation graph is walked, recursing through pjit / scan / cond /
+custom-vjp / shard_map / pallas_call sub-jaxprs:
+
+* **dtype policy** (APX101/APX102): for entries registered with a
+  low-precision opt level (O4/O5 bf16, O1-O3 fp16), every ``dot_general``
+  must consume low-precision operands — an fp32 operand with *no
+  low-precision ancestor* means a tensor bypassed the amp cast and the
+  matmul silently runs fp32 (the classic "slow model, right answer" bug).
+  Operands that were *explicitly* upcast from a low dtype (fp32 softmax /
+  loss islands — both sides descend from converts) are policy-intended
+  and pass. Sum-reductions must not accumulate in bf16/fp16.
+
+* **collective consistency** (APX103/APX104): every ``psum`` / ``pmean``
+  / ``all_gather`` / ``ppermute`` / ``all_to_all`` / ``psum_scatter`` /
+  ``axis_index`` must name an axis of the entry's mesh (an unknown name
+  is the TPU analog of a deadlock: on multi-host it hangs, single-host it
+  dies with an opaque unbound-axis error — surfaced here at lint time
+  instead), and a given axis must use one consistent ``axis_index_groups``
+  value across the entry body.
+
+* **Pallas tiling** (APX105): each ``pallas_call`` block mapping's last
+  two block dims must be multiples of the TPU native (8, 128) tile or
+  span the full array dim (the Mosaic rule; violating it either fails to
+  lower on real TPUs or degrades to scalar loads).
+
+Provenance ("has a low-precision ancestor") is a forward dataflow walk
+over the equations: a var is low-origin if its dtype is bf16/fp16 or any
+producer input is low-origin; sub-jaxpr invars inherit from the caller's
+operands when the arities line up.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from apex_tpu.lint.report import Finding
+
+_LOW_DTYPES = ("bfloat16", "float16")
+_COLLECTIVE_PRIMS = {
+    "psum", "pmin", "pmax", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "psum_scatter", "reduce_scatter", "axis_index",
+}
+
+
+def _dtype_name(aval) -> str:
+    return str(getattr(aval, "dtype", ""))
+
+
+def _env_get(low_env: Dict[Any, bool], v) -> bool:
+    """low-origin lookup tolerant of unhashable Literal atoms."""
+    try:
+        return low_env.get(v, False)
+    except TypeError:
+        return _is_low(getattr(v, "aval", None))
+
+
+def _is_low(aval) -> bool:
+    return _dtype_name(aval) in _LOW_DTYPES
+
+
+def _is_f32(aval) -> bool:
+    return _dtype_name(aval) == "float32"
+
+
+def _frame_for(eqn, default_path: str, default_line: int
+               ) -> Tuple[str, int]:
+    """Best user frame (file, line) for an equation: prefer the deepest
+    frame inside this repo/package, else the first user frame."""
+    try:
+        from jax._src import source_info_util
+        frames = list(source_info_util.user_frames(eqn.source_info))
+    except Exception:
+        frames = []
+    pick = None
+    for fr in frames:
+        fname = getattr(fr, "file_name", "") or ""
+        if "apex_tpu" in fname or fname.endswith("__graft_entry__.py"):
+            pick = fr
+            break
+    if pick is None and frames:
+        pick = frames[0]
+    if pick is None:
+        return default_path, default_line
+    line = getattr(pick, "start_line", None) or getattr(
+        pick, "line_num", 0) or 0
+    return getattr(pick, "file_name", default_path), int(line)
+
+
+@dataclasses.dataclass
+class _Ctx:
+    entry: str
+    path: str
+    compute_low: bool                      # entry runs a bf16/fp16 level
+    declared_axes: set
+    groups_by_axis: Dict[str, set]
+    findings: List[Finding]
+    flagged_group_axes: set = dataclasses.field(default_factory=set)
+
+    def emit(self, rule: str, eqn, msg: str):
+        path, line = _frame_for(eqn, self.path, 0)
+        self.findings.append(Finding(
+            rule, path, line, f"[entry {self.entry}] {msg}"))
+
+
+def _axis_names_of(params: dict) -> Tuple[str, ...]:
+    names = params.get("axes", params.get("axis_name", ()))
+    if isinstance(names, (str,)):
+        names = (names,)
+    return tuple(n for n in (names or ()) if isinstance(n, str))
+
+
+def _normalize_groups(groups) -> Any:
+    if groups is None:
+        return None
+    try:
+        return tuple(tuple(int(i) for i in g) for g in groups)
+    except Exception:
+        return str(groups)
+
+
+def _check_collective(eqn, ctx: _Ctx):
+    for name in _axis_names_of(eqn.params):
+        if ctx.declared_axes and name not in ctx.declared_axes:
+            ctx.emit(
+                "APX103", eqn,
+                f"collective `{eqn.primitive.name}` uses axis "
+                f"{name!r}, which is not an axis of the entry's mesh "
+                f"({sorted(ctx.declared_axes)})")
+        if "axis_index_groups" in eqn.params:
+            g = _normalize_groups(eqn.params["axis_index_groups"])
+            if g is None:
+                # a global collective composes fine with grouped ones on
+                # the same axis (SyncBN subgroups + whole-axis grad psum
+                # is a supported hierarchical pattern) — only *differing
+                # subset partitions* conflict
+                continue
+            seen = ctx.groups_by_axis.setdefault(name, set())
+            seen.add(g)
+            if len(seen) > 1 and name not in ctx.flagged_group_axes:
+                ctx.flagged_group_axes.add(name)
+                ctx.emit(
+                    "APX104", eqn,
+                    f"axis {name!r} is used with {len(seen)} different "
+                    f"axis_index_groups partitions in this entry — "
+                    f"mixing replica subsets on one axis is the "
+                    f"collective analog of mismatched communicators")
+
+
+def _check_dot(eqn, low_env: Dict[Any, bool], ctx: _Ctx):
+    if not ctx.compute_low:
+        return
+    lhs, rhs = eqn.invars[0], eqn.invars[1]
+    avals = [lhs.aval, rhs.aval]
+    if not all(np.issubdtype(getattr(a, "dtype", np.int32), np.floating)
+               or _is_low(a) for a in avals):
+        return   # integer/bool dots are not policy-relevant
+    silent = []
+    for v, a in ((lhs, avals[0]), (rhs, avals[1])):
+        if _is_low(a):
+            continue
+        if _is_f32(a) and not _env_get(low_env, v):
+            silent.append(_dtype_name(a))
+    if silent:
+        ctx.emit(
+            "APX101", eqn,
+            "dot_general consumes a float32 operand with no "
+            "low-precision ancestor under a bf16/fp16 opt level — the "
+            "matmul silently runs fp32 (amp cast bypassed); route the "
+            "tensor through amp.cast_model / the policy compute dtype, "
+            "or upcast explicitly where fp32 is intended")
+
+
+def _check_reduce(eqn, ctx: _Ctx):
+    if not ctx.compute_low:
+        return
+    if eqn.primitive.name not in ("reduce_sum", "cumsum",
+                                  "reduce_window_sum", "reduce"):
+        return
+    if _is_low(eqn.invars[0].aval) and any(
+            _is_low(ov.aval) for ov in eqn.outvars):
+        ctx.emit(
+            "APX102", eqn,
+            f"{eqn.primitive.name} accumulates in "
+            f"{_dtype_name(eqn.invars[0].aval)} — low-precision "
+            "sum-reductions lose mass for long axes; accumulate fp32 "
+            "(sum(x.astype(float32)) or dtype=jnp.float32)")
+
+
+def _check_pallas(eqn, ctx: _Ctx):
+    gm = eqn.params.get("grid_mapping")
+    for bm in getattr(gm, "block_mappings", ()) or ():
+        shape = tuple(getattr(bm, "block_shape", ()) or ())
+        arr = getattr(bm, "array_shape_dtype", None)
+        arr_shape = tuple(getattr(arr, "shape", ()) or ())
+        if (len(shape) < 2
+                or len([s for s in shape if isinstance(s, int)]) < 2):
+            continue    # scalar/SMEM operands have no tiling constraint
+        # block_shape entries pair 1:1 with array dims (None = squeezed
+        # index dim, no tiling constraint); only the trailing two
+        # positions carry the (sublane, lane) tile
+        full_dims = (arr_shape if len(arr_shape) == len(shape)
+                     else (None,) * len(shape))
+        checks = [(-1, 128), (-2, 8)]
+        bad = []
+        for pos, mult in checks:
+            blk, full = shape[pos], full_dims[pos]
+            if not isinstance(blk, int):
+                continue
+            if blk % mult != 0 and blk != full:
+                bad.append(
+                    f"{blk} (dim {pos}: want a multiple of {mult}"
+                    + (f" or the full array dim {full}"
+                       if full is not None else "") + ")")
+        if bad:
+            origin = getattr(bm, "origin", "operand")
+            ctx.emit(
+                "APX105", eqn,
+                f"pallas_call block shape {shape} for {origin} "
+                f"breaks (8, 128) tiling: " + "; ".join(bad))
+
+
+def _inner_jaxprs(eqn):
+    """(inner_jaxpr, outer_operands_or_None) pairs for every sub-jaxpr in
+    an equation's params — pjit/scan/cond/custom-vjp/shard_map/pallas."""
+    pairs = []
+
+    def add(j, operands):
+        if j is None:
+            return
+        inner = getattr(j, "jaxpr", j)          # ClosedJaxpr -> Jaxpr
+        if hasattr(inner, "eqns") and hasattr(inner, "invars"):
+            pairs.append((inner, operands))
+
+    for key, val in eqn.params.items():
+        if key == "branches" and isinstance(val, (tuple, list)):
+            for br in val:
+                add(br, eqn.invars[1:])
+        elif hasattr(val, "eqns") or hasattr(val, "jaxpr"):
+            add(val, eqn.invars)
+        elif isinstance(val, (tuple, list)):
+            for item in val:
+                if hasattr(item, "eqns") or hasattr(item, "jaxpr"):
+                    add(item, None)
+    return pairs
+
+
+def _walk(jaxpr, low_env: Dict[Any, bool], ctx: _Ctx):
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+
+        if prim == "shard_map":
+            mesh = eqn.params.get("mesh")
+            for n in getattr(mesh, "axis_names", ()) or ():
+                ctx.declared_axes.add(n)
+
+        if prim in _COLLECTIVE_PRIMS:
+            _check_collective(eqn, ctx)
+        elif prim == "dot_general":
+            _check_dot(eqn, low_env, ctx)
+        elif prim == "pallas_call":
+            _check_pallas(eqn, ctx)
+        _check_reduce(eqn, ctx)
+
+        # provenance: an output is low-origin if its dtype is low or any
+        # input is low / low-origin
+        in_low = False
+        for v in eqn.invars:
+            aval = getattr(v, "aval", None)
+            if (aval is not None and _is_low(aval)) or _env_get(low_env, v):
+                in_low = True
+                break
+        for ov in eqn.outvars:
+            try:
+                low_env[ov] = in_low or _is_low(getattr(ov, "aval", None))
+            except TypeError:       # DropVar/Literal-like outputs
+                pass
+
+        for inner, operands in _inner_jaxprs(eqn):
+            env: Dict[Any, bool] = {}
+            if operands is not None and len(operands) == len(inner.invars):
+                for outer, iv in zip(operands, inner.invars):
+                    aval = getattr(outer, "aval", None)
+                    env[iv] = _env_get(low_env, outer) or (
+                        aval is not None and _is_low(aval))
+            else:
+                for iv in inner.invars:
+                    env[iv] = _is_low(getattr(iv, "aval", None))
+            _walk(inner, env, ctx)
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class EntrySpec:
+    """A registered lowering target: ``make()`` returns ``(fn, args)``;
+    ``opt_level`` ties the dtype rules to the amp.policy tables;
+    ``mesh_axes`` declares the collectives' legal axis names."""
+    name: str
+    path: str
+    make: Callable[[], Tuple[Callable, tuple]]
+    mesh_axes: Tuple[str, ...] = ()
+    opt_level: Optional[str] = None
+
+
+def check_entry(fn: Callable, args: tuple, *, name: str = "<entry>",
+                path: str = "<jaxpr>", mesh_axes: Sequence[str] = (),
+                opt_level: Optional[str] = None) -> List[Finding]:
+    """Trace ``fn(*args)`` and run the jaxpr rules. Public so tests and
+    downstream projects can lint their own train steps."""
+    from apex_tpu.amp import policy
+
+    compute_low = False
+    if opt_level is not None:
+        props = policy.opt_levels[opt_level]
+        cd = props.compute_dtype
+        compute_low = cd is not None and str(np.dtype(cd)) in _LOW_DTYPES
+
+    ctx = _Ctx(entry=name, path=path, compute_low=compute_low,
+               declared_axes=set(mesh_axes), groups_by_axis={},
+               findings=[])
+    try:
+        closed = jax.make_jaxpr(fn)(*args)
+    except (NameError, ValueError) as e:
+        # unbound axis name: the runtime failure the collective rule
+        # exists to pre-empt — surface it as the lint finding. Two
+        # spellings reach us: jax's own NameError ("unbound axis name:
+        # X") and the ValueError from parallel.mesh.bound_axis_size
+        # ("axis name 'X' is not bound ..."), the runtime twin of this
+        # very rule.
+        msg = str(e)
+        if isinstance(e, NameError) and "unbound axis name" in msg:
+            axis = msg.rsplit(":", 1)[-1].strip()
+        elif isinstance(e, ValueError) and "is not bound" in msg:
+            axis = msg.split("'")[1] if "'" in msg else "<unknown>"
+        else:
+            raise
+        ctx.findings.append(Finding(
+            "APX103", path, 0,
+            f"[entry {name}] tracing failed on unbound collective axis "
+            f"{axis!r} — no enclosing mesh binds it "
+            f"(declared: {sorted(ctx.declared_axes)})"))
+        return ctx.findings
+    env = {v: _is_low(getattr(v, "aval", None))
+           for v in closed.jaxpr.invars}
+    _walk(closed.jaxpr, env, ctx)
+    return ctx.findings
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def builtin_entries() -> List[EntrySpec]:
+    """The repo's registered entry points, built lazily and small enough
+    to trace in seconds on CPU."""
+    import jax.numpy as jnp
+
+    def gpt_o5():
+        from apex_tpu.models import GPTTiny
+        from apex_tpu.models.gpt import next_token_loss
+        toks = jnp.zeros((1, 16), jnp.int32)
+        m = GPTTiny(vocab_size=64, max_seq=16, dtype=jnp.bfloat16)
+        params = m.init(jax.random.PRNGKey(0), toks)["params"]
+
+        def fwd_loss(p, t):
+            return next_token_loss(m.apply({"params": p}, t), t)
+        return fwd_loss, (params, toks)
+
+    def fused_adam():
+        from apex_tpu import optimizers
+        opt = optimizers.FusedAdam(lr=1e-3)
+        p = {"w": jnp.ones((16, 128)), "b": jnp.ones((128,))}
+        st = opt.init(p)
+        return (lambda g, p, s: opt.step(g, p, s)), (p, p, st)
+
+    def ddp_syncbn():
+        from jax.sharding import Mesh, PartitionSpec as P
+        from apex_tpu import models
+        from apex_tpu.parallel import allreduce_gradients
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+        model = models.ResNet18(num_classes=4, axis_name="data")
+        x = jnp.ones((2, 8, 8, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        params, bs = variables["params"], variables["batch_stats"]
+
+        def per_device(p, bs, x):
+            def loss_fn(p):
+                logits, _ = model.apply(
+                    {"params": p, "batch_stats": bs}, x, train=True,
+                    mutable=["batch_stats"])
+                return jnp.mean(logits * logits)
+            g = jax.grad(loss_fn)(p)
+            return allreduce_gradients(g, "data")
+
+        f = jax.shard_map(per_device, mesh=mesh,
+                          in_specs=(P(), P(), P("data")), out_specs=P(),
+                          check_vma=False)
+        return f, (params, bs, x)
+
+    def zero_step():
+        from jax.sharding import Mesh, PartitionSpec as P
+        from apex_tpu.contrib.optimizers import DistributedFusedAdam
+        n = 1
+        mesh = Mesh(np.asarray(jax.devices()[:n]), ("data",))
+        opt = DistributedFusedAdam(lr=1e-3, axis_name="data",
+                                   shard_count=n)
+        p = {"w": jnp.ones((64, 19)), "b": jnp.ones((33,))}
+        st = opt.init(p)
+
+        def per_device(g, p, s):
+            return opt.step(g, p, s)
+
+        f = jax.shard_map(per_device, mesh=mesh,
+                          in_specs=(P(), P(), opt.state_pspec()),
+                          out_specs=(P(), opt.state_pspec()),
+                          check_vma=False)
+        return f, (p, p, st)
+
+    root = _repo_root()
+    entries = [
+        EntrySpec("gpt_tiny_fwd_loss@O5", "apex_tpu/models/gpt.py",
+                  gpt_o5, opt_level="O5"),
+        EntrySpec("fused_adam_step", "apex_tpu/optimizers/fused.py",
+                  fused_adam),
+        EntrySpec("ddp_syncbn_grads", "apex_tpu/parallel/distributed.py",
+                  ddp_syncbn, mesh_axes=("data",)),
+        EntrySpec("zero_adam_step", "apex_tpu/contrib/optimizers/zero.py",
+                  zero_step, mesh_axes=("data",)),
+    ]
+
+    graft = os.path.join(root, "__graft_entry__.py")
+    if os.path.exists(graft):
+        def graft_entry():
+            import sys
+            if root not in sys.path:
+                sys.path.insert(0, root)
+            import __graft_entry__ as ge
+            return ge.entry()
+        entries.append(EntrySpec("__graft_entry__.entry",
+                                 "__graft_entry__.py", graft_entry))
+    return entries
+
+
+def run_entries(entries: Optional[Sequence[EntrySpec]] = None
+                ) -> List[Finding]:
+    """Lower every registered entry and collect jaxpr findings. A broken
+    entry fails loudly (with the entry name) rather than being skipped —
+    an unlowerable train step is exactly what the gate must catch."""
+    findings: List[Finding] = []
+    for spec in builtin_entries() if entries is None else entries:
+        try:
+            fn, args = spec.make()
+        except Exception as e:    # pragma: no cover - defensive
+            raise RuntimeError(
+                f"apexlint entry {spec.name!r} failed to build: {e}"
+            ) from e
+        findings.extend(check_entry(
+            fn, args, name=spec.name, path=spec.path,
+            mesh_axes=spec.mesh_axes, opt_level=spec.opt_level))
+    return findings

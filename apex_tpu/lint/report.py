@@ -1,0 +1,102 @@
+"""Findings, suppression handling, and output formatting for apex_tpu.lint.
+
+Suppression syntax (same line as the finding)::
+
+    x = s.astype(jnp.bfloat16)  # apexlint: disable=APX005 -- Mosaic shim
+
+``disable=`` takes a comma list of rule IDs or ``all``. A file is opted
+out wholesale with ``# apexlint: disable-file=APX005`` (or ``all``) in its
+first 10 lines. Suppressions are counted and reported so a blanket
+disable can't silently rot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+from apex_tpu.lint.rules import ERROR, RULES
+
+_LINE_RE = re.compile(r"#\s*apexlint:\s*disable=([A-Za-z0-9,\s]+)")
+_FILE_RE = re.compile(r"#\s*apexlint:\s*disable-file=([A-Za-z0-9,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule_id: str
+    path: str            # repo-relative where possible
+    line: int            # 1-based; 0 = whole-file / entry-level
+    message: str
+
+    @property
+    def severity(self) -> str:
+        return RULES[self.rule_id].severity
+
+    def format(self, fmt: str = "text") -> str:
+        rule = RULES[self.rule_id]
+        if fmt == "github":
+            kind = "error" if rule.severity == ERROR else "warning"
+            return (f"::{kind} file={self.path},line={max(self.line, 1)},"
+                    f"title={self.rule_id} {rule.name}::{self.message}")
+        return (f"{self.path}:{self.line}: {self.rule_id} "
+                f"[{rule.severity}] {self.message}")
+
+
+def _ids(match_text: str) -> set:
+    return {t.strip().upper() for t in match_text.split(",") if t.strip()}
+
+
+def suppressed_ids_for_line(source_lines: Sequence[str], line: int) -> set:
+    """Rule IDs suppressed on 1-based ``line`` (plus file-level ones)."""
+    ids: set = set()
+    for probe in source_lines[:10]:
+        m = _FILE_RE.search(probe)
+        if m:
+            ids |= _ids(m.group(1))
+    if 1 <= line <= len(source_lines):
+        m = _LINE_RE.search(source_lines[line - 1])
+        if m:
+            ids |= _ids(m.group(1))
+    return ids
+
+
+def apply_suppressions(
+    findings: Iterable[Finding],
+    sources: Dict[str, Sequence[str]],
+) -> Tuple[List[Finding], List[Finding]]:
+    """Split findings into (active, suppressed) using per-file source
+    lines (``sources`` maps finding.path -> list of lines)."""
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        lines = sources.get(f.path)
+        if lines is None:
+            active.append(f)
+            continue
+        ids = suppressed_ids_for_line(lines, f.line)
+        if "ALL" in ids or f.rule_id in ids:
+            suppressed.append(f)
+        else:
+            active.append(f)
+    return active, suppressed
+
+
+def render(findings: Sequence[Finding], suppressed: Sequence[Finding],
+           fmt: str = "text") -> str:
+    out = [f.format(fmt) for f in sorted(
+        findings, key=lambda f: (f.path, f.line, f.rule_id))]
+    n_err = sum(1 for f in findings if f.severity == ERROR)
+    n_warn = len(findings) - n_err
+    if fmt != "github":
+        out.append(f"apexlint: {n_err} error(s), {n_warn} warning(s), "
+                   f"{len(suppressed)} suppressed")
+    return "\n".join(out)
+
+
+def exit_code(findings: Sequence[Finding], strict: bool = False) -> int:
+    if any(f.severity == ERROR for f in findings):
+        return 1
+    if strict and findings:
+        return 1
+    return 0

@@ -1,0 +1,68 @@
+"""Rule catalog for ``apex_tpu.lint``.
+
+Every rule carries a stable ID (``APX0xx`` = source/AST pass, ``APX1xx`` =
+jaxpr pass), a severity, and a one-line summary. IDs are append-only: a
+rule may be retired (kept here, marked retired) but its ID is never
+reused — suppression comments in user code reference them.
+
+See ``docs/lint.md`` for the full catalog with TPU rationale and examples.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+ERROR = "error"
+WARNING = "warning"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    severity: str
+    summary: str
+
+
+_RULES = [
+    # ---- AST pass (source-level trace hazards) ----------------------------
+    Rule("APX000", "parse-error", ERROR,
+         "file does not parse — nothing else can be checked"),
+    Rule("APX001", "trace-control-flow", ERROR,
+         "Python if/while on a traced jax/jnp expression inside traced "
+         "code — use lax.cond / lax.while_loop / jnp.where"),
+    Rule("APX002", "trace-concretization", ERROR,
+         "concretization of a traced value (.item(), float()/int()/bool() "
+         "or np.asarray on a traced argument) inside traced code"),
+    Rule("APX003", "trace-impure-state", ERROR,
+         "Python-side RNG / wall-clock / mutable global state inside "
+         "traced code — it bakes into the trace at compile time"),
+    Rule("APX004", "jit-missing-donation", WARNING,
+         "train-step jax.jit without donate_argnums/donate_argnames — "
+         "params+optimizer state double-buffer in HBM"),
+    Rule("APX005", "hardcoded-dtype-literal", WARNING,
+         "hardcoded low-precision dtype literal outside amp/ — compute "
+         "dtypes should route through the amp.policy opt-level tables"),
+    # ---- jaxpr pass (lowered entry points) --------------------------------
+    Rule("APX101", "policy-fp32-matmul", ERROR,
+         "matmul runs with silently-fp32 operands in a bf16/fp16 "
+         "opt-level entry — activations/params bypassed the amp policy"),
+    Rule("APX102", "low-precision-accumulation", ERROR,
+         "sum-reduction accumulates in bf16/fp16 — reductions in a "
+         "low-precision entry must accumulate fp32"),
+    Rule("APX103", "collective-unknown-axis", ERROR,
+         "collective uses an axis name absent from the entry's mesh "
+         "(multi-host hang / opaque unbound-axis failure at run time)"),
+    Rule("APX104", "collective-groups-mismatch", ERROR,
+         "the same mesh axis is used with inconsistent axis_index_groups "
+         "within one entry — replica-subset collectives can deadlock"),
+    Rule("APX105", "pallas-block-misalignment", ERROR,
+         "Pallas block shape violates TPU (8, 128) tiling: the last two "
+         "block dims must be multiples of (8, 128) or span the array"),
+]
+
+RULES: Dict[str, Rule] = {r.id: r for r in _RULES}
+
+AST_RULE_IDS = tuple(r.id for r in _RULES if r.id.startswith("APX0"))
+JAXPR_RULE_IDS = tuple(r.id for r in _RULES if r.id.startswith("APX1"))

@@ -23,6 +23,7 @@ import flax.linen as nn
 
 from apex_tpu.contrib.multihead_attn import SelfMultiheadAttn
 from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.parallel.mesh import bound_axis_size
 
 
 class Block(nn.Module):
@@ -277,7 +278,7 @@ def _shifted_targets(tokens, axis_name: Optional[str]):
         valid = jnp.broadcast_to(
             jnp.where(col == s_loc - 1, 0.0, 1.0)[None, :], (b, s_loc))
         return targets, valid, jnp.asarray(b * (s_loc - 1), jnp.float32)
-    world = jax.lax.axis_size(axis_name)
+    world = bound_axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     # device r receives the first token of shard r+1 (source r+1 -> dest r)
     perm = [((j + 1) % world, j) for j in range(world)]

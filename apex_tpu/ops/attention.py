@@ -47,6 +47,12 @@ def _interpret() -> bool:
     return jax.default_backend() not in ("tpu", "axon")
 
 
+def _axis_size(axis_name):
+    # lazy import: ops loads before parallel in the package __init__
+    from apex_tpu.parallel.mesh import bound_axis_size
+    return bound_axis_size(axis_name)
+
+
 def _pad3(x, s_to, d_to):
     """Pad (bh, seq, d) to (bh, s_to, d_to)."""
     return jnp.pad(x, ((0, 0), (0, s_to - x.shape[1]),
@@ -1039,11 +1045,16 @@ def flash_attention(q, k, v, causal: bool = False,
     # cotangents the same way (the fp16 analog of multi_tensor's
     # fp16-routes-to-jnp policy; interpret mode runs f16 natively).
     if q.dtype == jnp.float16 and not _interpret():
+        # apexlint: the casts below do not BYPASS the amp policy — they
+        # IMPLEMENT it for the f16 levels on a backend with no f16 MXU
+        # path; the target dtype is fixed by hardware, not a policy knob.
         out = _flash_attention_core(
-            q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
-            v.astype(jnp.bfloat16), bias_arr, seed, causal, scale, rate,
+            q.astype(jnp.bfloat16),  # apexlint: disable=APX005 -- Mosaic f16 shim
+            k.astype(jnp.bfloat16),  # apexlint: disable=APX005 -- Mosaic f16 shim
+            v.astype(jnp.bfloat16),  # apexlint: disable=APX005 -- Mosaic f16 shim
+            bias_arr, seed, causal, scale, rate,
             has_bias, bias_grad)
-        return out.astype(jnp.float16)
+        return out.astype(jnp.float16)  # apexlint: disable=APX005 -- back to caller dtype
     return _flash_attention_core(q, k, v, bias_arr, seed, causal, scale,
                                  rate, has_bias, bias_grad)
 
@@ -1313,7 +1324,7 @@ def _ring_flash_fwd(q, k, v, bias, axis_name, causal, scale):
     never materializes), partials merge via stable lse arithmetic. Peak
     per-device memory is O(B·H·S_loc·D), the long-context point of ring
     attention, now without a dense inner step (VERDICT r1 weak #7)."""
-    world = jax.lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     b, h, s_loc, _ = q.shape
 
@@ -1375,7 +1386,7 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, has_bias, bias_grad,
     q, k, v, bias, o, lse = res
     bias_arr = bias
     bias = bias if has_bias else None
-    world = jax.lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     b, h, s_loc, _ = q.shape
     want_db = bias_grad and has_bias
@@ -1496,7 +1507,7 @@ def ring_self_attention(q, k, v, axis_name: str, *, causal: bool = False,
     backward); ``'default'`` runs the dense jnp chunk path; ``'auto'``
     picks flash on TPU.
     """
-    world = jax.lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape
     scale_ = (1.0 / math.sqrt(d)) if scale is None else scale
@@ -1522,11 +1533,14 @@ def ring_self_attention(q, k, v, axis_name: str, *, causal: bool = False,
             bias_arr = jnp.zeros((1, 1, 1, 1), jnp.float32)
         if q.dtype == jnp.float16 and not _interpret():
             # Mosaic has no f16 — bf16 reroute, see flash_attention
+            # (hardware-fixed target dtype, not a policy bypass)
             o = _ring_flash_core(
-                q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
-                v.astype(jnp.bfloat16), bias_arr, axis_name, causal,
+                q.astype(jnp.bfloat16),  # apexlint: disable=APX005 -- Mosaic f16 shim
+                k.astype(jnp.bfloat16),  # apexlint: disable=APX005 -- Mosaic f16 shim
+                v.astype(jnp.bfloat16),  # apexlint: disable=APX005 -- Mosaic f16 shim
+                bias_arr, axis_name, causal,
                 scale_, has_bias, bias_grad)
-            return o.astype(jnp.float16)
+            return o.astype(jnp.float16)  # apexlint: disable=APX005 -- back to caller dtype
         return _ring_flash_core(q, k, v, bias_arr, axis_name, causal,
                                 scale_, has_bias, bias_grad)
 
@@ -1589,7 +1603,7 @@ def ulysses_self_attention(q, k, v, axis_name: str, *,
 
     Shapes (per device): (B, H, S_local, D) -> (B, H, S_local, D).
     """
-    world = jax.lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     h = q.shape[1]
     if h % world != 0:
         raise ValueError(

@@ -3,6 +3,7 @@ apex/parallel/). DP gradient sync, SyncBatchNorm, LARC, mesh helpers."""
 
 from apex_tpu.parallel.mesh import (
     make_mesh, data_parallel_mesh, subgroups, init_distributed, hybrid_mesh,
+    require_axis, bound_axis_size,
 )
 from apex_tpu.parallel.distributed import (
     allreduce_gradients,

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from apex_tpu.ops import buckets as _buckets
+from apex_tpu.parallel.mesh import bound_axis_size, require_axis
 
 Tree = Any
 
@@ -69,7 +70,7 @@ def allreduce_gradients(
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if not leaves:
         return grads
-    world = jax.lax.axis_size(axis_name)
+    world = bound_axis_size(axis_name)
 
     predivide = gradient_predivide_factor if gradient_average else 1.0
     postdivide = (world / gradient_predivide_factor
@@ -191,6 +192,7 @@ def ddp_train_step(
     """
     from jax import shard_map
 
+    require_axis(mesh, axis_name)   # fail here, not deep inside tracing
     ddp = ddp or DistributedDataParallel(axis_name)
 
     def per_device(params, opt_state, batch):

@@ -17,6 +17,37 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def require_axis(mesh: Mesh, *axis_names: str) -> None:
+    """Validate that every name in ``axis_names`` is an axis of ``mesh``,
+    raising a ``ValueError`` that names the offender and the available
+    axes — the runtime twin of the APX103 lint rule. Without this, a
+    mistyped axis name surfaces as an opaque unbound-axis failure deep in
+    XLA tracing (or, on multi-host, a hang)."""
+    available = tuple(getattr(mesh, "axis_names", ()) or ())
+    for name in axis_names:
+        if name not in available:
+            raise ValueError(
+                f"axis name {name!r} is not an axis of the mesh; "
+                f"available axes: {available}")
+
+
+def bound_axis_size(axis_name: str) -> int:
+    """Size of the named mesh axis bound in the current trace context
+    (shard_map / pmap body). Raises ``ValueError`` naming the offending
+    axis when it is not bound — the trace-time twin of
+    :func:`require_axis` for collective helpers that never see the Mesh
+    object, replacing the opaque ``NameError: unbound axis name`` from
+    deep inside tracing."""
+    try:
+        return jax.lax.axis_size(axis_name)
+    except NameError as e:
+        raise ValueError(
+            f"axis name {axis_name!r} is not bound in this trace "
+            "context — collectives must run inside shard_map/pmap over "
+            "a mesh that names this axis (check the axis_name= argument "
+            "against the mesh's axis_names)") from e
+
+
 def make_mesh(axis_sizes: Optional[Sequence[int]] = None,
               axis_names: Sequence[str] = ("data",),
               devices=None) -> Mesh:

@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from apex_tpu.parallel.mesh import bound_axis_size
+
 Tree = Any
 
 
@@ -53,7 +55,7 @@ def pipeline_apply(stage_apply: Callable[[Tree, jax.Array], jax.Array],
     on EVERY device (psum-broadcast off the last stage so the caller's
     loss runs replicated). Differentiable end-to-end.
     """
-    world = jax.lax.axis_size(axis_name)
+    world = bound_axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     m = microbatches.shape[0]
     ticks = m + world - 1

@@ -29,6 +29,7 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
+import apex_tpu._compat  # noqa: F401  (jax version shims: jax.shard_map)
 from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 

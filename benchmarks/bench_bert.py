@@ -21,11 +21,13 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+import apex_tpu._compat  # noqa: E402,F401  (jax version shims)
+from jax import shard_map  # noqa: E402
 
 
 def main():

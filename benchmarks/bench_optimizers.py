@@ -295,6 +295,7 @@ def bench_zero_marshalling(iters: int):
     copy with its no-copy allgather views, distributed_fused_adam.py:
     392-407). Both paths derive grads from params in-scan with the same
     elementwise pass, so that cost cancels in the comparison."""
+    import apex_tpu._compat  # noqa: F401  (jax.shard_map on older jax)
     from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -12,7 +12,7 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "== 1/5 package import =="
+echo "== 1/6 package import =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import apex_tpu
@@ -20,7 +20,7 @@ from apex_tpu import amp, optimizers, parallel, ops
 print('apex_tpu imports OK')
 "
 
-echo "== 2/5 native host runtime builds (g++ -O3 -shared) =="
+echo "== 2/6 native host runtime builds (g++ -O3 -shared) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 from apex_tpu import runtime
@@ -35,7 +35,7 @@ print('flatten/unflatten path OK')
 assert ok, 'host runtime failed to build — check g++ toolchain'
 "
 
-echo "== 3/5 graft entry compiles (single-device + 8-device dryrun) =="
+echo "== 3/6 graft entry compiles (single-device + 8-device dryrun) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import __graft_entry__ as ge
@@ -45,7 +45,7 @@ print('entry() compiles')
 ge.dryrun_multichip(8)
 "
 
-echo "== 4/5 package install (wheel build + clean --target install) =="
+echo "== 4/6 package install (wheel build + clean --target install) =="
 # The reference gates on Docker extension builds
 # (tests/docker_extension_builds/run.sh); the TPU analog: build the wheel
 # from pyproject.toml, install it into an empty --target dir, and import
@@ -88,7 +88,14 @@ jax.jit(step).lower(params, state).compile()
 print('installed-package train step compiles')
 ")
 
-echo "== 5/5 pytest =="
+echo "== 5/6 lint (apex_tpu.lint: trace safety / dtype policy / collectives) =="
+# static gate BEFORE the test tier: AST pass over the package + graft
+# entry, jaxpr pass over the registered entry points. --strict: warnings
+# fail too (every intentional exception carries an inline suppression
+# with its why — see docs/lint.md). Use --format=github under CI bots.
+python -m apex_tpu.lint apex_tpu/ __graft_entry__.py --strict
+
+echo "== 6/6 pytest =="
 if [[ "${1:-}" == "--full" ]]; then
     # full suite + the complete L1 cross-product matrix (reference
     # tests/L1/cross_product{,_distributed}/run.sh); the convergence

@@ -20,13 +20,13 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))))
 
 from apex_tpu import amp, optimizers, parallel
+from jax import shard_map  # noqa: E402 (needs apex_tpu's jax version shims)
 from apex_tpu.models import TransformerLM
 from apex_tpu.models.gpt import chunked_next_token_loss, next_token_loss
 

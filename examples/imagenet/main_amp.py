@@ -25,7 +25,6 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 # allow running this file directly: put the repo root on sys.path
@@ -35,6 +34,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))))
 
 from apex_tpu import amp, optimizers, parallel
+from jax import shard_map  # noqa: E402 (needs apex_tpu's jax version shims)
 from apex_tpu import models
 from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
 

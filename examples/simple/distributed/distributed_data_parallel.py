@@ -8,10 +8,10 @@ Run: python examples/simple/distributed/distributed_data_parallel.py
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from apex_tpu import optimizers, parallel
+from jax import shard_map  # noqa: E402 (needs apex_tpu's jax version shims)
 
 
 def main():

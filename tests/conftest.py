@@ -22,3 +22,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+# Install the jax version-compat shims (jax.shard_map / lax.axis_size on
+# older releases) BEFORE any test module runs its `from jax import
+# shard_map` import. conftest is imported first, so this is the one place
+# that guarantees the ordering for the whole suite.
+import apex_tpu  # noqa: E402,F401
+
+# markers (slow, apexlint) are registered in pyproject.toml
+# [tool.pytest.ini_options] — the single source of truth

@@ -21,6 +21,11 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+# standalone process: conftest never runs here, so install the jax
+# version shims (jax.shard_map / lax.axis_size on older releases) before
+# any `from jax import shard_map` below
+import apex_tpu._compat  # noqa: E402,F401
+
 
 def local_zero_state(opt, params, rank, n_shards):
     """Build device ``rank``'s local ZeRO state shard IN-GRAPH from the

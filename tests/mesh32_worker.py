@@ -25,6 +25,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 def main():
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # standalone process (no conftest): jax version shims for the
+    # `from jax import shard_map` import below
+    import apex_tpu._compat  # noqa: F401
     import jax.numpy as jnp
     import numpy as np
     from jax import shard_map

@@ -247,6 +247,7 @@ def test_ulysses_head_count_check(mesh):
             out_specs=P(None, None, "seq", None), check_vma=False))(q)
 
 
+@pytest.mark.slow  # full bwd parity matrix; fwd parity stays in tier-1
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("sq,sk", [(128, 128), (200, 200), (128, 384),
                                    (96, 160)])
@@ -363,6 +364,7 @@ def test_self_mha_fast_dropout_trains():
 # Fused additive-mask / bias (reference *_bias_additive_mask kernels)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # full bias-broadcast matrix (see tier-1 budget note)
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("shape", [(2, 4, 128, 128), (2, 1, 1, 128),
                                    (1, 4, 128, 128), (1, 1, 1, 128)])
@@ -440,6 +442,7 @@ def test_flash_bwd_two_pass_fallback_matches_reference(monkeypatch):
                                    rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.slow  # segmented-backward matrix (see tier-1 budget note)
 @pytest.mark.parametrize("causal,sq,sk", [
     (True, 640, 640),     # 256-row segments, causal column trimming
     (False, 640, 640),    # non-causal: every segment sees all keys
@@ -659,6 +662,7 @@ def test_ring_flash_masked(mesh):
 # Trainable (learned) score bias: dbias emission from the flash backward
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # dbias-emission matrix (see tier-1 budget note)
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("shape", [(2, 4, 128, 128), (1, 4, 1, 128),
                                    (2, 1, 128, 128), (1, 1, 1, 128)])

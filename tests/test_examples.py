@@ -9,6 +9,12 @@ import sys
 
 import pytest
 
+# Integration tier (PR 1): this whole module rides `-m slow` — full example-trainer smokes (minutes each).
+# Tier-1 (-m 'not slow') must fit the 870 s gate budget; the fast cross-
+# sections of this stack stay in tier-1 via test_zero/test_parallel/
+# test_param_groups/test_attention and the ci/gate.sh dryrun parts.
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

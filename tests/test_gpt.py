@@ -16,6 +16,12 @@ from apex_tpu import parallel
 from apex_tpu.models import GPTTiny
 from apex_tpu.models.gpt import next_token_loss
 
+# Integration tier (PR 1): this whole module rides `-m slow` — end-to-end LM numerics (decode/seq-parallel parity).
+# Tier-1 (-m 'not slow') must fit the 870 s gate budget; the fast cross-
+# sections of this stack stay in tier-1 via test_zero/test_parallel/
+# test_param_groups/test_attention and the ci/gate.sh dryrun parts.
+pytestmark = pytest.mark.slow
+
 NDEV = 8
 
 

@@ -30,6 +30,12 @@ from apex_tpu.models.resnet import ResNet18
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+# Integration tier (PR 1): this whole module rides `-m slow` — L1 convergence cross-product matrix.
+# Tier-1 (-m 'not slow') must fit the 870 s gate budget; the fast cross-
+# sections of this stack stay in tier-1 via test_zero/test_parallel/
+# test_param_groups/test_attention and the ci/gate.sh dryrun parts.
+pytestmark = pytest.mark.slow
+
 STEPS = 6
 BATCH = 8          # global batch, split over devices in the DDP variant
 NUM_CLASSES = 10

@@ -1,0 +1,399 @@
+"""apex_tpu.lint — rule-by-rule fixtures (each bad snippet fires exactly
+its one rule; its corrected twin is silent), suppression handling, output
+formats, the mesh axis-validation runtime twins, and the repo-wide gate
+(`pytest -m apexlint` runs just that last one — the same check the CI
+gate runs as `python -m apex_tpu.lint apex_tpu/ --strict`)."""
+
+import os
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.lint import check_entry, check_source
+from apex_tpu.lint import main as lint_main
+from apex_tpu.lint import run as lint_run
+from apex_tpu.lint.report import Finding, exit_code, render
+from apex_tpu.lint.rules import RULES
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def ast_ids(src):
+    return sorted({f.rule_id
+                   for f in check_source("fx.py", textwrap.dedent(src))})
+
+
+# ---------------------------------------------------------------------------
+# AST rules: bad fixture fires exactly one rule; corrected twin is clean
+# ---------------------------------------------------------------------------
+
+AST_CASES = [
+    ("APX001", """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            if jnp.any(x > 0):
+                return x
+            return -x
+     """, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return jnp.where(jnp.any(x > 0), x, -x)
+     """),
+    ("APX002", """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x) * 2.0
+     """, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return x.astype(jnp.float32) * 2.0
+     """),
+    ("APX003", """
+        import jax
+        import random
+
+        @jax.jit
+        def f(x):
+            return x * random.random()
+     """, """
+        import jax
+
+        @jax.jit
+        def f(x, key):
+            return x * jax.random.uniform(key)
+     """),
+    ("APX004", """
+        import jax
+
+        def train_step(params, state, grads):
+            return params, state
+
+        step = jax.jit(train_step)
+     """, """
+        import jax
+
+        def train_step(params, state, grads):
+            return params, state
+
+        step = jax.jit(train_step, donate_argnums=(0, 1))
+     """),
+    ("APX005", """
+        import jax.numpy as jnp
+
+        def fwd(x):
+            return x.astype(jnp.bfloat16)
+     """, """
+        import jax.numpy as jnp
+        from apex_tpu.amp import policy
+
+        def fwd(x, props):
+            return x.astype(props.compute_dtype)
+     """),
+]
+
+
+@pytest.mark.parametrize("rule,bad,good",
+                         AST_CASES, ids=[c[0] for c in AST_CASES])
+def test_ast_rule_fires_and_twin_is_silent(rule, bad, good):
+    assert ast_ids(bad) == [rule]
+    assert ast_ids(good) == []
+
+
+def test_ast_traced_context_via_shard_map_and_pallas():
+    # functions reached through shard_map / pallas_call (not only @jit
+    # decorators) are traced contexts too
+    src = """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def step(x):
+            if jnp.any(x > 0):
+                return x
+            return -x
+
+        f = jax.shard_map(step, mesh=None, in_specs=(), out_specs=())
+
+        def kernel(x_ref, o_ref):
+            o_ref[:] = x_ref[:].item()
+
+        g = pl.pallas_call(kernel, out_shape=None)
+    """
+    assert ast_ids(src) == ["APX001", "APX002"]
+
+
+def test_ast_python_scalar_control_flow_is_fine():
+    # Python-bool kwargs driving branches (the kernels' `if causal:`
+    # idiom) must NOT fire APX001
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x, causal=True):
+            if causal:
+                return x
+            return -x
+    """
+    assert ast_ids(src) == []
+
+
+def test_ast_global_statement_fires_apx003():
+    src = """
+        import jax
+
+        _calls = 0
+
+        @jax.jit
+        def f(x):
+            global _calls
+            _calls += 1
+            return x
+    """
+    assert ast_ids(src) == ["APX003"]
+
+
+# ---------------------------------------------------------------------------
+# jaxpr rules
+# ---------------------------------------------------------------------------
+
+def test_jaxpr_apx101_fp32_matmul_under_bf16_policy():
+    p32 = jnp.ones((8, 8), jnp.float32)
+    x16 = jnp.ones((4, 8), jnp.bfloat16)
+
+    def bad(p, x):
+        return x @ p            # p never saw the amp cast -> silent fp32
+
+    def good(p, x):
+        return x @ p.astype(jnp.bfloat16)
+
+    ids = {f.rule_id for f in check_entry(bad, (p32, x16), opt_level="O5")}
+    assert ids == {"APX101"}
+    assert check_entry(good, (p32, x16), opt_level="O5") == []
+    # fp32 is the POLICY at O0: the same program is clean there
+    assert check_entry(bad, (p32, x16), opt_level="O0") == []
+
+
+def test_jaxpr_apx101_explicit_fp32_island_is_intended():
+    # both operands explicitly upcast from bf16 (fp32-softmax idiom):
+    # that is the policy's own fp32 island, not a bypass
+    x16 = jnp.ones((4, 8), jnp.bfloat16)
+
+    def f(x):
+        x32 = x.astype(jnp.float32)
+        return x32 @ x32.T
+
+    assert check_entry(f, (x16,), opt_level="O5") == []
+
+
+def test_jaxpr_apx102_bf16_accumulation():
+    # NB jnp.sum already upcasts float16/bfloat16 accumulators itself;
+    # the hazard is raw lax reductions and scans that keep the carry low
+    x16 = jnp.ones((128,), jnp.bfloat16)
+
+    def bad(x):
+        return jnp.cumsum(x)[-1]
+
+    def good(x):
+        return jnp.cumsum(x.astype(jnp.float32))[-1]
+
+    ids = {f.rule_id for f in check_entry(bad, (x16,), opt_level="O5")}
+    assert ids == {"APX102"}
+    assert check_entry(good, (x16,), opt_level="O5") == []
+
+
+def _smap(fn, mesh):
+    from jax.sharding import PartitionSpec as P
+    return jax.shard_map(fn, mesh=mesh, in_specs=(P(),),
+                         out_specs=P(), check_vma=False)
+
+
+def test_jaxpr_apx103_unknown_collective_axis():
+    from jax.sharding import Mesh
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+    x = jnp.ones((4,))
+
+    def bad(x):
+        return jax.lax.psum(x, "dp")      # mesh names it "data"
+
+    def good(x):
+        return jax.lax.psum(x, "data")
+
+    ids = {f.rule_id for f in check_entry(
+        _smap(bad, mesh), (x,), mesh_axes=("data",))}
+    assert ids == {"APX103"}
+    assert check_entry(_smap(good, mesh), (x,),
+                       mesh_axes=("data",)) == []
+
+
+def test_jaxpr_apx104_inconsistent_axis_index_groups():
+    from jax.sharding import Mesh
+    n = 2
+    assert len(jax.devices()) >= n    # conftest forces an 8-device mesh
+    mesh = Mesh(np.asarray(jax.devices()[:n]), ("data",))
+    pairs = [list(range(n))]          # one group spanning the axis
+    singles = [[i] for i in range(n)]  # per-device singleton groups
+    x = jnp.ones((4,))
+
+    def bad(x):
+        a = jax.lax.psum(x, "data", axis_index_groups=pairs)
+        b = jax.lax.psum(x, "data", axis_index_groups=singles)
+        return a + b
+
+    def good(x):
+        # grouped + GLOBAL on one axis is the supported hierarchical
+        # pattern (SyncBN subgroups + whole-axis grad psum): no finding
+        a = jax.lax.psum(x, "data", axis_index_groups=pairs)
+        b = jax.lax.psum(x * 2, "data", axis_index_groups=pairs)
+        return a + b + jax.lax.psum(x, "data")
+
+    findings = check_entry(_smap(bad, mesh), (x,), mesh_axes=("data",))
+    assert {f.rule_id for f in findings} == {"APX104"}
+    assert len(findings) == 1         # one finding per axis, not per eqn
+    assert check_entry(_smap(good, mesh), (x,),
+                       mesh_axes=("data",)) == []
+
+
+def test_jaxpr_apx105_pallas_block_misalignment():
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, o_ref):
+        o_ref[:] = x_ref[:] * 2
+
+    def call(block):
+        return lambda x: pl.pallas_call(
+            kernel, grid=(2,),
+            in_specs=[pl.BlockSpec(block, lambda i: (i, 0))],
+            out_specs=pl.BlockSpec(block, lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((8, 256), jnp.float32),
+            interpret=True)(x)
+
+    x = jnp.ones((8, 256))
+    ids = {f.rule_id for f in check_entry(call((4, 100)), (x,))}
+    assert ids == {"APX105"}
+    assert check_entry(call((8, 128)), (x,)) == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions / formats / CLI plumbing
+# ---------------------------------------------------------------------------
+
+def test_suppression_comment_silences_finding(tmp_path):
+    bad = "import jax.numpy as jnp\ny = jnp.zeros((4,), jnp.bfloat16)\n"
+    sup = ("import jax.numpy as jnp\n"
+           "y = jnp.zeros((4,), jnp.bfloat16)"
+           "  # apexlint: disable=APX005 -- test fixture\n")
+    (tmp_path / "bad.py").write_text(bad)
+    (tmp_path / "sup.py").write_text(sup)
+
+    active, suppressed = lint_run([str(tmp_path / "bad.py")], jaxpr=False)
+    assert [f.rule_id for f in active] == ["APX005"] and not suppressed
+
+    active, suppressed = lint_run([str(tmp_path / "sup.py")], jaxpr=False)
+    assert not active
+    assert [f.rule_id for f in suppressed] == ["APX005"]
+
+
+def test_clean_file_has_no_findings(tmp_path):
+    clean = textwrap.dedent("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(params, grads):
+            return jax.tree_util.tree_map(
+                lambda p, g: p - 0.1 * g, params, grads)
+    """)
+    (tmp_path / "clean.py").write_text(clean)
+    active, suppressed = lint_run([str(tmp_path / "clean.py")],
+                                  jaxpr=False)
+    assert not active and not suppressed
+
+
+def test_github_format_and_exit_codes():
+    err = Finding("APX101", "a.py", 3, "boom")
+    warn = Finding("APX005", "a.py", 7, "meh")
+    out = render([err, warn], [], fmt="github")
+    assert "::error file=a.py,line=3" in out
+    assert "::warning file=a.py,line=7" in out
+    assert exit_code([warn]) == 0           # warnings pass by default
+    assert exit_code([warn], strict=True) == 1
+    assert exit_code([err]) == 1            # errors always fail
+
+
+def test_cli_list_rules(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in RULES:
+        assert rid in out
+
+
+# ---------------------------------------------------------------------------
+# mesh axis validation — the runtime twin of APX103
+# ---------------------------------------------------------------------------
+
+def test_require_axis_names_offender():
+    from jax.sharding import Mesh
+    from apex_tpu.parallel import require_axis
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+    require_axis(mesh, "data")              # fine
+    with pytest.raises(ValueError, match=r"'dp'.*\('data',\)"):
+        require_axis(mesh, "dp")
+
+
+def test_bound_axis_size_clear_error():
+    from jax.sharding import Mesh, PartitionSpec as P
+    from apex_tpu.parallel import allreduce_gradients, bound_axis_size
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+
+    def ok(x):
+        return jnp.float32(bound_axis_size("data")) * x
+
+    out = jax.shard_map(ok, mesh=mesh, in_specs=(P(),), out_specs=P(),
+                        check_vma=False)(jnp.ones((2,)))
+    assert out.tolist() == [1.0, 1.0]
+
+    def bad(x):
+        return allreduce_gradients(x, "bogus")
+
+    with pytest.raises(ValueError, match="'bogus' is not bound"):
+        jax.make_jaxpr(jax.shard_map(
+            bad, mesh=mesh, in_specs=(P(),), out_specs=P(),
+            check_vma=False))(jnp.ones((2,)))
+
+
+def test_ddp_train_step_validates_mesh_axis():
+    from jax.sharding import Mesh
+    from apex_tpu import optimizers
+    from apex_tpu.parallel import ddp_train_step
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+    with pytest.raises(ValueError, match="'dp' is not an axis"):
+        ddp_train_step(lambda p, b: jnp.sum(p * b),
+                       optimizers.FusedAdam(), mesh, axis_name="dp")
+
+
+# ---------------------------------------------------------------------------
+# the repo-wide gate (this is what `pytest -m apexlint` selects, and the
+# same invocation ci/gate.sh runs)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.apexlint
+def test_repo_lint_clean():
+    rc = lint_main([os.path.join(REPO, "apex_tpu"),
+                    os.path.join(REPO, "__graft_entry__.py"),
+                    "--strict"])
+    assert rc == 0

@@ -18,6 +18,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from apex_tpu.parallel.expert_parallel import (
     MoEMLP, lm_moe_pspecs, moe_aux_total, moe_sync_grads, top_k_routing)
 
+# Integration tier (PR 1): this whole module rides `-m slow` — expert-parallel integration numerics.
+# Tier-1 (-m 'not slow') must fit the 870 s gate budget; the fast cross-
+# sections of this stack stay in tier-1 via test_zero/test_parallel/
+# test_param_groups/test_attention and the ci/gate.sh dryrun parts.
+pytestmark = pytest.mark.slow
+
 
 # ---------------------------------------------------------------------------
 # 1. routing

@@ -21,6 +21,12 @@ from apex_tpu.parallel.pipeline import (lm_stack_blocks,
                                         pipeline_apply, psum_input_grads,
                                         stacked_block_pspecs)
 
+# Integration tier (PR 1): this whole module rides `-m slow` — GPipe dense-parity integration.
+# Tier-1 (-m 'not slow') must fit the 870 s gate budget; the fast cross-
+# sections of this stack stay in tier-1 via test_zero/test_parallel/
+# test_param_groups/test_attention and the ci/gate.sh dryrun parts.
+pytestmark = pytest.mark.slow
+
 V, L, E, H, S, B = 64, 8, 32, 4, 16, 4
 STAGES = 4
 M = 4  # microbatches (batch B splits into M of B//M)

@@ -19,6 +19,12 @@ from apex_tpu.models.gpt import next_token_loss
 from apex_tpu.parallel import (lm_tp_pspecs, tp_shard_lm_params,
                                tp_unshard_lm_params)
 
+# Integration tier (PR 1): this whole module rides `-m slow` — Megatron-TP dense-parity integration.
+# Tier-1 (-m 'not slow') must fit the 870 s gate budget; the fast cross-
+# sections of this stack stay in tier-1 via test_zero/test_parallel/
+# test_param_groups/test_attention and the ci/gate.sh dryrun parts.
+pytestmark = pytest.mark.slow
+
 V, L, E, H, S, B = 64, 2, 64, 8, 32, 2
 TP = 4
 
