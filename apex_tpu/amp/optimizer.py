@@ -134,7 +134,20 @@ class AmpOptimizer:
         else:
             new_model, new_master, new_inner = do_step(None)
 
-        new_scaler = self.scaler.update(state.scaler, overflow, loss_id)
+        # telemetry step attribution: the EXECUTION index, not the inner
+        # optimizer step — skipped (overflowed) steps leave inner.step
+        # frozen, but successes + cumulative overflows advances once per
+        # call, so per-step event series stay per-step under skips.
+        # Built only when telemetry is on: the disabled program must be
+        # identical to the uninstrumented one.
+        from apex_tpu import telemetry
+        step_idx = None
+        if telemetry.enabled():
+            step_idx = getattr(state.inner, "step", None)
+            if step_idx is not None:
+                step_idx = step_idx + state.scaler.overflows[loss_id]
+        new_scaler = self.scaler.update(state.scaler, overflow, loss_id,
+                                        step=step_idx)
         new_state = AmpOptimizerState(inner=new_inner, master=new_master,
                                       scaler=new_scaler)
         info = {"overflow": overflow,

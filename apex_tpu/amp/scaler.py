@@ -94,9 +94,32 @@ class LossScaler:
         return scaled_grads, jnp.zeros((), jnp.bool_)
 
     def update(self, state: ScalerState, overflow: jax.Array,
-               loss_id: int = 0) -> ScalerState:
+               loss_id: int = 0, *, step=None) -> ScalerState:
         """Post-step scale adjustment (scaler.py:206-226): overflow halves the
-        scale and resets the window; ``scale_window`` clean steps double it."""
+        scale and resets the window; ``scale_window`` clean steps double it.
+
+        With telemetry enabled (apex_tpu.telemetry.enable() BEFORE jitting
+        the step), emits per-step ``amp/overflow`` and ``amp/loss_scale``
+        events through a trace-safe host callback; ``step`` optionally
+        attributes them to a step counter (AmpOptimizer passes its
+        execution index — successes + overflows — so the series stays
+        per-step even when overflow skips freeze the inner optimizer
+        step). Disabled: zero cost, nothing traced."""
+        new_state = self._update(state, overflow, loss_id)
+        from apex_tpu import telemetry
+        if telemetry.enabled():
+            # secondary losses get their own series — merging per-loss
+            # scalers under one name would average unrelated scales in
+            # summarize's (name, step) dedup
+            suffix = "" if loss_id == 0 else f"/loss{loss_id}"
+            telemetry.record(f"amp/overflow{suffix}",
+                             overflow.astype(jnp.float32), step=step)
+            telemetry.record(f"amp/loss_scale{suffix}",
+                             new_state.loss_scale[loss_id], step=step)
+        return new_state
+
+    def _update(self, state: ScalerState, overflow: jax.Array,
+                loss_id: int = 0) -> ScalerState:
         if not self.dynamic:
             return state._replace(
                 overflows=state.overflows.at[loss_id].add(

@@ -305,6 +305,30 @@ class _ZeroBase(FusedOptimizer):
         world = bound_axis_size(self.axis_name)
         if self.group_axis is not None:
             world = world * bound_axis_size(self.group_axis)
+
+        from apex_tpu import telemetry
+        if telemetry.enabled():
+            # trace-time static accounting: per-device f32 bytes entering
+            # the chunked reduce-scatter each step (+ the cross-group psum
+            # when subgrouped); (n-1)/n ring wire bill per shard axis.
+            n = bound_axis_size(self.axis_name)
+            nbytes = 4 * int(sum(b["padded"] for b in spec["buckets"]))
+            telemetry.record_static(
+                f"zero/{self.axis_name}/reduce_scatter_bytes", nbytes,
+                meta={"axis": self.axis_name, "primitive": "psum_scatter",
+                      "count": len(spec["buckets"]), "world": n,
+                      "bytes_wire": round(nbytes * (n - 1) / n)},
+                dedup_key=(self.axis_name, nbytes, len(spec["buckets"])))
+            if self.group_axis is not None:
+                gn = bound_axis_size(self.group_axis)
+                gbytes = nbytes // n
+                telemetry.record_static(
+                    f"zero/{self.group_axis}/allreduce_bytes", gbytes,
+                    meta={"axis": self.group_axis, "primitive": "psum",
+                          "count": len(spec["buckets"]), "world": gn,
+                          "bytes_wire": round(gbytes * 2 * (gn - 1) / gn)},
+                    dedup_key=(self.group_axis, gbytes,
+                               len(spec["buckets"])))
         shards = []
         for b in spec["buckets"]:
             flat = _bucket_flat(leaves, b["idxs"], b["padded"])
@@ -325,6 +349,22 @@ class _ZeroBase(FusedOptimizer):
         the next step's forward) of previously gathered buckets. Gathers
         over ``axis_name`` only — with group_axis, every subgroup already
         holds identical shards."""
+        from apex_tpu import telemetry
+        if telemetry.enabled():
+            # per-device shard bytes contributed to the parameter
+            # all_gather each step (post-compression dtype); ring wire
+            # bill is (n-1) x the contributed shard.
+            n = bound_axis_size(self.axis_name)
+            item = np.dtype(self.allgather_dtype or np.float32).itemsize
+            nbytes = item * int(sum(b["k"] for b in spec["buckets"]))
+            telemetry.record_static(
+                f"zero/{self.axis_name}/all_gather_bytes", nbytes,
+                meta={"axis": self.axis_name, "primitive": "all_gather",
+                      "count": len(spec["buckets"]), "world": n,
+                      "bytes_wire": round(nbytes * (n - 1))},
+                dedup_key=(self.axis_name, nbytes, len(spec["buckets"]),
+                           "gather"))
+
         leaves: list = [None] * len(spec["sizes"])
         off = 0
         for b in spec["buckets"]:

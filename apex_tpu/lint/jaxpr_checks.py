@@ -46,6 +46,7 @@ import jax
 import numpy as np
 
 from apex_tpu.lint.report import Finding
+from apex_tpu.utils.jaxpr_walk import subjaxprs
 
 _LOW_DTYPES = ("bfloat16", "float16")
 _COLLECTIVE_PRIMS = {
@@ -231,31 +232,6 @@ def _check_pallas(eqn, ctx: _Ctx):
                 f"breaks (8, 128) tiling: " + "; ".join(bad))
 
 
-def _inner_jaxprs(eqn):
-    """(inner_jaxpr, outer_operands_or_None) pairs for every sub-jaxpr in
-    an equation's params — pjit/scan/cond/custom-vjp/shard_map/pallas."""
-    pairs = []
-
-    def add(j, operands):
-        if j is None:
-            return
-        inner = getattr(j, "jaxpr", j)          # ClosedJaxpr -> Jaxpr
-        if hasattr(inner, "eqns") and hasattr(inner, "invars"):
-            pairs.append((inner, operands))
-
-    for key, val in eqn.params.items():
-        if key == "branches" and isinstance(val, (tuple, list)):
-            for br in val:
-                add(br, eqn.invars[1:])
-        elif hasattr(val, "eqns") or hasattr(val, "jaxpr"):
-            add(val, eqn.invars)
-        elif isinstance(val, (tuple, list)):
-            for item in val:
-                if hasattr(item, "eqns") or hasattr(item, "jaxpr"):
-                    add(item, None)
-    return pairs
-
-
 def _walk(jaxpr, low_env: Dict[Any, bool], ctx: _Ctx):
     for eqn in jaxpr.eqns:
         prim = eqn.primitive.name
@@ -287,7 +263,7 @@ def _walk(jaxpr, low_env: Dict[Any, bool], ctx: _Ctx):
             except TypeError:       # DropVar/Literal-like outputs
                 pass
 
-        for inner, operands in _inner_jaxprs(eqn):
+        for inner, operands in subjaxprs(eqn):
             env: Dict[Any, bool] = {}
             if operands is not None and len(operands) == len(inner.invars):
                 for outer, iv in zip(operands, inner.invars):

@@ -71,13 +71,32 @@ def allreduce_gradients(
     if not leaves:
         return grads
     world = bound_axis_size(axis_name)
+    buckets = _buckets.assign_buckets(leaves, message_size)
+
+    from apex_tpu import telemetry
+    if telemetry.enabled():
+        # trace-time static accounting: what this call will move per step,
+        # per device (itemsize after the optional fp32 upcast). The wire
+        # estimate is the ring all-reduce bill; summarize groups it with
+        # the other per-axis comm producers.
+        import numpy as _np
+        nbytes = sum(
+            int(_np.prod(l.shape)) * (4 if allreduce_always_fp32
+                                      else _np.dtype(l.dtype).itemsize)
+            for l in leaves)
+        telemetry.record_static(
+            f"ddp/{axis_name}/allreduce_bytes", nbytes,
+            meta={"axis": axis_name, "primitive": "psum",
+                  "count": len(buckets), "world": world,
+                  "bytes_wire": round(nbytes * 2 * (world - 1) / world)},
+            dedup_key=(axis_name, nbytes, len(buckets), world))
 
     predivide = gradient_predivide_factor if gradient_average else 1.0
     postdivide = (world / gradient_predivide_factor
                   if gradient_average else 1.0)
 
     out: list = [None] * len(leaves)
-    for _, idxs in _buckets.assign_buckets(leaves, message_size):
+    for _, idxs in buckets:
         flat, spec = _buckets.flatten_tensors([leaves[i] for i in idxs])
         orig_dtype = flat.dtype
         if allreduce_always_fp32 and orig_dtype != jnp.float32:

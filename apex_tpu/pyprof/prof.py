@@ -45,19 +45,38 @@ PEAK_BF16 = [
     ("v5p", 459e12), ("v4", 275e12), ("v6", 918e12),
 ]
 
+# Nominal peak for the XLA CPU backend: an order-of-magnitude figure for
+# a contemporary many-core host (~10 cores x ~3 GHz x 2x16-lane FMA f32
+# ≈ 1 TFLOP/s). CPU "MFU" is a relative utilization signal for smoke
+# runs and CI, NOT a roofline claim — but it must be a sane finite
+# denominator rather than the 197 TFLOP/s v5e figure a substring miss
+# used to return here (which made every CPU MFU a meaningless 1e-5).
+PEAK_CPU_NOMINAL = 1e12
+
 
 def device_peak_flops(device=None) -> float:
     """Peak dense bf16 FLOP/s of ``device`` (default: first local device).
-    Unlisted chips fall back to APEX_TPU_PEAK_FLOPS (or the legacy
-    BENCH_PEAK_FLOPS) and finally the v5e figure."""
+
+    Always returns a positive finite float, on every backend:
+    known TPU generations use the table above; the CPU backend returns
+    ``PEAK_CPU_NOMINAL`` (1 TFLOP/s — see its docstring for what CPU MFU
+    means); anything else falls back to APEX_TPU_PEAK_FLOPS (or the
+    legacy BENCH_PEAK_FLOPS) and finally the v5e figure. The env
+    overrides also take precedence on CPU, so a calibrated host can pin
+    its real peak."""
     import os
     device = device or jax.devices()[0]
     kind = getattr(device, "device_kind", "").lower()
     for sub, peak in PEAK_BF16:
         if sub in kind:
             return peak
-    return float(os.environ.get("APEX_TPU_PEAK_FLOPS",
-                                os.environ.get("BENCH_PEAK_FLOPS", 197e12)))
+    env = os.environ.get("APEX_TPU_PEAK_FLOPS",
+                         os.environ.get("BENCH_PEAK_FLOPS"))
+    if env is not None:
+        return float(env)
+    if getattr(device, "platform", "") == "cpu":
+        return PEAK_CPU_NOMINAL
+    return 197e12
 
 
 def xla_flops(jitted_fn, *args, **kwargs) -> Optional[float]:

@@ -263,7 +263,15 @@ class PrefetchLoader:
     applies ``transform`` (e.g. augment_batch + device_put), and keeps
     ``depth`` ready batches queued — overlapping input processing with device
     compute like the reference's side-stream data_prefetcher
-    (examples/imagenet/main_amp.py:264-317)."""
+    (examples/imagenet/main_amp.py:264-317).
+
+    The internal queue is observable: :meth:`stats` reports batches
+    produced/consumed, the live queue depth, and **starvations** — consumer
+    fetches that found the queue empty, i.e. steps where the device waited
+    on input (the reference's prefetcher has exactly this blind spot). With
+    ``apex_tpu.telemetry`` enabled, each fetch also emits
+    ``data/queue_depth`` (point) and ``data/starvation`` (counter) events.
+    """
 
     _SENTINEL = object()
 
@@ -279,6 +287,15 @@ class PrefetchLoader:
         self._error: Optional[BaseException] = None
         self._finished_workers = 0
         self._exhausted = False
+        self.depth = depth
+        # counters get their OWN lock: _lock is held across next(source)
+        # (potentially slow I/O), and counting under it would serialize
+        # the consumer's bookkeeping with source reads — adding fetch
+        # latency and masking the very starvation being measured.
+        self._stats_lock = threading.Lock()
+        self._produced = 0
+        self._consumed = 0
+        self._starvations = 0
         for _ in range(max(1, workers)):
             t = threading.Thread(target=self._worker, daemon=True)
             t.start()
@@ -296,6 +313,9 @@ class PrefetchLoader:
                 return
             try:
                 self._q.put(item, timeout=0.1)
+                if item is not self._SENTINEL:
+                    with self._stats_lock:
+                        self._produced += 1
                 return
             except queue.Full:
                 pass
@@ -328,6 +348,7 @@ class PrefetchLoader:
         return self
 
     def __next__(self):
+        starved = self._q.qsize() == 0   # device would wait on input HERE
         while True:
             if self._exhausted:
                 raise StopIteration
@@ -347,7 +368,35 @@ class PrefetchLoader:
                         raise err
                     raise StopIteration
                 continue
+            with self._stats_lock:
+                self._consumed += 1
+                if starved:
+                    self._starvations += 1
+            from apex_tpu import telemetry
+            if telemetry.enabled():
+                telemetry.record("data/queue_depth", self._q.qsize(),
+                                 step=self._consumed - 1)
+                if starved:
+                    telemetry.record("data/starvation", 1.0,
+                                     step=self._consumed - 1,
+                                     kind="counter")
             return item
+
+    def stats(self) -> dict:
+        """Counters since construction: ``produced``/``consumed`` batches,
+        live ``queue_depth``, configured ``depth``, and ``starvations``
+        (consumer fetches that found the queue empty — input-bound steps).
+        ``starvations``/``consumed`` near 1.0 means the pipeline, not the
+        device, is the bottleneck: raise ``workers`` or ``depth``, or
+        cheapen ``transform``."""
+        with self._stats_lock:
+            return {
+                "produced": self._produced,
+                "consumed": self._consumed,
+                "starvations": self._starvations,
+                "queue_depth": self._q.qsize(),
+                "depth": self.depth,
+            }
 
     def close(self):
         """Stop the workers and drop queued batches. Safe to call early

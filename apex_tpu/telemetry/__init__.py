@@ -1,0 +1,58 @@
+"""apex_tpu.telemetry — runtime training observability.
+
+The reference's pyprof layer (SURVEY.md §5.1) and our lint pass are both
+OFFLINE: one analyzes traces after the run, the other analyzes programs
+before it. This package is the third leg — what the run itself reports
+while it happens:
+
+  * :mod:`events`      — typed metric events, bounded thread-safe
+    collector, process-global enable flag.
+  * :mod:`instrument`  — ``record(name, value)`` that is trace-safe
+    (usable inside jit/pjit/shard_map via ``jax.debug.callback``) and
+    ``instrument_step`` (dispatch/device step-time split, tokens/s, MFU
+    from XLA cost analysis ÷ chip peak).
+  * :mod:`comm`        — static per-step communication accounting: bytes
+    per collective per mesh axis from the jaxpr (the quantity that decides
+    all-reduce vs ZeRO reduce-scatter+all-gather, arXiv:2004.13336).
+  * :mod:`export`      — JSONL/CSV writers with rotation; ``summarize``
+    aggregation.
+  * :mod:`cli`         — ``python -m apex_tpu.telemetry summarize
+    run.jsonl``.
+
+Producers wired through the stack (all no-ops until :func:`enable`):
+``amp.scaler`` (overflow + loss-scale), ``parallel.distributed`` and
+``contrib.optimizers.zero`` (bucket/comm bytes), ``runtime.
+PrefetchLoader`` (queue depth / starvation), ``bench.py`` and
+``examples/gpt/train_lm.py`` (full instrumented runs).
+
+Quick start::
+
+    from apex_tpu import telemetry
+    telemetry.enable()                      # BEFORE jitting the step
+    step = telemetry.instrument_step(step_fn, tokens_per_step=B * S)
+    for batch in data:
+        state = step(state, batch)
+    jax.effects_barrier()                   # flush async callbacks
+    telemetry.write_jsonl("run.jsonl")
+    # then: python -m apex_tpu.telemetry summarize run.jsonl
+"""
+
+from apex_tpu.telemetry.events import (Collector, Event, capture, disable,
+                                       enable, enabled, get_collector,
+                                       set_collector)
+from apex_tpu.telemetry.instrument import (instrument_step, record,
+                                           record_static)
+from apex_tpu.telemetry.comm import (CommRecord, comm_stats, format_comm,
+                                     record_comm_stats)
+from apex_tpu.telemetry.export import (JsonlWriter, format_summary,
+                                       read_jsonl, summarize, write_csv,
+                                       write_jsonl as _write_jsonl_events)
+
+
+def write_jsonl(path: str, events=None, **kwargs) -> str:
+    """Write ``events`` (default: drain the global collector) to ``path``.
+    The default drain clears the collector, so back-to-back runs into
+    separate files don't cross-contaminate."""
+    if events is None:
+        events = get_collector().drain()
+    return _write_jsonl_events(path, events, **kwargs)

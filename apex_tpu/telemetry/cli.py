@@ -1,0 +1,73 @@
+"""``python -m apex_tpu.telemetry`` — offline run-file tooling.
+
+Subcommands:
+
+  summarize RUN.jsonl [--json]   step-time percentiles (dispatch/device
+                                 split), throughput, MFU, overflow rate,
+                                 loss-scale timeline, per-axis comm bytes,
+                                 pipeline counters.
+  tail RUN.jsonl [-n N]          last N events, one line each.
+  csv RUN.jsonl OUT.csv          flat CSV re-export.
+
+Exit codes: 0 on success, 1 on a malformed/missing run file, 2 on usage
+errors (argparse). The run file is plain JSONL — no device, no trace
+artifacts, no compiled programs needed to analyze it after the fact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from apex_tpu.telemetry.export import (format_summary, read_jsonl,
+                                       summarize, write_csv)
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.telemetry",
+        description="apex_tpu runtime telemetry — run-file tools")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("summarize", help="aggregate a run JSONL")
+    s.add_argument("path", help="telemetry run file (JSONL)")
+    s.add_argument("--json", action="store_true",
+                   help="emit the aggregate as JSON instead of text")
+
+    t = sub.add_parser("tail", help="print the last N events")
+    t.add_argument("path")
+    t.add_argument("-n", type=int, default=20)
+
+    c = sub.add_parser("csv", help="re-export a run as CSV")
+    c.add_argument("path")
+    c.add_argument("out")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    try:
+        events = read_jsonl(args.path)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+    if args.cmd == "summarize":
+        agg = summarize(events)
+        print(json.dumps(agg, indent=1, sort_keys=True) if args.json
+              else format_summary(agg))
+    elif args.cmd == "tail":
+        for e in events[-args.n:]:
+            step = f" step={e['step']}" if e.get("step") is not None else ""
+            print(f"{e.get('ts', 0):.3f} {e['name']}={e['value']:g}"
+                  f"{step} [{e.get('kind', 'point')}]")
+    elif args.cmd == "csv":
+        write_csv(args.out, events)
+        print(f"wrote {len(events)} events to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,182 @@
+"""Typed metric events and the bounded, thread-safe collector.
+
+The event model is deliberately flat — one scalar per event — so every
+producer (a ``jax.debug.callback`` firing from inside a jitted step, the
+prefetch loader's worker thread, a trace-time static accounting pass) can
+emit without coordination and the JSONL export stays line-per-fact:
+
+  * ``kind="point"``   — a per-occurrence sample (step time, loss scale).
+  * ``kind="counter"`` — a monotone occurrence count contribution
+    (overflow flags, starvation ticks); summaries sum these.
+  * ``kind="static"``  — a trace-time constant (comm bytes per step,
+    bucket counts); recorded once per trace, summaries treat the value as
+    holding for every step.
+
+The collector is a bounded deque guarded by one lock: producers on any
+thread (XLA callback threads included) append in O(1); when full, the
+OLDEST events are dropped and counted in ``dropped`` — a telemetry
+subsystem must never become the memory leak it exists to find.
+
+Enabling is process-global and trace-time: producers guard emission with
+``enabled()``, so a disabled run traces a program with zero telemetry in
+it (no callbacks, no host syncs — the ≤5 %-overhead budget is met by not
+paying at all when off). Flipping the flag therefore changes the traced
+program: enable telemetry BEFORE building/jitting the step function.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, List, NamedTuple, Optional
+
+
+class Event(NamedTuple):
+    """One scalar fact. ``value`` is always a float; structured context
+    rides in ``meta`` (plain JSON-able dict) so export stays schema-free."""
+
+    name: str
+    value: float
+    ts: float                       # unix seconds, host clock
+    step: Optional[int] = None
+    kind: str = "point"             # point | counter | static
+    meta: Optional[Dict[str, Any]] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"name": self.name, "value": self.value,
+                             "ts": self.ts, "kind": self.kind}
+        if self.step is not None:
+            d["step"] = self.step
+        if self.meta:
+            d["meta"] = self.meta
+        return d
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Event":
+        return Event(name=d["name"], value=float(d["value"]),
+                     ts=float(d.get("ts", 0.0)),
+                     step=d.get("step"), kind=d.get("kind", "point"),
+                     meta=d.get("meta"))
+
+
+class Collector:
+    """Bounded in-memory event sink. All methods are thread-safe."""
+
+    def __init__(self, capacity: int = 100_000):
+        self._events: "collections.deque[Event]" = collections.deque(
+            maxlen=capacity)
+        self._lock = threading.Lock()
+        self.capacity = capacity
+        self.dropped = 0
+        self._seen_static: set = set()
+
+    def add(self, event: Event) -> None:
+        with self._lock:
+            if len(self._events) == self.capacity:
+                self.dropped += 1
+            self._events.append(event)
+
+    def record(self, name: str, value: Any, *, step: Optional[int] = None,
+               kind: str = "point", meta: Optional[dict] = None) -> None:
+        self.add(Event(name=name, value=float(value), ts=time.time(),
+                       step=step, kind=kind, meta=meta))
+
+    def record_static_once(self, name: str, value: Any, *,
+                           meta: Optional[dict] = None,
+                           dedup_key: Optional[tuple] = None) -> None:
+        """Record a trace-time constant at most once per (name, dedup_key).
+
+        Producers inside functions that get re-traced (jit retraces on new
+        shapes/layouts; donated buffers commonly force a second trace) call
+        this so the JSONL carries one static row per distinct fact, not one
+        per trace.
+        """
+        key = (name, dedup_key)
+        with self._lock:
+            if key in self._seen_static:
+                return
+            self._seen_static.add(key)
+        self.record(name, value, kind="static", meta=meta)
+
+    def snapshot(self) -> List[Event]:
+        with self._lock:
+            return list(self._events)
+
+    def drain(self) -> List[Event]:
+        with self._lock:
+            out = list(self._events)
+            self._events.clear()
+            self._seen_static.clear()
+            return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._seen_static.clear()
+            self.dropped = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+
+# ---------------------------------------------------------------------------
+# process-global default collector + enable flag
+# ---------------------------------------------------------------------------
+
+_default = Collector()
+_enabled = False
+
+
+def get_collector() -> Collector:
+    return _default
+
+
+def set_collector(collector: Collector) -> Collector:
+    """Swap the process-global collector (tests, multi-run isolation);
+    returns the previous one."""
+    global _default
+    prev, _default = _default, collector
+    return prev
+
+
+def enable() -> None:
+    """Turn producer emission on. Trace-time: call BEFORE jitting steps."""
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+class capture:
+    """Context manager: enable telemetry into a fresh collector, restore
+    the previous collector/flag on exit. The captured collector is the
+    ``as`` target::
+
+        with telemetry.capture() as col:
+            step(...)                   # producers emit into col
+        export.write_jsonl(path, col.drain())
+    """
+
+    def __init__(self, capacity: int = 100_000):
+        self.collector = Collector(capacity)
+
+    def __enter__(self) -> Collector:
+        self._prev_collector = set_collector(self.collector)
+        self._prev_enabled = enabled()
+        enable()
+        return self.collector
+
+    def __exit__(self, *exc):
+        set_collector(self._prev_collector)
+        if not self._prev_enabled:
+            disable()
+        return False

@@ -1,0 +1,349 @@
+"""JSONL / CSV export and end-of-run aggregation.
+
+The on-disk format is one JSON object per line (the Event.to_dict schema:
+``name``, ``value``, ``ts``, ``kind``, optional ``step``/``meta``) — no
+header, no framing — so a run file can be tailed, grepped, concatenated
+across restarts, and parsed by anything. ``JsonlWriter`` appends with
+size-based rotation (``run.jsonl`` -> ``run.jsonl.1`` ...), because an
+instrumented multi-day run must not fill the host disk.
+
+``summarize`` turns a list of event dicts into the run-health aggregate
+the CLI renders: step-time percentiles with the dispatch/device split,
+throughput, MFU, overflow rate + loss-scale timeline, per-axis comm
+bytes, and data-pipeline counters. Replicated emission (one callback per
+shard under shard_map) is collapsed by averaging point samples that share
+(name, step).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+from typing import Any, Dict, Iterable, List, Sequence
+
+from apex_tpu.telemetry.events import Event
+
+
+class JsonlWriter:
+    """Append-only JSONL sink with size rotation.
+
+    ``max_bytes`` > 0 rotates the live file to ``path.1`` (shifting older
+    generations up to ``max_files``) when a write would cross the limit.
+    """
+
+    def __init__(self, path: str, *, max_bytes: int = 0, max_files: int = 5):
+        self.path = path
+        self.max_bytes = max_bytes
+        self.max_files = max(1, max_files)
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._f = open(path, "a", encoding="utf-8")
+
+    def _rotate(self) -> None:
+        self._f.close()
+        oldest = f"{self.path}.{self.max_files}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for i in range(self.max_files - 1, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        os.replace(self.path, f"{self.path}.1")
+        self._f = open(self.path, "a", encoding="utf-8")
+
+    def write(self, event) -> None:
+        d = event.to_dict() if isinstance(event, Event) else dict(event)
+        line = json.dumps(d, sort_keys=True) + "\n"
+        if (self.max_bytes > 0
+                and self._f.tell() + len(line) > self.max_bytes
+                and self._f.tell() > 0):
+            self._rotate()
+        self._f.write(line)
+
+    def write_events(self, events: Iterable) -> None:
+        for e in events:
+            self.write(e)
+        self.flush()
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def write_jsonl(path: str, events: Iterable, *, max_bytes: int = 0,
+                max_files: int = 5) -> str:
+    """One-shot export: write ``events`` (Event objects or dicts) to
+    ``path``; returns the path."""
+    with JsonlWriter(path, max_bytes=max_bytes, max_files=max_files) as w:
+        w.write_events(events)
+    return path
+
+
+def read_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Load a run file (rotated generations are NOT followed — concat the
+    files yourself for a full-history view). Blank lines are skipped;
+    a malformed line raises with its line number."""
+    out: List[Dict[str, Any]] = []
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{i}: malformed JSONL: {e}") from e
+    return out
+
+
+def write_csv(path: str, events: Iterable) -> str:
+    """Flat CSV view (name,value,ts,step,kind) — meta is dropped; use
+    JSONL as the full-fidelity format."""
+    import csv
+    with open(path, "w", newline="", encoding="utf-8") as f:
+        w = csv.writer(f)
+        w.writerow(["name", "value", "ts", "step", "kind"])
+        for e in events:
+            d = e.to_dict() if isinstance(e, Event) else dict(e)
+            w.writerow([d["name"], d["value"], d.get("ts", ""),
+                        d.get("step", ""), d.get("kind", "point")])
+    return path
+
+
+# ---------------------------------------------------------------------------
+# aggregation
+# ---------------------------------------------------------------------------
+
+def _percentile(sorted_vals: Sequence[float], q: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    k = (len(sorted_vals) - 1) * q
+    lo, hi = int(k), min(int(k) + 1, len(sorted_vals) - 1)
+    frac = k - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def _dedup_points(events: List[Dict[str, Any]]) -> Dict[str, List[float]]:
+    """name -> per-step series, averaging samples that share (name, step)
+    (the shard_map one-callback-per-shard collapse). Events with no step
+    stay as individual samples."""
+    by_step: Dict[str, Dict[Any, List[float]]] = collections.defaultdict(
+        lambda: collections.defaultdict(list))
+    nostep: Dict[str, List[float]] = collections.defaultdict(list)
+    for e in events:
+        if e.get("kind", "point") != "point":
+            continue
+        if e.get("step") is None:
+            nostep[e["name"]].append(float(e["value"]))
+        else:
+            by_step[e["name"]][e["step"]].append(float(e["value"]))
+    out: Dict[str, List[float]] = {}
+    for name, steps in by_step.items():
+        out[name] = [sum(v) / len(v) for _, v in sorted(steps.items())]
+    for name, vals in nostep.items():
+        out.setdefault(name, []).extend(vals)
+    return out
+
+
+def _series_stats(vals: Sequence[float]) -> Dict[str, float]:
+    s = sorted(vals)
+    return {
+        "count": len(s),
+        "mean": sum(s) / len(s),
+        "p50": _percentile(s, 0.50),
+        "p90": _percentile(s, 0.90),
+        "p99": _percentile(s, 0.99),
+        "max": s[-1],
+    }
+
+
+def _timeline(events: List[Dict[str, Any]], name: str,
+              max_points: int = 24) -> List:
+    """(step, value) pairs for one point series, first-sample-per-step,
+    downsampled evenly to at most ``max_points``."""
+    seen: Dict[Any, float] = {}
+    order: List[Any] = []
+    for e in events:
+        if e["name"] == name and e.get("step") is not None:
+            if e["step"] not in seen:
+                order.append(e["step"])
+                seen[e["step"]] = float(e["value"])
+    pairs = [[s, seen[s]] for s in sorted(order)]
+    if len(pairs) > max_points:
+        idx = [round(i * (len(pairs) - 1) / (max_points - 1))
+               for i in range(max_points)]
+        pairs = [pairs[i] for i in sorted(set(idx))]
+    return pairs
+
+
+def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate a run's events into the health report dict.
+
+    Sections appear only when their producers ran, so the report shape is
+    stable across partial instrumentations."""
+    out: Dict[str, Any] = {"events": len(events)}
+    series = _dedup_points(events)
+
+    # step timing (any prefix: "step/..." from instrument_step's default
+    # name, or a custom name ending in the same suffixes)
+    for suffix, key in (("time_s", "step_time_s"),
+                        ("dispatch_s", "dispatch_s"),
+                        ("device_wait_s", "device_wait_s"),
+                        ("tokens_per_s", "tokens_per_s"),
+                        ("examples_per_s", "examples_per_s"),
+                        ("mfu", "mfu")):
+        vals: List[float] = []
+        for name, v in series.items():
+            if name.endswith("/" + suffix):
+                vals.extend(v)
+        if vals:
+            out[key] = _series_stats(vals)
+
+    # amp: overflow rate + loss-scale timeline
+    overflow = [v for name, vs in series.items()
+                if name.endswith("amp/overflow") for v in vs]
+    if overflow:
+        out["overflow"] = {"steps": len(overflow),
+                           "overflows": int(round(sum(overflow))),
+                           "rate": sum(overflow) / len(overflow)}
+    if any(e["name"].endswith("amp/loss_scale") for e in events):
+        names = {e["name"] for e in events
+                 if e["name"].endswith("amp/loss_scale")}
+        out["loss_scale"] = {"timeline": _timeline(events, sorted(names)[0])}
+
+    # comm: static per-step byte accounting, grouped by axis. Two event
+    # families can describe the SAME collectives: the jaxpr walker's
+    # whole-program bill (names under "comm/") and the per-producer
+    # wiring (ddp/zero bucket events). When an axis has walker events
+    # they are the complete, non-overlapping account — producer events
+    # for that axis become a named breakdown rather than additional
+    # bytes (summing both would double-count every wired collective).
+    comm_events: List[Dict[str, Any]] = []
+    for e in events:
+        if e.get("kind") != "static" or "/" not in e["name"]:
+            continue
+        if (e.get("meta") or {}).get("axis") is not None:
+            comm_events.append(e)
+    comm: Dict[str, Dict[str, Any]] = {}
+    walker_axes = {e["meta"]["axis"] for e in comm_events
+                   if e["name"].startswith("comm/")}
+    for e in comm_events:
+        meta = e["meta"]
+        axis = meta["axis"]
+        rec = comm.setdefault(axis, {"bytes_in_per_step": 0.0,
+                                     "collectives": {}})
+        from_walker = e["name"].startswith("comm/")
+        if axis in walker_axes and not from_walker:
+            rec.setdefault("producers", {})[e["name"]] = float(e["value"])
+            continue
+        prim = meta.get("primitive", e["name"].rsplit("/", 1)[-1])
+        rec["bytes_in_per_step"] += float(e["value"])
+        c = rec["collectives"].setdefault(
+            prim, {"count": 0, "bytes_in": 0.0})
+        c["count"] += int(meta.get("count", 1))
+        c["bytes_in"] += float(e["value"])
+        if "bytes_wire" in meta:
+            c["bytes_wire"] = c.get("bytes_wire", 0.0) \
+                + float(meta["bytes_wire"])
+            rec["bytes_wire_per_step"] = rec.get(
+                "bytes_wire_per_step", 0.0) + float(meta["bytes_wire"])
+    if comm:
+        out["comm"] = comm
+
+    # other static facts (model flops, bucket counts, ...)
+    statics = {e["name"]: e["value"] for e in events
+               if e.get("kind") == "static"
+               and (e.get("meta") or {}).get("axis") is None}
+    if statics:
+        out["static"] = statics
+
+    # counters (starvation ticks etc.)
+    counters: Dict[str, float] = collections.defaultdict(float)
+    for e in events:
+        if e.get("kind") == "counter":
+            counters[e["name"]] += float(e["value"])
+    if counters:
+        out["counters"] = dict(counters)
+
+    # data pipeline queue depth
+    depth = [v for name, vs in series.items()
+             if name.endswith("data/queue_depth") for v in vs]
+    if depth:
+        out["queue_depth"] = _series_stats(depth)
+    return out
+
+
+def _fmt_si(x: float) -> str:
+    for div, unit in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if abs(x) >= div:
+            return f"{x / div:.2f} {unit}"
+    return f"{x:.0f} "
+
+
+def format_summary(s: Dict[str, Any]) -> str:
+    """Render a summarize() dict as the CLI's text report."""
+    lines = [f"events: {s.get('events', 0)}"]
+
+    def timing(key, label):
+        t = s.get(key)
+        if not t:
+            return
+        lines.append(
+            f"{label:<14} n={t['count']:<5} mean {t['mean'] * 1e3:9.2f} ms"
+            f"   p50 {t['p50'] * 1e3:9.2f}   p90 {t['p90'] * 1e3:9.2f}"
+            f"   p99 {t['p99'] * 1e3:9.2f}   max {t['max'] * 1e3:9.2f}")
+
+    timing("step_time_s", "step time")
+    timing("dispatch_s", "  dispatch")
+    timing("device_wait_s", "  device wait")
+    for key, label, fmt in (
+            ("tokens_per_s", "tokens/s", "{:,.0f}"),
+            ("examples_per_s", "examples/s", "{:,.0f}")):
+        t = s.get(key)
+        if t:
+            lines.append(f"{label:<14} mean " + fmt.format(t["mean"])
+                         + "   p50 " + fmt.format(t["p50"]))
+    if s.get("mfu"):
+        lines.append(f"{'MFU':<14} mean {s['mfu']['mean']:.1%}"
+                     f"   p50 {s['mfu']['p50']:.1%}")
+    if s.get("overflow"):
+        o = s["overflow"]
+        lines.append(f"{'overflow':<14} {o['overflows']}/{o['steps']} steps"
+                     f" ({o['rate']:.1%})")
+    if s.get("loss_scale"):
+        tl = s["loss_scale"]["timeline"]
+        lines.append("loss scale     "
+                     + " ".join(f"{int(st)}:{v:g}" for st, v in tl))
+    if s.get("comm"):
+        lines.append("comm (per device per step):")
+        for axis, rec in sorted(s["comm"].items()):
+            wire = rec.get("bytes_wire_per_step")
+            lines.append(
+                f"  axis {axis!r}: {_fmt_si(rec['bytes_in_per_step'])}B in"
+                + (f", ~{_fmt_si(wire)}B wire" if wire else ""))
+            for prim, c in sorted(rec["collectives"].items()):
+                lines.append(f"    {prim:<14} x{c['count']:<4} "
+                             f"{_fmt_si(c['bytes_in'])}B")
+            for name, v in sorted(rec.get("producers", {}).items()):
+                lines.append(f"    of which {name}: {_fmt_si(v)}B")
+    if s.get("static"):
+        for name, v in sorted(s["static"].items()):
+            lines.append(f"{name:<28} {_fmt_si(v)}")
+    if s.get("counters"):
+        for name, v in sorted(s["counters"].items()):
+            lines.append(f"{name:<28} {v:g}")
+    if s.get("queue_depth"):
+        q = s["queue_depth"]
+        lines.append(f"{'queue depth':<14} mean {q['mean']:.2f}"
+                     f"   p50 {q['p50']:.1f}   max {q['max']:.0f}")
+    return "\n".join(lines)

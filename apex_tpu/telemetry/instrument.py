@@ -1,0 +1,190 @@
+"""Trace-safe in-step recording and the step-level instrumentation wrapper.
+
+``record(name, value)`` is callable ANYWHERE — plain host code, inside
+``jax.jit`` / ``pjit`` / ``shard_map`` bodies, inside ``lax.scan`` — and
+does the right thing for each:
+
+  * concrete value (host side): appended to the collector directly.
+  * traced value: emitted through ``jax.debug.callback`` — an unordered
+    host callback, legal under jit/vmap/shard_map/scan, that ships the
+    DEVICE value to the host asynchronously without forcing a sync in the
+    step. Under shard_map the callback fires once per shard (each device
+    runs the program); summaries group by (name, step) and average, so
+    replicated scalars survive unchanged.
+
+Callbacks are asynchronous: call ``jax.effects_barrier()`` (or read the
+step outputs) before draining the collector at end of run.
+
+``instrument_step`` wraps a (usually jitted) train step with the host-side
+clocks the reference's pyprof layer never had at runtime:
+
+  * **dispatch_s** — time for the step call to RETURN (python + tracing +
+    dispatch; on a remote TPU tunnel this is the ~120 ms axon tax).
+  * **device_wait_s** — additional time until ``jax.block_until_ready``
+    on the outputs, i.e. the device finishing after dispatch returned.
+  * **time_s** — the sum: full wall time of the step.
+  * tokens/sec (given ``tokens_per_step``), examples/sec (given
+    ``examples_per_step``).
+  * **MFU** — model FLOPs (XLA's own cost analysis of the compiled step,
+    via :func:`apex_tpu.pyprof.prof.xla_flops`, measured lazily on the
+    SECOND call so compile time never pollutes step 0's clock) divided by
+    step time x :func:`apex_tpu.pyprof.prof.device_peak_flops`.
+
+The blocking sync in the wrapper serializes dispatch with device compute
+— by design (that is how the split is measured). For dispatch-pipelined
+production loops, instrument every Nth step (``sync_every``) so the
+remaining steps run unsynced at full overlap.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from apex_tpu.telemetry import events as _ev
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def record(name: str, value: Any, *, step: Any = None,
+           kind: str = "point", meta: Optional[dict] = None) -> None:
+    """Record one scalar under ``name`` — trace-safe, no-op when
+    telemetry is disabled (the disabled path costs one bool check and
+    traces NO callback into the program)."""
+    if not _ev.enabled():
+        return
+    if _is_traced(value) or _is_traced(step):
+
+        def _host(v, s):
+            _ev.get_collector().record(
+                name, float(np.asarray(v).reshape(-1)[0]),
+                step=None if s is None else int(np.asarray(s)),
+                kind=kind, meta=meta)
+
+        if step is None:
+            jax.debug.callback(lambda v: _host(v, None), value)
+        else:
+            jax.debug.callback(_host, value, step)
+        return
+    _ev.get_collector().record(
+        name, float(np.asarray(value).reshape(-1)[0]),
+        step=None if step is None else int(step), kind=kind, meta=meta)
+
+
+def record_static(name: str, value: Any, *, meta: Optional[dict] = None,
+                  dedup_key: Optional[tuple] = None) -> None:
+    """Record a trace-time constant (bucket bytes, collective sizes).
+    Values must be concrete Python/numpy scalars. Dedup'd per
+    (name, dedup_key) so re-traces don't double-count."""
+    if not _ev.enabled():
+        return
+    _ev.get_collector().record_static_once(
+        name, float(value), meta=meta, dedup_key=dedup_key)
+
+
+class instrument_step:
+    """Wrap ``step_fn`` so every call emits step-time telemetry.
+
+    ``wrapped = instrument_step(step_fn, tokens_per_step=B*S)`` is a
+    drop-in callable: same args, same outputs. Per (synced) call it emits
+    ``step/dispatch_s``, ``step/device_wait_s``, ``step/time_s``, plus
+    ``step/tokens_per_s`` / ``step/examples_per_s`` / ``step/mfu`` when
+    the corresponding rates are derivable.
+
+    ``measure_flops`` (default True) runs XLA cost analysis on the wrapped
+    fn's compiled form once, lazily, before the SECOND synced call (the
+    first call pays compile; an AOT lower inside the timed region would
+    bill compile time to the step) — emits ``step/model_flops`` (static)
+    and enables MFU. Works when ``step_fn`` is a ``jax.jit`` product; for
+    anything else it degrades to no FLOPs silently.
+
+    ``sync_every=N`` only blocks (and emits) every Nth call so production
+    loops keep dispatch pipelining; unsynced calls are not timed.
+    """
+
+    def __init__(self, step_fn: Callable, *, name: str = "step",
+                 tokens_per_step: Optional[float] = None,
+                 examples_per_step: Optional[float] = None,
+                 measure_flops: bool = True,
+                 model_flops: Optional[float] = None,
+                 peak_flops: Optional[float] = None,
+                 sync_every: int = 1):
+        self._fn = step_fn
+        self.name = name
+        self.tokens_per_step = tokens_per_step
+        self.examples_per_step = examples_per_step
+        self.measure_flops = measure_flops
+        self._peak_flops = peak_flops
+        self.sync_every = max(1, int(sync_every))
+        self.step = 0              # calls made
+        # model_flops: caller-supplied FLOPs per CALL (skips measurement —
+        # for callers that already ran cost analysis, or whose per-call
+        # program XLA can't price, e.g. multi-step scan dispatches)
+        self._flops = model_flops
+        self._flops_done = model_flops is not None
+        if model_flops:
+            record_static(f"{name}/model_flops", model_flops,
+                          dedup_key=(name,))
+
+    # -- lazy derived quantities ------------------------------------------
+    def _peak(self) -> Optional[float]:
+        if self._peak_flops is None:
+            try:
+                from apex_tpu.pyprof.prof import device_peak_flops
+                self._peak_flops = device_peak_flops()
+            except Exception:
+                self._peak_flops = 0.0
+        return self._peak_flops or None
+
+    def _measure_flops(self, args, kwargs) -> None:
+        self._flops_done = True
+        if not self.measure_flops or not hasattr(self._fn, "lower"):
+            return
+        try:
+            from apex_tpu.pyprof.prof import xla_flops
+            self._flops = xla_flops(self._fn, *args, **kwargs)
+        except Exception:
+            self._flops = None
+        if self._flops:
+            record_static(f"{self.name}/model_flops", self._flops,
+                          dedup_key=(self.name,))
+
+    # -- the wrapper -------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        self.step += 1
+        if not _ev.enabled() or (self.step - 1) % self.sync_every:
+            return self._fn(*args, **kwargs)
+        step = self.step - 1
+        # flops measurement: lazily, from call 2 on (call 1 pays compile),
+        # BEFORE the timed region — XLA's compile cache makes re-lowering
+        # the already-compiled program cheap
+        if step >= 1 and not self._flops_done:
+            self._measure_flops(args, kwargs)
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        t1 = time.perf_counter()
+        jax.block_until_ready(out)
+        t2 = time.perf_counter()
+
+        col = _ev.get_collector()
+        dispatch, wait, total = t1 - t0, t2 - t1, t2 - t0
+        col.record(f"{self.name}/dispatch_s", dispatch, step=step)
+        col.record(f"{self.name}/device_wait_s", wait, step=step)
+        col.record(f"{self.name}/time_s", total, step=step)
+        if self.tokens_per_step:
+            col.record(f"{self.name}/tokens_per_s",
+                       self.tokens_per_step / total, step=step)
+        if self.examples_per_step:
+            col.record(f"{self.name}/examples_per_s",
+                       self.examples_per_step / total, step=step)
+        if self._flops:
+            peak = self._peak()
+            if peak:
+                col.record(f"{self.name}/mfu",
+                           self._flops / total / peak, step=step)
+        return out

@@ -1,0 +1,57 @@
+"""Generic jaxpr equation-graph walking, shared by the lint jaxpr pass
+(apex_tpu/lint/jaxpr_checks.py) and the telemetry comm accounting
+(apex_tpu/telemetry/comm.py).
+
+Both consumers traverse the same program shape — registered entry points
+lowered with ``jax.make_jaxpr`` whose equations nest sub-jaxprs through
+pjit / scan / cond / while / custom-vjp / shard_map / pallas_call — so the
+sub-jaxpr discovery lives here once. Consumers that need to thread their
+own per-subtree state (lint's low-precision provenance env) call
+:func:`subjaxprs` and recurse themselves; consumers that just need every
+equation call :func:`walk_jaxpr`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple
+
+
+def subjaxprs(eqn) -> List[Tuple[Any, Optional[tuple]]]:
+    """(inner_jaxpr, outer_operands_or_None) pairs for every sub-jaxpr in
+    an equation's params — pjit/scan/cond/custom-vjp/shard_map/pallas.
+
+    ``outer_operands`` is the equation's invars when the param shape lets
+    them map 1:1 onto the inner jaxpr's invars (``cond`` branches drop the
+    predicate), else ``None``; callers propagating per-var state use it to
+    seed the inner environment.
+    """
+    pairs: List[Tuple[Any, Optional[tuple]]] = []
+
+    def add(j, operands):
+        if j is None:
+            return
+        inner = getattr(j, "jaxpr", j)          # ClosedJaxpr -> Jaxpr
+        if hasattr(inner, "eqns") and hasattr(inner, "invars"):
+            pairs.append((inner, operands))
+
+    for key, val in eqn.params.items():
+        if key == "branches" and isinstance(val, (tuple, list)):
+            for br in val:
+                add(br, tuple(eqn.invars[1:]))
+        elif hasattr(val, "eqns") or hasattr(val, "jaxpr"):
+            add(val, tuple(eqn.invars))
+        elif isinstance(val, (tuple, list)):
+            for item in val:
+                if hasattr(item, "eqns") or hasattr(item, "jaxpr"):
+                    add(item, None)
+    return pairs
+
+
+def walk_jaxpr(jaxpr, visit: Callable[[Any], None]) -> None:
+    """Depth-first visit of every equation in ``jaxpr`` and all nested
+    sub-jaxprs. ``visit(eqn)`` runs before descending into the equation's
+    own sub-jaxprs."""
+    for eqn in jaxpr.eqns:
+        visit(eqn)
+        for inner, _ in subjaxprs(eqn):
+            walk_jaxpr(inner, visit)

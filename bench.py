@@ -57,6 +57,19 @@ def main():
     # BENCH_OPT_LEVEL=O2 measures true fp16 (master weights + dynamic
     # scaling); default O5 is the bf16 O2-equivalent, MXU-native.
     opt_level = os.environ.get("BENCH_OPT_LEVEL", "O5")
+    # BENCH_TELEMETRY=1 (or a path) writes a runtime-telemetry JSONL next
+    # to the BENCH json: per-dispatch step times (dispatch/device split),
+    # scaler overflow/loss-scale events, per-axis comm bytes, MFU. Must be
+    # enabled BEFORE the step functions are jitted (the scaler callbacks
+    # are traced into the program), which is why it sits here.
+    tel_path = os.environ.get("BENCH_TELEMETRY")
+    if tel_path:
+        from apex_tpu import telemetry
+        if tel_path in ("1", "true", "yes"):
+            tel_path = os.path.join(os.path.dirname(__file__) or ".",
+                                    "benchmarks",
+                                    "telemetry_resnet50.jsonl")
+        telemetry.enable()
     log(f"bench: resnet50 amp {opt_level} batch={batch} image={image} "
         f"on {dev}")
 
@@ -179,9 +192,19 @@ def main():
                 f"({dev_s * 1e3:.1f} ms for {inner_steps} steps)")
 
     outer = max(1, (steps - warmup) // inner_steps)
+    run_fn = multi_fn
+    if tel_path:
+        # instrumented variant of the measured loop: each call is one
+        # inner_steps-step dispatch, so the step/* events describe
+        # dispatches (examples_per_step keeps examples/s honest); the
+        # per-dispatch block_until_ready is the only overhead added.
+        run_fn = telemetry.instrument_step(
+            multi_fn, examples_per_step=batch * inner_steps,
+            measure_flops=False,
+            model_flops=(flops_per_step or 0) * inner_steps or None)
     t0 = time.perf_counter()
     for _ in range(outer):
-        params, batch_stats, opt_state, loss = multi_fn(
+        params, batch_stats, opt_state, loss = run_fn(
             params, batch_stats, opt_state, (x, y))
     _ = float(loss)  # D2H fetch: the only trustworthy sync on a remote chip
     dt = time.perf_counter() - t0
@@ -210,6 +233,18 @@ def main():
             log(f"MFU {result['mfu']:.1%} ({result['tflops']} TFLOP/s of "
                 f"{peak_flops(dev) / 1e12:.0f} peak, "
                 f"{result['model_gflop_per_img']} GFLOP/img)")
+
+    if tel_path:
+        # static comm bill of the SINGLE-step program (the scan dispatch
+        # would be counted once per trip by the walker's scan scaling, but
+        # the single step is the canonical per-step quantity)
+        telemetry.record_comm_stats(step_fn, params, batch_stats,
+                                    opt_state, (x, y), name="comm")
+        jax.effects_barrier()   # flush async debug callbacks
+        telemetry.write_jsonl(tel_path)
+        result["telemetry"] = tel_path
+        log(f"telemetry written to {tel_path} — summarize with "
+            f"`python -m apex_tpu.telemetry summarize {tel_path}`")
 
     if os.environ.get("BENCH_PROFILE"):
         trace_dir = "/tmp/apex_tpu_bench_trace"

@@ -12,7 +12,7 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "== 1/6 package import =="
+echo "== 1/7 package import =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import apex_tpu
@@ -20,7 +20,7 @@ from apex_tpu import amp, optimizers, parallel, ops
 print('apex_tpu imports OK')
 "
 
-echo "== 2/6 native host runtime builds (g++ -O3 -shared) =="
+echo "== 2/7 native host runtime builds (g++ -O3 -shared) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 from apex_tpu import runtime
@@ -35,7 +35,7 @@ print('flatten/unflatten path OK')
 assert ok, 'host runtime failed to build — check g++ toolchain'
 "
 
-echo "== 3/6 graft entry compiles (single-device + 8-device dryrun) =="
+echo "== 3/7 graft entry compiles (single-device + 8-device dryrun) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import __graft_entry__ as ge
@@ -45,7 +45,7 @@ print('entry() compiles')
 ge.dryrun_multichip(8)
 "
 
-echo "== 4/6 package install (wheel build + clean --target install) =="
+echo "== 4/7 package install (wheel build + clean --target install) =="
 # The reference gates on Docker extension builds
 # (tests/docker_extension_builds/run.sh); the TPU analog: build the wheel
 # from pyproject.toml, install it into an empty --target dir, and import
@@ -88,14 +88,41 @@ jax.jit(step).lower(params, state).compile()
 print('installed-package train step compiles')
 ")
 
-echo "== 5/6 lint (apex_tpu.lint: trace safety / dtype policy / collectives) =="
+echo "== 5/7 lint (apex_tpu.lint: trace safety / dtype policy / collectives) =="
 # static gate BEFORE the test tier: AST pass over the package + graft
 # entry, jaxpr pass over the registered entry points. --strict: warnings
 # fail too (every intentional exception carries an inline suppression
 # with its why — see docs/lint.md). Use --format=github under CI bots.
 python -m apex_tpu.lint apex_tpu/ __graft_entry__.py --strict
 
-echo "== 6/6 pytest =="
+echo "== 6/7 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
+# A 3-step instrumented GPT train step on the CPU mesh must produce a
+# parseable JSONL carrying step timing, amp loss-scale/overflow, comm
+# bytes and MFU, and the summarize CLI must render it (exit 0) — the
+# runtime-observability analog of the lint stage's static gate.
+TEL_FILE="$(mktemp -d)/run.jsonl"
+python examples/gpt/train_lm.py --steps 3 --warmup-steps 0 --vocab 512 \
+    --layers 2 --embed-dim 64 --heads 2 --seq-len 128 --batch-size 1 \
+    --opt-level O2 --telemetry "$TEL_FILE" > /dev/null
+python -c "
+import json, sys
+path = sys.argv[1]
+names = set()
+with open(path) as f:
+    for line in f:
+        names.add(json.loads(line)['name'])   # every line must parse
+need = {'step/time_s', 'step/dispatch_s', 'step/device_wait_s',
+        'amp/overflow', 'amp/loss_scale', 'step/mfu'}
+missing = need - names
+assert not missing, f'telemetry JSONL missing {missing}; has {sorted(names)}'
+assert any(n.startswith('comm/') for n in names), \
+    f'no per-axis comm bytes in {sorted(names)}'
+print(f'telemetry smoke OK: {len(names)} distinct metrics')
+" "$TEL_FILE"
+python -m apex_tpu.telemetry summarize "$TEL_FILE" | head -5
+rm -rf "$(dirname "$TEL_FILE")"
+
+echo "== 7/7 pytest =="
 if [[ "${1:-}" == "--full" ]]; then
     # full suite + the complete L1 cross-product matrix (reference
     # tests/L1/cross_product{,_distributed}/run.sh); the convergence

@@ -96,6 +96,12 @@ def parse_args(argv=None):
     p.add_argument("--top-k", type=int, default=0)
     p.add_argument("--top-p", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--telemetry", default=None, metavar="PATH",
+                   help="write a runtime-telemetry JSONL here: per-step "
+                        "dispatch/device time split, tokens/s, MFU, "
+                        "amp overflow/loss-scale events, per-axis comm "
+                        "bytes; inspect with `python -m "
+                        "apex_tpu.telemetry summarize PATH`")
     p.add_argument("--scan", type=int, default=1,
                    help=">1: dispatch-proof mode — N steps per jitted "
                         "lax.scan dispatch with on-device token "
@@ -159,6 +165,11 @@ def _run_generate(args):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.telemetry:
+        # BEFORE any step is jitted: the amp scaler's overflow/loss-scale
+        # callbacks are traced into the program only while enabled
+        from apex_tpu import telemetry
+        telemetry.enable()
     if args.generate:
         return _run_generate(args)
     n_dev = len(jax.devices())
@@ -258,6 +269,14 @@ def main(argv=None):
         return _run_scan_mode(args, mesh, axis, per_device, step_fn,
                               params, opt_state, batch, model)
 
+    step_call = step_fn
+    if args.telemetry:
+        from apex_tpu import telemetry
+        # wraps every call with the dispatch/device split + tokens/s, and
+        # (lazily, from call 2) MFU off XLA's cost analysis of step_fn
+        step_call = telemetry.instrument_step(
+            step_fn, tokens_per_step=batch * args.seq_len)
+
     rng = np.random.default_rng(args.seed + 1)
     t0 = None
     flops_step = None
@@ -266,8 +285,8 @@ def main(argv=None):
             rng.integers(0, args.vocab, (batch, args.seq_len),
                          np.int32), shard)
         step_rng = jax.random.PRNGKey(args.seed + 2 + i)
-        params, opt_state, loss = step_fn(params, opt_state, tokens,
-                                          step_rng)
+        params, opt_state, loss = step_call(params, opt_state, tokens,
+                                            step_rng)
         if i == args.warmup_steps:
             jax.block_until_ready(loss)
             # cost analysis BEFORE the timed region (AOT compile; the
@@ -310,6 +329,16 @@ def main(argv=None):
                 + (" (cost analysis + analytic attention model FLOPs)"
                    if flash_opaque else " (cost-analysis count)"))
     print(msg)
+    if args.telemetry:
+        from apex_tpu import telemetry
+        # static comm bill of the step program (per device per step,
+        # grouped by mesh axis) joins the run file
+        telemetry.record_comm_stats(step_fn, params, opt_state, tokens,
+                                    step_rng, name="comm")
+        jax.effects_barrier()   # async debug callbacks land before export
+        telemetry.write_jsonl(args.telemetry)
+        print(f"telemetry: {args.telemetry} (python -m apex_tpu.telemetry "
+              f"summarize {args.telemetry})")
     return tok_s
 
 
@@ -405,6 +434,13 @@ def _run_scan_mode(args, mesh, axis, per_device, step_fn, params,
             msg += f", {mfu:.1%} MFU"
         msg += (" (cost analysis + analytic attention model FLOPs)"
                 if flash_opaque else " (cost-analysis count)")
+    if args.telemetry:
+        from apex_tpu import telemetry
+        telemetry.record_comm_stats(step_fn, params, opt_state, tok_aval,
+                                    rng_aval, name="comm")
+        jax.effects_barrier()
+        telemetry.write_jsonl(args.telemetry)
+        msg += f"\ntelemetry: {args.telemetry}"
     if args.moe and on_tpu:
         # Dense-equivalent MFU (VERDICT r4 weak #4): the cost-analysis
         # numerator counts the one-hot dispatch/combine einsums — real

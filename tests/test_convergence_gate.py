@@ -16,6 +16,13 @@ GATE = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "benchmarks", "convergence_gate.py")
 
 
+# Integration tier (PR 2): ~350 s of several-hundred-step training — 40%
+# of the whole 870 s tier-1 budget for one test, which no longer fits now
+# that the suite has grown (912 s measured). Rides `-m slow` like the
+# other heavy integration modules (PR 1 tiering); ci/gate.sh --full runs
+# the suite WITHOUT the slow filter, so the gate still executes there,
+# and the on-chip endpoints in BASELINE.md are unaffected.
+@pytest.mark.slow
 def test_quick_convergence_gate():
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                XLA_FLAGS="--xla_force_host_platform_device_count=1")

@@ -59,6 +59,16 @@ def test_prefetch_loader():
     assert out == [x * 2 for x in range(20)]
 
 
+def test_prefetch_loader_stats_counters():
+    loader = runtime.PrefetchLoader(iter(range(12)), depth=3, workers=1)
+    assert list(loader) == list(range(12))
+    st = loader.stats()
+    assert st["produced"] == 12 and st["consumed"] == 12
+    assert st["queue_depth"] == 0 and st["depth"] == 3
+    # fast source, fast consumer: starvation bounded by total fetches
+    assert 0 <= st["starvations"] <= 12
+
+
 def test_prefetch_loader_multiworker_complete():
     src = iter(range(50))
     loader = runtime.PrefetchLoader(src, depth=8, workers=3)

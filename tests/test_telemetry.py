@@ -1,0 +1,568 @@
+"""apex_tpu.telemetry: trace-safe record under jit/shard_map,
+instrument_step timing fields, comm-byte accounting vs hand-computed
+values on a 1xN mesh, JSONL round-trip + rotation, the summarize CLI on a
+fixture run, and the producer wiring (amp scaler, ZeRO, PrefetchLoader,
+device_peak_flops CPU fallback)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import telemetry
+from apex_tpu.telemetry import events as tel_events
+from apex_tpu.telemetry import export as tel_export
+from apex_tpu.telemetry.cli import main as cli_main
+
+
+@pytest.fixture
+def col():
+    """Fresh enabled collector; global state restored afterwards."""
+    with tel_events.capture() as c:
+        yield c
+
+
+def _by_name(col, name):
+    return [e for e in col.snapshot() if e.name == name]
+
+
+# ---------------------------------------------------------------------------
+# events / collector
+# ---------------------------------------------------------------------------
+
+def test_disabled_record_is_noop():
+    telemetry.get_collector().clear()
+    assert not telemetry.enabled()
+    telemetry.record("x", 1.0)
+    telemetry.record_static("y", 2.0)
+    assert len(telemetry.get_collector()) == 0
+
+
+def test_collector_bounded_drops_oldest():
+    c = tel_events.Collector(capacity=4)
+    for i in range(7):
+        c.record("n", float(i))
+    evs = c.snapshot()
+    assert len(evs) == 4
+    assert [e.value for e in evs] == [3.0, 4.0, 5.0, 6.0]
+    assert c.dropped == 3
+
+
+def test_static_dedup_across_retraces(col):
+    for _ in range(3):
+        telemetry.record_static("comm/x", 5.0, dedup_key=("a", 1))
+    telemetry.record_static("comm/x", 7.0, dedup_key=("a", 2))
+    assert [e.value for e in _by_name(col, "comm/x")] == [5.0, 7.0]
+
+
+def test_event_dict_roundtrip():
+    e = tel_events.Event("a/b", 1.5, ts=12.0, step=3, kind="counter",
+                        meta={"axis": "data"})
+    assert tel_events.Event.from_dict(e.to_dict()) == e
+
+
+# ---------------------------------------------------------------------------
+# trace-safe record
+# ---------------------------------------------------------------------------
+
+def test_record_under_jit(col):
+    @jax.jit
+    def f(a):
+        telemetry.record("jit/sum", jnp.sum(a), step=7)
+        return a * 2
+
+    jax.block_until_ready(f(jnp.ones((8,))))
+    jax.effects_barrier()
+    evs = _by_name(col, "jit/sum")
+    assert len(evs) == 1
+    assert evs[0].value == 8.0 and evs[0].step == 7
+
+
+def test_record_traced_step_attribution(col):
+    @jax.jit
+    def f(a, s):
+        telemetry.record("jit/v", jnp.max(a), step=s)
+        return a
+
+    jax.block_until_ready(f(jnp.full((3,), 4.0), jnp.int32(11)))
+    jax.effects_barrier()
+    (e,) = _by_name(col, "jit/v")
+    assert (e.value, e.step) == (4.0, 11)
+
+
+def test_record_under_shard_map(col):
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+
+    def body(x):
+        s = jax.lax.psum(jnp.sum(x), "data")
+        telemetry.record("sm/total", s, step=0)
+        return s
+
+    f = jax.jit(shard_map(body, mesh=mesh, in_specs=P("data"),
+                          out_specs=P(), check_vma=False))
+    out = f(jnp.ones((8, 4)))
+    jax.block_until_ready(out)
+    jax.effects_barrier()
+    evs = _by_name(col, "sm/total")
+    # one callback per shard, all carrying the replicated global value
+    assert 1 <= len(evs) <= 8
+    assert all(e.value == 32.0 for e in evs)
+    # the summarize dedup collapses the replicas to one step sample
+    agg = tel_export.summarize([e.to_dict() for e in evs])
+    assert agg["events"] == len(evs)
+
+
+def test_record_inside_scan(col):
+    @jax.jit
+    def f(x):
+        def body(c, i):
+            telemetry.record("scan/c", c, step=i)
+            return c + 1.0, c
+        c, _ = jax.lax.scan(body, x, jnp.arange(4))
+        return c
+
+    jax.block_until_ready(f(jnp.float32(0.0)))
+    jax.effects_barrier()
+    evs = _by_name(col, "scan/c")
+    assert sorted((e.step, e.value) for e in evs) == [
+        (0, 0.0), (1, 1.0), (2, 2.0), (3, 3.0)]
+
+
+# ---------------------------------------------------------------------------
+# instrument_step
+# ---------------------------------------------------------------------------
+
+def test_instrument_step_fields(col):
+    step = telemetry.instrument_step(
+        jax.jit(lambda x: x * 2 + 1), tokens_per_step=1024)
+    x = jnp.ones((16, 64))
+    for _ in range(3):
+        x = step(x)
+    jax.effects_barrier()
+    for suffix in ("time_s", "dispatch_s", "device_wait_s",
+                   "tokens_per_s"):
+        evs = _by_name(col, f"step/{suffix}")
+        assert len(evs) == 3, suffix
+        assert [e.step for e in evs] == [0, 1, 2]
+        assert all(e.value >= 0 for e in evs)
+    # dispatch + wait == total, per step
+    for t, d, w in zip(_by_name(col, "step/time_s"),
+                       _by_name(col, "step/dispatch_s"),
+                       _by_name(col, "step/device_wait_s")):
+        assert t.value == pytest.approx(d.value + w.value, rel=1e-6)
+    # flops measured lazily (from call 2) -> static event + MFU samples
+    assert len(_by_name(col, "step/model_flops")) == 1
+    assert len(_by_name(col, "step/mfu")) == 2
+    assert all(e.value > 0 for e in _by_name(col, "step/mfu"))
+
+
+def test_instrument_step_passthrough_and_disabled():
+    step = telemetry.instrument_step(lambda a, b: a + b)
+    assert not telemetry.enabled()
+    assert step(2, 3) == 5            # disabled: pure passthrough
+    assert len(telemetry.get_collector()) == 0
+
+
+def test_instrument_step_sync_every(col):
+    step = telemetry.instrument_step(jax.jit(lambda x: x + 1),
+                                     sync_every=2, measure_flops=False)
+    x = jnp.zeros(())
+    for _ in range(4):
+        x = step(x)
+    assert float(x) == 4.0
+    assert [e.step for e in _by_name(col, "step/time_s")] == [0, 2]
+
+
+def test_instrument_step_model_flops_override(col):
+    step = telemetry.instrument_step(jax.jit(lambda x: x), name="b",
+                                     model_flops=1e9, peak_flops=1e12)
+    x = jnp.zeros((4,))
+    for _ in range(2):
+        x = step(x)
+    (fl,) = _by_name(col, "b/model_flops")
+    assert fl.value == 1e9 and fl.kind == "static"
+    mfu = _by_name(col, "b/mfu")
+    assert len(mfu) == 2
+    # mfu = 1e9 / t / 1e12 = 1e-3 / t
+    for e, t in zip(mfu, _by_name(col, "b/time_s")):
+        assert e.value == pytest.approx(1e-3 / t.value, rel=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# comm accounting (hand-computed on the 1x8 CPU mesh)
+# ---------------------------------------------------------------------------
+
+def test_comm_stats_hand_computed():
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+
+    def body(x):
+        return jax.lax.psum(x, "data"), jax.lax.all_gather(x, "data")
+
+    f = shard_map(body, mesh=mesh, in_specs=P("data"),
+                  out_specs=(P(), P()), check_vma=False)
+    x = jnp.ones((8, 128), jnp.float32)   # per-shard (1, 128) f32 = 512 B
+    recs = {r.primitive: r for r in telemetry.comm_stats(f, x)}
+    assert set(recs) == {"psum", "all_gather"}
+    ps, ag = recs["psum"], recs["all_gather"]
+    assert (ps.axis, ps.count, ps.bytes_in) == ("data", 1, 512.0)
+    assert ps.bytes_wire == pytest.approx(2 * 7 / 8 * 512)   # ring AR
+    assert (ag.count, ag.bytes_in) == (1, 512.0)
+    assert ag.bytes_wire == pytest.approx(7 * 512)           # ring AG
+
+
+def test_comm_stats_scan_scaling_and_axis_sizes_arg():
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+
+    def body(x):
+        def it(c, _):
+            return jax.lax.psum(c, "data"), None
+        c, _ = jax.lax.scan(it, x, None, length=5)
+        return c
+
+    f = shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P(),
+                  check_vma=False)
+    x = jnp.ones((8, 16), jnp.float32)    # per-shard 64 B
+    (r,) = telemetry.comm_stats(f, x)
+    assert (r.count, r.bytes_in) == (5, 5 * 64.0)
+    assert r.bytes_wire == pytest.approx(5 * 64 * 2 * 7 / 8)
+
+
+def test_comm_stats_axis_sizes_arg_and_unknown_axis():
+    # a bare collective fragment (no enclosing shard_map): the axis size
+    # must come from the caller; without it the wire bill is None
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("ax",))
+
+    def bare(x):
+        return jax.lax.psum(x, "ax")
+
+    f = shard_map(bare, mesh=mesh, in_specs=P(), out_specs=P(),
+                  check_vma=False)
+    (r,) = telemetry.comm_stats(f, jnp.ones((4,), jnp.float32))
+    assert r.bytes_in == 16.0
+    assert r.bytes_wire == pytest.approx(2 * 3 / 4 * 16)
+    # explicit axis_sizes pre-seed is honored where the mesh is unknown
+    (r2,) = telemetry.comm_stats(f, jnp.ones((4,), jnp.float32),
+                                 axis_sizes={"other": 2})
+    assert r2.bytes_wire == pytest.approx(2 * 3 / 4 * 16)
+
+
+def test_record_comm_stats_emits_static_events(col):
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+    f = shard_map(lambda x: jax.lax.psum(x, "data"), mesh=mesh,
+                  in_specs=P("data"), out_specs=P(), check_vma=False)
+    x = jnp.ones((8, 32), jnp.float32)
+    telemetry.record_comm_stats(f, x)
+    telemetry.record_comm_stats(f, x)   # retrace: dedup'd
+    evs = _by_name(col, "comm/data/psum_bytes")
+    assert len(evs) == 1
+    assert evs[0].value == 128.0 and evs[0].kind == "static"
+    assert evs[0].meta["axis"] == "data"
+
+
+# ---------------------------------------------------------------------------
+# export: JSONL round-trip, rotation, CSV, summarize
+# ---------------------------------------------------------------------------
+
+def test_jsonl_roundtrip(tmp_path, col):
+    telemetry.record("a", 1.0, step=0)
+    telemetry.record("a", 2.0, step=1)
+    telemetry.record_static("s", 3.0, meta={"k": "v"})
+    path = str(tmp_path / "run.jsonl")
+    telemetry.write_jsonl(path)           # drains the collector
+    assert len(col) == 0
+    back = telemetry.read_jsonl(path)
+    assert [(d["name"], d["value"]) for d in back] == [
+        ("a", 1.0), ("a", 2.0), ("s", 3.0)]
+    assert back[2]["kind"] == "static" and back[2]["meta"] == {"k": "v"}
+
+
+def test_jsonl_rotation(tmp_path):
+    path = str(tmp_path / "r.jsonl")
+    with tel_export.JsonlWriter(path, max_bytes=200, max_files=2) as w:
+        for i in range(20):
+            w.write(tel_events.Event("n", float(i), ts=0.0))
+    assert os.path.exists(path) and os.path.exists(path + ".1")
+    assert not os.path.exists(path + ".3")
+    # every surviving line still parses
+    for p in (path, path + ".1", path + ".2"):
+        if os.path.exists(p):
+            telemetry.read_jsonl(p)
+
+
+def test_read_jsonl_rejects_malformed(tmp_path):
+    p = tmp_path / "bad.jsonl"
+    p.write_text('{"name": "a", "value": 1}\nnot json\n')
+    with pytest.raises(ValueError, match="bad.jsonl:2"):
+        telemetry.read_jsonl(str(p))
+
+
+def test_csv_export(tmp_path):
+    path = str(tmp_path / "out.csv")
+    tel_export.write_csv(path, [tel_events.Event("n", 1.0, ts=2.0, step=3)])
+    lines = open(path).read().strip().splitlines()
+    assert lines[0] == "name,value,ts,step,kind"
+    assert lines[1] == "n,1.0,2.0,3,point"
+
+
+def _fixture_events():
+    evs = []
+    for step in range(10):
+        evs.append({"name": "step/time_s", "value": 0.1 + 0.01 * step,
+                    "ts": float(step), "step": step})
+        evs.append({"name": "step/dispatch_s", "value": 0.02,
+                    "ts": float(step), "step": step})
+        evs.append({"name": "step/device_wait_s",
+                    "value": 0.08 + 0.01 * step, "ts": float(step),
+                    "step": step})
+        # two shards' worth of replicated amp events
+        for _ in range(2):
+            evs.append({"name": "amp/overflow",
+                        "value": 1.0 if step == 3 else 0.0,
+                        "ts": float(step), "step": step})
+            evs.append({"name": "amp/loss_scale",
+                        "value": 2.0 ** 16 / (2 if step >= 3 else 1),
+                        "ts": float(step), "step": step})
+    evs.append({"name": "ddp/data/allreduce_bytes", "value": 4096.0,
+                "ts": 0.0, "kind": "static",
+                "meta": {"axis": "data", "primitive": "psum", "count": 2,
+                         "bytes_wire": 7168}})
+    evs.append({"name": "step/model_flops", "value": 1e9, "ts": 0.0,
+                "kind": "static"})
+    evs.append({"name": "data/starvation", "value": 1.0, "ts": 0.0,
+                "kind": "counter"})
+    return evs
+
+
+def test_summarize_aggregates():
+    s = tel_export.summarize(_fixture_events())
+    assert s["step_time_s"]["count"] == 10
+    assert s["step_time_s"]["p50"] == pytest.approx(0.145)
+    assert s["step_time_s"]["max"] == pytest.approx(0.19)
+    # replicated shard samples collapse to one per step
+    assert s["overflow"] == {"steps": 10, "overflows": 1, "rate": 0.1}
+    tl = dict(map(tuple, s["loss_scale"]["timeline"]))
+    assert tl[0] == 2.0 ** 16 and tl[9] == 2.0 ** 15
+    assert s["comm"]["data"]["bytes_in_per_step"] == 4096.0
+    assert s["comm"]["data"]["collectives"]["psum"]["count"] == 2
+    assert s["static"]["step/model_flops"] == 1e9
+    assert s["counters"]["data/starvation"] == 1.0
+
+
+def test_summarize_no_double_count_walker_vs_producer():
+    """A run carrying BOTH the jaxpr walker's comm bill and the ddp/zero
+    producer events for the same axis must not sum the same bytes twice:
+    walker events are the complete account, producers become a named
+    breakdown."""
+    evs = [
+        {"name": "comm/data/psum_bytes", "value": 1000.0, "ts": 0.0,
+         "kind": "static",
+         "meta": {"axis": "data", "primitive": "psum", "count": 3}},
+        {"name": "ddp/data/allreduce_bytes", "value": 900.0, "ts": 0.0,
+         "kind": "static",
+         "meta": {"axis": "data", "primitive": "psum", "count": 2}},
+        # a producer-only axis still gets its totals from the producer
+        {"name": "zero/model/reduce_scatter_bytes", "value": 512.0,
+         "ts": 0.0, "kind": "static",
+         "meta": {"axis": "model", "primitive": "psum_scatter",
+                  "count": 1}},
+    ]
+    s = tel_export.summarize(evs)
+    assert s["comm"]["data"]["bytes_in_per_step"] == 1000.0
+    assert s["comm"]["data"]["producers"] == {
+        "ddp/data/allreduce_bytes": 900.0}
+    assert s["comm"]["model"]["bytes_in_per_step"] == 512.0
+
+
+def test_summarize_cli_on_fixture_run(tmp_path, capsys):
+    path = str(tmp_path / "fix.jsonl")
+    tel_export.write_jsonl(path, _fixture_events())
+    assert cli_main(["summarize", path]) == 0
+    out = capsys.readouterr().out
+    for frag in ("step time", "overflow", "loss scale", "axis 'data'",
+                 "psum"):
+        assert frag in out, frag
+    assert cli_main(["summarize", path, "--json"]) == 0
+    agg = json.loads(capsys.readouterr().out)
+    assert agg["overflow"]["overflows"] == 1
+    assert cli_main(["tail", path, "-n", "3"]) == 0
+    assert cli_main(["summarize", str(tmp_path / "missing.jsonl")]) == 1
+
+
+# ---------------------------------------------------------------------------
+# producer wiring
+# ---------------------------------------------------------------------------
+
+def test_amp_scaler_emits_overflow_and_scale(col):
+    from apex_tpu import amp, optimizers
+
+    inner = optimizers.FusedSGD(lr=0.1)
+    _, aopt = amp.initialize(None, inner, opt_level="O2", verbosity=0)
+    params = {"w": jnp.ones((4, 4), jnp.float16)}
+    state = aopt.init(params)
+
+    @jax.jit
+    def step(g, p, s):
+        return aopt.step(g, p, s)
+
+    # clean grads, then an overflow (inf) step
+    good = {"w": jnp.ones((4, 4), jnp.float16)}
+    bad = {"w": jnp.full((4, 4), jnp.inf, jnp.float16)}
+    params, state, _ = step(good, params, state)
+    params, state, _ = step(bad, params, state)
+    jax.block_until_ready(state.scaler.loss_scale)
+    jax.effects_barrier()
+    ov = _by_name(col, "amp/overflow")
+    ls = _by_name(col, "amp/loss_scale")
+    assert [e.value for e in ov] == [0.0, 1.0]
+    # execution-index attribution: advances even though the overflow
+    # execution skipped the inner optimizer step
+    assert [e.step for e in ov] == [0, 1]
+    assert ls[0].value == 2.0 ** 16
+    assert ls[1].value == 2.0 ** 15        # halved on overflow
+
+
+def test_zero_emits_comm_bytes(col):
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+
+    n = 8
+    mesh = Mesh(np.asarray(jax.devices()[:n]), ("data",))
+    opt = DistributedFusedAdam(lr=1e-3, axis_name="data", shard_count=n)
+    p = {"w": jnp.ones((8, 16)), "b": jnp.ones((8,))}   # 136 el -> pad 136
+    st = opt.init(p)
+
+    f = jax.jit(shard_map(
+        lambda g, p, s: opt.step(g, p, s), mesh=mesh,
+        in_specs=(P(), P(), opt.state_pspec()),
+        out_specs=(P(), opt.state_pspec()), check_vma=False))
+    new_p, new_st = f(p, p, st)
+    jax.block_until_ready(new_st.master)
+    rs = _by_name(col, "zero/data/reduce_scatter_bytes")
+    ag = _by_name(col, "zero/data/all_gather_bytes")
+    assert len(rs) == 1 and len(ag) == 1
+    # 136 elements pad to 136 (17 * 8) -> 544 B f32 in; shard k=17 -> 68 B
+    assert rs[0].value == 544.0
+    assert rs[0].meta["bytes_wire"] == round(544 * 7 / 8)
+    assert ag[0].value == 68.0
+    assert ag[0].meta["bytes_wire"] == 68 * 7
+
+
+def test_ddp_emits_comm_bytes(col):
+    from apex_tpu import parallel
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+    grads = {"a": jnp.ones((16, 8), jnp.float32),
+             "b": jnp.ones((32,), jnp.bfloat16)}
+
+    f = jax.jit(shard_map(
+        lambda g: parallel.allreduce_gradients(g, "data"), mesh=mesh,
+        in_specs=(P(),), out_specs=P(), check_vma=False))
+    jax.block_until_ready(f(grads))
+    (e,) = _by_name(col, "ddp/data/allreduce_bytes")
+    assert e.value == 16 * 8 * 4 + 32 * 2
+    assert e.meta["count"] == 2       # one bucket per dtype
+    assert e.meta["world"] == 8
+
+
+def test_prefetch_loader_stats_and_telemetry(col):
+    from apex_tpu import runtime
+
+    loader = runtime.PrefetchLoader(iter(range(10)), depth=4, workers=1)
+    out = list(loader)
+    assert sorted(out) == list(range(10))
+    st = loader.stats()
+    assert st["produced"] == 10 and st["consumed"] == 10
+    assert 0 <= st["starvations"] <= 10
+    assert st["queue_depth"] == 0 and st["depth"] == 4
+    depth_evs = _by_name(col, "data/queue_depth")
+    assert len(depth_evs) == 10
+    starve_evs = _by_name(col, "data/starvation")
+    assert len(starve_evs) == st["starvations"]
+    assert all(e.kind == "counter" for e in starve_evs)
+
+
+def test_prefetch_loader_starvation_counts_slow_source():
+    import time as _time
+
+    from apex_tpu import runtime
+
+    def slow():
+        for i in range(5):
+            _time.sleep(0.05)
+            yield i
+
+    loader = runtime.PrefetchLoader(slow(), depth=4, workers=1)
+    assert list(loader) == list(range(5))
+    # a source slower than the consumer starves every fetch
+    assert loader.stats()["starvations"] >= 4
+
+
+def test_device_peak_flops_cpu_fallback(monkeypatch):
+    from apex_tpu.pyprof import prof
+
+    monkeypatch.delenv("APEX_TPU_PEAK_FLOPS", raising=False)
+    monkeypatch.delenv("BENCH_PEAK_FLOPS", raising=False)
+    peak = prof.device_peak_flops()           # CPU backend under tests
+    assert peak == prof.PEAK_CPU_NOMINAL
+    assert np.isfinite(peak) and peak > 0
+    monkeypatch.setenv("APEX_TPU_PEAK_FLOPS", "5e12")
+    assert prof.device_peak_flops() == 5e12   # calibrated override wins
+
+
+# Integration tier: ~40 s (compiles an amp GPT shard_map step). The same
+# product path runs in ci/gate.sh stage 6/7 (instrumented train_lm ->
+# JSONL -> summarize); the unit tests above cover every piece separately.
+@pytest.mark.slow
+def test_instrumented_train_step_end_to_end(tmp_path, col):
+    """The acceptance path in miniature: an amp GPT train step under
+    shard_map emits step-time, loss-scale/overflow, comm and MFU events;
+    the JSONL parses; summarize renders it."""
+    from apex_tpu import amp, optimizers
+    from apex_tpu.models import GPTTiny
+    from apex_tpu.models.gpt import next_token_loss
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+    m = GPTTiny(vocab_size=64, max_seq=16, dtype=jnp.float16)
+    toks = jnp.zeros((8, 16), jnp.int32)
+    params32 = m.init(jax.random.PRNGKey(0), toks[:1])["params"]
+    inner = optimizers.FusedAdam(lr=1e-3)
+    _, aopt = amp.initialize(None, inner, opt_level="O2", verbosity=0)
+    params = amp.cast_model(params32, amp.resolve(
+        "O2", keep_batchnorm_fp32=False))
+    state = aopt.init(params)
+
+    def per_device(p, s, t):
+        def scaled(p):
+            return aopt.scale_loss(
+                next_token_loss(m.apply({"params": p}, t), t), s)
+        g = jax.grad(scaled)(p)
+        g = jax.lax.pmean(g, "data")
+        new_p, new_s, info = aopt.step(g, p, s)
+        return new_p, new_s, info["loss_scale"]
+
+    step_fn = jax.jit(shard_map(
+        per_device, mesh=mesh, in_specs=(P(), P(), P("data")),
+        out_specs=(P(), P(), P()), check_vma=False))
+    step = telemetry.instrument_step(step_fn,
+                                     tokens_per_step=toks.size)
+    for _ in range(3):
+        params, state, scale = step(params, state, toks)
+    telemetry.record_comm_stats(step_fn, params, state, toks)
+    jax.block_until_ready(scale)
+    jax.effects_barrier()
+
+    path = str(tmp_path / "run.jsonl")
+    telemetry.write_jsonl(path)
+    agg = tel_export.summarize(telemetry.read_jsonl(path))
+    assert agg["step_time_s"]["count"] == 3
+    assert "dispatch_s" in agg and "device_wait_s" in agg
+    assert agg["overflow"]["steps"] == 3
+    assert agg["loss_scale"]["timeline"]
+    assert agg["comm"]["data"]["bytes_in_per_step"] > 0
+    assert "mfu" in agg            # CPU cost analysis + nominal peak
+    assert cli_main(["summarize", path]) == 0
