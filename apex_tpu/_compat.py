@@ -30,6 +30,22 @@ def _axis_size(axis_name):
         raise NameError(f"unbound axis name: {axis_name}") from e
 
 
+def cost_analysis_value(cost, key: str, default=None):
+    """Look up an XLA cost-analysis key accepting BOTH spellings.
+
+    jax/jaxlib versions disagree on whether compiled cost-analysis keys
+    use spaces or underscores ("bytes accessed" vs "bytes_accessed",
+    "optimal_seconds" vs "optimal seconds"); a caller keying on one
+    spelling silently reads None on the other. Returns whichever
+    variant is present, else ``default``."""
+    if not cost:
+        return default
+    if key in cost:
+        return cost[key]
+    alt = key.replace(" ", "_") if " " in key else key.replace("_", " ")
+    return cost.get(alt, default)
+
+
 def install() -> None:
     """Idempotently add missing modern-JAX names. Safe to call many times."""
     if not hasattr(jax.lax, "axis_size"):

@@ -71,6 +71,25 @@ class AmpOptimizer:
             return loss
         return self.scaler.scale_loss(loss, state.scaler, loss_id)
 
+    def execution_index(self, state: AmpOptimizerState,
+                        loss_id: int = 0):
+        """Monotone per-CALL step index for telemetry attribution.
+
+        ``inner.step`` counts only successful (non-overflow) applies —
+        it freezes while the dynamic scaler skips — so successes +
+        cumulative overflows advances exactly once per ``step()`` call.
+        ONE definition shared by every health/telemetry producer
+        (overflow attribution in :meth:`step`, grad_stats / ddp bucket
+        norms in trainers): series recorded against it join the scaler's
+        ``amp/overflow`` / ``amp/loss_scale`` timelines in summarize's
+        (name, step) dedup, and a drifting copy would silently mis-join
+        them. Returns None when the inner optimizer keeps no ``step``;
+        trace-safe (a traced scalar inside jit)."""
+        step = getattr(state.inner, "step", None)
+        if step is None:
+            return None
+        return step + state.scaler.overflows[loss_id]
+
     # -- the step ----------------------------------------------------------
     def step(self, scaled_grads: Tree, model_params: Tree,
              state: AmpOptimizerState, loss_id: int = 0,
@@ -143,9 +162,18 @@ class AmpOptimizer:
         from apex_tpu import telemetry
         step_idx = None
         if telemetry.enabled():
-            step_idx = getattr(state.inner, "step", None)
-            if step_idx is not None:
-                step_idx = step_idx + state.scaler.overflows[loss_id]
+            step_idx = self.execution_index(state, loss_id)
+        # non-finite provenance (telemetry.health): when the overflow
+        # flag fires, count NaN/Inf per named param group over the
+        # SCALED grads (that is where the non-finites live) and name the
+        # first offending group. The per-group reduction runs only on
+        # the overflow branch (lax.cond inside attribute_overflow); with
+        # health disabled nothing is traced.
+        if props.enabled and dynamic:
+            from apex_tpu.telemetry import health as _health
+            if _health.enabled():
+                _health.attribute_overflow(overflow, scaled_grads,
+                                           step=step_idx)
         new_scaler = self.scaler.update(state.scaler, overflow, loss_id,
                                         step=step_idx)
         new_state = AmpOptimizerState(inner=new_inner, master=new_master,

@@ -104,7 +104,12 @@ class LossScaler:
         attributes them to a step counter (AmpOptimizer passes its
         execution index — successes + overflows — so the series stays
         per-step even when overflow skips freeze the inner optimizer
-        step). Disabled: zero cost, nothing traced."""
+        step). Disabled: zero cost, nothing traced.
+
+        The scaler sees only the flag, not the grads, so WHICH param
+        group went non-finite is attributed one level up:
+        AmpOptimizer.step calls ``telemetry.health.attribute_overflow``
+        on the scaled grad tree when ``telemetry.health`` is enabled."""
         new_state = self._update(state, overflow, loss_id)
         from apex_tpu import telemetry
         if telemetry.enabled():

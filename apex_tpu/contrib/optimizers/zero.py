@@ -289,7 +289,8 @@ class _ZeroBase(FusedOptimizer):
         )
 
     # -- collectives -------------------------------------------------------
-    def _scatter_grads(self, grads: Tree, spec) -> jax.Array:
+    def _scatter_grads(self, grads: Tree, spec,
+                       telemetry_step=None) -> jax.Array:
         """Replicated grad tree -> reduced local shard (mean over the full
         data-parallel world).
 
@@ -337,6 +338,20 @@ class _ZeroBase(FusedOptimizer):
             if self.group_axis is not None:
                 sh = jax.lax.psum(sh, self.group_axis)
             shards.append(sh)
+        from apex_tpu.telemetry import health as _health
+        if _health.enabled():
+            # numerics health: per-bucket grad norms off the ALREADY
+            # reduced shards (each device holds a distinct slice of the
+            # summed bucket, so psum of local sum-of-squares over the
+            # shard axis is the full bucket's norm²; / world reports the
+            # MEAN-gradient norm the optimizer actually steps on).
+            # Cardinality is bounded by the bucket count.
+            from apex_tpu import telemetry
+            for i, sh in enumerate(shards):
+                n2 = jax.lax.psum(jnp.sum(jnp.square(sh)), self.axis_name)
+                telemetry.record(
+                    f"health/zero/bucket{i}/grad_norm",
+                    jnp.sqrt(n2) / world, step=telemetry_step)
         shard = shards[0] if len(shards) == 1 else jnp.concatenate(shards)
         return shard / world
 
@@ -454,7 +469,7 @@ class DistributedFusedAdam(_ZeroBase):
              ) -> Tuple[Tree, ZeroState]:
         spec = self._spec_cache or self._pack(params)
         step = state.step + 1
-        g = self._scatter_grads(grads, spec)
+        g = self._scatter_grads(grads, spec, telemetry_step=step)
         if grad_scale is not None:
             g = g / grad_scale
 
@@ -519,7 +534,7 @@ class DistributedFusedLAMB(_ZeroBase):
         spec = self._spec_cache or self._pack(params)
         num_tensors = len(spec["sizes"])
         step = state.step + 1
-        g = self._scatter_grads(grads, spec)
+        g = self._scatter_grads(grads, spec, telemetry_step=step)
         if grad_scale is not None:
             g = g / grad_scale
 

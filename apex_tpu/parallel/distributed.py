@@ -54,6 +54,7 @@ def allreduce_gradients(
     gradient_average: bool = True,
     gradient_predivide_factor: float = 1.0,
     axis_index_groups=None,
+    telemetry_step=None,
 ) -> Tree:
     """Leaf-grouped bucketed gradient allreduce over a mesh axis (the hot
     path of reference DDP: create_hooks/comm_ready_buckets/allreduce_bucket,
@@ -66,7 +67,12 @@ def allreduce_gradients(
     leaf larger than ``message_size`` still gets a chunked psum (slices of
     one leaf keep the same dependency footprint) for DCN message sizing.
     ``message_size=0`` disables bucketing (one whole-tree bucket per
-    dtype — the pre-r3 barrier form, kept for A/B comparison)."""
+    dtype — the pre-r3 barrier form, kept for A/B comparison).
+
+    ``telemetry_step``: optional step index (host int or traced scalar)
+    attached to the per-bucket ``health/`` events so replicated per-shard
+    emissions collapse in summarize's (name, step) dedup and the series
+    lines up with the overflow/loss timelines."""
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if not leaves:
         return grads
@@ -95,8 +101,11 @@ def allreduce_gradients(
     postdivide = (world / gradient_predivide_factor
                   if gradient_average else 1.0)
 
+    from apex_tpu.telemetry import health as _health
+    health_on = _health.enabled()
+
     out: list = [None] * len(leaves)
-    for _, idxs in buckets:
+    for bi, (_, idxs) in enumerate(buckets):
         flat, spec = _buckets.flatten_tensors([leaves[i] for i in idxs])
         orig_dtype = flat.dtype
         if allreduce_always_fp32 and orig_dtype != jnp.float32:
@@ -114,6 +123,15 @@ def allreduce_gradients(
             flat = psum(flat)
         if postdivide != 1.0:
             flat = flat / postdivide
+        if health_on:
+            # numerics health: per-bucket grad norm off the already
+            # reduced flat view — the synced gradient the optimizer will
+            # actually consume. One fused reduction per bucket; nothing
+            # traced when health is off.
+            telemetry.record(
+                f"health/ddp/bucket{bi}/grad_norm",
+                jnp.sqrt(jnp.sum(jnp.square(flat.astype(jnp.float32)))),
+                step=telemetry_step)
         if flat.dtype != orig_dtype:
             flat = flat.astype(orig_dtype)
         for i, t in zip(idxs, _buckets.unflatten_tensors(flat, spec)):

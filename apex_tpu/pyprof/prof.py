@@ -14,17 +14,22 @@ import jax
 
 
 def analyze(fn: Callable, *args, static_argnums=(), **kwargs) -> Dict[str, Any]:
-    """Compile ``fn(*args, **kwargs)`` and return XLA's cost/memory analysis."""
+    """Compile ``fn(*args, **kwargs)`` and return XLA's cost/memory analysis.
+
+    Cost-analysis key spellings differ across jax versions ("bytes
+    accessed" vs "bytes_accessed"); both are accepted via
+    :func:`apex_tpu._compat.cost_analysis_value`."""
+    from apex_tpu._compat import cost_analysis_value
     compiled = (jax.jit(fn, static_argnums=static_argnums)
                 .lower(*args, **kwargs).compile())
     cost = compiled.cost_analysis() or {}
     if isinstance(cost, (list, tuple)):
         cost = cost[0] if cost else {}
     out: Dict[str, Any] = {
-        "flops": cost.get("flops"),
-        "bytes_accessed": cost.get("bytes accessed"),
-        "transcendentals": cost.get("transcendentals"),
-        "optimal_seconds": cost.get("optimal_seconds"),
+        "flops": cost_analysis_value(cost, "flops"),
+        "bytes_accessed": cost_analysis_value(cost, "bytes accessed"),
+        "transcendentals": cost_analysis_value(cost, "transcendentals"),
+        "optimal_seconds": cost_analysis_value(cost, "optimal_seconds"),
     }
     if out["flops"] and out["bytes_accessed"]:
         out["arithmetic_intensity"] = out["flops"] / out["bytes_accessed"]

@@ -14,10 +14,19 @@ while it happens:
   * :mod:`comm`        — static per-step communication accounting: bytes
     per collective per mesh axis from the jaxpr (the quantity that decides
     all-reduce vs ZeRO reduce-scatter+all-gather, arXiv:2004.13336).
-  * :mod:`export`      — JSONL/CSV writers with rotation; ``summarize``
-    aggregation.
-  * :mod:`cli`         — ``python -m apex_tpu.telemetry summarize
-    run.jsonl``.
+  * :mod:`health`      — numerics-health observability: trace-safe
+    per-layer grad/weight/update statistics (:func:`health.grad_stats`),
+    non-finite provenance + overflow attribution
+    (:func:`health.attribute_overflow`, wired into ``amp.optimizer``),
+    and host-side divergence detection
+    (:class:`health.DivergenceDetector`, offline
+    :func:`health.detect`). Own trace-time flag: ``health.enable()``.
+  * :mod:`export`      — JSONL/CSV writers with rotation; ``load`` with
+    rotation-following; ``summarize`` aggregation (incl. the health
+    section).
+  * :mod:`cli`         — ``python -m apex_tpu.telemetry
+    summarize|health|tail|csv run.jsonl`` (``health`` exits 3 on
+    divergence alerts).
 
 Producers wired through the stack (all no-ops until :func:`enable`):
 ``amp.scaler`` (overflow + loss-scale), ``parallel.distributed`` and
@@ -44,15 +53,26 @@ from apex_tpu.telemetry.instrument import (instrument_step, record,
                                            record_static)
 from apex_tpu.telemetry.comm import (CommRecord, comm_stats, format_comm,
                                      record_comm_stats)
-from apex_tpu.telemetry.export import (JsonlWriter, format_summary,
+from apex_tpu.telemetry.export import (JsonlWriter, format_summary, load,
                                        read_jsonl, summarize, write_csv,
                                        write_jsonl as _write_jsonl_events)
+from apex_tpu.telemetry import health
+from apex_tpu.telemetry.health import (DivergenceDetector,
+                                       attribute_overflow, grad_stats)
 
 
 def write_jsonl(path: str, events=None, **kwargs) -> str:
     """Write ``events`` (default: drain the global collector) to ``path``.
     The default drain clears the collector, so back-to-back runs into
-    separate files don't cross-contaminate."""
+    separate files don't cross-contaminate. A nonzero ``dropped`` count
+    is appended as a ``telemetry/dropped`` counter event so silent event
+    loss can't masquerade as a healthy run (summarize warns on it)."""
     if events is None:
-        events = get_collector().drain()
+        import time as _time
+        col = get_collector()
+        events, dropped = col.drain(with_dropped=True)
+        if dropped:
+            events.append(Event(
+                "telemetry/dropped", float(dropped), ts=_time.time(),
+                kind="counter", meta={"capacity": col.capacity}))
     return _write_jsonl_events(path, events, **kwargs)

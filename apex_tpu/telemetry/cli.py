@@ -5,13 +5,25 @@ Subcommands:
   summarize RUN.jsonl [--json]   step-time percentiles (dispatch/device
                                  split), throughput, MFU, overflow rate,
                                  loss-scale timeline, per-axis comm bytes,
-                                 pipeline counters.
+                                 pipeline counters, numerics health.
+  health RUN.jsonl [--json]      numerics-health report + divergence
+                                 detection (loss z-score window, grad-norm
+                                 explosion, overflow streaks, NaN
+                                 provenance). Exit 0 when healthy, 3 when
+                                 any alert fires — wire it straight into a
+                                 CI gate or a babysitter cron.
   tail RUN.jsonl [-n N]          last N events, one line each.
   csv RUN.jsonl OUT.csv          flat CSV re-export.
 
-Exit codes: 0 on success, 1 on a malformed/missing run file, 2 on usage
-errors (argparse). The run file is plain JSONL — no device, no trace
-artifacts, no compiled programs needed to analyze it after the fact.
+Every subcommand follows rotated generations (``run.jsonl.1``, ...)
+oldest-first via :func:`~apex_tpu.telemetry.export.load`, so a rotated
+multi-day run is analyzed whole; ``--no-follow`` reads only the live
+file.
+
+Exit codes: 0 on success/healthy, 1 on a malformed/missing run file,
+2 on usage errors (argparse), 3 when ``health`` finds alerts. The run
+file is plain JSONL — no device, no trace artifacts, no compiled
+programs needed to analyze it after the fact.
 """
 
 from __future__ import annotations
@@ -19,10 +31,22 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from typing import List, Optional
+from typing import Any, List, Optional
 
-from apex_tpu.telemetry.export import (format_summary, read_jsonl,
+from apex_tpu.telemetry.export import (format_health, format_summary,
+                                       json_strict, load, read_jsonl,
                                        summarize, write_csv)
+
+EXIT_UNHEALTHY = 3
+
+
+def _dump_json(obj: Any) -> str:
+    """--json output is RFC 8259 strict: diverged runs — the health
+    command's whole point — carry NaN/Inf stats, and a bare ``NaN``
+    token breaks every strict parser (jq, CI tooling) exactly when it
+    matters."""
+    return json.dumps(json_strict(obj), indent=1, sort_keys=True,
+                      allow_nan=False)
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -31,33 +55,100 @@ def _build_parser() -> argparse.ArgumentParser:
         description="apex_tpu runtime telemetry — run-file tools")
     sub = p.add_subparsers(dest="cmd", required=True)
 
+    def add_path(sp):
+        sp.add_argument("path", help="telemetry run file (JSONL)")
+        sp.add_argument("--no-follow", action="store_true",
+                        help="read only the live file, not rotated "
+                             "generations (run.jsonl.1, ...)")
+
     s = sub.add_parser("summarize", help="aggregate a run JSONL")
-    s.add_argument("path", help="telemetry run file (JSONL)")
+    add_path(s)
     s.add_argument("--json", action="store_true",
                    help="emit the aggregate as JSON instead of text")
 
+    h = sub.add_parser(
+        "health",
+        help="numerics-health report + divergence detection (exit 3 on "
+             "alerts)")
+    add_path(h)
+    h.add_argument("--json", action="store_true")
+    h.add_argument("--window", type=int, default=50,
+                   help="rolling window (steps) for loss/grad statistics")
+    h.add_argument("--z-threshold", type=float, default=6.0,
+                   help="loss z-score that counts as a spike")
+    h.add_argument("--explosion-ratio", type=float, default=10.0,
+                   help="grad-norm multiple of the rolling median that "
+                        "counts as an explosion")
+    h.add_argument("--overflow-streak", type=int, default=4,
+                   help="consecutive overflow steps that count as scale "
+                        "collapse")
+
     t = sub.add_parser("tail", help="print the last N events")
-    t.add_argument("path")
+    add_path(t)
     t.add_argument("-n", type=int, default=20)
 
     c = sub.add_parser("csv", help="re-export a run as CSV")
-    c.add_argument("path")
+    add_path(c)
     c.add_argument("out")
     return p
+
+
+def _load_tail(path: str, n: int) -> List[dict]:
+    """Last ``n`` events across rotated generations WITHOUT parsing the
+    whole history: read newest-first (live file, then ``path.1``, ...)
+    and stop as soon as ``n`` events are in hand — ``tail -n 20`` on a
+    month of rotated generations must not load gigabytes to print 20
+    lines."""
+    import os
+    events = read_jsonl(path)
+    i = 1
+    while len(events) < n and os.path.exists(f"{path}.{i}"):
+        events = read_jsonl(f"{path}.{i}") + events
+        i += 1
+    return events[-n:]
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = _build_parser().parse_args(argv)
     try:
-        events = read_jsonl(args.path)
+        if args.cmd == "tail" and not args.no_follow:
+            events = _load_tail(args.path, args.n)
+        else:
+            events = load(args.path, follow_rotations=not args.no_follow)
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
 
     if args.cmd == "summarize":
         agg = summarize(events)
-        print(json.dumps(agg, indent=1, sort_keys=True) if args.json
-              else format_summary(agg))
+        print(_dump_json(agg) if args.json else format_summary(agg))
+    elif args.cmd == "health":
+        # the CLI's thresholds ride into summarize's single detection
+        # pass; recorded health/alert events are merged in either way
+        agg = summarize(events, health_detect=dict(
+            window=args.window, z_threshold=args.z_threshold,
+            explosion_ratio=args.explosion_ratio,
+            overflow_streak=args.overflow_streak))
+        h = agg.get("health") or {}
+        # a verdict over a lossy stream is NOT unqualified: the events
+        # that would have fired an alert may be among the dropped ones
+        dropped = int(agg.get("dropped") or 0)
+        if args.json:
+            if dropped:
+                h = dict(h, dropped=dropped)
+            print(_dump_json(h))
+        else:
+            lines = format_health(h)
+            print("\n".join(lines) if lines
+                  else "no health events in run file")
+            if not h.get("alerts"):
+                print("healthy: no divergence alerts")
+        if dropped:
+            print(f"WARNING: {dropped} events were dropped (collector "
+                  "capacity exceeded) — this verdict is computed on an "
+                  "incomplete stream", file=sys.stderr)
+        if h.get("alerts"):
+            return EXIT_UNHEALTHY
     elif args.cmd == "tail":
         for e in events[-args.n:]:
             step = f" step={e['step']}" if e.get("step") is not None else ""

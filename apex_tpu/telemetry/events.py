@@ -103,12 +103,29 @@ class Collector:
         with self._lock:
             return list(self._events)
 
-    def drain(self) -> List[Event]:
+    def last(self, name: str) -> Optional[Event]:
+        """Most recent event recorded under ``name`` (None if none).
+        Scans from the newest end, so a per-step lookup in a train loop
+        stops after a handful of events, not a full-buffer pass."""
+        with self._lock:
+            for e in reversed(self._events):
+                if e.name == name:
+                    return e
+        return None
+
+    def drain(self, *, with_dropped: bool = False):
+        """Drain the buffer. Resets the ``dropped`` counter alongside it
+        (both belong to the same capture window — back-to-back runs into
+        separate files must not inherit each other's drop count).
+        ``with_dropped=True`` returns ``(events, dropped)`` captured
+        atomically under the lock, for callers that surface the count."""
         with self._lock:
             out = list(self._events)
             self._events.clear()
             self._seen_static.clear()
-            return out
+            dropped = self.dropped
+            self.dropped = 0
+        return (out, dropped) if with_dropped else out
 
     def clear(self) -> None:
         with self._lock:

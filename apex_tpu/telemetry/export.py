@@ -19,10 +19,33 @@ from __future__ import annotations
 
 import collections
 import json
+import math
 import os
-from typing import Any, Dict, Iterable, List, Sequence
+from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 from apex_tpu.telemetry.events import Event
+
+# JSON string spellings for non-finite floats: the run file promises
+# plain RFC 8259 JSONL, but the values most worth exporting — a diverged
+# run's NaN loss, an Inf grad norm — are exactly the ones json.dumps
+# would emit as bare NaN/Infinity tokens no strict parser (jq, CI
+# tooling) accepts. The writer stringifies them; read_jsonl restores the
+# float on the ``value`` field.
+_NONFINITE = {"NaN": math.nan, "Infinity": math.inf,
+              "-Infinity": -math.inf}
+
+
+def json_strict(obj: Any) -> Any:
+    """Recursively replace non-finite floats with their string names so
+    the result serializes as strict JSON (see ``_NONFINITE``)."""
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return ("NaN" if math.isnan(obj)
+                else "Infinity" if obj > 0 else "-Infinity")
+    if isinstance(obj, dict):
+        return {k: json_strict(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [json_strict(v) for v in obj]
+    return obj
 
 
 class JsonlWriter:
@@ -54,7 +77,8 @@ class JsonlWriter:
 
     def write(self, event) -> None:
         d = event.to_dict() if isinstance(event, Event) else dict(event)
-        line = json.dumps(d, sort_keys=True) + "\n"
+        line = json.dumps(json_strict(d), sort_keys=True,
+                          allow_nan=False) + "\n"
         if (self.max_bytes > 0
                 and self._f.tell() + len(line) > self.max_bytes
                 and self._f.tell() > 0):
@@ -90,8 +114,8 @@ def write_jsonl(path: str, events: Iterable, *, max_bytes: int = 0,
 
 
 def read_jsonl(path: str) -> List[Dict[str, Any]]:
-    """Load a run file (rotated generations are NOT followed — concat the
-    files yourself for a full-history view). Blank lines are skipped;
+    """Load ONE run file (rotated generations are not followed — use
+    :func:`load` for the full-history view). Blank lines are skipped;
     a malformed line raises with its line number."""
     out: List[Dict[str, Any]] = []
     with open(path, encoding="utf-8") as f:
@@ -100,9 +124,37 @@ def read_jsonl(path: str) -> List[Dict[str, Any]]:
             if not line:
                 continue
             try:
-                out.append(json.loads(line))
+                row = json.loads(line)
             except json.JSONDecodeError as e:
                 raise ValueError(f"{path}:{i}: malformed JSONL: {e}") from e
+            v = row.get("value")
+            if isinstance(v, str) and v in _NONFINITE:
+                row["value"] = _NONFINITE[v]
+            out.append(row)
+    return out
+
+
+def load(path: str, *, follow_rotations: bool = True,
+         ) -> List[Dict[str, Any]]:
+    """Load a run file INCLUDING its rotated generations, oldest-first.
+
+    ``JsonlWriter`` rotates ``run.jsonl`` -> ``run.jsonl.1`` (shifting
+    older generations up), so generation N is older than N-1 and the
+    live file is newest: events are returned in chronological order
+    ``path.N, ..., path.1, path``. ``follow_rotations=False`` reads only
+    the live file (== :func:`read_jsonl`). The CLI loads through this,
+    so a rotated multi-day run summarizes whole, not just its tail."""
+    if not follow_rotations:
+        return read_jsonl(path)
+    gens: List[str] = []
+    i = 1
+    while os.path.exists(f"{path}.{i}"):
+        gens.append(f"{path}.{i}")
+        i += 1
+    out: List[Dict[str, Any]] = []
+    for p in reversed(gens):
+        out.extend(read_jsonl(p))
+    out.extend(read_jsonl(path))
     return out
 
 
@@ -156,15 +208,32 @@ def _dedup_points(events: List[Dict[str, Any]]) -> Dict[str, List[float]]:
 
 
 def _series_stats(vals: Sequence[float]) -> Dict[str, float]:
-    s = sorted(vals)
-    return {
-        "count": len(s),
-        "mean": sum(s) / len(s),
-        "p50": _percentile(s, 0.50),
-        "p90": _percentile(s, 0.90),
-        "p99": _percentile(s, 0.99),
-        "max": s[-1],
-    }
+    """Count/mean/percentiles/max over a series. NaN samples — by design
+    present in the health series on diverged runs — are incomparable
+    under sort (they'd land at an arbitrary position, poisoning the
+    percentiles and hiding the finite peak from ``max``), so order
+    statistics run on the FINITE samples and the non-finite count is
+    reported alongside. An Inf sample still wins ``max`` (it IS the
+    peak); an all-non-finite series reports NaN stats rather than lying
+    with a number."""
+    finite = sorted(v for v in vals if math.isfinite(v))
+    n_bad = len(vals) - len(finite)
+    if not finite:
+        out = {"count": len(vals), "mean": math.nan, "p50": math.nan,
+               "p90": math.nan, "p99": math.nan, "max": math.nan}
+    else:
+        out = {
+            "count": len(vals),
+            "mean": sum(finite) / len(finite),
+            "p50": _percentile(finite, 0.50),
+            "p90": _percentile(finite, 0.90),
+            "p99": _percentile(finite, 0.99),
+            "max": (math.inf if any(v == math.inf for v in vals)
+                    else finite[-1]),
+        }
+    if n_bad:
+        out["nonfinite"] = n_bad
+    return out
 
 
 def _timeline(events: List[Dict[str, Any]], name: str,
@@ -186,11 +255,16 @@ def _timeline(events: List[Dict[str, Any]], name: str,
     return pairs
 
 
-def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+def summarize(events: List[Dict[str, Any]], *,
+              health_detect: Optional[Dict[str, Any]] = None,
+              ) -> Dict[str, Any]:
     """Aggregate a run's events into the health report dict.
 
     Sections appear only when their producers ran, so the report shape is
-    stable across partial instrumentations."""
+    stable across partial instrumentations. ``health_detect``: kwargs
+    forwarded to :func:`~apex_tpu.telemetry.health.detect` for the
+    health section's divergence pass (the CLI's threshold flags land
+    here — detection runs ONCE, with those thresholds)."""
     out: Dict[str, Any] = {"events": len(events)}
     series = _dedup_points(events)
 
@@ -274,13 +348,103 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             counters[e["name"]] += float(e["value"])
     if counters:
         out["counters"] = dict(counters)
+    # collector drops mean the aggregates below are computed on an
+    # INCOMPLETE stream — surface loudly, never as just another counter
+    if counters.get("telemetry/dropped"):
+        out["dropped"] = counters["telemetry/dropped"]
 
     # data pipeline queue depth
     depth = [v for name, vs in series.items()
              if name.endswith("data/queue_depth") for v in vs]
     if depth:
         out["queue_depth"] = _series_stats(depth)
+
+    # numerics health (producers: telemetry.health)
+    health = _health_section(events, series, detect_kwargs=health_detect)
+    if health:
+        out["health"] = health
     return out
+
+
+def _health_section(events: List[Dict[str, Any]],
+                    series: Dict[str, List[float]], *,
+                    detect_kwargs: Optional[Dict[str, Any]] = None,
+                    ) -> Dict[str, Any]:
+    """The ``health`` block of :func:`summarize`: grad/weight-norm and
+    update-ratio stats, non-finite totals, per-layer top grad norms,
+    overflow provenance, and the offline divergence-detection alerts
+    (run with ``detect_kwargs`` thresholds when given)."""
+    import re
+
+    h: Dict[str, Any] = {}
+    for suffix, key in (("health/grad_norm", "grad_norm"),
+                        ("health/weight_norm", "weight_norm"),
+                        ("health/update_ratio", "update_ratio")):
+        vals = [v for name, vs in series.items()
+                if name.endswith(suffix) for v in vs]
+        if vals:
+            h[key] = _series_stats(vals)
+    for suffix, key in (("health/nonfinite", "nonfinite_elements"),
+                        ("health/nan", "nan_elements")):
+        vals = [v for name, vs in series.items()
+                if name.endswith(suffix) for v in vs]
+        if vals:
+            h[key] = sum(vals)
+    # per-layer vs per-bucket grad norms: report the run max per series
+    # (a NaN/Inf sample wins — that is the sample you want to see), but
+    # in SEPARATE tables: grad_stats layer series are unscaled, while
+    # the ddp/zero producer series run on whatever the collective saw
+    # (commonly still loss-scaled) — ranked together, a 2^16 scale would
+    # read as a four-orders-of-magnitude explosion and crowd out the
+    # layers.
+    layers: Dict[str, float] = {}
+    buckets: Dict[str, float] = {}
+    pat = re.compile(r"health/(.+)/grad_norm$")
+    for name, vs in series.items():
+        m = pat.search(name)
+        if not m or not vs:
+            continue
+        key = m.group(1)
+        bad = [v for v in vs if not math.isfinite(v)]
+        peak = bad[0] if bad else max(vs)
+        if key.startswith("layer/"):
+            layers[key[len("layer/"):]] = peak
+        else:
+            buckets[key] = peak
+
+    def top16(d):
+        top = sorted(d.items(),
+                     key=lambda kv: -(kv[1] if math.isfinite(kv[1])
+                                      else float("inf")))
+        return dict(top[:16])
+
+    if layers:
+        h["layers"] = top16(layers)
+    if buckets:
+        h["buckets"] = top16(buckets)
+    # overflow provenance: the debug callback fires once PER SHARD under
+    # shard_map/pmap, so dedup by (step, group) like every other series
+    # — 8 replicas of one overflow must not flood the 20-row cap
+    sources: List[Dict[str, Any]] = []
+    seen_src = set()
+    for e in events:
+        if not e["name"].endswith("health/overflow_source"):
+            continue
+        meta = e.get("meta") or {}
+        key = (e.get("step"), meta.get("group"))
+        if key in seen_src:
+            continue
+        seen_src.add(key)
+        sources.append({"step": e.get("step"), "group": meta.get("group"),
+                        "count": float(e["value"]),
+                        "nan": meta.get("nan", 0)})
+    if sources:
+        h["overflow_sources"] = sources[:20]
+    from apex_tpu.telemetry import health as _health_mod
+    alerts = _health_mod.detect(events, **(detect_kwargs or {}))
+    if alerts:
+        h["alerts"] = alerts
+    return h
 
 
 def _fmt_si(x: float) -> str:
@@ -290,9 +454,53 @@ def _fmt_si(x: float) -> str:
     return f"{x:.0f} "
 
 
+def format_health(h: Dict[str, Any]) -> List[str]:
+    """Render the summarize() ``health`` section as report lines."""
+    if not h:
+        return []
+    lines = ["health:"]
+
+    def stat(key, label, fmt="{:.4g}"):
+        t = h.get(key)
+        if t:
+            lines.append(
+                f"  {label:<14} mean " + fmt.format(t["mean"])
+                + "   p50 " + fmt.format(t["p50"])
+                + "   max " + fmt.format(t["max"]))
+
+    stat("grad_norm", "grad norm")
+    stat("weight_norm", "weight norm")
+    stat("update_ratio", "update ratio", "{:.2e}")
+    if h.get("nonfinite_elements") is not None:
+        lines.append(
+            f"  nonfinite grad elements: {h['nonfinite_elements']:g}"
+            f" (nan: {h.get('nan_elements', 0):g})")
+    for src in h.get("overflow_sources", []):
+        lines.append(
+            f"  overflow source  step {src.get('step')}: {src['group']}"
+            f" ({src['count']:g} non-finite, {src.get('nan', 0):g} nan)")
+    for g, v in h.get("layers", {}).items():
+        lines.append(f"  layer {g:<24} grad norm {v:.4g}")
+    for g, v in h.get("buckets", {}).items():
+        lines.append(f"  bucket {g:<23} grad norm {v:.4g}")
+    alerts = h.get("alerts", [])
+    for a in alerts[:50]:
+        lines.append(
+            f"  ALERT step {a.get('step')}: {a['reason']}"
+            + (f" — {a['detail']}" if a.get("detail") else ""))
+    if len(alerts) > 50:
+        lines.append(f"  ... and {len(alerts) - 50} more alerts")
+    return lines
+
+
 def format_summary(s: Dict[str, Any]) -> str:
     """Render a summarize() dict as the CLI's text report."""
     lines = [f"events: {s.get('events', 0)}"]
+    if s.get("dropped"):
+        lines.append(
+            f"WARNING: {int(s['dropped'])} events were dropped (collector "
+            "capacity exceeded) — the aggregates below are computed on an "
+            "incomplete stream")
 
     def timing(key, label):
         t = s.get(key)
@@ -346,4 +554,5 @@ def format_summary(s: Dict[str, Any]) -> str:
         q = s["queue_depth"]
         lines.append(f"{'queue depth':<14} mean {q['mean']:.2f}"
                      f"   p50 {q['p50']:.1f}   max {q['max']:.0f}")
+    lines.extend(format_health(s.get("health") or {}))
     return "\n".join(lines)

@@ -1,0 +1,549 @@
+"""Numerics-health observability: per-layer gradient statistics,
+non-finite provenance, overflow attribution, divergence detection.
+
+The perf telemetry (instrument/comm) answers "how fast is my run"; this
+module answers "why did my run diverge" — the question the reference
+Apex's whole O1-O5 loss-scaling machinery exists to dodge. Three layers:
+
+  * :func:`grad_stats` — IN-GRAPH, trace-safe tensor statistics: global
+    and per-layer grad norm, weight norm, update-to-weight ratio, and
+    NaN/Inf element counts, computed as fused per-group reductions
+    inside jit/pjit/shard_map and shipped to the host through ONE
+    ``jax.debug.callback`` per call. Event cardinality is bounded on the
+    host side: the top-K groups by grad norm (non-finite groups rank
+    first) get named ``health/layer/<group>/...`` series, the rest fold
+    into one ``health/layer/(rest)/grad_norm`` bucket — parenthesised
+    because ``other`` is a real group name (unmatched-prefix leaves) and
+    a collision would average two different series in summarize.
+  * :func:`attribute_overflow` — non-finite provenance. When the amp
+    scaler's overflow flag fires, per-group NaN/Inf counts over the
+    scaled grads are computed ONLY on the overflow branch (``lax.cond``
+    — the happy path pays nothing beyond the overflow reduction the
+    scaler already did) and the host names the FIRST offending param
+    group in tree order (``health/overflow_source``). NaN counts are
+    kept separate from Inf counts: an Inf overflow is the scaler's
+    normal saturation (skip + halve the scale); a NaN is numerics
+    corruption no rescale can fix, and the detector treats it as such.
+  * :class:`DivergenceDetector` / :func:`detect` — a host-side rolling
+    detector over the event stream: non-finite loss, loss z-score spike,
+    grad-norm explosion vs the rolling median, repeated-overflow streak,
+    NaN-gradient presence. Live (``detector.update(...)`` in the train
+    loop, emitting ``health/alert`` events) and offline
+    (``python -m apex_tpu.telemetry health run.jsonl`` — exit 0 healthy,
+    exit 3 when alerts fire).
+
+Enabling is separate from (and implies) the base telemetry flag:
+``health.enable()`` turns the in-graph producers on at TRACE time. With
+health disabled every hook is a no-op before any jnp op runs, so the
+traced step program is bit-identical to an uninstrumented one.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.telemetry import events as _ev
+from apex_tpu.utils import path_str
+
+Tree = Any
+
+# ---------------------------------------------------------------------------
+# enable flag (trace-time, like events.enable — see module docstring)
+# ---------------------------------------------------------------------------
+
+_health_enabled = False
+
+
+def enable() -> None:
+    """Turn the numerics-health producers on (and the base telemetry
+    flag with them — health events ride the same Collector). Trace-time:
+    call BEFORE jitting step functions."""
+    global _health_enabled
+    _health_enabled = True
+    _ev.enable()
+
+
+def disable() -> None:
+    global _health_enabled
+    _health_enabled = False
+
+
+def enabled() -> bool:
+    """True when BOTH the health flag and base telemetry are on — the
+    producers' single trace-time guard."""
+    return _health_enabled and _ev.enabled()
+
+
+# ---------------------------------------------------------------------------
+# static grouping: pytree leaves -> named param groups
+# ---------------------------------------------------------------------------
+
+def group_leaves(tree: Tree, *, prefixes: Optional[Sequence[str]] = None,
+                 depth: int = 1) -> Tuple[List[str], List[List[Any]]]:
+    """Partition a pytree's leaves into named groups — STATIC (trace-time)
+    metadata; the group list must not depend on traced values.
+
+    ``prefixes``: explicit path prefixes ('a/b' grammar, longest match
+    wins; unmatched leaves go to ``"other"``). Default: group by the
+    first ``depth`` path components (top-level modules for ``depth=1``).
+    Returns ``(names, groups)`` with groups in first-seen (tree) order.
+    """
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    prefs = sorted(prefixes, key=len, reverse=True) if prefixes else None
+    groups: "collections.OrderedDict[str, List[Any]]" = \
+        collections.OrderedDict()
+    for kp, leaf in leaves:
+        p = path_str(kp)
+        if prefs is not None:
+            for pref in prefs:
+                if p == pref or p.startswith(pref.rstrip("/") + "/"):
+                    name = pref
+                    break
+            else:
+                name = "other"
+        else:
+            name = "/".join(p.split("/")[:max(1, depth)]) or "params"
+        groups.setdefault(name, []).append(leaf)
+    return list(groups.keys()), list(groups.values())
+
+
+def _group_sumsq(groups: List[List[Any]]) -> jax.Array:
+    """(G,) f32 sum of squares per group — ONE fused reduction pass."""
+    return jnp.stack([
+        sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+        for leaves in groups])
+
+
+def _aligned_sumsq(tree: Tree, names: List[str], *,
+                   prefixes: Optional[Sequence[str]], depth: int,
+                   ) -> jax.Array:
+    """Per-group sum of squares of ``tree`` ALIGNED to ``names`` — the
+    grads' group list. The tree is grouped by the same rule, then
+    matched BY NAME; a group absent from ``tree`` (e.g. frozen params
+    carried in ``params`` but not in ``grads``, or vice versa) gets the
+    sentinel ``-1`` (a real sum of squares is nonnegative) so the host
+    skips it instead of pairing the wrong groups by index."""
+    tnames, tgroups = group_leaves(tree, prefixes=prefixes, depth=depth)
+    by = dict(zip(tnames, _group_sumsq(tgroups))) if tnames else {}
+    missing = jnp.asarray(-1.0, jnp.float32)
+    return jnp.stack([by.get(n, missing) for n in names])
+
+
+def _group_nonfinite(groups: List[List[Any]]) -> Tuple[jax.Array, jax.Array]:
+    """(nan_counts, inf_counts) per group, f32 — NaN separate from Inf
+    because they mean different things to the detector."""
+    nan_c = jnp.stack([
+        sum(jnp.sum(jnp.isnan(x).astype(jnp.float32)) for x in leaves)
+        for leaves in groups])
+    inf_c = jnp.stack([
+        sum(jnp.sum(jnp.isinf(x).astype(jnp.float32)) for x in leaves)
+        for leaves in groups])
+    return nan_c, inf_c
+
+
+# ---------------------------------------------------------------------------
+# host-side emission (runs inside the debug callback — concrete values)
+# ---------------------------------------------------------------------------
+
+def _emit_stats(name: str, groups: Tuple[str, ...], payload: Dict[str, Any],
+                top_k: int) -> None:
+    col = _ev.get_collector()
+    g2 = np.asarray(payload["g"], np.float64).reshape(-1)
+    nan_c = np.asarray(payload["nan"], np.float64).reshape(-1)
+    inf_c = np.asarray(payload["inf"], np.float64).reshape(-1)
+    s = payload.get("s")
+    step = None if s is None else int(np.asarray(s))
+    gn = np.sqrt(g2)
+    col.record(f"{name}/grad_norm", float(np.sqrt(g2.sum())), step=step)
+    col.record(f"{name}/nonfinite", float(nan_c.sum() + inf_c.sum()),
+               step=step)
+    col.record(f"{name}/nan", float(nan_c.sum()), step=step)
+    # w2/u2 are aligned to the grad groups by name; -1 marks a group the
+    # params/updates tree doesn't have (see _aligned_sumsq) — excluded
+    # from the global norms and from per-layer ratios below.
+    w2 = payload.get("w")
+    u2 = payload.get("u")
+    if w2 is not None:
+        w2 = np.asarray(w2, np.float64).reshape(-1)
+        col.record(f"{name}/weight_norm",
+                   float(np.sqrt(w2[w2 >= 0].sum())), step=step)
+    if u2 is not None:
+        u2 = np.asarray(u2, np.float64).reshape(-1)
+    if u2 is not None and w2 is not None:
+        both = (w2 >= 0) & (u2 >= 0)
+        col.record(
+            f"{name}/update_ratio",
+            float(np.sqrt(u2[both].sum())
+                  / max(np.sqrt(w2[both].sum()), 1e-30)),
+            step=step)
+    # bounded per-layer cardinality: top-K by grad norm, non-finite
+    # groups first (np.isfinite(nan)=False -> ranked +inf), rest folded
+    k = max(1, int(top_k))
+    rank = np.where(np.isfinite(gn), gn, np.inf)
+    order = np.argsort(-rank, kind="stable")
+    for i in order[:k]:
+        g = groups[int(i)]
+        col.record(f"{name}/layer/{g}/grad_norm", float(gn[i]), step=step)
+        if nan_c[i] or inf_c[i]:
+            col.record(f"{name}/layer/{g}/nonfinite",
+                       float(nan_c[i] + inf_c[i]), step=step)
+        if u2 is not None and w2 is not None and w2[i] >= 0 and u2[i] >= 0:
+            col.record(
+                f"{name}/layer/{g}/update_ratio",
+                float(np.sqrt(u2[i]) / max(np.sqrt(w2[i]), 1e-30)),
+                step=step)
+    rest = order[k:]
+    if rest.size:
+        col.record(f"{name}/layer/(rest)/grad_norm",
+                   float(np.sqrt(g2[rest].sum())), step=step)
+
+
+def _emit_overflow(name: str, groups: Tuple[str, ...], nan_c, inf_c,
+                   s) -> None:
+    nan_c = np.asarray(nan_c, np.float64).reshape(-1)
+    inf_c = np.asarray(inf_c, np.float64).reshape(-1)
+    total = float(nan_c.sum() + inf_c.sum())
+    if total <= 0:          # clean step: the cond took the zeros branch
+        return
+    step = None if s is None else int(np.asarray(s))
+    bad = np.flatnonzero(nan_c + inf_c > 0)
+    first = int(bad[0])     # FIRST offending group in tree order
+    per = {groups[int(i)]: int(nan_c[i] + inf_c[i]) for i in bad[:16]}
+    _ev.get_collector().record(
+        f"{name}/overflow_source", total, step=step,
+        meta={"group": groups[first], "nan": int(nan_c.sum()),
+              "inf": int(inf_c.sum()), "per_group": per})
+
+
+# ---------------------------------------------------------------------------
+# in-graph producers
+# ---------------------------------------------------------------------------
+
+def grad_stats(grads: Tree, *, params: Optional[Tree] = None,
+               updates: Optional[Tree] = None,
+               prefixes: Optional[Sequence[str]] = None, depth: int = 1,
+               top_k: int = 8, step: Any = None, scale: Any = None,
+               axis_name: Optional[str] = None,
+               name: str = "health") -> None:
+    """Record global + per-layer gradient statistics — trace-safe (legal
+    inside jit/pjit/shard_map/scan), no-op when health is disabled.
+
+    Emits (per call): ``health/grad_norm``, ``health/nonfinite``,
+    ``health/nan`` and, with ``params``/``updates`` given,
+    ``health/weight_norm`` / ``health/update_ratio`` — plus per-layer
+    ``health/layer/<group>/...`` series for the top-``top_k`` groups by
+    grad norm and a ``health/layer/(rest)/grad_norm`` fold of the rest
+    (parenthesised: a real group can be named ``other`` — the
+    unmatched-prefix bucket — and must not merge with the fold).
+
+    ``updates`` is the applied param delta (``new_params - params``) for
+    the update-to-weight ratio. ``params``/``updates`` are grouped by
+    the same rule as ``grads`` and matched BY NAME — a group present in
+    only one tree (e.g. frozen params with no grads) is excluded from
+    the weight/update norms rather than mispaired. ``scale`` divides the grad norms (pass
+    the amp loss scale to report UNSCALED norms). ``axis_name``: psum
+    the partial sums over a mesh axis first, for grads that are still
+    per-shard partials; synced (replicated) grads don't need it.
+    Replicated emission (one callback per shard under shard_map) is
+    collapsed by summarize's (name, step) dedup.
+    """
+    if not enabled():
+        return
+    names, ggroups = group_leaves(grads, prefixes=prefixes, depth=depth)
+    if not names:
+        return
+    gn2 = _group_sumsq(ggroups)
+    nan_c, inf_c = _group_nonfinite(ggroups)
+    if axis_name is not None:
+        gn2 = jax.lax.psum(gn2, axis_name)
+        nan_c = jax.lax.psum(nan_c, axis_name)
+        inf_c = jax.lax.psum(inf_c, axis_name)
+    if scale is not None:
+        s2 = jnp.square(jnp.asarray(scale, jnp.float32))
+        gn2 = gn2 / s2
+    payload: Dict[str, Any] = {"g": gn2, "nan": nan_c, "inf": inf_c}
+    if params is not None:
+        payload["w"] = _aligned_sumsq(params, names, prefixes=prefixes,
+                                      depth=depth)
+    if updates is not None:
+        payload["u"] = _aligned_sumsq(updates, names, prefixes=prefixes,
+                                      depth=depth)
+    if step is not None:
+        payload["s"] = jnp.asarray(step)
+    _ev.get_collector().record_static_once(
+        f"{name}/groups", len(names), meta={"groups": names[:64]},
+        dedup_key=(name, tuple(names)))
+    gtuple = tuple(names)
+
+    def _host(p):
+        _emit_stats(name, gtuple, p, top_k)
+
+    jax.debug.callback(_host, payload)
+
+
+def attribute_overflow(overflow: Any, grads: Tree, *,
+                       prefixes: Optional[Sequence[str]] = None,
+                       depth: int = 1, step: Any = None,
+                       name: str = "health") -> None:
+    """Non-finite provenance: when ``overflow`` fires, count NaN/Inf
+    elements per named param group and emit ``health/overflow_source``
+    naming the FIRST offending group in tree order (meta carries the
+    global nan/inf split and a per-group breakdown, capped at 16).
+
+    The per-group isfinite reduction runs ONLY on the overflow branch
+    (``lax.cond``); the happy path pays nothing beyond the single fused
+    overflow reduction the caller already computed. Trace-safe; no-op
+    when health is disabled.
+    """
+    if not enabled():
+        return
+    names, groups = group_leaves(grads, prefixes=prefixes, depth=depth)
+    if not names:
+        return
+    g = len(names)
+    zeros = (jnp.zeros((g,), jnp.float32), jnp.zeros((g,), jnp.float32))
+    nan_c, inf_c = jax.lax.cond(
+        jnp.asarray(overflow).astype(jnp.bool_).reshape(()),
+        lambda: _group_nonfinite(groups),
+        lambda: zeros)
+    gtuple = tuple(names)
+
+    if step is None:
+        jax.debug.callback(
+            lambda n, i: _emit_overflow(name, gtuple, n, i, None),
+            nan_c, inf_c)
+    else:
+        jax.debug.callback(
+            lambda n, i, s: _emit_overflow(name, gtuple, n, i, s),
+            nan_c, inf_c, jnp.asarray(step))
+
+
+# ---------------------------------------------------------------------------
+# divergence detection (host side)
+# ---------------------------------------------------------------------------
+
+class DivergenceDetector:
+    """Rolling host-side divergence detector over per-step scalars.
+
+    Call ``update(step, loss=..., grad_norm=..., overflow=...,
+    nan_count=...)`` once per step with whatever series you have; it
+    returns the NEW alerts fired by that step (list of dicts with
+    ``step``/``reason``/``detail``/``value``) and accumulates them in
+    ``.alerts``. With ``emit=True`` (default) each alert is also
+    recorded as a ``health/alert`` counter event when telemetry is on.
+
+    Persistent conditions (``loss_nonfinite``, ``nan_grads``,
+    ``grad_nonfinite``) fire once per EPISODE — at onset, re-arming only
+    after the condition clears — so a run stuck at NaN reports one
+    alert, not one per remaining step.
+
+    Rules (all thresholds configurable):
+      * ``loss_nonfinite`` — NaN/Inf loss, fires immediately.
+      * ``loss_spike`` — loss z-score vs the rolling window exceeds
+        ``z_threshold`` (needs ``min_history`` finite samples).
+      * ``nan_grads`` — ``nan_count`` > 0: NaN gradients are corruption,
+        alerting even on steps the scaler skipped.
+      * ``grad_nonfinite`` — non-finite grad norm on a step the scaler
+        did NOT flag as overflow (an Inf norm WITH overflow is the
+        dynamic scaler's normal saturate-skip-halve cycle, not an
+        alert).
+      * ``grad_explosion`` — grad norm exceeds ``explosion_ratio`` x
+        the rolling median.
+      * ``overflow_streak`` — ``overflow_streak`` consecutive overflow
+        steps AFTER the scale has found footing (a dynamic scaler's
+        initial search — start at 2^16, halve until grads fit — is a
+        legitimate overflow streak, so before the first clean step the
+        threshold is ``overflow_streak + _SCALE_SEARCH_GRACE``: enough
+        halvings to walk 2^16 down to 1; a cold streak longer than that
+        is non-finites no rescale can fix).
+    """
+
+    # extra consecutive overflows tolerated before the FIRST successful
+    # step: halving from the customary 2^16 initial scale to 1.
+    _SCALE_SEARCH_GRACE = 16
+
+    def __init__(self, *, window: int = 50, min_history: int = 8,
+                 z_threshold: float = 6.0, explosion_ratio: float = 10.0,
+                 overflow_streak: int = 4, emit: bool = True,
+                 name: str = "health"):
+        self.window = max(2, int(window))
+        # clamp min_history into the window: the spike/explosion rules
+        # gate on len(deque) >= min_history and the deques cap at
+        # maxlen=window, so min_history > window (e.g. --window 6 with
+        # the default 8) would silently disable both rules forever.
+        self.min_history = max(2, min(int(min_history), self.window))
+        self.z_threshold = z_threshold
+        self.explosion_ratio = explosion_ratio
+        self.overflow_streak = max(1, int(overflow_streak))
+        self.emit = emit
+        self.name = name
+        self._losses: "collections.deque[float]" = collections.deque(
+            maxlen=self.window)
+        self._gnorms: "collections.deque[float]" = collections.deque(
+            maxlen=self.window)
+        self._streak = 0
+        self._had_clean_step = False
+        # persistent conditions fire once per EPISODE (condition onset),
+        # re-arming when it clears — a 50k-step run whose loss went NaN
+        # at step 1k must report one alert, not 49k of them
+        self._active: set = set()
+        self.alerts: List[Dict[str, Any]] = []
+
+    def _alert(self, step, reason: str, detail: str, value: float,
+               out: List[Dict[str, Any]]) -> None:
+        a = {"step": step, "reason": reason, "detail": detail,
+             "value": value}
+        out.append(a)
+        self.alerts.append(a)
+        if self.emit and _ev.enabled():
+            _ev.get_collector().record(
+                f"{self.name}/alert", 1.0, step=step, kind="counter",
+                meta={"reason": reason, "detail": detail})
+
+    def update(self, step=None, *, loss=None, grad_norm=None,
+               overflow=None, nan_count=None) -> List[Dict[str, Any]]:
+        new: List[Dict[str, Any]] = []
+        ovf = bool(overflow is not None and float(overflow) >= 0.5)
+
+        def episodic(reason: str, firing: bool) -> bool:
+            """True when a persistent condition just set in (edge, not
+            level, so a stuck condition alerts once per episode)."""
+            if firing and reason not in self._active:
+                self._active.add(reason)
+                return True
+            if not firing:
+                self._active.discard(reason)
+            return False
+
+        if loss is not None:
+            loss = float(loss)
+            if not math.isfinite(loss):
+                if episodic("loss_nonfinite", True):
+                    self._alert(step, "loss_nonfinite", f"loss={loss}",
+                                loss, new)
+            else:
+                episodic("loss_nonfinite", False)
+                if len(self._losses) >= self.min_history:
+                    mu = sum(self._losses) / len(self._losses)
+                    var = sum((x - mu) ** 2 for x in self._losses) \
+                        / len(self._losses)
+                    sd = max(math.sqrt(var), abs(mu) * 1e-6, 1e-12)
+                    z = (loss - mu) / sd
+                    if z > self.z_threshold:
+                        self._alert(
+                            step, "loss_spike",
+                            f"loss={loss:g} z={z:.1f} over window "
+                            f"mean={mu:g}", loss, new)
+                self._losses.append(loss)
+        if nan_count is not None:
+            if episodic("nan_grads", float(nan_count) > 0):
+                self._alert(step, "nan_grads",
+                            f"{int(float(nan_count))} NaN grad elements",
+                            float(nan_count), new)
+        if grad_norm is not None:
+            g = float(grad_norm)
+            if not math.isfinite(g):
+                firing = not ovf and not (nan_count is not None
+                                          and float(nan_count) > 0)
+                if episodic("grad_nonfinite", firing):
+                    self._alert(step, "grad_nonfinite",
+                                f"grad_norm={g}", g, new)
+            else:
+                episodic("grad_nonfinite", False)
+                if len(self._gnorms) >= self.min_history:
+                    med = sorted(self._gnorms)[len(self._gnorms) // 2]
+                    if med > 0 and g > self.explosion_ratio * med:
+                        self._alert(
+                            step, "grad_explosion",
+                            f"grad_norm={g:g} is {g / med:.1f}x the "
+                            f"rolling median {med:g}", g, new)
+                self._gnorms.append(g)
+        if overflow is not None:
+            self._streak = self._streak + 1 if ovf else 0
+            if not ovf:
+                self._had_clean_step = True
+            limit = (self.overflow_streak if self._had_clean_step
+                     else self.overflow_streak + self._SCALE_SEARCH_GRACE)
+            if self._streak == limit:
+                self._alert(
+                    step, "overflow_streak",
+                    f"{self._streak} consecutive overflow steps — the "
+                    "loss scale is collapsing", float(self._streak), new)
+        return new
+
+
+def detect(events: List[Dict[str, Any]], *, window: int = 50,
+           min_history: int = 8, z_threshold: float = 6.0,
+           explosion_ratio: float = 10.0, overflow_streak: int = 4,
+           ) -> List[Dict[str, Any]]:
+    """Offline divergence detection over a loaded run's event dicts.
+
+    Rebuilds the per-step loss / grad-norm / overflow / NaN-count series
+    (averaging replicated shard samples), replays them through a fresh
+    :class:`DivergenceDetector`, and merges in any ``health/alert``
+    events already recorded live plus ``health/overflow_source`` events
+    whose meta carries NaN counts (deduped by (step, reason)). Returns
+    the alerts sorted by step."""
+
+    def series(pred) -> Dict[Any, float]:
+        by: Dict[Any, List[float]] = {}
+        for e in events:
+            if e.get("kind", "point") != "point" or e.get("step") is None:
+                continue
+            if pred(e["name"]):
+                by.setdefault(e["step"], []).append(float(e["value"]))
+        return {s: sum(v) / len(v) for s, v in by.items()}
+
+    # ONE loss series feeds the z-score window: blending distinct
+    # series (train/loss + val/loss at shared steps) would jump every
+    # eval step relative to a train-only window and fake a loss_spike.
+    # Prefer train/loss; otherwise take the first distinct */loss name
+    # (sorted, so the choice is deterministic). The per-step averaging
+    # inside series() still collapses per-shard replicas of that ONE
+    # name.
+    loss_names = sorted({
+        e["name"] for e in events
+        if e.get("kind", "point") == "point"
+        and e.get("step") is not None and e["name"].endswith("/loss")})
+    preferred = [n for n in loss_names
+                 if n == "train/loss" or n.endswith("/train/loss")]
+    loss_name = (preferred or loss_names or [None])[0]
+    loss = series(lambda n: n == loss_name)
+    gnorm = series(lambda n: n.endswith("health/grad_norm"))
+    nan = series(lambda n: n.endswith("health/nan"))
+    ovf = series(lambda n: n.endswith("amp/overflow"))
+
+    det = DivergenceDetector(
+        window=window, min_history=min_history, z_threshold=z_threshold,
+        explosion_ratio=explosion_ratio, overflow_streak=overflow_streak,
+        emit=False)
+    for s in sorted(set(loss) | set(gnorm) | set(nan) | set(ovf)):
+        det.update(s, loss=loss.get(s), grad_norm=gnorm.get(s),
+                   overflow=ovf.get(s), nan_count=nan.get(s))
+    alerts = list(det.alerts)
+    seen = {(a.get("step"), a["reason"]) for a in alerts}
+
+    def add(step, reason, detail, value):
+        if (step, reason) not in seen:
+            seen.add((step, reason))
+            alerts.append({"step": step, "reason": reason,
+                           "detail": detail, "value": value})
+
+    for e in events:
+        n = e["name"]
+        meta = e.get("meta") or {}
+        if n.endswith("health/alert"):
+            add(e.get("step"), meta.get("reason", "alert"),
+                meta.get("detail", ""), float(e.get("value", 1.0)))
+        elif n.endswith("health/overflow_source") and meta.get("nan"):
+            add(e.get("step"), "nan_grads",
+                f"first non-finite param group: {meta.get('group')}",
+                float(meta.get("nan", 0)))
+    alerts.sort(key=lambda a: (a.get("step") is None, a.get("step") or 0))
+    return alerts

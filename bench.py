@@ -70,6 +70,14 @@ def main():
                                     "benchmarks",
                                     "telemetry_resnet50.jsonl")
         telemetry.enable()
+    # BENCH_HEALTH=1 additionally traces the numerics-health producers
+    # into the step (per-layer grad/weight norms, NaN/Inf counts,
+    # overflow attribution — telemetry.health); events join the
+    # BENCH_TELEMETRY JSONL. Also the overhead A/B knob for the health
+    # acceptance budget: run with and without it and compare img/s.
+    if os.environ.get("BENCH_HEALTH"):
+        from apex_tpu import telemetry
+        telemetry.health.enable()
     log(f"bench: resnet50 amp {opt_level} batch={batch} image={image} "
         f"on {dev}")
 
@@ -107,8 +115,25 @@ def main():
                                                       updates["batch_stats"])
 
         grads, (loss, new_bs) = jax.grad(scaled, has_aux=True)(params)
-        grads = parallel.allreduce_gradients(grads, "data")
+        # step attribution for health events = the amp EXECUTION index
+        # (overflow-skipped steps freeze inner.step; a collided id would
+        # average two different steps' samples in summarize's
+        # (name, step) dedup). Computed only when health is on so the
+        # disabled trace stays identical.
+        from apex_tpu.telemetry import health as _health
+        step_idx = None
+        if _health.enabled():
+            step_idx = aopt.execution_index(opt_state)
+        grads = parallel.allreduce_gradients(grads, "data",
+                                             telemetry_step=step_idx)
         new_params, new_opt_state, _ = aopt.step(grads, params, opt_state)
+        if _health.enabled():
+            # per-layer grad/weight norms + NaN/Inf counts on the synced
+            # grads, loss scale divided out; overflow attribution runs
+            # inside aopt.step. Nothing traced when health is off.
+            _health.grad_stats(grads, params=params,
+                               scale=opt_state.scaler.loss_scale[0],
+                               step=step_idx, top_k=4)
         return new_params, new_bs, new_opt_state, jax.lax.pmean(loss, "data")
 
     rep = P()

@@ -122,6 +122,52 @@ print(f'telemetry smoke OK: {len(names)} distinct metrics')
 python -m apex_tpu.telemetry summarize "$TEL_FILE" | head -5
 rm -rf "$(dirname "$TEL_FILE")"
 
+# Numerics-health smoke: a 3-step --health train must emit parseable
+# per-layer grad stats, and the exit-code-bearing health CLI must pass
+# the healthy run (exit 0) and flag a fixture run with an injected NaN
+# step (nonzero) — the divergence-detection analog of the perf smoke.
+HLT_FILE="$(mktemp -d)/health.jsonl"
+python examples/gpt/train_lm.py --steps 3 --warmup-steps 0 --vocab 512 \
+    --layers 2 --embed-dim 64 --heads 2 --seq-len 128 --batch-size 1 \
+    --opt-level O2 --health --telemetry "$HLT_FILE" > /dev/null
+python -c "
+import json, sys
+names = set()
+with open(sys.argv[1]) as f:
+    for line in f:
+        names.add(json.loads(line)['name'])   # every line must parse
+need = {'health/grad_norm', 'health/nonfinite', 'health/update_ratio',
+        'train/loss'}
+missing = need - names
+assert not missing, f'health JSONL missing {missing}; has {sorted(names)}'
+assert any(n.startswith('health/layer/') for n in names), \
+    f'no per-layer health series in {sorted(names)}'
+print(f'health smoke OK: {len(names)} distinct metrics')
+" "$HLT_FILE"
+python -m apex_tpu.telemetry health "$HLT_FILE" > /dev/null  # healthy: 0
+NAN_FIX="$(dirname "$HLT_FILE")/nan_fixture.jsonl"
+python -c "
+import json, sys
+rows = []
+for s in range(6):
+    rows.append({'name': 'train/loss', 'ts': float(s), 'step': s,
+                 'value': float('nan') if s == 4 else 2.0})
+with open(sys.argv[1], 'w') as f:
+    for r in rows:
+        f.write(json.dumps(r) + '\n')
+" "$NAN_FIX"
+# demand the DOCUMENTED alert exit code (3), not just nonzero — a CLI
+# that crashes on every file (exit 1) must fail this gate, not pass it
+rc=0
+python -m apex_tpu.telemetry health "$NAN_FIX" > /dev/null || rc=$?
+if [[ "$rc" -ne 3 ]]; then
+    echo "telemetry health: expected exit 3 (divergence alerts) on the" \
+         "injected-NaN run, got $rc" >&2
+    exit 1
+fi
+echo "health CLI gate OK (healthy=0, injected-NaN=nonzero)"
+rm -rf "$(dirname "$HLT_FILE")"
+
 echo "== 7/7 pytest =="
 if [[ "${1:-}" == "--full" ]]; then
     # full suite + the complete L1 cross-product matrix (reference

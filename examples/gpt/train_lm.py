@@ -102,6 +102,16 @@ def parse_args(argv=None):
                         "amp overflow/loss-scale events, per-axis comm "
                         "bytes; inspect with `python -m "
                         "apex_tpu.telemetry summarize PATH`")
+    p.add_argument("--health", action="store_true",
+                   help="numerics-health observability: per-layer grad/"
+                        "weight norms + update ratios and NaN/Inf counts "
+                        "recorded trace-safely inside the step, overflow "
+                        "attribution to the first offending param group, "
+                        "live divergence alerts (loss z-score, grad "
+                        "explosion, overflow streak) printed to stderr. "
+                        "Implies telemetry; add --telemetry PATH to write "
+                        "the JSONL and inspect with `python -m "
+                        "apex_tpu.telemetry health PATH`")
     p.add_argument("--scan", type=int, default=1,
                    help=">1: dispatch-proof mode — N steps per jitted "
                         "lax.scan dispatch with on-device token "
@@ -170,6 +180,23 @@ def main(argv=None):
         # callbacks are traced into the program only while enabled
         from apex_tpu import telemetry
         telemetry.enable()
+    if args.health:
+        # separate trace-time flag: the in-graph health producers
+        # (grad_stats, overflow attribution) join the step program only
+        # while enabled; implies the base telemetry flag
+        from apex_tpu import telemetry
+        telemetry.health.enable()
+        if not args.telemetry:
+            print("note: --health without --telemetry prints live alerts "
+                  "only; pass --telemetry PATH to also write the JSONL "
+                  "for `python -m apex_tpu.telemetry health PATH`",
+                  file=sys.stderr)
+        if args.scan > 1:
+            print("note: --scan mode has no per-step host loop, so live "
+                  "divergence alerts and the train/loss series are "
+                  "unavailable; the in-graph health producers (grad "
+                  "stats, overflow attribution) still fire",
+                  file=sys.stderr)
     if args.generate:
         return _run_generate(args)
     n_dev = len(jax.devices())
@@ -250,6 +277,19 @@ def main(argv=None):
         grads = (jax.lax.psum(grads, axis) if args.seq_parallel
                  else jax.lax.pmean(grads, axis))
         new_params, new_opt, _ = aopt.step(grads, params, opt_state)
+        from apex_tpu.telemetry import health as _health
+        if _health.enabled():
+            # per-layer grad/weight norms, update ratios, NaN/Inf counts
+            # — on the SYNCED grads (replicated, no psum needed), with
+            # the loss scale divided out so norms are comparable across
+            # scale changes. Step attribution = the amp execution index
+            # so these series join the scaler's amp/* timelines.
+            step_idx = aopt.execution_index(opt_state)
+            _health.grad_stats(
+                grads, params=params,
+                updates=jax.tree_util.tree_map(
+                    lambda a, b: a - b, new_params, params),
+                scale=opt_state.scaler.loss_scale[0], step=step_idx)
         return new_params, new_opt, jax.lax.pmean(loss, axis)
 
     rep = P()
@@ -277,6 +317,12 @@ def main(argv=None):
         step_call = telemetry.instrument_step(
             step_fn, tokens_per_step=batch * args.seq_len)
 
+    detector = None
+    prev_overflows = 0.0
+    if args.health:
+        from apex_tpu import telemetry
+        detector = telemetry.DivergenceDetector()
+
     rng = np.random.default_rng(args.seed + 1)
     t0 = None
     flops_step = None
@@ -287,6 +333,37 @@ def main(argv=None):
         step_rng = jax.random.PRNGKey(args.seed + 2 + i)
         params, opt_state, loss = step_call(params, opt_state, tokens,
                                             step_rng)
+        if args.telemetry or detector is not None:
+            # the loss series feeds the offline loss_nonfinite /
+            # loss_spike rules — a --telemetry-only JSONL must carry it
+            # too, or `telemetry health` is blind to a NaN loss
+            from apex_tpu import telemetry
+            telemetry.record("train/loss", float(loss), step=i)
+        if detector is not None:
+            from apex_tpu import telemetry
+            loss_val = float(loss)
+            # feed the detector every rule's signal, not just loss: the
+            # overflow flag from the scaler's host-readable counter, and
+            # grad-norm / NaN-count from this step's in-graph grad_stats
+            # emission. Debug callbacks are async, so flush them first —
+            # the edge rules (grad_nonfinite-without-overflow) need the
+            # flag and the norm to describe the SAME step; a stale Inf
+            # norm from an overflow step paired with the next step's
+            # clean flag would read as corruption and fail a CI gate.
+            ovf_total = float(opt_state.scaler.overflows[0])
+            jax.effects_barrier()
+            col = telemetry.get_collector()
+            gn_ev = col.last("health/grad_norm")
+            nan_ev = col.last("health/nan")
+            alerts = detector.update(
+                i, loss=loss_val,
+                grad_norm=None if gn_ev is None else gn_ev.value,
+                overflow=ovf_total > prev_overflows,
+                nan_count=None if nan_ev is None else nan_ev.value)
+            prev_overflows = ovf_total
+            for alert in alerts:
+                print(f"health ALERT step {i}: {alert['reason']}"
+                      f" ({alert['detail']})", file=sys.stderr)
         if i == args.warmup_steps:
             jax.block_until_ready(loss)
             # cost analysis BEFORE the timed region (AOT compile; the
@@ -329,6 +406,9 @@ def main(argv=None):
                 + (" (cost analysis + analytic attention model FLOPs)"
                    if flash_opaque else " (cost-analysis count)"))
     print(msg)
+    if detector is not None and detector.alerts:
+        print(f"health: {len(detector.alerts)} divergence alert(s) fired "
+              "— see lines above", file=sys.stderr)
     if args.telemetry:
         from apex_tpu import telemetry
         # static comm bill of the step program (per device per step,
@@ -337,8 +417,9 @@ def main(argv=None):
                                     step_rng, name="comm")
         jax.effects_barrier()   # async debug callbacks land before export
         telemetry.write_jsonl(args.telemetry)
+        sub = "health" if args.health else "summarize"
         print(f"telemetry: {args.telemetry} (python -m apex_tpu.telemetry "
-              f"summarize {args.telemetry})")
+              f"{sub} {args.telemetry})")
     return tok_s
 
 

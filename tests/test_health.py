@@ -1,0 +1,848 @@
+"""apex_tpu.telemetry.health: trace-safe grad stats (global/per-layer,
+bounded cardinality), non-finite provenance + overflow attribution
+through the amp optimizer, divergence detection (live + offline + CLI
+exit codes), the DDP/ZeRO per-bucket grad-norm producers, and the PR's
+satellites: rotation-following export.load, Collector.dropped
+surfacing, concurrent-producer safety, cost-analysis key spellings."""
+
+import json
+import math
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import telemetry
+from apex_tpu.telemetry import events as tel_events
+from apex_tpu.telemetry import export as tel_export
+from apex_tpu.telemetry import health
+from apex_tpu.telemetry.cli import main as cli_main
+
+
+@pytest.fixture
+def col():
+    """Fresh collector with HEALTH (and telemetry) enabled; all global
+    flags restored afterwards."""
+    prev = health._health_enabled
+    with tel_events.capture() as c:
+        health.enable()
+        try:
+            yield c
+        finally:
+            if not prev:
+                health.disable()
+
+
+def _by_name(col, name):
+    return [e for e in col.snapshot() if e.name == name]
+
+
+def _names(col):
+    return {e.name for e in col.snapshot()}
+
+
+# ---------------------------------------------------------------------------
+# enable semantics / disabled-is-free
+# ---------------------------------------------------------------------------
+
+def test_disabled_grad_stats_is_noop():
+    telemetry.get_collector().clear()
+    assert not health.enabled()
+    health.grad_stats({"a": jnp.ones((4,))})
+    health.attribute_overflow(jnp.array(True), {"a": jnp.ones((4,))})
+    assert len(telemetry.get_collector()) == 0
+
+
+def test_health_enable_implies_telemetry():
+    prev_t, prev_h = telemetry.enabled(), health._health_enabled
+    try:
+        telemetry.disable()
+        health.disable()
+        health.enable()
+        assert telemetry.enabled() and health.enabled()
+        # base telemetry off -> health off too (events would be dropped)
+        telemetry.disable()
+        assert not health.enabled()
+    finally:
+        health.disable()
+        if prev_h:
+            health.enable()
+        elif prev_t:
+            telemetry.enable()
+        else:
+            telemetry.disable()
+
+
+def test_jaxpr_identical_when_health_disabled():
+    """The acceptance property: with health disabled, the traced step is
+    bit-identical to one with no health hooks at all."""
+    from apex_tpu import amp, optimizers
+
+    inner = optimizers.FusedSGD(lr=0.1)
+    _, aopt = amp.initialize(None, inner, opt_level="O2", verbosity=0)
+    params = {"a": jnp.ones((4, 4), jnp.float16)}
+    state = aopt.init(params)
+
+    def step(g, p, s):
+        return aopt.step(g, p, s)
+
+    def with_hook(g, p, s):
+        out = aopt.step(g, p, s)
+        health.grad_stats(g, params=p)      # disabled -> must trace nothing
+        return out
+
+    assert not health.enabled()
+    j_plain = str(jax.make_jaxpr(step)(params, params, state))
+    j_hooked = str(jax.make_jaxpr(with_hook)(params, params, state))
+    assert j_plain == j_hooked
+    assert "debug_callback" not in j_hooked
+
+
+def test_jaxpr_changes_when_health_enabled(col):
+    def f(g):
+        health.grad_stats(g)
+        return g
+
+    j = str(jax.make_jaxpr(f)({"a": jnp.ones((4,))}))
+    assert "debug_callback" in j
+
+
+# ---------------------------------------------------------------------------
+# grad_stats
+# ---------------------------------------------------------------------------
+
+def test_grad_stats_global_values(col):
+    g = {"emb": jnp.full((3,), 2.0), "head": jnp.full((4,), 1.0)}
+    p = {"emb": jnp.full((3,), 4.0), "head": jnp.full((4,), 3.0)}
+    u = {"emb": jnp.full((3,), 0.4), "head": jnp.full((4,), 0.3)}
+    health.grad_stats(g, params=p, updates=u, step=2)
+    jax.effects_barrier()
+    (gn,) = _by_name(col, "health/grad_norm")
+    assert gn.value == pytest.approx(math.sqrt(3 * 4 + 4 * 1))
+    assert gn.step == 2
+    (wn,) = _by_name(col, "health/weight_norm")
+    assert wn.value == pytest.approx(math.sqrt(3 * 16 + 4 * 9))
+    (ur,) = _by_name(col, "health/update_ratio")
+    assert ur.value == pytest.approx(
+        math.sqrt(3 * 0.16 + 4 * 0.09) / wn.value)
+    (nf,) = _by_name(col, "health/nonfinite")
+    assert nf.value == 0.0
+    # per-layer series for both groups (2 <= default top_k)
+    assert _by_name(col, "health/layer/emb/grad_norm")[0].value == \
+        pytest.approx(math.sqrt(12))
+    assert _by_name(col, "health/layer/head/grad_norm")[0].value == \
+        pytest.approx(2.0)
+
+
+def test_grad_stats_bounded_cardinality_topk_other(col):
+    # 5 groups, top_k=2: the two largest by norm get named series, the
+    # remaining three fold into layer/(rest)
+    g = {f"g{i}": jnp.full((2,), float(i)) for i in range(5)}
+    health.grad_stats(g, top_k=2)
+    jax.effects_barrier()
+    layer_names = {n for n in _names(col) if n.startswith("health/layer/")}
+    assert layer_names == {"health/layer/g4/grad_norm",
+                           "health/layer/g3/grad_norm",
+                           "health/layer/(rest)/grad_norm"}
+    (other,) = _by_name(col, "health/layer/(rest)/grad_norm")
+    assert other.value == pytest.approx(math.sqrt(2 * (0 + 1 + 4)))
+
+
+def test_grad_stats_nonfinite_group_ranks_first(col):
+    # the NaN group must be named even when its finite norm would lose
+    g = {"big": jnp.full((4,), 100.0),
+         "mid": jnp.full((4,), 10.0),
+         "sick": jnp.array([jnp.nan, 0.1])}
+    health.grad_stats(g, top_k=1)
+    jax.effects_barrier()
+    layer = {n for n in _names(col) if n.startswith("health/layer/")}
+    assert "health/layer/sick/grad_norm" in layer
+    assert "health/layer/sick/nonfinite" in layer
+    (nan_ev,) = _by_name(col, "health/nan")
+    assert nan_ev.value == 1.0
+
+
+def test_grad_stats_scale_divides_norms(col):
+    g = {"a": jnp.full((4,), 8.0)}
+    health.grad_stats(g, scale=8.0)
+    jax.effects_barrier()
+    (gn,) = _by_name(col, "health/grad_norm")
+    assert gn.value == pytest.approx(2.0)   # sqrt(4 * 64) / 8
+
+
+def test_grad_stats_prefixes_grouping(col):
+    g = {"enc": {"l0": jnp.ones((2,)), "l1": jnp.ones((2,))},
+         "dec": {"l0": jnp.ones((2,))},
+         "head": jnp.ones((3,))}
+    health.grad_stats(g, prefixes=["enc", "dec/l0"])
+    jax.effects_barrier()
+    layer = {n for n in _names(col) if n.startswith("health/layer/")}
+    assert layer == {"health/layer/enc/grad_norm",
+                     "health/layer/dec/l0/grad_norm",
+                     "health/layer/other/grad_norm"}
+
+
+def test_grad_stats_real_other_group_distinct_from_fold(col):
+    # the unmatched-prefix bucket is a REAL group named "other"; when it
+    # ranks in top-K while other groups fold, the fold's (rest) series
+    # must stay a separate name — a collision would average the two in
+    # summarize's (name, step) dedup.
+    g = {"embed": jnp.full((2,), 1.0),
+         "huge_unmatched": jnp.full((2,), 100.0),
+         "small_a": jnp.full((2,), 0.5),
+         "small_b": jnp.full((2,), 0.25)}
+    health.grad_stats(g, prefixes=["embed", "small_a", "small_b"],
+                      top_k=1)
+    jax.effects_barrier()
+    layer = {n for n in _names(col) if n.startswith("health/layer/")}
+    assert layer == {"health/layer/other/grad_norm",
+                     "health/layer/(rest)/grad_norm"}
+    (other,) = _by_name(col, "health/layer/other/grad_norm")
+    assert other.value == pytest.approx(100.0 * math.sqrt(2))
+    (rest,) = _by_name(col, "health/layer/(rest)/grad_norm")
+    assert rest.value == pytest.approx(
+        math.sqrt(2 * (1.0 + 0.25 + 0.0625)))
+
+
+def test_grad_stats_mismatched_trees_align_by_name(col):
+    # frozen-embedding training: params carry a group grads don't.
+    # The weight/update norms must pair groups BY NAME — the emb group
+    # is excluded, never index-mispaired onto head.
+    g = {"head": jnp.full((4,), 1.0)}
+    p = {"emb": jnp.full((3,), 100.0), "head": jnp.full((4,), 3.0)}
+    u = {"emb": jnp.zeros((3,)), "head": jnp.full((4,), 0.3)}
+    health.grad_stats(g, params=p, updates=u)
+    jax.effects_barrier()
+    (wn,) = _by_name(col, "health/weight_norm")
+    assert wn.value == pytest.approx(6.0)       # head only, not emb's 100s
+    (ur,) = _by_name(col, "health/update_ratio")
+    assert ur.value == pytest.approx(0.1)       # 0.6 / 6.0
+    (lur,) = _by_name(col, "health/layer/head/update_ratio")
+    assert lur.value == pytest.approx(0.1)
+
+
+def test_grad_stats_more_grad_groups_than_params(col):
+    # grads with a group params lack must not index out of bounds in the
+    # host callback; the uncovered group just has no per-layer ratio
+    g = {"a": jnp.full((2,), 1.0), "b": jnp.full((2,), 2.0)}
+    p = {"a": jnp.full((2,), 3.0)}
+    u = {"a": jnp.full((2,), 0.3)}
+    health.grad_stats(g, params=p, updates=u)
+    jax.effects_barrier()
+    assert _by_name(col, "health/layer/a/update_ratio")
+    assert not _by_name(col, "health/layer/b/update_ratio")
+    (wn,) = _by_name(col, "health/weight_norm")
+    assert wn.value == pytest.approx(math.sqrt(2 * 9))
+
+
+def test_grad_stats_under_jit_with_traced_step(col):
+    @jax.jit
+    def f(g, s):
+        health.grad_stats(g, step=s)
+        return g
+
+    jax.block_until_ready(f({"w": jnp.full((9,), 2.0)}, jnp.int32(7)))
+    jax.effects_barrier()
+    (gn,) = _by_name(col, "health/grad_norm")
+    assert (gn.value, gn.step) == (pytest.approx(6.0), 7)
+
+
+def test_grad_stats_under_shard_map_psum(col):
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+
+    def body(x):
+        health.grad_stats({"w": x}, axis_name="data", step=0)
+        return jax.lax.psum(jnp.sum(x), "data")
+
+    f = jax.jit(shard_map(body, mesh=mesh, in_specs=P("data"),
+                          out_specs=P(), check_vma=False))
+    jax.block_until_ready(f(jnp.ones((8, 4))))
+    jax.effects_barrier()
+    evs = _by_name(col, "health/grad_norm")
+    # one callback per shard, each carrying the psum'd global value
+    assert 1 <= len(evs) <= 8
+    assert all(e.value == pytest.approx(math.sqrt(32)) for e in evs)
+    # summarize's (name, step) dedup collapses the replicas
+    agg = tel_export.summarize([e.to_dict() for e in col.snapshot()])
+    assert agg["health"]["grad_norm"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# overflow attribution
+# ---------------------------------------------------------------------------
+
+def test_attribute_overflow_names_first_group_in_tree_order(col):
+    g = {"a": jnp.ones((4,)),
+         "b": jnp.array([jnp.nan, 1.0]),
+         "c": jnp.array([jnp.inf, jnp.inf])}
+    health.attribute_overflow(jnp.array(True), g, step=3)
+    jax.effects_barrier()
+    (e,) = _by_name(col, "health/overflow_source")
+    assert e.step == 3 and e.value == 3.0
+    assert e.meta["group"] == "b"           # first offender, tree order
+    assert e.meta["nan"] == 1 and e.meta["inf"] == 2
+    assert e.meta["per_group"] == {"b": 1, "c": 2}
+
+
+def test_attribute_overflow_silent_without_overflow(col):
+    health.attribute_overflow(
+        jnp.array(False), {"a": jnp.array([jnp.nan])})
+    jax.effects_barrier()
+    assert not _by_name(col, "health/overflow_source")
+
+
+def test_attribute_overflow_under_jit_cond(col):
+    @jax.jit
+    def f(g, flag):
+        health.attribute_overflow(flag, g, step=1)
+        return flag
+
+    g = {"x": jnp.ones((2,)), "y": jnp.array([jnp.inf])}
+    jax.block_until_ready(f(g, jnp.array(True)))
+    jax.block_until_ready(f(g, jnp.array(False)))
+    jax.effects_barrier()
+    evs = _by_name(col, "health/overflow_source")
+    assert len(evs) == 1                    # False run emitted nothing
+    assert evs[0].meta["group"] == "y"
+
+
+def test_amp_optimizer_attributes_overflow(col):
+    from apex_tpu import amp, optimizers
+
+    inner = optimizers.FusedSGD(lr=0.1)
+    _, aopt = amp.initialize(None, inner, opt_level="O2", verbosity=0)
+    params = {"a": jnp.ones((4, 4), jnp.float16),
+              "b": jnp.ones((4,), jnp.float16)}
+    state = aopt.init(params)
+    step = jax.jit(lambda g, p, s: aopt.step(g, p, s))
+
+    good = {"a": jnp.ones((4, 4), jnp.float16),
+            "b": jnp.ones((4,), jnp.float16)}
+    bad = {"a": jnp.ones((4, 4), jnp.float16),
+           "b": jnp.full((4,), jnp.nan, jnp.float16)}
+    params, state, _ = step(good, params, state)
+    params, state, _ = step(bad, params, state)
+    jax.block_until_ready(state.scaler.loss_scale)
+    jax.effects_barrier()
+    (e,) = _by_name(col, "health/overflow_source")
+    assert e.meta["group"] == "b" and e.meta["nan"] == 4
+    assert e.step == 1                      # execution index attribution
+
+
+# ---------------------------------------------------------------------------
+# divergence detector (live + offline + CLI)
+# ---------------------------------------------------------------------------
+
+def test_detector_loss_nonfinite_fires_immediately():
+    det = health.DivergenceDetector(emit=False)
+    assert det.update(0, loss=1.0) == []
+    (a,) = det.update(1, loss=float("nan"))
+    assert a["reason"] == "loss_nonfinite" and a["step"] == 1
+
+
+def test_detector_loss_spike_zscore():
+    det = health.DivergenceDetector(emit=False, min_history=4,
+                                    z_threshold=6.0)
+    for i in range(8):
+        assert det.update(i, loss=2.0 + 0.01 * (i % 2)) == []
+    (a,) = det.update(8, loss=50.0)
+    assert a["reason"] == "loss_spike"
+
+
+def test_detector_small_window_clamps_min_history():
+    # window < default min_history (8) must not silently disable the
+    # spike/explosion rules: the deques cap at maxlen=window, so an
+    # unclamped gate len >= 8 could never open.
+    det = health.DivergenceDetector(emit=False, window=6,
+                                    z_threshold=6.0,
+                                    explosion_ratio=10.0)
+    assert det.min_history <= det.window
+    for i in range(6):
+        assert det.update(i, loss=2.0, grad_norm=1.0) == []
+    alerts = det.update(6, loss=50.0, grad_norm=100.0)
+    assert {a["reason"] for a in alerts} == {"loss_spike",
+                                             "grad_explosion"}
+
+
+def test_detector_grad_explosion_and_nan():
+    det = health.DivergenceDetector(emit=False, min_history=4,
+                                    explosion_ratio=10.0)
+    for i in range(6):
+        assert det.update(i, grad_norm=1.0) == []
+    (a,) = det.update(6, grad_norm=100.0)
+    assert a["reason"] == "grad_explosion"
+    (b,) = det.update(7, nan_count=3.0)
+    assert b["reason"] == "nan_grads"
+
+
+def test_detector_persistent_conditions_fire_once_per_episode():
+    # a run stuck at NaN reports ONE alert per episode, not one per step
+    det = health.DivergenceDetector(emit=False)
+    assert len(det.update(0, loss=float("nan"), nan_count=5.0)) == 2
+    for s in range(1, 40):      # condition persists: no re-fire
+        assert det.update(s, loss=float("nan"), nan_count=5.0) == []
+    # clears, then sets in again: a NEW episode fires
+    assert det.update(40, loss=1.0, nan_count=0.0) == []
+    assert len(det.update(41, loss=float("nan"), nan_count=2.0)) == 2
+
+
+def test_detector_inf_with_overflow_is_benign_nan_is_not():
+    det = health.DivergenceDetector(emit=False)
+    # inf grad norm on a scaler-flagged step: normal saturate-skip-halve
+    assert det.update(0, grad_norm=float("inf"), overflow=1.0) == []
+    # same without the overflow flag: something else went non-finite
+    (a,) = det.update(1, grad_norm=float("inf"), overflow=0.0)
+    assert a["reason"] == "grad_nonfinite"
+
+
+def test_detector_overflow_streak():
+    det = health.DivergenceDetector(emit=False, overflow_streak=3)
+    assert det.update(0, overflow=0.0) == []   # scale found footing
+    assert det.update(1, overflow=1.0) == []
+    assert det.update(2, overflow=1.0) == []
+    (a,) = det.update(3, overflow=1.0)
+    assert a["reason"] == "overflow_streak"
+    assert det.update(4, overflow=1.0) == []   # fires once per streak
+
+
+def test_detector_overflow_streak_warmup_grace():
+    # the dynamic scaler's initial scale search (2^16 halved down) is a
+    # legitimate overflow streak: before any clean step the threshold is
+    # overflow_streak + grace, so healthy warmups don't trip CI gates
+    det = health.DivergenceDetector(emit=False, overflow_streak=3)
+    grace = health.DivergenceDetector._SCALE_SEARCH_GRACE
+    alerts = []
+    for s in range(3 + grace - 1):
+        alerts += det.update(s, overflow=1.0)
+    assert alerts == []            # a plausible scale search stays quiet
+    (a,) = det.update(3 + grace - 1, overflow=1.0)  # beyond a real search
+    assert a["reason"] == "overflow_streak"
+
+
+def test_detector_emits_alert_events(col):
+    det = health.DivergenceDetector()
+    det.update(4, loss=float("inf"))
+    (e,) = _by_name(col, "health/alert")
+    assert e.kind == "counter" and e.step == 4
+    assert e.meta["reason"] == "loss_nonfinite"
+
+
+def test_detector_tiny_window_keeps_rules_armed():
+    # window=1 clamps to 2 and the deques must use the CLAMPED value —
+    # deque(maxlen=1) with min_history=2 could never open the gate and
+    # both statistical rules would be silently off.
+    det = health.DivergenceDetector(emit=False, window=1,
+                                    z_threshold=6.0,
+                                    explosion_ratio=10.0)
+    assert det._losses.maxlen == det.window >= det.min_history
+    for i in range(4):
+        det.update(i, loss=2.0, grad_norm=1.0)
+    alerts = det.update(4, loss=2000.0, grad_norm=1000.0)
+    assert {a["reason"] for a in alerts} == {"loss_spike",
+                                             "grad_explosion"}
+
+
+def test_detect_prefers_train_loss_over_other_loss_series():
+    # a second */loss series (val/loss at eval steps) must NOT blend
+    # into the detector's loss signal: averaging train+val at shared
+    # steps jumps vs the train-only window and fakes a loss_spike.
+    evs = [{"name": "train/loss", "value": 2.0, "ts": float(s),
+            "step": s} for s in range(12)]
+    evs += [{"name": "val/loss", "value": 40.0, "ts": float(s),
+             "step": s} for s in (5, 10)]
+    assert health.detect(evs) == []
+
+
+def test_detect_offline_merges_sources():
+    evs = [{"name": "train/loss", "value": 2.0, "ts": 0.0, "step": 0},
+           {"name": "train/loss", "value": float("nan"), "ts": 1.0,
+            "step": 1},
+           {"name": "health/overflow_source", "value": 4.0, "ts": 1.0,
+            "step": 1,
+            "meta": {"group": "blk/w", "nan": 4, "inf": 0}},
+           {"name": "health/alert", "value": 1.0, "ts": 2.0, "step": 2,
+            "kind": "counter",
+            "meta": {"reason": "custom", "detail": "live"}}]
+    alerts = health.detect(evs)
+    reasons = {(a["step"], a["reason"]) for a in alerts}
+    assert (1, "loss_nonfinite") in reasons
+    assert (1, "nan_grads") in reasons
+    assert (2, "custom") in reasons
+    nan_a = next(a for a in alerts if a["reason"] == "nan_grads")
+    assert "blk/w" in nan_a["detail"]       # names the offending group
+
+
+def test_health_cli_healthy_exit_zero(tmp_path, capsys):
+    path = str(tmp_path / "ok.jsonl")
+    evs = [{"name": "train/loss", "value": 2.0 - 0.1 * s, "ts": float(s),
+            "step": s} for s in range(5)]
+    evs += [{"name": "health/grad_norm", "value": 1.0, "ts": float(s),
+             "step": s} for s in range(5)]
+    tel_export.write_jsonl(path, evs)
+    assert cli_main(["health", path]) == 0
+    out = capsys.readouterr().out
+    assert "healthy" in out and "grad norm" in out
+
+
+def test_health_cli_surfaces_dropped_events(tmp_path, capsys):
+    # a verdict over a lossy stream must be qualified: the events that
+    # would have alerted may be among the dropped ones.
+    path = str(tmp_path / "lossy.jsonl")
+    evs = [{"name": "train/loss", "value": 2.0, "ts": float(s),
+            "step": s} for s in range(5)]
+    evs.append({"name": "telemetry/dropped", "value": 7.0, "ts": 5.0,
+                "kind": "counter"})
+    tel_export.write_jsonl(path, evs)
+    assert cli_main(["health", path]) == 0
+    cap = capsys.readouterr()
+    assert "healthy" in cap.out
+    assert "7 events were dropped" in cap.err
+    assert cli_main(["health", path, "--json"]) == 0
+    cap = capsys.readouterr()
+    assert json.loads(cap.out)["dropped"] == 7
+    assert "7 events were dropped" in cap.err
+
+
+def test_health_cli_injected_nan_run(tmp_path, capsys, col):
+    """The acceptance fixture: an amp step fed NaN grads in one named
+    param group -> `telemetry health` exits nonzero AND the report names
+    the first non-finite group."""
+    from apex_tpu import amp, optimizers
+
+    inner = optimizers.FusedSGD(lr=0.1)
+    _, aopt = amp.initialize(None, inner, opt_level="O2", verbosity=0)
+    params = {"emb": jnp.ones((4, 4), jnp.float16),
+              "blocks_1": jnp.ones((8,), jnp.float16)}
+    state = aopt.init(params)
+    step = jax.jit(lambda g, p, s: aopt.step(g, p, s))
+    for i in range(4):
+        g = jax.tree_util.tree_map(jnp.ones_like, params)
+        if i == 2:   # the injected-NaN step
+            g["blocks_1"] = jnp.full((8,), jnp.nan, jnp.float16)
+        params, state, _ = step(g, params, state)
+    jax.block_until_ready(state.scaler.loss_scale)
+    jax.effects_barrier()
+    path = str(tmp_path / "nan_run.jsonl")
+    telemetry.write_jsonl(path)
+    rc = cli_main(["health", path])
+    out = capsys.readouterr().out
+    assert rc == 3
+    assert "blocks_1" in out                # names the offending group
+    assert "nan_grads" in out
+
+
+def test_health_cli_json_strict_on_nonfinite_stats(tmp_path, capsys):
+    # the --json contract: even a diverged run (NaN stats — the health
+    # command's core case) must emit RFC 8259 JSON a strict parser takes
+    path = str(tmp_path / "div.jsonl")
+    # every sample non-finite: the stats themselves are NaN (a finite
+    # subset would instead carry finite stats + a "nonfinite" count)
+    evs = [{"name": "health/grad_norm", "value": float("nan"),
+            "ts": float(s), "step": s} for s in range(5)]
+    tel_export.write_jsonl(path, evs)
+    cli_main(["health", path, "--json"])
+    out = capsys.readouterr().out
+    parsed = json.loads(out, parse_constant=lambda c: pytest.fail(
+        f"non-strict JSON constant {c!r} in --json output"))
+    assert parsed["grad_norm"]["mean"] == "NaN"
+    assert parsed["grad_norm"]["nonfinite"] == 5
+
+
+def test_jsonl_file_is_strict_json_and_roundtrips_nonfinite(tmp_path):
+    # the run FILE must also be RFC 8259 strict — a diverged run's NaN
+    # loss is exactly the value worth exporting. Strings on disk, floats
+    # back in memory.
+    path = str(tmp_path / "strict.jsonl")
+    tel_export.write_jsonl(path, [
+        {"name": "train/loss", "value": float("nan"), "ts": 0.0, "step": 0},
+        {"name": "health/grad_norm", "value": float("inf"), "ts": 1.0,
+         "step": 1},
+        {"name": "train/loss", "value": 2.0, "ts": 2.0, "step": 2}])
+    with open(path) as f:
+        for line in f:
+            json.loads(line, parse_constant=lambda c: pytest.fail(
+                f"non-strict JSON constant {c!r} in run file"))
+    evs = tel_export.read_jsonl(path)
+    assert math.isnan(evs[0]["value"])
+    assert evs[1]["value"] == float("inf")
+    assert evs[2]["value"] == 2.0
+    # and the NaN still drives detection after the round-trip
+    alerts = health.detect(evs)
+    assert any(a["reason"] == "loss_nonfinite" for a in alerts)
+
+
+def test_collector_last():
+    with tel_events.capture() as c:
+        assert c.last("a") is None
+        telemetry.record("a", 1.0, step=0)
+        telemetry.record("b", 5.0, step=0)
+        telemetry.record("a", 2.0, step=1)
+        assert c.last("a").value == 2.0
+        assert c.last("b").value == 5.0
+
+
+def test_summarize_health_section_and_format(tmp_path):
+    evs = []
+    for s in range(4):
+        evs.append({"name": "health/grad_norm", "value": 1.0 + s,
+                    "ts": float(s), "step": s})
+        evs.append({"name": "health/update_ratio", "value": 1e-3,
+                    "ts": float(s), "step": s})
+        evs.append({"name": "health/nonfinite", "value": 0.0,
+                    "ts": float(s), "step": s})
+        evs.append({"name": "health/layer/emb/grad_norm", "value": 0.5,
+                    "ts": float(s), "step": s})
+    s = tel_export.summarize(evs)
+    h = s["health"]
+    assert h["grad_norm"]["count"] == 4
+    assert h["grad_norm"]["max"] == 4.0
+    assert h["update_ratio"]["mean"] == pytest.approx(1e-3)
+    assert h["layers"] == {"emb": 0.5}
+    assert "alerts" not in h
+    text = tel_export.format_summary(s)
+    assert "health:" in text and "update ratio" in text
+
+
+def test_summarize_health_stats_robust_to_nonfinite():
+    # diverged runs carry NaN/Inf samples BY DESIGN; order statistics
+    # must run on the finite subset (NaN is incomparable under sort and
+    # would poison the percentiles / hide the finite peak from max)
+    evs = [{"name": "health/grad_norm", "value": v, "ts": float(i),
+            "step": i}
+           for i, v in enumerate([5.0, math.nan, 1.0])]
+    g = tel_export.summarize(evs)["health"]["grad_norm"]
+    assert g["count"] == 3 and g["nonfinite"] == 1
+    assert g["max"] == 5.0 and g["p50"] == 3.0
+    evs.append({"name": "health/grad_norm", "value": math.inf,
+                "ts": 3.0, "step": 3})
+    g = tel_export.summarize(evs)["health"]["grad_norm"]
+    assert g["max"] == math.inf and g["mean"] == 3.0  # finite mean
+
+
+def test_summarize_overflow_sources_dedup_shard_replicas():
+    # attribute_overflow's callback fires once PER SHARD under
+    # shard_map/pmap: 8 replicas of each overflow must collapse to one
+    # report row per (step, group), not flood the 20-row cap
+    evs = []
+    for step in (3, 7):
+        for _ in range(8):
+            evs.append({"name": "health/overflow_source", "value": 2.0,
+                        "ts": float(step), "step": step,
+                        "meta": {"group": "blk", "nan": 1}})
+    h = tel_export.summarize(evs)["health"]
+    assert [s["step"] for s in h["overflow_sources"]] == [3, 7]
+
+
+# ---------------------------------------------------------------------------
+# producer wiring: DDP / ZeRO per-bucket grad norms
+# ---------------------------------------------------------------------------
+
+def test_ddp_bucket_grad_norms(col):
+    from apex_tpu import parallel
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+    grads = {"a": jnp.ones((16, 8), jnp.float32),
+             "b": jnp.ones((32,), jnp.bfloat16)}
+    f = jax.jit(shard_map(
+        lambda g, s: parallel.allreduce_gradients(g, "data",
+                                                  telemetry_step=s),
+        mesh=mesh, in_specs=(P(), P()), out_specs=P(), check_vma=False))
+    jax.block_until_ready(f(grads, jnp.int32(5)))
+    jax.effects_barrier()
+    names = {n for n in _names(col) if n.startswith("health/ddp/")}
+    assert names == {"health/ddp/bucket0/grad_norm",
+                     "health/ddp/bucket1/grad_norm"}
+    # step attribution: per-shard replicas carry the step so summarize's
+    # (name, step) dedup collapses them to one sample per bucket
+    assert all(e.step == 5 for n in names for e in _by_name(col, n))
+    agg = tel_export.summarize([e.to_dict() for e in col.snapshot()])
+    # producer series report under "buckets", NOT mixed into the
+    # (unscaled) grad_stats "layers" table
+    assert agg["health"]["buckets"]["ddp/bucket0"] == pytest.approx(
+        math.sqrt(128), rel=1e-3)
+    assert "ddp/bucket0" not in agg["health"].get("layers", {})
+    # grads are replicated ones; pmean keeps them ones -> norm = sqrt(n)
+    vals = sorted({e.value for n in names for e in _by_name(col, n)})
+    assert vals[0] == pytest.approx(math.sqrt(32), rel=1e-3)
+    assert vals[-1] == pytest.approx(math.sqrt(128), rel=1e-3)
+
+
+def test_zero_bucket_grad_norms(col):
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+
+    n = 8
+    mesh = Mesh(np.asarray(jax.devices()[:n]), ("data",))
+    opt = DistributedFusedAdam(lr=1e-3, axis_name="data", shard_count=n)
+    p = {"w": jnp.ones((8, 16)), "b": jnp.ones((8,))}    # 136 elements
+    st = opt.init(p)
+    f = jax.jit(shard_map(
+        lambda g, p, s: opt.step(g, p, s), mesh=mesh,
+        in_specs=(P(), P(), opt.state_pspec()),
+        out_specs=(P(), opt.state_pspec()), check_vma=False))
+    _, new_st = f(p, p, st)
+    jax.block_until_ready(new_st.master)
+    jax.effects_barrier()
+    evs = _by_name(col, "health/zero/bucket0/grad_norm")
+    assert evs
+    # replicated ones-grads, mean over 8 devices is ones: norm sqrt(136)
+    assert all(e.value == pytest.approx(math.sqrt(136)) for e in evs)
+    # step rides in from ZeroState.step so shard replicas dedup
+    assert all(e.step == 1 for e in evs)
+
+
+# ---------------------------------------------------------------------------
+# satellites: load(follow_rotations), dropped surfacing, concurrency,
+# cost-analysis key spellings
+# ---------------------------------------------------------------------------
+
+def test_load_follows_rotations_oldest_first(tmp_path):
+    path = str(tmp_path / "r.jsonl")
+    with tel_export.JsonlWriter(path, max_bytes=300, max_files=3) as w:
+        for i in range(30):
+            w.write(tel_events.Event("n", float(i), ts=0.0))
+    import os
+    assert os.path.exists(path + ".1")      # rotation actually happened
+    all_evs = tel_export.load(path)
+    vals = [e["value"] for e in all_evs]
+    assert vals == sorted(vals)             # oldest-first, in order
+    assert vals[-1] == 29.0
+    live_only = tel_export.load(path, follow_rotations=False)
+    assert live_only == tel_export.read_jsonl(path)
+    assert len(live_only) < len(all_evs)
+
+
+def test_cli_summarize_includes_rotated_generations(tmp_path, capsys):
+    path = str(tmp_path / "rot.jsonl")
+    with tel_export.JsonlWriter(path, max_bytes=400, max_files=5) as w:
+        for s in range(40):
+            w.write(tel_events.Event("step/time_s", 0.1, ts=float(s),
+                                     step=s))
+    assert cli_main(["summarize", path, "--json"]) == 0
+    agg = json.loads(capsys.readouterr().out)
+    n_live = len(tel_export.read_jsonl(path))
+    assert agg["step_time_s"]["count"] > n_live
+    assert cli_main(["summarize", path, "--json", "--no-follow"]) == 0
+    agg2 = json.loads(capsys.readouterr().out)
+    assert agg2["step_time_s"]["count"] == n_live
+
+
+def test_cli_tail_reads_rotations_newest_first(tmp_path, capsys):
+    path = str(tmp_path / "t.jsonl")
+    with tel_export.JsonlWriter(path, max_bytes=300, max_files=5) as w:
+        for i in range(30):
+            w.write(tel_events.Event("n", float(i), ts=0.0))
+    n_live = len(tel_export.read_jsonl(path))
+    # ask for more than the live file holds: rotated generations must
+    # contribute, in order, without loading the whole history
+    want = n_live + 2
+    assert cli_main(["tail", path, "-n", str(want)]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == want
+    assert out[-1].startswith("0.000 n=29")
+    vals = [float(line.split("n=")[1].split()[0]) for line in out]
+    assert vals == sorted(vals)
+
+
+def test_dropped_events_surfaced(tmp_path):
+    with tel_events.capture(capacity=3) as c:
+        for i in range(8):
+            telemetry.record("x", float(i))
+        assert c.dropped == 5
+        path = str(tmp_path / "drop.jsonl")
+        telemetry.write_jsonl(path)         # drains + appends the marker
+    evs = tel_export.read_jsonl(path)
+    drop = [e for e in evs if e["name"] == "telemetry/dropped"]
+    assert len(drop) == 1
+    assert drop[0]["value"] == 5.0 and drop[0]["kind"] == "counter"
+    assert drop[0]["meta"]["capacity"] == 3
+    s = tel_export.summarize(evs)
+    assert s["dropped"] == 5.0
+    assert "WARNING" in tel_export.format_summary(s)
+    assert "dropped" in tel_export.format_summary(s)
+
+
+def test_drain_resets_dropped_between_runs(tmp_path):
+    # a lossy run A must not contaminate a clean run B written from the
+    # same collector: drain() resets dropped alongside the buffer
+    with tel_events.capture(capacity=3) as c:
+        for i in range(8):
+            telemetry.record("x", float(i))
+        path_a = str(tmp_path / "a.jsonl")
+        telemetry.write_jsonl(path_a)
+        assert c.dropped == 0
+        telemetry.record("y", 1.0)
+        path_b = str(tmp_path / "b.jsonl")
+        telemetry.write_jsonl(path_b)
+    assert any(e["name"] == "telemetry/dropped"
+               for e in tel_export.read_jsonl(path_a))
+    evs_b = tel_export.read_jsonl(path_b)
+    assert [e["name"] for e in evs_b] == ["y"]
+    assert "dropped" not in tel_export.summarize(evs_b)
+
+
+def test_no_dropped_event_when_nothing_dropped(tmp_path):
+    with tel_events.capture() as c:
+        telemetry.record("x", 1.0)
+        path = str(tmp_path / "ok.jsonl")
+        telemetry.write_jsonl(path)
+    evs = tel_export.read_jsonl(path)
+    assert [e["name"] for e in evs] == ["x"]
+    assert "dropped" not in tel_export.summarize(evs)
+
+
+def test_collector_concurrent_producers_no_loss_unaccounted():
+    n_threads, n_events, cap = 8, 500, 64
+    c = tel_events.Collector(capacity=cap)
+
+    def worker(t):
+        for i in range(n_events):
+            c.record(f"t{t}", float(i), step=i)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # conservation: every event either survived or was counted dropped
+    assert len(c) + c.dropped == n_threads * n_events
+    assert len(c) == cap
+    # no duplication/corruption: each surviving event is a well-formed
+    # (thread, step, value) fact and no (name, step) pair appears twice
+    seen = set()
+    for e in c.snapshot():
+        assert e.name in {f"t{t}" for t in range(n_threads)}
+        assert e.value == float(e.step)
+        assert (e.name, e.step) not in seen
+        seen.add((e.name, e.step))
+
+
+def test_cost_analysis_value_both_spellings():
+    from apex_tpu._compat import cost_analysis_value
+
+    assert cost_analysis_value({"bytes accessed": 5.0},
+                               "bytes accessed") == 5.0
+    assert cost_analysis_value({"bytes_accessed": 7.0},
+                               "bytes accessed") == 7.0
+    assert cost_analysis_value({"optimal seconds": 1.0},
+                               "optimal_seconds") == 1.0
+    assert cost_analysis_value({}, "bytes accessed", 0.0) == 0.0
+    assert cost_analysis_value(None, "bytes accessed") is None
+    # the spelled key wins over the variant when both exist
+    assert cost_analysis_value(
+        {"bytes accessed": 1.0, "bytes_accessed": 2.0},
+        "bytes accessed") == 1.0
+
+
+def test_analyze_reports_flops_via_compat():
+    from apex_tpu.pyprof import prof
+
+    out = prof.analyze(lambda x: x @ x, jnp.ones((16, 16)))
+    assert out["flops"] and out["flops"] > 0
+    if out["bytes_accessed"] is not None:
+        assert out["arithmetic_intensity"] > 0
