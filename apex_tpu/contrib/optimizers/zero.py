@@ -101,7 +101,7 @@ class _ZeroBase(FusedOptimizer):
                  shard_count: Optional[int] = None,
                  group_axis: Optional[str] = None,
                  allgather_dtype=None, param_groups=None,
-                 chunk_elements: int = 2 ** 23):
+                 chunk_elements: Optional[int] = None):
         self.axis_name = axis_name
         self._shard_count = shard_count  # resolved lazily from the mesh
         # Mesh axis ACROSS which optimizer state is replicated (the
@@ -111,7 +111,17 @@ class _ZeroBase(FusedOptimizer):
         self.allgather_dtype = allgather_dtype
         # Bucket capacity (elements) for the overlap-friendly chunked
         # reduce-scatter/all-gather (reference dwu chunking,
-        # distributed_fused_adam.py:297-331). <=0: one whole-tree bucket.
+        # distributed_fused_adam.py:297-331). None (default): resolved
+        # through apex_tpu.tune at first _pack (the frozen 2**23 under
+        # APEX_TPU_TUNE=off). 0: one whole-tree bucket. The RESOLVED
+        # value participates in the ZeroState flat layout and is recorded
+        # by layout_fingerprint. Negative values raise here, not at some
+        # deep trace site.
+        if chunk_elements is not None and chunk_elements < 0:
+            raise ValueError(
+                f"chunk_elements must be >= 1 (or 0 for one whole-tree "
+                f"bucket, or None to resolve via apex_tpu.tune); got "
+                f"{chunk_elements}")
         self.chunk_elements = chunk_elements
         self._spec_cache = None
         self._init_groups(param_groups)
@@ -144,9 +154,14 @@ class _ZeroBase(FusedOptimizer):
         offsets = np.cumsum([0] + sizes[:-1])
         total = int(sum(sizes))
         n = self.shard_count
+        from apex_tpu import tune
+        chunk_elements = self.chunk_elements
+        if chunk_elements is None:
+            chunk_elements = tune.zero_chunk_elements(total=total, world=n)
         # Contiguous-leaf buckets of at most chunk_elements each; a single
         # oversize leaf forms its own bucket (leaves never split).
-        runs = _buckets.partition_by_capacity(sizes, self.chunk_elements)
+        runs = _buckets.partition_by_capacity(sizes, chunk_elements)
+        tune.warn_bucket_count("zero", len(runs), chunk_elements)
         buckets = []
         for idxs in runs:
             size_b = int(sum(sizes[i] for i in idxs))
@@ -181,6 +196,7 @@ class _ZeroBase(FusedOptimizer):
         self._spec_cache = dict(
             treedef=treedef, shapes=shapes, sizes=sizes,
             offsets=offsets, total=total, padded=padded, buckets=buckets,
+            chunk_elements=int(chunk_elements),
             dtypes=[l.dtype for l in leaves],
             group_of_tensor=group_of_tensor, group_overrides=overrides)
         return self._spec_cache
@@ -233,7 +249,10 @@ class _ZeroBase(FusedOptimizer):
         pairs = [(path_str(p), tuple(l.shape)) for p, l in
                  jax.tree_util.tree_flatten_with_path(params)[0]]
         return {
-            "chunk_elements": int(self.chunk_elements),
+            # the RESOLVED capacity (chunk_elements=None routes through
+            # apex_tpu.tune): the layout guard must record what actually
+            # shaped the flat arrays, not the constructor sentinel
+            "chunk_elements": int(spec["chunk_elements"]),
             "shard_count": int(self.shard_count),
             "total": int(spec["total"]),
             "padded": int(spec["padded"]),
@@ -451,7 +470,7 @@ class DistributedFusedAdam(_ZeroBase):
                  adam_w_mode: bool = True, weight_decay: float = 0.0,
                  axis_name: str = "data", shard_count: Optional[int] = None,
                  group_axis: Optional[str] = None, allgather_dtype=None,
-                 param_groups=None, chunk_elements: int = 2 ** 23):
+                 param_groups=None, chunk_elements: Optional[int] = None):
         super().__init__(axis_name=axis_name, shard_count=shard_count,
                          group_axis=group_axis,
                          allgather_dtype=allgather_dtype,
@@ -512,7 +531,7 @@ class DistributedFusedLAMB(_ZeroBase):
                  use_nvlamb: bool = False, axis_name: str = "data",
                  shard_count: Optional[int] = None,
                  group_axis: Optional[str] = None, allgather_dtype=None,
-                 param_groups=None, chunk_elements: int = 2 ** 23):
+                 param_groups=None, chunk_elements: Optional[int] = None):
         super().__init__(axis_name=axis_name, shard_count=shard_count,
                          group_axis=group_axis,
                          allgather_dtype=allgather_dtype,

@@ -43,7 +43,11 @@ class MultiTensorApply:
 
     def __init__(self, chunk_size: int = 2048 * 32):
         # Kept for signature parity; XLA picks its own tiling. The Pallas
-        # bucket path (ops/buckets.py) uses its own TPU-lane-aligned chunking.
+        # bucket path sizes its (rows, 128) grid blocks through
+        # apex_tpu.tune (ops/pallas_mt._block_rows: the frozen BLOCK_ROWS
+        # under APEX_TPU_TUNE=off, cached/measured values under
+        # cache/auto); per-op ``block_rows=`` kwargs forwarded through
+        # this funnel always win over the tuner.
         self.chunk_size = chunk_size
 
     def __call__(self, op, noop_flag: Optional[jax.Array],

@@ -30,6 +30,10 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.ops._amp_guard import no_amp as _no_amp
+# The shared block-preference clamp lives in the tuner's heuristic module
+# (it is the seed/fallback policy every block-shaped kernel agrees on);
+# re-exported under the historical name for the sweep scripts/tests.
+from apex_tpu.tune.heuristics import pick_block as _pick_block
 
 NEG_INF = -1e30
 LOG2E = 1.4426950408889634   # log2(e): softmax runs in base-2 (exp2 is the
@@ -325,37 +329,31 @@ def _bias_spec(info, bq, bk, *, row_id, col_id):
     return pl.BlockSpec((1, bq if per_row else 1, bk), index)
 
 
-def _pick_block(pref: int, s: int) -> int:
-    """Largest block size <= ``pref`` whose block-rounded padding stays
-    within 15% of the minimal 128-aligned padding. Big blocks are faster
-    (the kernels are VPU-bound; fewer grid steps amortize per-step
-    overhead) but rounding a length just past a large-block multiple would
-    nearly double the computed/padded area — e.g. sk=1088 at block 1024
-    pads to 2048; this picks 256 (pads to 1280) instead."""
-    sp_min = ((s + 127) // 128) * 128
-    pref = min(pref, sp_min)
-    best = 128
-    for cand in (256, 512, 1024):
-        if cand <= pref and -(-s // cand) * cand <= sp_min * 1.15:
-            best = cand
-    return max(128, min(best, pref))
-
-
 @_no_amp
 def _flash_fwd(q, k, v, *, causal: bool, scale: float,
                dropout_rate: float = 0.0, dropout_seed=None,
-               bias=None, block_q: int = 1024, block_k: int = 1024):
-    # Default blocks re-measured r3 on v5e (s=4096, d=64, bf16) with
-    # PROFILER device time (wall-clock over the axon tunnel carries a
-    # ~120 ms fixed dispatch cost that poisoned the r2 sweep): (1024,
-    # 1024) runs 1.83 ms vs 2.14 for r2's (512, 1024); 2048-wide blocks
-    # fail VMEM. The kernel is VPU-bound on the softmax chain, so bigger
-    # blocks amortize per-step overhead. (For calibration: this kernel
-    # measures 2.7x faster than jax.experimental.pallas.ops.tpu
-    # flash_attention on the same shape/chip.)
+               bias=None, block_q: Optional[int] = None,
+               block_k: Optional[int] = None):
+    # Block preferences resolve through apex_tpu.tune (explicit values
+    # always win; None routes to the tuner). Under the default
+    # APEX_TPU_TUNE=off policy the resolution returns the frozen (1024,
+    # 1024) — re-measured r3 on v5e (s=4096, d=64, bf16) with PROFILER
+    # device time (wall-clock over the axon tunnel carries a ~120 ms
+    # fixed dispatch cost that poisoned the r2 sweep): (1024, 1024) runs
+    # 1.83 ms vs 2.14 for r2's (512, 1024); 2048-wide blocks fail VMEM.
+    # The kernel is VPU-bound on the softmax chain, so bigger blocks
+    # amortize per-step overhead. (For calibration: this kernel measures
+    # 2.7x faster than jax.experimental.pallas.ops.tpu flash_attention
+    # on the same shape/chip.)
     b, h, sq, d = q.shape
     sk = k.shape[2]
     dtype = q.dtype
+    if block_q is None or block_k is None:
+        from apex_tpu import tune
+        tq, tk = tune.attention_blocks("attention_fwd", sq=sq, sk=sk,
+                                       d=d, dtype=dtype)
+        block_q = tq if block_q is None else block_q
+        block_k = tk if block_k is None else block_k
     seed = jnp.asarray(
         0 if dropout_seed is None else dropout_seed,
         jnp.int32).reshape((1,))
@@ -678,10 +676,10 @@ def _flash_bwd_fused_kernel(scale, causal, rate, sq_actual, sk_actual, bq,
 _FUSED_BWD_DQ_SCRATCH_BYTES = 8 * 2 ** 20
 # Block tunings, overridable for sweeps: fused needs narrower query blocks
 # than r3's two-pass (1024, 1024) to leave VMEM room for the dq scratch.
+# (The two-pass preference itself now resolves through apex_tpu.tune —
+# heuristics.ATTENTION_BLOCK_Q/K carry the frozen (1024, 1024).)
 _FUSED_BLOCK_Q = 512
 _FUSED_BLOCK_K = 1024
-_BWD_BLOCK_Q = 1024
-_BWD_BLOCK_K = 1024
 
 
 def _fused_bwd_plan(sq: int, d: int) -> Tuple[bool, int]:
@@ -768,12 +766,16 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, scale: float,
     (_flash_bwd_fused_kernel); sequences whose full-seq dq scratch would
     blow VMEM (_fused_bwd_plan) fall back to the dKdV-then-dQ two-pass
     scheme at r3's (1024, 1024) tuning."""
-    if block_q is None:
-        block_q = _BWD_BLOCK_Q
-    if block_k is None:
-        block_k = _BWD_BLOCK_K
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    if block_q is None or block_k is None:
+        # tuner resolution (off policy: the frozen (1024, 1024) two-pass
+        # tuning); explicit caller values always win
+        from apex_tpu import tune
+        tq, tk = tune.attention_blocks("attention_bwd", sq=sq, sk=sk,
+                                       d=d, dtype=q.dtype)
+        block_q = tq if block_q is None else block_q
+        block_k = tk if block_k is None else block_k
     if (not _fused_bwd_plan(sq, d)[0] and dropout_rate == 0.0
             and bias is None and sq > _segment_rows(d)):
         # scratch-overflow shapes without dropout/bias: segmented fused

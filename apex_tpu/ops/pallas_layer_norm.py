@@ -15,7 +15,7 @@ apex_tpu/normalization/fused_layer_norm.py.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -64,10 +64,15 @@ def _ln_fwd_kernel(eps, x_ref, w_ref, b_ref, y_ref, mu_ref, rstd_ref):
 
 
 @_no_amp
-def ln_fwd(x2d: jax.Array, w: jax.Array, b: jax.Array, eps: float
+def ln_fwd(x2d: jax.Array, w: jax.Array, b: jax.Array, eps: float,
+           rows: Optional[int] = None,
            ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     n, d = x2d.shape
-    rows = _rows_per_block(d)
+    if rows is None:
+        # tuner resolution (off policy: exactly _rows_per_block(d));
+        # an explicit caller value always wins
+        from apex_tpu import tune
+        rows = tune.layer_norm_rows(d=d, dtype=x2d.dtype)
     padded = ((n + rows - 1) // rows) * rows
     if padded != n:
         x2d = jnp.pad(x2d, ((0, padded - n), (0, 0)))
@@ -121,9 +126,11 @@ def _ln_bwd_kernel(x_ref, w_ref, mu_ref, rstd_ref, dy_ref,
 
 
 @_no_amp
-def ln_bwd(x2d, w, mu, rstd, dy2d):
+def ln_bwd(x2d, w, mu, rstd, dy2d, rows: Optional[int] = None):
     n, d = x2d.shape
-    rows = _rows_per_block(d, arrays=2)
+    if rows is None:
+        from apex_tpu import tune
+        rows = tune.layer_norm_rows(d=d, dtype=x2d.dtype, bwd=True)
     padded = ((n + rows - 1) // rows) * rows
     if padded != n:
         x2d = jnp.pad(x2d, ((0, padded - n), (0, 0)))

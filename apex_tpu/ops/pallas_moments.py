@@ -18,7 +18,7 @@ surrounding backward elementwise chain.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -82,13 +82,19 @@ def _moments_kernel(nblocks, rows_actual, br, x_ref, s_ref, ss_ref,
 
 
 @_no_amp
-def _moments_2d(x2d: jax.Array) -> Tuple[jax.Array, jax.Array]:
+def _moments_2d(x2d: jax.Array, rows: Optional[int] = None,
+                ) -> Tuple[jax.Array, jax.Array]:
     n, c = x2d.shape
     if c % LANES != 0:  # narrow-C fold (see supported())
         fold = LANES // c
-        s, ss = _moments_2d(x2d.reshape(n // fold, c * fold))
+        s, ss = _moments_2d(x2d.reshape(n // fold, c * fold), rows)
         return (s.reshape(fold, c).sum(0), ss.reshape(fold, c).sum(0))
-    br = _rows_per_block(c)
+    if rows is None:
+        # tuner resolution (off policy: exactly _rows_per_block(c));
+        # an explicit caller value always wins
+        from apex_tpu import tune
+        rows = tune.moments_rows(c=c, dtype=x2d.dtype)
+    br = rows
     np_ = ((n + br - 1) // br) * br
     if np_ != n:
         x2d = jnp.pad(x2d, ((0, np_ - n), (0, 0)))

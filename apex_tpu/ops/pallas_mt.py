@@ -37,7 +37,12 @@ from apex_tpu.ops import buckets as _buckets
 Tree = Any
 
 LANES = 128
-BLOCK_ROWS = 512  # 512x128 fp32 = 256 KiB per operand block in VMEM
+# 512x128 fp32 = 256 KiB per operand block in VMEM. ONE definition: the
+# tuner's heuristic module owns the frozen default (a retune edits it
+# there, and the off-policy resolution can never silently diverge from
+# this in-file name); the per-call value resolves through apex_tpu.tune
+# — see _block_rows — with explicit caller values winning.
+from apex_tpu.tune.heuristics import MT_BLOCK_ROWS as BLOCK_ROWS
 
 
 def _interpret() -> bool:
@@ -47,11 +52,21 @@ def _interpret() -> bool:
     return jax.default_backend() not in _TPU_BACKENDS
 
 
-def _as_blocked(flat: jax.Array) -> Tuple[jax.Array, int]:
-    """Zero-pad a 1-D array to a multiple of BLOCK_ROWS*LANES and reshape to
+def _block_rows(n: int, dtype, block_rows: Optional[int]) -> int:
+    """Grid-block row count for an n-element bucket: the explicit caller
+    value when given, else the tuner's resolution (BLOCK_ROWS under the
+    default off policy)."""
+    if block_rows is not None:
+        return int(block_rows)
+    from apex_tpu import tune
+    return tune.mt_block_rows(n=n, dtype=dtype)
+
+
+def _as_blocked(flat: jax.Array, br: int) -> Tuple[jax.Array, int]:
+    """Zero-pad a 1-D array to a multiple of br*LANES and reshape to
     (rows, LANES). Returns (blocked, original_length)."""
     n = flat.shape[0]
-    chunk = BLOCK_ROWS * LANES
+    chunk = br * LANES
     padded = ((n + chunk - 1) // chunk) * chunk
     if padded != n:
         flat = jnp.pad(flat, (0, padded - n))
@@ -80,20 +95,23 @@ def _scale_kernel(scale_ref, x_ref, y_ref, of_ref):
 
 
 @_no_amp
-def scale_flat(x: jax.Array, scale: jax.Array) -> Tuple[jax.Array, jax.Array]:
+def scale_flat(x: jax.Array, scale: jax.Array, *,
+               block_rows: Optional[int] = None,
+               ) -> Tuple[jax.Array, jax.Array]:
     """Fused out = x*scale + nonfinite detect on one flat bucket."""
-    xb, n = _as_blocked(x)
+    br = _block_rows(x.shape[0], x.dtype, block_rows)
+    xb, n = _as_blocked(x, br)
     rows = xb.shape[0]
-    grid = rows // BLOCK_ROWS
+    grid = rows // br
     y, of = pl.pallas_call(
         _scale_kernel,
         grid=(grid,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((br, LANES), lambda i: (i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((br, LANES), lambda i: (i, 0)),
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
         ],
         out_shape=[
@@ -124,21 +142,24 @@ def _axpby_kernel(ab_ref, x_ref, y_ref, out_ref, of_ref):
 
 
 @_no_amp
-def axpby_flat(a, x: jax.Array, b, y: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    xb, n = _as_blocked(x)
-    yb, _ = _as_blocked(y)
-    grid = xb.shape[0] // BLOCK_ROWS
+def axpby_flat(a, x: jax.Array, b, y: jax.Array, *,
+               block_rows: Optional[int] = None,
+               ) -> Tuple[jax.Array, jax.Array]:
+    br = _block_rows(x.shape[0], x.dtype, block_rows)
+    xb, n = _as_blocked(x, br)
+    yb, _ = _as_blocked(y, br)
+    grid = xb.shape[0] // br
     ab = jnp.stack([jnp.asarray(a, jnp.float32), jnp.asarray(b, jnp.float32)])
     out, of = pl.pallas_call(
         _axpby_kernel,
         grid=(grid,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
-            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((br, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((br, LANES), lambda i: (i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((br, LANES), lambda i: (i, 0)),
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
         ],
         out_shape=[
@@ -166,14 +187,16 @@ def _l2norm_kernel(x_ref, acc_ref):
 
 
 @_no_amp
-def l2norm_sq_flat(x: jax.Array) -> jax.Array:
+def l2norm_sq_flat(x: jax.Array, *,
+                   block_rows: Optional[int] = None) -> jax.Array:
     """Sum of squares of one flat bucket (fp32 scalar)."""
-    xb, _ = _as_blocked(x)
-    grid = xb.shape[0] // BLOCK_ROWS
+    br = _block_rows(x.shape[0], x.dtype, block_rows)
+    xb, _ = _as_blocked(x, br)
+    grid = xb.shape[0] // br
     acc = pl.pallas_call(
         _l2norm_kernel,
         grid=(grid,),
-        in_specs=[pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0))],
+        in_specs=[pl.BlockSpec((br, LANES), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((1, 1), lambda i: (0, 0),
                                memory_space=pltpu.SMEM),
         out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
@@ -209,12 +232,14 @@ def _adam_kernel(adam_w_mode, c_ref, g_ref, p_ref, m_ref, v_ref,
 @_no_amp
 def adam_flat(g: jax.Array, p: jax.Array, m: jax.Array, v: jax.Array, *,
               lr, beta1, beta2, eps, bc1, bc2, adam_w_mode, weight_decay,
-              inv_scale=None) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    gb, n = _as_blocked(g)
-    pb, _ = _as_blocked(p)
-    mb, _ = _as_blocked(m)
-    vb, _ = _as_blocked(v)
-    grid = gb.shape[0] // BLOCK_ROWS
+              inv_scale=None, block_rows: Optional[int] = None,
+              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    br = _block_rows(g.shape[0], g.dtype, block_rows)
+    gb, n = _as_blocked(g, br)
+    pb, _ = _as_blocked(p, br)
+    mb, _ = _as_blocked(m, br)
+    vb, _ = _as_blocked(v, br)
+    grid = gb.shape[0] // br
     c = jnp.stack([
         jnp.asarray(lr, jnp.float32), jnp.asarray(beta1, jnp.float32),
         jnp.asarray(beta2, jnp.float32), jnp.asarray(eps, jnp.float32),
@@ -222,7 +247,7 @@ def adam_flat(g: jax.Array, p: jax.Array, m: jax.Array, v: jax.Array, *,
         jnp.asarray(weight_decay, jnp.float32),
         jnp.asarray(1.0 if inv_scale is None else inv_scale, jnp.float32),
     ])
-    blk = lambda: pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0))
+    blk = lambda: pl.BlockSpec((br, LANES), lambda i: (i, 0))
     p2, m2, v2 = pl.pallas_call(
         functools.partial(_adam_kernel, bool(adam_w_mode)),
         grid=(grid,),
@@ -276,10 +301,10 @@ def _seg_bounds(spec) -> Tuple[jax.Array, jax.Array, int]:
     return jnp.asarray(starts), jnp.asarray(ends), t_pad
 
 
-def _row_onehot(i, starts, ends):
-    """(BLOCK_ROWS, T_pad) {0,1} map of block-local rows to tensors."""
-    r = i * BLOCK_ROWS + jax.lax.broadcasted_iota(
-        jnp.int32, (BLOCK_ROWS, 1), 0)
+def _row_onehot(i, br, starts, ends):
+    """(br, T_pad) {0,1} map of block-local rows to tensors (``br`` = the
+    grid block's row count, read off the kernel's block shape)."""
+    r = i * br + jax.lax.broadcasted_iota(jnp.int32, (br, 1), 0)
     return jnp.logical_and(r >= starts, r < ends).astype(jnp.float32)
 
 
@@ -291,22 +316,24 @@ def _l2norm_seg_kernel(x_ref, starts_ref, ends_ref, acc_ref):
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     x = x_ref[:].astype(jnp.float32)
-    rowsq = jnp.sum(x * x, axis=1, keepdims=True)          # (BLOCK_ROWS, 1)
-    onehot = _row_onehot(i, starts_ref[:], ends_ref[:])
+    rowsq = jnp.sum(x * x, axis=1, keepdims=True)          # (rows, 1)
+    onehot = _row_onehot(i, x.shape[0], starts_ref[:], ends_ref[:])
     acc_ref[:] += jnp.sum(rowsq * onehot, axis=0, keepdims=True)
 
 
 @_no_amp
-def l2norm_sq_seg_flat(x: jax.Array, spec) -> jax.Array:
+def l2norm_sq_seg_flat(x: jax.Array, spec, *,
+                       block_rows: Optional[int] = None) -> jax.Array:
     """Per-tensor sums of squares of one LANES-aligned bucket -> (T,) fp32."""
     starts, ends, t_pad = _seg_bounds(spec)
-    xb, _ = _as_blocked(x)
-    grid = xb.shape[0] // BLOCK_ROWS
+    br = _block_rows(x.shape[0], x.dtype, block_rows)
+    xb, _ = _as_blocked(x, br)
+    grid = xb.shape[0] // br
     acc = pl.pallas_call(
         _l2norm_seg_kernel,
         grid=(grid,),
         in_specs=[
-            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((br, LANES), lambda i: (i, 0)),
             pl.BlockSpec((1, t_pad), lambda i: (0, 0)),
             pl.BlockSpec((1, t_pad), lambda i: (0, 0)),
         ],
@@ -350,7 +377,7 @@ def _sgd_kernel(use_momentum, nesterov, wd_after_momentum, n_out,
 @_no_amp
 def sgd_flat(g: jax.Array, p: jax.Array, m: jax.Array, *, lr, weight_decay,
              momentum, dampening, nesterov, wd_after_momentum, first,
-             scale=1.0, model_dtype=None):
+             scale=1.0, model_dtype=None, block_rows: Optional[int] = None):
     """Fused SGD on one flat bucket (csrc/multi_tensor_sgd_kernel.cu:320).
 
     ``model_dtype`` adds a fused low-precision model-param copy output — the
@@ -358,10 +385,11 @@ def sgd_flat(g: jax.Array, p: jax.Array, m: jax.Array, *, lr, weight_decay,
     ``materialize_master_grads=False`` (multi_tensor_sgd_kernel.cu N=4 case).
     Returns ``(new_p, new_m[, new_model])``.
     """
-    gb, n = _as_blocked(g)
-    pb, _ = _as_blocked(p)
-    mb, _ = _as_blocked(m)
-    grid = gb.shape[0] // BLOCK_ROWS
+    br = _block_rows(g.shape[0], g.dtype, block_rows)
+    gb, n = _as_blocked(g, br)
+    pb, _ = _as_blocked(p, br)
+    mb, _ = _as_blocked(m, br)
+    grid = gb.shape[0] // br
     c = jnp.stack([
         jnp.asarray(lr, jnp.float32), jnp.asarray(weight_decay, jnp.float32),
         jnp.asarray(momentum, jnp.float32),
@@ -369,7 +397,7 @@ def sgd_flat(g: jax.Array, p: jax.Array, m: jax.Array, *, lr, weight_decay,
         jnp.asarray(scale, jnp.float32),
         jnp.asarray(first, jnp.float32),
     ])
-    blk = lambda: pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0))
+    blk = lambda: pl.BlockSpec((br, LANES), lambda i: (i, 0))
     n_out = 3 if model_dtype is not None else 2
     out_specs = [blk() for _ in range(n_out)]
     out_shape = [jax.ShapeDtypeStruct(pb.shape, p.dtype),
@@ -415,18 +443,20 @@ def _adagrad_kernel(adagrad_w_mode, c_ref, g_ref, p_ref, h_ref, p_out, h_out):
 
 @_no_amp
 def adagrad_flat(g: jax.Array, p: jax.Array, h: jax.Array, *, lr, eps,
-                 weight_decay, adagrad_w_mode=False, scale=1.0):
+                 weight_decay, adagrad_w_mode=False, scale=1.0,
+                 block_rows: Optional[int] = None):
     """Fused Adagrad on one flat bucket (csrc/multi_tensor_adagrad.cu)."""
-    gb, n = _as_blocked(g)
-    pb, _ = _as_blocked(p)
-    hb, _ = _as_blocked(h)
-    grid = gb.shape[0] // BLOCK_ROWS
+    br = _block_rows(g.shape[0], g.dtype, block_rows)
+    gb, n = _as_blocked(g, br)
+    pb, _ = _as_blocked(p, br)
+    hb, _ = _as_blocked(h, br)
+    grid = gb.shape[0] // br
     c = jnp.stack([
         jnp.asarray(lr, jnp.float32), jnp.asarray(eps, jnp.float32),
         jnp.asarray(weight_decay, jnp.float32),
         jnp.asarray(scale, jnp.float32),
     ])
-    blk = lambda: pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0))
+    blk = lambda: pl.BlockSpec((br, LANES), lambda i: (i, 0))
     p2, h2 = pl.pallas_call(
         functools.partial(_adagrad_kernel, bool(adagrad_w_mode)),
         grid=(grid,),
@@ -471,7 +501,7 @@ def _lamb_stage1_kernel(adam_w_mode, c_ref, g_ref, p_ref, m_ref, v_ref,
     m_out[:] = m.astype(m_out.dtype)
     v_out[:] = v.astype(v_out.dtype)
     u_out[:] = u.astype(u_out.dtype)
-    onehot = _row_onehot(i, starts_ref[:], ends_ref[:])
+    onehot = _row_onehot(i, g.shape[0], starts_ref[:], ends_ref[:])
     pn_acc[:] += jnp.sum(jnp.sum(p * p, axis=1, keepdims=True) * onehot,
                          axis=0, keepdims=True)
     un_acc[:] += jnp.sum(jnp.sum(u * u, axis=1, keepdims=True) * onehot,
@@ -481,7 +511,7 @@ def _lamb_stage1_kernel(adam_w_mode, c_ref, g_ref, p_ref, m_ref, v_ref,
 def _lamb_stage2_kernel(c_ref, p_ref, u_ref, ratios_ref, starts_ref, ends_ref,
                         p_out):
     i = pl.program_id(0)
-    onehot = _row_onehot(i, starts_ref[:], ends_ref[:])
+    onehot = _row_onehot(i, p_ref.shape[0], starts_ref[:], ends_ref[:])
     ratio_row = jnp.sum(onehot * ratios_ref[:], axis=1, keepdims=True)
     p = p_ref[:].astype(jnp.float32)
     u = u_ref[:].astype(jnp.float32)
@@ -492,17 +522,19 @@ def _lamb_stage2_kernel(c_ref, p_ref, u_ref, ratios_ref, starts_ref, ends_ref,
 def lamb_flat(g: jax.Array, p: jax.Array, m: jax.Array, v: jax.Array, spec, *,
               lr, beta1, beta2, beta3, eps, bc1, bc2, adam_w_mode,
               weight_decay, inv_clip, use_ratio,
+              block_rows: Optional[int] = None,
               ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Fused LAMB on one LANES-aligned bucket. Stage 1 computes Adam moments,
     the raw update, and one-pass segmented p/update norms; scalar cleanup forms
     per-tensor trust ratios; stage 2 applies ``p -= lr * ratio * u``."""
     starts, ends, t_pad = _seg_bounds(spec)
     t = len(spec.sizes)
-    gb, n = _as_blocked(g)
-    pb, _ = _as_blocked(p)
-    mb, _ = _as_blocked(m)
-    vb, _ = _as_blocked(v)
-    grid = gb.shape[0] // BLOCK_ROWS
+    br = _block_rows(g.shape[0], g.dtype, block_rows)
+    gb, n = _as_blocked(g, br)
+    pb, _ = _as_blocked(p, br)
+    mb, _ = _as_blocked(m, br)
+    vb, _ = _as_blocked(v, br)
+    grid = gb.shape[0] // br
     c1 = jnp.stack([
         jnp.asarray(beta1, jnp.float32), jnp.asarray(beta3, jnp.float32),
         jnp.asarray(beta2, jnp.float32), jnp.asarray(eps, jnp.float32),
@@ -510,7 +542,7 @@ def lamb_flat(g: jax.Array, p: jax.Array, m: jax.Array, v: jax.Array, spec, *,
         jnp.asarray(weight_decay, jnp.float32),
         jnp.asarray(inv_clip, jnp.float32),
     ])
-    blk = lambda: pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0))
+    blk = lambda: pl.BlockSpec((br, LANES), lambda i: (i, 0))
     seg = lambda: pl.BlockSpec((1, t_pad), lambda i: (0, 0))
     m2, v2, u, pn_sq, un_sq = pl.pallas_call(
         functools.partial(_lamb_stage1_kernel, bool(adam_w_mode)),
@@ -565,7 +597,7 @@ def _novograd_kernel(c_ref, g_ref, p_ref, m_ref, denom_ref, starts_ref,
     # c = [lr, beta1, beta3, bc1, weight_decay, scale]
     lr, b1, beta3 = c_ref[0], c_ref[1], c_ref[2]
     bc1, wd, scale = c_ref[3], c_ref[4], c_ref[5]
-    onehot = _row_onehot(i, starts_ref[:], ends_ref[:])
+    onehot = _row_onehot(i, g_ref.shape[0], starts_ref[:], ends_ref[:])
     denom_row = jnp.sum(onehot * denom_ref[:], axis=1, keepdims=True)
     denom_row = jnp.where(denom_row > 0.0, denom_row, 1.0)  # padding rows
     g = g_ref[:].astype(jnp.float32) * scale
@@ -579,15 +611,17 @@ def _novograd_kernel(c_ref, g_ref, p_ref, m_ref, denom_ref, starts_ref,
 @_no_amp
 def novograd_flat(g: jax.Array, p: jax.Array, m: jax.Array, denoms: jax.Array,
                   spec, *, lr, beta1, beta3, bc1, weight_decay, scale=1.0,
+                  block_rows: Optional[int] = None,
                   ) -> Tuple[jax.Array, jax.Array]:
     """Fused NovoGrad update on one LANES-aligned bucket given per-tensor
     denominators ``denoms`` (T,). Returns ``(new_p, new_m)``."""
     starts, ends, t_pad = _seg_bounds(spec)
     t = len(spec.sizes)
-    gb, n = _as_blocked(g)
-    pb, _ = _as_blocked(p)
-    mb, _ = _as_blocked(m)
-    grid = gb.shape[0] // BLOCK_ROWS
+    br = _block_rows(g.shape[0], g.dtype, block_rows)
+    gb, n = _as_blocked(g, br)
+    pb, _ = _as_blocked(p, br)
+    mb, _ = _as_blocked(m, br)
+    grid = gb.shape[0] // br
     c = jnp.stack([
         jnp.asarray(lr, jnp.float32), jnp.asarray(beta1, jnp.float32),
         jnp.asarray(beta3, jnp.float32), jnp.asarray(bc1, jnp.float32),
@@ -595,7 +629,7 @@ def novograd_flat(g: jax.Array, p: jax.Array, m: jax.Array, denoms: jax.Array,
         jnp.asarray(scale, jnp.float32),
     ])
     denoms_pad = jnp.zeros((1, t_pad), jnp.float32).at[0, :t].set(denoms)
-    blk = lambda: pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0))
+    blk = lambda: pl.BlockSpec((br, LANES), lambda i: (i, 0))
     seg = lambda: pl.BlockSpec((1, t_pad), lambda i: (0, 0))
     p2, m2 = pl.pallas_call(
         _novograd_kernel,

@@ -49,7 +49,7 @@ def allreduce_gradients(
     grads: Tree,
     axis_name: str = "data",
     *,
-    message_size: int = 2 ** 23,
+    message_size: Optional[int] = None,
     allreduce_always_fp32: bool = False,
     gradient_average: bool = True,
     gradient_predivide_factor: float = 1.0,
@@ -66,8 +66,14 @@ def allreduce_gradients(
     and XLA can overlap the collective with the rest of backward. A single
     leaf larger than ``message_size`` still gets a chunked psum (slices of
     one leaf keep the same dependency footprint) for DCN message sizing.
+    ``message_size=None`` (default) resolves through ``apex_tpu.tune``
+    (the frozen 2**23 under the default ``APEX_TPU_TUNE=off`` policy;
+    a cached/measured granularity under ``cache``/``auto``);
     ``message_size=0`` disables bucketing (one whole-tree bucket per
-    dtype — the pre-r3 barrier form, kept for A/B comparison).
+    dtype — the pre-r3 barrier form, kept for A/B comparison); negative
+    values raise. A config that shatters the step into more than 256
+    buckets warns once via ``tune/warn/*`` telemetry — per-collective
+    latency serializes such a schedule.
 
     ``telemetry_step``: optional step index (host int or traced scalar)
     attached to the per-bucket ``health/`` events so replicated per-shard
@@ -77,7 +83,17 @@ def allreduce_gradients(
     if not leaves:
         return grads
     world = bound_axis_size(axis_name)
+    from apex_tpu import tune
+    if message_size is None:
+        total = sum(int(l.size) for l in leaves)
+        message_size = tune.ddp_message_size(total=total, world=world)
+    elif message_size < 0:
+        raise ValueError(
+            f"allreduce_gradients: message_size must be >= 1 (or 0 to "
+            f"disable bucketing, or None to resolve via apex_tpu.tune); "
+            f"got {message_size}")
     buckets = _buckets.assign_buckets(leaves, message_size)
+    tune.warn_bucket_count("ddp", len(buckets), message_size)
 
     from apex_tpu import telemetry
     if telemetry.enabled():
@@ -170,11 +186,12 @@ class DistributedDataParallel:
     ``ddp.sync(grads)`` explicitly after accumulation instead of wrapping.
     """
 
-    # Default bucket capacity mirrors the reference's message_size=1e7
-    # elements (distributed.py:177): big enough that ICI bandwidth is
-    # saturated, small enough that several buckets exist to overlap.
+    # Default bucket capacity (None) resolves through apex_tpu.tune: the
+    # frozen 2**23 under APEX_TPU_TUNE=off — mirroring the reference's
+    # message_size=1e7 elements (distributed.py:177): big enough that ICI
+    # bandwidth is saturated, small enough that several buckets overlap.
     def __init__(self, axis_name: str = "data", *,
-                 message_size: int = 2 ** 23,
+                 message_size: Optional[int] = None,
                  allreduce_always_fp32: bool = False,
                  gradient_average: bool = True,
                  gradient_predivide_factor: float = 1.0,

@@ -1,0 +1,187 @@
+"""apex_tpu.tune — empirical autotuner + persistent config cache for the
+toolkit's block shapes and collective bucketing.
+
+Every hot path used to run off a constant frozen from one sweep on one
+chip: flash-attention ``block_q/block_k``, the Pallas layer-norm /
+moments / multi-tensor tile shapes, and the DDP/ZeRO bucket granularity
+``message_size=2**23`` — the knob class the reference Apex exposes but
+never tunes, and the class AMP-style config search (arXiv:2210.07297)
+shows is worth searching per hardware generation. This package searches
+those knobs ONCE on the live backend and remembers the answer:
+
+  * :mod:`heuristics` — the frozen defaults (seed AND fallback policy),
+    including :func:`heuristics.pick_block`, factored out of
+    ``ops/attention``.
+  * :mod:`measure`    — warmup + median-of-k timing of candidate configs
+    on the live backend; CPU/interpret deterministically declines so CI
+    is hermetic.
+  * :mod:`cache`      — persistent JSON cache keyed by (device_kind, op,
+    shape-bucket, dtype) under ``~/.cache/apex_tpu/tune/``
+    (``APEX_TPU_TUNE_CACHE_DIR`` overrides), atomic-rename writes,
+    corrupted files degrade to heuristics.
+  * :mod:`tuner`      — ``resolve(op, key)`` with the ``APEX_TPU_TUNE``
+    policy (``off`` — today's heuristics, the default; ``cache`` —
+    read-only; ``auto`` — measure-and-fill) and in-process memoization
+    so jit retracing never re-measures. Resolutions emit ``tune/*``
+    telemetry events.
+  * :mod:`sweeps`     — per-op candidate spaces and measurement runners.
+  * :mod:`cli`        — ``python -m apex_tpu.tune sweep|show|clear`` for
+    offline pre-tuning and cache inspection.
+
+Call-site contract: kernels take their config as ``None``-defaulted
+keywords; ``None`` routes through the helpers below, an explicit value
+ALWAYS wins. With the default ``off`` policy the helpers return exactly
+the pre-tune constants — compiled programs are bit-identical to a build
+without this package (pinned by tests/test_tune.py's jaxpr-equality
+test).
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Tuple
+
+from apex_tpu.tune import cache, heuristics, measure, sweeps, tuner
+from apex_tpu.tune.cache import cache_dir, cache_path, device_kind
+from apex_tpu.tune.heuristics import pick_block, shape_bucket
+from apex_tpu.tune.tuner import (policy, reset, resolve, set_policy)
+
+
+def _dtype_name(dtype: Any) -> str:
+    import jax.numpy as jnp
+    if isinstance(dtype, str):
+        return dtype
+    return jnp.dtype(dtype).name
+
+
+def _rows_valid(rows: Any, default: int, dtype: Any) -> int:
+    """Sanitize a row-block count from the cache: a positive multiple of
+    the dtype's Mosaic sublane tile (8 fp32 / 16 bf16,f16 / 32 int8,fp8)
+    within [tile, 4096]. Anything else — hand-edited, schema drift, a
+    value measured under another build — degrades to the heuristic
+    ``default`` (which passes through UNVALIDATED: under ``off`` the
+    heuristic must survive bit-exact) rather than tracing a suspect
+    block."""
+    import jax.numpy as jnp
+    sub = max(8, 32 // max(1, jnp.dtype(dtype).itemsize))
+    try:
+        r = int(rows)
+    except (TypeError, ValueError):
+        return default
+    if r == default:           # identity on the heuristic value itself
+        return r
+    return r if (sub <= r <= 4096 and r % sub == 0) else default
+
+
+# ---------------------------------------------------------------------------
+# Call-site helpers: one per knob family. Each builds the canonical cache
+# key (shape-bucketed), resolves under the active policy, and sanitizes
+# the result so a bad cache entry can never trace an invalid program.
+# ---------------------------------------------------------------------------
+
+def attention_blocks(op: str, *, sq: int, sk: int, d: int,
+                     dtype: Any) -> Tuple[int, int]:
+    """(block_q, block_k) preference for ``attention_fwd`` /
+    ``attention_bwd`` at this shape. The kernel still clamps through
+    :func:`heuristics.pick_block` and its VMEM caps."""
+    cfg, _ = resolve(op, {"sq": shape_bucket(sq), "sk": shape_bucket(sk),
+                          "d": int(d), "dtype": _dtype_name(dtype)})
+    default = (heuristics.attention_bwd if op == "attention_bwd"
+               else heuristics.attention_fwd)({})
+    try:
+        return (max(128, int(cfg["block_q"])), max(128, int(cfg["block_k"])))
+    except (KeyError, TypeError, ValueError):
+        return default["block_q"], default["block_k"]
+
+
+def layer_norm_rows(*, d: int, dtype: Any, bwd: bool = False) -> int:
+    """Row-block height for the Pallas LayerNorm kernels."""
+    op = "layer_norm_bwd" if bwd else "layer_norm_fwd"
+    key = {"d": int(d), "dtype": _dtype_name(dtype)}
+    cfg, _ = resolve(op, key)
+    heur = (heuristics.layer_norm_bwd(key) if bwd
+            else heuristics.layer_norm_fwd(key))
+    return _rows_valid(cfg.get("rows"), heur["rows"], dtype)
+
+
+def moments_rows(*, c: int, dtype: Any) -> int:
+    """Row-block height for the fused sum/sumsq moments kernel."""
+    key = {"c": int(c), "dtype": _dtype_name(dtype)}
+    cfg, _ = resolve("moments", key)
+    return _rows_valid(cfg.get("rows"), heuristics.moments(key)["rows"],
+                       dtype)
+
+
+def mt_block_rows(*, n: int, dtype: Any) -> int:
+    """Rows per (rows, 128) grid block for the multi-tensor bucket
+    kernels."""
+    cfg, _ = resolve("mt_block", {"n": shape_bucket(n),
+                                  "dtype": _dtype_name(dtype)})
+    return _rows_valid(cfg.get("block_rows"), heuristics.MT_BLOCK_ROWS,
+                       dtype)
+
+
+def ddp_message_size(*, total: int, world: int) -> int:
+    """Bucket capacity (elements) for the DDP gradient allreduce."""
+    cfg, _ = resolve("ddp_message_size",
+                     {"total": shape_bucket(total), "world": int(world)})
+    try:
+        v = int(cfg["message_size"])
+    except (KeyError, TypeError, ValueError):
+        return heuristics.DDP_MESSAGE_SIZE
+    # < 1 would silently flip the run to the no-bucketing barrier form —
+    # a hand-edited/corrupt entry degrades to the heuristic instead
+    # (0 is reachable only as an EXPLICIT caller value, never via cache)
+    return v if v >= 1 else heuristics.DDP_MESSAGE_SIZE
+
+
+def zero_chunk_elements(*, total: int, world: int) -> int:
+    """Bucket capacity (elements) for the ZeRO scatter/gather layout.
+
+    NOTE: this participates in the ZeroState FLAT LAYOUT — resolutions
+    that change across runs change where a checkpointed master/moment
+    element lives. ``_ZeroBase.layout_fingerprint`` records the resolved
+    value, and ``check_layout`` fails loudly on restore mismatch."""
+    cfg, _ = resolve("zero_chunk_elements",
+                     {"total": shape_bucket(total), "world": int(world)})
+    try:
+        v = int(cfg["chunk_elements"])
+    except (KeyError, TypeError, ValueError):
+        return heuristics.ZERO_CHUNK_ELEMENTS
+    # see ddp_message_size: a cache entry can never disable bucketing
+    # (and thereby silently change the checkpointed flat layout)
+    return v if v >= 1 else heuristics.ZERO_CHUNK_ELEMENTS
+
+
+# ---------------------------------------------------------------------------
+# Degenerate-bucketing guard, shared by DDP and ZeRO.
+# ---------------------------------------------------------------------------
+
+_warned_bucket_counts: set = set()
+
+
+def warn_bucket_count(producer: str, count: int, capacity: int, *,
+                      threshold: int = heuristics.
+                      BUCKET_COUNT_WARN_THRESHOLD) -> None:
+    """Warn (once per (producer, capacity) per process) when a bucket
+    capacity shatters a step into more than ``threshold`` collectives —
+    a degenerate tiny-bucket config serializes the schedule on
+    per-collective latency. Emits a ``tune/warn/*`` telemetry event
+    (dedup'd) and a Python warning."""
+    if count <= threshold:
+        return
+    from apex_tpu import telemetry
+    telemetry.record_static(
+        f"tune/warn/{producer}_buckets", float(count),
+        meta={"producer": producer, "capacity": int(capacity),
+              "count": int(count), "threshold": int(threshold)},
+        dedup_key=(producer, int(capacity), int(count)))
+    wkey = (producer, int(capacity))
+    if wkey not in _warned_bucket_counts:
+        _warned_bucket_counts.add(wkey)
+        warnings.warn(
+            f"apex_tpu.tune: {producer} splits gradients into {count} "
+            f"collective buckets per step (capacity={capacity} elements, "
+            f"threshold {threshold}) — per-collective launch latency will "
+            "serialize the schedule; raise the bucket capacity "
+            "(message_size / chunk_elements)")

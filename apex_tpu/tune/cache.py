@@ -1,0 +1,170 @@
+"""Persistent tuning-config cache: one JSON file per device kind under
+``~/.cache/apex_tpu/tune/`` (override with ``APEX_TPU_TUNE_CACHE_DIR``).
+
+Design constraints, in order:
+
+  1. **Never crash a train step.** Every failure mode — missing dir,
+     corrupted file, schema drift, unwritable filesystem — degrades to
+     "no cache" (the caller falls back to heuristics) with at most one
+     warning per path per process.
+  2. **Atomic writes.** Entries are merged into a freshly re-read copy of
+     the file and published with ``os.replace`` (atomic on POSIX), so a
+     reader never sees a torn file and concurrent writers lose at most
+     each other's *newest* entries, never the file's validity.
+  3. **Self-describing.** The file carries a schema version and the
+     device kind it was measured on; keys are human-readable
+     ``"op|k=v,k=v"`` strings so ``python -m apex_tpu.tune show`` (and a
+     plain ``jq``) can inspect it.
+
+File schema (version 1)::
+
+    {"version": 1, "device_kind": "tpu-v5e",
+     "entries": {"attention_fwd|d=64,dtype=bfloat16,sk=4096,sq=4096":
+                   {"config": {"block_q": 1024, "block_k": 1024},
+                    "provenance": "measured",
+                    "measured_s": 0.00183, "default_s": 0.00214,
+                    "ts": 1723480000.0}}}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import warnings
+from typing import Any, Dict, Optional
+
+SCHEMA_VERSION = 1
+
+_ENV_DIR = "APEX_TPU_TUNE_CACHE_DIR"
+
+
+def cache_dir() -> str:
+    env = os.environ.get(_ENV_DIR)
+    if env:
+        return env
+    xdg = os.environ.get("XDG_CACHE_HOME")
+    base = xdg if xdg else os.path.join(os.path.expanduser("~"), ".cache")
+    return os.path.join(base, "apex_tpu", "tune")
+
+
+def device_kind() -> str:
+    """Sanitized device kind of the default backend — the outermost cache
+    key (a v5e measurement must never configure a v4 run, and a CPU
+    fallback entry must never configure either)."""
+    try:
+        import jax
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        kind = "unknown"
+    out = "".join(c if c.isalnum() or c in "-_." else "-"
+                  for c in str(kind).strip().lower())
+    return out or "unknown"
+
+
+def cache_path(kind: Optional[str] = None) -> str:
+    return os.path.join(cache_dir(), f"{kind or device_kind()}.json")
+
+
+class TuneCache:
+    """Entry store for one cache file. Thread-safe; see module docstring
+    for the corruption/concurrency contract."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._warned = False
+
+    # -- read ---------------------------------------------------------------
+    def _read_file(self) -> Dict[str, Any]:
+        """Parse the file into an entries dict; any problem returns {}
+        (with one warning per path) — recovery, not propagation."""
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+            entries = data.get("entries")
+            if data.get("version") != SCHEMA_VERSION \
+                    or not isinstance(entries, dict):
+                raise ValueError(
+                    f"unsupported schema (version={data.get('version')!r})")
+            return entries
+        except FileNotFoundError:
+            return {}
+        except Exception as e:  # corrupted / unreadable / wrong schema
+            if not self._warned:
+                self._warned = True
+                warnings.warn(
+                    f"apex_tpu.tune: ignoring unreadable cache file "
+                    f"{self.path} ({e}); falling back to heuristics — "
+                    "delete it or run `python -m apex_tpu.tune clear`")
+            return {}
+
+    def entries(self) -> Dict[str, Any]:
+        with self._lock:
+            return self._read_file()
+
+    def get(self, key: str) -> Optional[Dict[str, Any]]:
+        entry = self.entries().get(key)
+        if not isinstance(entry, dict) \
+                or not isinstance(entry.get("config"), dict):
+            return None
+        return entry
+
+    # -- write --------------------------------------------------------------
+    def put(self, key: str, entry: Dict[str, Any]) -> bool:
+        """Merge one entry into the file atomically. Returns False (after
+        at most one warning) when the filesystem refuses — a read-only
+        HOME must not take down training."""
+        entry = dict(entry)
+        entry.setdefault("ts", time.time())
+        with self._lock:
+            entries = self._read_file()  # merge-on-write: keep others' keys
+            entries[key] = entry
+            return self._write(entries)
+
+    def _write(self, entries: Dict[str, Any]) -> bool:
+        data = {"version": SCHEMA_VERSION,
+                "device_kind": os.path.splitext(
+                    os.path.basename(self.path))[0],
+                "entries": entries}
+        tmp = f"{self.path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        try:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(data, f, indent=1, sort_keys=True)
+            os.replace(tmp, self.path)  # atomic publish
+            return True
+        except OSError as e:
+            if not self._warned:
+                self._warned = True
+                warnings.warn(
+                    f"apex_tpu.tune: cannot write cache file {self.path} "
+                    f"({e}); tuned configs will not persist")
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+
+    def clear(self) -> None:
+        with self._lock:
+            try:
+                os.unlink(self.path)
+            except FileNotFoundError:
+                pass
+
+
+# One TuneCache per path (so in-process writers share a lock and the
+# merge-on-write actually serializes).
+_caches: Dict[str, TuneCache] = {}
+_caches_lock = threading.Lock()
+
+
+def get_cache(path: Optional[str] = None) -> TuneCache:
+    path = path or cache_path()
+    with _caches_lock:
+        cache = _caches.get(path)
+        if cache is None:
+            cache = _caches[path] = TuneCache(path)
+        return cache

@@ -1,0 +1,188 @@
+"""``python -m apex_tpu.tune`` — offline pre-tuning and cache management.
+
+Commands:
+
+  * ``sweep [--ops a,b] [--dry-run] [--repeats K] [--warmup W]`` —
+    measure each registered op's candidate space at its canonical sweep
+    shapes on THIS backend, fill the persistent cache, and print a
+    before/after table (frozen default vs tuned config, device-time
+    medians, speedup). On CPU/interpret backends the sweep completes
+    deterministically and reports ``heuristic`` provenance — nothing is
+    timed, the heuristic configs are recorded. ``--dry-run`` prints the
+    plan (ops, keys, candidate counts) without measuring or writing.
+  * ``show`` — print the cache entries for this backend's device kind.
+  * ``clear [--all]`` — delete this device kind's cache file (``--all``:
+    every file in the cache dir).
+
+``--cache-dir`` overrides the cache location for any command (same as
+``APEX_TPU_TUNE_CACHE_DIR``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from apex_tpu.tune import cache as _cache
+from apex_tpu.tune import measure as _measure
+from apex_tpu.tune import sweeps as _sweeps
+from apex_tpu.tune import tuner as _tuner
+
+
+def _fmt_cfg(cfg: dict) -> str:
+    return ",".join(f"{k}={v}" for k, v in sorted(cfg.items()))
+
+
+def _fmt_s(t: Optional[float]) -> str:
+    if t is None:
+        return "-"
+    return f"{t * 1e3:.3f}ms" if t < 1.0 else f"{t:.3f}s"
+
+
+def _table(rows: List[List[str]], header: List[str]) -> str:
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    lines = [fmt.format(*header), fmt.format(*["-" * w for w in widths])]
+    lines += [fmt.format(*[str(c) for c in r]) for r in rows]
+    return "\n".join(lines)
+
+
+def _selected_ops(args) -> List[str]:
+    reg = _sweeps.registry()
+    if not args.ops:
+        return sorted(reg)
+    ops = [o.strip() for o in args.ops.split(",") if o.strip()]
+    unknown = [o for o in ops if o not in reg]
+    if unknown:
+        raise SystemExit(f"unknown ops {unknown}; known: {sorted(reg)}")
+    return ops
+
+
+def cmd_sweep(args) -> int:
+    reg = _sweeps.registry()
+    ops = _selected_ops(args)
+    backend_ok = _measure.measurable()
+    print(f"tune sweep: device_kind={_cache.device_kind()} "
+          f"measurable={backend_ok} cache={_cache.cache_path()}",
+          file=sys.stderr)
+
+    if args.dry_run:
+        rows = []
+        for op in ops:
+            spec = reg[op]
+            for key in spec.sweep_keys():
+                rows.append([op, _tuner.key_str(key),
+                             len(spec.candidates(key)),
+                             "yes" if (backend_ok and spec.runner)
+                             else "no (heuristic)"])
+        print(_table(rows, ["op", "key", "candidates", "will measure"]))
+        print(f"dry run: {len(rows)} sweep cells, nothing measured or "
+              "written")
+        return 0
+
+    rows = []
+    tuned_better = 0
+    for op in ops:
+        spec = reg[op]
+        for key in spec.sweep_keys():
+            entry = _tuner.measure_op(spec, key, warmup=args.warmup,
+                                      repeats=args.repeats)
+            _cache.get_cache().put(_tuner.cache_key(op, key), entry)
+            heur = spec.heuristic(key)
+            default_s = entry.get("default_s")
+            tuned_s = entry.get("measured_s")
+            speedup = (f"{default_s / tuned_s:.2f}x"
+                       if default_s and tuned_s else "-")
+            if default_s and tuned_s and tuned_s < default_s:
+                tuned_better += 1
+            rows.append([op, _tuner.key_str(key), _fmt_cfg(heur),
+                         _fmt_cfg(entry["config"]), _fmt_s(default_s),
+                         _fmt_s(tuned_s), speedup, entry["provenance"]])
+    print(_table(rows, ["op", "key", "default", "tuned",
+                        "default_t", "tuned_t", "speedup", "provenance"]))
+    if backend_ok:
+        print(f"{tuned_better} op cell(s) improved over the frozen "
+              f"default; cache: {_cache.cache_path()}")
+    else:
+        print("backend not measurable (CPU/interpret): heuristic configs "
+              f"recorded with 'heuristic' provenance; cache: "
+              f"{_cache.cache_path()}")
+    return 0
+
+
+def cmd_show(args) -> int:
+    path = _cache.cache_path()
+    entries = _cache.get_cache().entries()
+    if not entries:
+        print(f"no cache entries at {path}")
+        return 0
+    rows = []
+    for key in sorted(entries):
+        e = entries[key]
+        if not isinstance(e, dict):
+            continue
+        rows.append([key, _fmt_cfg(e.get("config", {})),
+                     e.get("provenance", "?"),
+                     _fmt_s(e.get("measured_s")),
+                     _fmt_s(e.get("default_s"))])
+    print(f"cache: {path}")
+    print(_table(rows, ["op|key", "config", "provenance", "tuned_t",
+                        "default_t"]))
+    return 0
+
+
+def cmd_clear(args) -> int:
+    if args.all:
+        d = _cache.cache_dir()
+        removed = 0
+        if os.path.isdir(d):
+            for name in os.listdir(d):
+                if name.endswith(".json"):
+                    os.unlink(os.path.join(d, name))
+                    removed += 1
+        print(f"removed {removed} cache file(s) from {d}")
+        return 0
+    path = _cache.cache_path()
+    _cache.get_cache(path).clear()
+    print(f"removed {path}" if not os.path.exists(path)
+          else f"failed to remove {path}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.tune",
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--cache-dir", default=None,
+                   help="cache directory (default: "
+                        "$APEX_TPU_TUNE_CACHE_DIR or ~/.cache/apex_tpu/tune)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("sweep", help="measure candidate configs and fill "
+                                     "the cache")
+    s.add_argument("--ops", default=None,
+                   help="comma-separated op subset (default: all)")
+    s.add_argument("--dry-run", action="store_true",
+                   help="print the sweep plan; measure/write nothing")
+    s.add_argument("--repeats", type=int, default=_measure.DEFAULT_REPEATS)
+    s.add_argument("--warmup", type=int, default=_measure.DEFAULT_WARMUP)
+    s.set_defaults(fn=cmd_sweep)
+
+    s = sub.add_parser("show", help="print cache entries for this backend")
+    s.set_defaults(fn=cmd_show)
+
+    s = sub.add_parser("clear", help="delete cache file(s)")
+    s.add_argument("--all", action="store_true",
+                   help="every device kind, not just this backend's")
+    s.set_defaults(fn=cmd_clear)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cache_dir:
+        os.environ[_cache._ENV_DIR] = args.cache_dir
+    return args.fn(args)

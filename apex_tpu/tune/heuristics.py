@@ -1,0 +1,133 @@
+"""Seed / fallback configuration policies — the hand-measured defaults the
+rest of the toolkit shipped with, now owned by ONE module so the tuner, the
+call sites, and the offline sweep all agree on what "untuned" means.
+
+Every function here is pure and deterministic: given the same key it
+returns the same config, with no device probing, no cache I/O, and no
+measurement. This is what ``APEX_TPU_TUNE=off`` (the default) resolves to,
+what ``cache``/``auto`` fall back to on a miss, and what CI runs under —
+so a heuristic change is a *visible* perf/numerics change, reviewable in
+one place, instead of a constant silently re-frozen inside a kernel file.
+
+Provenance of the numbers:
+
+  * attention blocks (1024, 1024): r3 v5e device-time sweep at
+    (s=4096, d=64, bf16) — see ``ops/attention._flash_fwd``.
+  * layer-norm / moments row blocks: VMEM-budget arithmetic
+    (``pallas_layer_norm._rows_per_block``), r4 16 MB-scope fix.
+  * multi-tensor block rows 512: 512x128 fp32 = 256 KiB per operand
+    block (re-exported as ``ops/pallas_mt.BLOCK_ROWS``).
+  * DDP message_size / ZeRO chunk_elements 2**23: the reference DDP's
+    message-size default scaled to elements
+    (``apex/parallel/distributed.py:177``) — big enough to saturate ICI,
+    small enough that several buckets overlap with backward.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+# Frozen attention block preferences (forward AND backward): the r3 sweep
+# winner. The call sites still clamp through pick_block / the fused-plan
+# VMEM caps, so these are *preferences*, not final shapes.
+ATTENTION_BLOCK_Q = 1024
+ATTENTION_BLOCK_K = 1024
+
+# Multi-tensor bucket kernels: rows per (rows, 128) grid block.
+MT_BLOCK_ROWS = 512
+
+# Collective bucket granularity (elements per bucket).
+DDP_MESSAGE_SIZE = 2 ** 23
+ZERO_CHUNK_ELEMENTS = 2 ** 23
+
+# Bucket-count sanity threshold: beyond this many collectives per step the
+# per-collective launch/latency overhead dominates and the schedule
+# serializes (arXiv:2004.13336's granularity trade-off, degenerate end).
+BUCKET_COUNT_WARN_THRESHOLD = 256
+
+
+def pick_block(pref: int, s: int) -> int:
+    """Largest block size <= ``pref`` whose block-rounded padding stays
+    within 15% of the minimal 128-aligned padding. Big blocks are faster
+    (the attention kernels are VPU-bound; fewer grid steps amortize
+    per-step overhead) but rounding a length just past a large-block
+    multiple would nearly double the computed/padded area — e.g. sk=1088
+    at block 1024 pads to 2048; the padding rule rejects that.
+
+    Factored out of ``ops/attention._pick_block`` (it is the shared seed
+    policy every block-shaped kernel clamps preferences through) with the
+    edge behavior made structural: the preference is clamped into
+    [128, minimal-padded-length] FIRST, so the function returns a valid
+    128-aligned block for every input — including sequence lengths
+    smaller than 128 and preferences below 128, where the old
+    ``max(128, min(best, pref))`` ordering relied on the candidate loop
+    having rejected everything to stay in range. When the 15% rule
+    rejects every larger candidate (e.g. s=640: 256 pads to 768 >
+    1.15*640, and 512/1024 pad worse still) the minimum valid block 128
+    — which always achieves the minimal padding — is returned.
+    """
+    s = max(1, int(s))
+    sp_min = ((s + 127) // 128) * 128
+    # Structural validity: whatever happens below, the result is a
+    # 128-multiple in [128, sp_min] — never larger than the padded array,
+    # never smaller than one (sublane, lane)-legal tile.
+    pref = max(128, min(int(pref), sp_min))
+    best = 128
+    for cand in (256, 512, 1024):
+        if cand <= pref and -(-s // cand) * cand <= sp_min * 1.15:
+            best = cand
+    return best
+
+
+def shape_bucket(n: int) -> int:
+    """Round ``n`` up to a power of two — the cache key granularity for
+    continuous size dimensions (sequence lengths, element counts), so one
+    measurement serves the whole bucket instead of one cache entry per
+    exact shape."""
+    n = max(1, int(n))
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+# ---------------------------------------------------------------------------
+# Per-op heuristic configs. Each takes the canonical key dict and returns
+# the default config dict — exactly the constants the pre-tune call sites
+# froze, so ``off`` resolution is provably identical to the old defaults.
+# ---------------------------------------------------------------------------
+
+def attention_fwd(key: Dict) -> Dict:
+    return {"block_q": ATTENTION_BLOCK_Q, "block_k": ATTENTION_BLOCK_K}
+
+
+def attention_bwd(key: Dict) -> Dict:
+    return {"block_q": ATTENTION_BLOCK_Q, "block_k": ATTENTION_BLOCK_K}
+
+
+def layer_norm_fwd(key: Dict) -> Dict:
+    from apex_tpu.ops import pallas_layer_norm as _plln
+    return {"rows": _plln._rows_per_block(int(key["d"]))}
+
+
+def layer_norm_bwd(key: Dict) -> Dict:
+    from apex_tpu.ops import pallas_layer_norm as _plln
+    # arrays=2: the backward keeps ~2x the live row blocks (r4 VMEM fix)
+    return {"rows": _plln._rows_per_block(int(key["d"]), arrays=2)}
+
+
+def moments(key: Dict) -> Dict:
+    from apex_tpu.ops import pallas_moments as _pm
+    return {"rows": _pm._rows_per_block(int(key["c"]))}
+
+
+def mt_block(key: Dict) -> Dict:
+    return {"block_rows": MT_BLOCK_ROWS}
+
+
+def ddp_message_size(key: Dict) -> Dict:
+    return {"message_size": DDP_MESSAGE_SIZE}
+
+
+def zero_chunk_elements(key: Dict) -> Dict:
+    return {"chunk_elements": ZERO_CHUNK_ELEMENTS}

@@ -1,0 +1,76 @@
+"""Measurement core: time candidate configs on the live backend.
+
+The clock is the PR 2 timing path — ``perf_counter`` around a dispatched
+call bracketed by ``jax.block_until_ready`` (the same split
+``telemetry.instrument_step`` records as dispatch + device_wait), with
+warmup runs to absorb compilation and allocator settling and a
+median-of-k to reject dispatch jitter. On a tunneled chip the fixed
+per-dispatch tax rides BOTH the default and the candidate, so the
+*ordering* of medians survives it (the r3 lesson: absolute wall numbers
+over the tunnel are poisoned, relative ones at equal dispatch counts are
+not).
+
+Measurement only ever runs on a real TPU backend (``tpu`` or the
+``axon`` PJRT tunnel). On CPU / interpret mode every query reports
+"not measurable" and the tuner falls back to heuristics
+DETERMINISTICALLY — CI stays hermetic: no wall-clock enters any decision
+that affects a compiled program.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+DEFAULT_WARMUP = 2
+DEFAULT_REPEATS = 5
+
+
+def measurable() -> bool:
+    """True when timing on this backend produces device-meaningful
+    numbers. False on CPU/interpret — the hermetic-CI gate. The backend
+    list is ops.multi_tensor's (an axon-tunneled chip is a real TPU:
+    Mosaic compilation, real device clocks) — imported lazily so a new
+    PJRT backend name added there is immediately measurable here."""
+    import jax
+    try:
+        from apex_tpu.ops.multi_tensor import _TPU_BACKENDS
+        return jax.default_backend() in _TPU_BACKENDS
+    except Exception:
+        return False
+
+
+def time_fn(fn: Callable[[], Any], *, warmup: int = DEFAULT_WARMUP,
+            repeats: int = DEFAULT_REPEATS) -> float:
+    """Median wall seconds of ``fn()`` fully blocked to completion.
+
+    ``fn`` returns its device outputs; blocking happens HERE so a closure
+    under test cannot accidentally be timed async (returning unblocked
+    arrays is the natural way to write one)."""
+    import jax
+    for _ in range(max(0, warmup)):
+        jax.block_until_ready(fn())
+    samples: List[float] = []
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        samples.append(time.perf_counter() - t0)
+    return float(np.median(samples))
+
+
+def time_candidates(build_runner: Callable[[dict], Optional[Callable]],
+                    configs: List[dict], *, warmup: int = DEFAULT_WARMUP,
+                    repeats: int = DEFAULT_REPEATS) -> List[Optional[float]]:
+    """Median seconds per config (None where the runner declined or
+    failed — an OOM'ing candidate loses the sweep, it does not end it)."""
+    out: List[Optional[float]] = []
+    for cfg in configs:
+        try:
+            runner = build_runner(cfg)
+            out.append(None if runner is None else
+                       time_fn(runner, warmup=warmup, repeats=repeats))
+        except Exception:
+            out.append(None)
+    return out

@@ -1,0 +1,364 @@
+"""The op registry: candidate spaces and measurement runners per tunable op.
+
+Each :class:`OpSpec` binds one knob family to
+
+  * ``heuristic(key)``  — the frozen default (``tune.heuristics``): what
+    ``off`` resolves to and what misses fall back to,
+  * ``candidates(key)`` — the search space the sweep/auto measurement
+    walks (always includes the heuristic config),
+  * ``runner(key, config)`` — a no-arg closure executing the op at
+    ``key``'s bucket shape under ``config`` (None: the op cannot be
+    measured standalone in this process, e.g. a collective with no
+    second device — resolution then reports "heuristic" provenance),
+  * ``sweep_keys()`` — the canonical shapes ``python -m apex_tpu.tune
+    sweep`` pre-tunes offline.
+
+Runners lazy-import the op modules (ops import the tuner at resolve
+time; the registry must not close that loop at import time) and build
+synthetic operands at the cache key's bucket shape — a measurement is
+valid for exactly the (device_kind, op, shape-bucket, dtype) cell it is
+stored under.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Callable, Dict, List, Optional
+
+from apex_tpu.tune import heuristics as _h
+
+Config = Dict
+Key = Dict
+
+
+@dataclasses.dataclass(frozen=True)
+class OpSpec:
+    name: str
+    primary: str                                  # headline scalar in config
+    heuristic: Callable[[Key], Config]
+    candidates: Callable[[Key], List[Config]]
+    runner: Optional[Callable[[Key, Config], Optional[Callable]]] = None
+    sweep_keys: Callable[[], List[Key]] = lambda: []
+    doc: str = ""
+
+
+def _with_heuristic_first(heur: Config, cands: List[Config]) -> List[Config]:
+    out = [heur]
+    for c in cands:
+        if c != heur:
+            out.append(c)
+    return out
+
+
+def _np_dtype(name: str):
+    import jax.numpy as jnp
+    return jnp.dtype(name)
+
+
+# ---------------------------------------------------------------------------
+# attention forward / backward
+# ---------------------------------------------------------------------------
+
+_ATTN_BLOCKS = (256, 512, 1024)
+# Canonical batch*heads for synthetic attention operands: enough rows to
+# occupy the chip, small enough to build fast. Timing ORDER across block
+# configs is what matters, and that is bh-independent (the grid is
+# embarrassingly parallel over bh).
+_ATTN_BH = (1, 8)
+
+
+def _attn_candidates(heur_fn):
+    def candidates(key: Key) -> List[Config]:
+        cands = [{"block_q": bq, "block_k": bk}
+                 for bq in _ATTN_BLOCKS for bk in _ATTN_BLOCKS]
+        return _with_heuristic_first(heur_fn(key), cands)
+    return candidates
+
+
+@functools.lru_cache(maxsize=8)
+def _attn_operands_cached(key_items):
+    # Per-key, NOT per-candidate: time_candidates invokes the runner once
+    # per config, and rebuilding the operands 9x would dominate the sweep
+    key = dict(key_items)
+    import jax
+    b, h = _ATTN_BH
+    sq, sk, d = int(key["sq"]), int(key["sk"]), int(key["d"])
+    dtype = _np_dtype(key["dtype"])
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, h, sq, d)).astype(dtype)
+    k = jax.random.normal(kk, (b, h, sk, d)).astype(dtype)
+    v = jax.random.normal(kv, (b, h, sk, d)).astype(dtype)
+    return q, k, v, 1.0 / math.sqrt(d)
+
+
+def _attn_operands(key: Key):
+    return _attn_operands_cached(tuple(sorted(key.items())))
+
+
+@functools.lru_cache(maxsize=8)
+def _attn_bwd_inputs(key_items):
+    """One forward pass per KEY producing the out/lse/g the backward
+    candidates all consume — with explicit heuristic blocks, so the setup
+    can never trigger a nested attention_fwd resolution (under ``auto``
+    that would be a full fwd measurement as a side effect of a bwd
+    sweep)."""
+    import jax
+    from apex_tpu.ops import attention as _attn
+    key = dict(key_items)
+    q, k, v, scale = _attn_operands(key)
+    out, lse = jax.jit(lambda q, k, v: _attn._flash_fwd(
+        q, k, v, causal=False, scale=scale,
+        block_q=_h.ATTENTION_BLOCK_Q,
+        block_k=_h.ATTENTION_BLOCK_K))(q, k, v)
+    g = out  # any cotangent of the right shape/dtype
+    return q, k, v, out, lse, g, scale
+
+
+def _attn_fwd_runner(key: Key, cfg: Config) -> Optional[Callable]:
+    import jax
+    from apex_tpu.ops import attention as _attn
+    if _attn._interpret():
+        return None
+    q, k, v, scale = _attn_operands(key)
+    bq, bk = int(cfg["block_q"]), int(cfg["block_k"])
+
+    @jax.jit
+    def run(q, k, v):
+        return _attn._flash_fwd(q, k, v, causal=False, scale=scale,
+                                block_q=bq, block_k=bk)
+
+    return lambda: run(q, k, v)
+
+
+def _attn_bwd_runner(key: Key, cfg: Config) -> Optional[Callable]:
+    import jax
+    from apex_tpu.ops import attention as _attn
+    if _attn._interpret():
+        return None
+    q, k, v, out, lse, g, scale = _attn_bwd_inputs(
+        tuple(sorted(key.items())))
+    bq, bk = int(cfg["block_q"]), int(cfg["block_k"])
+
+    @jax.jit
+    def run(q, k, v, out, lse, g):
+        return _attn._flash_bwd(q, k, v, out, lse, g, causal=False,
+                                scale=scale, block_q=bq, block_k=bk)
+
+    return lambda: run(q, k, v, out, lse, g)
+
+
+# ---------------------------------------------------------------------------
+# pallas layer norm / moments row blocks
+# ---------------------------------------------------------------------------
+
+_ROW_CANDS = (128, 256, 512, 1024, 2048)
+_LN_ROWS_N = 16384      # canonical row count for the synthetic operand
+
+
+def _rows_candidates(heur: Config) -> List[Config]:
+    return _with_heuristic_first(heur, [{"rows": r} for r in _ROW_CANDS])
+
+
+@functools.lru_cache(maxsize=8)
+def _ln_inputs(key_items):
+    """Per-key synthetic operands plus the forward products the backward
+    candidates consume — forward run ONCE with explicit heuristic rows so
+    a bwd sweep can never trigger a nested layer_norm_fwd resolution."""
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu.ops import pallas_layer_norm as _plln
+    key = dict(key_items)
+    d = int(key["d"])
+    dtype = _np_dtype(key["dtype"])
+    x = jax.random.normal(jax.random.PRNGKey(0),
+                          (_LN_ROWS_N, d)).astype(dtype)
+    w = jnp.ones((d,), dtype)
+    b = jnp.zeros((d,), dtype)
+    _, mu, rstd = jax.jit(lambda x: _plln.ln_fwd(
+        x, w, b, 1e-5, rows=_plln._rows_per_block(d)))(x)
+    return x, w, b, mu, rstd
+
+
+def _ln_runner(bwd: bool):
+    def build(key: Key, cfg: Config) -> Optional[Callable]:
+        import jax
+        from apex_tpu.ops import pallas_layer_norm as _plln
+        if _plln._interpret():
+            return None
+        rows = int(cfg["rows"])
+        x, w, b, mu, rstd = _ln_inputs(tuple(sorted(key.items())))
+        if not bwd:
+            run = jax.jit(lambda x: _plln.ln_fwd(x, w, b, 1e-5, rows=rows))
+            return lambda: run(x)
+        run = jax.jit(lambda x, mu, rstd: _plln.ln_bwd(
+            x, w, mu, rstd, x, rows=rows))
+        return lambda: run(x, mu, rstd)
+    return build
+
+
+def _moments_runner(key: Key, cfg: Config) -> Optional[Callable]:
+    import jax
+    from apex_tpu.ops import pallas_moments as _pm
+    if _pm._interpret():
+        return None
+    c = int(key["c"])
+    dtype = _np_dtype(key["dtype"])
+    rows = int(cfg["rows"])
+    x = jax.random.normal(jax.random.PRNGKey(0), (65536, c)).astype(dtype)
+    run = jax.jit(lambda x: _pm._moments_2d(x, rows=rows))
+    return lambda: run(x)
+
+
+# ---------------------------------------------------------------------------
+# multi-tensor bucket block rows
+# ---------------------------------------------------------------------------
+
+def _mt_runner(key: Key, cfg: Config) -> Optional[Callable]:
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu.ops import pallas_mt as _mt
+    if _mt._interpret():
+        return None
+    n = min(int(key["n"]), 2 ** 24)   # cap the synthetic bucket at 64 MB f32
+    dtype = _np_dtype(key["dtype"])
+    br = int(cfg["block_rows"])
+    keys = jax.random.split(jax.random.PRNGKey(0), 2)
+    g = jax.random.normal(keys[0], (n,)).astype(dtype)
+    p = jax.random.normal(keys[1], (n,)).astype(dtype)
+    m = jnp.zeros((n,), dtype)
+    v = jnp.zeros((n,), dtype)
+    # adam is the representative bucket op: 4 reads + 3 writes per element,
+    # the bandwidth profile of the fused-optimizer hot path.
+    run = jax.jit(lambda g, p, m, v: _mt.adam_flat(
+        g, p, m, v, lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8, bc1=1.0,
+        bc2=1.0, adam_w_mode=True, weight_decay=0.0, block_rows=br))
+    return lambda: run(g, p, m, v)
+
+
+# ---------------------------------------------------------------------------
+# collective bucketing (DDP message_size / ZeRO chunk_elements)
+# ---------------------------------------------------------------------------
+
+_MSG_CANDS = (2 ** 20, 2 ** 22, 2 ** 23, 2 ** 24, 2 ** 25)
+
+
+def _ddp_runner(key: Key, cfg: Config) -> Optional[Callable]:
+    import jax
+    if len(jax.devices()) < 2:
+        return None     # a 1-device psum measures nothing about bucketing
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+    import numpy as np
+    from apex_tpu.parallel import distributed as _dist
+    world = int(key["world"])
+    if world != len(jax.devices()):
+        return None     # measurement must match the keyed world size
+    total = min(int(key["total"]), 2 ** 25)
+    # ~32 equal leaves: enough boundaries for bucketing to matter
+    n_leaf = max(1, total // 32)
+    leaves = [jax.random.normal(jax.random.PRNGKey(i), (n_leaf,))
+              for i in range(32)]
+    mesh = Mesh(np.asarray(jax.devices()).reshape(-1), ("data",))
+    msg = int(cfg["message_size"])
+
+    def body(*ls):
+        return _dist.allreduce_gradients(list(ls), "data",
+                                         message_size=msg)
+
+    run = jax.jit(shard_map(body, mesh=mesh,
+                            in_specs=tuple(P() for _ in leaves),
+                            out_specs=tuple(P() for _ in leaves),
+                            check_vma=False))
+    return lambda: run(*leaves)
+
+
+def _bucket_sweep_keys() -> List[Key]:
+    import jax
+    return [{"total": 2 ** 24, "world": len(jax.devices())}]
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def _registry() -> Dict[str, OpSpec]:
+    return {s.name: s for s in [
+        OpSpec(
+            name="attention_fwd", primary="block_q",
+            heuristic=_h.attention_fwd,
+            candidates=_attn_candidates(_h.attention_fwd),
+            runner=_attn_fwd_runner,
+            sweep_keys=lambda: [
+                {"sq": 4096, "sk": 4096, "d": 64, "dtype": "bfloat16"}],
+            doc="flash-attention forward (block_q, block_k)"),
+        OpSpec(
+            name="attention_bwd", primary="block_q",
+            heuristic=_h.attention_bwd,
+            candidates=_attn_candidates(_h.attention_bwd),
+            runner=_attn_bwd_runner,
+            sweep_keys=lambda: [
+                {"sq": 4096, "sk": 4096, "d": 64, "dtype": "bfloat16"}],
+            doc="flash-attention backward (block_q, block_k)"),
+        OpSpec(
+            name="layer_norm_fwd", primary="rows",
+            heuristic=_h.layer_norm_fwd,
+            candidates=lambda k: _rows_candidates(_h.layer_norm_fwd(k)),
+            runner=_ln_runner(bwd=False),
+            sweep_keys=lambda: [{"d": 768, "dtype": "bfloat16"}],
+            doc="Pallas LayerNorm forward row-block"),
+        OpSpec(
+            name="layer_norm_bwd", primary="rows",
+            heuristic=_h.layer_norm_bwd,
+            candidates=lambda k: _rows_candidates(_h.layer_norm_bwd(k)),
+            runner=_ln_runner(bwd=True),
+            sweep_keys=lambda: [{"d": 768, "dtype": "bfloat16"}],
+            doc="Pallas LayerNorm backward row-block"),
+        OpSpec(
+            name="moments", primary="rows",
+            heuristic=_h.moments,
+            candidates=lambda k: _rows_candidates(_h.moments(k)),
+            runner=_moments_runner,
+            sweep_keys=lambda: [{"c": 128, "dtype": "bfloat16"}],
+            doc="BatchNorm fused sum/sumsq row-block"),
+        OpSpec(
+            name="mt_block", primary="block_rows",
+            heuristic=_h.mt_block,
+            candidates=lambda k: _with_heuristic_first(
+                _h.mt_block(k),
+                [{"block_rows": r} for r in (128, 256, 512, 1024)]),
+            runner=_mt_runner,
+            sweep_keys=lambda: [{"n": 2 ** 24, "dtype": "float32"}],
+            doc="multi-tensor bucket kernel rows per grid block"),
+        OpSpec(
+            name="ddp_message_size", primary="message_size",
+            heuristic=_h.ddp_message_size,
+            candidates=lambda k: _with_heuristic_first(
+                _h.ddp_message_size(k),
+                [{"message_size": m} for m in _MSG_CANDS]),
+            runner=_ddp_runner,
+            sweep_keys=_bucket_sweep_keys,
+            doc="DDP allreduce bucket capacity (elements)"),
+        OpSpec(
+            name="zero_chunk_elements", primary="chunk_elements",
+            heuristic=_h.zero_chunk_elements,
+            candidates=lambda k: _with_heuristic_first(
+                _h.zero_chunk_elements(k),
+                [{"chunk_elements": m} for m in _MSG_CANDS]),
+            runner=None,   # needs live optimizer state + mesh: resolves
+            # to heuristics until an end-to-end harness exists
+            sweep_keys=_bucket_sweep_keys,
+            doc="ZeRO reduce-scatter/all-gather bucket capacity (elements)"),
+    ]}
+
+
+_REGISTRY_CACHE: Optional[Dict[str, OpSpec]] = None
+
+
+def registry() -> Dict[str, OpSpec]:
+    global _REGISTRY_CACHE
+    if _REGISTRY_CACHE is None:
+        _REGISTRY_CACHE = _registry()
+    return _REGISTRY_CACHE

@@ -1,0 +1,192 @@
+"""Resolution engine: policy -> memo -> cache -> (measure | heuristic).
+
+``resolve(op, key)`` is the single entry point every call site routes a
+``None`` config through. It is host-side pure-Python (legal at trace
+time) and returns ``(config, provenance)`` where provenance is one of
+
+  * ``"default"``   — policy ``off``: the frozen heuristic, untouched
+    disk, untouched telemetry state beyond the tune/* record. Provably
+    inert: the returned config IS the pre-tune constant.
+  * ``"heuristic"`` — a ``cache``/``auto`` miss that could not (or must
+    not) measure: CPU/interpret backends, an op with no standalone
+    runner, or a measurement that raised.
+  * ``"measured"``  — timed on this backend (warmup + median-of-k) and
+    persisted.
+  * ``"cached"``    — loaded from the persistent cache (the entry's own
+    recorded provenance is carried through when present).
+
+The in-process memo is keyed by (policy, device_kind, op, key): a jitted
+step that retraces — donation layouts, new shapes — re-resolves from the
+dict, never from disk and never from a re-measurement. Policy:
+
+  ``APEX_TPU_TUNE`` = ``off`` (default) | ``cache`` (read-only) |
+  ``auto`` (measure-and-fill); ``set_policy()`` overrides the env for
+  the process (bench's BENCH_TUNE knob).
+
+Every resolution emits a ``tune/<op>`` static telemetry event (config +
+provenance + key in meta) so a run's JSONL records exactly which configs
+it executed under; measurements additionally emit per-candidate
+``tune/measure/<op>`` points.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+from typing import Dict, Optional, Tuple
+
+from apex_tpu.tune import cache as _cache
+from apex_tpu.tune import measure as _measure
+from apex_tpu.tune import sweeps as _sweeps
+
+POLICIES = ("off", "cache", "auto")
+
+_lock = threading.Lock()
+_memo: Dict[tuple, Tuple[dict, str]] = {}
+_policy_override: Optional[str] = None
+
+
+def policy() -> str:
+    """The active resolution policy (programmatic override wins, then
+    ``APEX_TPU_TUNE``, then ``off``)."""
+    if _policy_override is not None:
+        return _policy_override
+    p = os.environ.get("APEX_TPU_TUNE", "off").strip().lower() or "off"
+    if p not in POLICIES:
+        raise ValueError(
+            f"APEX_TPU_TUNE={p!r} — expected one of {POLICIES} "
+            "(off: frozen heuristics; cache: read-only lookups; "
+            "auto: measure-and-fill)")
+    return p
+
+
+def set_policy(p: Optional[str]) -> None:
+    """Override the env policy for this process (None restores the env).
+    Takes effect for resolutions made AFTER the call — configs already
+    traced into a compiled program do not change."""
+    global _policy_override
+    if p is not None and p not in POLICIES:
+        raise ValueError(f"policy {p!r} not in {POLICIES}")
+    _policy_override = p
+
+
+def reset() -> None:
+    """Drop the in-process memo (tests / back-to-back policy flips).
+    Cached files on disk are untouched — use the CLI ``clear`` for those."""
+    with _lock:
+        _memo.clear()
+
+
+def key_str(key: Dict) -> str:
+    return ",".join(f"{k}={key[k]}" for k in sorted(key))
+
+
+def cache_key(op: str, key: Dict) -> str:
+    return f"{op}|{key_str(key)}"
+
+
+def _merge_config(heur: dict, stored: dict) -> Optional[dict]:
+    """Overlay a stored config onto the heuristic, coercing values to the
+    heuristic's numeric types. Returns None (use heuristics) when the
+    entry is unusable — a hand-edited or drifted cache entry must degrade,
+    not crash a train step."""
+    out = dict(heur)
+    try:
+        for k, v in stored.items():
+            if k in out:
+                out[k] = type(out[k])(v)
+        return out
+    except (TypeError, ValueError):
+        return None
+
+
+def _emit(op: str, kstr: str, cfg: dict, prov: str, spec) -> None:
+    from apex_tpu import telemetry
+    telemetry.record_static(
+        f"tune/{op}", float(cfg.get(spec.primary, 0)),
+        meta={"op": op, "key": kstr, "config": dict(cfg),
+              "provenance": prov, "policy": policy()},
+        dedup_key=(op, kstr, prov, tuple(sorted(cfg.items()))))
+
+
+def measure_op(spec, key: Dict, *, warmup: int = _measure.DEFAULT_WARMUP,
+               repeats: int = _measure.DEFAULT_REPEATS) -> dict:
+    """Time the candidate space of ``spec`` at ``key`` on this backend.
+
+    Returns a cache-entry dict: ``config``/``provenance`` always,
+    ``measured_s``/``default_s``/``results`` when a measurement ran.
+    Deterministic heuristic fallback on CPU/interpret, runner-less ops,
+    or any measurement failure."""
+    heur = spec.heuristic(key)
+    if not _measure.measurable() or spec.runner is None:
+        return {"config": heur, "provenance": "heuristic"}
+    try:
+        cands = spec.candidates(key)
+        times = _measure.time_candidates(
+            lambda cfg: spec.runner(key, cfg), cands,
+            warmup=warmup, repeats=repeats)
+        results = []
+        from apex_tpu import telemetry
+        for cfg, t in zip(cands, times):
+            results.append({"config": cfg, "median_s": t})
+            if t is not None:
+                telemetry.record(
+                    f"tune/measure/{spec.name}", t,
+                    meta={"key": key_str(key), "config": dict(cfg)})
+        timed = [(t, i) for i, t in enumerate(times) if t is not None]
+        if not timed:
+            return {"config": heur, "provenance": "heuristic",
+                    "results": results}
+        best_t, best_i = min(timed)
+        # times[0] is the heuristic (candidates() puts it first); None —
+        # it failed to run — stays None so the table/cache report "-"
+        # instead of aliasing the default to the winner's time
+        return {"config": cands[best_i], "provenance": "measured",
+                "measured_s": best_t, "default_s": times[0],
+                "results": results}
+    except Exception as e:
+        warnings.warn(
+            f"apex_tpu.tune: measurement for {spec.name} failed ({e}); "
+            "falling back to heuristics")
+        return {"config": heur, "provenance": "heuristic",
+                "error": str(e)}
+
+
+def resolve(op: str, key: Dict) -> Tuple[dict, str]:
+    """Resolve ``op`` at ``key`` under the active policy. See module
+    docstring for the provenance contract."""
+    spec = _sweeps.registry().get(op)
+    if spec is None:
+        raise KeyError(f"unknown tunable op {op!r}; known: "
+                       f"{sorted(_sweeps.registry())}")
+    pol = policy()
+    kstr = key_str(key)
+    memo_k = (pol, _cache.device_kind(), op, kstr)
+    with _lock:
+        hit = _memo.get(memo_k)
+    if hit is not None:
+        return hit
+
+    heur = spec.heuristic(key)
+    if pol == "off":
+        cfg, prov = heur, "default"
+    else:
+        entry = _cache.get_cache().get(cache_key(op, key))
+        if entry is not None:
+            cfg = _merge_config(heur, entry["config"])
+            if cfg is None:
+                cfg, prov = heur, "heuristic"
+            else:
+                prov = str(entry.get("provenance", "cached"))
+        elif pol == "cache":
+            cfg, prov = heur, "heuristic"    # read-only: no measure/write
+        else:  # auto: measure-and-fill
+            new = measure_op(spec, key)
+            cfg, prov = new["config"], new["provenance"]
+            _cache.get_cache().put(cache_key(op, key), new)
+
+    _emit(op, kstr, cfg, prov, spec)
+    with _lock:
+        _memo[memo_k] = (cfg, prov)
+    return cfg, prov

@@ -78,6 +78,13 @@ def main():
     if os.environ.get("BENCH_HEALTH"):
         from apex_tpu import telemetry
         telemetry.health.enable()
+    # BENCH_TUNE=1 runs under APEX_TPU_TUNE=auto (measure-and-fill from
+    # the persistent tune cache) — the A/B knob for the autotuner: run
+    # once without and once with it on the same machine and compare
+    # img/s; both runs record their resolved configs in the JSON.
+    from apex_tpu import tune
+    if os.environ.get("BENCH_TUNE"):
+        tune.set_policy("auto")
     log(f"bench: resnet50 amp {opt_level} batch={batch} image={image} "
         f"on {dev}")
 
@@ -102,6 +109,35 @@ def main():
     _, aopt = amp.initialize(None, inner, opt_level=opt_level, verbosity=0)
     params = amp.cast_model(params32, amp.resolve(opt_level))
     opt_state = aopt.init(params)
+
+    # Resolved-config header, so every BENCH_r*.json is attributable to
+    # its configs. ddp message_size (for THIS param tree) resolves under
+    # the live policy — it is the knob the resnet50 step actually
+    # executes, and the memoized entry is the one allreduce_gradients
+    # hits in-step. The mt block rows / attention blocks lines are
+    # context only (resnet50 never runs those kernels), so they PEEK
+    # read-only: under BENCH_TUNE=auto they must not trigger minutes of
+    # measurement sweeps for ops this bench never calls.
+    n_total = sum(int(np.prod(l.shape)) if l.shape else 1
+                  for l in jax.tree_util.tree_leaves(params))
+    bench_policy = tune.policy()
+    tune_cfg = {
+        "policy": bench_policy,
+        "ddp_message_size": tune.ddp_message_size(total=n_total,
+                                                  world=mesh.size),
+    }
+    if bench_policy == "auto":
+        tune.set_policy("cache")
+    try:
+        tune_cfg["mt_block_rows"] = tune.mt_block_rows(
+            n=n_total, dtype="float32")
+        tune_cfg["attention_blocks"] = list(tune.attention_blocks(
+            "attention_fwd", sq=4096, sk=4096, d=64, dtype="bfloat16"))
+    finally:
+        if bench_policy == "auto":
+            tune.set_policy(bench_policy)
+    log("tune config: " + "  ".join(f"{k}={v}"
+                                    for k, v in tune_cfg.items()))
 
     def per_device(params, batch_stats, opt_state, batch):
         x, y = batch
@@ -248,6 +284,7 @@ def main():
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
         "clock": "device" if img_s_dev > 0 else "wall",
         "wall_img_s": round(img_s_wall, 1),
+        "tune": tune_cfg,
     }
     if flops_per_step:
         achieved = flops_per_step * img_s / batch

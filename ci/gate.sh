@@ -12,7 +12,7 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "== 1/7 package import =="
+echo "== 1/8 package import =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import apex_tpu
@@ -20,7 +20,7 @@ from apex_tpu import amp, optimizers, parallel, ops
 print('apex_tpu imports OK')
 "
 
-echo "== 2/7 native host runtime builds (g++ -O3 -shared) =="
+echo "== 2/8 native host runtime builds (g++ -O3 -shared) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 from apex_tpu import runtime
@@ -35,7 +35,7 @@ print('flatten/unflatten path OK')
 assert ok, 'host runtime failed to build — check g++ toolchain'
 "
 
-echo "== 3/7 graft entry compiles (single-device + 8-device dryrun) =="
+echo "== 3/8 graft entry compiles (single-device + 8-device dryrun) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import __graft_entry__ as ge
@@ -45,7 +45,7 @@ print('entry() compiles')
 ge.dryrun_multichip(8)
 "
 
-echo "== 4/7 package install (wheel build + clean --target install) =="
+echo "== 4/8 package install (wheel build + clean --target install) =="
 # The reference gates on Docker extension builds
 # (tests/docker_extension_builds/run.sh); the TPU analog: build the wheel
 # from pyproject.toml, install it into an empty --target dir, and import
@@ -88,14 +88,14 @@ jax.jit(step).lower(params, state).compile()
 print('installed-package train step compiles')
 ")
 
-echo "== 5/7 lint (apex_tpu.lint: trace safety / dtype policy / collectives) =="
+echo "== 5/8 lint (apex_tpu.lint: trace safety / dtype policy / collectives) =="
 # static gate BEFORE the test tier: AST pass over the package + graft
 # entry, jaxpr pass over the registered entry points. --strict: warnings
 # fail too (every intentional exception carries an inline suppression
 # with its why — see docs/lint.md). Use --format=github under CI bots.
 python -m apex_tpu.lint apex_tpu/ __graft_entry__.py --strict
 
-echo "== 6/7 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
+echo "== 6/8 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
 # A 3-step instrumented GPT train step on the CPU mesh must produce a
 # parseable JSONL carrying step timing, amp loss-scale/overflow, comm
 # bytes and MFU, and the summarize CLI must render it (exit 0) — the
@@ -168,7 +168,84 @@ fi
 echo "health CLI gate OK (healthy=0, injected-NaN=nonzero)"
 rm -rf "$(dirname "$HLT_FILE")"
 
-echo "== 7/7 pytest =="
+echo "== 7/8 tune smoke (sweep dry-run + auto-policy tuned train) =="
+# The autotuner must be drivable offline (sweep plan renders, exit 0) and
+# inline: a 3-step train whose kernels resolve their configs through
+# apex_tpu.tune under APEX_TPU_TUNE=auto. On this CPU backend measurement
+# DECLINES deterministically (hermetic CI) — the gate asserts the
+# degraded path end-to-end: heuristic-provenance entries land in a
+# parseable schema-1 cache file and tune/* events land in the telemetry
+# JSONL, so a run is always attributable to its configs.
+python -m apex_tpu.tune sweep --dry-run > /dev/null
+TUNE_DIR="$(mktemp -d)"
+# APEX_TPU_MT_BACKEND=pallas: force the Pallas layer-norm dispatch so the
+# ln resolve sites are reached (interpret mode on this CPU backend)
+APEX_TPU_TUNE=auto APEX_TPU_TUNE_CACHE_DIR="$TUNE_DIR/cache" \
+APEX_TPU_MT_BACKEND=pallas \
+python -c "
+import jax; jax.config.update('jax_platforms', 'cpu')
+import sys
+import numpy as np
+import jax.numpy as jnp
+from apex_tpu import ops, telemetry, tune   # installs the _compat shims
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+from apex_tpu.normalization.fused_layer_norm import layer_norm
+from apex_tpu.parallel import distributed as dist
+
+assert tune.policy() == 'auto'
+telemetry.enable()
+mesh = Mesh(np.asarray(jax.devices()).reshape(-1), ('data',))
+params = {'w': jnp.eye(64) * 0.1, 'g': jnp.ones((128,)),
+          'b': jnp.zeros((128,))}
+x = jax.random.normal(jax.random.PRNGKey(0), (8, 2, 128, 64))
+
+def loss_fn(p, x):
+    q = x @ p['w']
+    o = ops.flash_attention(q, x, x, causal=True)   # tune: attention blocks
+    y = layer_norm(o.reshape(-1, 128), p['g'], p['b'])  # tune: ln rows
+    return jnp.mean(y * y)
+
+def step(p, x):
+    loss, grads = jax.value_and_grad(loss_fn)(p, x)
+    grads = dist.allreduce_gradients(grads, 'data')  # tune: message_size
+    return jax.tree_util.tree_map(lambda w, g: w - 1e-2 * g, p, grads), loss
+
+run = jax.jit(shard_map(step, mesh=mesh, in_specs=(P(), P('data')),
+                        out_specs=(P(), P()), check_vma=False))
+for _ in range(3):
+    params, loss = run(params, x)
+jax.block_until_ready(params)
+assert np.isfinite(float(loss.reshape(-1)[0]))
+telemetry.write_jsonl(sys.argv[1])
+print('tuned 3-step train OK')
+" "$TUNE_DIR/tune_run.jsonl"
+python -c "
+import glob, json, sys
+tel, cache_dir = sys.argv[1], sys.argv[2]
+names = set()
+with open(tel) as f:
+    for line in f:
+        names.add(json.loads(line)['name'])   # every line must parse
+tuned = {n for n in names if n.startswith('tune/')}
+need = {'tune/attention_fwd', 'tune/attention_bwd', 'tune/layer_norm_fwd',
+        'tune/layer_norm_bwd', 'tune/ddp_message_size'}
+missing = need - tuned
+assert not missing, f'telemetry JSONL missing {missing}; has {sorted(tuned)}'
+files = glob.glob(cache_dir + '/*.json')
+assert files, f'no tune cache file written under {cache_dir}'
+with open(files[0]) as f:
+    data = json.load(f)
+assert data['version'] == 1 and data['entries'], f'bad cache: {files[0]}'
+provs = {e['provenance'] for e in data['entries'].values()}
+assert provs == {'heuristic'}, \
+    f'CPU resolution must be deterministic-heuristic, got {provs}'
+print(f'tune smoke OK: {len(tuned)} tune/* series, '
+      f'{len(data[\"entries\"])} cache entries (heuristic provenance)')
+" "$TUNE_DIR/tune_run.jsonl" "$TUNE_DIR/cache"
+rm -rf "$TUNE_DIR"
+
+echo "== 8/8 pytest =="
 if [[ "${1:-}" == "--full" ]]; then
     # full suite + the complete L1 cross-product matrix (reference
     # tests/L1/cross_product{,_distributed}/run.sh); the convergence
@@ -180,7 +257,8 @@ else
     # fast subset: kernels, optimizers, amp, param groups, checkpoints
     python -m pytest tests/test_multi_tensor.py tests/test_optimizers.py \
         tests/test_amp.py tests/test_param_groups.py tests/test_zero.py \
-        tests/test_checkpoint.py tests/test_runtime.py -q -x
+        tests/test_checkpoint.py tests/test_runtime.py tests/test_tune.py \
+        -q -x
 fi
 
 echo "CI GATE PASSED"
