@@ -37,4 +37,5 @@ from apex_tpu import sparsity
 from apex_tpu import pyprof
 from apex_tpu import telemetry
 from apex_tpu import tune
+from apex_tpu import resilience
 from apex_tpu import testing

@@ -33,13 +33,20 @@ tests/test_checkpoint.py (the analog of tests/L0/run_amp/test_checkpointing.py).
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 
 Tree = Any
+
+#: npz member / orbax sidecar name carrying the optional layout
+#: fingerprint (e.g. ``DistributedFusedAdam.layout_fingerprint``) — a plain
+#: JSON dict of the facts that shaped any flat/sharded state in the tree.
+LAYOUT_KEY = "__layout__"
+_LAYOUT_SIDECAR = "apex_layout.json"
 
 # orbax (and its tensorstore dependency) costs ~2s to import; load it only
 # when an orbax-backed save/restore is actually requested so plain
@@ -64,22 +71,73 @@ def _checkpointer():
     return _orbax().PyTreeCheckpointer()
 
 
-def save(path: str, train_state: Tree, *, force: bool = True) -> None:
+def _check_layout(saved: Optional[Dict[str, Any]],
+                  expected: Dict[str, Any], path: str) -> None:
+    """Fail fast — BEFORE any array is materialized — when a checkpoint's
+    recorded layout fingerprint differs from the one the caller's live
+    configuration would produce (different mesh size, ZeRO chunk
+    resolution, leaf order...). Without this guard the failure surfaces as
+    a shape mismatch deep in the restore machinery or, worse, a silently
+    scrambled flat master."""
+    if saved == expected:
+        return
+    raise ValueError(
+        f"checkpoint layout fingerprint mismatch for {path}:\n"
+        f"  expected: {expected}\n  found:    {saved}\n"
+        + ("The checkpoint predates layout recording (no fingerprint "
+           "saved); re-save it with layout=, or pass expected_layout=None "
+           "to skip the check at your own risk."
+           if saved is None else
+           "The checkpoint was written under a different sharded-state "
+           "layout (mesh size / chunk resolution / param tree) and would "
+           "restore scrambled. Re-create the optimizer/mesh with the "
+           "saved configuration, or re-initialize state from params."))
+
+
+def save(path: str, train_state: Tree, *, force: bool = True,
+         layout: Optional[Dict[str, Any]] = None) -> None:
     """Save a full training-state pytree (params, AmpOptimizerState, step,
     ...) to ``path``. Sharded ``jax.Array`` leaves are written distributed:
-    every host persists its addressable shards."""
-    _checkpointer().save(os.path.abspath(path), train_state, force=force)
+    every host persists its addressable shards.
+
+    ``layout``: optional JSON-able layout fingerprint (e.g.
+    ``zero_opt.layout_fingerprint(params)``) written as a sidecar inside
+    the checkpoint directory; :func:`restore` validates it against
+    ``expected_layout`` before materializing any array."""
+    path = os.path.abspath(path)
+    _checkpointer().save(path, train_state, force=force)
+    if layout is not None:
+        with open(os.path.join(path, _LAYOUT_SIDECAR), "w") as f:
+            json.dump(layout, f, indent=1, sort_keys=True)
 
 
-def restore(path: str, template: Optional[Tree] = None) -> Tree:
+def restore(path: str, template: Optional[Tree] = None, *,
+            expected_layout: Optional[Dict[str, Any]] = None) -> Tree:
     """Restore a pytree saved by :func:`save`.
 
     ``template`` (a pytree of like-structured arrays or
     ``jax.ShapeDtypeStruct`` with shardings) restores arrays directly onto
     their mesh shardings — resume does not need to fit the whole state on
     one host. Without it, leaves restore as host numpy arrays.
+
+    ``expected_layout``: when given, the checkpoint's recorded layout
+    sidecar must match it exactly — checked BEFORE any array bytes move,
+    so restoring a checkpoint from a different mesh / ZeRO chunk
+    resolution fails fast with both fingerprints in the message.
     """
     path = os.path.abspath(path)
+    if expected_layout is not None:
+        # distinguish "no checkpoint here at all" from "checkpoint with
+        # no recorded layout" — the latter's fail-fast message would send
+        # a user with a typo'd path off to debug layout recording
+        if not os.path.isdir(path):
+            raise FileNotFoundError(f"no checkpoint directory at {path}")
+        saved = None
+        sidecar = os.path.join(path, _LAYOUT_SIDECAR)
+        if os.path.exists(sidecar):
+            with open(sidecar) as f:
+                saved = json.load(f)
+        _check_layout(saved, expected_layout, path)
     if template is not None:
         ocp = _orbax()
         restore_args = jax.tree_util.tree_map(
@@ -96,7 +154,14 @@ def restore(path: str, template: Optional[Tree] = None) -> Tree:
 # npz fallback (single host, replicated state)
 # ---------------------------------------------------------------------------
 
-def save_npz(path: str, train_state: Tree) -> None:
+def _npz_path(path: str) -> str:
+    # np.savez appends ".npz" to bare filenames; do it ourselves so the
+    # tmp-write/replace and the reader agree on one final name.
+    return path if str(path).endswith(".npz") else str(path) + ".npz"
+
+
+def save_npz(path: str, train_state: Tree, *,
+             layout: Optional[Dict[str, Any]] = None) -> None:
     """Single-host fallback: flatten the pytree to host numpy and write one
     ``.npz`` (the moral equivalent of the reference's ``torch.save``).
 
@@ -104,6 +169,15 @@ def save_npz(path: str, train_state: Tree) -> None:
     format, so they are widened to fp32 on disk; :func:`restore_npz` casts
     back to the template dtype. Widening is exact, so the round trip stays
     bitwise — the same fp32-on-disk convention as the reference's O2 hook.
+
+    The write is atomic: bytes go to a same-directory temp file that is
+    fsync'd and ``os.replace``'d onto the target, so a crash mid-write
+    leaves either the previous complete checkpoint or nothing — never a
+    truncated ``.npz`` that :func:`restore_npz` trips over later.
+
+    ``layout``: optional JSON-able layout fingerprint stored inside the
+    archive (see :data:`LAYOUT_KEY`); validated by ``restore_npz``'s
+    ``expected_layout`` before arrays are materialized.
     """
     leaves, treedef = jax.tree_util.tree_flatten(train_state)
     arrays = {}
@@ -112,8 +186,25 @@ def save_npz(path: str, train_state: Tree) -> None:
         if arr.dtype.kind == "V":
             arr = arr.astype(np.float32)
         arrays[f"leaf_{i}"] = arr
-    np.savez(path, __structure__=np.frombuffer(
-        _structure_key(train_state).encode(), dtype=np.uint8), **arrays)
+    if layout is not None:
+        arrays[LAYOUT_KEY] = np.frombuffer(
+            json.dumps(layout, sort_keys=True).encode(), dtype=np.uint8)
+    final = _npz_path(path)
+    tmp = f"{final}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, __structure__=np.frombuffer(
+                _structure_key(train_state).encode(), dtype=np.uint8),
+                **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def _structure_key(tree: Tree) -> str:
@@ -124,14 +215,53 @@ def _structure_key(tree: Tree) -> str:
     return "\n".join(jax.tree_util.keystr(p) for p, _ in paths)
 
 
-def restore_npz(path: str, template: Tree) -> Tree:
+def _corrupt(path: str, what: str, e: Exception) -> ValueError:
+    return ValueError(
+        f"truncated or corrupt checkpoint: {path} ({what}: {e}). The file "
+        "was most likely interrupted mid-write (pre-atomic-save era) or "
+        "damaged on disk — fall back to an older snapshot generation or "
+        "re-save; it cannot be loaded.")
+
+
+def restore_npz(path: str, template: Tree, *,
+                expected_layout: Optional[Dict[str, Any]] = None) -> Tree:
     """Restore an ``.npz`` checkpoint into the structure (and dtypes) of
     ``template`` — the same "re-initialize then load" contract as the
-    reference's resume recipe."""
-    data = np.load(path if str(path).endswith(".npz") else str(path) + ".npz")
+    reference's resume recipe.
+
+    A truncated or otherwise unreadable file raises a clear
+    "truncated or corrupt checkpoint" ``ValueError`` naming the file (not
+    a bare zipfile/pickle error); ``expected_layout`` is validated
+    against the archive's recorded fingerprint (see :func:`save_npz`)
+    BEFORE any array is materialized."""
+    final = _npz_path(path)
+    try:
+        data = np.load(final)
+        members = set(data.files)  # forces the zip central directory read
+    except Exception as e:  # BadZipFile / OSError / EOFError / ValueError
+        if isinstance(e, FileNotFoundError):
+            raise
+        raise _corrupt(final, "unreadable archive", e) from e
+
+    def member(name):
+        try:
+            return data[name]
+        except KeyError:
+            raise
+        except Exception as e:  # truncated/corrupt member payload
+            raise _corrupt(final, f"member {name!r} unreadable", e) from e
+
+    if expected_layout is not None:
+        saved_layout = (json.loads(bytes(member(LAYOUT_KEY)).decode())
+                        if LAYOUT_KEY in members else None)
+        _check_layout(saved_layout, expected_layout, final)
     leaves, treedef = jax.tree_util.tree_flatten(template)
-    key = "__structure__" if "__structure__" in data else "__treedef__"
-    saved = bytes(data[key]).decode()
+    if "__structure__" not in members and "__treedef__" not in members:
+        raise ValueError(
+            f"{final} is a readable .npz but not an apex_tpu checkpoint "
+            f"(no structure key; members: {sorted(members)[:8]})")
+    key = "__structure__" if "__structure__" in members else "__treedef__"
+    saved = bytes(member(key)).decode()
     expected = (_structure_key(template) if key == "__structure__"
                 else repr(treedef))  # pre-rename checkpoints
     if saved != expected:
@@ -143,7 +273,7 @@ def restore_npz(path: str, template: Tree) -> Tree:
             "same contract as the reference's resume recipe.")
     new_leaves = []
     for i, leaf in enumerate(leaves):
-        arr = data[f"leaf_{i}"]
+        arr = member(f"leaf_{i}")
         if (hasattr(leaf, "shape")
                 and tuple(arr.shape) != tuple(leaf.shape)):
             # The keystr fingerprint doesn't encode leaf shapes, so a

@@ -260,14 +260,27 @@ class _ZeroBase(FusedOptimizer):
             "structure_crc32": int(zlib.crc32(repr(pairs).encode())),
         }
 
+    def layout_mismatch(self, saved: Optional[dict],
+                        params: Tree) -> dict:
+        """``{field: (saved, current)}`` for every fingerprint field on
+        which a recorded layout disagrees with the one THIS optimizer
+        would use for ``params`` (empty = compatible). ``saved=None`` —
+        a checkpoint that never recorded a layout — mismatches on every
+        field. Shared by :meth:`check_layout` and the resilience
+        manifest validation (``resilience.SnapshotManager`` stores
+        :meth:`layout_fingerprint` under the manifest's ``layout`` key
+        and refuses to restore across a mismatch)."""
+        current = self.layout_fingerprint(params)
+        saved = saved if isinstance(saved, dict) else {}
+        return {k: (saved.get(k), v) for k, v in current.items()
+                if saved.get(k) != v}
+
     def check_layout(self, saved: dict, params: Tree) -> None:
         """Raise if a restored ZeroState's recorded layout differs from
         the layout THIS optimizer would use for ``params`` — the loud
         failure that replaces silent master/moment scrambling when
         chunk_elements / shard_count changed between save and load."""
-        current = self.layout_fingerprint(params)
-        bad = {k: (saved.get(k), v) for k, v in current.items()
-               if saved.get(k) != v}
+        bad = self.layout_mismatch(saved, params)
         if bad:
             raise ValueError(
                 "ZeroState layout mismatch — the checkpoint was saved "

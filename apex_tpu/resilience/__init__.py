@@ -1,0 +1,44 @@
+"""apex_tpu.resilience — fault-tolerant training.
+
+The reference's checkpointing recipe (SURVEY.md §5.4) is a blocking
+rank-0 ``torch.save`` with no story for preemption, mid-write crashes,
+or resume correctness. This package turns the one-shot
+``apex_tpu.checkpoint`` into a training-loop component with guarantees:
+
+  * :mod:`snapshot` — :class:`SnapshotManager`: atomic, generation-
+    numbered checkpoints (tmp dir + fsync + ``os.replace`` publish),
+    last-K + every-Nth retention, manifests carrying step / crc32 /
+    ZeRO layout fingerprint / loader state, and an async mode that
+    overlaps serialization + disk I/O with the next train steps.
+  * :mod:`preempt` — :class:`PreemptionHandler`: SIGTERM/SIGINT graceful
+    shutdown + optional walltime deadline; documented exit code
+    :data:`EXIT_PREEMPTED` (75, ``EX_TEMPFAIL`` — "resubmit with
+    ``--resume auto``").
+  * :mod:`faults` — :class:`FaultInjector`: deterministic fault
+    injection (``APEX_TPU_FAULT=step:N:kill|sigterm|nan_grad|io_error``)
+    so kill-and-resume is exercised by CI, not assumed.
+  * :mod:`loop` — :func:`resilient_loop`: the driver wiring snapshot
+    cadence, preemption, retry-with-backoff around transient save I/O,
+    and auto-resume-from-latest-valid (corrupt/partial generations skip
+    with a loud ``resilience/skipped_generation`` event — the
+    ``tune.cache`` degrade-don't-crash contract).
+
+Resume telemetry: a resumed run emits a ``resilience/resume`` marker
+(generation, step); ``python -m apex_tpu.telemetry summarize`` reports
+resume points and drops pre-resume samples for re-executed steps rather
+than double-counting them.
+
+Full guide: ``docs/resilience.md``.
+"""
+
+from apex_tpu.resilience.faults import (ENV_VAR as FAULT_ENV,
+                                        FaultInjector, raise_if_io_error)
+from apex_tpu.resilience.loop import LoopResult, resilient_loop
+from apex_tpu.resilience.preempt import EXIT_PREEMPTED, PreemptionHandler
+from apex_tpu.resilience.snapshot import Restored, SnapshotManager
+
+__all__ = [
+    "EXIT_PREEMPTED", "FAULT_ENV", "FaultInjector", "LoopResult",
+    "PreemptionHandler", "Restored", "SnapshotManager",
+    "raise_if_io_error", "resilient_loop",
+]

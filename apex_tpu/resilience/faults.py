@@ -1,0 +1,169 @@
+"""Deterministic fault injection — so kill-and-resume is TESTED in CI,
+not just believed.
+
+The reference has no fault story at all (a mid-``torch.save`` crash is
+simply a corrupt ``amp_checkpoint.pt``); here the failure modes the
+resilience stack claims to survive are injectable on demand::
+
+    APEX_TPU_FAULT=step:4:kill        # SIGKILL self at the top of step 4
+    APEX_TPU_FAULT=step:4:sigterm     # graceful-preemption path instead
+    APEX_TPU_FAULT=step:4:nan_grad    # poison that step's loss with NaN
+    APEX_TPU_FAULT=step:4:io_error    # first snapshot attempt at/after
+                                      # step 4 raises OSError once
+    APEX_TPU_FAULT=prob:0.05:kill:7   # seeded Bernoulli(0.05) per step
+
+Semantics:
+
+* ``kill`` — ``os.kill(getpid(), SIGKILL)``: the abrupt-death case.
+  Nothing runs afterwards — no final snapshot, no atexit. A shell
+  observes exit code 137 (128+9).
+* ``sigterm`` — SIGTERM to self: exercises the
+  :mod:`~apex_tpu.resilience.preempt` graceful path (final snapshot +
+  exit :data:`~apex_tpu.resilience.preempt.EXIT_PREEMPTED`).
+* ``nan_grad`` — :meth:`FaultInjector.loss_mult` returns NaN for the
+  faulted step; trainers multiply it into the loss so the poison flows
+  through backward exactly like a real numerics blow-up (the dynamic
+  scaler then skips the step; health telemetry attributes it).
+* ``io_error`` — arms a one-shot ``OSError`` consumed by the snapshot
+  writer (:func:`raise_if_io_error`), exercising the retry-with-backoff
+  path around transient save I/O.
+
+Determinism: the ``step:N`` form is exact; the ``prob:p[:seed]`` form
+draws one seeded Bernoulli per ``fire`` call, so a given seed reproduces
+the same fault schedule call-for-call.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Optional
+
+import numpy as np
+
+ENV_VAR = "APEX_TPU_FAULT"
+KINDS = ("kill", "sigterm", "nan_grad", "io_error")
+
+# The active injector (set by FaultInjector.install / from_env): the
+# snapshot writer consults it without plumbing an object through every
+# call site — a CI-harness global, same spirit as the telemetry enable
+# flag.
+_active: Optional["FaultInjector"] = None
+
+
+def active() -> Optional["FaultInjector"]:
+    return _active
+
+
+class FaultInjector:
+    """One parsed fault spec. ``fire(step)`` is called by the training
+    loop at the top of each step; kill/sigterm act immediately, nan_grad
+    and io_error arm per-step state the producers read."""
+
+    def __init__(self, kind: str, *, step: Optional[int] = None,
+                 prob: Optional[float] = None, seed: int = 0):
+        if kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r}; expected one of {KINDS}")
+        if (step is None) == (prob is None):
+            raise ValueError("exactly one of step=/prob= must be given")
+        self.kind = kind
+        self.step = step
+        self.prob = prob
+        self.seed = seed
+        self._rng = np.random.default_rng(seed)
+        self._io_armed = False
+        self._fired = False
+
+    # -- construction -------------------------------------------------------
+    @classmethod
+    def parse(cls, spec: str) -> "FaultInjector":
+        """``step:N:kind`` or ``prob:P:kind[:seed]`` (see module doc)."""
+        parts = spec.strip().split(":")
+        try:
+            if parts[0] == "step" and len(parts) == 3:
+                return cls(parts[2], step=int(parts[1]))
+            if parts[0] == "prob" and len(parts) in (3, 4):
+                seed = int(parts[3]) if len(parts) == 4 else 0
+                p = float(parts[1])
+                if not 0.0 <= p <= 1.0:
+                    raise ValueError(f"probability {p} outside [0, 1]")
+                return cls(parts[2], prob=p, seed=seed)
+        except ValueError as e:
+            raise ValueError(
+                f"bad {ENV_VAR} spec {spec!r}: {e}. Expected "
+                "'step:N:kind' or 'prob:P:kind[:seed]' with kind in "
+                f"{KINDS}") from e
+        raise ValueError(
+            f"bad {ENV_VAR} spec {spec!r}: expected 'step:N:kind' or "
+            f"'prob:P:kind[:seed]' with kind in {KINDS}")
+
+    @classmethod
+    def from_env(cls, install: bool = True) -> Optional["FaultInjector"]:
+        """Parse :data:`ENV_VAR` (None when unset). ``install=True`` also
+        makes it the process-active injector so the snapshot writer's
+        ``io_error`` hook sees it."""
+        spec = os.environ.get(ENV_VAR)
+        if not spec:
+            return None
+        inj = cls.parse(spec)
+        if install:
+            inj.install()
+        return inj
+
+    def install(self) -> "FaultInjector":
+        global _active
+        _active = self
+        return self
+
+    def uninstall(self) -> None:
+        global _active
+        if _active is self:
+            _active = None
+
+    # -- the per-step hook ---------------------------------------------------
+    def _matches(self, step: int) -> bool:
+        if self._fired:
+            return False
+        if self.step is not None:
+            return step == self.step
+        return bool(self._rng.random() < self.prob)
+
+    def fire(self, step: int) -> None:
+        """Called at the top of step ``step``. kill/sigterm act here;
+        io_error arms the one-shot snapshot failure; nan_grad is read via
+        :meth:`loss_mult` instead (it must flow into the traced loss)."""
+        if self.kind == "nan_grad" or not self._matches(step):
+            return
+        self._fired = True
+        if self.kind == "io_error":
+            self._io_armed = True
+        elif self.kind == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif self.kind == "sigterm":
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def loss_mult(self, step: int) -> float:
+        """1.0 normally; NaN when this step is the armed ``nan_grad``
+        fault. Trainers multiply it into the (pre-scale) loss so the
+        poison takes the same path as a genuine numerics failure."""
+        if self.kind == "nan_grad" and self._matches(step):
+            self._fired = True
+            return float("nan")
+        return 1.0
+
+    def consume_io_error(self) -> bool:
+        """True exactly once after an ``io_error`` fault fired — the
+        snapshot writer translates it into its injected OSError."""
+        if self._io_armed:
+            self._io_armed = False
+            return True
+        return False
+
+
+def raise_if_io_error(what: str = "snapshot write") -> None:
+    """Hook for I/O paths that participate in fault injection (the
+    snapshot writer): raises the armed one-shot ``OSError``."""
+    inj = _active
+    if inj is not None and inj.consume_io_error():
+        raise OSError(f"injected fault: {ENV_VAR} io_error during {what}")

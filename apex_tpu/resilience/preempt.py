@@ -1,0 +1,122 @@
+"""Preemption handling: SIGTERM/SIGINT graceful shutdown + an optional
+wall-clock deadline watcher.
+
+TPU pods are routinely preempted; the platform's contract is a SIGTERM
+with a short grace window. The handler converts that into a cooperative
+flag the training loop polls between steps — the loop takes one final
+snapshot and exits cleanly with :data:`EXIT_PREEMPTED` (75, BSD
+``EX_TEMPFAIL``: "try again later", which is exactly what a rescheduled
+job does). A second signal restores the previous disposition and
+re-delivers itself, so the process dies with real signal semantics
+(SIGTERM -> 143) and a stuck final snapshot can still be killed
+interactively.
+
+The deadline watcher covers the other common shape — a fixed walltime
+budget (batch schedulers, spot VMs with known horizons): pass
+``deadline_s`` and :meth:`PreemptionHandler.requested` flips in time for
+the loop to snapshot and exit before the hard kill lands.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+import warnings
+from typing import Optional, Tuple
+
+#: Exit code of a run that stopped on preemption AFTER persisting a final
+#: snapshot (BSD EX_TEMPFAIL). Schedulers/wrappers treat it as "resubmit
+#: with --resume auto"; anything else is a real failure.
+EXIT_PREEMPTED = 75
+
+
+class PreemptionHandler:
+    """Context manager installing cooperative SIGTERM/SIGINT handling and
+    an optional deadline. Poll :meth:`requested` between steps::
+
+        with PreemptionHandler(deadline_s=3500) as pre:
+            for step in ...:
+                state = step_fn(state, batch)
+                if pre.requested():
+                    snapshot(state); sys.exit(EXIT_PREEMPTED)
+
+    Handlers are restored on exit. Signal installation requires the main
+    thread; elsewhere it degrades (with one warning) to deadline-only.
+    """
+
+    def __init__(self, *, signals: Tuple[int, ...] = (signal.SIGTERM,
+                                                      signal.SIGINT),
+                 deadline_s: Optional[float] = None, enabled: bool = True):
+        self.signals = signals
+        self.deadline_s = deadline_s
+        self.enabled = enabled
+        self._event = threading.Event()
+        self._reason: Optional[str] = None
+        self._prev: dict = {}
+        self._t0: Optional[float] = None
+
+    # -- signal plumbing ----------------------------------------------------
+    def _handle(self, signum, frame):
+        if self._event.is_set():
+            # second signal: the operator really means it — restore the
+            # previous disposition and RE-DELIVER, so the process dies
+            # with real signal semantics (SIGTERM default -> exit 143,
+            # SIGINT default -> KeyboardInterrupt), not a traceback from
+            # inside the handler. (A handler only runs between
+            # bytecodes; a THIRD signal during an uninterruptible
+            # syscall now hits the restored disposition directly.)
+            signal.signal(signum, self._prev.get(signum, signal.SIG_DFL))
+            os.kill(os.getpid(), signum)
+            return
+        self._reason = f"signal:{signal.Signals(signum).name}"
+        self._event.set()
+
+    def __enter__(self) -> "PreemptionHandler":
+        self._t0 = time.monotonic()
+        if self.enabled:
+            for s in self.signals:
+                try:
+                    self._prev[s] = signal.signal(s, self._handle)
+                except ValueError:
+                    # not the main thread: signals cannot be installed —
+                    # deadline polling still works
+                    warnings.warn(
+                        "apex_tpu.resilience: cannot install signal "
+                        "handlers outside the main thread; preemption "
+                        "handling degrades to deadline-only")
+                    break
+        return self
+
+    def __exit__(self, *exc):
+        for s, prev in self._prev.items():
+            try:
+                signal.signal(s, prev)
+            except ValueError:
+                pass
+        self._prev.clear()
+        return False
+
+    # -- the poll ------------------------------------------------------------
+    def requested(self) -> bool:
+        """True once a shutdown signal arrived or the deadline passed.
+        Sticky — stays True until the handler is re-entered."""
+        if self._event.is_set():
+            return True
+        if (self.deadline_s is not None and self._t0 is not None
+                and time.monotonic() - self._t0 >= self.deadline_s):
+            self._reason = f"deadline:{self.deadline_s:g}s"
+            self._event.set()
+            return True
+        return False
+
+    def reason(self) -> Optional[str]:
+        """``"signal:SIGTERM"`` / ``"deadline:3500s"`` / None."""
+        self.requested()  # refresh deadline state
+        return self._reason
+
+    def request(self, reason: str = "manual") -> None:
+        """Programmatic trigger (tests; in-process schedulers)."""
+        self._reason = reason
+        self._event.set()

@@ -1,0 +1,408 @@
+"""Atomic, generation-numbered training snapshots with manifests,
+retention, and an async write mode.
+
+Layout of a snapshot directory::
+
+    snap/
+      gen_00000003/            # one generation, published atomically
+        MANIFEST.json          # step, crc32, layout fingerprint, loader
+        state.npz              # checkpoint.save_npz payload
+      gen_00000005/
+      ...
+
+Publish protocol: each generation is assembled in a same-filesystem temp
+directory (payload written via :func:`apex_tpu.checkpoint.save_npz`,
+which itself fsyncs + ``os.replace``s; manifest written last, fsync'd),
+then the whole directory is ``os.replace``'d onto its final name and the
+parent directory fsync'd. A reader therefore sees either a complete
+generation or none — the mid-write crash that corrupts the reference's
+blocking ``torch.save`` recipe leaves at worst an ignorable ``_tmp.*``
+directory here.
+
+Restore protocol (:meth:`SnapshotManager.restore_latest`): newest
+generation first — manifest must parse, the payload's crc32 must match,
+and the checkpoint's structure/dtype/layout validation must pass.
+A generation failing any of these is SKIPPED with a loud warning and a
+``resilience/skipped_generation`` telemetry counter (the
+``tune.cache`` degrade-don't-crash contract), and the previous valid one
+loads instead. A LAYOUT mismatch is different: it means the live
+configuration (mesh size, ZeRO chunk resolution, param tree) disagrees
+with the whole run's checkpoints — older generations would mismatch the
+same way — so it raises immediately with both fingerprints.
+
+Async mode overlaps snapshot cost with training: the device→host
+transfer is initiated for every leaf up front (``copy_to_host_async``)
+and materialized on the calling thread — it must complete before the
+next step could donate those buffers anyway — while serialization,
+fsync, publish, and retention run on a background thread. ``save``
+blocks only if the PREVIOUS snapshot is still in flight.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import threading
+import time
+import warnings
+import zlib
+from typing import Any, Callable, Dict, List, NamedTuple, Optional
+
+import numpy as np
+
+from apex_tpu import checkpoint
+from apex_tpu.resilience import faults
+
+Tree = Any
+
+MANIFEST = "MANIFEST.json"
+PAYLOAD = "state.npz"
+MANIFEST_VERSION = 1
+_GEN_RE = re.compile(r"^gen_(\d{8})$")
+
+
+def _gen_name(gen: int) -> str:
+    return f"gen_{gen:08d}"
+
+
+class Restored(NamedTuple):
+    """Result of a successful :meth:`SnapshotManager.restore_latest`."""
+    state: Tree
+    step: int
+    generation: int
+    manifest: Dict[str, Any]
+    path: str
+
+
+def _crc32_file(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds — fsync is best-effort
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _record(name: str, value: float, *, step: Optional[int] = None,
+            kind: str = "point", meta: Optional[dict] = None) -> None:
+    from apex_tpu import telemetry
+    if telemetry.enabled():
+        telemetry.record(name, value, step=step, kind=kind, meta=meta)
+
+
+class SnapshotManager:
+    """Generation-numbered checkpoint store for one training run.
+
+    Parameters
+    ----------
+    directory:
+        Snapshot root; created on first save.
+    keep_last:
+        Retain the newest K generations (0 = keep everything).
+    keep_every:
+        Additionally retain every generation whose *step* is a multiple
+        of this (0 = none) — the "last-K plus every-Nth" policy, so a
+        long run keeps sparse history without unbounded disk.
+    async_mode:
+        Overlap serialization + disk I/O with training (module doc).
+    save_retries / backoff_s:
+        Transient-I/O retry policy around each write attempt
+        (exponential backoff: ``backoff_s * 2**attempt``).
+    """
+
+    def __init__(self, directory: str, *, keep_last: int = 3,
+                 keep_every: int = 0, async_mode: bool = False,
+                 save_retries: int = 2, backoff_s: float = 0.25,
+                 _sleep: Callable[[float], None] = time.sleep):
+        self.directory = str(directory)
+        self.keep_last = int(keep_last)
+        self.keep_every = int(keep_every)
+        self.async_mode = bool(async_mode)
+        self.save_retries = int(save_retries)
+        self.backoff_s = float(backoff_s)
+        self._sleep = _sleep
+        self._thread: Optional[threading.Thread] = None
+        self._last_error: Optional[BaseException] = None
+        self._lock = threading.Lock()
+
+    # -- listing -------------------------------------------------------------
+    def generations(self) -> List[int]:
+        """Published generation numbers, ascending."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:   # missing, or not (yet) a directory
+            return []
+        out = []
+        for n in names:
+            m = _GEN_RE.match(n)
+            if m and os.path.isdir(os.path.join(self.directory, n)):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def _next_generation(self) -> int:
+        gens = self.generations()
+        return (gens[-1] + 1) if gens else 0
+
+    def manifest(self, gen: int) -> Dict[str, Any]:
+        with open(os.path.join(self.directory, _gen_name(gen),
+                               MANIFEST)) as f:
+            return json.load(f)
+
+    # -- save ----------------------------------------------------------------
+    def save(self, state: Tree, *, step: int,
+             layout: Optional[Dict[str, Any]] = None,
+             loader: Optional[Dict[str, Any]] = None,
+             extra: Optional[Dict[str, Any]] = None) -> bool:
+        """Persist one generation. Returns True on success, False after
+        retries were exhausted (degrade-don't-crash: a full disk must not
+        kill the training step that just succeeded; the failure is warned
+        + counted, and the run keeps its previous generations).
+
+        ``layout``: JSON-able layout fingerprint (ZeRO
+        ``layout_fingerprint``) validated at restore. ``loader``:
+        resumable data-loader state (e.g. ``{"offset": n}``,
+        ``PrefetchLoader.loader_state()``). ``extra``: free-form
+        JSON-able provenance (seeds, opt level, ...).
+        """
+        if self.async_mode:
+            self.wait()  # at most one snapshot in flight
+        host = self._to_host(state)
+        args = (host, int(step), layout, loader, extra)
+        if self.async_mode:
+            t = threading.Thread(target=self._write_guarded, args=args,
+                                 daemon=True, name="apex-snapshot")
+            with self._lock:
+                self._thread = t
+                self._last_error = None
+            t.start()
+            return True
+        return self._write_with_retries(*args)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until any in-flight async snapshot lands. Returns False
+        when that snapshot failed (warned at write time) — or when
+        ``timeout`` expired with the write STILL in flight, in which
+        case the thread stays tracked so a later wait/save cannot start
+        a second concurrent writer against the same generation."""
+        with self._lock:
+            t = self._thread
+        if t is None:
+            return True
+        t.join(timeout)
+        if t.is_alive():
+            return False   # timed out: still in flight, keep tracking
+        with self._lock:
+            if self._thread is t:
+                self._thread = None
+            err = self._last_error
+            self._last_error = None
+        return err is None
+
+    def _to_host(self, state: Tree) -> Tree:
+        """Materialize the state to host numpy on the CALLING thread.
+
+        Donation-safety: trainers routinely jit with donate_argnums, so a
+        background thread must never touch device buffers the next step
+        may have reused. The D2H itself is still overlapped: every leaf's
+        transfer is initiated up front (``copy_to_host_async``) before
+        any is materialized."""
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        for leaf in leaves:
+            start = getattr(leaf, "copy_to_host_async", None)
+            if start is not None:
+                try:
+                    start()
+                except Exception:
+                    pass  # materialization below is authoritative
+        return jax.tree_util.tree_unflatten(
+            treedef, [np.asarray(leaf) for leaf in leaves])
+
+    def _write_guarded(self, *args) -> None:
+        try:
+            ok = self._write_with_retries(*args)
+            if not ok:
+                with self._lock:
+                    self._last_error = OSError("snapshot write failed")
+        except BaseException as e:  # never kill the process from a thread
+            with self._lock:
+                self._last_error = e
+            warnings.warn(f"apex_tpu.resilience: async snapshot failed: {e}")
+
+    def _write_with_retries(self, host: Tree, step: int, layout, loader,
+                            extra) -> bool:
+        delay = self.backoff_s
+        for attempt in range(self.save_retries + 1):
+            try:
+                self._write(host, step, layout, loader, extra)
+                return True
+            except OSError as e:
+                if attempt >= self.save_retries:
+                    warnings.warn(
+                        f"apex_tpu.resilience: snapshot at step {step} "
+                        f"failed after {attempt + 1} attempts ({e}); "
+                        "training continues on the previous generations")
+                    _record("resilience/save_failed", 1.0, step=step,
+                            kind="counter", meta={"error": str(e)})
+                    return False
+                _record("resilience/save_retry", 1.0, step=step,
+                        kind="counter",
+                        meta={"attempt": attempt + 1, "error": str(e)})
+                self._sleep(delay)
+                delay *= 2
+        return False  # unreachable
+
+    def _write(self, host: Tree, step: int, layout, loader, extra) -> None:
+        t_start = time.perf_counter()
+        faults.raise_if_io_error("snapshot write")
+        gen = self._next_generation()
+        final = os.path.join(self.directory, _gen_name(gen))
+        tmp = os.path.join(self.directory,
+                           f"_tmp.{_gen_name(gen)}.{os.getpid()}")
+        os.makedirs(tmp, exist_ok=True)
+        try:
+            payload = os.path.join(tmp, PAYLOAD)
+            checkpoint.save_npz(payload, host, layout=layout)
+            man = {
+                "manifest_version": MANIFEST_VERSION,
+                "generation": gen,
+                "step": int(step),
+                "ts": time.time(),
+                "payload": PAYLOAD,
+                "crc32": _crc32_file(payload),
+                "bytes": os.path.getsize(payload),
+                "layout": layout,
+                "loader": loader,
+                "extra": extra or {},
+                "complete": True,
+            }
+            mpath = os.path.join(tmp, MANIFEST)
+            with open(mpath, "w") as f:
+                json.dump(man, f, indent=1, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            _fsync_dir(tmp)
+            os.replace(tmp, final)   # the atomic publish
+            _fsync_dir(self.directory)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        dt = time.perf_counter() - t_start
+        _record("resilience/snapshot_s", dt, step=step)
+        _record("resilience/snapshot_bytes", man["bytes"], step=step)
+        _record("resilience/generation", gen, step=step,
+                meta={"generation": gen})
+        self._apply_retention()
+
+    def _apply_retention(self) -> None:
+        """Delete generations outside last-K + every-Nth-step. Best
+        effort: an undeletable directory is skipped, not fatal."""
+        if self.keep_last <= 0:
+            return
+        gens = self.generations()
+        protected = set(gens[-self.keep_last:])
+        if self.keep_every > 0:
+            for g in gens:
+                try:
+                    if self.manifest(g).get("step", -1) % self.keep_every \
+                            == 0:
+                        protected.add(g)
+                except (OSError, ValueError, KeyError):
+                    pass  # unreadable manifest: not worth protecting
+        for g in gens:
+            if g not in protected:
+                shutil.rmtree(
+                    os.path.join(self.directory, _gen_name(g)),
+                    ignore_errors=True)
+
+    # -- restore -------------------------------------------------------------
+    def restore_latest(self, template: Tree, *,
+                       layout: Optional[Dict[str, Any]] = None,
+                       ) -> Optional[Restored]:
+        """Load the newest VALID generation into ``template``'s
+        structure/dtypes. Corrupt or partial generations are skipped with
+        a warning + telemetry counter; a layout-fingerprint mismatch
+        raises (module doc). Returns None when no valid generation
+        exists."""
+        self.wait()  # an in-flight async write may be the latest gen
+
+        def skip(gen, gdir, e):
+            warnings.warn(
+                f"apex_tpu.resilience: skipping corrupt/partial snapshot "
+                f"generation {gen} at {gdir} ({e}); falling back to the "
+                "previous one")
+            _record("resilience/skipped_generation", 1.0, kind="counter",
+                    meta={"generation": gen, "error": str(e)})
+
+        for gen in reversed(self.generations()):
+            gdir = os.path.join(self.directory, _gen_name(gen))
+            try:
+                man = self.manifest(gen)
+                if not man.get("complete") \
+                        or man.get("manifest_version") != MANIFEST_VERSION:
+                    raise ValueError(
+                        f"incomplete or unknown-version manifest: "
+                        f"{man.get('manifest_version')!r}")
+                payload = os.path.join(gdir, man.get("payload", PAYLOAD))
+                if "crc32" in man and _crc32_file(payload) != man["crc32"]:
+                    raise ValueError("payload crc32 mismatch")
+                if "step" not in man:
+                    raise ValueError("manifest carries no step")
+            except (OSError, ValueError, KeyError) as e:
+                skip(gen, gdir, e)
+                continue
+            if layout is not None and man.get("layout") != layout:
+                # configuration mismatch, not corruption: every older
+                # generation of this run carries the same layout, so
+                # skipping would just fail N more times — fail fast with
+                # both fingerprints in the message
+                checkpoint._check_layout(man.get("layout"), layout, gdir)
+            try:
+                state = checkpoint.restore_npz(payload, template,
+                                               expected_layout=layout)
+            except (FileNotFoundError, OSError) as e:
+                skip(gen, gdir, e)
+                continue
+            except ValueError as e:
+                if "truncated or corrupt" in str(e) \
+                        or "not an apex_tpu checkpoint" in str(e):
+                    skip(gen, gdir, e)   # damage: older gens may be fine
+                    continue
+                raise   # structure/shape/layout mismatch: config error
+            return Restored(state=state, step=int(man["step"]),
+                            generation=gen, manifest=man, path=gdir)
+        return None
+
+    def latest_manifest(self) -> Optional[Dict[str, Any]]:
+        """Manifest of the newest generation whose manifest is readable
+        (no payload validation), or None. Read this BEFORE constructing a
+        resumable data loader: its ``loader`` key carries the saved
+        offset (``PrefetchLoader(source, skip=manifest["loader"]
+        ["offset"])``) — :func:`~apex_tpu.resilience.loop.resilient_loop`
+        does not fast-forward loaders that manage their own offset."""
+        for gen in reversed(self.generations()):
+            try:
+                man = self.manifest(gen)
+                int(man["step"])
+                return man
+            except (OSError, ValueError, KeyError, TypeError):
+                continue
+        return None
+
+    def latest_step(self) -> Optional[int]:
+        """Step of the newest generation with a readable manifest (no
+        payload validation), or None."""
+        man = self.latest_manifest()
+        return None if man is None else int(man["step"])

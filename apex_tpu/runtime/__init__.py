@@ -271,12 +271,29 @@ class PrefetchLoader:
     on input (the reference's prefetcher has exactly this blind spot). With
     ``apex_tpu.telemetry`` enabled, each fetch also emits
     ``data/queue_depth`` (point) and ``data/starvation`` (counter) events.
+
+    Resumable: ``skip=N`` discards the first N source items before any
+    batch is produced, and :meth:`loader_state` reports the CONSUMED
+    offset — skip + batches actually delivered to the trainer, NOT items
+    merely prefetched into the queue (those are lost on a kill and must
+    be re-produced). ``apex_tpu.resilience`` records it in the snapshot
+    manifest; resume reconstructs the loader over a fresh source with
+    ``skip=offset``.
     """
 
     _SENTINEL = object()
 
     def __init__(self, source: Iterator, transform: Optional[Callable] = None,
-                 depth: int = 2, workers: int = 1):
+                 depth: int = 2, workers: int = 1, skip: int = 0):
+        # fast-forward BEFORE the workers exist — racing them for the
+        # source would skip arbitrary interleaved items
+        self._skip = 0
+        for _ in range(max(0, skip)):
+            try:
+                next(source)
+                self._skip += 1
+            except StopIteration:
+                break
         self._source = source
         self._transform = transform or (lambda x: x)
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
@@ -396,7 +413,18 @@ class PrefetchLoader:
                 "starvations": self._starvations,
                 "queue_depth": self._q.qsize(),
                 "depth": self.depth,
+                "skip": self._skip,
             }
+
+    def loader_state(self) -> dict:
+        """Resume state: ``{"offset": skip + consumed}`` — the number of
+        source items whose batches the trainer has actually received.
+        Feed it back as ``skip=offset`` over a fresh source to continue
+        exactly where a killed run's TRAINER (not its prefetch queue)
+        left off. The shape matches what ``resilience.SnapshotManager``
+        stores under the manifest's ``loader`` key."""
+        with self._stats_lock:
+            return {"offset": self._skip + self._consumed}
 
     def close(self):
         """Stop the workers and drop queued batches. Safe to call early

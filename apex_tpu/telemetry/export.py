@@ -21,7 +21,7 @@ import collections
 import json
 import math
 import os
-from typing import Any, Dict, Iterable, List, Optional, Sequence
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from apex_tpu.telemetry.events import Event
 
@@ -185,26 +185,55 @@ def _percentile(sorted_vals: Sequence[float], q: float) -> float:
     return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
 
 
-def _dedup_points(events: List[Dict[str, Any]]) -> Dict[str, List[float]]:
-    """name -> per-step series, averaging samples that share (name, step)
-    (the shard_map one-callback-per-shard collapse). Events with no step
-    stay as individual samples."""
-    by_step: Dict[str, Dict[Any, List[float]]] = collections.defaultdict(
-        lambda: collections.defaultdict(list))
+def _is_resume_marker(e: Dict[str, Any]) -> bool:
+    return e.get("name", "").endswith("resilience/resume")
+
+
+def _dedup_points(events: List[Dict[str, Any]],
+                  ) -> "Tuple[Dict[str, List[float]], int]":
+    """``(name -> per-step series, superseded_count)``, averaging samples
+    that share (name, step) (the shard_map one-callback-per-shard
+    collapse). Events with no step stay as individual samples.
+
+    Resume-aware: a resumed run appends to the SAME JSONL, re-executing
+    the steps between its restored snapshot and the kill — so a
+    (name, step) can carry samples from both the pre-kill attempt and
+    the resumed one. The ``resilience/resume`` marker events segment the
+    stream (file order is chronological); for a duplicated (name, step)
+    only the newest segment's samples count, and the number of dropped
+    older-segment samples is reported so summarize can say how much was
+    superseded instead of silently averaging two attempts of the same
+    step."""
+    # name -> step -> segment -> samples
+    by_step: Dict[str, Dict[Any, Dict[int, List[float]]]] = \
+        collections.defaultdict(lambda: collections.defaultdict(dict))
     nostep: Dict[str, List[float]] = collections.defaultdict(list)
+    seg = 0
     for e in events:
+        if _is_resume_marker(e):
+            seg += 1
+            continue
         if e.get("kind", "point") != "point":
             continue
         if e.get("step") is None:
             nostep[e["name"]].append(float(e["value"]))
         else:
-            by_step[e["name"]][e["step"]].append(float(e["value"]))
+            by_step[e["name"]][e["step"]].setdefault(seg, []).append(
+                float(e["value"]))
+    superseded = 0
     out: Dict[str, List[float]] = {}
     for name, steps in by_step.items():
-        out[name] = [sum(v) / len(v) for _, v in sorted(steps.items())]
+        series = []
+        for _, segs in sorted(steps.items()):
+            newest = max(segs)
+            superseded += sum(len(v) for s, v in segs.items()
+                              if s != newest)
+            vals = segs[newest]
+            series.append(sum(vals) / len(vals))
+        out[name] = series
     for name, vals in nostep.items():
         out.setdefault(name, []).extend(vals)
-    return out
+    return out, superseded
 
 
 def _series_stats(vals: Sequence[float]) -> Dict[str, float]:
@@ -266,7 +295,7 @@ def summarize(events: List[Dict[str, Any]], *,
     health section's divergence pass (the CLI's threshold flags land
     here — detection runs ONCE, with those thresholds)."""
     out: Dict[str, Any] = {"events": len(events)}
-    series = _dedup_points(events)
+    series, superseded = _dedup_points(events)
 
     # step timing (any prefix: "step/..." from instrument_step's default
     # name, or a custom name ending in the same suffixes)
@@ -341,11 +370,27 @@ def summarize(events: List[Dict[str, Any]], *,
     if statics:
         out["static"] = statics
 
-    # counters (starvation ticks etc.)
+    # counters (starvation ticks etc.). Stepped counter events get the
+    # same resume segmentation as points — a resumed run re-emits the
+    # ticks of its re-executed steps, and summing both attempts would
+    # inflate e.g. starvation totals for that range. Step-less counters
+    # (telemetry/dropped) cannot be attributed and sum as before.
     counters: Dict[str, float] = collections.defaultdict(float)
+    stepped: Dict[Any, Dict[int, float]] = collections.defaultdict(dict)
+    seg = 0
     for e in events:
-        if e.get("kind") == "counter":
+        if _is_resume_marker(e):
+            seg += 1
+            continue
+        if e.get("kind") != "counter":
+            continue
+        if e.get("step") is None:
             counters[e["name"]] += float(e["value"])
+        else:
+            segs = stepped[(e["name"], e["step"])]
+            segs[seg] = segs.get(seg, 0.0) + float(e["value"])
+    for (name, _), segs in stepped.items():
+        counters[name] += segs[max(segs)]
     if counters:
         out["counters"] = dict(counters)
     # collector drops mean the aggregates below are computed on an
@@ -358,6 +403,38 @@ def summarize(events: List[Dict[str, Any]], *,
              if name.endswith("data/queue_depth") for v in vs]
     if depth:
         out["queue_depth"] = _series_stats(depth)
+
+    # resilience: resume provenance + snapshot cost. Reported whenever
+    # any resilience/* producer ran; resume points are listed explicitly
+    # (generation + restored step) and `superseded_samples` counts the
+    # pre-resume samples _dedup_points dropped for re-executed steps.
+    resil: Dict[str, Any] = {}
+    resumes = [{"step": e.get("step"),
+                "generation": (e.get("meta") or {}).get(
+                    "generation", int(e["value"]))}
+               for e in events if _is_resume_marker(e)]
+    if resumes:
+        resil["resumes"] = resumes
+        if superseded:
+            resil["superseded_samples"] = superseded
+    snap_s = [v for name, vs in series.items()
+              if name.endswith("resilience/snapshot_s") for v in vs]
+    if snap_s:
+        resil["snapshot_s"] = _series_stats(snap_s)
+    snap_b = [v for name, vs in series.items()
+              if name.endswith("resilience/snapshot_bytes") for v in vs]
+    if snap_b:
+        resil["snapshot_bytes"] = _series_stats(snap_b)
+    for cname, key in (("resilience/skipped_generation",
+                        "skipped_generations"),
+                       ("resilience/save_retry", "save_retries"),
+                       ("resilience/save_failed", "save_failures"),
+                       ("resilience/preempted", "preempted")):
+        total = sum(v for n, v in counters.items() if n.endswith(cname))
+        if total:
+            resil[key] = int(total)
+    if resil:
+        out["resilience"] = resil
 
     # numerics health (producers: telemetry.health)
     health = _health_section(events, series, detect_kwargs=health_detect)
@@ -554,5 +631,33 @@ def format_summary(s: Dict[str, Any]) -> str:
         q = s["queue_depth"]
         lines.append(f"{'queue depth':<14} mean {q['mean']:.2f}"
                      f"   p50 {q['p50']:.1f}   max {q['max']:.0f}")
+    if s.get("resilience"):
+        r = s["resilience"]
+        lines.append("resilience:")
+        for rp in r.get("resumes", []):
+            lines.append(f"  resumed from generation {rp['generation']}"
+                         f" at step {rp['step']}")
+        if r.get("superseded_samples"):
+            lines.append(
+                f"  {r['superseded_samples']} pre-resume samples of "
+                "re-executed steps superseded (not double-counted)")
+        if r.get("snapshot_s"):
+            t = r["snapshot_s"]
+            lines.append(
+                f"  {'snapshot':<13} n={t['count']:<4}"
+                f" mean {t['mean'] * 1e3:9.2f} ms"
+                f"   p50 {t['p50'] * 1e3:9.2f}"
+                f"   max {t['max'] * 1e3:9.2f}")
+        if r.get("snapshot_bytes"):
+            lines.append(
+                f"  {'bytes':<13} mean "
+                f"{_fmt_si(r['snapshot_bytes']['mean'])}B")
+        for key, label in (("skipped_generations",
+                            "skipped (corrupt/partial) generations"),
+                           ("save_retries", "save retries"),
+                           ("save_failures", "save FAILURES"),
+                           ("preempted", "preempted")):
+            if r.get(key):
+                lines.append(f"  {label}: {r[key]}")
     lines.extend(format_health(s.get("health") or {}))
     return "\n".join(lines)

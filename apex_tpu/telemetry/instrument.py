@@ -131,6 +131,15 @@ class instrument_step:
             record_static(f"{name}/model_flops", model_flops,
                           dedup_key=(name,))
 
+    def advance_to(self, step: int) -> None:
+        """Resume attribution: make the NEXT call emit with step index
+        ``step``. A resiliently auto-resumed run restores mid-stream;
+        without this the wrapper restarts at 0 and its ``step/*`` series
+        misattribute — summarize's resume-marker segmentation would then
+        supersede the first attempt's genuine early samples with the
+        resumed run's misnumbered ones."""
+        self.step = int(step)
+
     # -- lazy derived quantities ------------------------------------------
     def _peak(self) -> Optional[float]:
         if self._peak_flops is None:

@@ -308,6 +308,39 @@ def main():
         log(f"telemetry written to {tel_path} — summarize with "
             f"`python -m apex_tpu.telemetry summarize {tel_path}`")
 
+    # BENCH_SNAPSHOT=dir (or 1 for a temp dir) measures the resilience
+    # snapshot cost of THIS model's full train state — sync save wall
+    # time and the async-mode caller-side blocking time (what a train
+    # step actually pays at cadence) — and records both in the JSON, so
+    # snapshot-every choices are sized from data, not guessed.
+    snap_env = os.environ.get("BENCH_SNAPSHOT")
+    if snap_env:
+        import tempfile
+        from apex_tpu import resilience
+        snap_dir = (tempfile.mkdtemp(prefix="apex_bench_snap_")
+                    if snap_env in ("1", "true", "yes") else snap_env)
+        state = {"params": params, "opt": opt_state,
+                 "batch_stats": batch_stats}
+        mgr = resilience.SnapshotManager(snap_dir, keep_last=2)
+        t0 = time.perf_counter()
+        mgr.save(state, step=n_steps)
+        sync_s = time.perf_counter() - t0
+        amgr = resilience.SnapshotManager(snap_dir, keep_last=2,
+                                          async_mode=True)
+        t0 = time.perf_counter()
+        amgr.save(state, step=n_steps + 1)
+        async_block_s = time.perf_counter() - t0
+        amgr.wait()
+        man = mgr.manifest(mgr.generations()[-1])
+        result["snapshot"] = {
+            "dir": snap_dir, "bytes": man["bytes"],
+            "sync_s": round(sync_s, 4),
+            "async_caller_block_s": round(async_block_s, 4),
+        }
+        log(f"snapshot: {man['bytes'] / 1e6:.1f} MB, sync "
+            f"{sync_s * 1e3:.0f} ms, async caller-side block "
+            f"{async_block_s * 1e3:.0f} ms -> {snap_dir}")
+
     if os.environ.get("BENCH_PROFILE"):
         trace_dir = "/tmp/apex_tpu_bench_trace"
         with jax.profiler.trace(trace_dir):

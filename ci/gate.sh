@@ -12,7 +12,7 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "== 1/8 package import =="
+echo "== 1/9 package import =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import apex_tpu
@@ -20,7 +20,7 @@ from apex_tpu import amp, optimizers, parallel, ops
 print('apex_tpu imports OK')
 "
 
-echo "== 2/8 native host runtime builds (g++ -O3 -shared) =="
+echo "== 2/9 native host runtime builds (g++ -O3 -shared) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 from apex_tpu import runtime
@@ -35,7 +35,7 @@ print('flatten/unflatten path OK')
 assert ok, 'host runtime failed to build — check g++ toolchain'
 "
 
-echo "== 3/8 graft entry compiles (single-device + 8-device dryrun) =="
+echo "== 3/9 graft entry compiles (single-device + 8-device dryrun) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import __graft_entry__ as ge
@@ -45,7 +45,7 @@ print('entry() compiles')
 ge.dryrun_multichip(8)
 "
 
-echo "== 4/8 package install (wheel build + clean --target install) =="
+echo "== 4/9 package install (wheel build + clean --target install) =="
 # The reference gates on Docker extension builds
 # (tests/docker_extension_builds/run.sh); the TPU analog: build the wheel
 # from pyproject.toml, install it into an empty --target dir, and import
@@ -88,14 +88,14 @@ jax.jit(step).lower(params, state).compile()
 print('installed-package train step compiles')
 ")
 
-echo "== 5/8 lint (apex_tpu.lint: trace safety / dtype policy / collectives) =="
+echo "== 5/9 lint (apex_tpu.lint: trace safety / dtype policy / collectives) =="
 # static gate BEFORE the test tier: AST pass over the package + graft
 # entry, jaxpr pass over the registered entry points. --strict: warnings
 # fail too (every intentional exception carries an inline suppression
 # with its why — see docs/lint.md). Use --format=github under CI bots.
 python -m apex_tpu.lint apex_tpu/ __graft_entry__.py --strict
 
-echo "== 6/8 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
+echo "== 6/9 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
 # A 3-step instrumented GPT train step on the CPU mesh must produce a
 # parseable JSONL carrying step timing, amp loss-scale/overflow, comm
 # bytes and MFU, and the summarize CLI must render it (exit 0) — the
@@ -168,7 +168,7 @@ fi
 echo "health CLI gate OK (healthy=0, injected-NaN=nonzero)"
 rm -rf "$(dirname "$HLT_FILE")"
 
-echo "== 7/8 tune smoke (sweep dry-run + auto-policy tuned train) =="
+echo "== 7/9 tune smoke (sweep dry-run + auto-policy tuned train) =="
 # The autotuner must be drivable offline (sweep plan renders, exit 0) and
 # inline: a 3-step train whose kernels resolve their configs through
 # apex_tpu.tune under APEX_TPU_TUNE=auto. On this CPU backend measurement
@@ -245,7 +245,64 @@ print(f'tune smoke OK: {len(tuned)} tune/* series, '
 " "$TUNE_DIR/tune_run.jsonl" "$TUNE_DIR/cache"
 rm -rf "$TUNE_DIR"
 
-echo "== 8/8 pytest =="
+echo "== 8/9 resilience smoke (snapshot -> injected kill -> auto-resume) =="
+# Kill-and-resume end to end: a 6-step train snapshotting every 2 steps is
+# SIGKILLed by the fault injector at the top of step 4 (exit 137 — an
+# abrupt death, no final snapshot), then the SAME command with --resume
+# auto completes to step 6. The gate then demands the documented
+# artifacts: a parseable manifest, EXACTLY the retained generations the
+# keep-last policy promises (steps 2, 4, 6), and the resilience/resume
+# marker in the telemetry JSONL.
+RES_DIR="$(mktemp -d)"
+TRAIN_ARGS=(--steps 6 --warmup-steps 0 --vocab 512 --layers 2
+            --embed-dim 64 --heads 2 --seq-len 128 --batch-size 1
+            --opt-level O2 --snapshot-dir "$RES_DIR/snap"
+            --snapshot-every 2)
+rc=0
+APEX_TPU_FAULT=step:4:kill \
+    python examples/gpt/train_lm.py "${TRAIN_ARGS[@]}" \
+    > /dev/null 2>&1 || rc=$?
+if [[ "$rc" -ne 137 ]]; then
+    echo "resilience: expected the injected SIGKILL (exit 137) from the" \
+         "faulted run, got $rc" >&2
+    exit 1
+fi
+python examples/gpt/train_lm.py "${TRAIN_ARGS[@]}" --resume auto \
+    --telemetry "$RES_DIR/resume.jsonl" > /dev/null
+python -c "
+import glob, json, os, sys
+snap, tel = sys.argv[1], sys.argv[2]
+gens = sorted(glob.glob(os.path.join(snap, 'gen_*')))
+steps = []
+for g in gens:
+    with open(os.path.join(g, 'MANIFEST.json')) as f:
+        man = json.load(f)          # every manifest must parse
+    assert man['complete'] and os.path.exists(
+        os.path.join(g, man['payload'])), f'incomplete generation {g}'
+    steps.append(man['step'])
+assert steps == [2, 4, 6], \
+    f'retention: expected generations at steps [2, 4, 6], got {steps}'
+assert not glob.glob(os.path.join(snap, '_tmp.*')), 'unpublished tmp dir'
+names = set()
+resume = None
+with open(tel) as f:
+    for line in f:
+        row = json.loads(line)      # every line must parse
+        names.add(row['name'])
+        if row['name'] == 'resilience/resume':
+            resume = row
+assert resume is not None, f'no resilience/resume marker in {sorted(names)}'
+assert resume['meta']['step'] == 4, f'resume marker: {resume}'
+print(f'resilience smoke OK: resumed from generation '
+      f\"{resume['meta']['generation']} at step 4; \"
+      f'{len(gens)} retained generations')
+" "$RES_DIR/snap" "$RES_DIR/resume.jsonl"
+python -m apex_tpu.telemetry summarize "$RES_DIR/resume.jsonl" \
+    | grep -q "resumed from generation" \
+    || { echo "summarize did not report the resume point" >&2; exit 1; }
+rm -rf "$RES_DIR"
+
+echo "== 9/9 pytest =="
 if [[ "${1:-}" == "--full" ]]; then
     # full suite + the complete L1 cross-product matrix (reference
     # tests/L1/cross_product{,_distributed}/run.sh); the convergence
@@ -258,7 +315,7 @@ else
     python -m pytest tests/test_multi_tensor.py tests/test_optimizers.py \
         tests/test_amp.py tests/test_param_groups.py tests/test_zero.py \
         tests/test_checkpoint.py tests/test_runtime.py tests/test_tune.py \
-        -q -x
+        tests/test_resilience.py -q -x
 fi
 
 echo "CI GATE PASSED"

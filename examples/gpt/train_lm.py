@@ -116,6 +116,34 @@ def parse_args(argv=None):
                    help=">1: dispatch-proof mode — N steps per jitted "
                         "lax.scan dispatch with on-device token "
                         "generation; device-time primary clock")
+    p.add_argument("--snapshot-dir", default=None, metavar="DIR",
+                   help="fault tolerance: atomic generation-numbered "
+                        "snapshots of (params, amp optimizer state) "
+                        "under DIR; pair with --snapshot-every and "
+                        "--resume auto (docs/resilience.md). SIGTERM/"
+                        "deadline preemption then exits 75 after a "
+                        "final snapshot")
+    p.add_argument("--snapshot-every", type=int, default=0, metavar="N",
+                   help="snapshot cadence in steps (0: only a final "
+                        "snapshot when --snapshot-dir is set)")
+    p.add_argument("--resume", default="none", choices=["none", "auto"],
+                   help="auto: restore the latest valid snapshot "
+                        "generation from --snapshot-dir and continue "
+                        "(corrupt generations are skipped loudly); "
+                        "emits the resilience/resume telemetry marker")
+    p.add_argument("--keep-last", type=int, default=3,
+                   help="snapshot retention: newest K generations")
+    p.add_argument("--keep-every", type=int, default=0, metavar="N",
+                   help="additionally retain every generation whose "
+                        "step is a multiple of N (0: none)")
+    p.add_argument("--async-snapshots", action="store_true",
+                   help="overlap snapshot serialization + disk I/O "
+                        "with the next train steps (blocks only if the "
+                        "previous snapshot is still in flight)")
+    p.add_argument("--preempt-deadline", type=float, default=None,
+                   metavar="SECONDS",
+                   help="walltime budget: snapshot and exit 75 once "
+                        "this many seconds have elapsed")
     return p.parse_args(argv)
 
 
@@ -241,7 +269,7 @@ def main(argv=None):
         args.opt_level, keep_batchnorm_fp32=False))
     opt_state = aopt.init(params)
 
-    def per_device(params, opt_state, tokens, rng):
+    def per_device(params, opt_state, tokens, rng, loss_mult):
         if args.seq_parallel:
             off = jax.lax.axis_index(axis) * tokens.shape[1]
         else:
@@ -268,6 +296,10 @@ def main(argv=None):
             if args.moe:
                 from apex_tpu.parallel import moe_aux_total
                 loss = loss + moe_aux_total(inter["intermediates"])
+            # resilience fault injection (nan_grad): 1.0 normally; NaN on
+            # the faulted step, so the poison flows through backward like
+            # a real numerics blow-up (the dynamic scaler then skips)
+            loss = loss * loss_mult
             return aopt.scale_loss(loss, opt_state), loss
 
         grads, loss = jax.grad(scaled, has_aux=True)(params)
@@ -296,7 +328,7 @@ def main(argv=None):
     tok_spec = P(None, "seq") if args.seq_parallel else P("data")
     step_fn = jax.jit(shard_map(
         per_device, mesh=mesh,
-        in_specs=(rep, rep, tok_spec, rep),
+        in_specs=(rep, rep, tok_spec, rep, rep),
         out_specs=(rep, rep, rep), check_vma=False),
         donate_argnums=(0, 1))
 
@@ -305,7 +337,14 @@ def main(argv=None):
         args.batch_size * n_dev
     args.warmup_steps = min(args.warmup_steps, max(args.steps - 2, 0))
 
+    if args.resume == "auto" and not args.snapshot_dir:
+        raise SystemExit("--resume auto requires --snapshot-dir")
     if args.scan > 1:
+        if args.snapshot_dir or args.resume != "none":
+            raise SystemExit(
+                "--snapshot-dir/--resume need the per-step host loop; "
+                "--scan dispatches N steps per jitted call with no "
+                "host point to snapshot at")
         return _run_scan_mode(args, mesh, axis, per_device, step_fn,
                               params, opt_state, batch, model)
 
@@ -318,21 +357,48 @@ def main(argv=None):
             step_fn, tokens_per_step=batch * args.seq_len)
 
     detector = None
-    prev_overflows = 0.0
     if args.health:
         from apex_tpu import telemetry
         detector = telemetry.DivergenceDetector()
 
-    rng = np.random.default_rng(args.seed + 1)
-    t0 = None
-    flops_step = None
-    for i in range(args.steps):
+    from apex_tpu import resilience
+    injector = resilience.FaultInjector.from_env()
+    manager = None
+    if args.snapshot_dir:
+        manager = resilience.SnapshotManager(
+            args.snapshot_dir, keep_last=args.keep_last,
+            keep_every=args.keep_every, async_mode=args.async_snapshots)
+
+    # cost analysis / comm accounting avals: lower() never executes, so
+    # shapes+dtypes suffice (same trick as scan mode)
+    tok_aval = jax.ShapeDtypeStruct((batch, args.seq_len), jnp.int32)
+    rng_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    mult_aval = jax.ShapeDtypeStruct((), jnp.float32)
+
+    def make_batch(i):
+        # per-step seeded token draw: batch i is addressable by its step
+        # index alone, so a killed run's resume regenerates the exact
+        # stream without replaying i sequential host-RNG draws
         tokens = jax.device_put(
-            rng.integers(0, args.vocab, (batch, args.seq_len),
-                         np.int32), shard)
-        step_rng = jax.random.PRNGKey(args.seed + 2 + i)
+            np.random.default_rng([args.seed + 1, i]).integers(
+                0, args.vocab, (batch, args.seq_len), np.int32), shard)
+        mult = injector.loss_mult(i) if injector is not None else 1.0
+        return (tokens, jax.random.PRNGKey(args.seed + 2 + i),
+                jnp.float32(mult))
+
+    def loop_step(state, batch_inputs, i):
+        params, opt_state = state
+        tokens, step_rng, mult = batch_inputs
         params, opt_state, loss = step_call(params, opt_state, tokens,
-                                            step_rng)
+                                            step_rng, mult)
+        return (params, opt_state), loss
+
+    timing = {"t0": None, "timed": 0, "flops": None,
+              "prev_overflows": 0.0, "loss": None}
+
+    def on_step(i, state, loss):
+        timing["loss"] = loss
+        opt_state = state[1]
         if args.telemetry or detector is not None:
             # the loss series feeds the offline loss_nonfinite /
             # loss_spike rules — a --telemetry-only JSONL must carry it
@@ -358,29 +424,86 @@ def main(argv=None):
             alerts = detector.update(
                 i, loss=loss_val,
                 grad_norm=None if gn_ev is None else gn_ev.value,
-                overflow=ovf_total > prev_overflows,
+                overflow=ovf_total > timing["prev_overflows"],
                 nan_count=None if nan_ev is None else nan_ev.value)
-            prev_overflows = ovf_total
+            timing["prev_overflows"] = ovf_total
             for alert in alerts:
                 print(f"health ALERT step {i}: {alert['reason']}"
                       f" ({alert['detail']})", file=sys.stderr)
-        if i == args.warmup_steps:
+        if timing["t0"] is None and i >= args.warmup_steps:
             jax.block_until_ready(loss)
             # cost analysis BEFORE the timed region (AOT compile; the
             # XLA compile cache makes this cheap for the already-compiled
-            # step) — see pyprof.xla_flops
+            # step) — see pyprof.xla_flops. First step at/past warmup:
+            # a resumed run may start beyond the warmup boundary.
             from apex_tpu import pyprof
-            flops_step = pyprof.xla_flops(step_fn, params, opt_state,
-                                          tokens, step_rng)
-            t0 = time.perf_counter()
+            timing["flops"] = pyprof.xla_flops(
+                step_fn, state[0], opt_state, tok_aval, rng_aval,
+                mult_aval)
+            timing["t0"] = time.perf_counter()
+        elif timing["t0"] is not None:
+            timing["timed"] += 1
         if i % 5 == 0 or i == args.steps - 1:
             print(f"step {i:4d} loss {float(loss):.4f}")
+
+    def on_resume(f):
+        if step_call is not step_fn:
+            # re-attribute the instrumented step/* series to the GLOBAL
+            # step index — the wrapper would otherwise restart at 0 and
+            # mis-join the appended JSONL's resume segmentation
+            step_call.advance_to(f.step)
+        print(f"resilience: resumed from generation {f.generation} at "
+              f"step {f.step} ({f.path})")
+
+    result = resilience.resilient_loop(
+        loop_step, (params, opt_state), make_batch, steps=args.steps,
+        manager=manager, snapshot_every=args.snapshot_every,
+        resume=args.resume, injector=injector,
+        handle_signals=manager is not None,
+        deadline_s=args.preempt_deadline,
+        extra={"seed": args.seed, "opt_level": args.opt_level,
+               "seq_len": args.seq_len, "batch": batch},
+        on_step=on_step,
+        on_resume=on_resume)
+    params, opt_state = result.state
+    loss = timing["loss"]
+
+    if result.preempted:
+        if manager is None:
+            detail = ("no --snapshot-dir configured, progress NOT "
+                      "persisted")
+        elif result.final_snapshot_ok:
+            detail = (f"snapshot saved at step {result.step} — resubmit "
+                      "with --resume auto to continue")
+        else:
+            detail = ("final snapshot FAILED (see warnings); resubmit "
+                      "with --resume auto to continue from the latest "
+                      "persisted generation")
+        print(f"preempted ({result.reason}): {detail}", file=sys.stderr)
+        if args.telemetry:
+            from apex_tpu import telemetry
+            jax.effects_barrier()
+            telemetry.write_jsonl(args.telemetry)
+        sys.exit(result.exit_code)
+    if loss is None:   # resumed at or past the requested step count
+        print(f"nothing to do: resumed at step {result.step} of "
+              f"{args.steps}")
+        if args.telemetry:
+            from apex_tpu import telemetry
+            telemetry.write_jsonl(args.telemetry)  # the resume marker
+        return 0.0
     jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-    timed = args.steps - 1 - args.warmup_steps
-    tok_s = batch * args.seq_len * timed / dt
-    msg = (f"Speed: {tok_s:,.0f} tokens/s over {timed} steps "
-           f"(seq_parallel={args.seq_parallel})")
+    timed = timing["timed"]
+    flops_step = timing["flops"]
+    if timing["t0"] is None or timed <= 0:
+        print("Speed: n/a (too few steps after warmup/resume to time)")
+        dt, tok_s = 0.0, 0.0
+        msg = ""
+    else:
+        dt = time.perf_counter() - timing["t0"]
+        tok_s = batch * args.seq_len * timed / dt
+        msg = (f"Speed: {tok_s:,.0f} tokens/s over {timed} steps "
+               f"(seq_parallel={args.seq_parallel})")
     # Roofline position: XLA cost analysis covers the non-Pallas graph
     # (it reports the flash custom calls as ~0 FLOPs); the analytic
     # attention model FLOPs per layer are added on TPU, so for long
@@ -393,7 +516,7 @@ def main(argv=None):
     # mode (CPU/GPU) the kernel lowers to countable HLO and adding the
     # analytic FLOPs would double-count.
     flash_opaque = not _interpret()
-    if flops_step:
+    if flops_step and msg:
         if flash_opaque:
             dhead = args.embed_dim // args.heads
             flops_step += args.layers * attention_model_flops(
@@ -405,7 +528,8 @@ def main(argv=None):
                 + (f", {mfu:.1%} MFU" if on_tpu else "")
                 + (" (cost analysis + analytic attention model FLOPs)"
                    if flash_opaque else " (cost-analysis count)"))
-    print(msg)
+    if msg:
+        print(msg)
     if detector is not None and detector.alerts:
         print(f"health: {len(detector.alerts)} divergence alert(s) fired "
               "— see lines above", file=sys.stderr)
@@ -413,8 +537,8 @@ def main(argv=None):
         from apex_tpu import telemetry
         # static comm bill of the step program (per device per step,
         # grouped by mesh axis) joins the run file
-        telemetry.record_comm_stats(step_fn, params, opt_state, tokens,
-                                    step_rng, name="comm")
+        telemetry.record_comm_stats(step_fn, params, opt_state, tok_aval,
+                                    rng_aval, mult_aval, name="comm")
         jax.effects_barrier()   # async debug callbacks land before export
         telemetry.write_jsonl(args.telemetry)
         sub = "health" if args.health else "summarize"
@@ -449,7 +573,8 @@ def _run_scan_mode(args, mesh, axis, per_device, step_fn, params,
             tok_rng = jax.random.fold_in(rng_i, ax_i)
             tokens = jax.random.randint(tok_rng, (local_b, local_s), 0,
                                         args.vocab)
-            p, s, loss = per_device(p, s, tokens, rng_i)
+            p, s, loss = per_device(p, s, tokens, rng_i,
+                                    jnp.float32(1.0))
             return (p, s), loss
 
         (params, opt_state), losses = jax.lax.scan(
@@ -471,8 +596,9 @@ def _run_scan_mode(args, mesh, axis, per_device, step_fn, params,
     # once); avals suffice — lower() never executes
     tok_aval = jax.ShapeDtypeStruct((batch, args.seq_len), jnp.int32)
     rng_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    mult_aval = jax.ShapeDtypeStruct((), jnp.float32)
     flops_step = pyprof.xla_flops(step_fn, params, opt_state, tok_aval,
-                                  rng_aval)
+                                  rng_aval, mult_aval)
     # same gating as the default loop: analytic attention FLOPs only
     # when flash runs as an opaque custom call; MFU only on a real TPU
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -518,7 +644,7 @@ def _run_scan_mode(args, mesh, axis, per_device, step_fn, params,
     if args.telemetry:
         from apex_tpu import telemetry
         telemetry.record_comm_stats(step_fn, params, opt_state, tok_aval,
-                                    rng_aval, name="comm")
+                                    rng_aval, mult_aval, name="comm")
         jax.effects_barrier()
         telemetry.write_jsonl(args.telemetry)
         msg += f"\ntelemetry: {args.telemetry}"

@@ -1,0 +1,80 @@
+"""Subprocess driver for the kill-and-resume bitwise test
+(tests/test_resilience.py): a tiny amp O2 train (fp16 model + fp32
+masters + dynamic loss scaler — the full scaler state rides the
+snapshot) run under ``resilient_loop``. A REAL ``SIGKILL`` from the
+``APEX_TPU_FAULT`` injector cannot be simulated in-process, hence the
+subprocess (same pattern as tests/distributed_worker.py).
+
+Usage: python resilience_worker.py STEPS SNAPSHOT_DIR OUT_NPZ
+Environment: APEX_TPU_FAULT (optional), SNAP_EVERY (default 2),
+SNAP_ASYNC=1 for async snapshot mode.
+
+Writes OUT_NPZ with the final (params, AmpOptimizerState) leaves plus
+the (step, loss) trajectory observed by THIS process — the test
+compares them bitwise against an uninterrupted run.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    steps, snap_dir, out = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    from apex_tpu import amp, optimizers, resilience
+
+    opt = optimizers.FusedAdam(lr=0.05)
+    aopt = amp.AmpOptimizer(opt, amp.resolve("O2"))
+    params = {"w": jnp.ones((8,), jnp.float16),
+              "b": jnp.zeros((2,), jnp.float16)}
+    state0 = aopt.init(params)
+
+    @jax.jit
+    def step(params, state, x):
+        def loss_fn(p):
+            loss = ((p["w"] * x).sum() - 1.0) ** 2 + (p["b"] ** 2).sum()
+            return aopt.scale_loss(loss, state), loss
+        grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+        new_params, new_state, _ = aopt.step(grads, params, state)
+        return new_params, new_state, loss
+
+    def make_x(i):
+        # addressable by step index: the resumed process regenerates the
+        # identical batch stream
+        return jnp.asarray(
+            np.random.default_rng([7, i]).uniform(-1, 1, 8), jnp.float16)
+
+    losses = []
+
+    def loop_step(st, x, i):
+        p, s = st
+        p, s, loss = step(p, s, x)
+        return (p, s), loss
+
+    result = resilience.resilient_loop(
+        loop_step, (params, state0), make_x, steps=steps,
+        snapshot_dir=snap_dir,
+        snapshot_every=int(os.environ.get("SNAP_EVERY", "2")),
+        resume="auto",
+        async_mode=bool(os.environ.get("SNAP_ASYNC")),
+        on_step=lambda i, st, loss: losses.append((i, float(loss))))
+
+    leaves = jax.tree_util.tree_leaves(result.state)
+    np.savez(out, losses=np.asarray(losses, np.float64),
+             resumed_from=np.asarray(
+                 -1 if result.resumed_from is None else result.resumed_from),
+             **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)})
+    print(f"done: {result.step} steps, resumed_from={result.resumed_from}")
+
+
+if __name__ == "__main__":
+    main()

@@ -2,6 +2,8 @@
 tests/L0/run_amp/test_checkpointing.py (loss-scale round trip, O2/O5 fp32
 transparency, bitwise resume)."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -127,3 +129,87 @@ def test_npz_structure_mismatch_raises(tmp_path):
     with pytest.raises(ValueError, match="does not match the template"):
         checkpoint.restore_npz(path, {"a": jnp.ones((2,)),
                                       "c": jnp.zeros((3,))})
+
+
+def test_save_npz_atomic_publish(tmp_path, monkeypatch):
+    """A crash mid-write must leave the previous complete checkpoint in
+    place (the write goes to a temp file published via os.replace), not
+    a truncated archive."""
+    path = str(tmp_path / "ck.npz")
+    checkpoint.save_npz(path, {"a": jnp.ones((4,))})
+    before = open(path, "rb").read()
+
+    real_savez = np.savez
+
+    def dying_savez(f, **kw):
+        real_savez(f, **kw)
+        raise RuntimeError("simulated crash mid-save")
+
+    monkeypatch.setattr(np, "savez", dying_savez)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        checkpoint.save_npz(path, {"a": jnp.zeros((4,))})
+    monkeypatch.undo()
+    # target untouched, no tmp litter
+    assert open(path, "rb").read() == before
+    assert os.listdir(tmp_path) == ["ck.npz"]
+    restored = checkpoint.restore_npz(path, {"a": jnp.zeros((4,))})
+    np.testing.assert_array_equal(np.asarray(restored["a"]), np.ones(4))
+
+
+def test_restore_npz_truncated_raises_clear_error(tmp_path):
+    """A truncated .npz (mid-write crash from the pre-atomic era, disk
+    damage) must raise a clear error NAMING the file — not a confusing
+    pickle/zip traceback."""
+    path = str(tmp_path / "ck.npz")
+    checkpoint.save_npz(path, {"a": jnp.arange(1024.0)})
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[: len(blob) // 2])
+    with pytest.raises(ValueError,
+                       match="truncated or corrupt checkpoint.*ck.npz"):
+        checkpoint.restore_npz(path, {"a": jnp.zeros((1024,))})
+
+
+def test_restore_npz_garbage_raises_clear_error(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    open(path, "wb").write(b"this was never an npz file")
+    with pytest.raises(ValueError, match="truncated or corrupt"):
+        checkpoint.restore_npz(path, {"a": jnp.zeros((2,))})
+
+
+def test_npz_layout_fingerprint_roundtrip_and_mismatch(tmp_path):
+    """The ZeRO-style layout fingerprint rides inside the archive and is
+    validated BEFORE arrays materialize: a checkpoint from a different
+    mesh/chunk resolution fails fast with both fingerprints in the
+    message."""
+    path = str(tmp_path / "ck.npz")
+    fp = {"chunk_elements": 1 << 23, "shard_count": 8, "total": 72}
+    checkpoint.save_npz(path, {"m": jnp.ones((72,))}, layout=fp)
+    restored = checkpoint.restore_npz(path, {"m": jnp.zeros((72,))},
+                                      expected_layout=fp)
+    np.testing.assert_array_equal(np.asarray(restored["m"]), np.ones(72))
+    other = dict(fp, shard_count=4)
+    with pytest.raises(ValueError) as exc:
+        checkpoint.restore_npz(path, {"m": jnp.zeros((72,))},
+                               expected_layout=other)
+    assert "layout fingerprint mismatch" in str(exc.value)
+    assert "'shard_count': 8" in str(exc.value)    # found
+    assert "'shard_count': 4" in str(exc.value)    # expected
+    # a checkpoint that never recorded a layout also fails fast
+    checkpoint.save_npz(path, {"m": jnp.ones((72,))})
+    with pytest.raises(ValueError, match="predates layout recording"):
+        checkpoint.restore_npz(path, {"m": jnp.zeros((72,))},
+                               expected_layout=fp)
+
+
+def test_orbax_layout_sidecar(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    path = str(tmp_path / "orbax_ck")
+    fp = {"shard_count": 8, "structure_crc32": 12345}
+    checkpoint.save(path, {"x": jnp.arange(8.0)}, layout=fp)
+    template = {"x": jnp.zeros((8,))}
+    restored = checkpoint.restore(path, template, expected_layout=fp)
+    np.testing.assert_array_equal(np.asarray(restored["x"]),
+                                  np.arange(8.0))
+    with pytest.raises(ValueError, match="layout fingerprint mismatch"):
+        checkpoint.restore(path, template,
+                           expected_layout=dict(fp, shard_count=16))
