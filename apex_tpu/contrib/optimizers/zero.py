@@ -19,6 +19,8 @@ TPU-native mapping:
   * all_gather params    -> ``lax.all_gather(..., tiled=True)``
   * multiple comm PGs / streams -> XLA latency-hiding scheduler
   * compressed allgather (e5m2 flag) -> ``allgather_dtype=jnp.bfloat16``
+  * compressed grad reduction -> ``reduce_dtype="bf16"`` (16-bit wire for
+    the reduce-scatter, fp32 accumulation — docs/overlap.md contract)
   * step-revert on overflow (revert_method 1-3) -> free: the functional step
     returns the previous state under ``lax.cond`` — nothing to undo.
   * ``dwu_group_size`` subgroup sharding (state sharded over a subgroup,
@@ -101,9 +103,20 @@ class _ZeroBase(FusedOptimizer):
                  shard_count: Optional[int] = None,
                  group_axis: Optional[str] = None,
                  allgather_dtype=None, param_groups=None,
-                 chunk_elements: Optional[int] = None):
+                 chunk_elements: Optional[int] = None,
+                 reduce_dtype=None):
+        from apex_tpu.parallel import overlap as _overlap
         self.axis_name = axis_name
         self._shard_count = shard_count  # resolved lazily from the mesh
+        # 16-bit wire format for the gradient reduce-scatter (the inbound
+        # analog of the compressed allgather): each bucket is pre-scaled
+        # by the full data-parallel world and cast before psum_scatter,
+        # and the local shard returns to fp32 immediately after — master
+        # weights and moments always accumulate fp32
+        # (apex_tpu.parallel.overlap numerics contract, docs/overlap.md).
+        # Does NOT participate in the flat state layout: fingerprints and
+        # checkpoints are compatible across reduce_dtype changes.
+        self.reduce_dtype = _overlap.resolve_reduce_dtype(reduce_dtype)
         # Mesh axis ACROSS which optimizer state is replicated (the
         # dwu_group_size analog): grads are reduce-scattered over axis_name
         # (within the subgroup) and allreduced over group_axis.
@@ -341,20 +354,31 @@ class _ZeroBase(FusedOptimizer):
 
         from apex_tpu import telemetry
         if telemetry.enabled():
-            # trace-time static accounting: per-device f32 bytes entering
-            # the chunked reduce-scatter each step (+ the cross-group psum
-            # when subgrouped); (n-1)/n ring wire bill per shard axis.
+            # trace-time static accounting: per-device bytes entering
+            # the chunked reduce-scatter each step at the WIRE dtype
+            # (f32, or reduce_dtype when compressed; + the cross-group
+            # psum when subgrouped); (n-1)/n ring wire bill per axis.
             n = bound_axis_size(self.axis_name)
-            nbytes = 4 * int(sum(b["padded"] for b in spec["buckets"]))
+            item = 4 if self.reduce_dtype is None \
+                else self.reduce_dtype.itemsize
+            nbytes = item * int(sum(b["padded"] for b in spec["buckets"]))
+            meta = {"axis": self.axis_name, "primitive": "psum_scatter",
+                    "count": len(spec["buckets"]), "world": n,
+                    "bytes_wire": round(nbytes * (n - 1) / n)}
+            if self.reduce_dtype is not None:
+                meta["reduce_dtype"] = self.reduce_dtype.name
             telemetry.record_static(
                 f"zero/{self.axis_name}/reduce_scatter_bytes", nbytes,
-                meta={"axis": self.axis_name, "primitive": "psum_scatter",
-                      "count": len(spec["buckets"]), "world": n,
-                      "bytes_wire": round(nbytes * (n - 1) / n)},
-                dedup_key=(self.axis_name, nbytes, len(spec["buckets"])))
+                meta=meta,
+                dedup_key=(self.axis_name, nbytes, len(spec["buckets"]),
+                           item))
             if self.group_axis is not None:
                 gn = bound_axis_size(self.group_axis)
-                gbytes = nbytes // n
+                # the cross-subgroup psum deliberately stays fp32 even
+                # when the scatter is compressed (see below), so bill it
+                # at 4 bytes/element, not the scatter's wire itemsize
+                gbytes = 4 * int(sum(b["padded"]
+                                     for b in spec["buckets"])) // n
                 telemetry.record_static(
                     f"zero/{self.group_axis}/allreduce_bytes", gbytes,
                     meta={"axis": self.group_axis, "primitive": "psum",
@@ -365,9 +389,24 @@ class _ZeroBase(FusedOptimizer):
         shards = []
         for b in spec["buckets"]:
             flat = _bucket_flat(leaves, b["idxs"], b["padded"])
-            sh = jax.lax.psum_scatter(
-                flat, self.axis_name, scatter_dimension=0, tiled=True)
+            if self.reduce_dtype is not None:
+                # pre-scaling compression: the full-world mean divide
+                # lands BEFORE the cast so wire-dtype partial sums carry
+                # mean-gradient magnitude (loss-scale-safe; overflow
+                # saturates to Inf for the amp non-finite check); the
+                # shard returns to fp32 immediately — everything past
+                # the wire accumulates fp32
+                wire = (flat / world).astype(self.reduce_dtype)
+                sh = jax.lax.psum_scatter(
+                    wire, self.axis_name, scatter_dimension=0,
+                    tiled=True).astype(jnp.float32)
+            else:
+                sh = jax.lax.psum_scatter(
+                    flat, self.axis_name, scatter_dimension=0, tiled=True)
             if self.group_axis is not None:
+                # cross-subgroup reduction stays fp32: it moves 1/n of
+                # the bytes and compressing it would square the
+                # quantization error for no meaningful wire saving
                 sh = jax.lax.psum(sh, self.group_axis)
             shards.append(sh)
         from apex_tpu.telemetry import health as _health
@@ -381,11 +420,15 @@ class _ZeroBase(FusedOptimizer):
             from apex_tpu import telemetry
             for i, sh in enumerate(shards):
                 n2 = jax.lax.psum(jnp.sum(jnp.square(sh)), self.axis_name)
+                norm = (jnp.sqrt(n2) if self.reduce_dtype is not None
+                        else jnp.sqrt(n2) / world)
                 telemetry.record(
                     f"health/zero/bucket{i}/grad_norm",
-                    jnp.sqrt(n2) / world, step=telemetry_step)
+                    norm, step=telemetry_step)
         shard = shards[0] if len(shards) == 1 else jnp.concatenate(shards)
-        return shard / world
+        # compressed shards were pre-divided by the full world before the
+        # wire cast (pre-scaling) — they are already the mean
+        return shard if self.reduce_dtype is not None else shard / world
 
     def _gather_params(self, master_shard: jax.Array, spec,
                        params: Tree) -> Tree:
@@ -483,12 +526,14 @@ class DistributedFusedAdam(_ZeroBase):
                  adam_w_mode: bool = True, weight_decay: float = 0.0,
                  axis_name: str = "data", shard_count: Optional[int] = None,
                  group_axis: Optional[str] = None, allgather_dtype=None,
-                 param_groups=None, chunk_elements: Optional[int] = None):
+                 param_groups=None, chunk_elements: Optional[int] = None,
+                 reduce_dtype=None):
         super().__init__(axis_name=axis_name, shard_count=shard_count,
                          group_axis=group_axis,
                          allgather_dtype=allgather_dtype,
                          param_groups=param_groups,
-                         chunk_elements=chunk_elements)
+                         chunk_elements=chunk_elements,
+                         reduce_dtype=reduce_dtype)
         self.lr = lr
         self.bias_correction = bias_correction
         self.betas = betas
@@ -544,12 +589,14 @@ class DistributedFusedLAMB(_ZeroBase):
                  use_nvlamb: bool = False, axis_name: str = "data",
                  shard_count: Optional[int] = None,
                  group_axis: Optional[str] = None, allgather_dtype=None,
-                 param_groups=None, chunk_elements: Optional[int] = None):
+                 param_groups=None, chunk_elements: Optional[int] = None,
+                 reduce_dtype=None):
         super().__init__(axis_name=axis_name, shard_count=shard_count,
                          group_axis=group_axis,
                          allgather_dtype=allgather_dtype,
                          param_groups=param_groups,
-                         chunk_elements=chunk_elements)
+                         chunk_elements=chunk_elements,
+                         reduce_dtype=reduce_dtype)
         self.lr = lr
         self.bias_correction = bias_correction
         self.betas = betas

@@ -108,6 +108,7 @@ class _Ctx:
     groups_by_axis: Dict[str, set]
     findings: List[Finding]
     flagged_group_axes: set = dataclasses.field(default_factory=set)
+    wire_dtype: Optional[str] = None       # declared 16-bit reduce_dtype
 
     def emit(self, rule: str, eqn, msg: str):
         path, line = _frame_for(eqn, self.path, 0)
@@ -157,6 +158,40 @@ def _check_collective(eqn, ctx: _Ctx):
                     f"axis_index_groups partitions in this entry — "
                     f"mixing replica subsets on one axis is the "
                     f"collective analog of mismatched communicators")
+
+
+# A gradient-payload reduction, as opposed to a scalar norm / loss pmean:
+# grouped-collective entries legitimately psum fp32 SCALARS (grad norms,
+# loss means) even on a compressed wire — only array-sized fp32 payloads
+# mean a call site bypassed the reduce_dtype path.
+_APX106_MIN_ELEMENTS = 2048
+_APX106_PRIMS = ("psum", "psum_scatter", "reduce_scatter")
+
+
+def _check_wire_dtype(eqn, ctx: _Ctx):
+    """APX106: the entry declares a 16-bit wire format for gradient
+    reduction (``reduce_dtype=`` on its DDP/ZeRO config), but this
+    collective moves an fp32 payload of gradient size — a call site that
+    routed around ``allreduce_gradients`` / the ZeRO scatter and pays
+    full-width wire bytes the config promised to halve."""
+    if ctx.wire_dtype is None or eqn.primitive.name not in _APX106_PRIMS:
+        return
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        if aval is None or not _is_f32(aval):
+            continue
+        shape = getattr(aval, "shape", ()) or ()
+        n = int(np.prod(shape)) if shape else 1
+        if n >= _APX106_MIN_ELEMENTS:
+            ctx.emit(
+                "APX106", eqn,
+                f"{eqn.primitive.name} moves a float32 payload of {n} "
+                f"elements, but this entry is configured with "
+                f"reduce_dtype={ctx.wire_dtype} — the call site bypasses "
+                "the compressed wire path (route gradient collectives "
+                "through allreduce_gradients / the ZeRO reduce-scatter, "
+                "which honor reduce_dtype)")
+            return
 
 
 def _check_dot(eqn, low_env: Dict[Any, bool], ctx: _Ctx):
@@ -243,6 +278,7 @@ def _walk(jaxpr, low_env: Dict[Any, bool], ctx: _Ctx):
 
         if prim in _COLLECTIVE_PRIMS:
             _check_collective(eqn, ctx)
+            _check_wire_dtype(eqn, ctx)
         elif prim == "dot_general":
             _check_dot(eqn, low_env, ctx)
         elif prim == "pallas_call":
@@ -284,17 +320,21 @@ def _walk(jaxpr, low_env: Dict[Any, bool], ctx: _Ctx):
 class EntrySpec:
     """A registered lowering target: ``make()`` returns ``(fn, args)``;
     ``opt_level`` ties the dtype rules to the amp.policy tables;
-    ``mesh_axes`` declares the collectives' legal axis names."""
+    ``mesh_axes`` declares the collectives' legal axis names;
+    ``reduce_dtype`` declares the entry's configured 16-bit gradient
+    wire format (arms APX106 against fp32 payload collectives)."""
     name: str
     path: str
     make: Callable[[], Tuple[Callable, tuple]]
     mesh_axes: Tuple[str, ...] = ()
     opt_level: Optional[str] = None
+    reduce_dtype: Optional[str] = None
 
 
 def check_entry(fn: Callable, args: tuple, *, name: str = "<entry>",
                 path: str = "<jaxpr>", mesh_axes: Sequence[str] = (),
-                opt_level: Optional[str] = None) -> List[Finding]:
+                opt_level: Optional[str] = None,
+                reduce_dtype: Optional[str] = None) -> List[Finding]:
     """Trace ``fn(*args)`` and run the jaxpr rules. Public so tests and
     downstream projects can lint their own train steps."""
     from apex_tpu.amp import policy
@@ -305,9 +345,14 @@ def check_entry(fn: Callable, args: tuple, *, name: str = "<entry>",
         cd = props.compute_dtype
         compute_low = cd is not None and str(np.dtype(cd)) in _LOW_DTYPES
 
+    wire = None
+    if reduce_dtype is not None:
+        from apex_tpu.parallel.overlap import resolve_reduce_dtype
+        wire = resolve_reduce_dtype(reduce_dtype).name
+
     ctx = _Ctx(entry=name, path=path, compute_low=compute_low,
                declared_axes=set(mesh_axes), groups_by_axis={},
-               findings=[])
+               findings=[], wire_dtype=wire)
     try:
         closed = jax.make_jaxpr(fn)(*args)
     except (NameError, ValueError) as e:
@@ -388,6 +433,24 @@ def builtin_entries() -> List[EntrySpec]:
                           check_vma=False)
         return f, (params, bs, x)
 
+    def ddp_compressed():
+        from jax.sharding import Mesh, PartitionSpec as P
+        from apex_tpu.parallel import allreduce_gradients
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+        params = {"w": jnp.ones((64, 64)), "b": jnp.ones((64,))}
+        x = jnp.ones((4, 64))
+
+        def per_device(p, x):
+            def loss_fn(p):
+                return jnp.mean((x @ p["w"] + p["b"]) ** 2)
+            g = jax.grad(loss_fn)(p)
+            return allreduce_gradients(g, "data", reduce_dtype="bf16")
+
+        f = jax.shard_map(per_device, mesh=mesh,
+                          in_specs=(P(), P("data")), out_specs=P(),
+                          check_vma=False)
+        return f, (params, x)
+
     def zero_step():
         from jax.sharding import Mesh, PartitionSpec as P
         from apex_tpu.contrib.optimizers import DistributedFusedAdam
@@ -415,6 +478,9 @@ def builtin_entries() -> List[EntrySpec]:
                   fused_adam),
         EntrySpec("ddp_syncbn_grads", "apex_tpu/parallel/distributed.py",
                   ddp_syncbn, mesh_axes=("data",)),
+        EntrySpec("ddp_compressed_grads", "apex_tpu/parallel/overlap.py",
+                  ddp_compressed, mesh_axes=("data",),
+                  reduce_dtype="bfloat16"),
         EntrySpec("zero_adam_step", "apex_tpu/contrib/optimizers/zero.py",
                   zero_step, mesh_axes=("data",)),
     ]
@@ -447,5 +513,6 @@ def run_entries(entries: Optional[Sequence[EntrySpec]] = None
             ) from e
         findings.extend(check_entry(
             fn, args, name=spec.name, path=spec.path,
-            mesh_axes=spec.mesh_axes, opt_level=spec.opt_level))
+            mesh_axes=spec.mesh_axes, opt_level=spec.opt_level,
+            reduce_dtype=spec.reduce_dtype))
     return findings

@@ -60,6 +60,10 @@ _RULES = [
     Rule("APX105", "pallas-block-misalignment", ERROR,
          "Pallas block shape violates TPU (8, 128) tiling: the last two "
          "block dims must be multiples of (8, 128) or span the array"),
+    Rule("APX106", "collective-bypasses-reduce-dtype", ERROR,
+         "psum/reduce-scatter moves a gradient-sized fp32 payload in an "
+         "entry configured with a 16-bit reduce_dtype — the call site "
+         "bypasses the compressed wire path"),
 ]
 
 RULES: Dict[str, Rule] = {r.id: r for r in _RULES}

@@ -10,6 +10,7 @@ from apex_tpu.ops.buckets import (
     tree_flatten_buckets,
     tree_unflatten_buckets,
 )
+from apex_tpu.ops.staged_vjp import apply_staged, cotangent_transform
 from apex_tpu.ops.multi_tensor import (
     multi_tensor_scale,
     multi_tensor_axpby,

@@ -1,0 +1,72 @@
+"""Bucket-staged cotangent transforms — the dataflow primitive under the
+overlap engine (:mod:`apex_tpu.parallel.overlap`).
+
+The reference Apex DDP overlaps gradient all-reduce with backward compute
+by registering per-parameter backward *hooks* that fire as each grad is
+produced (apex/parallel/distributed.py:320-557). JAX has no hooks — but it
+has ``jax.custom_vjp``: wrapping a group of parameters in an identity
+whose VJP applies a transform to the cotangents places that transform
+*inside the backward graph*, at exactly the point where those parameters'
+gradients are finalized. Split the parameters into buckets, give each
+bucket its own identity-with-transform, and each bucket's collective
+becomes an equation that depends only on *its* cotangents — bucket *k*'s
+``psum`` can be issued while bucket *k+1*'s backward compute is still
+running, which is the latency-hiding schedule XLA's scheduler needs to
+see in the dataflow before it can exploit it.
+
+This module is deliberately communication-agnostic: it knows nothing
+about meshes or collectives, only "identity forward, transformed
+cotangents backward". The overlap engine supplies reducers.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Tuple
+
+import jax
+
+
+def cotangent_transform(transform: Callable[[Tuple], Sequence],
+                        ) -> Callable:
+    """Build an identity function over ``*arrays`` whose backward maps the
+    cotangent tuple through ``transform``.
+
+    ``transform(cotangents: tuple) -> sequence`` must return one cotangent
+    per primal operand, matching shapes and dtypes (custom_vjp enforces
+    this at trace time). The forward saves no residuals, so the wrapper
+    adds zero memory pressure to the backward.
+    """
+
+    @jax.custom_vjp
+    def ident(*arrays):
+        return arrays
+
+    def fwd(*arrays):
+        return arrays, None
+
+    def bwd(_, cotangents):
+        return tuple(transform(tuple(cotangents)))
+
+    ident.defvjp(fwd, bwd)
+    return ident
+
+
+def apply_staged(leaves: Sequence, bucket_indices: Sequence[Sequence[int]],
+                 make_transform: Callable[[int, int], Callable],
+                 ) -> list:
+    """Route ``leaves`` through one :func:`cotangent_transform` per bucket.
+
+    ``bucket_indices``: leaf indices per bucket (e.g. from
+    ``ops.buckets.assign_buckets``). ``make_transform(bucket_index,
+    n_buckets)`` returns that bucket's cotangent transform. Returns the
+    wrapped leaves in original order — an identity on values, with the
+    backward staged per bucket.
+    """
+    out: list = list(leaves)
+    n = len(bucket_indices)
+    for bi, idxs in enumerate(bucket_indices):
+        wrapped = cotangent_transform(make_transform(bi, n))(
+            *[leaves[i] for i in idxs])
+        for i, t in zip(idxs, wrapped):
+            out[i] = t
+    return out

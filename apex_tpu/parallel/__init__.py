@@ -11,6 +11,8 @@ from apex_tpu.parallel.distributed import (
     Reducer,
     ddp_train_step,
 )
+from apex_tpu.parallel import overlap
+from apex_tpu.parallel.overlap import adasum_flat, sync_in_backward
 from apex_tpu.parallel.sync_batchnorm import (
     SyncBatchNorm,
     sync_moments,

@@ -55,6 +55,8 @@ def allreduce_gradients(
     gradient_predivide_factor: float = 1.0,
     axis_index_groups=None,
     telemetry_step=None,
+    reduce_dtype=None,
+    adasum: bool = False,
 ) -> Tree:
     """Leaf-grouped bucketed gradient allreduce over a mesh axis (the hot
     path of reference DDP: create_hooks/comm_ready_buckets/allreduce_bucket,
@@ -78,7 +80,25 @@ def allreduce_gradients(
     ``telemetry_step``: optional step index (host int or traced scalar)
     attached to the per-bucket ``health/`` events so replicated per-shard
     emissions collapse in summarize's (name, step) dedup and the series
-    lines up with the overflow/loss timelines."""
+    lines up with the overflow/loss timelines.
+
+    ``reduce_dtype`` (bf16/fp16) compresses each bucket to a 16-bit wire
+    format for the collective with the mean pre-scaled in before the cast
+    (fp32 accumulation downstream — the overlap engine's numerics
+    contract, docs/overlap.md); ``adasum=True`` replaces the mean with
+    adaptive summation (arXiv:2006.02924). Both are implemented by
+    :mod:`apex_tpu.parallel.overlap`; with both at their defaults this
+    function traces the exact pre-overlap program (pinned by
+    tests/test_overlap.py's jaxpr-equality test). For collectives
+    overlapped with backward COMPUTE, see ``overlap.sync_in_backward`` /
+    ``DistributedDataParallel(overlap=True)``."""
+    from apex_tpu.parallel import overlap as _overlap
+    reduce_dtype = _overlap.resolve_reduce_dtype(reduce_dtype)
+    _overlap.validate_comm_args(
+        reduce_dtype=reduce_dtype, adasum=adasum,
+        allreduce_always_fp32=allreduce_always_fp32,
+        axis_index_groups=axis_index_groups,
+        gradient_average=gradient_average)
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if not leaves:
         return grads
@@ -95,30 +115,24 @@ def allreduce_gradients(
     buckets = _buckets.assign_buckets(leaves, message_size)
     tune.warn_bucket_count("ddp", len(buckets), message_size)
 
-    from apex_tpu import telemetry
-    if telemetry.enabled():
-        # trace-time static accounting: what this call will move per step,
-        # per device (itemsize after the optional fp32 upcast). The wire
-        # estimate is the ring all-reduce bill; summarize groups it with
-        # the other per-axis comm producers.
-        import numpy as _np
-        nbytes = sum(
-            int(_np.prod(l.shape)) * (4 if allreduce_always_fp32
-                                      else _np.dtype(l.dtype).itemsize)
-            for l in leaves)
-        telemetry.record_static(
-            f"ddp/{axis_name}/allreduce_bytes", nbytes,
-            meta={"axis": axis_name, "primitive": "psum",
-                  "count": len(buckets), "world": world,
-                  "bytes_wire": round(nbytes * 2 * (world - 1) / world)},
-            dedup_key=(axis_name, nbytes, len(buckets), world))
+    # trace-time static accounting: what this call will move per step,
+    # per device (itemsize after the optional fp32 upcast / wire
+    # compression), with the wire bill under the active algorithm (ring
+    # all-reduce or adasum's pairwise levels). Shared with the staged
+    # overlap path so both bill identically; no-op unless telemetry is on.
+    _overlap.record_comm_event(
+        axis_name, leaves, world=world, n_buckets=len(buckets),
+        reduce_dtype=reduce_dtype, adasum=adasum,
+        allreduce_always_fp32=allreduce_always_fp32,
+        axis_index_groups=axis_index_groups)
 
-    predivide = gradient_predivide_factor if gradient_average else 1.0
-    postdivide = (world / gradient_predivide_factor
-                  if gradient_average else 1.0)
-
-    from apex_tpu.telemetry import health as _health
-    health_on = _health.enabled()
+    # averaging divides: with compression/adasum off these are exactly
+    # the pre-overlap predivide/postdivide pair; compression folds the
+    # mean into the pre-cast divide (pre-scaling) and adasum skips both
+    predivide, postdivide = _overlap.compression_divides(
+        world=world, reduce_dtype=reduce_dtype, adasum=adasum,
+        gradient_average=gradient_average,
+        gradient_predivide_factor=gradient_predivide_factor)
 
     out: list = [None] * len(leaves)
     for bi, (_, idxs) in enumerate(buckets):
@@ -126,28 +140,19 @@ def allreduce_gradients(
         orig_dtype = flat.dtype
         if allreduce_always_fp32 and orig_dtype != jnp.float32:
             flat = flat.astype(jnp.float32)
-        if predivide != 1.0:
-            flat = flat / predivide
-        psum = functools.partial(jax.lax.psum, axis_name=axis_name,
-                                 axis_index_groups=axis_index_groups)
-        if 0 < message_size < flat.shape[0]:
-            # oversize single leaf: chunked psum for message sizing
-            chunks = [psum(flat[i:i + message_size])
-                      for i in range(0, flat.shape[0], message_size)]
-            flat = jnp.concatenate(chunks)
-        else:
-            flat = psum(flat)
-        if postdivide != 1.0:
-            flat = flat / postdivide
-        if health_on:
-            # numerics health: per-bucket grad norm off the already
-            # reduced flat view — the synced gradient the optimizer will
-            # actually consume. One fused reduction per bucket; nothing
-            # traced when health is off.
-            telemetry.record(
-                f"health/ddp/bucket{bi}/grad_norm",
-                jnp.sqrt(jnp.sum(jnp.square(flat.astype(jnp.float32)))),
-                step=telemetry_step)
+        # one shared bucket reduction for every config (overlap engine):
+        # predivide -> (wire cast) -> chunked psum / adasum -> fp32 ->
+        # postdivide -> per-bucket health grad norm. With the knobs at
+        # their defaults this traces the exact pre-overlap op sequence
+        # (pinned by tests/test_overlap.py's jaxpr-equality tests).
+        flat = _overlap.reduce_bucket(
+            flat, axis_name, message_size=message_size,
+            reduce_dtype=reduce_dtype, adasum=adasum,
+            predivide=predivide, postdivide=postdivide,
+            axis_index_groups=axis_index_groups,
+            bucket_index=bi, n_buckets=len(buckets),
+            telemetry_step=telemetry_step,
+            health_name=f"health/ddp/bucket{bi}/grad_norm")
         if flat.dtype != orig_dtype:
             flat = flat.astype(orig_dtype)
         for i, t in zip(idxs, _buckets.unflatten_tensors(flat, spec)):
@@ -177,42 +182,89 @@ class DistributedDataParallel:
     grads::
 
         ddp = DistributedDataParallel(axis_name="data",
-                                      message_size=2**25,
                                       allreduce_always_fp32=True)
         grad_fn = ddp.wrap_grad_fn(jax.grad(loss_fn))
         # inside shard_map: grads come back pre-averaged
+
+    Bucket capacity: ``message_size=None`` (the default) resolves through
+    ``apex_tpu.tune`` — the frozen ``2**23`` elements under the default
+    ``APEX_TPU_TUNE=off`` policy (``tune.heuristics.DDP_MESSAGE_SIZE``),
+    a cached/measured granularity under ``cache``/``auto``. An explicit
+    ``message_size=`` ALWAYS wins over the tune resolution; ``0``
+    disables bucketing (one whole-tree bucket per dtype).
+
+    ``overlap=True`` switches from post-hoc sync to the staged-backward
+    schedule: call :meth:`prepare` on the params INSIDE the loss function
+    and the gradients come out of ``jax.grad`` already reduced, with each
+    bucket's collective overlapping the remaining backward compute
+    (:func:`apex_tpu.parallel.overlap.sync_in_backward` — the reference
+    DDP's hook/side-stream overlap as dataflow). ``reduce_dtype`` /
+    ``adasum`` apply to both paths.
 
     ``delay_allreduce`` (reference :168) is expressed by calling
     ``ddp.sync(grads)`` explicitly after accumulation instead of wrapping.
     """
 
-    # Default bucket capacity (None) resolves through apex_tpu.tune: the
-    # frozen 2**23 under APEX_TPU_TUNE=off — mirroring the reference's
-    # message_size=1e7 elements (distributed.py:177): big enough that ICI
-    # bandwidth is saturated, small enough that several buckets overlap.
     def __init__(self, axis_name: str = "data", *,
                  message_size: Optional[int] = None,
                  allreduce_always_fp32: bool = False,
                  gradient_average: bool = True,
                  gradient_predivide_factor: float = 1.0,
-                 axis_index_groups=None, prof: bool = False):
+                 axis_index_groups=None, prof: bool = False,
+                 overlap: bool = False, reduce_dtype=None,
+                 adasum: bool = False):
+        from apex_tpu.parallel import overlap as _overlap
         self.axis_name = axis_name
         self.prof = prof
+        self.overlap = overlap
+        # resolve + validate at construction — a bad wire dtype or a
+        # contradictory combination fails here, not at first trace
+        reduce_dtype = _overlap.resolve_reduce_dtype(reduce_dtype)
+        _overlap.validate_comm_args(
+            reduce_dtype=reduce_dtype, adasum=adasum,
+            allreduce_always_fp32=allreduce_always_fp32,
+            axis_index_groups=axis_index_groups,
+            gradient_average=gradient_average)
         self._kw = dict(message_size=message_size,
                         allreduce_always_fp32=allreduce_always_fp32,
                         gradient_average=gradient_average,
                         gradient_predivide_factor=gradient_predivide_factor,
-                        axis_index_groups=axis_index_groups)
+                        axis_index_groups=axis_index_groups,
+                        reduce_dtype=reduce_dtype, adasum=adasum)
 
-    def sync(self, grads: Tree) -> Tree:
+    def sync(self, grads: Tree, *, telemetry_step=None) -> Tree:
         if self.prof:
             # reference DDP prof=True brackets its hook/bucket logic with
             # NVTX ranges (distributed.py:360-364,517-518); here the named
             # scope tags the collective in XLA metadata/profiler traces
             with jax.named_scope("apex_ddp_allreduce"):
                 return allreduce_gradients(grads, self.axis_name,
+                                           telemetry_step=telemetry_step,
                                            **self._kw)
-        return allreduce_gradients(grads, self.axis_name, **self._kw)
+        return allreduce_gradients(grads, self.axis_name,
+                                   telemetry_step=telemetry_step,
+                                   **self._kw)
+
+    def prepare(self, params: Tree, *, telemetry_step=None) -> Tree:
+        """Overlap staging: identity on ``params`` whose cotangents come
+        back bucket-reduced from the backward itself. Call inside the
+        loss function; with ``overlap=False`` this is a plain passthrough
+        (use :meth:`sync` on the grads instead)."""
+        if not self.overlap:
+            return params
+        from apex_tpu.parallel import overlap as _overlap
+        return _overlap.sync_in_backward(
+            params, self.axis_name, telemetry_step=telemetry_step,
+            **self._kw)
+
+    def wrap_loss_fn(self, loss_fn: Callable) -> Callable:
+        """Wrap ``loss_fn(params, *args)`` so its first argument is
+        routed through :meth:`prepare` — differentiate the result and
+        the grads arrive pre-synchronized via the overlap schedule."""
+        @functools.wraps(loss_fn)
+        def wrapped(params, *args, **kwargs):
+            return loss_fn(self.prepare(params), *args, **kwargs)
+        return wrapped
 
     def wrap_grad_fn(self, grad_fn: Callable) -> Callable:
         @functools.wraps(grad_fn)
@@ -250,8 +302,15 @@ def ddp_train_step(
     ddp = ddp or DistributedDataParallel(axis_name)
 
     def per_device(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        grads = ddp.sync(grads)
+        if ddp.overlap:
+            # staged-backward schedule: grads leave value_and_grad
+            # already reduced, each bucket's collective overlapping the
+            # remaining backward compute
+            loss, grads = jax.value_and_grad(
+                ddp.wrap_loss_fn(loss_fn))(params, batch)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            grads = ddp.sync(grads)
         loss = jax.lax.pmean(loss, axis_name)
         new_params, new_opt_state = optimizer.step(grads, params, opt_state)
         return new_params, new_opt_state, loss

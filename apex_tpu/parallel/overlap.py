@@ -1,0 +1,496 @@
+"""Overlap engine: backward/collective pipelining + gradient compression
+for the DDP/ZeRO communication paths.
+
+Three legs, composable independently (ROADMAP item 1):
+
+  * **Backward/collective overlap** — :func:`sync_in_backward` wraps the
+    parameter tree in per-bucket identity ``custom_vjp``\\ s
+    (:mod:`apex_tpu.ops.staged_vjp`) so each bucket's gradient collective
+    is an equation *inside the backward graph* that depends only on that
+    bucket's cotangents. Bucket *k*'s ``psum`` can therefore be issued
+    while bucket *k+1*'s backward compute runs — the reference Apex DDP's
+    per-param-hook + side-stream overlap (distributed.py:320-557),
+    expressed as dataflow for XLA's latency-hiding scheduler. Bucket
+    granularity resolves through ``apex_tpu.tune`` (op ``ddp_overlap``).
+
+  * **Wire compression** — ``reduce_dtype`` (bf16/fp16) casts each
+    bucket to a 16-bit wire format for the collective and returns to the
+    original dtype after, halving ``bytes_wire``. Numerics contract
+    (*pre-scaling*): the full mean divide is folded in *before* the cast,
+    so wire-dtype partial sums carry mean-gradient magnitude — fp16 wire
+    stays in range even under a 2^16 amp loss scale, and a true overflow
+    saturates to Inf which the amp scaler's non-finite check catches (the
+    step is skipped and the scale backs off — O2/O5 stay
+    loss-scale-correct). bf16 shares fp32's exponent range, so bf16 wire
+    is range-safe at any loss scale and costs only mantissa (~3 decimal
+    digits on the per-bucket mean).
+
+  * **Adasum** — ``adasum=True`` replaces the mean with adaptive
+    summation ("Scaling Distributed Training with Adaptive Summation",
+    arXiv:2006.02924): recursive pairwise combination where each pair
+    contributes ``(1 - g1·g2/(2|g1|²)) g1 + (1 - g1·g2/(2|g2|²)) g2`` —
+    the sum when gradients are orthogonal, the common value (== the mean)
+    when they are parallel. Magnitude adapts to gradient agreement, which
+    is what lets large-batch data parallel keep per-replica learning
+    rates. The operation is scale-invariant (``adasum(S·g) == S·adasum(g)``),
+    so amp loss scaling composes: unscaling after reduction is exact.
+    Requires a power-of-two axis size; wire cost is ``log2(n) ×
+    bytes_in`` (one pair-allreduce per level) vs the ring all-reduce's
+    ``2(n-1)/n`` — Adasum trades wire bytes for convergence, and the
+    telemetry bill reports it honestly.
+
+Observability: when telemetry is enabled and a step index is supplied,
+per-bucket issue/completion host timestamps are recorded around each
+staged collective and a ``ddp/overlap_efficiency`` event (fraction of
+total per-bucket comm time hidden behind remaining compute) is emitted
+per step; ``telemetry summarize`` renders it. Timestamps come from
+``jax.debug.callback`` arrival on the host — an estimate of the device
+schedule, not a profiler truth, but enough to see overlap collapse when
+a config serializes.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import threading
+import time
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import buckets as _buckets
+from apex_tpu.ops import staged_vjp as _staged
+from apex_tpu.parallel.mesh import bound_axis_size
+
+Tree = Any
+
+# accepted spellings -> canonical dtype name. 16-bit floats only: an 8-bit
+# wire format would need error feedback state this engine does not keep,
+# and a 32-bit "compression" is the identity.
+_WIRE_DTYPES = {
+    "bf16": "bfloat16", "bfloat16": "bfloat16",
+    "fp16": "float16", "float16": "float16", "half": "float16",
+}
+
+
+def resolve_reduce_dtype(reduce_dtype):
+    """None, a spelling ('bf16', 'fp16', 'bfloat16', 'float16'), or a
+    dtype-like -> canonical ``jnp.dtype`` (or None). Anything that is not
+    a 16-bit float wire format raises."""
+    if reduce_dtype is None:
+        return None
+    name = (reduce_dtype if isinstance(reduce_dtype, str)
+            else jnp.dtype(reduce_dtype).name)
+    canon = _WIRE_DTYPES.get(name.lower())
+    if canon is None:
+        raise ValueError(
+            f"reduce_dtype must be a 16-bit float wire format "
+            f"({sorted(set(_WIRE_DTYPES))}) or None; got {reduce_dtype!r}")
+    return jnp.dtype(canon)
+
+
+def validate_comm_args(*, reduce_dtype, adasum: bool,
+                       allreduce_always_fp32: bool = False,
+                       axis_index_groups=None,
+                       gradient_average: bool = True) -> None:
+    """Shared argument validation for the compressed/adasum paths —
+    raised at construction/trace time with the conflict named, not deep
+    inside XLA."""
+    if reduce_dtype is not None and allreduce_always_fp32:
+        raise ValueError(
+            "reduce_dtype and allreduce_always_fp32 are contradictory: "
+            "one compresses the wire format, the other forces it to "
+            "fp32 — pick one")
+    if adasum and axis_index_groups is not None:
+        raise ValueError(
+            "adasum builds its own pairwise axis_index_groups per "
+            "recursion level and cannot compose with caller-supplied "
+            "groups — run adasum over a dedicated mesh axis instead")
+    if adasum and not gradient_average:
+        raise ValueError(
+            "adasum replaces the gradient combiner entirely — it cannot "
+            "honor gradient_average=False sum semantics (shard "
+            "contributions would come out ~world x too small with no "
+            "diagnostic); use a plain psum for summed contributions")
+
+
+def wire_multiplier(world: int, *, adasum: bool) -> float:
+    """Per-device interconnect bytes per payload byte: ring all-reduce
+    ``2(n-1)/n``, Adasum ``log2(n)`` (one pair-allreduce per level)."""
+    if world <= 1:
+        return 0.0
+    if adasum:
+        return float(math.log2(world))
+    return 2.0 * (world - 1) / world
+
+
+# ---------------------------------------------------------------------------
+# overlap-efficiency tracker (host side)
+# ---------------------------------------------------------------------------
+
+def overlap_efficiency(issues: dict, dones: dict) -> Optional[float]:
+    """Fraction of per-bucket comm time hidden behind remaining backward
+    work, from per-bucket issue/done timestamps (``{bucket: t}``).
+
+    A bucket's in-flight window counts as *hidden* only up to the latest
+    OTHER bucket's issue falling inside it — another issue landing while
+    this collective is in flight is direct evidence the backward was
+    still producing work concurrently. This makes the two failure modes
+    read as failures: a serialized schedule (compute blocked on each
+    collective, so no issue ever lands inside another's window) scores
+    ~0, and the all-comm-after-backward barrier (issues clustered at the
+    step tail with nothing left to compute) also scores ~0. Returns
+    None when no bucket has a positive window. Clamped to [0, 1]."""
+    common = [b for b in dones if b in issues]
+    total = sum(dones[b] - issues[b] for b in common)
+    if total <= 0.0:
+        return None
+    issue_times = sorted(issues[b] for b in common)
+    hidden = 0.0
+    for b in common:
+        t0, t1 = issues[b], dones[b]
+        inside = [t for t in issue_times if t0 < t <= t1]
+        if inside:
+            hidden += inside[-1] - t0
+    return min(1.0, max(0.0, hidden / total))
+
+
+class _OverlapTracker:
+    """Collects per-bucket issue/done host timestamps and emits one
+    ``ddp/overlap_efficiency`` event per step once every bucket reported.
+
+    Under shard_map the callbacks fire once per shard; the first arrival
+    per (step, bucket, phase) wins and replicas are ignored, so the
+    emitted series needs no downstream dedup. The metric is
+    :func:`overlap_efficiency` over the step's bucket timestamps."""
+
+    _MAX_STEPS = 64     # bound memory if done-marks never complete
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._steps: dict = {}
+
+    def mark(self, step: int, bucket: int, n_buckets: int,
+             phase: str) -> None:
+        now = time.perf_counter()
+        emit = None
+        with self._lock:
+            rec = self._steps.setdefault(step, {"issue": {}, "done": {}})
+            d = rec[phase]
+            if bucket in d:
+                return      # per-shard replica: first arrival wins
+            d[bucket] = now
+            if (phase == "done" and len(rec["done"]) >= n_buckets
+                    and len(rec["issue"]) >= n_buckets):
+                emit = rec
+                self._steps.pop(step, None)
+            elif len(self._steps) > self._MAX_STEPS:
+                self._steps.pop(next(iter(self._steps)), None)
+        if emit is not None:
+            self._emit(step, emit)
+
+    @staticmethod
+    def _emit(step: int, rec: dict) -> None:
+        eff = overlap_efficiency(rec["issue"], rec["done"])
+        if eff is None:
+            return
+        from apex_tpu import telemetry
+        telemetry.record("ddp/overlap_efficiency", eff, step=step,
+                         meta={"buckets": len(rec["done"])})
+
+    def reset(self) -> None:
+        with self._lock:
+            self._steps.clear()
+
+
+_tracker = _OverlapTracker()
+
+
+def _mark_cb(_dep, step, *, bucket: int, n_buckets: int,
+             phase: str) -> None:
+    import numpy as _np
+    _tracker.mark(int(_np.asarray(step)), bucket, n_buckets, phase)
+
+
+def _mark(dep: jax.Array, step, bucket: int, n_buckets: int,
+          phase: str) -> None:
+    """Record a host timestamp ordered after ``dep`` materializes — the
+    issue/done brackets around one bucket's collective."""
+    jax.debug.callback(
+        functools.partial(_mark_cb, bucket=bucket, n_buckets=n_buckets,
+                          phase=phase),
+        dep.reshape(-1)[0], step)
+
+
+# ---------------------------------------------------------------------------
+# flat-bucket reductions
+# ---------------------------------------------------------------------------
+
+def adasum_flat(flat: jax.Array, axis_name: str, *,
+                reduce_dtype=None) -> jax.Array:
+    """Adaptive summation of ``flat`` across the mesh axis by recursive
+    pairwise combination (arXiv:2006.02924, Alg. 1 lifted onto
+    ``axis_index_groups``).
+
+    Level *l* pairs devices whose axis index differs in bit *l*; the pair
+    total arrives via a 2-member grouped ``psum`` and the partner's
+    contribution is recovered as ``total - own``. Both pair members
+    compute the combination from the SAME quantized views (own is read
+    back through the wire dtype when compressing), and the formula is
+    symmetric, so the result stays replica-consistent bitwise. Dot
+    products and the combination always run in fp32."""
+    world = bound_axis_size(axis_name)
+    if world == 1:
+        return flat
+    if world & (world - 1):
+        raise ValueError(
+            f"adasum requires a power-of-two axis size (recursive "
+            f"pairwise halving); axis {axis_name!r} has size {world}")
+    wire_dt = resolve_reduce_dtype(reduce_dtype)
+    acc = flat.astype(jnp.float32)
+    for level in range(world.bit_length() - 1):
+        stride = 1 << level
+        span = stride * 2
+        groups = [[b * span + j, b * span + j + stride]
+                  for b in range(world // span) for j in range(stride)]
+        if wire_dt is None:
+            wire = acc
+        else:
+            # per-level pre-scaling: halve before the cast so the pair
+            # psum of two near-max values stays in the wire dtype's
+            # range (fp16: two elements at 40k would sum to Inf raw);
+            # the combination is scale-invariant and linear, so doubling
+            # the result after restores magnitude exactly (x0.5/x2 are
+            # power-of-two exact in every float format)
+            wire = (acc * 0.5).astype(wire_dt)
+        total = jax.lax.psum(wire, axis_name, axis_index_groups=groups)
+        own = wire.astype(jnp.float32)
+        other = total.astype(jnp.float32) - own
+        dot = jnp.sum(own * other)
+        n_own = jnp.sum(own * own)
+        n_oth = jnp.sum(other * other)
+        a = jnp.where(n_own > 0.0, dot / (2.0 * n_own), 0.0)
+        b = jnp.where(n_oth > 0.0, dot / (2.0 * n_oth), 0.0)
+        acc = (1.0 - a) * own + (1.0 - b) * other
+        if wire_dt is not None:
+            acc = acc * 2.0
+    return acc.astype(flat.dtype)
+
+
+def compression_divides(*, world: int, reduce_dtype, adasum: bool,
+                        gradient_average: bool,
+                        gradient_predivide_factor: float,
+                        ) -> Tuple[float, float]:
+    """(predivide, postdivide) for one bucket reduction.
+
+    Base semantics mirror ``allreduce_gradients``: divide by
+    ``gradient_predivide_factor`` before and ``world / factor`` after
+    when averaging. With ``reduce_dtype`` the FULL mean folds into the
+    pre-cast divide (pre-scaling — see the module numerics contract) so
+    postdivide collapses to 1; a pure sum (``gradient_average=False``)
+    pre-scales by ``world`` and multiplies it back after. Adasum ignores
+    averaging knobs entirely: its magnitude is the adaptive point of the
+    algorithm (compression pre-scaling happens per level inside
+    :func:`adasum_flat`, scale-invariance makes it neutral)."""
+    if adasum:
+        return 1.0, 1.0
+    predivide = gradient_predivide_factor if gradient_average else 1.0
+    postdivide = (world / gradient_predivide_factor
+                  if gradient_average else 1.0)
+    if reduce_dtype is not None:
+        predivide = predivide * postdivide if gradient_average else float(
+            world)
+        postdivide = 1.0 if gradient_average else 1.0 / world
+    return predivide, postdivide
+
+
+def reduce_bucket(flat: jax.Array, axis_name: str, *,
+                  message_size: int = 0,
+                  reduce_dtype=None, adasum: bool = False,
+                  predivide: float = 1.0, postdivide: float = 1.0,
+                  axis_index_groups=None,
+                  bucket_index: int = 0, n_buckets: int = 1,
+                  telemetry_step=None, track: bool = False,
+                  health_name: Optional[str] = None) -> jax.Array:
+    """Reduce one flat same-dtype bucket across ``axis_name`` under the
+    engine's compression/adasum options. Returns the reduced bucket in
+    the input dtype. ``track=True`` brackets the collective with the
+    overlap-tracker timestamps (requires a ``telemetry_step``)."""
+    orig_dtype = flat.dtype
+    wire_dt = resolve_reduce_dtype(reduce_dtype)
+    do_track = track and telemetry_step is not None
+    if do_track:
+        _mark(flat, telemetry_step, bucket_index, n_buckets, "issue")
+    if predivide != 1.0:
+        flat = flat / predivide
+    if adasum:
+        red = adasum_flat(flat, axis_name, reduce_dtype=wire_dt)
+    else:
+        wire = flat if wire_dt is None or flat.dtype == wire_dt \
+            else flat.astype(wire_dt)
+        psum = functools.partial(jax.lax.psum, axis_name=axis_name,
+                                 axis_index_groups=axis_index_groups)
+        if 0 < message_size < wire.shape[0]:
+            # oversize single leaf: chunked psum for message sizing
+            red = jnp.concatenate(
+                [psum(wire[i:i + message_size])
+                 for i in range(0, wire.shape[0], message_size)])
+        else:
+            red = psum(wire)
+        if wire_dt is not None and red.dtype != jnp.float32:
+            # fp32 accumulation of everything downstream of the wire:
+            # postdivide, health norms, and the caller's unscale/update
+            red = red.astype(jnp.float32)
+    if postdivide != 1.0:
+        red = red / postdivide
+    if do_track:
+        _mark(red, telemetry_step, bucket_index, n_buckets, "done")
+    if health_name is not None:
+        from apex_tpu import telemetry
+        from apex_tpu.telemetry import health as _health
+        if _health.enabled():
+            telemetry.record(
+                health_name,
+                jnp.sqrt(jnp.sum(jnp.square(red.astype(jnp.float32)))),
+                step=telemetry_step)
+    if red.dtype != orig_dtype:
+        red = red.astype(orig_dtype)
+    return red
+
+
+# ---------------------------------------------------------------------------
+# the staged-backward entry point
+# ---------------------------------------------------------------------------
+
+def record_comm_event(axis_name: str, leaves: Sequence[jax.Array], *,
+                      world: int, n_buckets: int, reduce_dtype,
+                      adasum: bool, allreduce_always_fp32: bool = False,
+                      overlap: bool = False,
+                      axis_index_groups=None) -> None:
+    """Static telemetry: the per-device bytes this reduction will move
+    per step, with the wire bill under the active compression/algorithm.
+    Shared by ``allreduce_gradients`` and :func:`sync_in_backward` so the
+    two paths bill identically. ``axis_index_groups`` restricts the ring
+    to a replica subset: the wire bill uses the GROUP world, matching
+    the jaxpr comm walker's grouped accounting."""
+    from apex_tpu import telemetry
+    if not telemetry.enabled():
+        return
+    import numpy as _np
+    if axis_index_groups is not None:
+        try:
+            world = len(axis_index_groups[0]) or world
+        except Exception:
+            pass
+    wire_dt = resolve_reduce_dtype(reduce_dtype)
+    def itemsize(leaf):
+        if wire_dt is not None:
+            return wire_dt.itemsize
+        if allreduce_always_fp32:
+            return 4
+        return _np.dtype(leaf.dtype).itemsize
+    nbytes = sum(int(_np.prod(leaf.shape) if leaf.shape else 1)
+                 * itemsize(leaf) for leaf in leaves)
+    meta = {"axis": axis_name, "primitive": "psum", "count": n_buckets,
+            "world": world,
+            "bytes_wire": round(nbytes * wire_multiplier(world,
+                                                         adasum=adasum))}
+    if wire_dt is not None:
+        meta["reduce_dtype"] = wire_dt.name
+    if adasum:
+        meta["adasum"] = True
+    if overlap:
+        meta["overlap"] = True
+    telemetry.record_static(
+        f"ddp/{axis_name}/allreduce_bytes", nbytes, meta=meta,
+        dedup_key=(axis_name, nbytes, n_buckets, world, bool(adasum),
+                   None if wire_dt is None else wire_dt.name,
+                   bool(overlap)))
+
+
+def sync_in_backward(params: Tree, axis_name: str = "data", *,
+                     message_size: Optional[int] = None,
+                     reduce_dtype=None, adasum: bool = False,
+                     allreduce_always_fp32: bool = False,
+                     gradient_average: bool = True,
+                     gradient_predivide_factor: float = 1.0,
+                     axis_index_groups=None,
+                     telemetry_step=None) -> Tree:
+    """Identity on ``params``; their cotangents come back bucket-reduced.
+
+    Call INSIDE the loss function (within the shard_map/pmap context that
+    binds ``axis_name``), on the params the model will consume::
+
+        def loss_fn(params, batch):
+            params = overlap.sync_in_backward(params, "data")
+            return model_loss(params, batch)
+
+        grads = jax.grad(loss_fn)(params, batch)   # already averaged
+
+    Each bucket's collective is staged into the backward at the point its
+    gradients finalize (see :mod:`apex_tpu.ops.staged_vjp`), so XLA can
+    overlap bucket *k*'s ``psum`` with bucket *k+1*'s backward compute.
+    Reduction semantics (bucketing, averaging, predivide, fp32 upcast,
+    ``reduce_dtype`` / ``adasum``) match ``allreduce_gradients`` — the
+    two paths are interchangeable numerically; this one overlaps.
+
+    ``message_size=None`` resolves through ``apex_tpu.tune`` (op
+    ``ddp_overlap``; the frozen 2**23 under the default ``off`` policy).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    if not leaves:
+        return params
+    world = bound_axis_size(axis_name)
+    wire_dt = resolve_reduce_dtype(reduce_dtype)
+    validate_comm_args(reduce_dtype=wire_dt, adasum=adasum,
+                       allreduce_always_fp32=allreduce_always_fp32,
+                       axis_index_groups=axis_index_groups,
+                       gradient_average=gradient_average)
+    from apex_tpu import tune
+    if message_size is None:
+        total = sum(int(leaf.size) for leaf in leaves)
+        message_size = tune.ddp_overlap_message_size(total=total,
+                                                     world=world)
+    elif message_size < 0:
+        raise ValueError(
+            f"sync_in_backward: message_size must be >= 1 (or 0 to "
+            f"disable bucketing, or None to resolve via apex_tpu.tune); "
+            f"got {message_size}")
+    buckets = _buckets.assign_buckets(leaves, message_size)
+    tune.warn_bucket_count("ddp", len(buckets), message_size)
+    record_comm_event(axis_name, leaves, world=world,
+                      n_buckets=len(buckets), reduce_dtype=wire_dt,
+                      adasum=adasum,
+                      allreduce_always_fp32=allreduce_always_fp32,
+                      overlap=True, axis_index_groups=axis_index_groups)
+    predivide, postdivide = compression_divides(
+        world=world, reduce_dtype=wire_dt, adasum=adasum,
+        gradient_average=gradient_average,
+        gradient_predivide_factor=gradient_predivide_factor)
+    from apex_tpu import telemetry
+    track = telemetry.enabled()
+
+    def make_transform(bi: int, n: int):
+        def transform(cotangents: Tuple) -> List[jax.Array]:
+            flat, spec = _buckets.flatten_tensors(list(cotangents))
+            orig_dtype = flat.dtype
+            if allreduce_always_fp32 and orig_dtype != jnp.float32:
+                flat = flat.astype(jnp.float32)
+            flat = reduce_bucket(
+                flat, axis_name, message_size=message_size,
+                reduce_dtype=wire_dt, adasum=adasum,
+                predivide=predivide, postdivide=postdivide,
+                axis_index_groups=axis_index_groups,
+                bucket_index=bi, n_buckets=n,
+                telemetry_step=telemetry_step, track=track,
+                health_name=f"health/ddp/bucket{bi}/grad_norm")
+            if flat.dtype != orig_dtype:
+                flat = flat.astype(orig_dtype)
+            return _buckets.unflatten_tensors(flat, spec)
+        return transform
+
+    wrapped = _staged.apply_staged(
+        leaves, [idxs for _, idxs in buckets], make_transform)
+    return jax.tree_util.tree_unflatten(treedef, wrapped)

@@ -124,6 +124,17 @@ def _accumulate(jaxpr, mult: int, in_while: bool,
             for name in names:
                 n = axis_sizes.get(name)
                 world = None if n is None or world is None else world * n
+            # grouped collective: the ring runs within one replica
+            # subset, so the effective world is the GROUP size, not the
+            # axis size (and it is known even when the axis size is not
+            # discoverable — adasum's pairwise levels bill as 2-member
+            # all-reduces, not full-axis ones)
+            groups = eqn.params.get("axis_index_groups")
+            if groups is not None:
+                try:
+                    world = len(groups[0]) or None
+                except Exception:
+                    pass
             for name in names:
                 rec = stats.setdefault(
                     (name, prim), CommRecord(axis=name, primitive=prim))

@@ -312,6 +312,13 @@ def summarize(events: List[Dict[str, Any]], *,
         if vals:
             out[key] = _series_stats(vals)
 
+    # overlap engine: fraction of per-bucket comm time hidden behind the
+    # remaining backward compute (producer: parallel.overlap's tracker)
+    eff = [v for name, vs in series.items()
+           if name.endswith("ddp/overlap_efficiency") for v in vs]
+    if eff:
+        out["overlap_efficiency"] = _series_stats(eff)
+
     # amp: overflow rate + loss-scale timeline
     overflow = [v for name, vs in series.items()
                 if name.endswith("amp/overflow") for v in vs]
@@ -601,6 +608,11 @@ def format_summary(s: Dict[str, Any]) -> str:
     if s.get("mfu"):
         lines.append(f"{'MFU':<14} mean {s['mfu']['mean']:.1%}"
                      f"   p50 {s['mfu']['p50']:.1%}")
+    if s.get("overlap_efficiency"):
+        e = s["overlap_efficiency"]
+        lines.append(f"{'overlap eff':<14} mean {e['mean']:.1%}"
+                     f"   p50 {e['p50']:.1%}"
+                     " (comm hidden behind backward compute)")
     if s.get("overflow"):
         o = s["overflow"]
         lines.append(f"{'overflow':<14} {o['overflows']}/{o['steps']} steps"

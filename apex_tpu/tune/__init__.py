@@ -135,6 +135,23 @@ def ddp_message_size(*, total: int, world: int) -> int:
     return v if v >= 1 else heuristics.DDP_MESSAGE_SIZE
 
 
+def ddp_overlap_message_size(*, total: int, world: int) -> int:
+    """Bucket capacity (elements) for the staged-backward overlap
+    schedule (``overlap.sync_in_backward``). Own cache key (op
+    ``ddp_overlap``): the overlap sweet spot can differ from the
+    post-hoc ``ddp_message_size`` because each bucket's collective
+    overlaps the remaining backward compute."""
+    cfg, _ = resolve("ddp_overlap",
+                     {"total": shape_bucket(total), "world": int(world)})
+    try:
+        v = int(cfg["message_size"])
+    except (KeyError, TypeError, ValueError):
+        return heuristics.DDP_MESSAGE_SIZE
+    # see ddp_message_size: a cache entry can never silently disable
+    # bucketing (0 stays an explicit caller-only value)
+    return v if v >= 1 else heuristics.DDP_MESSAGE_SIZE
+
+
 def zero_chunk_elements(*, total: int, world: int) -> int:
     """Bucket capacity (elements) for the ZeRO scatter/gather layout.
 

@@ -129,5 +129,15 @@ def ddp_message_size(key: Dict) -> Dict:
     return {"message_size": DDP_MESSAGE_SIZE}
 
 
+def ddp_overlap(key: Dict) -> Dict:
+    # The staged-backward (overlap) schedule reuses the post-hoc bucket
+    # capacity as its seed: granularity trades the same way (big enough
+    # to saturate ICI, small enough that several buckets pipeline with
+    # backward), but the sweet spot can differ because each bucket's
+    # collective now races the REMAINING backward compute — which is why
+    # it gets its own sweep key instead of aliasing ddp_message_size.
+    return {"message_size": DDP_MESSAGE_SIZE}
+
+
 def zero_chunk_elements(key: Dict) -> Dict:
     return {"chunk_elements": ZERO_CHUNK_ELEMENTS}

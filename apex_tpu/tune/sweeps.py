@@ -275,6 +275,50 @@ def _ddp_runner(key: Key, cfg: Config) -> Optional[Callable]:
     return lambda: run(*leaves)
 
 
+def _ddp_overlap_runner(key: Key, cfg: Config) -> Optional[Callable]:
+    """Staged-backward overlap step: a chained-matmul loss whose params
+    route through ``overlap.sync_in_backward``, so the measured quantity
+    is backward compute WITH the per-bucket collectives staged inside it
+    — bucket granularity trades collective latency against how much
+    backward remains to hide it behind, which a bare allreduce sweep
+    (``ddp_message_size``) cannot see."""
+    import jax
+    if len(jax.devices()) < 2:
+        return None     # no second device: nothing overlaps
+    world = int(key["world"])
+    if world != len(jax.devices()):
+        return None     # measurement must match the keyed world size
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+    from apex_tpu.parallel import overlap as _ov
+    total = min(int(key["total"]), 2 ** 25)
+    # ~16 chained square layers: a backward long enough to hide buckets in
+    n_layers = 16
+    side = max(128, int(round((total / n_layers) ** 0.5)) // 128 * 128)
+    keys = jax.random.split(jax.random.PRNGKey(0), n_layers + 1)
+    ws = [jax.random.normal(k, (side, side)) * (1.0 / side ** 0.5)
+          for k in keys[:-1]]
+    x = jax.random.normal(keys[-1], (8 * len(jax.devices()), side))
+    mesh = Mesh(np.asarray(jax.devices()).reshape(-1), ("data",))
+    msg = int(cfg["message_size"])
+
+    def step(ws, x):
+        def loss(ws):
+            ws = _ov.sync_in_backward(ws, "data", message_size=msg)
+            h = x
+            for w in ws:
+                h = jnp.tanh(h @ w)
+            return jnp.mean(h * h)
+        return jax.grad(loss)(ws)
+
+    run = jax.jit(shard_map(step, mesh=mesh,  # apexlint: disable=APX004 -- measurement runner re-invokes on the SAME operands; donation would invalidate them
+                            in_specs=(P(), P("data")),
+                            out_specs=P(), check_vma=False))
+    return lambda: run(ws, x)
+
+
 def _bucket_sweep_keys() -> List[Key]:
     import jax
     return [{"total": 2 ** 24, "world": len(jax.devices())}]
@@ -341,6 +385,15 @@ def _registry() -> Dict[str, OpSpec]:
             runner=_ddp_runner,
             sweep_keys=_bucket_sweep_keys,
             doc="DDP allreduce bucket capacity (elements)"),
+        OpSpec(
+            name="ddp_overlap", primary="message_size",
+            heuristic=_h.ddp_overlap,
+            candidates=lambda k: _with_heuristic_first(
+                _h.ddp_overlap(k),
+                [{"message_size": m} for m in _MSG_CANDS]),
+            runner=_ddp_overlap_runner,
+            sweep_keys=_bucket_sweep_keys,
+            doc="staged-backward overlap bucket capacity (elements)"),
         OpSpec(
             name="zero_chunk_elements", primary="chunk_elements",
             heuristic=_h.zero_chunk_elements,

@@ -85,8 +85,20 @@ def main():
     from apex_tpu import tune
     if os.environ.get("BENCH_TUNE"):
         tune.set_policy("auto")
+    # Overlap engine (docs/overlap.md). BENCH_OVERLAP=0 is the A/B knob
+    # back to the post-hoc schedule: default ON stages each gradient
+    # bucket's allreduce into the backward so it overlaps the remaining
+    # backward compute (the MFU-plateau fix, ROADMAP item 1).
+    # BENCH_REDUCE_DTYPE=bf16|fp16 additionally compresses the wire;
+    # BENCH_ADASUM=1 switches to adaptive summation.
+    overlap_on = os.environ.get("BENCH_OVERLAP", "1").lower() not in (
+        "0", "false", "no", "off")
+    reduce_dtype = os.environ.get("BENCH_REDUCE_DTYPE") or None
+    adasum = os.environ.get("BENCH_ADASUM", "").lower() in (
+        "1", "true", "yes")
     log(f"bench: resnet50 amp {opt_level} batch={batch} image={image} "
-        f"on {dev}")
+        f"on {dev} overlap={overlap_on} reduce_dtype={reduce_dtype} "
+        f"adasum={adasum}")
 
     mesh = parallel.make_mesh(axis_names=("data",))
     # dtype=bf16: convs/matmuls run bf16 on the MXU (flax BatchNorm still
@@ -110,6 +122,10 @@ def main():
     params = amp.cast_model(params32, amp.resolve(opt_level))
     opt_state = aopt.init(params)
 
+    ddp = parallel.DistributedDataParallel(
+        "data", overlap=overlap_on, reduce_dtype=reduce_dtype,
+        adasum=adasum)
+
     # Resolved-config header, so every BENCH_r*.json is attributable to
     # its configs. ddp message_size (for THIS param tree) resolves under
     # the live policy — it is the knob the resnet50 step actually
@@ -126,6 +142,10 @@ def main():
         "ddp_message_size": tune.ddp_message_size(total=n_total,
                                                   world=mesh.size),
     }
+    if overlap_on:
+        # the knob the overlap schedule actually executes (own sweep key)
+        tune_cfg["ddp_overlap_message_size"] = tune.ddp_overlap_message_size(
+            total=n_total, world=mesh.size)
     if bench_policy == "auto":
         tune.set_policy("cache")
     try:
@@ -141,8 +161,22 @@ def main():
 
     def per_device(params, batch_stats, opt_state, batch):
         x, y = batch
+        # step attribution for health/overlap events = the amp EXECUTION
+        # index (overflow-skipped steps freeze inner.step; a collided id
+        # would average two different steps' samples in summarize's
+        # (name, step) dedup). Computed only when an observer needs it so
+        # the unobserved trace stays identical.
+        from apex_tpu import telemetry
+        from apex_tpu.telemetry import health as _health
+        step_idx = None
+        if _health.enabled() or (telemetry.enabled() and ddp.overlap):
+            step_idx = aopt.execution_index(opt_state)
 
         def scaled(p):
+            # overlap staging: identity on the params whose cotangents
+            # come back bucket-reduced from the backward itself, each
+            # bucket's psum overlapping the remaining backward compute
+            p = ddp.prepare(p, telemetry_step=step_idx)
             logits, updates = model.apply(
                 {"params": p, "batch_stats": batch_stats}, x, train=True,
                 mutable=["batch_stats"])
@@ -151,17 +185,8 @@ def main():
                                                       updates["batch_stats"])
 
         grads, (loss, new_bs) = jax.grad(scaled, has_aux=True)(params)
-        # step attribution for health events = the amp EXECUTION index
-        # (overflow-skipped steps freeze inner.step; a collided id would
-        # average two different steps' samples in summarize's
-        # (name, step) dedup). Computed only when health is on so the
-        # disabled trace stays identical.
-        from apex_tpu.telemetry import health as _health
-        step_idx = None
-        if _health.enabled():
-            step_idx = aopt.execution_index(opt_state)
-        grads = parallel.allreduce_gradients(grads, "data",
-                                             telemetry_step=step_idx)
+        if not ddp.overlap:
+            grads = ddp.sync(grads, telemetry_step=step_idx)
         new_params, new_opt_state, _ = aopt.step(grads, params, opt_state)
         if _health.enabled():
             # per-layer grad/weight norms + NaN/Inf counts on the synced
@@ -285,6 +310,8 @@ def main():
         "clock": "device" if img_s_dev > 0 else "wall",
         "wall_img_s": round(img_s_wall, 1),
         "tune": tune_cfg,
+        "overlap": {"enabled": overlap_on, "reduce_dtype": reduce_dtype,
+                    "adasum": adasum},
     }
     if flops_per_step:
         achieved = flops_per_step * img_s / batch

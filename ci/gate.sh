@@ -12,7 +12,7 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "== 1/9 package import =="
+echo "== 1/10 package import =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import apex_tpu
@@ -20,7 +20,7 @@ from apex_tpu import amp, optimizers, parallel, ops
 print('apex_tpu imports OK')
 "
 
-echo "== 2/9 native host runtime builds (g++ -O3 -shared) =="
+echo "== 2/10 native host runtime builds (g++ -O3 -shared) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 from apex_tpu import runtime
@@ -35,7 +35,7 @@ print('flatten/unflatten path OK')
 assert ok, 'host runtime failed to build — check g++ toolchain'
 "
 
-echo "== 3/9 graft entry compiles (single-device + 8-device dryrun) =="
+echo "== 3/10 graft entry compiles (single-device + 8-device dryrun) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import __graft_entry__ as ge
@@ -45,7 +45,7 @@ print('entry() compiles')
 ge.dryrun_multichip(8)
 "
 
-echo "== 4/9 package install (wheel build + clean --target install) =="
+echo "== 4/10 package install (wheel build + clean --target install) =="
 # The reference gates on Docker extension builds
 # (tests/docker_extension_builds/run.sh); the TPU analog: build the wheel
 # from pyproject.toml, install it into an empty --target dir, and import
@@ -88,14 +88,14 @@ jax.jit(step).lower(params, state).compile()
 print('installed-package train step compiles')
 ")
 
-echo "== 5/9 lint (apex_tpu.lint: trace safety / dtype policy / collectives) =="
+echo "== 5/10 lint (apex_tpu.lint: trace safety / dtype policy / collectives) =="
 # static gate BEFORE the test tier: AST pass over the package + graft
 # entry, jaxpr pass over the registered entry points. --strict: warnings
 # fail too (every intentional exception carries an inline suppression
 # with its why — see docs/lint.md). Use --format=github under CI bots.
 python -m apex_tpu.lint apex_tpu/ __graft_entry__.py --strict
 
-echo "== 6/9 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
+echo "== 6/10 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
 # A 3-step instrumented GPT train step on the CPU mesh must produce a
 # parseable JSONL carrying step timing, amp loss-scale/overflow, comm
 # bytes and MFU, and the summarize CLI must render it (exit 0) — the
@@ -168,7 +168,7 @@ fi
 echo "health CLI gate OK (healthy=0, injected-NaN=nonzero)"
 rm -rf "$(dirname "$HLT_FILE")"
 
-echo "== 7/9 tune smoke (sweep dry-run + auto-policy tuned train) =="
+echo "== 7/10 tune smoke (sweep dry-run + auto-policy tuned train) =="
 # The autotuner must be drivable offline (sweep plan renders, exit 0) and
 # inline: a 3-step train whose kernels resolve their configs through
 # apex_tpu.tune under APEX_TPU_TUNE=auto. On this CPU backend measurement
@@ -245,7 +245,7 @@ print(f'tune smoke OK: {len(tuned)} tune/* series, '
 " "$TUNE_DIR/tune_run.jsonl" "$TUNE_DIR/cache"
 rm -rf "$TUNE_DIR"
 
-echo "== 8/9 resilience smoke (snapshot -> injected kill -> auto-resume) =="
+echo "== 8/10 resilience smoke (snapshot -> injected kill -> auto-resume) =="
 # Kill-and-resume end to end: a 6-step train snapshotting every 2 steps is
 # SIGKILLed by the fault injector at the top of step 4 (exit 137 — an
 # abrupt death, no final snapshot), then the SAME command with --resume
@@ -302,7 +302,63 @@ python -m apex_tpu.telemetry summarize "$RES_DIR/resume.jsonl" \
     || { echo "summarize did not report the resume point" >&2; exit 1; }
 rm -rf "$RES_DIR"
 
-echo "== 9/9 pytest =="
+echo "== 9/10 overlap smoke (staged backward + bf16 wire vs fp32 baseline) =="
+# The overlap engine end to end on the 8-device CPU mesh: a 3-step fp32
+# baseline train and the same train under --overlap --reduce-dtype bf16
+# must (a) land within 1e-2 of each other's final loss (the compression
+# numerics contract), (b) show the bf16 run's static comm bill at ~half
+# the baseline's bytes_wire (the walker reads the wire dtype off the
+# jaxpr — nothing to fake), and (c) emit the ddp/overlap_efficiency
+# series derived from the per-bucket dispatch timestamps.
+OVL_DIR="$(mktemp -d)"
+OVL_ARGS=(--steps 3 --warmup-steps 0 --vocab 512 --layers 2
+          --embed-dim 64 --heads 2 --seq-len 128 --batch-size 1
+          --opt-level O0)
+python examples/gpt/train_lm.py "${OVL_ARGS[@]}" \
+    --telemetry "$OVL_DIR/fp32.jsonl" > "$OVL_DIR/fp32.out"
+python examples/gpt/train_lm.py "${OVL_ARGS[@]}" \
+    --overlap --reduce-dtype bf16 \
+    --telemetry "$OVL_DIR/bf16.jsonl" > "$OVL_DIR/bf16.out"
+python -c "
+import json, re, sys
+d = sys.argv[1]
+
+def wire(path):
+    total, names = 0.0, set()
+    with open(path) as f:
+        for line in f:
+            row = json.loads(line)        # every line must parse
+            names.add(row['name'])
+            meta = row.get('meta') or {}
+            if row['name'].startswith('comm/') and meta.get('axis'):
+                total += float(meta.get('bytes_wire') or 0)
+    return total, names
+
+def final_loss(path):
+    steps = dict(re.findall(r'step\s+(\d+) loss ([0-9.naninf-]+)',
+                            open(path).read()))
+    assert steps, f'no per-step loss lines in {path}'
+    return float(steps[max(steps, key=int)])
+
+w32, _ = wire(d + '/fp32.jsonl')
+w16, names16 = wire(d + '/bf16.jsonl')
+assert w32 > 0 and w16 > 0, (w32, w16)
+assert w16 < 0.6 * w32, \
+    f'bf16 wire bill not reduced: {w16:.0f} vs fp32 {w32:.0f}'
+assert 'ddp/overlap_efficiency' in names16, \
+    f'no overlap-efficiency series; has {sorted(names16)[:20]}'
+l32, l16 = final_loss(d + '/fp32.out'), final_loss(d + '/bf16.out')
+assert abs(l32 - l16) <= 1e-2, \
+    f'loss diverged under bf16 wire: {l16} vs {l32}'
+print(f'overlap smoke OK: wire {w16 / w32:.2f}x of fp32, '
+      f'loss delta {abs(l32 - l16):.4f}')
+" "$OVL_DIR"
+python -m apex_tpu.telemetry summarize "$OVL_DIR/bf16.jsonl" \
+    | grep -q "overlap eff" \
+    || { echo "summarize did not render overlap efficiency" >&2; exit 1; }
+rm -rf "$OVL_DIR"
+
+echo "== 10/10 pytest =="
 if [[ "${1:-}" == "--full" ]]; then
     # full suite + the complete L1 cross-product matrix (reference
     # tests/L1/cross_product{,_distributed}/run.sh); the convergence
@@ -315,7 +371,7 @@ else
     python -m pytest tests/test_multi_tensor.py tests/test_optimizers.py \
         tests/test_amp.py tests/test_param_groups.py tests/test_zero.py \
         tests/test_checkpoint.py tests/test_runtime.py tests/test_tune.py \
-        tests/test_resilience.py -q -x
+        tests/test_resilience.py tests/test_overlap.py -q -x
 fi
 
 echo "CI GATE PASSED"

@@ -50,6 +50,24 @@ def parse_args(argv=None):
                    help="shard the SEQUENCE over the mesh axis; attention "
                         "communicates (ring ppermute / ulysses all-to-all),"
                         " the rest of the block is token-local")
+    p.add_argument("--overlap", action="store_true",
+                   help="backward/collective overlap: stage each "
+                        "gradient bucket's collective into the backward "
+                        "(custom_vjp) so it overlaps the remaining "
+                        "backward compute (docs/overlap.md); bucket "
+                        "granularity resolves via apex_tpu.tune")
+    p.add_argument("--reduce-dtype", default=None,
+                   choices=[None, "bf16", "fp16"],
+                   help="16-bit wire format for the gradient "
+                        "collectives (fp32 accumulation via "
+                        "pre-scaling; loss-scale-safe — see "
+                        "docs/overlap.md numerics contract)")
+    p.add_argument("--adasum", action="store_true",
+                   help="adaptive summation (arXiv:2006.02924) instead "
+                        "of the mean for data-parallel gradients — "
+                        "large-batch friendly; requires a power-of-two "
+                        "device count and data parallelism (not "
+                        "--seq-parallel)")
     p.add_argument("--dropout", type=float, default=0.0)
     p.add_argument("--remat", action="store_true",
                    help="rematerialize blocks in the backward "
@@ -260,6 +278,22 @@ def main(argv=None):
     init_tokens = jnp.zeros((1, min(args.seq_len, 128)), jnp.int32)
     params32 = init_model.init(key, init_tokens)["params"]
 
+    if args.adasum and args.seq_parallel:
+        raise SystemExit(
+            "--adasum is a data-parallel gradient combiner; under "
+            "--seq-parallel the per-device grads are shard "
+            "CONTRIBUTIONS (summed, not averaged) and adaptive "
+            "summation of non-replicated pieces is not meaningful")
+    ddp = None
+    if args.overlap or args.reduce_dtype or args.adasum:
+        # the overlap-engine DDP path (docs/overlap.md); seq-parallel
+        # grads are shard contributions -> sum (gradient_average=False),
+        # data-parallel grads are replica means
+        ddp = parallel.DistributedDataParallel(
+            axis, overlap=args.overlap, reduce_dtype=args.reduce_dtype,
+            adasum=args.adasum,
+            gradient_average=not args.seq_parallel)
+
     inner = optimizers.FusedAdam(lr=args.lr)
     _, aopt = amp.initialize(None, inner, opt_level=args.opt_level,
                              verbosity=0)
@@ -277,7 +311,20 @@ def main(argv=None):
 
         loss_axis = axis if args.seq_parallel else None
 
+        # step attribution for the overlap tracker's per-bucket
+        # timestamps (ddp/overlap_efficiency): the amp execution index,
+        # computed only when an observer will consume it so the
+        # unobserved trace stays identical
+        from apex_tpu import telemetry as _telemetry
+        ddp_step_idx = None
+        if ddp is not None and _telemetry.enabled():
+            ddp_step_idx = aopt.execution_index(opt_state)
+
         def scaled(p):
+            if ddp is not None:
+                # overlap staging (identity when overlap is off):
+                # cotangents return bucket-reduced from the backward
+                p = ddp.prepare(p, telemetry_step=ddp_step_idx)
             mutable = ["intermediates"] if args.moe else []
             if args.loss_chunk:
                 hidden, inter = model.apply(
@@ -305,9 +352,15 @@ def main(argv=None):
         grads, loss = jax.grad(scaled, has_aux=True)(params)
         # seq-parallel: the loss is globally normalized (psum inside
         # next_token_loss), so each device's grad holds only its shard's
-        # contribution — sum, don't average
-        grads = (jax.lax.psum(grads, axis) if args.seq_parallel
-                 else jax.lax.pmean(grads, axis))
+        # contribution — sum, don't average. The overlap-engine path
+        # (--overlap/--reduce-dtype/--adasum) keeps the same semantics
+        # via gradient_average; with --overlap the grads already left
+        # the backward reduced.
+        if ddp is None:
+            grads = (jax.lax.psum(grads, axis) if args.seq_parallel
+                     else jax.lax.pmean(grads, axis))
+        elif not ddp.overlap:
+            grads = ddp.sync(grads, telemetry_step=ddp_step_idx)
         new_params, new_opt, _ = aopt.step(grads, params, opt_state)
         from apex_tpu.telemetry import health as _health
         if _health.enabled():

@@ -268,6 +268,47 @@ def test_jaxpr_apx104_inconsistent_axis_index_groups():
                        mesh_axes=("data",)) == []
 
 
+def test_jaxpr_apx106_fp32_psum_under_reduce_dtype():
+    from jax.sharding import Mesh
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+    x = jnp.ones((64, 64))            # 4096 elements: payload-sized
+
+    def bad(x):
+        # raw fp32 psum of a gradient-sized tree — bypasses the
+        # configured compressed wire path
+        return jax.lax.psum(x, "data")
+
+    def good(x):
+        from apex_tpu.parallel import allreduce_gradients
+        return allreduce_gradients({"w": x}, "data",
+                                   reduce_dtype="bf16")["w"]
+
+    ids = {f.rule_id for f in check_entry(
+        _smap(bad, mesh), (x,), mesh_axes=("data",),
+        reduce_dtype="bfloat16")}
+    assert ids == {"APX106"}
+    # the compressed call site is clean under the same declaration
+    assert check_entry(_smap(good, mesh), (x,), mesh_axes=("data",),
+                       reduce_dtype="bfloat16") == []
+    # no reduce_dtype declared: the rule is disarmed
+    assert check_entry(_smap(bad, mesh), (x,),
+                       mesh_axes=("data",)) == []
+
+
+def test_jaxpr_apx106_scalar_psum_is_exempt():
+    from jax.sharding import Mesh
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+    x = jnp.ones((64, 64))
+
+    def norms(x):
+        # scalar reductions (grad norms, loss pmean) legitimately ride
+        # fp32 even on a compressed wire — payload threshold exempts them
+        return jax.lax.psum(jnp.sum(x * x), "data")
+
+    assert check_entry(_smap(norms, mesh), (x,), mesh_axes=("data",),
+                       reduce_dtype="bfloat16") == []
+
+
 def test_jaxpr_apx105_pallas_block_misalignment():
     from jax.experimental import pallas as pl
 
