@@ -386,29 +386,35 @@ class _ZeroBase(FusedOptimizer):
                           "bytes_wire": round(gbytes * 2 * (gn - 1) / gn)},
                     dedup_key=(self.group_axis, gbytes,
                                len(spec["buckets"])))
+        # the named scope tags every bucket's psum_scatter (and the
+        # cross-subgroup psum) in XLA metadata, so profiler traces
+        # attribute this comm to ZeRO (pyprof.capture's collective/zero
+        # bucket) — metadata only, the traced program is unchanged
         shards = []
-        for b in spec["buckets"]:
-            flat = _bucket_flat(leaves, b["idxs"], b["padded"])
-            if self.reduce_dtype is not None:
-                # pre-scaling compression: the full-world mean divide
-                # lands BEFORE the cast so wire-dtype partial sums carry
-                # mean-gradient magnitude (loss-scale-safe; overflow
-                # saturates to Inf for the amp non-finite check); the
-                # shard returns to fp32 immediately — everything past
-                # the wire accumulates fp32
-                wire = (flat / world).astype(self.reduce_dtype)
-                sh = jax.lax.psum_scatter(
-                    wire, self.axis_name, scatter_dimension=0,
-                    tiled=True).astype(jnp.float32)
-            else:
-                sh = jax.lax.psum_scatter(
-                    flat, self.axis_name, scatter_dimension=0, tiled=True)
-            if self.group_axis is not None:
-                # cross-subgroup reduction stays fp32: it moves 1/n of
-                # the bytes and compressing it would square the
-                # quantization error for no meaningful wire saving
-                sh = jax.lax.psum(sh, self.group_axis)
-            shards.append(sh)
+        with jax.named_scope("apex_zero_reduce_scatter"):
+            for b in spec["buckets"]:
+                flat = _bucket_flat(leaves, b["idxs"], b["padded"])
+                if self.reduce_dtype is not None:
+                    # pre-scaling compression: the full-world mean divide
+                    # lands BEFORE the cast so wire-dtype partial sums
+                    # carry mean-gradient magnitude (loss-scale-safe;
+                    # overflow saturates to Inf for the amp non-finite
+                    # check); the shard returns to fp32 immediately —
+                    # everything past the wire accumulates fp32
+                    wire = (flat / world).astype(self.reduce_dtype)
+                    sh = jax.lax.psum_scatter(
+                        wire, self.axis_name, scatter_dimension=0,
+                        tiled=True).astype(jnp.float32)
+                else:
+                    sh = jax.lax.psum_scatter(
+                        flat, self.axis_name, scatter_dimension=0,
+                        tiled=True)
+                if self.group_axis is not None:
+                    # cross-subgroup reduction stays fp32: it moves 1/n
+                    # of the bytes and compressing it would square the
+                    # quantization error for no meaningful wire saving
+                    sh = jax.lax.psum(sh, self.group_axis)
+                shards.append(sh)
         from apex_tpu.telemetry import health as _health
         if _health.enabled():
             # numerics health: per-bucket grad norms off the ALREADY
@@ -457,17 +463,23 @@ class _ZeroBase(FusedOptimizer):
 
         leaves: list = [None] * len(spec["sizes"])
         off = 0
-        for b in spec["buckets"]:
-            piece = jax.lax.slice_in_dim(master_shard, off, off + b["k"])
-            off += b["k"]
-            if self.allgather_dtype is not None:
-                piece = piece.astype(self.allgather_dtype)
-            flat = jax.lax.all_gather(piece, self.axis_name, tiled=True)
-            for i in b["idxs"]:
-                rel = int(spec["offsets"][i]) - b["start"]
-                leaves[i] = (
-                    jax.lax.slice_in_dim(flat, rel, rel + spec["sizes"][i])
-                    .reshape(spec["shapes"][i]).astype(spec["dtypes"][i]))
+        # profiler attribution scope (see _scatter_grads)
+        with jax.named_scope("apex_zero_allgather"):
+            for b in spec["buckets"]:
+                piece = jax.lax.slice_in_dim(master_shard, off,
+                                             off + b["k"])
+                off += b["k"]
+                if self.allgather_dtype is not None:
+                    piece = piece.astype(self.allgather_dtype)
+                flat = jax.lax.all_gather(piece, self.axis_name,
+                                          tiled=True)
+                for i in b["idxs"]:
+                    rel = int(spec["offsets"][i]) - b["start"]
+                    leaves[i] = (
+                        jax.lax.slice_in_dim(flat, rel,
+                                             rel + spec["sizes"][i])
+                        .reshape(spec["shapes"][i])
+                        .astype(spec["dtypes"][i]))
         return jax.tree_util.tree_unflatten(spec["treedef"], leaves)
 
     def _shard_positions(self, spec) -> jax.Array:

@@ -120,18 +120,24 @@ class Block(nn.Module):
                 raise ValueError(
                     f"tensor_parallel_size ({self.tensor_parallel_size}) "
                     f"must divide the mlp width ({self.mlp_ratio * e})")
-            y = tp_region_enter(y, self.tensor_parallel_axis)
-            y = nn.Dense(self.mlp_ratio * e // self.tensor_parallel_size,
-                         dtype=self.dtype, name="fc1")(y)
-            y = nn.gelu(y)
-            # row-parallel: partial matmul -> g psum -> bias added once
-            y = RowParallelDense(e, self.tensor_parallel_axis,
-                                 dtype=self.dtype, name="fc2")(y)
+            # named scope for profiler attribution (pyprof.capture joins
+            # trace kernels on it); flax module names already tag
+            # attn/ln1/ln2/moe the same way
+            with jax.named_scope("mlp"):
+                y = tp_region_enter(y, self.tensor_parallel_axis)
+                y = nn.Dense(
+                    self.mlp_ratio * e // self.tensor_parallel_size,
+                    dtype=self.dtype, name="fc1")(y)
+                y = nn.gelu(y)
+                # row-parallel: partial matmul -> g psum -> bias once
+                y = RowParallelDense(e, self.tensor_parallel_axis,
+                                     dtype=self.dtype, name="fc2")(y)
         else:
-            y = nn.Dense(self.mlp_ratio * e, dtype=self.dtype,
-                         name="fc1")(y)
-            y = nn.gelu(y)
-            y = nn.Dense(e, dtype=self.dtype, name="fc2")(y)
+            with jax.named_scope("mlp"):
+                y = nn.Dense(self.mlp_ratio * e, dtype=self.dtype,
+                             name="fc1")(y)
+                y = nn.gelu(y)
+                y = nn.Dense(e, dtype=self.dtype, name="fc2")(y)
         return x + y
 
 
@@ -321,10 +327,13 @@ def next_token_loss(logits, tokens, axis_name: Optional[str] = None):
     objective on the gathered sequence.
     """
     from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
-    targets, valid, den = _shifted_targets(tokens, axis_name)
-    losses = softmax_cross_entropy_loss(logits, targets)
-    local = jnp.sum(losses * valid) / den
-    return _globalize(local, axis_name)
+    # named scope: profiler traces attribute the xentropy + masking ops
+    # to the loss bucket (pyprof.capture) — metadata only
+    with jax.named_scope("loss"):
+        targets, valid, den = _shifted_targets(tokens, axis_name)
+        losses = softmax_cross_entropy_loss(logits, targets)
+        local = jnp.sum(losses * valid) / den
+        return _globalize(local, axis_name)
 
 
 def chunked_next_token_loss(hidden, head_params, tokens, *,
@@ -376,9 +385,12 @@ def chunked_next_token_loss(hidden, head_params, tokens, *,
             logits.astype(jnp.float32), t_c)
         return acc + jnp.sum(losses * v_c), None
 
-    num, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
-                          (hid, tgt, val))
-    return _globalize(num / den, axis_name)
+    # scope for profiler attribution: the scan body (head matmul +
+    # xentropy) is traced inside it, so its kernels land in 'loss'
+    with jax.named_scope("loss"):
+        num, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                              (hid, tgt, val))
+        return _globalize(num / den, axis_name)
 
 
 def generate(model: TransformerLM, params, prompt, max_new_tokens: int,

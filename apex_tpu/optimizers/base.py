@@ -125,12 +125,18 @@ class FusedOptimizer:
              *, grad_scale: Optional[jax.Array] = None, **kw):
         """Apply one update. ``grad_scale`` (if given) divides grads on the
         fly, fused into the update kernel (the reference fused optimizers'
-        ``scale`` argument)."""
-        if not self.param_groups:
-            return self._step_dense(grads, params, state,
-                                    grad_scale=grad_scale, **kw)
-        return self._step_grouped(grads, params, state,
-                                  grad_scale=grad_scale, **kw)
+        ``scale`` argument).
+
+        The ``apex_optimizer_step`` named scope tags every update op in
+        XLA metadata so profiler traces attribute optimizer time as its
+        own bucket (pyprof.capture) — metadata only, the traced program
+        is unchanged."""
+        with jax.named_scope("apex_optimizer_step"):
+            if not self.param_groups:
+                return self._step_dense(grads, params, state,
+                                        grad_scale=grad_scale, **kw)
+            return self._step_grouped(grads, params, state,
+                                      grad_scale=grad_scale, **kw)
 
     def _step_dense(self, grads: Tree, params: Tree, state: Any,
                     *, grad_scale: Optional[jax.Array] = None, **kw):

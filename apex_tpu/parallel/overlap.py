@@ -325,24 +325,31 @@ def reduce_bucket(flat: jax.Array, axis_name: str, *,
         _mark(flat, telemetry_step, bucket_index, n_buckets, "issue")
     if predivide != 1.0:
         flat = flat / predivide
-    if adasum:
-        red = adasum_flat(flat, axis_name, reduce_dtype=wire_dt)
-    else:
-        wire = flat if wire_dt is None or flat.dtype == wire_dt \
-            else flat.astype(wire_dt)
-        psum = functools.partial(jax.lax.psum, axis_name=axis_name,
-                                 axis_index_groups=axis_index_groups)
-        if 0 < message_size < wire.shape[0]:
-            # oversize single leaf: chunked psum for message sizing
-            red = jnp.concatenate(
-                [psum(wire[i:i + message_size])
-                 for i in range(0, wire.shape[0], message_size)])
+    # named scope: both DDP paths (post-hoc allreduce_gradients and the
+    # staged backward) reduce through here, so every bucket collective
+    # carries the apex_ddp_allreduce tag in XLA metadata — the join key
+    # pyprof.capture attributes comm time by. Metadata only: the traced
+    # program (and the defaults' jaxpr-equality contract) is unchanged.
+    with jax.named_scope("apex_ddp_allreduce"):
+        if adasum:
+            red = adasum_flat(flat, axis_name, reduce_dtype=wire_dt)
         else:
-            red = psum(wire)
-        if wire_dt is not None and red.dtype != jnp.float32:
-            # fp32 accumulation of everything downstream of the wire:
-            # postdivide, health norms, and the caller's unscale/update
-            red = red.astype(jnp.float32)
+            wire = flat if wire_dt is None or flat.dtype == wire_dt \
+                else flat.astype(wire_dt)
+            psum = functools.partial(jax.lax.psum, axis_name=axis_name,
+                                     axis_index_groups=axis_index_groups)
+            if 0 < message_size < wire.shape[0]:
+                # oversize single leaf: chunked psum for message sizing
+                red = jnp.concatenate(
+                    [psum(wire[i:i + message_size])
+                     for i in range(0, wire.shape[0], message_size)])
+            else:
+                red = psum(wire)
+            if wire_dt is not None and red.dtype != jnp.float32:
+                # fp32 accumulation of everything downstream of the
+                # wire: postdivide, health norms, the caller's
+                # unscale/update
+                red = red.astype(jnp.float32)
     if postdivide != 1.0:
         red = red / postdivide
     if do_track:

@@ -11,11 +11,26 @@ SURVEY.md §5.1). The TPU-native pipeline:
      bytes / arithmetic intensity straight from XLA's own cost model
      (``compiled.cost_analysis()``) — no hand-written per-op calculators
      needed; the compiler already knows.
+  4. **capture / hlo / roofline** (reference parse+prof joined up): the
+     working attribution profiler — ``capture(step_fn, *args)`` traces a
+     compiled step, joins kernel events to ``named_scope`` paths via the
+     HLO ``op_name`` metadata, and reports the compute / exposed-
+     collective / idle device-timeline split, per-subsystem buckets with
+     roofline verdicts, overlap efficiency from device timestamps, and
+     the dispatch gap. ``python -m apex_tpu.pyprof report|compare`` is
+     the offline CLI + CI perf-regression gate (exit 4 on regression).
 """
 
 from apex_tpu.pyprof.annotate import annotate, annotate_module, push, pop
 from apex_tpu.pyprof.parse import Trace, TraceEvent, categorize, load_trace
-from apex_tpu.pyprof.prof import (analyze, device_peak_flops,
-                                  device_time_of, format_report,
-                                  summarize_trace, xla_flops)
+from apex_tpu.pyprof.prof import (analyze, analyze_compiled,
+                                  device_peak_flops, device_time_of,
+                                  format_report, summarize_trace,
+                                  xla_flops)
 from apex_tpu.pyprof.trace import trace, start_trace, stop_trace
+from apex_tpu.pyprof.capture import (breakdown_from_logdir, capture,
+                                     compute_breakdown, format_breakdown,
+                                     record_breakdown, subsystem_of)
+from apex_tpu.pyprof.roofline import (classify, device_peak_bytes_per_s,
+                                      program_roofline, ridge_intensity)
+from apex_tpu.pyprof.hlo import clean_op_name, parse_hlo_text, scope_of

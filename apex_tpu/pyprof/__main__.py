@@ -1,13 +1,15 @@
-"""``python -m apex_tpu.pyprof <trace-file-or-logdir>`` — offline per-op
-report (reference: ``python -m apex.pyprof.prof``, prof/__main__.py)."""
+"""``python -m apex_tpu.pyprof report|compare|summarize ...`` — see
+:mod:`apex_tpu.pyprof.cli`. A bare trace path (the pre-attribution
+invocation, ``python -m apex_tpu.pyprof <trace|logdir>``) still renders
+the legacy per-op table."""
 
 import sys
 
-from apex_tpu.pyprof.prof import summarize_trace
+from apex_tpu.pyprof.cli import main
 
 if __name__ == "__main__":
-    if len(sys.argv) != 2:
-        print("usage: python -m apex_tpu.pyprof <trace.json[.gz] | logdir>",
-              file=sys.stderr)
-        sys.exit(2)
-    print(summarize_trace(sys.argv[1]))
+    argv = sys.argv[1:]
+    if len(argv) == 1 and argv[0] not in (
+            "report", "compare", "summarize", "-h", "--help"):
+        argv = ["summarize", argv[0]]      # legacy form
+    raise SystemExit(main(argv))

@@ -1,0 +1,530 @@
+"""Step-time attribution: device-timeline capture, scope join, and the
+per-subsystem breakdown (the working form of the reference pyprof
+pipeline — parse joins kernels to markers, prof attributes and scores —
+over ``jax.profiler`` artifacts instead of the nvprof DB).
+
+``capture(step_fn, *args)`` runs the compiled step under
+``jax.profiler.trace``, parses the Chrome-trace JSON with
+:mod:`apex_tpu.pyprof.parse`, joins every kernel event to its
+``jax.named_scope`` path through the compiled HLO's ``op_name`` metadata
+(:mod:`apex_tpu.pyprof.hlo` — trace events carry only the instruction
+name), and produces:
+
+  * a device-timeline category split — **compute / exposed-collective /
+    idle** — that sums to 100% of the device window. Collective time
+    hidden behind concurrent compute is attributed to compute (it costs
+    nothing); the *exposed* remainder is what an overlap scheme would
+    save. The hidden fraction IS the device-timestamp-grounded
+    overlap-efficiency number that cross-checks the callback-based
+    ``ddp/overlap_efficiency`` series.
+  * a per-subsystem table (attention, layer_norm, mlp, conv, optimizer,
+    ddp/zero collectives, ...) from the joined scope paths, each bucket
+    carrying its roofline verdict (:mod:`apex_tpu.pyprof.roofline`).
+  * ``dispatch_gap_pct`` — the wall-vs-device reconciliation
+    (100 * (wall - device busy) / wall), the figure that explains the
+    bench's device-rate vs wall-rate split.
+
+Everything works hermetically on the CPU backend: XLA:CPU traces carry
+real per-op events with ``hlo_op`` args (verified on jax 0.4.37), and the
+HLO text carries the same scope metadata as TPU. A capture writes a
+sidecar (``apex_pyprof_capture.json.gz``: instruction→scope/flops/bytes
+map + wall time + cost analysis) into the logdir so ``python -m
+apex_tpu.pyprof report <logdir>`` can rebuild the full breakdown offline,
+with no devices and no recompile.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import re
+import tempfile
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from apex_tpu.pyprof import hlo as _hlo
+from apex_tpu.pyprof import roofline as _roofline
+from apex_tpu.pyprof.parse import Trace, categorize, load_trace, union_us
+
+__all__ = ["capture", "compute_breakdown", "breakdown_from_logdir",
+           "format_breakdown", "record_breakdown", "SIDECAR_NAME",
+           "subsystem_of"]
+
+SIDECAR_NAME = "apex_pyprof_capture.json.gz"
+BREAKDOWN_NAME = "breakdown.json"
+
+_COLLECTIVE_RE = re.compile(
+    r"(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute|"
+    r"collective-broadcast|partition-id|replica-id)")
+
+# Ordered scope→subsystem rules; first match wins. Matching runs on the
+# CLEANED scope path lowercased (hlo.clean_op_name: flax module names,
+# explicit jax.named_scope annotations, apex_* producer scopes).
+_SUBSYSTEM_RULES: List[Tuple[str, "re.Pattern"]] = [
+    ("attention", re.compile(r"attn|attention|flash")),
+    ("layer_norm", re.compile(
+        r"(^|/)ln\d?(/|$)|layer_?norm|layernorm|fused_ln|batch_?norm|"
+        r"(^|/)bn_|norm_proj|sync_?batch")),
+    ("optimizer", re.compile(
+        r"apex_optimizer|fused_adam|fused_sgd|fusedlamb|(^|/)adam(/|$)|"
+        r"(^|/)sgd(/|$)|(^|/)lamb(/|$)")),
+    ("ddp", re.compile(r"apex_ddp")),
+    ("zero", re.compile(r"apex_zero")),
+    ("head", re.compile(r"(^|/)head(/|$)")),
+    ("embedding", re.compile(r"tok_emb|pos_emb|(^|/)embed")),
+    ("mlp", re.compile(r"(^|/)mlp(/|$)|(^|/)fc\d(/|$)|gelu|(^|/)moe(/|$)")),
+    ("loss", re.compile(
+        r"xentropy|cross_entropy|softmax_cross|next_token|(^|/)loss")),
+    ("conv", re.compile(r"(^|/)conv|(^|/)stem(/|$)|(^|/)stage\d|resnet")),
+]
+
+
+def subsystem_of(scope: str, op_hlo_name: str = "") -> str:
+    """Map a cleaned scope path (+ the HLO op name, for collectives that
+    carry no scope) to a named subsystem bucket. Collectives resolve to
+    the producer that issued them (``collective/ddp`` for the bucketed
+    DDP all-reduce, ``collective/zero`` for the reduce-scatter path,
+    ``collective/other`` for bare psums), so the comm bill is itemized by
+    owner, not lumped."""
+    low = scope.lower()
+    if _COLLECTIVE_RE.search(op_hlo_name.lower()) \
+            or _COLLECTIVE_RE.search(low):
+        if "apex_ddp" in low:
+            return "collective/ddp"
+        if "apex_zero" in low:
+            return "collective/zero"
+        return "collective/other"
+    for bucket, pat in _SUBSYSTEM_RULES:
+        if pat.search(low):
+            return bucket
+    return "other"
+
+
+def _is_collective(bucket: str) -> bool:
+    return bucket.startswith("collective/")
+
+
+# ---------------------------------------------------------------------------
+# breakdown computation
+# ---------------------------------------------------------------------------
+
+def compute_breakdown(trace: Trace, *,
+                      instr_map: Optional[Dict[str, Any]] = None,
+                      module: str = "",
+                      wall_s: Optional[float] = None,
+                      steps: int = 1,
+                      cost_stats: Optional[Dict[str, Any]] = None,
+                      peak_flops: Optional[float] = None,
+                      peak_bytes_per_s: Optional[float] = None,
+                      top_scopes: int = 24,
+                      top_ops: int = 24) -> Dict[str, Any]:
+    """Join a parsed trace to the instruction map and aggregate the
+    attribution report. ``instr_map``: ``{hlo_instr_name: {"scope": str,
+    "flops": float|None, "bytes": int}}`` (from a capture sidecar or
+    :func:`_instr_map_of`); without it, scope attribution degrades to
+    whatever the event args carry (TPU traces embed ``tf_op`` long
+    names; CPU traces don't) and every op lands by HLO-name category
+    only."""
+    instr_map = instr_map or {}
+    kernels = trace.kernel_events()
+    w_start, w_end = trace.device_window_us()
+    window_us = max(w_end - w_start, 0.0)
+    busy_us = trace.busy_us(kernels)
+    idle_us = max(window_us - busy_us, 0.0)
+
+    # roofline setup (None peaks => resolve from the local device; in a
+    # deviceless offline `report` the caller passes the sidecar's values)
+    if peak_flops is None:
+        from apex_tpu.pyprof.prof import device_peak_flops
+        peak_flops = device_peak_flops()
+    if peak_bytes_per_s is None:
+        peak_bytes_per_s = _roofline.device_peak_bytes_per_s()
+    ridge = _roofline.ridge_intensity(peak_flops, peak_bytes_per_s)
+
+    subsystems: Dict[str, Dict[str, Any]] = {}
+    scopes: Dict[str, Dict[str, Any]] = {}
+    ops: Dict[str, Dict[str, Any]] = {}
+    coll_ivs: List[Tuple[float, float]] = []
+    comp_ivs: List[Tuple[float, float]] = []
+    unattributed_us = 0.0
+
+    for e in kernels:
+        hlo_op = str(e.args.get("hlo_op") or "")
+        rec = instr_map.get(hlo_op) if hlo_op else None
+        if rec is not None and module and e.args.get("hlo_module") \
+                and e.args.get("hlo_module") != module:
+            # a DIFFERENT executable's op in the trace window: HLO
+            # instruction names (dot.7, fusion.1) are only unique per
+            # module, so joining it to the profiled module's map would
+            # hand it the wrong scope/FLOPs
+            rec = None
+        if rec is not None:
+            scope = rec.get("scope", "")
+            flops = rec.get("flops")
+            nbytes = rec.get("bytes")
+        else:
+            # degrade: TPU events carry the long op name in args
+            scope = _hlo.scope_of(e.long_name) \
+                if e.long_name != e.name else ""
+            flops = nbytes = None
+            if not scope:
+                unattributed_us += e.dur_us
+        bucket = subsystem_of(scope, e.name)
+        iv = (e.ts_us, e.ts_us + e.dur_us)
+        if _is_collective(bucket):
+            coll_ivs.append(iv)
+        else:
+            comp_ivs.append(iv)
+
+        srow = subsystems.setdefault(bucket, {
+            "us": 0.0, "count": 0, "flops": 0.0, "bytes": 0.0,
+            "bound_us": {}})
+        srow["us"] += e.dur_us
+        srow["count"] += 1
+        if flops:
+            srow["flops"] += flops
+        if nbytes:
+            srow["bytes"] += nbytes
+        verdict = _roofline.classify(flops, nbytes, ridge=ridge,
+                                     is_collective=_is_collective(bucket))
+        srow["bound_us"][verdict] = srow["bound_us"].get(verdict, 0.0) \
+            + e.dur_us
+
+        if scope:
+            sc = scopes.setdefault(scope, {"us": 0.0, "count": 0})
+            sc["us"] += e.dur_us
+            sc["count"] += 1
+        key = e.name.split(".")[0] if hlo_op else e.name
+        orow = ops.setdefault(key, {
+            "op": key, "us": 0.0, "count": 0, "flops": 0.0, "bytes": 0.0,
+            "scope": scope})
+        orow["us"] += e.dur_us
+        orow["count"] += 1
+        if flops:
+            orow["flops"] += flops
+        if nbytes:
+            orow["bytes"] += nbytes
+
+    # device-timeline categories: compute / exposed collective / idle,
+    # summing to 100% of the window. Collective time covered by
+    # concurrent compute is attributed to compute (hidden == free); the
+    # exposed remainder is the overlap scheme's remaining target.
+    compute_busy_us = union_us(comp_ivs)
+    coll_busy_us = union_us(coll_ivs)
+    exposed_coll_us = max(busy_us - compute_busy_us, 0.0)
+    hidden_coll_us = max(coll_busy_us - exposed_coll_us, 0.0)
+
+    total_op_us = sum(r["us"] for r in subsystems.values()) or 1.0
+    sub_table = {}
+    for name, r in sorted(subsystems.items(), key=lambda kv: -kv[1]["us"]):
+        dominant = max(r["bound_us"].items(), key=lambda kv: kv[1])[0] \
+            if r["bound_us"] else "unknown"
+        row = {"us": round(r["us"], 1),
+               "pct": round(100.0 * r["us"] / total_op_us, 2),
+               "count": r["count"], "bound": dominant}
+        if r["flops"]:
+            row["flops"] = r["flops"]
+            row["achieved_flops_per_s"] = (
+                r["flops"] / (r["us"] / 1e6) if r["us"] else None)
+        if r["bytes"]:
+            row["bytes"] = r["bytes"]
+        if r["flops"] and r["bytes"]:
+            row["intensity"] = round(r["flops"] / r["bytes"], 3)
+        sub_table[name] = row
+
+    op_rows = sorted(ops.values(), key=lambda r: -r["us"])[:top_ops]
+    for r in op_rows:
+        r["us"] = round(r["us"], 1)
+        if r["flops"] and r["bytes"]:
+            r["intensity"] = round(r["flops"] / r["bytes"], 3)
+        r["bound"] = _roofline.classify(
+            r.get("flops") or None, r.get("bytes") or None, ridge=ridge,
+            is_collective=_is_collective(subsystem_of(r["scope"], r["op"])))
+
+    scope_table = {
+        k: {"us": round(v["us"], 1), "count": v["count"]}
+        for k, v in sorted(scopes.items(),
+                           key=lambda kv: -kv[1]["us"])[:top_scopes]}
+
+    window_s = window_us / 1e6
+    busy_s = busy_us / 1e6
+    wall = wall_s if wall_s and wall_s > 0 else window_s
+    bd: Dict[str, Any] = {
+        "schema": 1,
+        "steps": steps,
+        "module": module,
+        "wall_s": round(wall, 6),
+        "device": {
+            "window_s": round(window_s, 6),
+            "busy_s": round(busy_s, 6),
+            "idle_s": round(idle_us / 1e6, 6),
+            "lanes": trace.device_lane_count(),
+            "kernel_events": len(kernels),
+        },
+        "categories": _categories(window_us, compute_busy_us,
+                                  exposed_coll_us, idle_us),
+        "subsystems": sub_table,
+        "scopes": scope_table,
+        "ops": op_rows,
+        "overlap": {
+            "collective_s": round(coll_busy_us / 1e6, 6),
+            "exposed_s": round(exposed_coll_us / 1e6, 6),
+            "hidden_s": round(hidden_coll_us / 1e6, 6),
+            "efficiency": (round(hidden_coll_us / coll_busy_us, 4)
+                           if coll_busy_us > 0 else None),
+        },
+        "dispatch_gap_pct": (round(100.0 * max(wall - busy_s, 0.0) / wall,
+                                   2) if wall > 0 else None),
+        "unattributed_us": round(unattributed_us, 1),
+    }
+    bd["roofline"] = _roofline.program_roofline(
+        cost_stats or {}, peak_flops=peak_flops,
+        peak_bytes_per_s=peak_bytes_per_s)
+    return bd
+
+
+def _categories(window_us, compute_us, exposed_coll_us, idle_us):
+    w = window_us or 1.0
+    cats = {
+        "compute": compute_us, "collective": exposed_coll_us,
+        "idle": idle_us,
+    }
+    return {k: {"s": round(v / 1e6, 6), "pct": round(100.0 * v / w, 2)}
+            for k, v in cats.items()}
+
+
+# ---------------------------------------------------------------------------
+# capture
+# ---------------------------------------------------------------------------
+
+def _instr_map_of(mod: "_hlo.HloModule") -> Dict[str, Any]:
+    """Flatten an HloModule into the sidecar's join map: every
+    instruction (entry and nested computations — while bodies' ops emit
+    their own trace events) to its cleaned scope, flops (incl. called
+    fusion bodies), and bytes estimate."""
+    out: Dict[str, Any] = {}
+    for name, ins in mod.instructions.items():
+        if not ins.op_name and ins.opcode in ("parameter", "constant",
+                                              "tuple", "get-tuple-element"):
+            continue
+        out[name] = {
+            "scope": _hlo.scope_of(ins.op_name) if ins.op_name else "",
+            "flops": mod.flops_of(name),
+            "bytes": ins.bytes_accessed,
+        }
+    return out
+
+
+def capture(step_fn: Callable, *args, steps: int = 2, warmup: int = 1,
+            logdir: Optional[str] = None, runner: Optional[Callable] = None,
+            peak_flops: Optional[float] = None,
+            peak_bytes_per_s: Optional[float] = None,
+            write: bool = True, **kwargs) -> Dict[str, Any]:
+    """Profile ``steps`` executions of a compiled step and return the
+    attribution breakdown.
+
+    ``step_fn(*args, **kwargs)`` must be jit-able (already-jitted
+    functions are used as-is); it is BOTH the HLO source (lowered once
+    for the scope-join map and XLA cost analysis — an AOT lower, no
+    donation is consumed) and, by default, the profiled body. When the
+    step donates its inputs or threads state, pass ``runner``: a
+    zero-arg callable invoked ``steps`` times inside the trace (it must
+    block on its own result), while ``step_fn``/``args`` still supply
+    the HLO. ``warmup`` un-traced calls run first so compile time never
+    lands in the profile.
+
+    The trace + sidecar land in ``logdir`` (a kept temp dir when None);
+    ``python -m apex_tpu.pyprof report <logdir>`` rebuilds the report
+    offline. The breakdown dict is also written there as
+    ``breakdown.json`` when ``write=True``.
+    """
+    import jax
+
+    # no donation on purpose: the capture re-executes with the SAME args
+    # every step, which donated buffers would forbid
+    jitted = step_fn if hasattr(step_fn, "lower") \
+        else jax.jit(step_fn)  # apexlint: disable=APX004
+    compiled = jitted.lower(*args, **kwargs).compile()
+    try:
+        hlo_text = compiled.as_text()
+    except Exception:
+        hlo_text = ""
+    mod = _hlo.parse_hlo_text(hlo_text) if hlo_text else _hlo.HloModule("")
+    instr_map = _instr_map_of(mod)
+
+    from apex_tpu.pyprof.prof import analyze_compiled
+    cost_stats = analyze_compiled(compiled)
+
+    if peak_flops is None:
+        from apex_tpu.pyprof.prof import device_peak_flops
+        peak_flops = device_peak_flops()
+    if peak_bytes_per_s is None:
+        peak_bytes_per_s = _roofline.device_peak_bytes_per_s()
+
+    if runner is None:
+        def runner():
+            jax.block_until_ready(jitted(*args, **kwargs))
+
+    for _ in range(max(warmup, 0)):
+        runner()
+
+    logdir = logdir or tempfile.mkdtemp(prefix="apex_pyprof_")
+    os.makedirs(logdir, exist_ok=True)
+    # wall clock brackets ONLY the step loop: profiler session start can
+    # cost seconds (measured ~10 s in sandboxed CPU environments) and
+    # would otherwise swamp dispatch_gap_pct
+    jax.profiler.start_trace(logdir)
+    try:
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            runner()
+        wall_s = time.perf_counter() - t0
+    finally:
+        jax.profiler.stop_trace()
+
+    sidecar = {
+        "schema": 1,
+        "module": mod.name,
+        "steps": steps,
+        "wall_s": wall_s,
+        "peak_flops": peak_flops,
+        "peak_bytes_per_s": peak_bytes_per_s,
+        "cost_stats": cost_stats,
+        "instructions": instr_map,
+    }
+    with gzip.open(os.path.join(logdir, SIDECAR_NAME), "wt") as f:
+        json.dump(sidecar, f)
+
+    trace = load_trace(logdir)
+    bd = compute_breakdown(
+        trace, instr_map=instr_map, module=mod.name, wall_s=wall_s,
+        steps=steps, cost_stats=cost_stats, peak_flops=peak_flops,
+        peak_bytes_per_s=peak_bytes_per_s)
+    bd["logdir"] = logdir
+    if write:
+        with open(os.path.join(logdir, BREAKDOWN_NAME), "w") as f:
+            json.dump(bd, f, indent=1, sort_keys=True)
+    return bd
+
+
+def breakdown_from_logdir(logdir: str) -> Dict[str, Any]:
+    """Rebuild the breakdown offline from a capture logdir (trace +
+    sidecar). Works with no devices and no source program; a logdir
+    without the sidecar (a raw ``jax.profiler`` capture) degrades to
+    name-category attribution with a warning field."""
+    trace = load_trace(logdir)
+    side_path = os.path.join(logdir, SIDECAR_NAME)
+    side: Dict[str, Any] = {}
+    if os.path.exists(side_path):
+        with gzip.open(side_path, "rt") as f:
+            side = json.load(f)
+    bd = compute_breakdown(
+        trace,
+        instr_map=side.get("instructions"),
+        module=side.get("module", ""),
+        wall_s=side.get("wall_s"),
+        steps=side.get("steps", 1),
+        cost_stats=side.get("cost_stats"),
+        peak_flops=side.get("peak_flops"),
+        peak_bytes_per_s=side.get("peak_bytes_per_s"))
+    bd["logdir"] = logdir
+    if not side:
+        bd["warning"] = ("no capture sidecar in logdir: scope join "
+                         "degraded to event-name categories (capture() "
+                         "writes " + SIDECAR_NAME + ")")
+    return bd
+
+
+# ---------------------------------------------------------------------------
+# rendering + telemetry
+# ---------------------------------------------------------------------------
+
+def format_breakdown(bd: Dict[str, Any], *, top: int = 12) -> str:
+    """Render a breakdown dict as the CLI's text report."""
+    dev = bd.get("device", {})
+    cats = bd.get("categories", {})
+    lines = [
+        f"steps: {bd.get('steps', 1)}   module: {bd.get('module') or '?'}"
+        f"   kernel events: {dev.get('kernel_events', 0)}",
+        f"wall {bd.get('wall_s', 0) * 1e3:.1f} ms   device window "
+        f"{dev.get('window_s', 0) * 1e3:.1f} ms   busy "
+        f"{dev.get('busy_s', 0) * 1e3:.1f} ms",
+    ]
+    if bd.get("warning"):
+        lines.append(f"WARNING: {bd['warning']}")
+    cat_line = "   ".join(
+        f"{k} {v['pct']:.1f}%" for k, v in cats.items())
+    lines.append(f"device timeline: {cat_line}")
+    if bd.get("dispatch_gap_pct") is not None:
+        lines.append(f"dispatch gap: {bd['dispatch_gap_pct']:.1f}% of wall "
+                     "(host/dispatch time the device sat idle)")
+    ov = bd.get("overlap") or {}
+    if ov.get("efficiency") is not None:
+        lines.append(
+            f"overlap efficiency (device timestamps): "
+            f"{ov['efficiency']:.1%} of {ov['collective_s'] * 1e3:.1f} ms "
+            f"collective time hidden behind compute")
+    rf = bd.get("roofline") or {}
+    if rf.get("classification"):
+        lines.append(
+            f"roofline: program intensity "
+            f"{rf['program_intensity']:.1f} flop/B vs ridge "
+            f"{rf['ridge_intensity']:.1f} -> {rf['classification']}"
+            f" (floors: compute {rf['compute_floor_s'] * 1e3:.2f} ms, "
+            f"memory {rf['memory_floor_s'] * 1e3:.2f} ms)")
+    subs = bd.get("subsystems") or {}
+    if subs:
+        lines += ["", f"{'subsystem':<20}{'time':>12}{'pct':>8}"
+                      f"{'count':>8}  bound"]
+        for name, r in list(subs.items())[:top]:
+            lines.append(
+                f"{name:<20}{r['us'] / 1e3:>10.2f} ms{r['pct']:>7.1f}%"
+                f"{r['count']:>8}  {r['bound']}")
+    scopes = bd.get("scopes") or {}
+    if scopes:
+        lines += ["", f"{'scope':<52}{'time':>12}{'count':>8}"]
+        for name, r in list(scopes.items())[:top]:
+            lines.append(f"{name[:51]:<52}{r['us'] / 1e3:>10.2f} ms"
+                         f"{r['count']:>8}")
+    ops = bd.get("ops") or []
+    if ops:
+        lines += ["", f"{'op':<28}{'time':>12}{'count':>7}"
+                      f"{'intensity':>11}  bound"]
+        for r in ops[:top]:
+            inten = (f"{r['intensity']:.1f}"
+                     if r.get("intensity") is not None else "-")
+            lines.append(
+                f"{r['op'][:27]:<28}{r['us'] / 1e3:>10.2f} ms"
+                f"{r['count']:>7}{inten:>11}  {r.get('bound', '?')}")
+    return "\n".join(lines)
+
+
+def record_breakdown(bd: Dict[str, Any], *, prefix: str = "profile"
+                     ) -> None:
+    """Emit a captured breakdown into the telemetry collector (no-op when
+    telemetry is disabled), so ``telemetry summarize`` renders a profile
+    section next to the run's in-step counters."""
+    from apex_tpu import telemetry
+    if not telemetry.enabled():
+        return
+    cats = bd.get("categories", {})
+    for k in ("compute", "collective", "idle"):
+        if k in cats:
+            telemetry.record_static(
+                f"{prefix}/{k}_pct", cats[k]["pct"],
+                dedup_key=(prefix, k))
+    if bd.get("dispatch_gap_pct") is not None:
+        telemetry.record_static(f"{prefix}/dispatch_gap_pct",
+                                bd["dispatch_gap_pct"],
+                                dedup_key=(prefix, "gap"))
+    ov = bd.get("overlap") or {}
+    if ov.get("efficiency") is not None:
+        telemetry.record_static(f"{prefix}/overlap_efficiency",
+                                ov["efficiency"],
+                                dedup_key=(prefix, "overlap"))
+    for name, r in (bd.get("subsystems") or {}).items():
+        telemetry.record_static(
+            f"{prefix}/scope/{name}", r["us"],
+            meta={"pct": r["pct"], "bound": r.get("bound", "unknown")},
+            dedup_key=(prefix, "scope", name))

@@ -1,0 +1,221 @@
+"""``python -m apex_tpu.pyprof`` — offline attribution + perf-regression
+gate (the reference's ``python -m apex.pyprof.prof`` stage, grown a CI
+contract).
+
+Subcommands:
+
+  report LOGDIR|breakdown.json [--json] [-o OUT.json] [--top N]
+      Rebuild and render the step-time attribution breakdown from a
+      capture logdir (trace + sidecar, no devices needed) or re-render a
+      saved breakdown JSON. ``-o`` additionally writes the breakdown
+      JSON for later ``compare``.
+
+  compare BASELINE NEW [--max-regress PCT]
+      Perf-regression gate. Inputs are capture logdirs, breakdown JSONs
+      (from ``report -o`` / ``capture()``), or BENCH JSON lines files
+      (``BENCH_r*.json`` — detected by their ``metric``/``value`` keys).
+      Breakdowns gate on per-step device busy time and the per-category
+      split (lower is better); BENCH rows gate on throughput (higher is
+      better). Exits ``EXIT_REGRESSION`` (4) when NEW is worse than
+      BASELINE by more than ``--max-regress`` percent (default 10).
+
+  summarize TRACE|LOGDIR [--top N]
+      The legacy per-op table (pre-attribution view).
+
+Exit codes: 0 ok, 1 unreadable/malformed input, 2 usage errors
+(argparse), 4 regression detected — stable contract for CI gates
+(ci/gate.sh asserts 4, not just nonzero, so a CLI crash can't pass as a
+regression verdict).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+EXIT_REGRESSION = 4
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.pyprof",
+        description="apex_tpu step-time attribution profiler — offline "
+                    "tools")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    r = sub.add_parser("report", help="attribution breakdown from a "
+                                      "capture logdir or breakdown JSON")
+    r.add_argument("path", help="capture logdir (trace + sidecar) or a "
+                                "breakdown.json")
+    r.add_argument("--json", action="store_true",
+                   help="emit the breakdown as JSON instead of text")
+    r.add_argument("-o", "--out", default=None, metavar="OUT.json",
+                   help="also write the breakdown JSON here (compare "
+                        "input)")
+    r.add_argument("--top", type=int, default=12,
+                   help="rows per table in the text report")
+
+    c = sub.add_parser("compare",
+                       help="perf-regression gate over two breakdowns or "
+                            "BENCH json files (exit 4 on regression)")
+    c.add_argument("baseline")
+    c.add_argument("new")
+    c.add_argument("--max-regress", type=float, default=10.0,
+                   metavar="PCT",
+                   help="tolerated regression percent (default 10)")
+
+    s = sub.add_parser("summarize",
+                       help="legacy per-op table from a raw trace")
+    s.add_argument("path")
+    s.add_argument("--top", type=int, default=25)
+    return p
+
+
+def _load_breakdown(path: str) -> Dict[str, Any]:
+    """A capture logdir, a breakdown JSON, or a BENCH JSON-lines file ->
+    a comparable dict. Raises ValueError with a useful message on
+    anything else."""
+    from apex_tpu.pyprof.capture import BREAKDOWN_NAME, \
+        breakdown_from_logdir
+    if os.path.isdir(path):
+        bd_path = os.path.join(path, BREAKDOWN_NAME)
+        if os.path.exists(bd_path) and not _has_trace(path):
+            with open(bd_path) as f:
+                return json.load(f)
+        return breakdown_from_logdir(path)
+    with open(path) as f:
+        text = f.read()
+    try:
+        d = json.loads(text)
+    except json.JSONDecodeError:
+        # JSON-lines file (bench stdout): the first row
+        d = json.loads(text.splitlines()[0])
+    if isinstance(d, dict) and isinstance(d.get("parsed"), dict):
+        d = d["parsed"]        # BENCH_r*.json trajectory wrapper
+    if not isinstance(d, dict):
+        raise ValueError(f"{path}: not a breakdown/BENCH JSON object")
+    return d
+
+
+def _has_trace(logdir: str) -> bool:
+    from apex_tpu.pyprof.parse import find_trace_files
+    return bool(find_trace_files(logdir))
+
+
+def _kind(d: Dict[str, Any]) -> str:
+    if "categories" in d and "device" in d:
+        return "breakdown"
+    if "metric" in d and "value" in d:
+        return "bench"
+    raise ValueError(
+        "unrecognized comparison input: expected a pyprof breakdown "
+        "(categories/device keys) or a BENCH row (metric/value keys), "
+        f"got keys {sorted(d)[:8]}")
+
+
+def _breakdown_metrics(d: Dict[str, Any]) -> Dict[str, float]:
+    """Lower-is-better per-step seconds the gate watches."""
+    steps = max(int(d.get("steps", 1)), 1)
+    dev = d.get("device", {})
+    cats = d.get("categories", {})
+    out = {"device_busy_s": float(dev.get("busy_s", 0.0)) / steps}
+    for k in ("compute", "collective"):
+        if k in cats:
+            out[f"{k}_s"] = float(cats[k].get("s", 0.0)) / steps
+    return {k: v for k, v in out.items() if v > 0}
+
+
+def compare_dicts(a: Dict[str, Any], b: Dict[str, Any], *,
+                  max_regress_pct: float) -> Tuple[List[str], List[str]]:
+    """(report_lines, regressions). Both inputs must be the same kind."""
+    ka, kb = _kind(a), _kind(b)
+    if ka != kb:
+        raise ValueError(f"cannot compare a {ka} against a {kb}")
+    lines: List[str] = []
+    regressions: List[str] = []
+    tol = max_regress_pct / 100.0
+    if ka == "bench":
+        va, vb = float(a["value"]), float(b["value"])
+        delta = (vb - va) / va * 100.0 if va else 0.0
+        lines.append(f"{a.get('metric', 'value')}: {va:.1f} -> {vb:.1f} "
+                     f"({delta:+.1f}%)")
+        if va > 0 and vb < va * (1.0 - tol):
+            regressions.append(
+                f"throughput regressed {-delta:.1f}% "
+                f"(> {max_regress_pct:g}% tolerated)")
+        return lines, regressions
+    ma, mb = _breakdown_metrics(a), _breakdown_metrics(b)
+    for key in ma:
+        if key not in mb:
+            continue
+        va, vb = ma[key], mb[key]
+        delta = (vb - va) / va * 100.0
+        lines.append(f"{key}: {va * 1e3:.2f} ms -> {vb * 1e3:.2f} ms "
+                     f"({delta:+.1f}%/step)")
+        if vb > va * (1.0 + tol):
+            regressions.append(
+                f"{key} regressed {delta:+.1f}% (> {max_regress_pct:g}% "
+                "tolerated)")
+    ga = a.get("dispatch_gap_pct")
+    gb = b.get("dispatch_gap_pct")
+    if ga is not None and gb is not None:
+        lines.append(f"dispatch_gap_pct: {ga:.1f} -> {gb:.1f}")
+    if not lines:
+        raise ValueError("no comparable metrics between the two inputs")
+    return lines, regressions
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.cmd == "summarize":
+        from apex_tpu.pyprof.prof import summarize_trace
+        try:
+            print(summarize_trace(args.path, top=args.top))
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        return 0
+
+    if args.cmd == "report":
+        from apex_tpu.pyprof.capture import format_breakdown
+        try:
+            bd = _load_breakdown(args.path)
+            _kind(bd)  # validates
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        if _kind(bd) != "breakdown":
+            print(f"error: {args.path} is not a capture logdir or "
+                  "breakdown JSON", file=sys.stderr)
+            return 1
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(bd, f, indent=1, sort_keys=True)
+        print(json.dumps(bd, indent=1, sort_keys=True) if args.json
+              else format_breakdown(bd, top=args.top))
+        return 0
+
+    # compare
+    try:
+        a = _load_breakdown(args.baseline)
+        b = _load_breakdown(args.new)
+        lines, regressions = compare_dicts(
+            a, b, max_regress_pct=args.max_regress)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    for line in lines:
+        print(line)
+    if regressions:
+        for r in regressions:
+            print(f"REGRESSION: {r}", file=sys.stderr)
+        return EXIT_REGRESSION
+    print(f"ok: within {args.max_regress:g}% of baseline")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

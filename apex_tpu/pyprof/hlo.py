@@ -1,0 +1,302 @@
+"""Optimized-HLO text parsing — the join key between profiler traces and
+apex subsystems (the TPU counterpart of the reference pyprof's
+kernel→NVTX-marker join, apex/pyprof/parse/kernel.py + nvvp marker
+tables, and of its per-kernel FLOP calculators, prof/linear.py,
+prof/conv.py, ...).
+
+``jax.profiler`` trace events carry only the post-optimization HLO
+instruction name (``dot.7``) in ``args.hlo_op`` — the ``jax.named_scope``
+path the user wrote lives in the compiled module's per-instruction
+``metadata={op_name="jit(f)/jit(main)/myattn/dot_general"}``. This module
+parses ``compiled.as_text()`` into per-instruction records:
+
+  * ``op_name`` scope path, cleaned of tracing wrappers (``jvp(...)``,
+    ``transpose(...)``, ``jit(...)``), so forward and backward ops
+    attribute to the SAME user scope;
+  * FLOPs for ``dot`` and ``convolution`` from the printed shapes and
+    contraction/window attributes (the reference's per-kernel FLOP
+    analysis, without hand-written per-op calculators for everything
+    else);
+  * a bytes estimate (operand + result sizes) — for a fusion this is the
+    fusion's own operands/result, i.e. the actual memory traffic of the
+    fused kernel, which is exactly the roofline numerator you want.
+
+Everything is best-effort and fail-soft: an instruction the regexes
+don't understand yields a record with ``flops=None`` rather than an
+error — attribution must never be the thing that crashes a run.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["Instruction", "HloModule", "parse_hlo_text", "clean_op_name",
+           "scope_of"]
+
+# dtype token -> bytes per element (HLO shape prefixes)
+_DTYPE_BYTES = {
+    "pred": 1, "s2": 1, "u2": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1,
+    "f8e5m2fnuz": 1, "token": 0, "opaque": 0,
+}
+
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_METADATA_RE = re.compile(r'op_name="([^"]*)"')
+_CALLS_RE = re.compile(r"(?:calls|to_apply|body)=%([\w.\-]+)")
+_INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=\s*(.*)$")
+# computation header: "%name (params...) -> result {" — the param list
+# can nest parens (tuple-typed while-carries), so only the leading name
+# is matched and the "->" presence gates
+_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s*\(")
+_CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{([0-9,]*)\}")
+_WINDOW_SIZE_RE = re.compile(r"window=\{[^}]*?size=([0-9x]+)")
+_DIM_LABELS_RE = re.compile(r"dim_labels=([\w?]+)_([\w?]+)->")
+_FEATURE_GROUP_RE = re.compile(r"feature_group_count=(\d+)")
+
+
+def _shapes_in(text: str) -> List[Tuple[str, List[int]]]:
+    """All (dtype, dims) shape literals in ``text``, in order."""
+    out = []
+    for dt, dims in _SHAPE_RE.findall(text):
+        if dt not in _DTYPE_BYTES:
+            continue
+        out.append((dt, [int(d) for d in dims.split(",")] if dims else []))
+    return out
+
+
+def _nbytes(shapes: List[Tuple[str, List[int]]]) -> int:
+    total = 0
+    for dt, dims in shapes:
+        n = 1
+        for d in dims:
+            n *= d
+        total += n * _DTYPE_BYTES.get(dt, 0)
+    return total
+
+
+def _prod(xs) -> int:
+    n = 1
+    for x in xs:
+        n *= x
+    return n
+
+
+@dataclass
+class Instruction:
+    """One parsed HLO instruction."""
+
+    name: str
+    opcode: str
+    op_name: str = ""                     # raw metadata op_name
+    result_shapes: List[Tuple[str, List[int]]] = field(default_factory=list)
+    operand_shapes: List[Tuple[str, List[int]]] = field(default_factory=list)
+    flops: Optional[float] = None         # own dot/conv flops (not callees)
+    called: List[str] = field(default_factory=list)
+
+    @property
+    def bytes_accessed(self) -> int:
+        return _nbytes(self.result_shapes) + _nbytes(self.operand_shapes)
+
+
+@dataclass
+class HloModule:
+    name: str
+    computations: Dict[str, List[Instruction]] = field(default_factory=dict)
+    # instruction name -> record, module-wide (HLO names are unique)
+    instructions: Dict[str, Instruction] = field(default_factory=dict)
+    entry: str = ""
+
+    def flops_of(self, instr_name: str, _depth: int = 0) -> Optional[float]:
+        """FLOPs of an instruction INCLUDING its called computations
+        (fusion/call bodies) — the number the profiler event for that
+        instruction actually executed. While bodies count once (the same
+        trip-count caveat as XLA's own cost model)."""
+        ins = self.instructions.get(instr_name)
+        if ins is None:
+            return None
+        total = ins.flops or 0.0
+        if _depth < 8:
+            for comp in ins.called:
+                for sub in self.computations.get(comp, ()):
+                    f = self.flops_of(sub.name, _depth + 1)
+                    if f:
+                        total += f
+        return total or None
+
+
+def _dot_flops(rest: str, result: List[Tuple[str, List[int]]],
+               operands: List[Tuple[str, List[int]]]) -> Optional[float]:
+    """2 * prod(result dims) * prod(lhs contracting dim sizes) — the
+    MAC=2 convention. Result dims already include batch dims."""
+    if not result or not operands:
+        return None
+    m = _CONTRACT_RE.search(rest)
+    if not m:
+        return None
+    lhs_dims = operands[0][1]
+    try:
+        contract = _prod(lhs_dims[int(i)]
+                         for i in m.group(1).split(",") if i != "")
+    except (IndexError, ValueError):
+        return None
+    return 2.0 * _prod(result[0][1]) * contract
+
+
+def _conv_flops(rest: str, result: List[Tuple[str, List[int]]],
+                operands: List[Tuple[str, List[int]]]) -> Optional[float]:
+    """2 * prod(result dims) * prod(window) * in_features / groups."""
+    if not result or len(operands) < 2:
+        return None
+    mw = _WINDOW_SIZE_RE.search(rest)
+    ml = _DIM_LABELS_RE.search(rest)
+    if not mw or not ml:
+        return None
+    window = _prod(int(s) for s in mw.group(1).split("x"))
+    rhs_labels = ml.group(2)
+    if "i" not in rhs_labels:
+        return None
+    in_feat = operands[1][1][rhs_labels.index("i")]
+    mg = _FEATURE_GROUP_RE.search(rest)
+    groups = int(mg.group(1)) if mg else 1
+    return 2.0 * _prod(result[0][1]) * window * in_feat / max(groups, 1)
+
+
+def _parse_instruction(line: str) -> Optional[Instruction]:
+    m = _INSTR_RE.match(line)
+    if not m:
+        return None
+    name, rest = m.group(1), m.group(2)
+    # split "<result type> <opcode>(operands...), attrs"
+    if rest.startswith("("):            # tuple result type
+        depth = 0
+        for i, ch in enumerate(rest):
+            depth += ch == "("
+            depth -= ch == ")"
+            if depth == 0:
+                break
+        result_txt, rest2 = rest[:i + 1], rest[i + 1:].lstrip()
+    else:
+        parts = rest.split(" ", 1)
+        if len(parts) != 2:
+            return None
+        result_txt, rest2 = parts
+    om = re.match(r"([\w\-]+)\(", rest2)
+    if not om:
+        return None
+    opcode = om.group(1)
+    # operand list: the first balanced paren group after the opcode
+    depth, start = 0, rest2.index("(")
+    end = start
+    for i in range(start, len(rest2)):
+        depth += rest2[i] == "("
+        depth -= rest2[i] == ")"
+        if depth == 0:
+            end = i
+            break
+    operand_txt = rest2[start + 1:end]
+    attrs = rest2[end + 1:]
+    mm = _METADATA_RE.search(attrs)
+    ins = Instruction(
+        name=name, opcode=opcode,
+        op_name=mm.group(1) if mm else "",
+        result_shapes=_shapes_in(result_txt),
+        operand_shapes=_shapes_in(operand_txt),
+        called=_CALLS_RE.findall(attrs),
+    )
+    try:
+        if opcode == "dot":
+            ins.flops = _dot_flops(attrs, ins.result_shapes,
+                                   ins.operand_shapes)
+        elif opcode == "convolution":
+            ins.flops = _conv_flops(attrs, ins.result_shapes,
+                                    ins.operand_shapes)
+    except Exception:
+        ins.flops = None
+    return ins
+
+
+def parse_hlo_text(text: str) -> HloModule:
+    """Parse ``compiled.as_text()`` into an :class:`HloModule`. Tolerant:
+    unrecognized lines are skipped, so HLO dialect drift across jax
+    versions degrades attribution instead of raising."""
+    mod = HloModule(name="")
+    current: Optional[str] = None
+    for line in text.splitlines():
+        s = line.strip()
+        if not s:
+            continue
+        if s.startswith("HloModule"):
+            mod.name = s.split(",", 1)[0].split()[1].strip()
+            continue
+        if s.endswith("{") and "=" not in s.split("(")[0] \
+                and "->" in s:
+            head = s.rstrip("{").strip()
+            cm = _COMP_RE.match(head)
+            if cm:
+                current = cm.group(1)
+                mod.computations.setdefault(current, [])
+                if head.startswith("ENTRY") or "ENTRY" in line:
+                    mod.entry = current
+            continue
+        if s == "}":
+            current = None
+            continue
+        if current is None or "=" not in s:
+            continue
+        ins = _parse_instruction(s)
+        if ins is not None:
+            mod.computations[current].append(ins)
+            mod.instructions[ins.name] = ins
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# op_name -> user scope path
+# ---------------------------------------------------------------------------
+
+# transform wrappers jax layers onto scope segments; unwrapping them makes
+# forward ("jvp(attn)") and backward ("transpose(jvp(attn))") ops land in
+# the SAME bucket — grad-time attention is still attention time
+_WRAPPER_RE = re.compile(
+    r"^(?:jit|pjit|jvp|vjp|transpose|vmap|pmap|xmap|custom_jvp|custom_vjp|"
+    r"custom_vjp_call|checkpoint|remat|rematted_computation|shard_map|"
+    r"named|core_call)\((.*)\)$")
+
+# structural segments that carry no attribution information
+_NOISE_SEGMENTS = {"main", "shmap_body", "wrapped_fun", "wrapped",
+                   "unnamed_wrapped_function", ""}
+
+
+def _clean_segment(seg: str) -> str:
+    prev = None
+    while prev != seg:
+        prev = seg
+        m = _WRAPPER_RE.match(seg)
+        if m:
+            seg = m.group(1)
+    return seg
+
+
+def clean_op_name(op_name: str, *, drop_first: bool = True) -> str:
+    """``"jit(f)/jit(main)/transpose(jvp(attn))/dot_general"`` ->
+    ``"attn/dot_general"``. ``drop_first`` removes the entry-function
+    segment (``f``) that every op in the module shares."""
+    segs = [_clean_segment(s) for s in op_name.split("/")]
+    segs = [s for s in segs if s not in _NOISE_SEGMENTS]
+    if drop_first and len(segs) > 1:
+        segs = segs[1:]
+    return "/".join(segs)
+
+
+def scope_of(op_name: str) -> str:
+    """The scope PATH of an op (cleaned path minus the trailing primitive
+    segment) — empty for ops at module top level."""
+    cleaned = clean_op_name(op_name)
+    if "/" not in cleaned:
+        return ""
+    return cleaned.rsplit("/", 1)[0]

@@ -19,7 +19,36 @@ import re
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
-__all__ = ["TraceEvent", "Trace", "load_trace", "find_trace_files"]
+__all__ = ["TraceEvent", "Trace", "load_trace", "find_trace_files",
+           "union_us"]
+
+# Runtime bookkeeping frames that share the device lanes with real kernel
+# events (XLA:CPU thunk executors, thread-pool listeners, dispatch
+# plumbing). They are not ops: a ThunkExecutor "wait for completion" span
+# is the WHOLE dispatch and would double every breakdown that summed it
+# next to its children.
+_RUNTIME_FRAME_RE = re.compile(
+    r"(ThreadpoolListener|ThunkExecutor|TfrtCpu|PjitFunction|"
+    r"ParseArguments|CopyTo|CopyFrom|TransferTo|BufferFromHost|"
+    r"ExecuteHelper|RunId|EnqueueWork)", re.IGNORECASE)
+
+
+def union_us(intervals) -> float:
+    """Total length of the union of (start_us, end_us) intervals — busy
+    time without double-counting concurrent lanes."""
+    ivs = sorted((s, e) for s, e in intervals if e > s)
+    total = 0.0
+    cur_s = cur_e = None
+    for s, e in ivs:
+        if cur_e is None or s > cur_e:
+            if cur_e is not None:
+                total += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    if cur_e is not None:
+        total += cur_e - cur_s
+    return total
 
 
 @dataclass
@@ -59,6 +88,34 @@ class TraceEvent:
         return self.name
 
 
+def _leaves_of(evs: List["TraceEvent"]) -> List["TraceEvent"]:
+    """Innermost events per (pid, tid) lane: an event with a strictly
+    nested event on its own lane is an enclosing span, not a kernel."""
+    out: List[TraceEvent] = []
+    lanes: Dict[Tuple[int, int], List[TraceEvent]] = {}
+    for e in evs:
+        lanes.setdefault((e.pid, e.tid), []).append(e)
+    for lane_evs in lanes.values():
+        lane_evs.sort(key=lambda ev: (ev.ts_us, -ev.dur_us))
+        stack: List[list] = []   # [event, has_child]
+
+        def pop_leafward():
+            ev, has_child = stack.pop()
+            if not has_child:
+                out.append(ev)
+
+        for e in lane_evs:
+            while stack and e.ts_us >= (stack[-1][0].ts_us
+                                        + stack[-1][0].dur_us - 1e-6):
+                pop_leafward()
+            if stack:
+                stack[-1][1] = True
+            stack.append([e, False])
+        while stack:
+            pop_leafward()
+    return out
+
+
 class Trace:
     """Parsed trace: event list + aggregation helpers."""
 
@@ -89,29 +146,39 @@ class Trace:
             evs = [e for e in evs
                    if "xla ops" in e.thread.lower()
                    or "stream" in e.thread.lower()]
-        out: List[TraceEvent] = []
-        lanes: Dict[Tuple[int, int], List[TraceEvent]] = {}
-        for e in evs:
-            lanes.setdefault((e.pid, e.tid), []).append(e)
-        for evs in lanes.values():
-            evs.sort(key=lambda ev: (ev.ts_us, -ev.dur_us))
-            stack: List[list] = []   # [event, has_child]
+        return _leaves_of(evs)
 
-            def pop_leafward():
-                ev, has_child = stack.pop()
-                if not has_child:
-                    out.append(ev)
+    def kernel_events(self) -> List[TraceEvent]:
+        """Device events that are actual kernels. When the trace carries
+        ``hlo_op``-attributed events (XLA:CPU and TPU runtimes both emit
+        them), the leaf-nesting pass runs on THAT subset only — XLA:CPU
+        interleaves zero-duration thread-pool bookkeeping events inside a
+        kernel's span, which would otherwise mark every real kernel a
+        'container' (a ``call`` that spans its fusion still collapses to
+        the fusion). Traces without hlo attribution fall back to the leaf
+        device events minus known runtime bookkeeping frames."""
+        hlo_evs = [e for e in self.device_events()
+                   if e.args.get("hlo_op")]
+        if hlo_evs:
+            return _leaves_of(hlo_evs)
+        return [e for e in self.leaf_device_events()
+                if not _RUNTIME_FRAME_RE.search(e.name)]
 
-            for e in evs:
-                while stack and e.ts_us >= (stack[-1][0].ts_us
-                                            + stack[-1][0].dur_us - 1e-6):
-                    pop_leafward()
-                if stack:
-                    stack[-1][1] = True
-                stack.append([e, False])
-            while stack:
-                pop_leafward()
-        return out
+    def device_window_us(self) -> Tuple[float, float]:
+        """(start, end) timestamps spanning all kernel events — the
+        device timeline window whose gaps are idle/dispatch time."""
+        evs = self.kernel_events()
+        if not evs:
+            return (0.0, 0.0)
+        return (min(e.ts_us for e in evs),
+                max(e.ts_us + e.dur_us for e in evs))
+
+    def busy_us(self, events: Optional[List[TraceEvent]] = None) -> float:
+        """Union-of-intervals busy time over ``events`` (default: the
+        kernel events) — concurrent lanes (compute vs DMA units, CPU
+        worker threads) are not double-counted."""
+        evs = self.kernel_events() if events is None else events
+        return union_us((e.ts_us, e.ts_us + e.dur_us) for e in evs)
 
     def total_device_time_us(self) -> float:
         """Leaf device time summed across ALL device lanes — on an
@@ -209,6 +276,11 @@ def find_trace_files(logdir: str) -> List[str]:
     out: List[str] = []
     for p in pats:
         for f in sorted(glob.glob(p)):
+            base = os.path.basename(f)
+            # pyprof's own capture artifacts live next to the trace and
+            # also end in .json(.gz) — they are not traces
+            if base.startswith("apex_pyprof_") or base == "breakdown.json":
+                continue
             if f not in out:
                 out.append(f)
     return out

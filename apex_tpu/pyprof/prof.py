@@ -19,10 +19,20 @@ def analyze(fn: Callable, *args, static_argnums=(), **kwargs) -> Dict[str, Any]:
     Cost-analysis key spellings differ across jax versions ("bytes
     accessed" vs "bytes_accessed"); both are accepted via
     :func:`apex_tpu._compat.cost_analysis_value`."""
-    from apex_tpu._compat import cost_analysis_value
     compiled = (jax.jit(fn, static_argnums=static_argnums)
                 .lower(*args, **kwargs).compile())
-    cost = compiled.cost_analysis() or {}
+    return analyze_compiled(compiled)
+
+
+def analyze_compiled(compiled) -> Dict[str, Any]:
+    """:func:`analyze` over an already-compiled executable (the capture
+    path lowers once and reuses the same compiled object for the HLO
+    scope map and this cost analysis)."""
+    from apex_tpu._compat import cost_analysis_value
+    try:
+        cost = compiled.cost_analysis() or {}
+    except Exception:
+        cost = {}
     if isinstance(cost, (list, tuple)):
         cost = cost[0] if cost else {}
     out: Dict[str, Any] = {

@@ -1,0 +1,101 @@
+"""Roofline classification — memory-bound vs compute-bound, per op and
+per subsystem bucket (the role of the reference pyprof's per-kernel
+efficiency columns, prof/output.py "sil%"/"tc" — recast in roofline
+terms because on TPU the cost model, not a kernel database, supplies
+FLOPs and bytes).
+
+The ridge point is ``peak_flops / peak_bytes_per_s`` (FLOP per byte): an
+op whose arithmetic intensity sits below it cannot reach peak FLOP/s no
+matter how good the kernel — it is bandwidth-limited. Intensities come
+from :mod:`apex_tpu.pyprof.hlo` (dot/conv FLOPs from the printed shapes,
+bytes from operand+result sizes); the whole-program numbers come from
+XLA's own cost analysis. Collectives classify as ``network`` — their
+roofline is the ICI/DCN, not HBM.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+__all__ = ["device_peak_bytes_per_s", "ridge_intensity", "classify",
+           "program_roofline", "PEAK_HBM_BW", "PEAK_CPU_BW_NOMINAL"]
+
+# Peak HBM bandwidth (bytes/s) per chip by device_kind substring — the
+# roofline's memory ceiling (companion of prof.PEAK_BF16). Override with
+# APEX_TPU_PEAK_BW for new chips.
+PEAK_HBM_BW = [
+    ("v5 lite", 8.19e11), ("v5e", 8.19e11),
+    ("v5p", 2.765e12), ("v4", 1.228e12), ("v6", 1.64e12),
+]
+
+# Nominal main-memory bandwidth for the XLA CPU backend (~100 GB/s, a
+# contemporary DDR5 host) — like prof.PEAK_CPU_NOMINAL this makes CPU
+# classification a sane relative signal for CI, not a roofline claim.
+PEAK_CPU_BW_NOMINAL = 1e11
+
+
+def device_peak_bytes_per_s(device=None) -> float:
+    """Peak memory bandwidth of ``device`` (default: first local device).
+    Same resolution ladder as :func:`~apex_tpu.pyprof.prof.
+    device_peak_flops`: known TPU generations from the table, CPU nominal,
+    APEX_TPU_PEAK_BW env override wins everywhere."""
+    import jax
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    env = os.environ.get("APEX_TPU_PEAK_BW")
+    if env is not None:
+        return float(env)
+    for sub, bw in PEAK_HBM_BW:
+        if sub in kind:
+            return bw
+    if getattr(device, "platform", "") == "cpu":
+        return PEAK_CPU_BW_NOMINAL
+    return 8.19e11
+
+
+def ridge_intensity(peak_flops: float, peak_bytes_per_s: float) -> float:
+    """The roofline ridge point in FLOP/byte: below it, memory-bound."""
+    return peak_flops / max(peak_bytes_per_s, 1.0)
+
+
+def classify(flops: Optional[float], nbytes: Optional[float], *,
+             ridge: float, is_collective: bool = False) -> str:
+    """One op's verdict: ``network`` (collectives), ``compute-bound``
+    (intensity at/above the ridge), ``memory-bound`` (below it, or no
+    FLOPs at all — pure data movement), or ``unknown`` (nothing
+    parseable)."""
+    if is_collective:
+        return "network"
+    if not nbytes:
+        return "unknown"
+    if not flops:
+        return "memory-bound"
+    return ("compute-bound" if flops / nbytes >= ridge
+            else "memory-bound")
+
+
+def program_roofline(stats: Dict[str, Any], *, peak_flops: float,
+                     peak_bytes_per_s: float) -> Dict[str, Any]:
+    """Whole-program roofline from an :func:`~apex_tpu.pyprof.prof.
+    analyze` dict: measured intensity vs the ridge, plus the two ceiling
+    times (compute floor at peak FLOP/s, memory floor at peak B/s) whose
+    max is the roofline-optimal step time."""
+    flops = stats.get("flops")
+    nbytes = stats.get("bytes_accessed")
+    ridge = ridge_intensity(peak_flops, peak_bytes_per_s)
+    out: Dict[str, Any] = {
+        "peak_flops": peak_flops,
+        "peak_bytes_per_s": peak_bytes_per_s,
+        "ridge_intensity": ridge,
+        "program_flops": flops,
+        "program_bytes": nbytes,
+    }
+    if flops and nbytes:
+        out["program_intensity"] = flops / nbytes
+        out["classification"] = classify(flops, nbytes, ridge=ridge)
+        out["compute_floor_s"] = flops / peak_flops
+        out["memory_floor_s"] = nbytes / peak_bytes_per_s
+        out["roofline_floor_s"] = max(out["compute_floor_s"],
+                                      out["memory_floor_s"])
+    return out

@@ -370,10 +370,36 @@ def summarize(events: List[Dict[str, Any]], *,
     if comm:
         out["comm"] = comm
 
+    # profile breakdown (producer: pyprof.record_breakdown after a
+    # BENCH_PROFILE / --profile capture) — its statics get their own
+    # section instead of the generic table, rendered as the device
+    # timeline + per-subsystem scope table
+    profile: Dict[str, Any] = {}
+    prof_scopes: Dict[str, Dict[str, Any]] = {}
     # other static facts (model flops, bucket counts, ...)
-    statics = {e["name"]: e["value"] for e in events
-               if e.get("kind") == "static"
-               and (e.get("meta") or {}).get("axis") is None}
+    statics = {}
+    for e in events:
+        if e.get("kind") != "static" \
+                or (e.get("meta") or {}).get("axis") is not None:
+            continue
+        name = e["name"]
+        if "profile/" in name:
+            key = name.split("profile/", 1)[1]
+            if key.startswith("scope/"):
+                meta = e.get("meta") or {}
+                prof_scopes[key[len("scope/"):]] = {
+                    "us": float(e["value"]),
+                    "pct": meta.get("pct"),
+                    "bound": meta.get("bound"),
+                }
+            else:
+                profile[key] = float(e["value"])
+        else:
+            statics[name] = e["value"]
+    if prof_scopes:
+        profile["scopes"] = prof_scopes
+    if profile:
+        out["profile"] = profile
     if statics:
         out["static"] = statics
 
@@ -633,6 +659,23 @@ def format_summary(s: Dict[str, Any]) -> str:
                              f"{_fmt_si(c['bytes_in'])}B")
             for name, v in sorted(rec.get("producers", {}).items()):
                 lines.append(f"    of which {name}: {_fmt_si(v)}B")
+    if s.get("profile"):
+        p = s["profile"]
+        parts = [f"{k.replace('_pct', '')} {p[k]:.1f}%"
+                 for k in ("compute_pct", "collective_pct", "idle_pct")
+                 if k in p]
+        if "dispatch_gap_pct" in p:
+            parts.append(f"dispatch gap {p['dispatch_gap_pct']:.1f}%")
+        lines.append("profile (device timeline): " + "   ".join(parts))
+        if "overlap_efficiency" in p:
+            lines.append(f"  overlap efficiency (device timestamps): "
+                         f"{p['overlap_efficiency']:.1%}")
+        for name, r in sorted((p.get("scopes") or {}).items(),
+                              key=lambda kv: -kv[1]["us"]):
+            pct = f" ({r['pct']:.1f}%)" if r.get("pct") is not None else ""
+            bound = f" [{r['bound']}]" if r.get("bound") else ""
+            lines.append(f"  scope {name:<20} {r['us'] / 1e3:9.2f} ms"
+                         f"{pct}{bound}")
     if s.get("static"):
         for name, v in sorted(s["static"].items()):
             lines.append(f"{name:<28} {_fmt_si(v)}")

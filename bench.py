@@ -16,9 +16,15 @@ mfu is roofline-honest: model FLOPs are taken from XLA's own cost analysis of
 the compiled train step (MAC=2 convention, the standard MFU accounting), and
 peak from the chip generation (v5e bf16 = 197 TFLOP/s).
 
-BENCH_PROFILE=1 additionally captures a jax.profiler trace of the measured
-loop and writes a per-category/per-op summary via pyprof.summarize_trace to
-benchmarks/trace_summary_resnet50.txt.
+BENCH_PROFILE=dir (or 1 for benchmarks/profile_resnet50) runs the
+pyprof attribution capture on the measured loop: the trace + sidecar land
+in the dir (offline report: `python -m apex_tpu.pyprof report <dir>`),
+the per-subsystem breakdown (compute/collective/idle split, roofline
+verdicts, overlap efficiency from device timestamps) is embedded under
+the BENCH JSON's "profile" key, and the legacy per-op summary still
+lands in benchmarks/trace_summary_resnet50.txt. The BENCH JSON always
+carries "dispatch_gap_pct" and "profile" (null when unavailable/off) so
+BENCH_r*.json rows stay schema-comparable across rounds.
 """
 
 import json
@@ -300,6 +306,14 @@ def main():
         f"{inner_steps} per dispatch)")
 
     img_s = img_s_dev if img_s_dev > 0 else img_s_wall
+    # device-vs-wall reconciliation: the share of wall time the device
+    # sat idle (dispatch/host overhead). Emitted ALWAYS (null when no
+    # device clock exists and no profile ran) so BENCH_r*.json rows stay
+    # schema-comparable; the profile capture below fills it on CPU.
+    dispatch_gap_pct = None
+    if img_s_dev > 0 and img_s_wall > 0:
+        dispatch_gap_pct = round(
+            100.0 * max(0.0, 1.0 - img_s_wall / img_s_dev), 2)
     result = {
         "metric": ("resnet50_train_img_per_sec_amp_O5_bf16(O2-equiv)"
                    if opt_level == "O5" else
@@ -309,6 +323,8 @@ def main():
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
         "clock": "device" if img_s_dev > 0 else "wall",
         "wall_img_s": round(img_s_wall, 1),
+        "dispatch_gap_pct": dispatch_gap_pct,
+        "profile": None,
         "tune": tune_cfg,
         "overlap": {"enabled": overlap_on, "reduce_dtype": reduce_dtype,
                     "adasum": adasum},
@@ -322,6 +338,54 @@ def main():
             log(f"MFU {result['mfu']:.1%} ({result['tflops']} TFLOP/s of "
                 f"{peak_flops(dev) / 1e12:.0f} peak, "
                 f"{result['model_gflop_per_img']} GFLOP/img)")
+
+    # BENCH_PROFILE: pyprof attribution capture of the measured loop —
+    # runs BEFORE the telemetry export so the profile/* events join the
+    # JSONL (telemetry summarize then renders the profile section).
+    if os.environ.get("BENCH_PROFILE"):
+        from apex_tpu import pyprof
+        prof_env = os.environ.get("BENCH_PROFILE")
+        trace_dir = (os.path.join(os.path.dirname(__file__) or ".",
+                                  "benchmarks", "profile_resnet50")
+                     if prof_env in ("1", "true", "yes") else prof_env)
+
+        def prof_runner():
+            nonlocal params, batch_stats, opt_state
+            params, batch_stats, opt_state, loss = multi_fn(
+                params, batch_stats, opt_state, (x, y))
+            jax.block_until_ready(loss)
+
+        # multi_fn is BOTH the HLO source (AOT lower, donation untouched)
+        # and — via the rebinding runner — the profiled body, so trace
+        # hlo_op names join the right module's scope metadata
+        bd = pyprof.capture(multi_fn, params, batch_stats, opt_state,
+                            (x, y), runner=prof_runner, steps=2,
+                            warmup=0, logdir=trace_dir)
+        cats = bd["categories"]
+        result["profile"] = {
+            "logdir": trace_dir,
+            "categories": {k: v["pct"] for k, v in cats.items()},
+            "subsystems": {k: v["pct"]
+                           for k, v in bd["subsystems"].items()},
+            "overlap_efficiency": bd["overlap"].get("efficiency"),
+            "dispatch_gap_pct": bd["dispatch_gap_pct"],
+        }
+        if result["dispatch_gap_pct"] is None:
+            # no device clock on this backend: the capture's own
+            # device-timeline gap is the reconciliation figure
+            result["dispatch_gap_pct"] = bd["dispatch_gap_pct"]
+        if tel_path:
+            pyprof.record_breakdown(bd)
+        out_path = os.path.join(os.path.dirname(__file__) or ".",
+                                "benchmarks", "trace_summary_resnet50.txt")
+        with open(out_path, "w") as f:
+            f.write(f"# ResNet-50 amp {opt_level} train step, "
+                    f"batch={batch}, {inner_steps} steps per dispatch, "
+                    f"{dev}\n")
+            f.write(pyprof.format_breakdown(bd) + "\n\n")
+            f.write(pyprof.summarize_trace(trace_dir) + "\n")
+        log(f"profile breakdown -> {trace_dir} (report with `python -m "
+            f"apex_tpu.pyprof report {trace_dir}`); summary -> {out_path}")
 
     if tel_path:
         # static comm bill of the SINGLE-step program (the scan dispatch
@@ -367,22 +431,6 @@ def main():
         log(f"snapshot: {man['bytes'] / 1e6:.1f} MB, sync "
             f"{sync_s * 1e3:.0f} ms, async caller-side block "
             f"{async_block_s * 1e3:.0f} ms -> {snap_dir}")
-
-    if os.environ.get("BENCH_PROFILE"):
-        trace_dir = "/tmp/apex_tpu_bench_trace"
-        with jax.profiler.trace(trace_dir):
-            params, batch_stats, opt_state, loss = multi_fn(
-                params, batch_stats, opt_state, (x, y))
-            _ = float(loss)
-        from apex_tpu import pyprof
-        summary = pyprof.summarize_trace(trace_dir)
-        out_path = os.path.join(os.path.dirname(__file__) or ".",
-                                "benchmarks", "trace_summary_resnet50.txt")
-        with open(out_path, "w") as f:
-            f.write(f"# ResNet-50 amp O5 train step, batch={batch}, "
-                    f"{inner_steps} steps per dispatch, {dev}\n")
-            f.write(summary + "\n")
-        log(f"trace summary written to {out_path}")
 
     print(json.dumps(result))
 

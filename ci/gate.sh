@@ -12,7 +12,7 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "== 1/10 package import =="
+echo "== 1/11 package import =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import apex_tpu
@@ -20,7 +20,7 @@ from apex_tpu import amp, optimizers, parallel, ops
 print('apex_tpu imports OK')
 "
 
-echo "== 2/10 native host runtime builds (g++ -O3 -shared) =="
+echo "== 2/11 native host runtime builds (g++ -O3 -shared) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 from apex_tpu import runtime
@@ -35,7 +35,7 @@ print('flatten/unflatten path OK')
 assert ok, 'host runtime failed to build — check g++ toolchain'
 "
 
-echo "== 3/10 graft entry compiles (single-device + 8-device dryrun) =="
+echo "== 3/11 graft entry compiles (single-device + 8-device dryrun) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import __graft_entry__ as ge
@@ -45,7 +45,7 @@ print('entry() compiles')
 ge.dryrun_multichip(8)
 "
 
-echo "== 4/10 package install (wheel build + clean --target install) =="
+echo "== 4/11 package install (wheel build + clean --target install) =="
 # The reference gates on Docker extension builds
 # (tests/docker_extension_builds/run.sh); the TPU analog: build the wheel
 # from pyproject.toml, install it into an empty --target dir, and import
@@ -88,14 +88,14 @@ jax.jit(step).lower(params, state).compile()
 print('installed-package train step compiles')
 ")
 
-echo "== 5/10 lint (apex_tpu.lint: trace safety / dtype policy / collectives) =="
+echo "== 5/11 lint (apex_tpu.lint: trace safety / dtype policy / collectives) =="
 # static gate BEFORE the test tier: AST pass over the package + graft
 # entry, jaxpr pass over the registered entry points. --strict: warnings
 # fail too (every intentional exception carries an inline suppression
 # with its why — see docs/lint.md). Use --format=github under CI bots.
 python -m apex_tpu.lint apex_tpu/ __graft_entry__.py --strict
 
-echo "== 6/10 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
+echo "== 6/11 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
 # A 3-step instrumented GPT train step on the CPU mesh must produce a
 # parseable JSONL carrying step timing, amp loss-scale/overflow, comm
 # bytes and MFU, and the summarize CLI must render it (exit 0) — the
@@ -168,7 +168,7 @@ fi
 echo "health CLI gate OK (healthy=0, injected-NaN=nonzero)"
 rm -rf "$(dirname "$HLT_FILE")"
 
-echo "== 7/10 tune smoke (sweep dry-run + auto-policy tuned train) =="
+echo "== 7/11 tune smoke (sweep dry-run + auto-policy tuned train) =="
 # The autotuner must be drivable offline (sweep plan renders, exit 0) and
 # inline: a 3-step train whose kernels resolve their configs through
 # apex_tpu.tune under APEX_TPU_TUNE=auto. On this CPU backend measurement
@@ -245,7 +245,7 @@ print(f'tune smoke OK: {len(tuned)} tune/* series, '
 " "$TUNE_DIR/tune_run.jsonl" "$TUNE_DIR/cache"
 rm -rf "$TUNE_DIR"
 
-echo "== 8/10 resilience smoke (snapshot -> injected kill -> auto-resume) =="
+echo "== 8/11 resilience smoke (snapshot -> injected kill -> auto-resume) =="
 # Kill-and-resume end to end: a 6-step train snapshotting every 2 steps is
 # SIGKILLed by the fault injector at the top of step 4 (exit 137 — an
 # abrupt death, no final snapshot), then the SAME command with --resume
@@ -302,7 +302,7 @@ python -m apex_tpu.telemetry summarize "$RES_DIR/resume.jsonl" \
     || { echo "summarize did not report the resume point" >&2; exit 1; }
 rm -rf "$RES_DIR"
 
-echo "== 9/10 overlap smoke (staged backward + bf16 wire vs fp32 baseline) =="
+echo "== 9/11 overlap smoke (staged backward + bf16 wire vs fp32 baseline) =="
 # The overlap engine end to end on the 8-device CPU mesh: a 3-step fp32
 # baseline train and the same train under --overlap --reduce-dtype bf16
 # must (a) land within 1e-2 of each other's final loss (the compression
@@ -358,7 +358,68 @@ python -m apex_tpu.telemetry summarize "$OVL_DIR/bf16.jsonl" \
     || { echo "summarize did not render overlap efficiency" >&2; exit 1; }
 rm -rf "$OVL_DIR"
 
-echo "== 10/10 pytest =="
+echo "== 10/11 profile smoke (capture -> attribution report -> compare gate) =="
+# The attribution profiler end to end on the CPU backend: a 3-step train
+# with --profile must produce a capture logdir whose offline report
+# parses with nonzero compute time and carries the named
+# attention/LN/DDP scopes; `pyprof compare` must exit 0 against itself
+# and exit the DOCUMENTED regression code (4) against a doctored
+# 10%-slower copy — a CLI that crashes (exit 1) must fail this gate.
+PROF_DIR="$(mktemp -d)"
+python examples/gpt/train_lm.py --steps 3 --warmup-steps 0 --vocab 512 \
+    --layers 2 --embed-dim 64 --heads 2 --seq-len 128 --batch-size 1 \
+    --opt-level O2 --profile "$PROF_DIR/capture" \
+    --telemetry "$PROF_DIR/run.jsonl" > /dev/null
+python -m apex_tpu.pyprof report "$PROF_DIR/capture" \
+    -o "$PROF_DIR/breakdown.json" > "$PROF_DIR/report.txt"
+python -c "
+import json, sys
+bd = json.load(open(sys.argv[1]))
+report = open(sys.argv[2]).read()
+cats = bd['categories']
+total = sum(v['pct'] for v in cats.values())
+assert abs(total - 100.0) < 0.5, f'categories sum to {total}, not 100'
+assert cats['compute']['pct'] > 0, 'no compute time attributed'
+assert bd['device']['busy_s'] > 0, 'empty device timeline'
+subs = bd['subsystems']
+for need in ('attention', 'layer_norm', 'collective/ddp'):
+    assert need in subs, f'missing {need} bucket; has {sorted(subs)}'
+assert any('attn' in s for s in bd['scopes']), 'no attention scope'
+assert bd['dispatch_gap_pct'] is not None
+assert 'attention' in report and 'collective/ddp' in report
+print(f'profile smoke OK: compute {cats[\"compute\"][\"pct\"]:.1f}%, '
+      f'collective {cats[\"collective\"][\"pct\"]:.1f}%, idle '
+      f'{cats[\"idle\"][\"pct\"]:.1f}%, dispatch gap '
+      f'{bd[\"dispatch_gap_pct\"]:.1f}%')
+" "$PROF_DIR/breakdown.json" "$PROF_DIR/report.txt"
+# telemetry renders the profile section from the recorded events
+python -m apex_tpu.telemetry summarize "$PROF_DIR/run.jsonl" \
+    | grep -q "profile (device timeline)" \
+    || { echo "summarize did not render the profile section" >&2; exit 1; }
+# self-compare: identical runs gate clean
+python -m apex_tpu.pyprof compare "$PROF_DIR/breakdown.json" \
+    "$PROF_DIR/breakdown.json" > /dev/null
+# doctored 10%-slower copy: demand the documented exit 4, not just nonzero
+python -c "
+import json, sys
+bd = json.load(open(sys.argv[1]))
+bd['device']['busy_s'] *= 1.10
+for c in bd['categories'].values():
+    c['s'] *= 1.10
+json.dump(bd, open(sys.argv[2], 'w'))
+" "$PROF_DIR/breakdown.json" "$PROF_DIR/slower.json"
+rc=0
+python -m apex_tpu.pyprof compare "$PROF_DIR/breakdown.json" \
+    "$PROF_DIR/slower.json" --max-regress 5 > /dev/null 2>&1 || rc=$?
+if [[ "$rc" -ne 4 ]]; then
+    echo "pyprof compare: expected the documented regression exit 4 on" \
+         "the doctored 10%-slower breakdown, got $rc" >&2
+    exit 1
+fi
+echo "compare gate OK (identical=0, doctored-slower=4)"
+rm -rf "$PROF_DIR"
+
+echo "== 11/11 pytest =="
 if [[ "${1:-}" == "--full" ]]; then
     # full suite + the complete L1 cross-product matrix (reference
     # tests/L1/cross_product{,_distributed}/run.sh); the convergence
@@ -371,7 +432,8 @@ else
     python -m pytest tests/test_multi_tensor.py tests/test_optimizers.py \
         tests/test_amp.py tests/test_param_groups.py tests/test_zero.py \
         tests/test_checkpoint.py tests/test_runtime.py tests/test_tune.py \
-        tests/test_resilience.py tests/test_overlap.py -q -x
+        tests/test_resilience.py tests/test_overlap.py \
+        tests/test_pyprof.py -q -x
 fi
 
 echo "CI GATE PASSED"

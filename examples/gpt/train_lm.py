@@ -114,6 +114,17 @@ def parse_args(argv=None):
     p.add_argument("--top-k", type=int, default=0)
     p.add_argument("--top-p", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="after training, run the pyprof attribution "
+                        "capture on the train step (a few extra profiled "
+                        "steps): jax.profiler trace + scope-join sidecar "
+                        "land in DIR, breakdown.json holds the "
+                        "compute/collective/idle split, per-subsystem "
+                        "buckets (attention/LN/DDP/optimizer) with "
+                        "roofline verdicts, and dispatch_gap_pct. "
+                        "Inspect with `python -m apex_tpu.pyprof report "
+                        "DIR`; gate with `... compare A B`. With "
+                        "--telemetry, profile/* events join the JSONL")
     p.add_argument("--telemetry", default=None, metavar="PATH",
                    help="write a runtime-telemetry JSONL here: per-step "
                         "dispatch/device time split, tokens/s, MFU, "
@@ -173,11 +184,12 @@ def _run_generate(args):
     from apex_tpu.models import TransformerLM
     from apex_tpu.models.gpt import generate
 
-    if args.seq_parallel or args.remat or args.loss_chunk:
+    if args.seq_parallel or args.remat or args.loss_chunk or args.profile:
         raise SystemExit(
             "--generate is a single-device inference mode: "
-            "--seq-parallel/--remat/--loss-chunk do not apply (the "
-            "number would describe a different model than the flags)")
+            "--seq-parallel/--remat/--loss-chunk/--profile do not apply "
+            "(the number would describe a different model than the "
+            "flags)")
     compute_dtype = amp.resolve(args.opt_level).cast_model_type
     total = args.prompt_len + args.generate
     model = TransformerLM(
@@ -357,8 +369,12 @@ def main(argv=None):
         # via gradient_average; with --overlap the grads already left
         # the backward reduced.
         if ddp is None:
-            grads = (jax.lax.psum(grads, axis) if args.seq_parallel
-                     else jax.lax.pmean(grads, axis))
+            # the named scope tags the grad collective in XLA metadata
+            # so profiler traces attribute it to DDP comm (pyprof's
+            # collective/ddp bucket) even on this plain-psum path
+            with jax.named_scope("apex_ddp_allreduce"):
+                grads = (jax.lax.psum(grads, axis) if args.seq_parallel
+                         else jax.lax.pmean(grads, axis))
         elif not ddp.overlap:
             grads = ddp.sync(grads, telemetry_step=ddp_step_idx)
         new_params, new_opt, _ = aopt.step(grads, params, opt_state)
@@ -398,6 +414,12 @@ def main(argv=None):
                 "--snapshot-dir/--resume need the per-step host loop; "
                 "--scan dispatches N steps per jitted call with no "
                 "host point to snapshot at")
+        if args.profile:
+            raise SystemExit(
+                "--profile captures the per-step program; under --scan "
+                "the dispatch is an N-step lax.scan whose breakdown "
+                "would describe the whole dispatch — run --profile "
+                "without --scan")
         return _run_scan_mode(args, mesh, axis, per_device, step_fn,
                               params, opt_state, batch, model)
 
@@ -583,6 +605,32 @@ def main(argv=None):
                    if flash_opaque else " (cost-analysis count)"))
     if msg:
         print(msg)
+    if args.profile:
+        # attribution capture on the live step (AOT lower for the scope
+        # map — donation untouched; the runner rebinds the donated
+        # carry, so these are a few extra real train steps)
+        from apex_tpu import pyprof
+        tokens, step_rng, mult = make_batch(args.steps)
+        carry = [params, opt_state]
+
+        def prof_runner():
+            carry[0], carry[1], lo = step_fn(carry[0], carry[1], tokens,
+                                             step_rng, mult)
+            jax.block_until_ready(lo)
+
+        bd = pyprof.capture(step_fn, params, opt_state, tokens, step_rng,
+                            mult, runner=prof_runner, steps=3, warmup=1,
+                            logdir=args.profile)
+        params, opt_state = carry
+        if args.telemetry:
+            pyprof.record_breakdown(bd)
+        cats = bd["categories"]
+        print("profile: " + "   ".join(
+            f"{k} {v['pct']:.1f}%" for k, v in cats.items())
+            + (f"   dispatch gap {bd['dispatch_gap_pct']:.1f}%"
+               if bd.get("dispatch_gap_pct") is not None else ""))
+        print(f"profile: {args.profile} (python -m apex_tpu.pyprof "
+              f"report {args.profile})")
     if detector is not None and detector.alerts:
         print(f"health: {len(detector.alerts)} divergence alert(s) fired "
               "— see lines above", file=sys.stderr)

@@ -1,0 +1,449 @@
+"""apex_tpu.pyprof attribution profiler: Chrome-trace parsing + scope
+join on the committed synthetic fixture (hermetic — no profiler run),
+HLO-text parsing (scope metadata, dot/conv FLOPs), roofline
+classification, the report/compare CLI exit-code contract, and a CPU
+end-to-end capture→report pass on a tiny jitted step."""
+
+import gzip
+import json
+import os
+import shutil
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import pyprof
+from apex_tpu.pyprof import cli as pyprof_cli
+from apex_tpu.pyprof import hlo as pyprof_hlo
+from apex_tpu.pyprof import roofline as pyprof_roofline
+from apex_tpu.pyprof.capture import (SIDECAR_NAME, compute_breakdown,
+                                     subsystem_of)
+from apex_tpu.pyprof.parse import load_trace, union_us
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "synthetic_trace.json")
+
+# the scope-join map matching the fixture's hlo_op names (what a capture
+# sidecar carries; built here by hand so no profiler run is needed)
+FIXTURE_MAP = {
+    "dot.1": {"scope": "block_0/attn", "flops": 524288.0, "bytes": 49152},
+    "call.9": {"scope": "block_0/ln1", "flops": None, "bytes": 16384},
+    "fusion.2": {"scope": "block_0/ln1", "flops": None, "bytes": 16384},
+    "all-reduce.3": {"scope": "apex_ddp_allreduce", "flops": None,
+                     "bytes": 8192},
+    "all-reduce.4": {"scope": "apex_zero_reduce_scatter", "flops": None,
+                     "bytes": 8192},
+}
+
+
+def _fixture_breakdown(**kw):
+    tr = load_trace(FIXTURE)
+    kw.setdefault("instr_map", FIXTURE_MAP)
+    kw.setdefault("module", "jit_step")
+    kw.setdefault("wall_s", 300e-6)
+    kw.setdefault("cost_stats", {"flops": 4e6, "bytes_accessed": 1e6})
+    kw.setdefault("peak_flops", 1e12)
+    kw.setdefault("peak_bytes_per_s", 1e11)
+    return compute_breakdown(tr, **kw)
+
+
+# ---------------------------------------------------------------------------
+# trace parsing
+# ---------------------------------------------------------------------------
+
+class TestParse:
+    def test_union_us(self):
+        assert union_us([(0, 10), (5, 15), (20, 30)]) == 25
+        assert union_us([]) == 0.0
+        assert union_us([(3, 3)]) == 0.0
+
+    def test_load_fields(self):
+        tr = load_trace(FIXTURE)
+        assert len(tr.events) == 7
+        ev = next(e for e in tr.events if e.name == "dot.1")
+        assert ev.process == "/device:TPU:0"
+        assert ev.thread == "XLA Ops"
+        assert ev.on_device
+        host = next(e for e in tr.events if "Pjit" in e.name)
+        assert not host.on_device
+
+    def test_kernel_events_nesting_and_runtime_frames(self):
+        """The container call.9 (spans fusion.2) and the zero-duration
+        thread-pool frame are excluded; the hlo_op population remains."""
+        tr = load_trace(FIXTURE)
+        names = sorted(e.name for e in tr.kernel_events())
+        assert names == ["all-reduce.3", "all-reduce.4", "dot.1",
+                         "fusion.2"]
+
+    def test_window_and_busy(self):
+        tr = load_trace(FIXTURE)
+        assert tr.device_window_us() == (0.0, 250.0)
+        assert tr.busy_us() == pytest.approx(200.0)
+
+
+# ---------------------------------------------------------------------------
+# scope join + breakdown (the fixture numbers are hand-derivable)
+# ---------------------------------------------------------------------------
+
+class TestBreakdown:
+    def test_categories_sum_to_100(self):
+        bd = _fixture_breakdown()
+        total = sum(v["pct"] for v in bd["categories"].values())
+        assert total == pytest.approx(100.0, abs=0.1)
+
+    def test_category_split(self):
+        """window 250us = compute [0,150] + exposed collective 50us
+        (all-reduce.3; all-reduce.4 hides behind fusion.2) + idle 50us."""
+        bd = _fixture_breakdown()
+        cats = bd["categories"]
+        assert cats["compute"]["pct"] == pytest.approx(60.0, abs=0.1)
+        assert cats["collective"]["pct"] == pytest.approx(20.0, abs=0.1)
+        assert cats["idle"]["pct"] == pytest.approx(20.0, abs=0.1)
+
+    def test_overlap_efficiency_from_device_timestamps(self):
+        """90us of collective, 40us hidden behind concurrent compute."""
+        bd = _fixture_breakdown()
+        ov = bd["overlap"]
+        assert ov["collective_s"] == pytest.approx(90e-6)
+        assert ov["hidden_s"] == pytest.approx(40e-6)
+        assert ov["efficiency"] == pytest.approx(40.0 / 90.0, abs=1e-3)
+
+    def test_subsystem_buckets(self):
+        bd = _fixture_breakdown()
+        subs = bd["subsystems"]
+        assert subs["attention"]["us"] == pytest.approx(100.0)
+        assert subs["layer_norm"]["us"] == pytest.approx(50.0)
+        assert subs["collective/ddp"]["us"] == pytest.approx(50.0)
+        assert subs["collective/zero"]["us"] == pytest.approx(40.0)
+        # subsystem table accounts for every kernel microsecond
+        assert sum(r["us"] for r in subs.values()) == pytest.approx(240.0)
+
+    def test_dispatch_gap(self):
+        bd = _fixture_breakdown()
+        # wall 300us, busy 200us -> 33.3% of wall the device sat idle
+        assert bd["dispatch_gap_pct"] == pytest.approx(33.33, abs=0.1)
+
+    def test_roofline_verdicts(self):
+        bd = _fixture_breakdown()
+        # ridge = 1e12/1e11 = 10 flop/B; dot.1 intensity 10.67 -> compute
+        assert bd["subsystems"]["attention"]["bound"] == "compute-bound"
+        assert bd["subsystems"]["collective/ddp"]["bound"] == "network"
+        rf = bd["roofline"]
+        assert rf["classification"] == "memory-bound"        # 4 < 10
+        assert rf["ridge_intensity"] == pytest.approx(10.0)
+
+    def test_degraded_without_map(self):
+        """No sidecar map: ops land by HLO-name category, nothing raises,
+        collectives still split out of compute."""
+        bd = _fixture_breakdown(instr_map={})
+        cats = bd["categories"]
+        assert sum(v["pct"] for v in cats.values()) == pytest.approx(
+            100.0, abs=0.1)
+        assert cats["collective"]["pct"] > 0
+
+    def test_subsystem_rules(self):
+        assert subsystem_of("block_0/attn") == "attention"
+        assert subsystem_of("TransformerLM/block_1/mlp/fc1") == "mlp"
+        assert subsystem_of("blk/ln2") == "layer_norm"
+        assert subsystem_of("stage3/block1") == "conv"
+        assert subsystem_of("cond/apex_optimizer_step") == "optimizer"
+        assert subsystem_of("apex_ddp_allreduce", "all-reduce.1") \
+            == "collective/ddp"
+        assert subsystem_of("apex_zero_reduce_scatter",
+                            "reduce-scatter.2") == "collective/zero"
+        assert subsystem_of("", "all-reduce.7") == "collective/other"
+        assert subsystem_of("tok_emb") == "embedding"
+        assert subsystem_of("head") == "head"
+        assert subsystem_of("loss") == "loss"
+        assert subsystem_of("something_else") == "other"
+
+
+# ---------------------------------------------------------------------------
+# HLO text parsing
+# ---------------------------------------------------------------------------
+
+_HLO_TEXT = """\
+HloModule jit_step, is_scheduled=true, entry_computation_layout={(f32[64,64]{1,0})->f32[64,64]{1,0}}
+
+%fused_computation (param_0: f32[64,64]) -> f32[64,64] {
+  %param_0 = f32[64,64]{1,0} parameter(0)
+  ROOT %multiply.1 = f32[64,64]{1,0} multiply(f32[64,64]{1,0} %param_0, f32[64,64]{1,0} %param_0), metadata={op_name="jit(step)/jit(main)/ln1/mul"}
+}
+
+%region_0.9 (arg_tuple.10: (s32[], f32[64,64])) -> (s32[], f32[64,64]) {
+  %arg_tuple.10 = (s32[], f32[64,64]{1,0}) parameter(0)
+  %get-tuple-element.1 = f32[64,64]{1,0} get-tuple-element((s32[], f32[64,64]{1,0}) %arg_tuple.10), index=1
+  %dot.5 = f32[64,64]{1,0} dot(f32[64,64]{1,0} %get-tuple-element.1, f32[64,64]{1,0} %get-tuple-element.1), lhs_contracting_dims={1}, rhs_contracting_dims={0}, metadata={op_name="jit(step)/jit(main)/jvp(while)/body/dot_general"}
+}
+
+ENTRY %main.10 (Arg_0.1: f32[64,64], Arg_1.2: f32[64,64], Arg_2.3: f32[1,32,32,3], Arg_3.4: f32[3,3,3,8]) -> f32[64,64] {
+  %Arg_0.1 = f32[64,64]{1,0} parameter(0), metadata={op_name="x"}
+  %Arg_1.2 = f32[64,64]{1,0} parameter(1), metadata={op_name="w"}
+  %Arg_2.3 = f32[1,32,32,3]{3,2,1,0} parameter(2)
+  %Arg_3.4 = f32[3,3,3,8]{3,2,1,0} parameter(3)
+  %dot.1 = f32[64,64]{1,0} dot(f32[64,64]{1,0} %Arg_0.1, f32[64,64]{1,0} %Arg_1.2), lhs_contracting_dims={1}, rhs_contracting_dims={0}, metadata={op_name="jit(step)/jit(main)/transpose(jvp(attn))/dot_general" source_file="x.py" source_line=4}
+  %convolution.5 = f32[1,32,32,8]{3,2,1,0} convolution(f32[1,32,32,3]{3,2,1,0} %Arg_2.3, f32[3,3,3,8]{3,2,1,0} %Arg_3.4), window={size=3x3 pad=1_1x1_1}, dim_labels=b01f_01io->b01f, metadata={op_name="jit(step)/jit(main)/stem/conv_general_dilated"}
+  ROOT %fusion.2 = f32[64,64]{1,0} fusion(f32[64,64]{1,0} %dot.1), kind=kLoop, calls=%fused_computation, metadata={op_name="jit(step)/jit(main)/ln1/mul"}
+}
+"""
+
+
+class TestHlo:
+    def test_parse_module(self):
+        mod = pyprof_hlo.parse_hlo_text(_HLO_TEXT)
+        assert mod.name == "jit_step"
+        assert mod.entry == "main.10"
+        # nested-paren while-body header parsed (a tuple-typed carry)
+        assert "region_0.9" in mod.computations
+        assert "dot.5" in mod.instructions
+
+    def test_dot_flops(self):
+        mod = pyprof_hlo.parse_hlo_text(_HLO_TEXT)
+        # 2 * 64*64 (out) * 64 (contraction)
+        assert mod.instructions["dot.1"].flops == pytest.approx(524288.0)
+        assert mod.instructions["dot.1"].bytes_accessed == 3 * 64 * 64 * 4
+
+    def test_conv_flops(self):
+        mod = pyprof_hlo.parse_hlo_text(_HLO_TEXT)
+        # 2 * prod(out 1*32*32*8) * window 9 * in_features 3
+        assert mod.instructions["convolution.5"].flops == pytest.approx(
+            2.0 * 1 * 32 * 32 * 8 * 9 * 3)
+
+    def test_fusion_flops_include_called_computation(self):
+        mod = pyprof_hlo.parse_hlo_text(_HLO_TEXT)
+        assert mod.instructions["fusion.2"].called == [
+            "fused_computation"]
+        # the fused body has no dot/conv -> no flops claim
+        assert mod.flops_of("fusion.2") is None
+
+    def test_clean_op_name(self):
+        f = pyprof_hlo.clean_op_name
+        assert f("jit(step)/jit(main)/transpose(jvp(attn))/dot_general") \
+            == "attn/dot_general"
+        assert f("jit(step)/jit(main)/jit(shmap_body)/"
+                 "jvp(TransformerLM)/block_0/attn/while/body/add") \
+            == "TransformerLM/block_0/attn/while/body/add"
+        assert pyprof_hlo.scope_of(
+            "jit(step)/jit(main)/transpose(jvp(attn))/dot_general") \
+            == "attn"
+        assert pyprof_hlo.scope_of("jit(step)/jit(main)/psum") == ""
+
+
+class TestRoofline:
+    def test_classify(self):
+        c = pyprof_roofline.classify
+        assert c(100.0, 1.0, ridge=10.0) == "compute-bound"
+        assert c(1.0, 100.0, ridge=10.0) == "memory-bound"
+        assert c(None, 100.0, ridge=10.0) == "memory-bound"
+        assert c(None, None, ridge=10.0) == "unknown"
+        assert c(100.0, 1.0, ridge=10.0, is_collective=True) == "network"
+
+    def test_program_roofline(self):
+        rf = pyprof_roofline.program_roofline(
+            {"flops": 2e9, "bytes_accessed": 1e8},
+            peak_flops=1e12, peak_bytes_per_s=1e11)
+        assert rf["classification"] == "compute-bound"    # 20 >= 10
+        assert rf["compute_floor_s"] == pytest.approx(2e-3)
+        assert rf["memory_floor_s"] == pytest.approx(1e-3)
+        assert rf["roofline_floor_s"] == pytest.approx(2e-3)
+
+    def test_peak_bw_env_override(self, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_PEAK_BW", "123.0")
+        assert pyprof_roofline.device_peak_bytes_per_s() == 123.0
+
+
+# ---------------------------------------------------------------------------
+# CLI: report + the compare exit-code contract
+# ---------------------------------------------------------------------------
+
+def _make_logdir(tmp_path):
+    """A capture-shaped logdir from the committed fixture: trace JSON +
+    gz sidecar (exactly what capture() writes)."""
+    ld = tmp_path / "logdir"
+    ld.mkdir()
+    shutil.copy(FIXTURE, ld / "fixture.trace.json")
+    side = {
+        "schema": 1, "module": "jit_step", "steps": 1, "wall_s": 300e-6,
+        "peak_flops": 1e12, "peak_bytes_per_s": 1e11,
+        "cost_stats": {"flops": 4e6, "bytes_accessed": 1e6},
+        "instructions": FIXTURE_MAP,
+    }
+    with gzip.open(ld / SIDECAR_NAME, "wt") as f:
+        json.dump(side, f)
+    return str(ld)
+
+
+class TestCli:
+    def test_report_from_logdir(self, tmp_path, capsys):
+        ld = _make_logdir(tmp_path)
+        out_json = str(tmp_path / "bd.json")
+        rc = pyprof_cli.main(["report", ld, "-o", out_json])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "attention" in text and "collective/ddp" in text
+        bd = json.load(open(out_json))
+        assert bd["categories"]["compute"]["pct"] == pytest.approx(
+            60.0, abs=0.1)
+
+    def test_report_json_flag(self, tmp_path, capsys):
+        ld = _make_logdir(tmp_path)
+        assert pyprof_cli.main(["report", ld, "--json"]) == 0
+        bd = json.loads(capsys.readouterr().out)
+        assert "subsystems" in bd
+
+    def test_report_bad_input_exit_1(self, tmp_path, capsys):
+        bad = tmp_path / "junk.json"
+        bad.write_text("{not json")
+        assert pyprof_cli.main(["report", str(bad)]) == 1
+
+    def test_compare_identical_exit_0(self, tmp_path, capsys):
+        ld = _make_logdir(tmp_path)
+        out = str(tmp_path / "bd.json")
+        pyprof_cli.main(["report", ld, "-o", out])
+        capsys.readouterr()
+        assert pyprof_cli.main(["compare", out, out]) == 0
+
+    def test_compare_regression_exit_4(self, tmp_path, capsys):
+        ld = _make_logdir(tmp_path)
+        out = str(tmp_path / "bd.json")
+        pyprof_cli.main(["report", ld, "-o", out])
+        bd = json.load(open(out))
+        bd["device"]["busy_s"] *= 1.25          # doctored 25% slower
+        for c in bd["categories"].values():
+            c["s"] *= 1.25
+        worse = str(tmp_path / "worse.json")
+        json.dump(bd, open(worse, "w"))
+        assert pyprof_cli.main(
+            ["compare", out, worse, "--max-regress", "10"]) \
+            == pyprof_cli.EXIT_REGRESSION
+        # within tolerance: a 25% regression passes a 30% gate
+        capsys.readouterr()
+        assert pyprof_cli.main(
+            ["compare", out, worse, "--max-regress", "30"]) == 0
+
+    def test_compare_bench_rows(self, tmp_path, capsys):
+        a = tmp_path / "a.json"
+        b = tmp_path / "b.json"
+        a.write_text(json.dumps({"metric": "img_s", "value": 2599.0}))
+        b.write_text(json.dumps({"metric": "img_s", "value": 2000.0}))
+        assert pyprof_cli.main(["compare", str(a), str(b)]) \
+            == pyprof_cli.EXIT_REGRESSION
+        capsys.readouterr()
+        # higher-is-better: an IMPROVEMENT is never a regression
+        assert pyprof_cli.main(["compare", str(b), str(a)]) == 0
+
+    def test_compare_bench_wrapper(self, tmp_path, capsys):
+        """BENCH_r*.json trajectory rows ride a {parsed: {...}} wrapper."""
+        a = tmp_path / "r1.json"
+        a.write_text(json.dumps(
+            {"n": 1, "parsed": {"metric": "img_s", "value": 2599.0}}))
+        assert pyprof_cli.main(["compare", str(a), str(a)]) == 0
+
+    def test_compare_mixed_kinds_exit_1(self, tmp_path, capsys):
+        ld = _make_logdir(tmp_path)
+        out = str(tmp_path / "bd.json")
+        pyprof_cli.main(["report", ld, "-o", out])
+        bench = tmp_path / "bench.json"
+        bench.write_text(json.dumps({"metric": "img_s", "value": 1.0}))
+        assert pyprof_cli.main(["compare", out, str(bench)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# telemetry integration
+# ---------------------------------------------------------------------------
+
+class TestTelemetryProfileSection:
+    def test_summarize_profile_section(self):
+        from apex_tpu.telemetry.export import format_summary, summarize
+        events = [
+            {"name": "profile/compute_pct", "value": 60.0,
+             "kind": "static"},
+            {"name": "profile/collective_pct", "value": 20.0,
+             "kind": "static"},
+            {"name": "profile/idle_pct", "value": 20.0, "kind": "static"},
+            {"name": "profile/dispatch_gap_pct", "value": 33.3,
+             "kind": "static"},
+            {"name": "profile/overlap_efficiency", "value": 0.44,
+             "kind": "static"},
+            {"name": "profile/scope/attention", "value": 100.0,
+             "kind": "static",
+             "meta": {"pct": 41.7, "bound": "compute-bound"}},
+            {"name": "step/model_flops", "value": 1e9, "kind": "static"},
+        ]
+        s = summarize(events)
+        assert s["profile"]["compute_pct"] == 60.0
+        assert s["profile"]["scopes"]["attention"]["bound"] \
+            == "compute-bound"
+        # profile statics do NOT leak into the generic statics table
+        assert "profile/compute_pct" not in (s.get("static") or {})
+        assert "step/model_flops" in s["static"]
+        text = format_summary(s)
+        assert "profile (device timeline)" in text
+        assert "attention" in text and "dispatch gap 33.3%" in text
+
+    def test_record_breakdown_roundtrip(self):
+        from apex_tpu import telemetry
+        from apex_tpu.telemetry.export import summarize
+        bd = _fixture_breakdown()
+        with telemetry.capture() as col:
+            pyprof.record_breakdown(bd)
+            events = [e.to_dict() for e in col.drain()]
+        s = summarize(events)
+        assert s["profile"]["compute_pct"] == pytest.approx(60.0, abs=0.1)
+        assert "attention" in s["profile"]["scopes"]
+
+    def test_record_breakdown_disabled_is_noop(self):
+        from apex_tpu import telemetry
+        assert not telemetry.enabled()
+        pyprof.record_breakdown(_fixture_breakdown())   # must not raise
+
+
+# ---------------------------------------------------------------------------
+# CPU end-to-end: capture -> breakdown -> offline report
+# ---------------------------------------------------------------------------
+
+class TestCaptureE2E:
+    def test_capture_cpu_end_to_end(self, tmp_path):
+        def f(x, w):
+            with jax.named_scope("attn"):
+                y = jnp.dot(x, w)
+            with jax.named_scope("ln1"):
+                z = jax.nn.relu(y) * 2.0
+            return z.sum()
+
+        g = jax.jit(jax.grad(f))
+        x = jnp.ones((256, 256), jnp.float32)
+        w = jnp.ones((256, 256), jnp.float32)
+        ld = str(tmp_path / "prof")
+        bd = pyprof.capture(g, x, w, steps=3, logdir=ld)
+
+        # categories sum to ~100% of the device window
+        total = sum(v["pct"] for v in bd["categories"].values())
+        assert total == pytest.approx(100.0, abs=0.5)
+        assert bd["device"]["busy_s"] > 0
+        assert bd["device"]["kernel_events"] > 0
+        # known scopes appear, joined through HLO metadata
+        assert any("attn" in s for s in bd["scopes"]), bd["scopes"]
+        assert any("ln1" in s for s in bd["scopes"]), bd["scopes"]
+        assert "attention" in bd["subsystems"]
+        assert "layer_norm" in bd["subsystems"]
+        # subsystem table accounts for the summed kernel time
+        kernel_us = sum(r["us"] for r in bd["subsystems"].values())
+        tr = load_trace(ld)
+        assert kernel_us == pytest.approx(
+            sum(e.dur_us for e in tr.kernel_events()), rel=1e-3)
+        # the grad dot dominates and is compute-bound at 256^3 vs the
+        # CPU's nominal ridge
+        assert bd["subsystems"]["attention"]["bound"] == "compute-bound"
+        assert bd["dispatch_gap_pct"] is not None
+
+        # offline rebuild from the logdir matches
+        bd2 = pyprof.breakdown_from_logdir(ld)
+        assert bd2["subsystems"].keys() == bd["subsystems"].keys()
+        assert bd2["categories"]["compute"]["pct"] == pytest.approx(
+            bd["categories"]["compute"]["pct"], abs=0.1)
+        # and the text report renders the scopes
+        text = pyprof.format_breakdown(bd2)
+        assert "attn" in text and "roofline" in text
