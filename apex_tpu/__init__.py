@@ -36,6 +36,7 @@ from apex_tpu import reparameterization
 from apex_tpu import sparsity
 from apex_tpu import pyprof
 from apex_tpu import telemetry
+from apex_tpu import trace
 from apex_tpu import tune
 from apex_tpu import resilience
 from apex_tpu import testing

@@ -19,6 +19,11 @@ SURVEY.md §5.1). The TPU-native pipeline:
      roofline verdicts, overlap efficiency from device timestamps, and
      the dispatch gap. ``python -m apex_tpu.pyprof report|compare`` is
      the offline CLI + CI perf-regression gate (exit 4 on regression).
+  5. **timeline** (the reference's joined NVTX+kernel view): ``report
+     LOGDIR --timeline out.trace.json`` merges the host ``span/*`` lanes
+     (:mod:`apex_tpu.trace`) with the device kernel lane into one
+     Chrome-trace/Perfetto file, clock-joined at the profiled step
+     boundaries.
 """
 
 from apex_tpu.pyprof.annotate import annotate, annotate_module, push, pop
@@ -34,3 +39,5 @@ from apex_tpu.pyprof.capture import (breakdown_from_logdir, capture,
 from apex_tpu.pyprof.roofline import (classify, device_peak_bytes_per_s,
                                       program_roofline, ridge_intensity)
 from apex_tpu.pyprof.hlo import clean_op_name, parse_hlo_text, scope_of
+from apex_tpu.pyprof.timeline import (build_timeline, timeline_from_logdir,
+                                      write_timeline)

@@ -374,14 +374,41 @@ def capture(step_fn: Callable, *args, steps: int = 2, warmup: int = 1,
     # wall clock brackets ONLY the step loop: profiler session start can
     # cost seconds (measured ~10 s in sandboxed CPU environments) and
     # would otherwise swamp dispatch_gap_pct
+    from apex_tpu import trace as _trace
     jax.profiler.start_trace(logdir)
     try:
         t0 = time.perf_counter()
-        for _ in range(steps):
+        for k in range(steps):
+            s0 = time.perf_counter()
             runner()
+            # per-step host anchor: the unified-timeline export aligns
+            # the device lane's clock to these step boundaries (the
+            # device trace's epoch is arbitrary — measured as process
+            # uptime on XLA:CPU, not unix or perf_counter time)
+            _trace.emit_span("profile/step", s0, time.perf_counter(),
+                             step=k)
         wall_s = time.perf_counter() - t0
+        t_end = time.perf_counter()
     finally:
         jax.profiler.stop_trace()
+
+    # host spans observed during the profiled window (the profile/step
+    # anchors plus anything the wired producers emitted — data waits,
+    # snapshot I/O, callback work) ride the sidecar, so `report
+    # --timeline` can rebuild the unified host+device view offline
+    host_spans: List[Dict[str, Any]] = []
+    if _trace.enabled():
+        from apex_tpu import telemetry as _telemetry
+        # callback/record spans are emitted inside async debug
+        # callbacks — block_until_ready does NOT flush those, so the
+        # snapshot below would miss the last profiled step's callback
+        # work without the barrier
+        jax.effects_barrier()
+        for e in _trace.span_rows(_telemetry.get_collector().snapshot()):
+            if e["end_mono"] is None:
+                continue
+            if e["end_mono"] >= t0 and e["begin_mono"] <= t_end:
+                host_spans.append(e)
 
     sidecar = {
         "schema": 1,
@@ -392,6 +419,7 @@ def capture(step_fn: Callable, *args, steps: int = 2, warmup: int = 1,
         "peak_bytes_per_s": peak_bytes_per_s,
         "cost_stats": cost_stats,
         "instructions": instr_map,
+        "host_spans": host_spans,
     }
     with gzip.open(os.path.join(logdir, SIDECAR_NAME), "wt") as f:
         json.dump(sidecar, f)
@@ -514,6 +542,14 @@ def record_breakdown(bd: Dict[str, Any], *, prefix: str = "profile"
             telemetry.record_static(
                 f"{prefix}/{k}_pct", cats[k]["pct"],
                 dedup_key=(prefix, k))
+    # per-step device busy seconds: the anchor of summarize's wall
+    # reconciliation (wall = busy + named host spans + residual)
+    dev = bd.get("device") or {}
+    steps = max(int(bd.get("steps", 1)), 1)
+    if dev.get("busy_s"):
+        telemetry.record_static(
+            f"{prefix}/device_busy_s_per_step",
+            float(dev["busy_s"]) / steps, dedup_key=(prefix, "busy"))
     if bd.get("dispatch_gap_pct") is not None:
         telemetry.record_static(f"{prefix}/dispatch_gap_pct",
                                 bd["dispatch_gap_pct"],

@@ -57,6 +57,18 @@ def _build_parser() -> argparse.ArgumentParser:
                         "input)")
     r.add_argument("--top", type=int, default=12,
                    help="rows per table in the text report")
+    r.add_argument("--timeline", default=None, metavar="OUT.trace.json",
+                   help="also write the unified host+device Chrome-trace "
+                        "timeline (host lanes per thread from span/* "
+                        "events, device lane from the kernel events; "
+                        "open in chrome://tracing / Perfetto). Needs a "
+                        "capture logdir recorded with apex_tpu.trace "
+                        "enabled, or --spans")
+    r.add_argument("--spans", default=None, metavar="RUN.jsonl",
+                   help="telemetry run file whose span/* events join "
+                        "the --timeline host lanes (spans recorded "
+                        "outside the capture window: data waits, "
+                        "snapshot I/O, ...)")
 
     c = sub.add_parser("compare",
                        help="perf-regression gate over two breakdowns or "
@@ -194,6 +206,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.out:
             with open(args.out, "w") as f:
                 json.dump(bd, f, indent=1, sort_keys=True)
+        if args.timeline:
+            from apex_tpu.pyprof.timeline import (timeline_from_logdir,
+                                                  write_timeline)
+            if not os.path.isdir(args.path):
+                print("error: --timeline needs a capture logdir (trace "
+                      "+ sidecar), not a breakdown JSON",
+                      file=sys.stderr)
+                return 1
+            try:
+                tl = timeline_from_logdir(args.path,
+                                          spans_path=args.spans)
+            except (OSError, ValueError) as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 1
+            write_timeline(tl, args.timeline)
+            md = tl["metadata"]
+            print(f"timeline: {md['host_spans']} host spans + "
+                  f"{md['device_events']} device events -> "
+                  f"{args.timeline} (chrome://tracing / "
+                  "ui.perfetto.dev)")
         print(json.dumps(bd, indent=1, sort_keys=True) if args.json
               else format_breakdown(bd, top=args.top))
         return 0

@@ -1,0 +1,208 @@
+"""Unified host+device timeline — ONE Chrome-trace/Perfetto file from a
+pyprof capture logdir plus the host-side ``span/*`` events.
+
+The reference pyprof's whole value was the JOINED view: NVTX host ranges
+and CUDA kernels on one timeline. Here the two halves already exist —
+:mod:`apex_tpu.pyprof` parses the device kernel events out of the
+``jax.profiler`` trace, and :mod:`apex_tpu.trace` records host spans
+(data waits, dispatch, callbacks, snapshot I/O) — and this module merges
+them:
+
+  * host lanes: one Chrome-trace thread per host thread, one ``X`` event
+    per completed span (name = the span family path, args carry step +
+    family).
+  * device lane(s): the existing kernel events, one thread per original
+    trace lane, args carrying the HLO op and (when the sidecar is
+    present) the joined ``named_scope`` path.
+
+Clock join: the device trace's timestamps use an ARBITRARY epoch
+(measured: process-uptime-like on XLA:CPU — neither unix time nor
+``perf_counter``), so absolute clocks cannot be compared. Both sides
+however record the same step boundaries: ``capture()`` emits a
+``span/profile/step`` host span per profiled step, and the device
+window's first kernel belongs to the first profiled step. The export
+anchors the first step's host begin to the device window start; host
+spans therefore land within one dispatch latency of their true device
+alignment (documented approximation — there is no shared hardware clock
+to do better from a Chrome trace).
+
+Open the result in ``chrome://tracing`` or https://ui.perfetto.dev.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from apex_tpu.pyprof.parse import Trace
+
+__all__ = ["build_timeline", "timeline_from_logdir", "write_timeline"]
+
+
+def _anchor_offset_us(kernels, host_spans) -> float:
+    """Offset to ADD to a host ``perf_counter``-microsecond timestamp to
+    land on the device trace's clock. Anchor preference: the capture's
+    per-step ``profile/step`` spans, then ``step/dispatch`` spans, then
+    any span — each aligning its earliest begin with the device window
+    start."""
+    if not kernels:
+        return 0.0
+    w0 = min(e.ts_us for e in kernels)
+    for fam in ("profile/step", "step/dispatch"):
+        begins = [s["begin_mono"] for s in host_spans
+                  if s.get("family") == fam
+                  and s.get("begin_mono") is not None]
+        if begins:
+            return w0 - min(begins) * 1e6
+    begins = [s["begin_mono"] for s in host_spans
+              if s.get("begin_mono") is not None]
+    if begins:
+        return w0 - min(begins) * 1e6
+    return 0.0
+
+
+def build_timeline(trace: Trace, host_spans: List[Dict[str, Any]], *,
+                   instr_map: Optional[Dict[str, Any]] = None,
+                   ) -> Dict[str, Any]:
+    """Merge a parsed device trace and host span rows (the
+    :func:`apex_tpu.trace.span_rows` shape) into a Chrome-trace dict."""
+    instr_map = instr_map or {}
+    kernels = trace.kernel_events()
+    spans = [s for s in host_spans if s.get("begin_mono") is not None]
+    offset = _anchor_offset_us(kernels, spans)
+
+    events: List[Dict[str, Any]] = []
+    # lane bookkeeping: stable small tids, named via metadata events
+    events.append({"ph": "M", "pid": 1, "name": "process_name",
+                   "args": {"name": "host"}})
+    events.append({"ph": "M", "pid": 2, "name": "process_name",
+                   "args": {"name": "device"}})
+
+    host_tids: Dict[Any, int] = {}
+    for s in spans:
+        key = (s.get("process"), s.get("tid", 0))
+        if key not in host_tids:
+            tid = len(host_tids) + 1
+            host_tids[key] = tid
+            label = s.get("thread") or f"thread-{s.get('tid', 0)}"
+            if s.get("process") is not None:
+                label = f"{s['process']}/{label}"
+            events.append({"ph": "M", "pid": 1, "tid": tid,
+                           "name": "thread_name",
+                           "args": {"name": label}})
+        args: Dict[str, Any] = {"family": s.get("family")}
+        if s.get("step") is not None:
+            args["step"] = s["step"]
+        name = s["name"]
+        if name.startswith("span/"):
+            name = name[len("span/"):]
+        events.append({
+            "ph": "X", "pid": 1, "tid": host_tids[key], "name": name,
+            "ts": round(s["begin_mono"] * 1e6 + offset, 3),
+            "dur": round(max(s["dur_s"], 0.0) * 1e6, 3),
+            "args": args,
+        })
+
+    dev_tids: Dict[Any, int] = {}
+    for e in kernels:
+        key = (e.pid, e.tid)
+        if key not in dev_tids:
+            tid = len(dev_tids) + 1
+            dev_tids[key] = tid
+            label = "/".join(p for p in (e.process, e.thread) if p) \
+                or f"lane-{e.pid}.{e.tid}"
+            events.append({"ph": "M", "pid": 2, "tid": tid,
+                           "name": "thread_name",
+                           "args": {"name": label}})
+        hlo_op = str(e.args.get("hlo_op") or "")
+        args = {"hlo_op": hlo_op} if hlo_op else {}
+        rec = instr_map.get(hlo_op) if hlo_op else None
+        if rec and rec.get("scope"):
+            args["scope"] = rec["scope"]
+        events.append({
+            "ph": "X", "pid": 2, "tid": dev_tids[key], "name": e.name,
+            "ts": round(e.ts_us, 3), "dur": round(e.dur_us, 3),
+            "args": args,
+        })
+
+    # re-zero so the viewer opens at t=0 instead of an arbitrary epoch
+    xs = [ev for ev in events if ev.get("ph") == "X"]
+    if xs:
+        t0 = min(ev["ts"] for ev in xs)
+        for ev in xs:
+            ev["ts"] = round(ev["ts"] - t0, 3)
+    return {
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "producer": "apex_tpu.pyprof timeline",
+            "clock_join": ("host spans anchored to the device window at "
+                           "the first profiled step boundary"),
+            "host_spans": len(spans),
+            "device_events": len(kernels),
+        },
+        "traceEvents": events,
+    }
+
+
+def timeline_from_logdir(logdir: str, *,
+                         spans_path: Optional[str] = None,
+                         ) -> Dict[str, Any]:
+    """Build the unified timeline offline from a capture logdir. Host
+    spans come from the capture sidecar (written when ``apex_tpu.trace``
+    was enabled during capture); ``spans_path`` (a telemetry run JSONL)
+    adds/substitutes spans recorded outside the capture — e.g. the full
+    train loop's data waits and snapshot I/O."""
+    import gzip
+
+    from apex_tpu.pyprof.capture import SIDECAR_NAME
+    from apex_tpu.pyprof.parse import load_trace
+
+    trace = load_trace(logdir)
+    side: Dict[str, Any] = {}
+    side_path = os.path.join(logdir, SIDECAR_NAME)
+    if os.path.exists(side_path):
+        with gzip.open(side_path, "rt") as f:
+            side = json.load(f)
+    host_spans = list(side.get("host_spans") or [])
+    if spans_path:
+        import warnings
+
+        from apex_tpu import trace as _trace
+        from apex_tpu.telemetry.export import load
+        # the run JSONL re-carries the capture-window spans (same
+        # collector) — dedup on the (name, thread, end timestamp)
+        # identity so each span renders once
+        seen = {(s["name"], s.get("tid"), s.get("end_mono"))
+                for s in host_spans}
+        rows = _trace.span_rows(load(spans_path))
+        if any(s.get("process") is not None for s in rows):
+            # a MERGED multi-process file: merge aligns wall ts only —
+            # the monotonic clocks the timeline positions spans by share
+            # an epoch across processes of ONE host (CLOCK_MONOTONIC),
+            # but not across hosts, where lanes would displace by the
+            # hosts' boot-time deltas
+            warnings.warn(
+                "apex_tpu.pyprof: --spans carries merged multi-process "
+                "spans; host lanes are clock-accurate only for "
+                "processes on the capture's own host — other hosts' "
+                "lanes may be displaced (monotonic epochs are "
+                "per-machine)")
+        for s in rows:
+            key = (s["name"], s.get("tid"), s.get("end_mono"))
+            if key not in seen:
+                seen.add(key)
+                host_spans.append(s)
+    if not host_spans:
+        raise ValueError(
+            "no host spans: enable apex_tpu.trace before capture() "
+            "(train_lm --trace --profile DIR), or pass a telemetry "
+            "JSONL that carries span/* events via --spans")
+    return build_timeline(trace, host_spans,
+                          instr_map=side.get("instructions"))
+
+
+def write_timeline(timeline: Dict[str, Any], out_path: str) -> str:
+    with open(out_path, "w") as f:
+        json.dump(timeline, f)
+    return out_path

@@ -176,6 +176,11 @@ class SnapshotManager:
         ``PrefetchLoader.loader_state()``). ``extra``: free-form
         JSON-able provenance (seeds, opt level, ...).
         """
+        # span: caller-blocked time only — in async mode that is the
+        # wait-for-predecessor + D2H materialization; the serialize/
+        # publish spans then land on the writer thread (thread-aware)
+        from apex_tpu import trace as _trace
+        t_call = time.perf_counter()
         if self.async_mode:
             self.wait()  # at most one snapshot in flight
         host = self._to_host(state)
@@ -187,8 +192,14 @@ class SnapshotManager:
                 self._thread = t
                 self._last_error = None
             t.start()
+            _trace.emit_span("snapshot/save", t_call,
+                             time.perf_counter(), step=int(step),
+                             meta={"async": True})
             return True
-        return self._write_with_retries(*args)
+        ok = self._write_with_retries(*args)
+        _trace.emit_span("snapshot/save", t_call, time.perf_counter(),
+                         step=int(step), meta={"async": False})
+        return ok
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block until any in-flight async snapshot lands. Returns False
@@ -272,9 +283,11 @@ class SnapshotManager:
         tmp = os.path.join(self.directory,
                            f"_tmp.{_gen_name(gen)}.{os.getpid()}")
         os.makedirs(tmp, exist_ok=True)
+        from apex_tpu import trace as _trace
         try:
             payload = os.path.join(tmp, PAYLOAD)
-            checkpoint.save_npz(payload, host, layout=layout)
+            with _trace.span("snapshot/serialize", step=step):
+                checkpoint.save_npz(payload, host, layout=layout)
             man = {
                 "manifest_version": MANIFEST_VERSION,
                 "generation": gen,
@@ -289,13 +302,14 @@ class SnapshotManager:
                 "complete": True,
             }
             mpath = os.path.join(tmp, MANIFEST)
-            with open(mpath, "w") as f:
-                json.dump(man, f, indent=1, sort_keys=True)
-                f.flush()
-                os.fsync(f.fileno())
-            _fsync_dir(tmp)
-            os.replace(tmp, final)   # the atomic publish
-            _fsync_dir(self.directory)
+            with _trace.span("snapshot/publish", step=step):
+                with open(mpath, "w") as f:
+                    json.dump(man, f, indent=1, sort_keys=True)
+                    f.flush()
+                    os.fsync(f.fileno())
+                _fsync_dir(tmp)
+                os.replace(tmp, final)   # the atomic publish
+                _fsync_dir(self.directory)
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
             raise

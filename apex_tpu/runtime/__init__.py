@@ -313,6 +313,7 @@ class PrefetchLoader:
         self._produced = 0
         self._consumed = 0
         self._starvations = 0
+        self._wait_s = 0.0
         for _ in range(max(1, workers)):
             t = threading.Thread(target=self._worker, daemon=True)
             t.start()
@@ -342,8 +343,15 @@ class PrefetchLoader:
         # finishes only after collecting all of them, so a sentinel can
         # never overtake another worker's in-flight item. A transform/source
         # exception is captured and re-raised on the consumer side.
+        import time as _time
+        from apex_tpu import trace as _trace
         try:
             while True:
+                # produce span: source read + transform (lock wait rides
+                # the bill — contended source access IS production
+                # latency). The queue put is excluded: a put that blocks
+                # means the CONSUMER is ahead, not that producing is slow.
+                t0 = _time.perf_counter()
                 with self._lock:
                     if self._stopped:
                         return
@@ -352,7 +360,9 @@ class PrefetchLoader:
                     except StopIteration:
                         self._stopped = True
                         return
-                self._put(self._transform(item))
+                out = self._transform(item)
+                _trace.emit_span("data/produce", t0, _time.perf_counter())
+                self._put(out)
         except BaseException as e:
             with self._lock:
                 if self._error is None:
@@ -365,7 +375,9 @@ class PrefetchLoader:
         return self
 
     def __next__(self):
+        import time as _time
         starved = self._q.qsize() == 0   # device would wait on input HERE
+        t_enter = _time.perf_counter()
         while True:
             if self._exhausted:
                 raise StopIteration
@@ -385,10 +397,21 @@ class PrefetchLoader:
                         raise err
                     raise StopIteration
                 continue
+            # consumer-blocked time: from entry until the batch is in
+            # hand. When the queue had a ready batch this is ~a lock-free
+            # get (µs); when starved it is the magnitude the satellite
+            # counter exists for — the device-side input wait.
+            wait = _time.perf_counter() - t_enter
             with self._stats_lock:
                 self._consumed += 1
+                self._wait_s += wait
                 if starved:
                     self._starvations += 1
+            if starved:
+                from apex_tpu import trace as _trace
+                _trace.emit_span("data/wait", t_enter,
+                                 _time.perf_counter(),
+                                 step=self._consumed - 1)
             from apex_tpu import telemetry
             if telemetry.enabled():
                 telemetry.record("data/queue_depth", self._q.qsize(),
@@ -401,8 +424,11 @@ class PrefetchLoader:
 
     def stats(self) -> dict:
         """Counters since construction: ``produced``/``consumed`` batches,
-        live ``queue_depth``, configured ``depth``, and ``starvations``
-        (consumer fetches that found the queue empty — input-bound steps).
+        live ``queue_depth``, configured ``depth``, ``starvations``
+        (consumer fetches that found the queue empty — input-bound steps),
+        and ``wait_s`` — CUMULATIVE consumer-blocked seconds, so
+        starvation has a magnitude, not just a count (the same interval
+        the ``span/data/wait`` trace spans record per occurrence).
         ``starvations``/``consumed`` near 1.0 means the pipeline, not the
         device, is the bottleneck: raise ``workers`` or ``depth``, or
         cheapen ``transform``."""
@@ -411,6 +437,7 @@ class PrefetchLoader:
                 "produced": self._produced,
                 "consumed": self._consumed,
                 "starvations": self._starvations,
+                "wait_s": self._wait_s,
                 "queue_depth": self._q.qsize(),
                 "depth": self.depth,
                 "skip": self._skip,

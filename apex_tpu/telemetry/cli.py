@@ -14,6 +14,17 @@ Subcommands:
                                  CI gate or a babysitter cron.
   tail RUN.jsonl [-n N]          last N events, one line each.
   csv RUN.jsonl OUT.csv          flat CSV re-export.
+  merge RUN-p*.jsonl [-o OUT]    multi-process aggregation: estimate
+                                 each process's clock offset from its
+                                 step-start spans (median over shared
+                                 steps vs process 0), rewrite every
+                                 event onto the reference clock, tag
+                                 events with ``process=``, and write ONE
+                                 merged JSONL. ``summarize`` on the
+                                 result grows the straggler section
+                                 (per-step max−median step time, worst
+                                 process named, excess attributed by
+                                 span family).
 
 Every subcommand follows rotated generations (``run.jsonl.1``, ...)
 oldest-first via :func:`~apex_tpu.telemetry.export.load`, so a rotated
@@ -90,6 +101,23 @@ def _build_parser() -> argparse.ArgumentParser:
     c = sub.add_parser("csv", help="re-export a run as CSV")
     add_path(c)
     c.add_argument("out")
+
+    m = sub.add_parser(
+        "merge",
+        help="align + merge per-process run files on the shared step "
+             "index (clock offsets recovered from step-start spans)")
+    m.add_argument("paths", nargs="+",
+                   help="per-process run files (run-p0.jsonl "
+                        "run-p1.jsonl ...; process labels come from the "
+                        "p<N> filename marker, else argument order)")
+    m.add_argument("-o", "--out", default="merged.jsonl",
+                   help="merged output JSONL (default: merged.jsonl)")
+    m.add_argument("--no-follow", action="store_true",
+                   help="read only each live file, not rotated "
+                        "generations")
+    m.add_argument("--summarize", action="store_true",
+                   help="also print the merged summary (incl. the "
+                        "straggler section)")
     return p
 
 
@@ -110,6 +138,8 @@ def _load_tail(path: str, n: int) -> List[dict]:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = _build_parser().parse_args(argv)
+    if args.cmd == "merge":
+        return _run_merge(args)
     try:
         if args.cmd == "tail" and not args.no_follow:
             events = _load_tail(args.path, args.n)
@@ -157,6 +187,33 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif args.cmd == "csv":
         write_csv(args.out, events)
         print(f"wrote {len(events)} events to {args.out}")
+    return 0
+
+
+def _run_merge(args) -> int:
+    from apex_tpu.telemetry.export import write_jsonl
+    from apex_tpu.telemetry.merge import merge_files
+    try:
+        merged, offsets = merge_files(
+            args.paths, follow_rotations=not args.no_follow)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    # truncate first: write_jsonl APPENDS (the run-file contract), but a
+    # re-run merge into the same output must replace it — appending
+    # would silently double every series in the next summarize
+    open(args.out, "w").close()
+    write_jsonl(args.out, merged)
+    for label, info in sorted(offsets.items()):
+        note = "" if info["anchors"] else \
+            "  WARNING: no shared step anchors — merged UNALIGNED"
+        print(f"process {label}: clock offset {info['offset_s']:+.4f} s "
+              f"({info['anchors']} step anchors){note}")
+    print(f"merged {len(args.paths)} streams "
+          f"({len(merged)} events) -> {args.out}")
+    if args.summarize:
+        agg = summarize(merged)
+        print(format_summary(agg))
     return 0
 
 

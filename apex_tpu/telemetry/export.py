@@ -469,11 +469,214 @@ def summarize(events: List[Dict[str, Any]], *,
     if resil:
         out["resilience"] = resil
 
+    # host spans (producer: apex_tpu.trace) — per-family duration stats,
+    # the wall reconciliation, and (for merged multi-process streams)
+    # the straggler section
+    from apex_tpu import trace as _trace
+    rows = _trace.span_rows(events)
+    if rows:
+        out["spans"] = _spans_section(rows)
+        recon = _reconciliation(out, rows)
+        if recon:
+            out["reconciliation"] = recon
+    stragglers = _stragglers(events, rows)
+    if stragglers:
+        out["stragglers"] = stragglers
+
     # numerics health (producers: telemetry.health)
     health = _health_section(events, series, detect_kwargs=health_detect)
     if health:
         out["health"] = health
     return out
+
+
+def _spans_section(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Per-family span stats: count/total plus the duration order
+    statistics. Nested spans double into their parents on purpose —
+    each family answers "how long does THIS activity take"."""
+    fams: Dict[str, List[float]] = collections.defaultdict(list)
+    for r in rows:
+        fams[r["family"]].append(r["dur_s"])
+    out: Dict[str, Any] = {}
+    for fam, durs in sorted(fams.items(),
+                            key=lambda kv: -sum(kv[1])):
+        st = _series_stats(durs)
+        st["total_s"] = sum(durs)
+        out[fam] = st
+    return out
+
+
+def _reconciliation(out: Dict[str, Any], rows: List[Dict[str, Any]],
+                    ) -> Optional[Dict[str, Any]]:
+    """The wall-reconciliation block: per-step
+    ``wall = device busy + named host span families + residual``.
+
+    Device busy comes from the pyprof capture when one ran
+    (``profile/device_busy_s_per_step``); without it the
+    ``step/device_wait`` span stands in as a proxy (the host blocked on
+    the device — an upper bound on busy, so the residual then measures
+    only host-side attribution). ``blocked_on_device`` is the named
+    excess of the wait span over busy: device idle/dispatch gaps the
+    host sat through. Concurrent-by-design families
+    (:data:`apex_tpu.trace.CONCURRENT_FAMILIES`) and stack-nested spans
+    (depth > 0 — a parent span on the same thread already carries that
+    time) are never billed. The residual is an HONESTY counter (the
+    ``unattributed_us`` contract): it is printed, never folded away, and
+    can go negative when caller-blocking spans that merely overlap in
+    TIME (an ``emit_span`` interval inside another) over-attribute."""
+    from apex_tpu import trace as _trace
+    wall_stats = out.get("step_time_s")
+    if not wall_stats or not wall_stats.get("count"):
+        return None
+    wall = wall_stats["mean"]
+    steps = max(int(wall_stats["count"]), 1)
+
+    fams: Dict[str, List[float]] = collections.defaultdict(list)
+    procs = set()
+    for r in rows:
+        if r.get("process") is not None:
+            procs.add(r["process"])
+        if r.get("depth", 0):
+            continue
+        fams[r["family"]].append(r["dur_s"])
+    # merged multi-process stream: family durations sum over EVERY
+    # process while ``wall``/``steps`` describe the per-process mean
+    # (the (name, step) dedup averages across processes) — normalize by
+    # process count or a perfectly attributed N-process run reads as
+    # N× over-attributed (the straggler section's totals-vs-rates
+    # lesson; per-occurrence means below are immune)
+    n_procs = max(len(procs), 1)
+
+    def fam_mean(name):
+        v = fams.get(name)
+        return sum(v) / len(v) if v else None
+
+    dispatch = fam_mean("step/dispatch")
+    devwait = fam_mean("step/device_wait")
+    profile = out.get("profile") or {}
+    busy = profile.get("device_busy_s_per_step")
+    if busy is not None:
+        busy_source = "profile"
+    elif devwait is not None:
+        busy, busy_source = devwait, "step/device_wait (proxy)"
+    else:
+        return None
+
+    components: Dict[str, float] = {}
+    if dispatch:
+        components["step/dispatch"] = dispatch
+    if devwait is not None and devwait > busy:
+        components["blocked_on_device"] = devwait - busy
+    for fam, durs in fams.items():
+        if fam in ("step/dispatch", "step/device_wait", "profile/step") \
+                or fam in _trace.CONCURRENT_FAMILIES:
+            continue
+        components[fam] = sum(durs) / (steps * n_procs)
+    attributed = sum(components.values())
+    gap = wall - busy
+    residual = gap - attributed
+    recon: Dict[str, Any] = {
+        "wall_s": wall,
+        "steps": steps,
+        "device_busy_s": busy,
+        "busy_source": busy_source,
+        "gap_s": gap,
+        "gap_pct": (100.0 * gap / wall) if wall > 0 else None,
+        "components": {k: v for k, v in sorted(
+            components.items(), key=lambda kv: -kv[1])},
+        "attributed_s": attributed,
+        "residual_s": residual,
+        "residual_pct": (100.0 * residual / gap) if gap > 0 else None,
+    }
+    if profile.get("dispatch_gap_pct") is not None:
+        # the cross-check: this is pyprof's own wall-vs-busy figure for
+        # the PROFILED steps; disagreement means the profiled window is
+        # not representative of the instrumented loop
+        recon["profile_dispatch_gap_pct"] = profile["dispatch_gap_pct"]
+    return recon
+
+
+def _stragglers(events: List[Dict[str, Any]],
+                rows: List[Dict[str, Any]],
+                ) -> Optional[Dict[str, Any]]:
+    """The straggler block of a MERGED multi-process stream (events tag
+    ``meta.process``): per-step max−median step time across processes,
+    the worst process named, and its excess attributed by span family
+    against the median process."""
+    # per-process per-step step time
+    by_proc: Dict[str, Dict[int, List[float]]] = \
+        collections.defaultdict(lambda: collections.defaultdict(list))
+    for e in events:
+        proc = (e.get("meta") or {}).get("process")
+        if proc is None or e.get("kind", "point") != "point":
+            continue
+        if e.get("step") is None or not e["name"].endswith("/time_s"):
+            continue
+        by_proc[proc][int(e["step"])].append(float(e["value"]))
+    if len(by_proc) < 2:
+        return None
+    times = {proc: {s: sum(v) / len(v) for s, v in steps.items()}
+             for proc, steps in by_proc.items()}
+    shared = sorted(set.intersection(*(set(t) for t in times.values())))
+    skews: List[float] = []
+    worst_counts: Dict[str, int] = collections.defaultdict(int)
+    for s in shared:
+        vals = {p: times[p][s] for p in times}
+        ordered = sorted(vals.values())
+        med = _percentile(ordered, 0.5)
+        worst_p = max(vals, key=lambda p: vals[p])
+        skews.append(vals[worst_p] - med)
+        worst_counts[worst_p] += 1
+    result: Dict[str, Any] = {
+        "processes": {p: {"steps": len(t),
+                          "step_time_mean_s": (sum(t.values()) / len(t))
+                          if t else math.nan}
+                      for p, t in sorted(times.items())},
+        "shared_steps": len(shared),
+    }
+    if skews:
+        result["skew_s"] = _series_stats(skews)
+        worst = max(worst_counts, key=lambda p: worst_counts[p])
+        result["worst"] = {"process": worst,
+                           "steps_worst": worst_counts[worst],
+                           "of_steps": len(shared)}
+        # attribution: the worst process's per-step span-family RATES vs
+        # the cross-process median rate. Each process's family total is
+        # normalized by ITS OWN observed step count — processes can have
+        # recorded different step ranges (a resumed or longer-running
+        # one), and normalizing everyone's whole-run totals by the
+        # shared-step count would fabricate excess for whichever process
+        # simply recorded more steps
+        fam_per_proc: Dict[str, Dict[str, float]] = \
+            collections.defaultdict(lambda: collections.defaultdict(float))
+        for r in rows:
+            if r.get("process") is not None:
+                fam_per_proc[r["process"]][r["family"]] += r["dur_s"]
+        rates = {p: {f: v / max(len(times[p]), 1)
+                     for f, v in fam_per_proc.get(p, {}).items()}
+                 for p in times}
+        attribution = []
+        all_fams = {f for fams in rates.values() for f in fams}
+        for fam in all_fams:
+            per_proc = sorted(rates[p].get(fam, 0.0) for p in times)
+            med = _percentile(per_proc, 0.5)
+            excess = rates.get(worst, {}).get(fam, 0.0) - med
+            if excess > 0:
+                attribution.append({"family": fam,
+                                    "excess_s_per_step": excess})
+        attribution.sort(key=lambda a: -a["excess_s_per_step"])
+        result["attribution"] = attribution[:5]
+    # recovered clock offsets (the merge CLI's audit trail)
+    offsets = {}
+    for e in events:
+        if e.get("name") == "merge/offset":
+            meta = e.get("meta") or {}
+            offsets[meta.get("process", "?")] = {
+                "offset_s": float(e["value"]),
+                "anchors": meta.get("anchors", 0)}
+    if offsets:
+        result["offsets"] = offsets
+    return result
 
 
 def _health_section(events: List[Dict[str, Any]],
@@ -714,5 +917,65 @@ def format_summary(s: Dict[str, Any]) -> str:
                            ("preempted", "preempted")):
             if r.get(key):
                 lines.append(f"  {label}: {r[key]}")
+    if s.get("spans"):
+        lines.append("host spans (apex_tpu.trace):")
+        for fam, st in s["spans"].items():
+            lines.append(
+                f"  {fam:<22} x{st['count']:<5}"
+                f" total {st['total_s'] * 1e3:9.2f} ms"
+                f"   mean {st['mean'] * 1e3:8.3f}"
+                f"   max {st['max'] * 1e3:8.3f}")
+    if s.get("reconciliation"):
+        rc = s["reconciliation"]
+        res_pct = rc.get("residual_pct")
+        lines.append(
+            "wall reconciliation (per step, "
+            f"busy from {rc['busy_source']}):")
+        lines.append(
+            f"  wall {rc['wall_s'] * 1e3:.2f} ms = device busy "
+            f"{rc['device_busy_s'] * 1e3:.2f} ms + host spans "
+            f"{rc['attributed_s'] * 1e3:.2f} ms + residual "
+            f"{rc['residual_s'] * 1e3:.2f} ms"
+            + (f" ({res_pct:.1f}% of gap)" if res_pct is not None
+               else ""))
+        for fam, v in rc["components"].items():
+            lines.append(f"    {fam:<24} {v * 1e3:9.3f} ms")
+        gap_line = (f"  dispatch gap {rc['gap_pct']:.1f}% of wall"
+                    if rc.get("gap_pct") is not None else None)
+        if gap_line and rc.get("profile_dispatch_gap_pct") is not None:
+            gap_line += (" (pyprof profiled-window: "
+                         f"{rc['profile_dispatch_gap_pct']:.1f}%)")
+        if gap_line:
+            lines.append(gap_line)
+    if s.get("stragglers"):
+        st = s["stragglers"]
+        lines.append(
+            f"stragglers ({len(st['processes'])} processes, "
+            f"{st['shared_steps']} shared steps):")
+        if st.get("skew_s"):
+            k = st["skew_s"]
+            lines.append(
+                f"  step-time skew (max - median)  mean "
+                f"{k['mean'] * 1e3:8.2f} ms   p50 {k['p50'] * 1e3:8.2f}"
+                f"   max {k['max'] * 1e3:8.2f}")
+        if st.get("worst"):
+            w = st["worst"]
+            lines.append(
+                f"  worst: {w['process']} (slowest on "
+                f"{w['steps_worst']}/{w['of_steps']} shared steps)")
+            attr = st.get("attribution") or []
+            if attr:
+                lines.append("    excess by span family: " + ";  ".join(
+                    f"{a['family']} "
+                    f"+{a['excess_s_per_step'] * 1e3:.2f} ms/step"
+                    for a in attr[:3]))
+        for p, info in st["processes"].items():
+            lines.append(
+                f"  {p}: {info['steps']} steps, mean "
+                f"{info['step_time_mean_s'] * 1e3:.2f} ms/step")
+        for p, o in sorted((st.get("offsets") or {}).items()):
+            lines.append(
+                f"  clock offset {p}: {o['offset_s']:+.4f} s "
+                f"({o['anchors']} step anchors)")
     lines.extend(format_health(s.get("health") or {}))
     return "\n".join(lines)

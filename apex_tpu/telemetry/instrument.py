@@ -61,10 +61,17 @@ def record(name: str, value: Any, *, step: Any = None,
     if _is_traced(value) or _is_traced(step):
 
         def _host(v, s):
+            # the host half of the debug callback is real host work (one
+            # per shard per record per step) — span it so the wall
+            # reconciliation can bill it, without adding ANYTHING to the
+            # traced program (the span lives inside this host function)
+            from apex_tpu import trace as _trace
+            t0 = time.perf_counter()
             _ev.get_collector().record(
                 name, float(np.asarray(v).reshape(-1)[0]),
                 step=None if s is None else int(np.asarray(s)),
                 kind=kind, meta=meta)
+            _trace.emit_span("callback/record", t0, time.perf_counter())
 
         if step is None:
             jax.debug.callback(lambda v: _host(v, None), value)
@@ -180,6 +187,15 @@ class instrument_step:
         jax.block_until_ready(out)
         t2 = time.perf_counter()
 
+        from apex_tpu import trace as _trace
+        if _trace.enabled():
+            # the host-side step anchors: dispatch (host python + tracing
+            # + dispatch) and the block_until_ready wait. The dispatch
+            # span's BEGIN is every process's per-step clock anchor for
+            # `telemetry merge`'s offset estimation.
+            _trace.emit_span(f"{self.name}/dispatch", t0, t1, step=step)
+            _trace.emit_span(f"{self.name}/device_wait", t1, t2,
+                             step=step)
         col = _ev.get_collector()
         dispatch, wait, total = t1 - t0, t2 - t1, t2 - t0
         col.record(f"{self.name}/dispatch_s", dispatch, step=step)

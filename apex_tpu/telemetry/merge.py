@@ -1,0 +1,145 @@
+"""Multi-process run aggregation: align N per-process telemetry streams
+on the shared step index and merge them into ONE stream.
+
+A multi-process run (the exit-75/elastic arc) produces one JSONL per
+process, each stamped with that process's OWN clocks — the wall clocks
+can skew and the monotonic clocks share no epoch at all, so the files
+cannot be interleaved by timestamp as-is. What every process DOES share
+is the step index: step ``i`` is the same global step everywhere (the
+collectives inside it synchronize the processes). Each process's
+``span/step/dispatch`` spans record when ITS clock saw each step begin;
+the per-process clock offset is therefore the median over shared steps
+of the per-step begin-time differences against the reference process
+(process 0) — the median rejects per-step jitter (one process entering
+a step late because it WAS the straggler must move the skew estimate,
+not the clock estimate; over many steps the median holds).
+
+``merge_streams`` rewrites every event's ``ts`` into the reference
+process's clock, tags every event's ``meta`` with ``process=<label>``,
+emits one ``merge/offset`` static per process (the recovered offset, for
+auditing against a known skew), and returns the merged, time-sorted
+stream. ``summarize`` then detects the ``process`` tags and grows the
+straggler section: per-step max−median step time across processes, the
+worst process named, and its excess attributed by span family.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import statistics
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from apex_tpu import trace as _trace
+
+__all__ = ["process_label", "step_anchors", "estimate_offsets",
+           "merge_streams", "merge_files"]
+
+
+def process_label(path: str, index: int) -> str:
+    """``run-p3.jsonl`` -> ``p3``; anything without a ``p<N>`` marker
+    gets its argument position: ``p<index>``. The marker must be a
+    separator-delimited token and the LAST one wins — a bare
+    ``p(\\d+)`` search would grab the ``p2`` of ``exp2-run-p0.jsonl``
+    and label both of a pair's files identically."""
+    base = os.path.basename(path)
+    ms = list(re.finditer(r"(?:^|[-_.])p(\d+)(?=[-_.]|$)", base))
+    return f"p{ms[-1].group(1)}" if ms else f"p{index}"
+
+
+def step_anchors(events: Sequence[Dict[str, Any]]) -> Dict[int, float]:
+    """``{step: begin wall-ts}`` from this stream's step-start spans
+    (``span/step/dispatch`` end events: begin = ts − duration). Streams
+    recorded without tracing fall back to ONE ``*/time_s`` point series
+    — ``step/time_s`` when present, else the first sorted name (same
+    begin arithmetic). One series only: anchoring each step on whichever
+    ``/time_s`` name happened to appear first in the file would compute
+    offsets from MISMATCHED series when two processes' files interleave
+    them differently (the blended-loss-series lesson)."""
+    out: Dict[int, float] = {}
+    for r in _trace.span_rows(events):
+        if r["family"] == "step/dispatch" and r["step"] is not None:
+            out.setdefault(int(r["step"]), r["ts"] - r["dur_s"])
+    if out:
+        return out
+    by_name: Dict[str, Dict[int, float]] = {}
+    for e in events:
+        if (e.get("kind", "point") == "point"
+                and e.get("step") is not None
+                and e.get("name", "").endswith("/time_s")):
+            by_name.setdefault(e["name"], {}).setdefault(
+                int(e["step"]),
+                float(e.get("ts", 0.0)) - float(e["value"]))
+    if not by_name:
+        return out
+    pick = next((n for n in by_name if n == "step/time_s"
+                 or n.endswith("/step/time_s")), None)
+    return by_name[pick if pick is not None else sorted(by_name)[0]]
+
+
+def estimate_offsets(streams: Sequence[Tuple[str, List[Dict[str, Any]]]],
+                     ) -> Dict[str, Dict[str, Any]]:
+    """Per-process clock offset vs the FIRST stream (the reference):
+    ``{label: {"offset_s", "anchors"}}``. A stream sharing no step
+    anchors with the reference gets offset 0.0 and ``anchors == 0`` —
+    merged unaligned, loudly visible in the report."""
+    ref_label, ref_events = streams[0]
+    ref = step_anchors(ref_events)
+    out: Dict[str, Dict[str, Any]] = {
+        ref_label: {"offset_s": 0.0, "anchors": len(ref)}}
+    for label, events in streams[1:]:
+        anchors = step_anchors(events)
+        shared = sorted(set(ref) & set(anchors))
+        if shared:
+            offset = statistics.median(
+                anchors[s] - ref[s] for s in shared)
+        else:
+            offset = 0.0
+        out[label] = {"offset_s": offset, "anchors": len(shared)}
+    return out
+
+
+def merge_streams(streams: Sequence[Tuple[str, List[Dict[str, Any]]]],
+                  *, offsets: Optional[Dict[str, Dict[str, Any]]] = None,
+                  ) -> Tuple[List[Dict[str, Any]], Dict[str, Any]]:
+    """Merge ``[(label, events), ...]`` into one aligned stream.
+    Returns ``(merged_events, offsets)``."""
+    offsets = offsets or estimate_offsets(streams)
+    merged: List[Dict[str, Any]] = []
+    now = time.time()
+    for label, info in offsets.items():
+        merged.append({
+            "name": "merge/offset", "value": float(info["offset_s"]),
+            "ts": now, "kind": "static",
+            "meta": {"process": label, "anchors": info["anchors"]},
+        })
+    for label, events in streams:
+        off = offsets.get(label, {}).get("offset_s", 0.0)
+        for e in events:
+            d = dict(e)
+            if "ts" in d:
+                d["ts"] = float(d["ts"]) - off
+            meta = dict(d.get("meta") or {})
+            meta["process"] = label
+            d["meta"] = meta
+            merged.append(d)
+    # stable sort: statics (no meaningful ts ordering) keep file order
+    merged.sort(key=lambda d: float(d.get("ts", 0.0)))
+    return merged, offsets
+
+
+def merge_files(paths: Sequence[str], *,
+                follow_rotations: bool = True,
+                ) -> Tuple[List[Dict[str, Any]], Dict[str, Any]]:
+    """Load + label + align + merge per-process run files."""
+    from apex_tpu.telemetry.export import load
+    streams = [(process_label(p, i),
+                load(p, follow_rotations=follow_rotations))
+               for i, p in enumerate(paths)]
+    labels = [lab for lab, _ in streams]
+    if len(set(labels)) != len(labels):
+        # two files mapping to one label (run-p1.jsonl twice) would
+        # silently fuse their series; position-index them instead
+        streams = [(f"p{i}", ev) for i, (_, ev) in enumerate(streams)]
+    return merge_streams(streams)

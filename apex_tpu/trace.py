@@ -1,0 +1,261 @@
+"""apex_tpu.trace — host-side span tracing.
+
+The reference Apex's pyprof rides NVTX *ranges*: host-side begin/end
+markers are what join framework intent to device activity
+(apex/pyprof/nvtx). Our device half exists (``apex_tpu.pyprof``); this
+module is the host half — a low-overhead span API whose events land in
+the SAME ``telemetry.Collector``/JSONL stream as every other runtime
+fact, as a new ``span/*`` event family:
+
+  * ``span("name")`` — context manager AND decorator. Thread-aware
+    (each event records its thread), nestable (depth is tracked
+    per-thread), re-entrant (state lives in thread-local storage, so one
+    decorator instance is safe under concurrency and recursion).
+  * ``emit_span(name, begin, end)`` — record an already-timed interval
+    (producers that hold their own ``perf_counter`` brackets, e.g.
+    ``instrument_step``'s dispatch/wait split).
+
+Every span emits a begin/end *pair*: the begin event (value 0) is crash
+forensics — a JSONL whose last span has no end names the host activity
+the process died inside — and the end event carries the duration as its
+``value`` plus the monotonic end timestamp in ``meta`` (aggregation and
+the timeline export consume end events only). Span events use
+``kind="span"`` so summarize's point/counter aggregations ignore them by
+construction.
+
+Enabling is process-global, separate from telemetry's flag (the pattern
+of ``telemetry.health``): ``trace.enable()``. Spans are pure host code —
+they never trace anything into a jitted program, so flipping the flag
+cannot change a compiled step (pinned by a jaxpr-equality test); the
+disabled cost is one module-global bool check per span.
+
+Span naming convention: ``<family>/<point>`` — ``data/produce``,
+``data/wait``, ``step/dispatch``, ``step/device_wait``,
+``snapshot/serialize``, ``callback/record``, ``tune/measure``,
+``profile/step``. :func:`family_of` returns that two-component id; the
+wall-reconciliation and straggler reports aggregate by it.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from apex_tpu.telemetry import events as _ev
+
+__all__ = ["span", "emit_span", "enable", "disable", "enabled",
+           "family_of", "span_rows", "family_totals", "PREFIX",
+           "CONCURRENT_FAMILIES"]
+
+PREFIX = "span/"
+
+# Span families that run CONCURRENTLY with the train loop by design
+# (worker threads, async writer threads, XLA callback threads): real
+# host work — always visible in the spans table — but never a component
+# of the per-step wall, so neither summarize's reconciliation nor
+# bench's wall_gap may bill them (one definition, both consumers).
+CONCURRENT_FAMILIES = frozenset((
+    "data/produce", "callback/record", "snapshot/serialize",
+    "snapshot/publish"))
+
+_enabled = False
+_ids = itertools.count(1)        # CPython: count.__next__ is atomic
+_tls = threading.local()
+
+# pushed for spans entered while tracing was OFF, so a flag flip between
+# __enter__ and __exit__ can never mispair the per-thread stack
+_OFF = (False, 0, 0.0)
+
+
+def enable() -> None:
+    """Turn span emission on (host-side only: unlike telemetry's flag,
+    this is NOT trace-time — no compiled program changes either way)."""
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def family_of(name: str) -> str:
+    """``span/data/wait`` (or ``data/wait``) -> ``data/wait``: the
+    two-component producer id the reports aggregate by."""
+    if name.startswith(PREFIX):
+        name = name[len(PREFIX):]
+    parts = name.split("/")
+    return "/".join(parts[:2])
+
+
+def _stack() -> list:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+def _depth() -> int:
+    return getattr(_tls, "depth", 0)
+
+
+def _emit(name: str, value: float, *, ph: str, sid: int, depth: int,
+          mono: float, ts: float, step: Optional[int],
+          meta: Optional[dict]) -> None:
+    t = threading.current_thread()
+    m: Dict[str, Any] = {"ph": ph, "id": sid, "tid": t.ident or 0,
+                         "thread": t.name, "depth": depth, "mono": mono}
+    if meta:
+        m.update(meta)
+    _ev.get_collector().add(_ev.Event(
+        name=PREFIX + name, value=value, ts=ts, step=step, kind="span",
+        meta=m))
+
+
+class span:
+    """``with trace.span("data/produce"): ...`` or ``@trace.span(...)``.
+
+    ``step=`` attaches the step index (the merge CLI's cross-process
+    anchor and the reconciliation's per-step join); ``meta=`` rides extra
+    JSON-able context on both events."""
+
+    __slots__ = ("name", "step", "meta")
+
+    def __init__(self, name: str, *, step: Optional[int] = None,
+                 meta: Optional[dict] = None):
+        self.name = name
+        self.step = step
+        self.meta = meta
+
+    def __enter__(self) -> "span":
+        st = _stack()
+        if not _enabled:
+            st.append(_OFF)
+            return self
+        sid = next(_ids)
+        depth = _depth()
+        _tls.depth = depth + 1
+        t0 = time.perf_counter()
+        st.append((True, sid, t0))
+        _emit(self.name, 0.0, ph="B", sid=sid, depth=depth, mono=t0,
+              ts=time.time(), step=self.step, meta=self.meta)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        st = _stack()
+        if not st:          # defensive: unbalanced exit
+            return False
+        on, sid, t0 = st.pop()
+        if not on:
+            return False
+        _tls.depth = max(_depth() - 1, 0)
+        t1 = time.perf_counter()
+        _emit(self.name, t1 - t0, ph="E", sid=sid, depth=_depth(),
+              mono=t1, ts=time.time(), step=self.step, meta=self.meta)
+        return False
+
+    def __call__(self, fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with self:       # re-entrant: state lives on the tls stack
+                return fn(*args, **kwargs)
+        return wrapper
+
+
+def emit_span(name: str, begin: float, end: float, *,
+              step: Optional[int] = None,
+              meta: Optional[dict] = None) -> None:
+    """Record an already-timed ``perf_counter`` interval as a span pair.
+    No-op while disabled — producers can bracket unconditionally and pay
+    only the two clock reads.
+
+    Wall timestamps are DERIVED from the monotonic brackets (one paired
+    wall/mono reading at emission, shifted back by ``now_mono − end``),
+    so emission may lag the interval arbitrarily without displacing the
+    recorded times — ``instrument_step`` emits the dispatch span only
+    after ``block_until_ready``, and that span's begin is the merge
+    CLI's cross-process clock anchor: displacing it by the device wait
+    would bias every recovered offset by exactly the straggler signal
+    being measured."""
+    if not _enabled:
+        return
+    sid = next(_ids)
+    dur = max(end - begin, 0.0)
+    now_wall = time.time()
+    now_mono = time.perf_counter()
+    ts_end = now_wall - max(now_mono - end, 0.0)
+    depth = _depth()
+    _emit(name, 0.0, ph="B", sid=sid, depth=depth, mono=begin,
+          ts=ts_end - dur, step=step, meta=meta)
+    _emit(name, dur, ph="E", sid=sid, depth=depth, mono=end, ts=ts_end,
+          step=step, meta=meta)
+
+
+# ---------------------------------------------------------------------------
+# offline helpers (consumed by export.summarize, bench, pyprof timeline)
+# ---------------------------------------------------------------------------
+
+def span_rows(events: Iterable) -> List[Dict[str, Any]]:
+    """Completed spans from an event stream (dicts or Events): one row
+    per END event — ``{name, family, dur_s, begin_mono, end_mono, ts,
+    step, tid, thread, depth, process}``. Begin events (crash forensics)
+    are skipped; a span that never ended therefore never shows a bogus
+    duration."""
+    rows: List[Dict[str, Any]] = []
+    for e in events:
+        d = e.to_dict() if isinstance(e, _ev.Event) else e
+        if d.get("kind") != "span":
+            continue
+        meta = d.get("meta") or {}
+        if meta.get("ph") != "E":
+            continue
+        dur = float(d.get("value", 0.0))
+        mono = meta.get("mono")
+        rows.append({
+            "name": d["name"],
+            "family": family_of(d["name"]),
+            "dur_s": dur,
+            "begin_mono": None if mono is None else float(mono) - dur,
+            "end_mono": None if mono is None else float(mono),
+            "ts": float(d.get("ts", 0.0)),
+            "step": d.get("step"),
+            "tid": meta.get("tid", 0),
+            "thread": meta.get("thread", ""),
+            "depth": meta.get("depth", 0),
+            "process": meta.get("process"),
+        })
+    return rows
+
+
+def family_totals(events: Iterable, *, exclude: Iterable[str] = (),
+                  window: Optional[tuple] = None) -> Dict[str, float]:
+    """Total seconds per span family over a stream (bench's ``wall_gap``
+    bill). ``window=(mono_t0, mono_t1)`` keeps only spans intersecting
+    that ``perf_counter`` interval — the same rule capture's sidecar
+    uses, so startup work (an autotuner sweep) is not billed to a
+    measured loop that never paid it. Nested spans double into their
+    parents by design — each family answers "how much time did THIS
+    activity take", not "how does the wall partition". (The
+    reconciliation report approximates partitioning: it skips
+    :data:`CONCURRENT_FAMILIES` and stack-nested spans, but spans that
+    merely overlap in TIME on one thread — an ``emit_span`` interval
+    inside another — can still double-bill; its residual goes negative
+    rather than hiding that.)"""
+    exclude = frozenset(exclude)
+    out: Dict[str, float] = {}
+    for r in span_rows(events):
+        if r["family"] in exclude:
+            continue
+        if window is not None:
+            if r["end_mono"] is None or r["end_mono"] < window[0] \
+                    or r["begin_mono"] > window[1]:
+                continue
+        out[r["family"]] = out.get(r["family"], 0.0) + r["dur_s"]
+    return out

@@ -48,8 +48,15 @@ def time_fn(fn: Callable[[], Any], *, warmup: int = DEFAULT_WARMUP,
 
     ``fn`` returns its device outputs; blocking happens HERE so a closure
     under test cannot accidentally be timed async (returning unblocked
-    arrays is the natural way to write one)."""
+    arrays is the natural way to write one).
+
+    With ``apex_tpu.trace`` enabled, the whole measurement (warmup +
+    repeats) is bracketed in a ``span/tune/measure`` span — an in-run
+    sweep is host time the train loop pays, and the wall reconciliation
+    should bill it by name, not leave it in the residual."""
     import jax
+    from apex_tpu import trace as _trace
+    t_span = time.perf_counter()
     for _ in range(max(0, warmup)):
         jax.block_until_ready(fn())
     samples: List[float] = []
@@ -57,6 +64,7 @@ def time_fn(fn: Callable[[], Any], *, warmup: int = DEFAULT_WARMUP,
         t0 = time.perf_counter()
         jax.block_until_ready(fn())
         samples.append(time.perf_counter() - t0)
+    _trace.emit_span("tune/measure", t_span, time.perf_counter())
     return float(np.median(samples))
 
 

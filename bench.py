@@ -23,8 +23,11 @@ the per-subsystem breakdown (compute/collective/idle split, roofline
 verdicts, overlap efficiency from device timestamps) is embedded under
 the BENCH JSON's "profile" key, and the legacy per-op summary still
 lands in benchmarks/trace_summary_resnet50.txt. The BENCH JSON always
-carries "dispatch_gap_pct" and "profile" (null when unavailable/off) so
-BENCH_r*.json rows stay schema-comparable across rounds.
+carries "dispatch_gap_pct", "profile" and "wall_gap" (null when
+unavailable/off) so BENCH_r*.json rows stay schema-comparable across
+rounds. BENCH_TRACE=1 turns on host span tracing (apex_tpu.trace) and
+fills "wall_gap" with the top host span families behind the
+device-vs-wall gap.
 """
 
 import json
@@ -84,6 +87,16 @@ def main():
     if os.environ.get("BENCH_HEALTH"):
         from apex_tpu import telemetry
         telemetry.health.enable()
+    # BENCH_TRACE=1 turns on host-side span tracing (apex_tpu.trace):
+    # the measured loop runs instrumented (dispatch/device-wait spans per
+    # dispatch) and the BENCH JSON's "wall_gap" key decomposes the
+    # device-vs-wall gap into the top host span families. Spans are host
+    # code only — the compiled step is identical either way.
+    trace_on = bool(os.environ.get("BENCH_TRACE"))
+    if trace_on:
+        from apex_tpu import telemetry, trace
+        telemetry.enable()   # instrument_step rides telemetry's flag
+        trace.enable()
     # BENCH_TUNE=1 runs under APEX_TPU_TUNE=auto (measure-and-fill from
     # the persistent tune cache) — the A/B knob for the autotuner: run
     # once without and once with it on the same machine and compare
@@ -285,7 +298,8 @@ def main():
 
     outer = max(1, (steps - warmup) // inner_steps)
     run_fn = multi_fn
-    if tel_path:
+    if tel_path or trace_on:
+        from apex_tpu import telemetry
         # instrumented variant of the measured loop: each call is one
         # inner_steps-step dispatch, so the step/* events describe
         # dispatches (examples_per_step keeps examples/s honest); the
@@ -294,12 +308,13 @@ def main():
             multi_fn, examples_per_step=batch * inner_steps,
             measure_flops=False,
             model_flops=(flops_per_step or 0) * inner_steps or None)
-    t0 = time.perf_counter()
+    loop_t0 = t0 = time.perf_counter()
     for _ in range(outer):
         params, batch_stats, opt_state, loss = run_fn(
             params, batch_stats, opt_state, (x, y))
     _ = float(loss)  # D2H fetch: the only trustworthy sync on a remote chip
     dt = time.perf_counter() - t0
+    loop_t1 = time.perf_counter()
     n_steps = outer * inner_steps
     img_s_wall = batch * n_steps / dt
     log(f"{img_s_wall:.1f} img/s wall ({dt:.2f}s for {n_steps} steps, "
@@ -325,10 +340,38 @@ def main():
         "wall_img_s": round(img_s_wall, 1),
         "dispatch_gap_pct": dispatch_gap_pct,
         "profile": None,
+        "wall_gap": None,
         "tune": tune_cfg,
         "overlap": {"enabled": overlap_on, "reduce_dtype": reduce_dtype,
                     "adasum": adasum},
     }
+    if trace_on:
+        # the wall-vs-device gap, itemized: top host span families by
+        # time over the MEASURED loop only (spans windowed to
+        # [loop_t0, loop_t1], the same intersect-the-window rule as
+        # capture's sidecar — warmup/startup spans like an autotuner
+        # sweep are host time the timed loop never paid), per TRAIN
+        # step. Excluded: step/device_wait (the host blocking on the
+        # device — device time, not host overhead) and the
+        # concurrent-by-design families (same set summarize's
+        # reconciliation skips); the "wall_gap": null default keeps
+        # BENCH_r*.json rows schema-comparable across rounds.
+        from apex_tpu import telemetry, trace
+        jax.effects_barrier()   # async callback spans land first
+        fams = trace.family_totals(
+            telemetry.get_collector().snapshot(),
+            exclude=("step/device_wait", "profile/step",
+                     *trace.CONCURRENT_FAMILIES),
+            window=(loop_t0, loop_t1))
+        top = sorted(fams.items(), key=lambda kv: -kv[1])[:3]
+        result["wall_gap"] = {
+            "steps": n_steps,
+            "families_s_per_step": {
+                fam: round(total / n_steps, 9) for fam, total in top},
+        }
+        log("wall gap (host span families): " + "  ".join(
+            f"{fam}={total / n_steps * 1e3:.3f}ms/step"
+            for fam, total in top))
     if flops_per_step:
         achieved = flops_per_step * img_s / batch
         result["tflops"] = round(achieved / 1e12, 1)

@@ -125,6 +125,18 @@ def parse_args(argv=None):
                         "Inspect with `python -m apex_tpu.pyprof report "
                         "DIR`; gate with `... compare A B`. With "
                         "--telemetry, profile/* events join the JSONL")
+    p.add_argument("--trace", action="store_true",
+                   help="host-side span tracing (apex_tpu.trace): "
+                        "span/* begin/end events for the step dispatch/"
+                        "device-wait split, data-pipeline waits, "
+                        "snapshot I/O and callback host work join the "
+                        "telemetry stream; summarize then renders the "
+                        "wall-reconciliation section, and with "
+                        "--profile DIR the unified host+device timeline "
+                        "exports via `python -m apex_tpu.pyprof report "
+                        "DIR --timeline out.trace.json`. Implies "
+                        "telemetry; add --telemetry PATH to write the "
+                        "JSONL")
     p.add_argument("--telemetry", default=None, metavar="PATH",
                    help="write a runtime-telemetry JSONL here: per-step "
                         "dispatch/device time split, tokens/s, MFU, "
@@ -238,6 +250,19 @@ def main(argv=None):
         # callbacks are traced into the program only while enabled
         from apex_tpu import telemetry
         telemetry.enable()
+    if args.trace:
+        # host-side spans: purely host code, nothing joins the traced
+        # program (jaxpr-identical either way) — but the step wrapper
+        # that emits the dispatch/device-wait spans rides telemetry's
+        # flag, so tracing implies it
+        from apex_tpu import telemetry, trace
+        telemetry.enable()
+        trace.enable()
+        if not args.telemetry:
+            print("note: --trace without --telemetry keeps spans "
+                  "in-process only; pass --telemetry PATH to write the "
+                  "JSONL for summarize/merge/--timeline",
+                  file=sys.stderr)
     if args.health:
         # separate trace-time flag: the in-graph health producers
         # (grad_stats, overflow attribution) join the step program only
@@ -424,10 +449,12 @@ def main(argv=None):
                               params, opt_state, batch, model)
 
     step_call = step_fn
-    if args.telemetry:
+    if args.telemetry or args.trace:
         from apex_tpu import telemetry
         # wraps every call with the dispatch/device split + tokens/s, and
-        # (lazily, from call 2) MFU off XLA's cost analysis of step_fn
+        # (lazily, from call 2) MFU off XLA's cost analysis of step_fn;
+        # under --trace it additionally emits the span/step/* pair every
+        # step (the merge CLI's clock anchors)
         step_call = telemetry.instrument_step(
             step_fn, tokens_per_step=batch * args.seq_len)
 
@@ -631,6 +658,10 @@ def main(argv=None):
                if bd.get("dispatch_gap_pct") is not None else ""))
         print(f"profile: {args.profile} (python -m apex_tpu.pyprof "
               f"report {args.profile})")
+        if args.trace:
+            print(f"timeline: python -m apex_tpu.pyprof report "
+                  f"{args.profile} --timeline out.trace.json "
+                  "(unified host+device lanes)")
     if detector is not None and detector.alerts:
         print(f"health: {len(detector.alerts)} divergence alert(s) fired "
               "— see lines above", file=sys.stderr)

@@ -1,0 +1,656 @@
+"""apex_tpu.trace host-side span tracing: span API units (pairing,
+nesting, threading, decorator, disabled no-op), producer wiring
+(instrument_step dispatch/wait spans, PrefetchLoader wait_s +
+blocked-wait span, SnapshotManager save/serialize/publish, tune
+measurement), the disabled-tracing jaxpr-equality guarantee, the
+summarize spans/wall-reconciliation sections, multi-process merge on the
+COMMITTED two-process fixture with a known 1.75 s clock skew (offset
+recovery + straggler attribution), and the unified host+device timeline
+export."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import telemetry, trace
+from apex_tpu.telemetry.export import format_summary, summarize
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures")
+P0 = os.path.join(FIXDIR, "trace_run-p0.jsonl")
+P1 = os.path.join(FIXDIR, "trace_run-p1.jsonl")
+DEVICE_TRACE = os.path.join(FIXDIR, "synthetic_trace.json")
+
+# fixture ground truth (see the generator values in the files)
+FIXTURE_SKEW = 1.75
+FIXTURE_STEPS = 6
+
+
+@pytest.fixture
+def traced():
+    """Fresh collector + tracing enabled; both restored afterwards."""
+    with telemetry.capture() as col:
+        trace.enable()
+        try:
+            yield col
+        finally:
+            trace.disable()
+
+
+def _events(col):
+    return [e.to_dict() for e in col.snapshot()]
+
+
+# ---------------------------------------------------------------------------
+# span API
+# ---------------------------------------------------------------------------
+
+class TestSpanAPI:
+    def test_begin_end_pair(self, traced):
+        with trace.span("data/wait", step=3):
+            time.sleep(0.005)
+        evs = _events(traced)
+        assert len(evs) == 2
+        b, e = evs
+        assert b["name"] == e["name"] == "span/data/wait"
+        assert b["kind"] == e["kind"] == "span"
+        assert b["meta"]["ph"] == "B" and e["meta"]["ph"] == "E"
+        assert b["meta"]["id"] == e["meta"]["id"]
+        assert b["step"] == e["step"] == 3
+        assert e["value"] >= 0.005
+        assert e["meta"]["mono"] > b["meta"]["mono"]
+        assert e["meta"]["thread"] == threading.current_thread().name
+
+    def test_disabled_emits_nothing(self):
+        with telemetry.capture() as col:
+            assert not trace.enabled()
+            with trace.span("data/wait"):
+                pass
+            trace.emit_span("step/dispatch", 0.0, 1.0)
+            assert len(col) == 0
+
+    def test_nesting_depth(self, traced):
+        with trace.span("snapshot/save"):
+            with trace.span("snapshot/serialize"):
+                pass
+        rows = trace.span_rows(_events(traced))
+        by_name = {r["name"]: r for r in rows}
+        assert by_name["span/snapshot/save"]["depth"] == 0
+        assert by_name["span/snapshot/serialize"]["depth"] == 1
+
+    def test_decorator_and_recursion(self, traced):
+        calls = []
+
+        @trace.span("tune/measure")
+        def f(n):
+            calls.append(n)
+            if n:
+                f(n - 1)
+
+        f(2)
+        rows = trace.span_rows(_events(traced))
+        assert len(rows) == 3 and calls == [2, 1, 0]
+        assert sorted(r["depth"] for r in rows) == [0, 1, 2]
+
+    def test_thread_awareness(self, traced):
+        def work():
+            with trace.span("data/produce"):
+                time.sleep(0.002)
+
+        ts = [threading.Thread(target=work, name=f"w{i}")
+              for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        rows = trace.span_rows(_events(traced))
+        assert len(rows) == 2
+        assert {r["thread"] for r in rows} == {"w0", "w1"}
+        assert len({r["tid"] for r in rows}) == 2
+        # each thread's depth is tracked independently
+        assert all(r["depth"] == 0 for r in rows)
+
+    def test_flag_flip_mid_span_stays_balanced(self):
+        with telemetry.capture() as col:
+            trace.enable()
+            try:
+                s = trace.span("data/wait")
+                s.__enter__()
+                trace.disable()
+                # a span that BEGAN still ends: the begin/end pairing in
+                # the file stays balanced across a mid-span disable
+                s.__exit__(None, None, None)
+                # the reverse: entered disabled -> nothing is emitted,
+                # and the per-thread stack stays consistent
+                s2 = trace.span("tune/measure")
+                s2.__enter__()
+                trace.enable()
+                s2.__exit__(None, None, None)
+                with trace.span("data/produce"):
+                    pass
+            finally:
+                trace.disable()
+            evs = _events(col)
+            rows = trace.span_rows(evs)
+            assert [r["name"] for r in rows] == ["span/data/wait",
+                                                "span/data/produce"]
+            begins = sum(1 for e in evs if e["meta"]["ph"] == "B")
+            ends = sum(1 for e in evs if e["meta"]["ph"] == "E")
+            assert begins == ends == 2
+            assert rows[-1]["depth"] == 0
+
+    def test_family_of(self):
+        assert trace.family_of("span/data/wait") == "data/wait"
+        assert trace.family_of("step/dispatch") == "step/dispatch"
+        assert trace.family_of("span/snapshot/serialize/extra") \
+            == "snapshot/serialize"
+        assert trace.family_of("span/custom") == "custom"
+
+    def test_emit_span_late_emission_keeps_wall_ts(self, traced):
+        """emit_span may run long after the interval it records (the
+        dispatch span is emitted after block_until_ready) — the wall ts
+        must derive from the mono brackets, not the emission time, or
+        every merge clock anchor would be displaced by the device wait
+        (biasing recovered offsets by exactly the straggler signal)."""
+        t0 = time.perf_counter()
+        w0 = time.time()
+        time.sleep(0.05)                      # emission lags the span
+        trace.emit_span("step/dispatch", t0, t0 + 0.01, step=0)
+        r = trace.span_rows(_events(traced))[0]
+        begin_wall = r["ts"] - r["dur_s"]
+        assert begin_wall == pytest.approx(w0, abs=0.02)
+        assert begin_wall < w0 + 0.04         # NOT displaced by the lag
+
+    def test_emit_span_and_family_totals(self, traced):
+        trace.emit_span("step/dispatch", 10.0, 10.5, step=0)
+        trace.emit_span("step/dispatch", 11.0, 11.25, step=1)
+        trace.emit_span("data/wait", 10.5, 10.6)
+        evs = _events(traced)
+        totals = trace.family_totals(evs)
+        assert totals["step/dispatch"] == pytest.approx(0.75)
+        assert totals["data/wait"] == pytest.approx(0.1)
+        assert trace.family_totals(evs, exclude=("data/wait",)) == \
+            {"step/dispatch": pytest.approx(0.75)}
+        rows = trace.span_rows(evs)
+        r = next(r for r in rows if r["step"] == 1)
+        assert r["begin_mono"] == pytest.approx(11.0)
+        assert r["end_mono"] == pytest.approx(11.25)
+
+
+# ---------------------------------------------------------------------------
+# producer wiring
+# ---------------------------------------------------------------------------
+
+class TestProducers:
+    def test_instrument_step_spans(self, traced):
+        step = telemetry.instrument_step(jax.jit(lambda x: x + 1.0),
+                                         measure_flops=False)
+        x = jnp.zeros(())
+        step(x)
+        step(x)
+        rows = trace.span_rows(_events(traced))
+        fams = {r["family"] for r in rows}
+        assert {"step/dispatch", "step/device_wait"} <= fams
+        disp = sorted(r["step"] for r in rows
+                      if r["family"] == "step/dispatch")
+        assert disp == [0, 1]
+
+    def test_prefetch_wait_s_and_span(self, traced):
+        from apex_tpu.runtime import PrefetchLoader
+
+        def slow_source():
+            for i in range(3):
+                time.sleep(0.02)
+                yield i
+
+        loader = PrefetchLoader(slow_source(), depth=2)
+        items = list(loader)
+        assert items == [0, 1, 2]
+        st = loader.stats()
+        assert st["wait_s"] > 0.0          # the consumer really blocked
+        assert st["starvations"] >= 1
+        rows = trace.span_rows(_events(traced))
+        fams = [r["family"] for r in rows]
+        assert "data/wait" in fams
+        assert "data/produce" in fams
+        # the wait spans roughly account for the stats counter
+        waited = sum(r["dur_s"] for r in rows
+                     if r["family"] == "data/wait")
+        assert waited <= st["wait_s"] + 1e-3
+
+    def test_prefetch_wait_s_without_tracing(self):
+        from apex_tpu.runtime import PrefetchLoader
+        loader = PrefetchLoader(iter(range(4)), depth=2)
+        assert list(loader) == [0, 1, 2, 3]
+        assert "wait_s" in loader.stats()
+
+    def test_snapshot_spans_sync(self, traced, tmp_path):
+        from apex_tpu.resilience import SnapshotManager
+        mgr = SnapshotManager(str(tmp_path / "snap"), keep_last=2)
+        mgr.save({"w": np.ones((4,), np.float32)}, step=1)
+        rows = trace.span_rows(_events(traced))
+        fams = {r["family"] for r in rows}
+        assert {"snapshot/save", "snapshot/serialize",
+                "snapshot/publish"} <= fams
+        save = next(r for r in rows if r["family"] == "snapshot/save")
+        assert save["step"] == 1
+        # sync: serialize nests inside the caller-side save span
+        ser = next(r for r in rows
+                   if r["family"] == "snapshot/serialize")
+        assert ser["depth"] == 0 or ser["thread"] == save["thread"]
+
+    def test_snapshot_spans_async_thread(self, traced, tmp_path):
+        from apex_tpu.resilience import SnapshotManager
+        mgr = SnapshotManager(str(tmp_path / "snap"), keep_last=2,
+                              async_mode=True)
+        mgr.save({"w": np.ones((4,), np.float32)}, step=2)
+        assert mgr.wait()
+        rows = trace.span_rows(_events(traced))
+        save = next(r for r in rows if r["family"] == "snapshot/save")
+        ser = next(r for r in rows
+                   if r["family"] == "snapshot/serialize")
+        # serialize runs on the background writer thread, save on ours
+        assert ser["thread"] == "apex-snapshot"
+        assert save["thread"] == threading.current_thread().name
+
+    def test_tune_measure_span(self, traced):
+        from apex_tpu.tune import measure
+        x = jnp.ones((8,))
+        measure.time_fn(lambda: x * 2.0, warmup=0, repeats=1)
+        rows = trace.span_rows(_events(traced))
+        assert any(r["family"] == "tune/measure" for r in rows)
+
+    def test_callback_record_span(self, traced):
+        @jax.jit
+        def step(x):
+            telemetry.record("train/loss", x)
+            return x + 1.0
+
+        step(jnp.zeros(()))
+        jax.effects_barrier()
+        rows = trace.span_rows(_events(traced))
+        assert any(r["family"] == "callback/record" for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# disabled tracing changes nothing in traced programs
+# ---------------------------------------------------------------------------
+
+class TestJaxprEquality:
+    def _step_factory(self):
+        # fresh closure per trace: jax.make_jaxpr caches by function
+        # object, which would make same-object comparisons trivially pass
+        def step(x, w):
+            telemetry.record("train/loss", jnp.mean(x))
+            return x @ w
+
+        return step
+
+    def test_all_disabled_traces_no_callbacks(self):
+        assert not telemetry.enabled() and not trace.enabled()
+        x = jnp.ones((4, 4))
+        jaxpr = str(jax.make_jaxpr(self._step_factory())(x, x))
+        assert "debug_callback" not in jaxpr
+
+    def test_trace_flag_never_changes_the_program(self):
+        """Spans are host-side only: even with telemetry's callbacks
+        traced in, flipping the trace flag yields a bit-identical
+        program (the span wrapping lives inside the host callback)."""
+        import re
+        x = jnp.ones((4, 4))
+        with telemetry.capture():
+            assert not trace.enabled()
+            off = str(jax.make_jaxpr(self._step_factory())(x, x))
+            trace.enable()
+            try:
+                on = str(jax.make_jaxpr(self._step_factory())(x, x))
+            finally:
+                trace.disable()
+        # the debug_callback eqn prints its host closure's id — an
+        # incidental per-object address, not program structure
+        addr = re.compile(r"0x[0-9a-f]+")
+        assert addr.sub("0x", on) == addr.sub("0x", off)
+
+
+# ---------------------------------------------------------------------------
+# summarize: spans section + wall reconciliation
+# ---------------------------------------------------------------------------
+
+def _mk_span(name, dur, *, step=None, mono=0.0, tid=1, ph="E",
+             thread="MainThread", depth=0, process=None):
+    meta = {"ph": ph, "id": 1, "tid": tid, "thread": thread,
+            "depth": depth, "mono": mono}
+    if process is not None:
+        meta["process"] = process
+    return {"name": f"span/{name}", "value": dur, "ts": mono,
+            "step": step, "kind": "span", "meta": meta}
+
+
+class TestSummarizeSections:
+    def _recon_events(self, with_profile=True):
+        evs = []
+        for i in range(3):
+            evs.append({"name": "step/time_s", "value": 0.100,
+                        "ts": float(i), "step": i, "kind": "point"})
+            evs.append(_mk_span("step/dispatch", 0.010, step=i))
+            evs.append(_mk_span("step/device_wait", 0.088, step=i))
+            evs.append(_mk_span("data/wait", 0.002, step=i))
+            # concurrent-by-design families: visible in the spans
+            # section, never billed as wall components
+            evs.append(_mk_span("data/produce", 0.050, step=i))
+            evs.append(_mk_span("callback/record", 0.001))
+            # stack-nested span (depth 1): its parent already carries
+            # this time — spans table yes, wall component no
+            evs.append(_mk_span("tune/measure", 0.005, step=i, depth=1))
+        if with_profile:
+            evs.append({"name": "profile/device_busy_s_per_step",
+                        "value": 0.080, "kind": "static", "ts": 0.0})
+            evs.append({"name": "profile/dispatch_gap_pct",
+                        "value": 20.0, "kind": "static", "ts": 0.0})
+        return evs
+
+    def test_spans_section(self):
+        s = summarize(self._recon_events())
+        sp = s["spans"]
+        assert sp["data/produce"]["count"] == 3
+        assert sp["data/produce"]["total_s"] == pytest.approx(0.150)
+        assert sp["step/dispatch"]["mean"] == pytest.approx(0.010)
+
+    def test_reconciliation_exact(self):
+        """wall 100 ms = busy 80 + dispatch 10 + blocked_on_device 8 +
+        data/wait 2 + residual 0."""
+        s = summarize(self._recon_events())
+        rc = s["reconciliation"]
+        assert rc["busy_source"] == "profile"
+        assert rc["device_busy_s"] == pytest.approx(0.080)
+        comps = rc["components"]
+        assert comps["step/dispatch"] == pytest.approx(0.010)
+        assert comps["blocked_on_device"] == pytest.approx(0.008)
+        assert comps["data/wait"] == pytest.approx(0.002)
+        assert "data/produce" not in comps
+        assert "callback/record" not in comps
+        assert "tune/measure" not in comps     # depth-1: parent's time
+        assert s["spans"]["tune/measure"]["count"] == 3
+        assert rc["gap_s"] == pytest.approx(0.020)
+        assert rc["residual_s"] == pytest.approx(0.0, abs=1e-12)
+        assert rc["profile_dispatch_gap_pct"] == 20.0
+        # the acceptance contract: >= 80% of the gap is named
+        assert abs(rc["residual_pct"]) <= 20.0
+        text = format_summary(s)
+        assert "wall reconciliation" in text
+        assert "blocked_on_device" in text
+
+    def test_reconciliation_proxy_without_profile(self):
+        s = summarize(self._recon_events(with_profile=False))
+        rc = s["reconciliation"]
+        assert rc["busy_source"].startswith("step/device_wait")
+        assert rc["device_busy_s"] == pytest.approx(0.088)
+        assert "blocked_on_device" not in rc["components"]
+        # residual = 100 - 88 - 10 - 2 = 0
+        assert rc["residual_s"] == pytest.approx(0.0, abs=1e-12)
+
+    def test_reconciliation_not_inflated_by_process_count(self):
+        """Merged 2-process stream, identical behavior: each process's
+        data/wait is 20 ms/step — the component must read 20 ms, not
+        the 40 ms a total/distinct-steps division would fabricate."""
+        events = []
+        for proc in ("p0", "p1"):
+            for i in range(3):
+                events.append({"name": "step/time_s", "value": 0.100,
+                               "ts": float(i), "step": i,
+                               "kind": "point",
+                               "meta": {"process": proc}})
+                events.append(_mk_span("step/dispatch", 0.010, step=i,
+                                       process=proc))
+                events.append(_mk_span("step/device_wait", 0.088,
+                                       step=i, process=proc))
+                events.append(_mk_span("data/wait", 0.020, step=i,
+                                       process=proc))
+        s = summarize(events)
+        rc = s["reconciliation"]
+        assert rc["components"]["data/wait"] == pytest.approx(0.020)
+        assert rc["components"]["step/dispatch"] == pytest.approx(0.010)
+
+    def test_family_totals_window(self):
+        evs = [_mk_span("tune/measure", 2.0, mono=5.0),     # pre-loop
+               _mk_span("data/wait", 0.5, mono=11.0)]       # in-loop
+        totals = trace.family_totals(evs, window=(10.0, 20.0))
+        assert totals == {"data/wait": pytest.approx(0.5)}
+        assert "tune/measure" in trace.family_totals(evs)
+
+    def test_no_spans_no_sections(self):
+        s = summarize([{"name": "step/time_s", "value": 0.1, "ts": 0.0,
+                        "step": 0, "kind": "point"}])
+        assert "spans" not in s and "reconciliation" not in s
+
+
+# ---------------------------------------------------------------------------
+# multi-process merge: the committed skewed fixture
+# ---------------------------------------------------------------------------
+
+class TestMergeFixture:
+    def test_offset_recovered_within_tolerance(self):
+        from apex_tpu.telemetry.merge import merge_files
+        merged, offsets = merge_files([P0, P1])
+        assert offsets["p0"]["offset_s"] == 0.0
+        assert offsets["p1"]["anchors"] == FIXTURE_STEPS
+        assert offsets["p1"]["offset_s"] == pytest.approx(
+            FIXTURE_SKEW, abs=0.01)
+
+    def test_merged_events_tagged_and_aligned(self):
+        from apex_tpu.telemetry.merge import merge_files
+        merged, offsets = merge_files([P0, P1])
+        procs = {(e.get("meta") or {}).get("process") for e in merged
+                 if e["name"] != "merge/offset"}
+        assert procs == {"p0", "p1"}
+        # after alignment both processes' step-0 dispatch begins agree
+        # to within the fixture's per-step jitter
+        from apex_tpu.telemetry.merge import step_anchors
+        a0 = step_anchors([e for e in merged
+                           if e["meta"].get("process") == "p0"])
+        a1 = step_anchors([e for e in merged
+                           if e["meta"].get("process") == "p1"])
+        for s in range(FIXTURE_STEPS):
+            assert a1[s] - a0[s] == pytest.approx(0.0, abs=0.005)
+
+    def test_straggler_names_slow_process(self):
+        from apex_tpu.telemetry.merge import merge_files
+        merged, _ = merge_files([P0, P1])
+        s = summarize(merged)
+        st = s["stragglers"]
+        assert st["worst"]["process"] == "p1"
+        assert st["worst"]["steps_worst"] == FIXTURE_STEPS
+        # skew = 125 - median(95, 125) = 15 ms per step
+        assert st["skew_s"]["mean"] == pytest.approx(0.015, abs=1e-6)
+        # the excess is attributed to the input wait, by name
+        attr = st["attribution"]
+        assert attr and attr[0]["family"] == "data/wait"
+        assert attr[0]["excess_s_per_step"] == pytest.approx(
+            0.014, abs=1e-3)
+        text = format_summary(s)
+        assert "stragglers (2 processes" in text
+        assert "worst: p1" in text
+        assert "data/wait" in text
+
+    def test_merge_cli(self, tmp_path, capsys):
+        from apex_tpu.telemetry import cli
+        out = str(tmp_path / "merged.jsonl")
+        assert cli.main(["merge", P0, P1, "-o", out]) == 0
+        printed = capsys.readouterr().out
+        assert "clock offset" in printed
+        from apex_tpu.telemetry.export import read_jsonl
+        merged = read_jsonl(out)
+        assert any(e["name"] == "merge/offset" for e in merged)
+        # summarize CLI renders the straggler section on the merged file
+        assert cli.main(["summarize", out]) == 0
+        assert "stragglers" in capsys.readouterr().out
+
+    def test_merge_cli_rerun_truncates_output(self, tmp_path, capsys):
+        """Re-running merge into the same -o must REPLACE the file —
+        write_jsonl appends by contract, and a doubled merged stream
+        would double-count every series in the next summarize."""
+        from apex_tpu.telemetry import cli
+        from apex_tpu.telemetry.export import read_jsonl
+        out = str(tmp_path / "merged.jsonl")
+        assert cli.main(["merge", P0, P1, "-o", out]) == 0
+        n1 = len(read_jsonl(out))
+        assert cli.main(["merge", P0, P1, "-o", out]) == 0
+        assert len(read_jsonl(out)) == n1
+
+    def test_process_label_anchored_marker(self):
+        """The p<N> marker must be separator-delimited and the LAST one
+        wins — a bare search would label exp2-run-p0 as p2."""
+        from apex_tpu.telemetry.merge import process_label
+        assert process_label("run-p3.jsonl", 9) == "p3"
+        assert process_label("exp2-run-p0.jsonl", 9) == "p0"
+        assert process_label("exp2-run-p1.jsonl", 9) == "p1"
+        assert process_label("p7.jsonl", 9) == "p7"
+        assert process_label("plain.jsonl", 4) == "p4"
+
+    def test_attribution_rates_survive_uneven_step_counts(self):
+        """A process that recorded MORE steps must not read as a
+        straggler just because its whole-run family totals are bigger —
+        rates are per process-own step count."""
+        events = []
+        # p0: 3 steps; p1: 6 steps — identical per-step behavior
+        for proc, steps in (("p0", 3), ("p1", 6)):
+            for i in range(steps):
+                events.append({"name": "step/time_s", "value": 0.1,
+                               "ts": float(i), "step": i,
+                               "kind": "point",
+                               "meta": {"process": proc}})
+                events.append(_mk_span("data/produce", 0.05, step=i,
+                                       process=proc))
+        s = summarize(events)
+        st = s["stragglers"]
+        # identical step times: no per-family excess fabricated for p1
+        assert all(a["excess_s_per_step"] < 1e-9
+                   for a in st.get("attribution", []))
+
+    def test_fallback_anchor_uses_one_series(self):
+        """Without spans, anchors come from ONE /time_s series
+        (step/time_s preferred) — never whichever name appears first in
+        the file, which would mismatch across differently-interleaved
+        process files."""
+        from apex_tpu.telemetry.merge import step_anchors
+
+        def ev(name, step, ts, value):
+            return {"name": name, "step": step, "ts": ts,
+                    "value": value, "kind": "point"}
+
+        # eval/time_s interleaved FIRST at every step
+        events = []
+        for i in range(3):
+            events.append(ev("eval/time_s", i, 100.0 + i, 0.5))
+            events.append(ev("step/time_s", i, 10.0 + i, 0.1))
+        anchors = step_anchors(events)
+        assert anchors == {i: pytest.approx(9.9 + i) for i in range(3)}
+
+    def test_no_shared_anchors_warns_not_crashes(self):
+        from apex_tpu.telemetry.merge import merge_streams
+        merged, offsets = merge_streams([
+            ("p0", [{"name": "x", "value": 1.0, "ts": 0.0,
+                     "kind": "point"}]),
+            ("p1", [{"name": "x", "value": 1.0, "ts": 5.0,
+                     "kind": "point"}]),
+        ])
+        assert offsets["p1"]["anchors"] == 0
+        assert offsets["p1"]["offset_s"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# unified host+device timeline
+# ---------------------------------------------------------------------------
+
+class TestTimeline:
+    def _host_rows(self):
+        # device fixture window: [0, 250] us. Anchor: profile/step 0
+        # begins at mono 5.0 s -> aligned to the window start.
+        return [
+            {"name": "span/data/wait", "family": "data/wait",
+             "dur_s": 100e-6, "begin_mono": 4.9999, "end_mono": 5.0,
+             "ts": 0.0, "step": None, "tid": 7, "thread": "MainThread",
+             "depth": 0, "process": None},
+            {"name": "span/profile/step", "family": "profile/step",
+             "dur_s": 250e-6, "begin_mono": 5.0, "end_mono": 5.00025,
+             "ts": 0.0, "step": 0, "tid": 7, "thread": "MainThread",
+             "depth": 0, "process": None},
+        ]
+
+    def test_build_timeline_lanes_and_anchor(self):
+        from apex_tpu.pyprof import build_timeline
+        from apex_tpu.pyprof.parse import load_trace
+        tl = build_timeline(load_trace(DEVICE_TRACE), self._host_rows())
+        evs = tl["traceEvents"]
+        procs = {e["args"]["name"] for e in evs
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert procs == {"host", "device"}
+        host = [e for e in evs if e.get("ph") == "X" and e["pid"] == 1]
+        dev = [e for e in evs if e.get("ph") == "X" and e["pid"] == 2]
+        assert len(host) == 2 and len(dev) == 4
+        # the anchor: profile/step 0 lands exactly at the first kernel
+        anchor = next(e for e in host if e["name"] == "profile/step")
+        assert anchor["ts"] == pytest.approx(min(e["ts"] for e in dev))
+        # everything re-zeroed at the earliest event (the data/wait)
+        assert min(e["ts"] for e in evs if e.get("ph") == "X") == 0.0
+        # valid JSON end to end
+        assert json.loads(json.dumps(tl))["displayTimeUnit"] == "ms"
+
+    def test_timeline_from_logdir_with_spans_file(self, tmp_path):
+        import gzip
+        import shutil
+        from apex_tpu.pyprof import timeline_from_logdir
+        from apex_tpu.pyprof.capture import SIDECAR_NAME
+        ld = tmp_path / "logdir"
+        ld.mkdir()
+        shutil.copy(DEVICE_TRACE, ld / "fixture.trace.json")
+        with gzip.open(ld / SIDECAR_NAME, "wt") as f:
+            json.dump({"schema": 1, "module": "jit_step",
+                       "host_spans": self._host_rows()}, f)
+        # a spans JSONL adds spans from outside the capture window
+        run = tmp_path / "run.jsonl"
+        with open(run, "w") as f:
+            f.write(json.dumps(_mk_span(
+                "snapshot/save", 0.001, mono=5.001)) + "\n")
+        tl = timeline_from_logdir(str(ld), spans_path=str(run))
+        host_names = {e["name"] for e in tl["traceEvents"]
+                      if e.get("ph") == "X" and e["pid"] == 1}
+        assert host_names == {"data/wait", "profile/step",
+                              "snapshot/save"}
+
+    def test_timeline_without_spans_raises(self, tmp_path):
+        import gzip
+        import shutil
+        from apex_tpu.pyprof import timeline_from_logdir
+        from apex_tpu.pyprof.capture import SIDECAR_NAME
+        ld = tmp_path / "logdir"
+        ld.mkdir()
+        shutil.copy(DEVICE_TRACE, ld / "fixture.trace.json")
+        with gzip.open(ld / SIDECAR_NAME, "wt") as f:
+            json.dump({"schema": 1, "module": "jit_step"}, f)
+        with pytest.raises(ValueError, match="no host spans"):
+            timeline_from_logdir(str(ld))
+
+    def test_cli_timeline_flag(self, tmp_path, capsys):
+        import gzip
+        import shutil
+        from apex_tpu.pyprof import cli as pyprof_cli
+        from apex_tpu.pyprof.capture import SIDECAR_NAME
+        ld = tmp_path / "logdir"
+        ld.mkdir()
+        shutil.copy(DEVICE_TRACE, ld / "fixture.trace.json")
+        with gzip.open(ld / SIDECAR_NAME, "wt") as f:
+            json.dump({"schema": 1, "module": "jit_step",
+                       "host_spans": self._host_rows()}, f)
+        out = str(tmp_path / "out.trace.json")
+        assert pyprof_cli.main(["report", str(ld),
+                                "--timeline", out]) == 0
+        assert "timeline:" in capsys.readouterr().out
+        tl = json.load(open(out))
+        assert tl["traceEvents"]
