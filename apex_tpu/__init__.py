@@ -38,5 +38,6 @@ from apex_tpu import pyprof
 from apex_tpu import telemetry
 from apex_tpu import trace
 from apex_tpu import tune
+from apex_tpu import trainer
 from apex_tpu import resilience
 from apex_tpu import testing

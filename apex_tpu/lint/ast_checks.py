@@ -1,4 +1,4 @@
-"""AST pass: source-level trace hazards (rules APX001-APX005).
+"""AST pass: source-level trace hazards (rules APX001-APX005, APX007).
 
 The pass is deliberately heuristic-but-precise: every rule is scoped so
 that a firing is near-certainly a real hazard (Python control flow on a
@@ -231,6 +231,82 @@ def _check_jit_donation(tree: ast.Module, path: str,
                 "state double-buffer in HBM"))
 
 
+def _is_trainer_build(name: str) -> bool:
+    """``trainer.build`` / ``apex_tpu.trainer.build`` (any alias whose
+    dotted path routes through a ``trainer`` component)."""
+    parts = name.split(".")
+    return parts[-1] == "build" and "trainer" in parts[:-1]
+
+
+def _donate_false(call: ast.Call) -> bool:
+    """``donate=False`` on the call itself or on a literal
+    ``TrainerConfig(...)`` argument (a config built elsewhere and passed
+    by name is out of this heuristic's reach — by design)."""
+
+    def kw_false(c: ast.Call) -> bool:
+        return any(k.arg == "donate" and isinstance(k.value, ast.Constant)
+                   and k.value.value is False for k in c.keywords)
+
+    if kw_false(call):
+        return True
+    for sub in list(call.args) + [k.value for k in call.keywords
+                                  if k.value is not None]:
+        if isinstance(sub, ast.Call) and _call_tail(sub) == "TrainerConfig" \
+                and kw_false(sub):
+            return True
+    return False
+
+
+class _RejitChecker(ast.NodeVisitor):
+    """APX007: step re-compilation inside a loop body (``jax.jit`` /
+    ``pjit`` / ``trainer.build`` lexically under ``for``/``while`` — a
+    fresh trace+compile per iteration), and ``trainer.build`` call sites
+    that opt the carried state out of donation. Comprehensions are not
+    loops here (building a list of differently-configured jits is a
+    legitimate pattern); an intentional in-loop jit earns its
+    ``# apexlint: disable=APX007`` comment."""
+
+    def __init__(self, path: str, findings: List[Finding]):
+        self.path = path
+        self.findings = findings
+        self.loop_depth = 0
+
+    def visit_For(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_AsyncFor = visit_For
+    visit_While = visit_For
+
+    def visit_Call(self, node):
+        name = _dotted(node.func) or ""
+        tail = name.rsplit(".", 1)[-1]
+        # bare `build` counts too (`from apex_tpu.trainer import build`);
+        # a dotted foreign `.build()` (protobuf builders etc.) does not
+        is_build = _is_trainer_build(name) or name == "build"
+        if self.loop_depth and (tail in ("jit", "pjit") or is_build):
+            self.findings.append(Finding(
+                "APX007", self.path, node.lineno,
+                f"`{name}` inside a loop body — the step re-traces and "
+                "re-compiles every iteration (jit caches on function "
+                "identity; a fresh closure/build never hits it). Hoist "
+                "the jit/trainer.build out of the loop"))
+        if is_build and _donate_false(node):
+            self.findings.append(Finding(
+                "APX007", self.path, node.lineno,
+                "trainer.build with donate=False — the carried "
+                "params/optimizer state double-buffers in HBM every "
+                "step; donate the carry and let the construction-time "
+                "audit report anything XLA refuses"))
+        self.generic_visit(node)
+
+
+def _check_rejit_and_build(tree: ast.Module, path: str,
+                           findings: List[Finding]):
+    _RejitChecker(path, findings).visit(tree)
+
+
 def _check_dtype_literals(tree: ast.Module, path: str,
                           findings: List[Finding]):
     norm = path.replace("\\", "/")
@@ -291,6 +367,7 @@ def check_source(path: str, text: str) -> List[Finding]:
 
     _check_jit_donation(tree, path, findings)
     _check_dtype_literals(tree, path, findings)
+    _check_rejit_and_build(tree, path, findings)
     # a def nested in a traced fn AND independently marked traced is
     # visited twice; findings are value-equal, so dedup preserves order
     return list(dict.fromkeys(findings))

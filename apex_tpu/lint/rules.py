@@ -44,6 +44,11 @@ _RULES = [
     Rule("APX005", "hardcoded-dtype-literal", WARNING,
          "hardcoded low-precision dtype literal outside amp/ — compute "
          "dtypes should route through the amp.policy opt-level tables"),
+    # APX006 is unassigned (IDs are append-only, not contiguous)
+    Rule("APX007", "step-rejit-or-undonated-build", WARNING,
+         "step re-jit / trainer.build inside a loop (a fresh compile "
+         "per iteration), or a trainer.build call site that opts its "
+         "carried state out of donation (donate=False)"),
     # ---- jaxpr pass (lowered entry points) --------------------------------
     Rule("APX101", "policy-fp32-matmul", ERROR,
          "matmul runs with silently-fp32 operands in a bf16/fp16 "

@@ -73,6 +73,7 @@ def _record_resume(found: Restored) -> None:
 
 
 def resilient_loop(step_fn: Callable, state: Tree, data, *, steps: int,
+                   trainer: Optional[Any] = None,
                    snapshot_dir: Optional[str] = None,
                    manager: Optional[SnapshotManager] = None,
                    snapshot_every: int = 0,
@@ -90,6 +91,20 @@ def resilient_loop(step_fn: Callable, state: Tree, data, *, steps: int,
 
     Parameters beyond the module-doc basics:
 
+    trainer:
+        A compiled :class:`apex_tpu.trainer.Trainer`. When given,
+        ``step_fn`` may be ``None`` — steps dispatch through
+        ``trainer.step`` with its in-flight pipelining, and the
+        snapshot/preempt/resume contract holds UNCHANGED: the window is
+        drained (every in-flight dispatch retired) before every
+        snapshot, before the final save, and on preemption, so a saved
+        generation never races device work and resume stays bitwise
+        (pinned by the trainer variant of the SIGKILL test). ``on_step``
+        deliveries are deferred to retirement — the callback sees step
+        i's ready aux alongside the NEWEST dispatched state — and a
+        restore re-anchors the trainer's global step index
+        (``trainer.notify_resume``) so plugin step attribution survives
+        the resume.
     manager:
         Pre-built :class:`SnapshotManager` (wins over ``snapshot_dir`` +
         ``manager_kwargs`` such as ``keep_last``/``keep_every``/
@@ -118,6 +133,8 @@ def resilient_loop(step_fn: Callable, state: Tree, data, *, steps: int,
     """
     if resume not in ("auto", "none"):
         raise ValueError(f"resume must be 'auto' or 'none', got {resume!r}")
+    if trainer is None and step_fn is None:
+        raise ValueError("step_fn is required when no trainer is given")
     mgr = manager
     if mgr is None and snapshot_dir is not None:
         mgr = SnapshotManager(snapshot_dir, **manager_kwargs)
@@ -131,6 +148,30 @@ def resilient_loop(step_fn: Callable, state: Tree, data, *, steps: int,
     if injector is None:
         injector = FaultInjector.from_env()
 
+    steps_per_call = getattr(trainer, "steps_per_call", 1) \
+        if trainer is not None else 1
+    if steps_per_call > 1:
+        # a scan/unroll trainer advances k steps per dispatch: the loop
+        # only ever observes step values at dispatch boundaries. A
+        # cadence that is not k-aligned would silently fire at
+        # lcm(k, every) instead (losing up to that many steps of work
+        # on preemption), and a step-targeted fault between boundaries
+        # would never fire — both violations of the loud-failure
+        # doctrine, so refuse instead of misfiring.
+        if snapshot_every and snapshot_every % steps_per_call:
+            raise ValueError(
+                f"snapshot_every={snapshot_every} is not a multiple of "
+                f"the trainer's steps_per_call={steps_per_call}; the "
+                "loop only sees dispatch boundaries, so this cadence "
+                "would silently stretch to their least common multiple")
+        if injector is not None and getattr(injector, "step", None) \
+                is not None and injector.step % steps_per_call:
+            raise ValueError(
+                f"fault injector targets step {injector.step}, which a "
+                f"steps_per_call={steps_per_call} trainer never "
+                "observes (dispatch boundaries only) — the fault would "
+                "silently never fire")
+
     start = 0
     resumed_from = None
     if mgr is not None and resume == "auto":
@@ -139,8 +180,18 @@ def resilient_loop(step_fn: Callable, state: Tree, data, *, steps: int,
             state, start, resumed_from = found.state, found.step, \
                 found.generation
             _record_resume(found)
+            if trainer is not None:
+                trainer.notify_resume(found.step)
             if on_resume is not None:
                 on_resume(found)
+    if trainer is not None:
+        trainer.step_index = start
+        # deferred delivery: the user callback fires when step i's aux
+        # RETIRES from the in-flight window; the state alongside it is
+        # the newest dispatched one (an async value)
+        trainer.set_user_on_step(
+            None if on_step is None else
+            (lambda i, aux: on_step(i, trainer.last_state, aux)))
 
     if callable(data):
         batch_fn = data
@@ -186,17 +237,29 @@ def resilient_loop(step_fn: Callable, state: Tree, data, *, steps: int,
             if pre.requested():
                 break
             batch = batch_fn(step)
-            out = step_fn(state, batch, step)
-            state, aux = out if (isinstance(out, tuple) and len(out) == 2) \
-                else (out, None)
-            step += 1
+            if trainer is not None:
+                # pipelined dispatch: aux lands via the deferred on_step
+                # deliveries at retirement, not here
+                state, _ = trainer.step(state, batch, index=step)
+                step += trainer.steps_per_call
+            else:
+                out = step_fn(state, batch, step)
+                state, aux = out if (isinstance(out, tuple)
+                                     and len(out) == 2) else (out, None)
+                step += 1
             if snapshot_every and step % snapshot_every == 0:
+                if trainer is not None:
+                    trainer.drain()   # a snapshot never races in-flight work
                 save(step)
-            if on_step is not None:
+            if trainer is None and on_step is not None:
                 on_step(step - 1, state, aux)
         preempted = pre.requested()
         reason = pre.reason()
 
+    if trainer is not None:
+        # retire every in-flight dispatch (and flush its deliveries)
+        # before the final/preemption save and before returning state
+        trainer.drain()
     final_ok = True
     if preempted or final_snapshot:
         final_ok = save(step)

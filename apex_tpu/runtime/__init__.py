@@ -279,12 +279,25 @@ class PrefetchLoader:
     be re-produced). ``apex_tpu.resilience`` records it in the snapshot
     manifest; resume reconstructs the loader over a fresh source with
     ``skip=offset``.
+
+    Double-buffered host->device IO: ``device_put=`` stages each
+    produced batch onto device FROM THE WORKER THREAD — ``True`` for
+    the default device, a jax ``Device``/``Sharding`` (or pytree of
+    shardings) to target one, or a callable ``batch -> batch`` for
+    custom placement. ``jax.device_put`` is asynchronous, so the
+    transfer of batch N+1 overlaps device compute of step N and the
+    consumer receives device-resident arrays; the staging cost is
+    visible as ``stats()['put_s']`` (cumulative seconds) and a
+    ``span/data/put`` trace span per batch (a
+    :data:`apex_tpu.trace.CONCURRENT_FAMILIES` member — worker-thread
+    time, never billed to the step wall).
     """
 
     _SENTINEL = object()
 
     def __init__(self, source: Iterator, transform: Optional[Callable] = None,
-                 depth: int = 2, workers: int = 1, skip: int = 0):
+                 depth: int = 2, workers: int = 1, skip: int = 0,
+                 device_put: Any = None):
         # fast-forward BEFORE the workers exist — racing them for the
         # source would skip arbitrary interleaved items
         self._skip = 0
@@ -296,6 +309,19 @@ class PrefetchLoader:
                 break
         self._source = source
         self._transform = transform or (lambda x: x)
+        # device staging resolves to one callable; jax imports lazily so
+        # numpy-only consumers keep their import-free path
+        if device_put in (None, False):
+            self._put_fn = None
+        elif device_put is True:
+            import jax
+            self._put_fn = jax.device_put
+        elif callable(device_put):
+            self._put_fn = device_put
+        else:   # a Device / Sharding / pytree of shardings
+            import jax
+            self._put_fn = (lambda x, _tgt=device_put:
+                            jax.device_put(x, _tgt))
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._threads = []
         self._lock = threading.Lock()
@@ -314,6 +340,7 @@ class PrefetchLoader:
         self._consumed = 0
         self._starvations = 0
         self._wait_s = 0.0
+        self._put_s = 0.0
         for _ in range(max(1, workers)):
             t = threading.Thread(target=self._worker, daemon=True)
             t.start()
@@ -362,6 +389,17 @@ class PrefetchLoader:
                         return
                 out = self._transform(item)
                 _trace.emit_span("data/produce", t0, _time.perf_counter())
+                if self._put_fn is not None:
+                    # async H2D staging: device_put returns immediately
+                    # with a committed device array, so the transfer of
+                    # this batch overlaps the step the consumer is
+                    # already running; put_s bills the CALL cost only
+                    t1 = _time.perf_counter()
+                    out = self._put_fn(out)
+                    t2 = _time.perf_counter()
+                    with self._stats_lock:
+                        self._put_s += t2 - t1
+                    _trace.emit_span("data/put", t1, t2)
                 self._put(out)
         except BaseException as e:
             with self._lock:
@@ -431,13 +469,17 @@ class PrefetchLoader:
         the ``span/data/wait`` trace spans record per occurrence).
         ``starvations``/``consumed`` near 1.0 means the pipeline, not the
         device, is the bottleneck: raise ``workers`` or ``depth``, or
-        cheapen ``transform``."""
+        cheapen ``transform``. ``put_s`` is the cumulative worker-thread
+        ``device_put`` staging cost when ``device_put=`` is on (0.0
+        otherwise) — host call time for the async transfer, the overlap
+        the ``span/data/put`` spans make visible on the timeline."""
         with self._stats_lock:
             return {
                 "produced": self._produced,
                 "consumed": self._consumed,
                 "starvations": self._starvations,
                 "wait_s": self._wait_s,
+                "put_s": self._put_s,
                 "queue_depth": self._q.qsize(),
                 "depth": self.depth,
                 "skip": self._skip,

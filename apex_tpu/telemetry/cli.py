@@ -137,6 +137,23 @@ def _load_tail(path: str, n: int) -> List[dict]:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    try:
+        return _run(argv)
+    except BrokenPipeError:
+        # piped into `head -5` / `grep -q`: the reader closing early is
+        # normal CLI usage, not a failure — summaries grow with new
+        # event families, so "output fit the pipe buffer" must never be
+        # a correctness condition. Point stdout at devnull so Python's
+        # interpreter-shutdown flush doesn't raise a second time.
+        import os
+        try:
+            os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        except OSError:
+            pass
+        return 0
+
+
+def _run(argv: Optional[List[str]] = None) -> int:
     args = _build_parser().parse_args(argv)
     if args.cmd == "merge":
         return _run_merge(args)

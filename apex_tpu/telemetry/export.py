@@ -568,7 +568,8 @@ def _reconciliation(out: Dict[str, Any], rows: List[Dict[str, Any]],
     if devwait is not None and devwait > busy:
         components["blocked_on_device"] = devwait - busy
     for fam, durs in fams.items():
-        if fam in ("step/dispatch", "step/device_wait", "profile/step") \
+        if fam in ("step/dispatch", "profile/step") \
+                or fam in _trace.DEVICE_WAIT_FAMILIES \
                 or fam in _trace.CONCURRENT_FAMILIES:
             continue
         components[fam] = sum(durs) / (steps * n_procs)

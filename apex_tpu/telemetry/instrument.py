@@ -138,6 +138,19 @@ class instrument_step:
             record_static(f"{name}/model_flops", model_flops,
                           dedup_key=(name,))
 
+    def set_model_flops(self, model_flops: Optional[float]) -> None:
+        """Late-bound FLOPs per call, for callers that compute cost
+        analysis only after the wrapper exists (the trainer builds the
+        instrumented dispatch before the warmup that prices it). Marks
+        measurement done either way; records the static like the
+        constructor path (same dedup key, so re-setting cannot
+        double-count)."""
+        self._flops = model_flops
+        self._flops_done = True
+        if model_flops:
+            record_static(f"{self.name}/model_flops", model_flops,
+                          dedup_key=(self.name,))
+
     def advance_to(self, step: int) -> None:
         """Resume attribution: make the NEXT call emit with step index
         ``step``. A resiliently auto-resumed run restores mid-stream;

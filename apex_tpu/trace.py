@@ -48,7 +48,7 @@ from apex_tpu.telemetry import events as _ev
 
 __all__ = ["span", "emit_span", "enable", "disable", "enabled",
            "family_of", "span_rows", "family_totals", "PREFIX",
-           "CONCURRENT_FAMILIES"]
+           "CONCURRENT_FAMILIES", "DEVICE_WAIT_FAMILIES"]
 
 PREFIX = "span/"
 
@@ -58,8 +58,17 @@ PREFIX = "span/"
 # of the per-step wall, so neither summarize's reconciliation nor
 # bench's wall_gap may bill them (one definition, both consumers).
 CONCURRENT_FAMILIES = frozenset((
-    "data/produce", "callback/record", "snapshot/serialize",
+    "data/produce", "data/put", "callback/record", "snapshot/serialize",
     "snapshot/publish"))
+
+# Span families that are the host BLOCKED ON THE DEVICE — device time
+# wearing a host span, not host overhead: instrument_step's per-call
+# block_until_ready, and the trainer's in-flight window retiring a
+# pipelined dispatch. The reconciliation and bench's wall_gap must not
+# bill them as host components (step/device_wait doubles as the busy
+# proxy instead).
+DEVICE_WAIT_FAMILIES = frozenset((
+    "step/device_wait", "trainer/retire"))
 
 _enabled = False
 _ids = itertools.count(1)        # CPython: count.__next__ is atomic

@@ -1,0 +1,49 @@
+"""apex_tpu.trainer — the compiled-step builder (ROADMAP item 5).
+
+One step definition, every loop variant: ``build()`` compiles a
+``(state, batch) -> (new_state, aux)`` step function with
+
+  * **donation** owned and AUDITED at construction (every carried leaf
+    declared donated; whatever XLA refuses is reported loudly —
+    :class:`DonationReport`),
+  * **dispatch pipelining** via a bounded in-flight window (host
+    dispatch of step N+1 overlaps device execution of step N; aux
+    consumption is deferred to retirement so observing a loss never
+    serializes the pipeline),
+  * **scan / unroll / per-step dispatch modes** off one
+    :class:`TrainerConfig`, jaxpr/bitwise parity pinned by
+    tests/test_trainer.py,
+  * **double-buffered host IO** through ``runtime.PrefetchLoader``'s
+    async ``device_put`` staging (``Trainer.run`` / ``resilient_loop``
+    consume it directly),
+  * a **plugin seam** (:mod:`apex_tpu.trainer.plugins`) that amp,
+    telemetry, health, tune, resilience, and trace attach to exactly
+    once instead of being hand-wired into each loop.
+
+Minimal use::
+
+    from apex_tpu import trainer
+
+    tr = trainer.build(step, state, batch, mesh=mesh,
+                       batch_spec=P("data"),
+                       config=trainer.TrainerConfig(in_flight=2),
+                       plugins=[trainer.TelemetryPlugin()])
+    state = tr.run(state, loader, steps=1000)
+
+Design reference: veScale's eager-SPMD single-device-semantics model
+(arXiv 2509.07003). See docs/trainer.md.
+"""
+
+from apex_tpu.trainer.builder import (DonationReport, Trainer,
+                                      TrainerConfig, build, stack_batches)
+from apex_tpu.trainer.pipeline import InflightWindow
+from apex_tpu.trainer.plugins import (AmpPlugin, HealthPlugin,
+                                      ResumePrintPlugin, TelemetryPlugin,
+                                      TunePlugin)
+
+__all__ = [
+    "build", "Trainer", "TrainerConfig", "DonationReport",
+    "InflightWindow", "stack_batches",
+    "TelemetryPlugin", "AmpPlugin", "TunePlugin", "HealthPlugin",
+    "ResumePrintPlugin",
+]

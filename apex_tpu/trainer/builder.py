@@ -1,0 +1,449 @@
+"""The compiled-step builder: one place that owns donation, dispatch
+mode (per-step / scanned / unrolled), shard_map wrapping, the
+construction-time donation audit, and the dispatch-pipelined host loop.
+
+Design reference: veScale's eager-SPMD consistency model (arXiv
+2509.07003) — ONE step definition, semantically identical across every
+loop variant. The step function is written once as
+
+    def step(state, batch):          # both pytrees
+        ...
+        return new_state, aux        # new_state: same structure as state
+
+and :func:`build` compiles it per the :class:`TrainerConfig`:
+
+  * ``mode="per_step"`` — one dispatch per step (the default loop).
+  * ``mode="scan"`` — ``steps_per_call`` steps per dispatch via
+    ``lax.scan`` (the dispatch-proof bench/--scan form).
+  * ``mode="unroll"`` — the same k steps unrolled in the traced body
+    (larger programs, no loop-carried scan structure; lets XLA software-
+    pipeline across step boundaries).
+
+``batch_mode`` selects how scan/unroll consume batches: ``"stacked"``
+(the dispatch receives a ``[k, ...]``-stacked batch pytree; each step
+gets its slice) or ``"shared"`` (one batch reused every step — the
+bench's synthetic-data form).
+
+Parity contract, pinned by tests/test_trainer.py: the traced function
+``Trainer.traced_fn`` in per_step mode is jaxpr-identical to the
+hand-built ``shard_map(step)`` it replaces, and all three modes produce
+bit-identical states when fed the same per-step batches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import warnings
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.trainer.pipeline import InflightWindow
+
+Tree = Any
+
+_MODES = ("per_step", "scan", "unroll")
+_BATCH_MODES = ("stacked", "shared")
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainerConfig:
+    """Everything the builder needs beyond the step function itself.
+
+    mode / steps_per_call / batch_mode:
+        Dispatch granularity (see module doc). ``steps_per_call`` is
+        ignored (forced 1) in per_step mode.
+    in_flight:
+        Bounded dispatch-pipelining window depth. ``1`` = synchronous
+        per-dispatch retirement (the pre-trainer behavior); ``2``
+        (default) keeps the host one dispatched step ahead of the
+        retirement point. Results are bit-identical at every depth —
+        the window only moves WHERE the host blocks.
+    donate:
+        Donate the carried state (argnum 0) to XLA so weights/optimizer
+        moments update in place instead of double-buffering in HBM.
+    audit_donation:
+        AOT-compile at build time and verify the donation actually
+        landed: every carried leaf declared, every refusal reported
+        loudly (see :class:`DonationReport`). COST: the audit's AOT
+        compile does not populate jax's dispatch cache, so the first
+        real dispatch compiles the program a second time — one extra
+        full compile per build (``DonationReport.compile_s`` records
+        it). For very large programs either set ``audit_donation=False``
+        or audit a smaller representative program built from the same
+        step, as bench.py audits its single-step program rather than
+        the 25-step scan.
+    """
+
+    mode: str = "per_step"
+    steps_per_call: int = 1
+    batch_mode: str = "stacked"
+    in_flight: int = 2
+    donate: bool = True
+    audit_donation: bool = True
+
+    def __post_init__(self):
+        if self.mode not in _MODES:
+            raise ValueError(f"mode must be one of {_MODES}, "
+                             f"got {self.mode!r}")
+        if self.batch_mode not in _BATCH_MODES:
+            raise ValueError(f"batch_mode must be one of {_BATCH_MODES}, "
+                             f"got {self.batch_mode!r}")
+        if self.mode != "per_step" and self.steps_per_call < 1:
+            raise ValueError("steps_per_call must be >= 1")
+        if self.in_flight < 1:
+            raise ValueError("in_flight must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
+class DonationReport:
+    """Construction-time donation audit result.
+
+    declared:
+        Carried-state leaves declared donated (donate_argnums=(0,)).
+    aliased:
+        Input->output aliases XLA actually established (parsed from the
+        compiled module's ``input_output_alias`` header).
+    refused:
+        Buffers XLA declined to alias, verbatim from its compile-time
+        warning (shape/dtype mismatches between a carried input and its
+        output slot — each one is a real double-buffer). Empty on a
+        healthy build.
+    dropped:
+        Declared-donated leaves that vanished from the compiled program
+        entirely (dead-code-eliminated carries: declared - aliased -
+        refused). Harmless — nothing to double-buffer.
+    compile_s:
+        Wall seconds the audit's AOT compile took — also the extra
+        compile the build added on top of the first dispatch's own
+        (see :class:`TrainerConfig`'s ``audit_donation`` cost note).
+    """
+
+    declared: int
+    aliased: Optional[int]
+    refused: Tuple[str, ...]
+    dropped: Optional[int]
+    backend: str
+    compile_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not self.refused
+
+    def summary(self) -> str:
+        alias = "?" if self.aliased is None else str(self.aliased)
+        s = (f"donation audit: {self.declared} carried leaves declared, "
+             f"{alias} aliased, {len(self.refused)} refused"
+             + (f", {self.dropped} dead-code-dropped"
+                if self.dropped else "")
+             + f" [{self.backend}]")
+        if self.refused:
+            s += "\n  XLA refused: " + ", ".join(self.refused)
+        return s
+
+    def to_json(self) -> dict:
+        return {"declared": self.declared, "aliased": self.aliased,
+                "refused": list(self.refused), "dropped": self.dropped,
+                "compile_s": self.compile_s, "ok": self.ok}
+
+
+def _count_aliases(compiled) -> Optional[int]:
+    """Aliases in the compiled module's ``input_output_alias`` header.
+    Entries look like ``{out_idx}: (param, {tuple_path}, may-alias)``
+    inside a brace-nested map, so they are counted by their unique
+    ``{..}: (`` shape rather than by delimiting the map (nested ``{}``
+    defeat a non-greedy match)."""
+    try:
+        head = compiled.as_text().split("\n", 1)[0]
+    except Exception:
+        return None
+    if "HloModule" not in head:
+        return None
+    if "input_output_alias=" not in head:
+        return 0
+    return len(re.findall(r"\{[\d,\s]*\}:\s*\(", head))
+
+
+def _audit_donation(jitted, state: Tree, batch: Tree) -> DonationReport:
+    import time
+    declared = len(jax.tree_util.tree_leaves(state))
+    t0 = time.perf_counter()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        compiled = jitted.lower(state, batch).compile()
+    compile_s = time.perf_counter() - t0
+    refused = []
+    for w in caught:
+        msg = str(w.message)
+        if "donated" in msg.lower():
+            shapes = re.findall(r"ShapedArray\([^)]*\)", msg)
+            refused.extend(shapes or [msg.splitlines()[0]])
+    aliased = _count_aliases(compiled)
+    dropped = None
+    if aliased is not None:
+        dropped = max(declared - aliased - len(refused), 0)
+    report = DonationReport(
+        declared=declared, aliased=aliased, refused=tuple(refused),
+        dropped=dropped, backend=jax.devices()[0].platform,
+        compile_s=round(compile_s, 3))
+    if not report.ok:
+        # the LOUD half of the contract: a refused donation is a real
+        # double-buffer of carried state — surface it at build, where
+        # the shapes still mean something to the caller
+        warnings.warn("apex_tpu.trainer " + report.summary(), stacklevel=3)
+    from apex_tpu import telemetry
+    if telemetry.enabled():
+        telemetry.record_static("trainer/donation_refused",
+                                float(len(report.refused)),
+                                meta=report.to_json(),
+                                dedup_key=("trainer",))
+    return report
+
+
+def stack_batches(batches: Sequence[Tree]) -> Tree:
+    """Stack k per-step batch pytrees into the ``[k, ...]`` dispatch form
+    scan/unroll ``batch_mode="stacked"`` consumes."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *batches)
+
+
+def _make_traced(step_fn: Callable, config: TrainerConfig) -> Callable:
+    """The mode wrapper: per_step passes ``step_fn`` through UNTOUCHED
+    (the jaxpr-parity anchor); scan/unroll wrap it in the k-step body.
+    scan/unroll return the LAST step's aux (the hand-built bench scan's
+    ``losses[-1]`` convention)."""
+    if config.mode == "per_step":
+        return step_fn
+    k = config.steps_per_call
+    shared = config.batch_mode == "shared"
+
+    def check_stack(batch):
+        # trace-time (shapes are static): a stacked batch whose leading
+        # dim disagrees with steps_per_call would execute a different
+        # number of train steps than the trainer's step accounting
+        # advances — snapshot step numbers and resume batch streams
+        # would silently diverge, so refuse loudly instead
+        for leaf in jax.tree_util.tree_leaves(batch):
+            if leaf.shape[0] != k:
+                raise ValueError(
+                    f"stacked batch leaf has leading dim "
+                    f"{leaf.shape[0]} but steps_per_call={k}; the "
+                    "dispatch would run a different number of steps "
+                    "than the trainer accounts for (stack_batches with "
+                    "exactly steps_per_call batches)")
+
+    if config.mode == "scan":
+        def traced(state, batch):
+            if not shared:
+                check_stack(batch)
+
+            def body(carry, x):
+                carry, aux = step_fn(carry, batch if shared else x)
+                return carry, aux
+            state, auxs = jax.lax.scan(
+                body, state, None if shared else batch,
+                length=k if shared else None)
+            return state, jax.tree_util.tree_map(lambda a: a[-1], auxs)
+        return traced
+
+    def traced(state, batch):
+        if not shared:
+            check_stack(batch)
+        aux = None
+        for i in range(k):
+            b = batch if shared else jax.tree_util.tree_map(
+                lambda a, _i=i: a[_i], batch)
+            state, aux = step_fn(state, b)
+        return state, aux
+    return traced
+
+
+class Trainer:
+    """The compiled trainer: dispatch callable + in-flight window +
+    plugin seam. Built by :func:`build`; not constructed directly.
+
+    Attributes
+    ----------
+    fn:
+        The raw jitted dispatch callable ``(state, batch) -> (state,
+        aux)`` — hand it to ``pyprof.capture`` / ``xla_flops`` /
+        ``record_comm_stats`` (those want the *lowerable* jit product,
+        not the instrumented wrapper).
+    traced_fn:
+        The pre-jit traced function (after mode/shard_map wrapping) —
+        the jaxpr-parity handle.
+    donation:
+        The :class:`DonationReport`, or None when the audit was off.
+    steps_per_call:
+        Global-step increment per :meth:`step` call (k in scan/unroll).
+    last_state:
+        The most recently dispatched state (an async value; reading it
+        synchronizes to the newest dispatch).
+    """
+
+    def __init__(self, *, fn: Callable, traced_fn: Callable,
+                 config: TrainerConfig,
+                 donation: Optional[DonationReport],
+                 plugins: Sequence[Any] = (), name: str = "trainer"):
+        self.fn = fn
+        self.traced_fn = traced_fn
+        self.config = config
+        self.donation = donation
+        self.name = name
+        self.steps_per_call = (1 if config.mode == "per_step"
+                               else config.steps_per_call)
+        self.plugins = list(plugins)
+        self.step_index = 0          # next global step to dispatch
+        self.last_state: Tree = None
+        self._call = fn              # plugins may wrap (instrument_step)
+        self._window = InflightWindow(config.in_flight)
+        self._on_step: list = []     # plugin deliveries, ready aux only
+        self._user_on_step: Optional[Callable] = None
+        for p in self.plugins:
+            hook = getattr(p, "on_build", None)
+            if hook is not None:
+                hook(self)
+
+    @property
+    def call_fn(self) -> Callable:
+        """The dispatch callable exactly as :meth:`step` invokes it —
+        ``fn`` plus whatever the plugins wrapped around it (e.g.
+        ``instrument_step``). For callers that need to drive dispatches
+        OUTSIDE the in-flight window (an A/B baseline loop) without
+        losing the attached instrumentation."""
+        return self._call
+
+    # -- the plugin seam ---------------------------------------------------
+    def wrap_call(self, wrapper: Callable) -> None:
+        """Plugin hook (``on_build`` time): wrap the dispatch callable
+        (e.g. ``telemetry.instrument_step``). Wrappers compose; ``fn``
+        stays the raw jit product."""
+        self._call = wrapper(self._call)
+
+    def add_on_step(self, cb: Callable) -> None:
+        """Plugin hook: ``cb(step_index, aux)`` on every RETIRED step —
+        aux is ready, so the callback can read it without stalling the
+        dispatches in flight ahead of it."""
+        self._on_step.append(cb)
+
+    def set_user_on_step(self, cb: Optional[Callable]) -> None:
+        """The single user callback slot (resilient_loop / run own it);
+        delivered after the plugin callbacks, same retirement rule."""
+        self._user_on_step = cb
+
+    def notify_resume(self, step: int) -> None:
+        """Re-anchor the global step index after a snapshot restore and
+        fan out to every plugin's ``on_resume`` (telemetry re-attributes
+        its ``step/*`` series; see docs/trainer.md)."""
+        self.step_index = int(step)
+        for p in self.plugins:
+            hook = getattr(p, "on_resume", None)
+            if hook is not None:
+                hook(self, int(step))
+
+    # -- dispatch ----------------------------------------------------------
+    def step(self, state: Tree, batch: Tree,
+             index: Optional[int] = None) -> Tuple[Tree, Tree]:
+        """Dispatch one call (``steps_per_call`` train steps). Returns
+        ``(new_state, aux)`` — both asynchronous; consume aux via the
+        on_step callbacks (delivered ready, in order) unless you mean to
+        sync. Retires older dispatches per the in-flight window."""
+        idx = self.step_index if index is None else int(index)
+        new_state, aux = self._call(state, batch)
+        self.last_state = new_state
+        self.step_index = idx + self.steps_per_call
+        for i, a in self._window.push(idx, aux):
+            self._deliver(i, a)
+        return new_state, aux
+
+    def _deliver(self, index: int, aux: Tree) -> None:
+        for cb in self._on_step:
+            cb(index, aux)
+        if self._user_on_step is not None:
+            self._user_on_step(index, aux)
+
+    def drain(self) -> None:
+        """Retire every in-flight dispatch and deliver its callbacks —
+        call before snapshots, timing reads, and at loop end."""
+        for i, a in self._window.drain():
+            self._deliver(i, a)
+
+    def pipeline_stats(self) -> dict:
+        """In-flight window counters (depth, pending, retired, blocked
+        seconds) — ``wait_s`` near zero means the device was never the
+        bottleneck."""
+        return self._window.stats()
+
+    # -- convenience loop --------------------------------------------------
+    def run(self, state: Tree, data, steps: int,
+            on_step: Optional[Callable] = None) -> Tree:
+        """Minimal pipelined loop: ``data`` is ``step -> batch`` or an
+        iterable (e.g. ``runtime.PrefetchLoader``); drives ``steps``
+        dispatch calls and drains. For snapshots/preemption use
+        ``resilience.resilient_loop(trainer=...)`` instead."""
+        if on_step is not None:
+            self.set_user_on_step(on_step)
+        if callable(data):
+            batch_fn = data
+        else:
+            it = iter(data)
+            batch_fn = lambda _step: next(it)   # noqa: E731
+        done = 0
+        while done < steps:
+            state, _ = self.step(state, batch_fn(self.step_index))
+            done += self.steps_per_call
+        self.drain()
+        return state
+
+
+def build(step_fn: Callable, state: Tree, batch: Tree, *,
+          mesh=None, state_spec=None, batch_spec=None, aux_spec=None,
+          config: Optional[TrainerConfig] = None,
+          plugins: Sequence[Any] = (), name: str = "trainer",
+          check_vma: bool = False) -> Trainer:
+    """Compile ``step_fn`` into a :class:`Trainer`.
+
+    Parameters
+    ----------
+    step_fn:
+        ``(state, batch) -> (new_state, aux)`` — per-device semantics
+        when ``mesh`` is given (the builder applies ``shard_map``), plain
+        otherwise.
+    state, batch:
+        Example pytrees matching the DISPATCH signature (stacked batch in
+        stacked scan/unroll modes). ``jax.ShapeDtypeStruct`` avals work —
+        nothing is executed at build; they drive the donation audit's AOT
+        compile and nothing else when the audit is off.
+    mesh / state_spec / batch_spec / aux_spec:
+        ``shard_map`` wiring; specs default to replicated (``P()``).
+        ``state_spec`` doubles as the carried-state out_spec.
+    plugins:
+        Objects with any of ``on_build(trainer)`` / ``on_step(step,
+        aux)`` (registered automatically) / ``on_resume(trainer, step)``
+        — see :mod:`apex_tpu.trainer.plugins`.
+    """
+    config = config or TrainerConfig()
+    traced = _make_traced(step_fn, config)
+    if mesh is not None:
+        import apex_tpu._compat  # noqa: F401  (jax.shard_map shim)
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        state_spec = P() if state_spec is None else state_spec
+        batch_spec = P() if batch_spec is None else batch_spec
+        aux_spec = P() if aux_spec is None else aux_spec
+        traced = shard_map(
+            traced, mesh=mesh, in_specs=(state_spec, batch_spec),
+            out_specs=(state_spec, aux_spec), check_vma=check_vma)
+    donate = (0,) if config.donate else ()
+    fn = jax.jit(traced, donate_argnums=donate)
+    report = None
+    if config.donate and config.audit_donation:
+        report = _audit_donation(fn, state, batch)
+    trainer = Trainer(fn=fn, traced_fn=traced, config=config,
+                      donation=report, plugins=plugins, name=name)
+    for p in trainer.plugins:
+        hook = getattr(p, "on_step", None)
+        if hook is not None:
+            trainer.add_on_step(hook)
+    return trainer

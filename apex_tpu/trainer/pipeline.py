@@ -1,0 +1,88 @@
+"""Dispatch pipelining for the compiled trainer: a bounded in-flight
+window over asynchronously dispatched steps.
+
+JAX dispatch is already asynchronous — calling a jitted step returns
+arrays that are futures on device work. What the hand-written loops did
+wrong (bench.py's per-dispatch ``float(loss)``, train_lm's per-step
+loss fetch) was SYNC every dispatch, serializing host dispatch of step
+N+1 behind device execution of step N: the measured device-vs-wall gap
+(BENCH_r05: 2598.9 dev vs 2490.1 wall img/s) is exactly that
+serialization. The window here is the discipline that replaces it:
+
+  * ``push(item)`` after every dispatch; the window retires (blocks on)
+    the OLDEST entry only once more than ``depth - 1`` dispatches are
+    pending, so with ``depth=2`` the host is always one dispatched step
+    ahead of the retirement point while the device works.
+  * ``depth=1`` degrades to the old synchronous per-dispatch behavior —
+    the A/B knob (and the bitwise-equivalence anchor: the window changes
+    WHEN the host blocks, never what the device computes or in which
+    order, so results are bit-identical at every depth).
+  * retirement is where deferred consumers run: per-step callbacks see
+    each step's aux only once it is ready, so observing a loss never
+    stalls the dispatch ahead of it.
+
+Each retirement emits a ``trainer/retire`` trace span (the host blocked
+on the device inside the pipelined loop — the pipelining-era analog of
+``step/device_wait``; the wall reconciliation treats both as device
+time, never host overhead).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Deque, List, Tuple
+
+import jax
+
+
+class InflightWindow:
+    """Bounded queue of dispatched-but-unretired step results.
+
+    Items are ``(index, payload)``; ``payload`` is any pytree of (possibly
+    still-executing) arrays. Not thread-safe — it lives inside one
+    trainer's host loop.
+    """
+
+    def __init__(self, depth: int):
+        self.depth = max(1, int(depth))
+        self._q: Deque[Tuple[int, Any]] = collections.deque()
+        # retirement accounting: how often and for how long the host
+        # actually blocked — ``wait_s`` near zero means the device was
+        # always ahead (input- or host-bound); large means device-bound,
+        # i.e. the pipeline is doing its job
+        self.retired = 0
+        self.wait_s = 0.0
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def push(self, index: int, payload: Any) -> List[Tuple[int, Any]]:
+        """Add one dispatched step; retire down to ``depth - 1`` pending
+        (the just-pushed dispatch counts as in flight). Returns the
+        retired ``(index, payload)`` items, oldest first, each fully
+        ready."""
+        self._q.append((index, payload))
+        return self._retire_to(self.depth - 1)
+
+    def drain(self) -> List[Tuple[int, Any]]:
+        """Retire everything (loop end, snapshot points, preemption)."""
+        return self._retire_to(0)
+
+    def _retire_to(self, limit: int) -> List[Tuple[int, Any]]:
+        out: List[Tuple[int, Any]] = []
+        while len(self._q) > limit:
+            index, payload = self._q.popleft()
+            t0 = time.perf_counter()
+            jax.block_until_ready(payload)
+            t1 = time.perf_counter()
+            self.retired += 1
+            self.wait_s += t1 - t0
+            from apex_tpu import trace as _trace
+            _trace.emit_span("trainer/retire", t0, t1, step=index)
+            out.append((index, payload))
+        return out
+
+    def stats(self) -> dict:
+        return {"depth": self.depth, "pending": len(self._q),
+                "retired": self.retired, "wait_s": self.wait_s}

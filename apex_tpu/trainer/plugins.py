@@ -1,0 +1,208 @@
+"""The plugin seam: how amp, telemetry, health, tune, resilience, and
+trace attach to a compiled trainer EXACTLY ONCE.
+
+Before the trainer, every observability/resilience feature was
+hand-wired into three separately-maintained loops (train_lm, bench,
+resilient_loop) — six subsystems x three loops of drift surface. A
+plugin is any object exposing a subset of three hooks:
+
+  * ``on_build(trainer)`` — once, after compile + donation audit; wrap
+    the dispatch callable (``trainer.wrap_call``) or record build-time
+    facts.
+  * ``on_step(step_index, aux)`` — per RETIRED step, aux ready (the
+    in-flight window defers delivery, so observing never stalls the
+    pipeline ahead of it).
+  * ``on_resume(trainer, step)`` — after a snapshot restore re-anchors
+    the global step index (``resilient_loop`` calls
+    ``trainer.notify_resume``).
+
+Trace needs no plugin: the trainer core emits its ``trainer/retire``
+spans whenever ``apex_tpu.trace`` is enabled, and
+:class:`TelemetryPlugin`'s ``instrument_step`` wrapper emits the
+``span/step/*`` pairs on its synced calls.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, Optional
+
+
+class TelemetryPlugin:
+    """Attach :func:`apex_tpu.telemetry.instrument_step` to the dispatch.
+
+    ``sync_every=None`` (default) resolves to the trainer's ``in_flight``
+    depth: the instrumented sync then lands at the window's natural
+    retirement cadence instead of serializing every dispatch — the
+    composition rule docs/telemetry.md describes. Pass ``sync_every=1``
+    to time every dispatch (the pre-trainer behavior; kills pipelining).
+
+    Handles ``on_resume`` by re-anchoring the wrapper's step counter
+    (``instrument_step.advance_to``) so a resumed run's ``step/*``
+    series keeps global step attribution.
+    """
+
+    def __init__(self, *, name: str = "step",
+                 tokens_per_step: Optional[float] = None,
+                 examples_per_step: Optional[float] = None,
+                 measure_flops: bool = True,
+                 model_flops: Optional[float] = None,
+                 sync_every: Optional[int] = None):
+        self.name = name
+        self.tokens_per_step = tokens_per_step
+        self.examples_per_step = examples_per_step
+        self.measure_flops = measure_flops
+        self.model_flops = model_flops
+        self.sync_every = sync_every
+        self.instrument = None
+
+    def on_build(self, trainer) -> None:
+        from apex_tpu import telemetry
+        sync_every = self.sync_every
+        if sync_every is None:
+            sync_every = trainer.config.in_flight
+
+        def wrap(fn):
+            self.instrument = telemetry.instrument_step(
+                fn, name=self.name,
+                tokens_per_step=self.tokens_per_step,
+                examples_per_step=self.examples_per_step,
+                measure_flops=self.measure_flops,
+                model_flops=self.model_flops,
+                sync_every=sync_every)
+            return self.instrument
+
+        trainer.wrap_call(wrap)
+        telemetry.record_static(
+            "trainer/in_flight", float(trainer.config.in_flight),
+            meta={"mode": trainer.config.mode,
+                  "steps_per_call": trainer.steps_per_call,
+                  "sync_every": sync_every},
+            dedup_key=("trainer", trainer.name))
+
+    def on_resume(self, trainer, step: int) -> None:
+        if self.instrument is not None:
+            self.instrument.advance_to(step)
+
+
+class AmpPlugin:
+    """Record the amp opt level + loss-scaling mode against the run
+    (build-time statics joining the ``amp/*`` series the scaler emits
+    in-step). The numerics themselves live in the step function — amp's
+    ``scale_loss``/``AmpOptimizer.step`` are traced by the user's step —
+    so the plugin's job is attribution, not interposition."""
+
+    def __init__(self, opt_level: str):
+        self.opt_level = opt_level
+
+    def on_build(self, trainer) -> None:
+        from apex_tpu import amp, telemetry
+        props = amp.resolve(self.opt_level)
+        telemetry.record_static(
+            "trainer/amp_opt_level", float(self.opt_level.lstrip("O") or 0),
+            meta={"opt_level": self.opt_level,
+                  "cast_model_type": str(props.cast_model_type),
+                  "master_weights": bool(props.master_weights),
+                  "loss_scale": str(props.loss_scale)},
+            dedup_key=("trainer", trainer.name))
+
+
+class TunePlugin:
+    """Record the live autotune policy at build — every trainer-built
+    run is attributable to the config source its kernels resolved
+    through (the bench's resolved-config header, generalized)."""
+
+    def on_build(self, trainer) -> None:
+        from apex_tpu import telemetry, tune
+        telemetry.record_static(
+            "trainer/tune_policy", 1.0,
+            meta={"policy": tune.policy()},
+            dedup_key=("trainer", trainer.name))
+
+
+class HealthPlugin:
+    """Live divergence detection over retired steps.
+
+    Wires a :class:`apex_tpu.telemetry.DivergenceDetector` to the
+    trainer's deferred on_step deliveries: loss from aux (via
+    ``loss_from_aux``), grad-norm / NaN-count from the collector's
+    freshest in-graph ``health/*`` emissions, the overflow edge from the
+    scaler counter read off ``trainer.last_state`` (via
+    ``overflow_total``). Alerts print to stderr and accumulate on
+    ``detector.alerts``.
+
+    Per-step signal pairing needs ``in_flight=1``: under a pipelined
+    window, step i's delivery runs after step i+1 dispatched, so the
+    collector's FRESHEST grad-norm/NaN emissions (and the overflow
+    counter on ``trainer.last_state``) describe a later step than the
+    loss in hand — an Inf norm from step i+1 against step i's clean
+    loss would read as corruption. The plugin therefore consumes those
+    per-step signals only when the trainer's window depth is 1 and runs
+    LOSS-ONLY rules (non-finite loss, z-score spikes — exact at any
+    depth) otherwise, warning once about the dropped signals.
+    """
+
+    def __init__(self, detector=None,
+                 loss_from_aux: Optional[Callable] = None,
+                 overflow_total: Optional[Callable] = None,
+                 out=sys.stderr):
+        from apex_tpu import telemetry
+        self.detector = detector or telemetry.DivergenceDetector()
+        self.loss_from_aux = loss_from_aux or (lambda aux: aux)
+        self.overflow_total = overflow_total
+        self._prev_overflows = 0.0
+        self._out = out
+        self._synced = True          # resolved against the window depth
+        self._warned_skew = False
+
+    def on_build(self, trainer) -> None:
+        self._synced = trainer.config.in_flight == 1
+        if not self._synced and (self.overflow_total is not None):
+            self._warn_skew()
+
+    def _warn_skew(self) -> None:
+        if not self._warned_skew:
+            self._warned_skew = True
+            print("HealthPlugin: in_flight > 1 — per-step grad/NaN/"
+                  "overflow signals describe a later dispatch than the "
+                  "retired loss, so only loss-based rules run; build "
+                  "with in_flight=1 for full divergence detection",
+                  file=self._out)
+
+    def on_step(self, step: int, aux) -> None:
+        import jax
+        from apex_tpu import telemetry
+        loss = float(self.loss_from_aux(aux))
+        telemetry.record("train/loss", loss, step=step)
+        gn_value = nan_value = None
+        overflow = False
+        if self._synced:
+            if self.overflow_total is not None:
+                total = float(self.overflow_total())
+                overflow = total > self._prev_overflows
+                self._prev_overflows = total
+            # the in-graph grad_stats emissions ride async debug
+            # callbacks; flush so the edge rules pair THIS step's flag
+            # with THIS step's norm (with in_flight=1 nothing newer can
+            # be in flight — the freshest emission IS this step's)
+            jax.effects_barrier()
+            col = telemetry.get_collector()
+            gn = col.last("health/grad_norm")
+            nan = col.last("health/nan")
+            gn_value = None if gn is None else gn.value
+            nan_value = None if nan is None else nan.value
+        else:
+            self._warn_skew()
+        for alert in self.detector.update(
+                step, loss=loss, grad_norm=gn_value, overflow=overflow,
+                nan_count=nan_value):
+            print(f"health ALERT step {step}: {alert['reason']} "
+                  f"({alert['detail']})", file=self._out)
+
+
+class ResumePrintPlugin:
+    """Announce snapshot restores (what every hand loop printed)."""
+
+    def on_resume(self, trainer, step: int) -> None:
+        print(f"resilience: {trainer.name} re-anchored at step {step} "
+              f"(pipelined dispatch window drained before restore)")

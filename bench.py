@@ -28,6 +28,15 @@ unavailable/off) so BENCH_r*.json rows stay schema-comparable across
 rounds. BENCH_TRACE=1 turns on host span tracing (apex_tpu.trace) and
 fills "wall_gap" with the top host span families behind the
 device-vs-wall gap.
+
+The step is built through apex_tpu.trainer (one step definition for the
+single-step and 25-step-scan programs, donation owned + audited at
+construction) and the measured loop rides its pipelined dispatch: an
+in-flight window (BENCH_INFLIGHT, default 2) keeps host dispatch of
+call N+1 overlapping device execution of call N, closing the wall clock
+onto the device clock. The JSON's "trainer" key records mode / window /
+donation-audit result; BENCH_TRAINER=0 is the A/B knob back to
+synchronous per-dispatch retirement ("trainer": null, schema stable).
 """
 
 import json
@@ -39,7 +48,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import apex_tpu._compat  # noqa: F401  (jax version shims: jax.shard_map)
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 BASELINE_IMG_S = 900.0
@@ -217,13 +225,17 @@ def main():
         return new_params, new_bs, new_opt_state, jax.lax.pmean(loss, "data")
 
     rep = P()
-    # Donate params/batch_stats/opt_state so XLA updates them in place —
-    # halves HBM traffic on the weight/moment buffers.
-    step_fn = jax.jit(shard_map(
-        per_device, mesh=mesh,
-        in_specs=(rep, rep, rep, (P("data"), P("data"))),
-        out_specs=(rep, rep, rep, rep), check_vma=False),
-        donate_argnums=(0, 1, 2))
+
+    # ONE step definition for every dispatch form (ROADMAP item 5): the
+    # trainer builds both the per-step program (warmup, cost analysis,
+    # comm accounting) and the scanned measured-loop program from this
+    # single (state, batch) -> (state, aux) function, owning donation
+    # (params/batch_stats/opt_state update in place — halves HBM traffic
+    # on the weight/moment buffers) with a construction-time audit.
+    def tstep(state, batch):
+        p, bs, os_ = state
+        p, bs, os_, loss = per_device(p, bs, os_, batch)
+        return (p, bs, os_), loss
 
     # Measured loop: `inner_steps` train steps inside ONE jitted lax.scan —
     # the TPU-native train loop (static-shape, compiler-friendly control
@@ -232,22 +244,54 @@ def main():
     # recorded 2,388 img/s on 10-step dispatches vs the repo's own
     # 2,461-2,473 device-time band (VERDICT r3 weak #1).
     inner_steps = 25 if on_tpu else 2
+    # BENCH_TRAINER=0 drops the dispatch pipeline back to synchronous
+    # per-dispatch retirement (the pre-trainer wall path, the A/B knob
+    # for the dispatch-gap win); BENCH_INFLIGHT sizes the window.
+    trainer_on = os.environ.get("BENCH_TRAINER", "1").lower() not in (
+        "0", "false", "no", "off")
+    in_flight = int(os.environ.get("BENCH_INFLIGHT", "2")) \
+        if trainer_on else 1
 
-    def multi_step(params, batch_stats, opt_state, batch):
-        def body(carry, _):
-            p, bs, os_ = carry
-            p, bs, os_, loss = per_device(p, bs, os_, batch)
-            return (p, bs, os_), loss
-        (params, batch_stats, opt_state), losses = jax.lax.scan(
-            body, (params, batch_stats, opt_state), None,
-            length=inner_steps)
-        return params, batch_stats, opt_state, losses[-1]
+    from apex_tpu import trainer as trainer_mod
+    state = (params, batch_stats, opt_state)
+    batch_specs = (P("data"), P("data"))
+    state_aval = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), state)
 
-    multi_fn = jax.jit(shard_map(
-        multi_step, mesh=mesh,
-        in_specs=(rep, rep, rep, (P("data"), P("data"))),
-        out_specs=(rep, rep, rep, rep), check_vma=False),
-        donate_argnums=(0, 1, 2))
+    def batch_aval(b=batch):
+        return (jax.ShapeDtypeStruct((b, image, image, 3), compute_dtype),
+                jax.ShapeDtypeStruct((b,), jnp.int32))
+
+    # per-step trainer: the canonical single-step program — its donation
+    # audit is the one the BENCH json reports (same step program the
+    # scan body runs; auditing the 25-step dispatch too would only pay a
+    # second AOT compile for the same answer)
+    tr_single = trainer_mod.build(
+        tstep, state_aval, batch_aval(), mesh=mesh, state_spec=rep,
+        batch_spec=batch_specs,
+        config=trainer_mod.TrainerConfig(in_flight=1),
+        name="bench_single")
+    step_fn = tr_single.fn
+    donation = tr_single.donation
+    log(donation.summary())
+
+    tr_plugins = []
+    if tel_path or trace_on:
+        # instrumented variant of the measured loop: each synced call is
+        # one inner_steps-step dispatch, so the step/* events describe
+        # dispatches (examples_per_step keeps examples/s honest);
+        # sync_every rides the in-flight depth so instrumentation blocks
+        # at the window's natural retirement cadence, not per dispatch
+        tr_plugins.append(trainer_mod.TelemetryPlugin(
+            examples_per_step=batch * inner_steps, measure_flops=False))
+    tr = trainer_mod.build(
+        tstep, state_aval, batch_aval(), mesh=mesh, state_spec=rep,
+        batch_spec=batch_specs,
+        config=trainer_mod.TrainerConfig(
+            mode="scan", steps_per_call=inner_steps, batch_mode="shared",
+            in_flight=in_flight, audit_donation=False),
+        plugins=tr_plugins, name="bench")
+    multi_fn = tr.fn
 
     shard = NamedSharding(mesh, P("data"))
     kx, ky = jax.random.split(jax.random.PRNGKey(1))
@@ -259,24 +303,26 @@ def main():
 
     # warmup: compiles both executables and settles the allocator
     for i in range(warmup):
-        params, batch_stats, opt_state, loss = step_fn(
-            params, batch_stats, opt_state, (x, y))
+        state, loss = step_fn(state, (x, y))
     jax.block_until_ready(loss)
     log(f"single-step warmup done ({warmup} steps), loss={float(loss):.3f}")
     # TWO warm dispatches: donated outputs can return with different
     # layouts than the device_put inputs, and the second call then
     # re-compiles (jit caches on layouts) — warm until steady
     for _ in range(2):
-        params, batch_stats, opt_state, loss = multi_fn(
-            params, batch_stats, opt_state, (x, y))
+        state, loss = multi_fn(state, (x, y))
         float(loss)
     log("scan executable warmed up")
 
     # Model FLOPs per step from XLA's cost analysis of the compiled step
     # (the honest numerator for MFU; no hand-assumed GFLOP/img constant).
     from apex_tpu import pyprof
-    flops_per_step = pyprof.xla_flops(step_fn, params, batch_stats,
-                                      opt_state, (x, y))
+    flops_per_step = pyprof.xla_flops(step_fn, state, (x, y))
+    if tr_plugins:
+        # late-bind the per-dispatch FLOPs into the instrumented wrapper
+        # (cost analysis only exists after warmup)
+        tr_plugins[0].instrument.set_model_flops(
+            (flops_per_step or 0) * inner_steps or None)
 
     # Primary clock: profiler DEVICE time of one 25-step dispatch
     # (pyprof.device_time_of) — immune to the ~120 ms/dispatch axon-tunnel
@@ -285,9 +331,8 @@ def main():
     img_s_dev = 0.0
     if on_tpu:
         def once():
-            nonlocal params, batch_stats, opt_state
-            params, batch_stats, opt_state, loss = multi_fn(
-                params, batch_stats, opt_state, (x, y))
+            nonlocal state
+            state, loss = multi_fn(state, (x, y))
             float(loss)  # D2H fetch: trustworthy sync on a remote chip
 
         dev_s = pyprof.device_time_of(once)
@@ -297,28 +342,30 @@ def main():
                 f"({dev_s * 1e3:.1f} ms for {inner_steps} steps)")
 
     outer = max(1, (steps - warmup) // inner_steps)
-    run_fn = multi_fn
-    if tel_path or trace_on:
-        from apex_tpu import telemetry
-        # instrumented variant of the measured loop: each call is one
-        # inner_steps-step dispatch, so the step/* events describe
-        # dispatches (examples_per_step keeps examples/s honest); the
-        # per-dispatch block_until_ready is the only overhead added.
-        run_fn = telemetry.instrument_step(
-            multi_fn, examples_per_step=batch * inner_steps,
-            measure_flops=False,
-            model_flops=(flops_per_step or 0) * inner_steps or None)
+    # Measured loop rides the trainer's pipelined dispatch: the window
+    # keeps in_flight dispatches outstanding and retires aux without
+    # stalling the dispatches ahead of it. BENCH_TRAINER=0 is the
+    # FAITHFUL pre-trainer baseline — direct calls on the (possibly
+    # instrumented) dispatch callable with NO window at all, exactly
+    # the old `for: run_fn(...)` + one trailing float(loss) loop — not
+    # a depth-1 window, whose per-dispatch block_until_ready the old
+    # loop never performed (the A/B must not overstate the win).
     loop_t0 = t0 = time.perf_counter()
-    for _ in range(outer):
-        params, batch_stats, opt_state, loss = run_fn(
-            params, batch_stats, opt_state, (x, y))
+    if trainer_on:
+        for _ in range(outer):
+            state, loss = tr.step(state, (x, y))
+        tr.drain()
+    else:
+        run_fn = tr.call_fn
+        for _ in range(outer):
+            state, loss = run_fn(state, (x, y))
     _ = float(loss)  # D2H fetch: the only trustworthy sync on a remote chip
     dt = time.perf_counter() - t0
     loop_t1 = time.perf_counter()
     n_steps = outer * inner_steps
     img_s_wall = batch * n_steps / dt
     log(f"{img_s_wall:.1f} img/s wall ({dt:.2f}s for {n_steps} steps, "
-        f"{inner_steps} per dispatch)")
+        f"{inner_steps} per dispatch, in_flight={in_flight})")
 
     img_s = img_s_dev if img_s_dev > 0 else img_s_wall
     # device-vs-wall reconciliation: the share of wall time the device
@@ -344,6 +391,14 @@ def main():
         "tune": tune_cfg,
         "overlap": {"enabled": overlap_on, "reduce_dtype": reduce_dtype,
                     "adasum": adasum},
+        # compiled-trainer provenance: dispatch mode, in-flight window,
+        # and the construction-time donation audit of the step program
+        # (null when BENCH_TRAINER=0 — rows stay schema-comparable)
+        "trainer": ({"mode": tr.config.mode,
+                     "steps_per_call": tr.steps_per_call,
+                     "in_flight": in_flight,
+                     "donation": donation.to_json()}
+                    if trainer_on else None),
     }
     if trace_on:
         # the wall-vs-device gap, itemized: top host span families by
@@ -360,7 +415,7 @@ def main():
         jax.effects_barrier()   # async callback spans land first
         fams = trace.family_totals(
             telemetry.get_collector().snapshot(),
-            exclude=("step/device_wait", "profile/step",
+            exclude=("profile/step", *trace.DEVICE_WAIT_FAMILIES,
                      *trace.CONCURRENT_FAMILIES),
             window=(loop_t0, loop_t1))
         top = sorted(fams.items(), key=lambda kv: -kv[1])[:3]
@@ -393,17 +448,15 @@ def main():
                      if prof_env in ("1", "true", "yes") else prof_env)
 
         def prof_runner():
-            nonlocal params, batch_stats, opt_state
-            params, batch_stats, opt_state, loss = multi_fn(
-                params, batch_stats, opt_state, (x, y))
+            nonlocal state
+            state, loss = multi_fn(state, (x, y))
             jax.block_until_ready(loss)
 
         # multi_fn is BOTH the HLO source (AOT lower, donation untouched)
         # and — via the rebinding runner — the profiled body, so trace
         # hlo_op names join the right module's scope metadata
-        bd = pyprof.capture(multi_fn, params, batch_stats, opt_state,
-                            (x, y), runner=prof_runner, steps=2,
-                            warmup=0, logdir=trace_dir)
+        bd = pyprof.capture(multi_fn, state, (x, y), runner=prof_runner,
+                            steps=2, warmup=0, logdir=trace_dir)
         cats = bd["categories"]
         result["profile"] = {
             "logdir": trace_dir,
@@ -434,8 +487,7 @@ def main():
         # static comm bill of the SINGLE-step program (the scan dispatch
         # would be counted once per trip by the walker's scan scaling, but
         # the single step is the canonical per-step quantity)
-        telemetry.record_comm_stats(step_fn, params, batch_stats,
-                                    opt_state, (x, y), name="comm")
+        telemetry.record_comm_stats(step_fn, state, (x, y), name="comm")
         jax.effects_barrier()   # flush async debug callbacks
         telemetry.write_jsonl(tel_path)
         result["telemetry"] = tel_path
@@ -453,16 +505,17 @@ def main():
         from apex_tpu import resilience
         snap_dir = (tempfile.mkdtemp(prefix="apex_bench_snap_")
                     if snap_env in ("1", "true", "yes") else snap_env)
-        state = {"params": params, "opt": opt_state,
-                 "batch_stats": batch_stats}
+        params, batch_stats, opt_state = state
+        snap_state = {"params": params, "opt": opt_state,
+                      "batch_stats": batch_stats}
         mgr = resilience.SnapshotManager(snap_dir, keep_last=2)
         t0 = time.perf_counter()
-        mgr.save(state, step=n_steps)
+        mgr.save(snap_state, step=n_steps)
         sync_s = time.perf_counter() - t0
         amgr = resilience.SnapshotManager(snap_dir, keep_last=2,
                                           async_mode=True)
         t0 = time.perf_counter()
-        amgr.save(state, step=n_steps + 1)
+        amgr.save(snap_state, step=n_steps + 1)
         async_block_s = time.perf_counter() - t0
         amgr.wait()
         man = mgr.manifest(mgr.generations()[-1])

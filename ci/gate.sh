@@ -12,7 +12,7 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "== 1/12 package import =="
+echo "== 1/13 package import =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import apex_tpu
@@ -20,7 +20,7 @@ from apex_tpu import amp, optimizers, parallel, ops
 print('apex_tpu imports OK')
 "
 
-echo "== 2/12 native host runtime builds (g++ -O3 -shared) =="
+echo "== 2/13 native host runtime builds (g++ -O3 -shared) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 from apex_tpu import runtime
@@ -35,7 +35,7 @@ print('flatten/unflatten path OK')
 assert ok, 'host runtime failed to build — check g++ toolchain'
 "
 
-echo "== 3/12 graft entry compiles (single-device + 8-device dryrun) =="
+echo "== 3/13 graft entry compiles (single-device + 8-device dryrun) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import __graft_entry__ as ge
@@ -45,7 +45,7 @@ print('entry() compiles')
 ge.dryrun_multichip(8)
 "
 
-echo "== 4/12 package install (wheel build + clean --target install) =="
+echo "== 4/13 package install (wheel build + clean --target install) =="
 # The reference gates on Docker extension builds
 # (tests/docker_extension_builds/run.sh); the TPU analog: build the wheel
 # from pyproject.toml, install it into an empty --target dir, and import
@@ -88,14 +88,14 @@ jax.jit(step).lower(params, state).compile()
 print('installed-package train step compiles')
 ")
 
-echo "== 5/12 lint (apex_tpu.lint: trace safety / dtype policy / collectives) =="
+echo "== 5/13 lint (apex_tpu.lint: trace safety / dtype policy / collectives) =="
 # static gate BEFORE the test tier: AST pass over the package + graft
 # entry, jaxpr pass over the registered entry points. --strict: warnings
 # fail too (every intentional exception carries an inline suppression
 # with its why — see docs/lint.md). Use --format=github under CI bots.
 python -m apex_tpu.lint apex_tpu/ __graft_entry__.py --strict
 
-echo "== 6/12 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
+echo "== 6/13 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
 # A 3-step instrumented GPT train step on the CPU mesh must produce a
 # parseable JSONL carrying step timing, amp loss-scale/overflow, comm
 # bytes and MFU, and the summarize CLI must render it (exit 0) — the
@@ -168,7 +168,7 @@ fi
 echo "health CLI gate OK (healthy=0, injected-NaN=nonzero)"
 rm -rf "$(dirname "$HLT_FILE")"
 
-echo "== 7/12 tune smoke (sweep dry-run + auto-policy tuned train) =="
+echo "== 7/13 tune smoke (sweep dry-run + auto-policy tuned train) =="
 # The autotuner must be drivable offline (sweep plan renders, exit 0) and
 # inline: a 3-step train whose kernels resolve their configs through
 # apex_tpu.tune under APEX_TPU_TUNE=auto. On this CPU backend measurement
@@ -245,7 +245,7 @@ print(f'tune smoke OK: {len(tuned)} tune/* series, '
 " "$TUNE_DIR/tune_run.jsonl" "$TUNE_DIR/cache"
 rm -rf "$TUNE_DIR"
 
-echo "== 8/12 resilience smoke (snapshot -> injected kill -> auto-resume) =="
+echo "== 8/13 resilience smoke (snapshot -> injected kill -> auto-resume) =="
 # Kill-and-resume end to end: a 6-step train snapshotting every 2 steps is
 # SIGKILLed by the fault injector at the top of step 4 (exit 137 — an
 # abrupt death, no final snapshot), then the SAME command with --resume
@@ -302,7 +302,7 @@ python -m apex_tpu.telemetry summarize "$RES_DIR/resume.jsonl" \
     || { echo "summarize did not report the resume point" >&2; exit 1; }
 rm -rf "$RES_DIR"
 
-echo "== 9/12 overlap smoke (staged backward + bf16 wire vs fp32 baseline) =="
+echo "== 9/13 overlap smoke (staged backward + bf16 wire vs fp32 baseline) =="
 # The overlap engine end to end on the 8-device CPU mesh: a 3-step fp32
 # baseline train and the same train under --overlap --reduce-dtype bf16
 # must (a) land within 1e-2 of each other's final loss (the compression
@@ -358,7 +358,7 @@ python -m apex_tpu.telemetry summarize "$OVL_DIR/bf16.jsonl" \
     || { echo "summarize did not render overlap efficiency" >&2; exit 1; }
 rm -rf "$OVL_DIR"
 
-echo "== 10/12 profile smoke (capture -> attribution report -> compare gate) =="
+echo "== 10/13 profile smoke (capture -> attribution report -> compare gate) =="
 # The attribution profiler end to end on the CPU backend: a 3-step train
 # with --profile must produce a capture logdir whose offline report
 # parses with nonzero compute time and carries the named
@@ -419,7 +419,7 @@ fi
 echo "compare gate OK (identical=0, doctored-slower=4)"
 rm -rf "$PROF_DIR"
 
-echo "== 11/12 trace smoke (host spans -> unified timeline -> merge/stragglers) =="
+echo "== 11/13 trace smoke (host spans -> unified timeline -> merge/stragglers) =="
 # The host-tracing layer end to end: a 3-step --trace train must emit
 # parseable span/* begin/end pairs, the unified host+device timeline
 # must export as valid Chrome-trace JSON with BOTH lanes populated,
@@ -492,7 +492,52 @@ grep -q "worst: p" "$TRC_DIR/merged.txt" \
 echo "trace smoke OK (spans + timeline + reconciliation + 2-process merge)"
 rm -rf "$TRC_DIR"
 
-echo "== 12/12 pytest =="
+echo "== 12/13 trainer smoke (compiled-step builder: pipelined dispatch + donation audit) =="
+# The compiled trainer end to end: a 3-step train_lm built through
+# apex_tpu.trainer with telemetry+trace on must (a) emit balanced
+# span/* begin/end pairs (the in-flight window's trainer/retire spans
+# included), (b) carry a parseable step/* series covering every step,
+# and (c) report a donation audit with ZERO refused buffers — a refusal
+# means carried state double-buffers in HBM, the exact regression the
+# construction-time audit exists to catch.
+TRN_DIR="$(mktemp -d)"
+python examples/gpt/train_lm.py --steps 3 --warmup-steps 0 --vocab 512 \
+    --layers 2 --embed-dim 64 --heads 2 --seq-len 128 --batch-size 1 \
+    --opt-level O2 --trace --in-flight 2 \
+    --telemetry "$TRN_DIR/run.jsonl" > "$TRN_DIR/out.txt"
+python -c "
+import json, sys
+names = set()
+pairs = {'B': 0, 'E': 0}
+steps = set()
+refused = None
+for line in open(sys.argv[1]):
+    row = json.loads(line)              # every line must parse
+    names.add(row['name'])
+    if row['name'].startswith('span/'):
+        pairs[row['meta']['ph']] += 1
+    if row['name'].startswith('step/') and row.get('step') is not None:
+        steps.add(row['step'])
+    if row['name'] == 'trainer/donation_refused':
+        refused = row
+assert pairs['B'] == pairs['E'] > 0, f'unpaired span events: {pairs}'
+need = {'step/time_s', 'step/dispatch_s', 'step/device_wait_s',
+        'trainer/in_flight'}
+missing = need - names
+assert not missing, f'missing {missing}; has {sorted(names)}'
+assert steps == {0, 1, 2}, f'step/* series cover {sorted(steps)}, not 0-2'
+assert refused is not None, 'no trainer/donation_refused event'
+assert refused['value'] == 0 and refused['meta']['ok'], \
+    f'donation audit refused buffers: {refused}'
+print(f'trainer smoke OK: donation {refused[\"meta\"][\"aliased\"]}/'
+      f'{refused[\"meta\"][\"declared\"]} aliased 0 refused; '
+      f'{pairs[\"B\"]} span pairs balanced; step series 0-2')
+" "$TRN_DIR/run.jsonl"
+grep -q "donation audit: .* 0 refused" "$TRN_DIR/out.txt" \
+    || { echo "train_lm did not print the donation audit" >&2; exit 1; }
+rm -rf "$TRN_DIR"
+
+echo "== 13/13 pytest =="
 if [[ "${1:-}" == "--full" ]]; then
     # full suite + the complete L1 cross-product matrix (reference
     # tests/L1/cross_product{,_distributed}/run.sh); the convergence
@@ -501,11 +546,13 @@ if [[ "${1:-}" == "--full" ]]; then
     # on-chip (BASELINE.md)
     APEX_TPU_L1_FULL=1 python -m pytest tests/ -q -x
 else
-    # fast subset: kernels, optimizers, amp, param groups, checkpoints
+    # fast subset: kernels, optimizers, amp, param groups, checkpoints,
+    # and the trainer parity/pipelining block
     python -m pytest tests/test_multi_tensor.py tests/test_optimizers.py \
         tests/test_amp.py tests/test_param_groups.py tests/test_zero.py \
         tests/test_checkpoint.py tests/test_runtime.py tests/test_tune.py \
         tests/test_resilience.py tests/test_overlap.py \
+        tests/test_trainer.py \
         tests/test_pyprof.py tests/test_trace.py -q -x
 fi
 

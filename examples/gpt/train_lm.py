@@ -26,7 +26,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))))
 
 from apex_tpu import amp, optimizers, parallel
-from jax import shard_map  # noqa: E402 (needs apex_tpu's jax version shims)
 from apex_tpu.models import TransformerLM
 from apex_tpu.models.gpt import chunked_next_token_loss, next_token_loss
 
@@ -157,6 +156,20 @@ def parse_args(argv=None):
                    help=">1: dispatch-proof mode — N steps per jitted "
                         "lax.scan dispatch with on-device token "
                         "generation; device-time primary clock")
+    p.add_argument("--in-flight", type=int, default=2,
+                   help="dispatch-pipelining window depth "
+                        "(apex_tpu.trainer): keep this many dispatches "
+                        "outstanding so host dispatch of step N+1 "
+                        "overlaps device execution of step N; 1 = "
+                        "synchronous per-dispatch retirement (results "
+                        "are bit-identical at every depth)")
+    p.add_argument("--prefetch", type=int, default=0, metavar="DEPTH",
+                   help="double-buffered host IO: generate + stage "
+                        "batches onto device (async device_put) from a "
+                        "runtime.PrefetchLoader worker thread, DEPTH "
+                        "batches ahead of the step (not with --resume "
+                        "auto; the loader reports put_s / starvation "
+                        "stats at exit)")
     p.add_argument("--snapshot-dir", default=None, metavar="DIR",
                    help="fault tolerance: atomic generation-numbered "
                         "snapshots of (params, amp optimizer state) "
@@ -420,16 +433,29 @@ def main(argv=None):
 
     rep = P()
     tok_spec = P(None, "seq") if args.seq_parallel else P("data")
-    step_fn = jax.jit(shard_map(
-        per_device, mesh=mesh,
-        in_specs=(rep, rep, tok_spec, rep, rep),
-        out_specs=(rep, rep, rep), check_vma=False),
-        donate_argnums=(0, 1))
+
+    # ONE step definition for every loop variant (apex_tpu.trainer,
+    # ROADMAP item 5): the builder owns shard_map wiring, donation (+
+    # construction-time audit), dispatch pipelining, and the plugin seam
+    # telemetry/health/amp/tune attach to.
+    def tstep(state, batch):
+        params, opt_state = state
+        tokens, step_rng, mult = batch
+        params, opt_state, loss = per_device(params, opt_state, tokens,
+                                             step_rng, mult)
+        return (params, opt_state), loss
 
     shard = NamedSharding(mesh, tok_spec)
     batch = args.batch_size if args.seq_parallel else \
         args.batch_size * n_dev
     args.warmup_steps = min(args.warmup_steps, max(args.steps - 2, 0))
+
+    # cost analysis / comm accounting avals: lower() never executes, so
+    # shapes+dtypes suffice (the donation audit compiles AOT from them)
+    tok_aval = jax.ShapeDtypeStruct((batch, args.seq_len), jnp.int32)
+    rng_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    mult_aval = jax.ShapeDtypeStruct((), jnp.float32)
+    batch_avals = (tok_aval, rng_aval, mult_aval)
 
     if args.resume == "auto" and not args.snapshot_dir:
         raise SystemExit("--resume auto requires --snapshot-dir")
@@ -445,23 +471,23 @@ def main(argv=None):
                 "the dispatch is an N-step lax.scan whose breakdown "
                 "would describe the whole dispatch — run --profile "
                 "without --scan")
-        return _run_scan_mode(args, mesh, axis, per_device, step_fn,
-                              params, opt_state, batch, model)
+        return _run_scan_mode(args, mesh, axis, per_device, params,
+                              opt_state, batch, model)
 
-    step_call = step_fn
+    from apex_tpu import trainer as trainer_mod
+
+    plugins = []
     if args.telemetry or args.trace:
-        from apex_tpu import telemetry
-        # wraps every call with the dispatch/device split + tokens/s, and
-        # (lazily, from call 2) MFU off XLA's cost analysis of step_fn;
-        # under --trace it additionally emits the span/step/* pair every
-        # step (the merge CLI's clock anchors)
-        step_call = telemetry.instrument_step(
-            step_fn, tokens_per_step=batch * args.seq_len)
-
-    detector = None
-    if args.health:
-        from apex_tpu import telemetry
-        detector = telemetry.DivergenceDetector()
+        # the dispatch/device split + tokens/s per synced call, and
+        # (lazily, from call 2) MFU off XLA's cost analysis; under
+        # --trace it additionally emits the span/step/* pairs (the merge
+        # CLI's clock anchors). sync_every=1: the per-step example keeps
+        # every step timed — production loops raise it to the window
+        # depth (docs/telemetry.md)
+        plugins.append(trainer_mod.TelemetryPlugin(
+            tokens_per_step=batch * args.seq_len, sync_every=1))
+        plugins.append(trainer_mod.AmpPlugin(args.opt_level))
+        plugins.append(trainer_mod.TunePlugin())
 
     from apex_tpu import resilience
     injector = resilience.FaultInjector.from_env()
@@ -471,67 +497,84 @@ def main(argv=None):
             args.snapshot_dir, keep_last=args.keep_last,
             keep_every=args.keep_every, async_mode=args.async_snapshots)
 
-    # cost analysis / comm accounting avals: lower() never executes, so
-    # shapes+dtypes suffice (same trick as scan mode)
-    tok_aval = jax.ShapeDtypeStruct((batch, args.seq_len), jnp.int32)
-    rng_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
-    mult_aval = jax.ShapeDtypeStruct((), jnp.float32)
+    in_flight = args.in_flight
+    health_plugin = None
+    if args.health:
+        if in_flight > 1:
+            # HealthPlugin pairs per-step signals (overflow edge, grad
+            # norm, NaN count) with that step's loss — a pairing it only
+            # trusts at window depth 1, so health mode keeps the
+            # pre-trainer synchronous semantics
+            print("note: --health needs per-step signal pairing; "
+                  "running with in_flight=1 (pipelining disabled)",
+                  file=sys.stderr)
+            in_flight = 1
+        # the scaler's host-readable overflow counter off the NEWEST
+        # dispatched state — with in_flight=1 that IS the retired step's
+        health_plugin = trainer_mod.HealthPlugin(
+            loss_from_aux=float,
+            overflow_total=lambda: float(
+                tr.last_state[1].scaler.overflows[0]))
+        plugins.append(health_plugin)
 
-    def make_batch(i):
+    tr = trainer_mod.build(
+        tstep, (params, opt_state), batch_avals, mesh=mesh,
+        state_spec=rep, batch_spec=(tok_spec, rep, rep),
+        config=trainer_mod.TrainerConfig(in_flight=in_flight),
+        plugins=plugins, name="train_lm")
+    step_fn = tr.fn
+    if tr.donation is not None:
+        print(tr.donation.summary())
+    detector = health_plugin.detector if health_plugin else None
+
+    def host_batch(i):
         # per-step seeded token draw: batch i is addressable by its step
         # index alone, so a killed run's resume regenerates the exact
-        # stream without replaying i sequential host-RNG draws
-        tokens = jax.device_put(
-            np.random.default_rng([args.seed + 1, i]).integers(
-                0, args.vocab, (batch, args.seq_len), np.int32), shard)
+        # stream without replaying i sequential host-RNG draws. ONE
+        # definition — the per-step path and the --prefetch loader both
+        # consume it, so the streams cannot drift apart.
+        tokens = np.random.default_rng([args.seed + 1, i]).integers(
+            0, args.vocab, (batch, args.seq_len), np.int32)
         mult = injector.loss_mult(i) if injector is not None else 1.0
         return (tokens, jax.random.PRNGKey(args.seed + 2 + i),
                 jnp.float32(mult))
 
-    def loop_step(state, batch_inputs, i):
-        params, opt_state = state
-        tokens, step_rng, mult = batch_inputs
-        params, opt_state, loss = step_call(params, opt_state, tokens,
-                                            step_rng, mult)
-        return (params, opt_state), loss
+    def stage(b):
+        return (jax.device_put(b[0], shard), b[1], b[2])
 
-    timing = {"t0": None, "timed": 0, "flops": None,
-              "prev_overflows": 0.0, "loss": None}
+    def make_batch(i):
+        return stage(host_batch(i))
+
+    data = make_batch
+    loader = None
+    if args.prefetch:
+        # double-buffered host IO: a background worker generates batch
+        # i+1 and stages its tokens onto device (async device_put —
+        # span/data/put, stats()['put_s']) while the trainer runs step i
+        if args.resume != "none":
+            raise SystemExit(
+                "--prefetch streams batches ahead of the step index; "
+                "resume needs the step-addressable make_batch path "
+                "(run --resume none or drop --prefetch)")
+        from apex_tpu import runtime
+        loader = runtime.PrefetchLoader(
+            (host_batch(i) for i in range(args.steps)),
+            depth=args.prefetch, device_put=stage)
+        data = loader
+
+    timing = {"t0": None, "timed": 0, "flops": None, "loss": None}
 
     def on_step(i, state, loss):
         timing["loss"] = loss
-        opt_state = state[1]
-        if args.telemetry or detector is not None:
+        # divergence detection (grad-norm / NaN / overflow pairing +
+        # stderr alerts) lives in HealthPlugin, attached once above —
+        # it already records the train/loss series under --health
+        if args.telemetry and detector is None:
             # the loss series feeds the offline loss_nonfinite /
             # loss_spike rules — a --telemetry-only JSONL must carry it
             # too, or `telemetry health` is blind to a NaN loss
             from apex_tpu import telemetry
             telemetry.record("train/loss", float(loss), step=i)
-        if detector is not None:
-            from apex_tpu import telemetry
-            loss_val = float(loss)
-            # feed the detector every rule's signal, not just loss: the
-            # overflow flag from the scaler's host-readable counter, and
-            # grad-norm / NaN-count from this step's in-graph grad_stats
-            # emission. Debug callbacks are async, so flush them first —
-            # the edge rules (grad_nonfinite-without-overflow) need the
-            # flag and the norm to describe the SAME step; a stale Inf
-            # norm from an overflow step paired with the next step's
-            # clean flag would read as corruption and fail a CI gate.
-            ovf_total = float(opt_state.scaler.overflows[0])
-            jax.effects_barrier()
-            col = telemetry.get_collector()
-            gn_ev = col.last("health/grad_norm")
-            nan_ev = col.last("health/nan")
-            alerts = detector.update(
-                i, loss=loss_val,
-                grad_norm=None if gn_ev is None else gn_ev.value,
-                overflow=ovf_total > timing["prev_overflows"],
-                nan_count=None if nan_ev is None else nan_ev.value)
-            timing["prev_overflows"] = ovf_total
-            for alert in alerts:
-                print(f"health ALERT step {i}: {alert['reason']}"
-                      f" ({alert['detail']})", file=sys.stderr)
         if timing["t0"] is None and i >= args.warmup_steps:
             jax.block_until_ready(loss)
             # cost analysis BEFORE the timed region (AOT compile; the
@@ -540,8 +583,7 @@ def main(argv=None):
             # a resumed run may start beyond the warmup boundary.
             from apex_tpu import pyprof
             timing["flops"] = pyprof.xla_flops(
-                step_fn, state[0], opt_state, tok_aval, rng_aval,
-                mult_aval)
+                step_fn, (state[0], state[1]), batch_avals)
             timing["t0"] = time.perf_counter()
         elif timing["t0"] is not None:
             timing["timed"] += 1
@@ -549,16 +591,16 @@ def main(argv=None):
             print(f"step {i:4d} loss {float(loss):.4f}")
 
     def on_resume(f):
-        if step_call is not step_fn:
-            # re-attribute the instrumented step/* series to the GLOBAL
-            # step index — the wrapper would otherwise restart at 0 and
-            # mis-join the appended JSONL's resume segmentation
-            step_call.advance_to(f.step)
+        # step re-attribution (the instrumented step/* series restart at
+        # the restored step, not 0) happens in TelemetryPlugin.on_resume
+        # via trainer.notify_resume — resilient_loop fires it before
+        # this callback
         print(f"resilience: resumed from generation {f.generation} at "
               f"step {f.step} ({f.path})")
 
     result = resilience.resilient_loop(
-        loop_step, (params, opt_state), make_batch, steps=args.steps,
+        None, (params, opt_state), data, steps=args.steps,
+        trainer=tr,
         manager=manager, snapshot_every=args.snapshot_every,
         resume=args.resume, injector=injector,
         handle_signals=manager is not None,
@@ -568,6 +610,12 @@ def main(argv=None):
         on_step=on_step,
         on_resume=on_resume)
     params, opt_state = result.state
+    if loader is not None:
+        lst = loader.stats()
+        print(f"prefetch: {lst['consumed']} batches, "
+              f"{lst['starvations']} starvations, "
+              f"put {lst['put_s'] * 1e3:.1f} ms total")
+        loader.close()
     loss = timing["loss"]
 
     if result.preempted:
@@ -637,18 +685,17 @@ def main(argv=None):
         # map — donation untouched; the runner rebinds the donated
         # carry, so these are a few extra real train steps)
         from apex_tpu import pyprof
-        tokens, step_rng, mult = make_batch(args.steps)
-        carry = [params, opt_state]
+        prof_batch = make_batch(args.steps)
+        carry = [(params, opt_state)]
 
         def prof_runner():
-            carry[0], carry[1], lo = step_fn(carry[0], carry[1], tokens,
-                                             step_rng, mult)
+            carry[0], lo = step_fn(carry[0], prof_batch)
             jax.block_until_ready(lo)
 
-        bd = pyprof.capture(step_fn, params, opt_state, tokens, step_rng,
-                            mult, runner=prof_runner, steps=3, warmup=1,
+        bd = pyprof.capture(step_fn, (params, opt_state), prof_batch,
+                            runner=prof_runner, steps=3, warmup=1,
                             logdir=args.profile)
-        params, opt_state = carry
+        params, opt_state = carry[0]
         if args.telemetry:
             pyprof.record_breakdown(bd)
         cats = bd["categories"]
@@ -669,8 +716,8 @@ def main(argv=None):
         from apex_tpu import telemetry
         # static comm bill of the step program (per device per step,
         # grouped by mesh axis) joins the run file
-        telemetry.record_comm_stats(step_fn, params, opt_state, tok_aval,
-                                    rng_aval, mult_aval, name="comm")
+        telemetry.record_comm_stats(step_fn, (params, opt_state),
+                                    batch_avals, name="comm")
         jax.effects_barrier()   # async debug callbacks land before export
         telemetry.write_jsonl(args.telemetry)
         sub = "health" if args.health else "summarize"
@@ -679,16 +726,16 @@ def main(argv=None):
     return tok_s
 
 
-def _run_scan_mode(args, mesh, axis, per_device, step_fn, params,
-                   opt_state, batch, model=None):
+def _run_scan_mode(args, mesh, axis, per_device, params, opt_state,
+                   batch, model=None):
     """Dispatch-proof throughput mode (r4): ``--scan N`` runs N train
     steps per jitted lax.scan dispatch with ON-DEVICE token generation —
     each device draws its own shard of fresh tokens from a folded key
-    inside the scan body (the TPU-native synthetic-data path). The
-    default per-step loop host-generates + device_puts every batch and
-    pays the ~120 ms axon dispatch+sync tax per step, which at short
-    step times dominates the wall number (r3 timing doctrine)."""
-    from apex_tpu import pyprof
+    inside the scan body (the TPU-native synthetic-data path). Built
+    through ``apex_tpu.trainer`` (mode="scan", stacked per-step keys as
+    the batch); the outer loop rides the trainer's in-flight window so
+    even the dispatch boundaries overlap."""
+    from apex_tpu import pyprof, trainer as trainer_mod
     from apex_tpu.ops.attention import _interpret, attention_model_flops
 
     rep = P()
@@ -696,41 +743,60 @@ def _run_scan_mode(args, mesh, axis, per_device, step_fn, params,
     local_b = args.batch_size
     local_s = args.seq_len // n_dev if args.seq_parallel else args.seq_len
 
-    def multi(params, opt_state, base_rng):
+    def sstep(state, rng_i):
+        p, s = state
         ax_i = jax.lax.axis_index(axis)
+        tok_rng = jax.random.fold_in(rng_i, ax_i)
+        tokens = jax.random.randint(tok_rng, (local_b, local_s), 0,
+                                    args.vocab)
+        p, s, loss = per_device(p, s, tokens, rng_i, jnp.float32(1.0))
+        return (p, s), loss
 
-        def body(carry, i):
-            p, s = carry
-            rng_i = jax.random.fold_in(base_rng, i)
-            tok_rng = jax.random.fold_in(rng_i, ax_i)
-            tokens = jax.random.randint(tok_rng, (local_b, local_s), 0,
-                                        args.vocab)
-            p, s, loss = per_device(p, s, tokens, rng_i,
-                                    jnp.float32(1.0))
-            return (p, s), loss
+    def avals(tree):
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
 
-        (params, opt_state), losses = jax.lax.scan(
-            body, (params, opt_state), jnp.arange(args.scan))
-        return params, opt_state, losses[-1]
+    key_aval = jax.ShapeDtypeStruct((args.scan, 2), jnp.uint32)
+    tr = trainer_mod.build(
+        sstep, avals((params, opt_state)), key_aval, mesh=mesh,
+        state_spec=rep, batch_spec=rep,
+        config=trainer_mod.TrainerConfig(
+            mode="scan", steps_per_call=args.scan,
+            in_flight=args.in_flight),
+        name="train_lm_scan")
+    multi_fn = tr.fn
+    if tr.donation is not None:
+        print(tr.donation.summary())
 
-    multi_fn = jax.jit(shard_map(
-        multi, mesh=mesh, in_specs=(rep, rep, rep),
-        out_specs=(rep, rep, rep), check_vma=False),
-        donate_argnums=(0, 1))
+    # the per-step keys, derived ON DEVICE in one jitted call per
+    # dispatch: fold_in(k, i) for each scan slot — bit-identical to
+    # folding inside the body (fold_in is deterministic, only WHERE it
+    # runs moved), and the timed loop pays ONE key dispatch per outer
+    # iteration instead of args.scan host-side fold dispatches (this
+    # mode exists to amortize dispatch overhead — r3 timing doctrine)
+    dispatch_keys = jax.jit(lambda k: jax.vmap(
+        lambda i: jax.random.fold_in(k, i))(jnp.arange(args.scan)))
 
+    state = (params, opt_state)
     key = jax.random.PRNGKey(args.seed + 1)
     for _ in range(2):  # compile + donated-layout recompile
         key, k = jax.random.split(key)
-        params, opt_state, loss = multi_fn(params, opt_state, k)
+        state, loss = multi_fn(state, dispatch_keys(k))
     print(f"scan mode warm, loss {float(loss):.4f}")
 
     # cost analysis on a SINGLE-step program (scan bodies are counted
-    # once); avals suffice — lower() never executes
-    tok_aval = jax.ShapeDtypeStruct((batch, args.seq_len), jnp.int32)
-    rng_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
-    mult_aval = jax.ShapeDtypeStruct((), jnp.float32)
-    flops_step = pyprof.xla_flops(step_fn, params, opt_state, tok_aval,
-                                  rng_aval, mult_aval)
+    # once) from the same step definition; avals suffice — lower()
+    # never executes, and the audit is off (the measured dispatch's
+    # program is the one above)
+    tr_single = trainer_mod.build(
+        sstep, avals(state), jax.ShapeDtypeStruct((2,), jnp.uint32),
+        mesh=mesh, state_spec=rep, batch_spec=rep,
+        config=trainer_mod.TrainerConfig(in_flight=1,
+                                         audit_donation=False),
+        name="train_lm_scan_single")
+    flops_step = pyprof.xla_flops(
+        tr_single.fn, avals(state),
+        jax.ShapeDtypeStruct((2,), jnp.uint32))
     # same gating as the default loop: analytic attention FLOPs only
     # when flash runs as an opaque custom call; MFU only on a real TPU
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -743,9 +809,9 @@ def _run_scan_mode(args, mesh, axis, per_device, step_fn, params,
     tok_s_dev = 0.0
     if on_tpu:
         def once():
-            nonlocal params, opt_state, key
+            nonlocal state, key
             key, k = jax.random.split(key)
-            params, opt_state, loss = multi_fn(params, opt_state, k)
+            state, loss = multi_fn(state, dispatch_keys(k))
             float(loss)
 
         dev_s = pyprof.device_time_of(once)
@@ -756,9 +822,11 @@ def _run_scan_mode(args, mesh, axis, per_device, step_fn, params,
     t0 = time.perf_counter()
     for _ in range(outer):
         key, k = jax.random.split(key)
-        params, opt_state, loss = multi_fn(params, opt_state, k)
+        state, loss = tr.step(state, dispatch_keys(k))
+    tr.drain()
     float(loss)
     dt = time.perf_counter() - t0
+    params, opt_state = state
     tok_s_wall = batch * args.seq_len * outer * args.scan / dt
     tok_s = tok_s_dev or tok_s_wall
     msg = (f"Speed: {tok_s:,.0f} tokens/s "
@@ -775,8 +843,9 @@ def _run_scan_mode(args, mesh, axis, per_device, step_fn, params,
                 if flash_opaque else " (cost-analysis count)")
     if args.telemetry:
         from apex_tpu import telemetry
-        telemetry.record_comm_stats(step_fn, params, opt_state, tok_aval,
-                                    rng_aval, mult_aval, name="comm")
+        telemetry.record_comm_stats(
+            tr_single.fn, avals((params, opt_state)),
+            jax.ShapeDtypeStruct((2,), jnp.uint32), name="comm")
         jax.effects_barrier()
         telemetry.write_jsonl(args.telemetry)
         msg += f"\ntelemetry: {args.telemetry}"

@@ -7,7 +7,12 @@ subprocess (same pattern as tests/distributed_worker.py).
 
 Usage: python resilience_worker.py STEPS SNAPSHOT_DIR OUT_NPZ
 Environment: APEX_TPU_FAULT (optional), SNAP_EVERY (default 2),
-SNAP_ASYNC=1 for async snapshot mode.
+SNAP_ASYNC=1 for async snapshot mode, USE_TRAINER=1 to build the step
+through apex_tpu.trainer (donation + pipelined dispatch, in-flight
+window from TRAINER_INFLIGHT, default 2) and drive it via
+``resilient_loop(trainer=...)`` — the PR's claim that pipelining does
+not break the exit-75/bitwise-resume contract is tested by comparing
+THIS path against the hand-built one.
 
 Writes OUT_NPZ with the final (params, AmpOptimizerState) leaves plus
 the (step, loss) trajectory observed by THIS process — the test
@@ -38,14 +43,14 @@ def main() -> None:
               "b": jnp.zeros((2,), jnp.float16)}
     state0 = aopt.init(params)
 
-    @jax.jit
-    def step(params, state, x):
+    def tstep(st, x):
+        params, state = st
         def loss_fn(p):
             loss = ((p["w"] * x).sum() - 1.0) ** 2 + (p["b"] ** 2).sum()
             return aopt.scale_loss(loss, state), loss
         grads, loss = jax.grad(loss_fn, has_aux=True)(params)
         new_params, new_state, _ = aopt.step(grads, params, state)
-        return new_params, new_state, loss
+        return (new_params, new_state), loss
 
     def make_x(i):
         # addressable by step index: the resumed process regenerates the
@@ -54,14 +59,23 @@ def main() -> None:
             np.random.default_rng([7, i]).uniform(-1, 1, 8), jnp.float16)
 
     losses = []
+    trainer = None
+    loop_step = None
+    if os.environ.get("USE_TRAINER"):
+        from apex_tpu import trainer as trainer_mod
+        trainer = trainer_mod.build(
+            tstep, (params, state0), make_x(0),
+            config=trainer_mod.TrainerConfig(
+                in_flight=int(os.environ.get("TRAINER_INFLIGHT", "2"))))
+    else:
+        step = jax.jit(tstep)
 
-    def loop_step(st, x, i):
-        p, s = st
-        p, s, loss = step(p, s, x)
-        return (p, s), loss
+        def loop_step(st, x, i):
+            return step(st, x)
 
     result = resilience.resilient_loop(
         loop_step, (params, state0), make_x, steps=steps,
+        trainer=trainer,
         snapshot_dir=snap_dir,
         snapshot_every=int(os.environ.get("SNAP_EVERY", "2")),
         resume="auto",

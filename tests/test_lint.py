@@ -103,6 +103,25 @@ AST_CASES = [
         def fwd(x, props):
             return x.astype(props.compute_dtype)
      """),
+    ("APX007", """
+        import jax
+
+        def train_step(params, batch):
+            return params
+
+        for lr in (0.1, 0.01):
+            step = jax.jit(train_step, donate_argnums=(0,))
+            step(lr, 2.0)
+     """, """
+        import jax
+
+        def train_step(params, batch):
+            return params
+
+        step = jax.jit(train_step, donate_argnums=(0,))
+        for lr in (0.1, 0.01):
+            step(lr, 2.0)
+     """),
 ]
 
 
@@ -165,6 +184,167 @@ def test_ast_global_statement_fires_apx003():
             return x
     """
     assert ast_ids(src) == ["APX003"]
+
+
+# ---------------------------------------------------------------------------
+# APX007: step re-jit in a loop / un-donated trainer.build call sites
+# ---------------------------------------------------------------------------
+
+def test_apx007_trainer_build_in_loop_fires():
+    src = """
+        from apex_tpu import trainer
+
+        def step(state, batch):
+            return state, 0.0
+
+        for depth in (1, 2, 4):
+            tr = trainer.build(step, None, None)
+    """
+    assert ast_ids(src) == ["APX007"]
+
+
+def test_apx007_trainer_build_outside_loop_is_silent():
+    src = """
+        from apex_tpu import trainer
+
+        def step(state, batch):
+            return state, 0.0
+
+        tr = trainer.build(step, None, None)
+        for i in range(4):
+            tr.step(None, None)
+    """
+    assert ast_ids(src) == []
+
+
+def test_apx007_donate_false_keyword_fires():
+    src = """
+        from apex_tpu import trainer
+
+        def step(state, batch):
+            return state, 0.0
+
+        tr = trainer.build(step, None, None, donate=False)
+    """
+    assert ast_ids(src) == ["APX007"]
+
+
+def test_apx007_donate_false_in_trainer_config_fires():
+    src = """
+        from apex_tpu import trainer
+
+        def step(state, batch):
+            return state, 0.0
+
+        tr = trainer.build(
+            step, None, None,
+            config=trainer.TrainerConfig(donate=False, in_flight=2))
+    """
+    assert ast_ids(src) == ["APX007"]
+
+
+def test_apx007_bare_build_import_with_donate_false_fires():
+    src = """
+        from apex_tpu.trainer import build
+
+        def step(state, batch):
+            return state, 0.0
+
+        tr = build(step, None, None, donate=False)
+    """
+    assert ast_ids(src) == ["APX007"]
+
+
+def test_apx007_donated_build_is_silent():
+    src = """
+        from apex_tpu import trainer
+
+        def step(state, batch):
+            return state, 0.0
+
+        tr = trainer.build(
+            step, None, None,
+            config=trainer.TrainerConfig(donate=True, in_flight=2))
+    """
+    assert ast_ids(src) == []
+
+
+def test_apx007_unrelated_builder_objects_are_silent():
+    # foreign .build() APIs (a protobuf Builder, etc.) must not fire —
+    # only dotted paths routing through a `trainer` component count
+    src = """
+        def make(msg_builder):
+            for i in range(3):
+                msg_builder.build(i)
+    """
+    assert ast_ids(src) == []
+
+
+def test_apx007_bare_build_in_loop_fires():
+    # `from apex_tpu.trainer import build` used in a loop is the same
+    # re-compile hazard as the dotted form
+    src = """
+        from apex_tpu.trainer import build
+
+        def step(state, batch):
+            return state, 0.0
+
+        for depth in (1, 2):
+            tr = build(step, None, None)
+    """
+    assert ast_ids(src) == ["APX007"]
+
+
+def test_apx007_foreign_dotted_build_in_loop_is_silent():
+    src = """
+        def make(msg_builder):
+            for i in range(3):
+                msg_builder.build(i)
+    """
+    assert ast_ids(src) == []
+
+
+def test_apx007_jit_in_while_loop_fires():
+    src = """
+        import jax
+
+        def helper(x):
+            return x
+
+        def run():
+            n = 0
+            while n < 3:
+                f = jax.jit(helper, donate_argnums=(0,))
+                n += 1
+    """
+    assert ast_ids(src) == ["APX007"]
+
+
+def test_apx007_jit_in_comprehension_is_silent():
+    # building a list of differently-configured jits is a legitimate
+    # pattern; comprehensions are not loop re-jits
+    src = """
+        import jax
+
+        def helper(x):
+            return x
+
+        fns = [jax.jit(helper, static_argnums=(i,)) for i in range(2)]
+    """
+    assert ast_ids(src) == []
+
+
+def test_apx007_suppression_honored(tmp_path):
+    bad = ("import jax\n"
+           "def helper(x):\n"
+           "    return x\n"
+           "for i in range(2):\n"
+           "    f = jax.jit(helper)"
+           "  # apexlint: disable=APX007 -- test fixture\n")
+    (tmp_path / "sup.py").write_text(bad)
+    active, suppressed = lint_run([str(tmp_path / "sup.py")], jaxpr=False)
+    assert not active
+    assert [f.rule_id for f in suppressed] == ["APX007"]
 
 
 # ---------------------------------------------------------------------------

@@ -587,6 +587,40 @@ def test_kill_and_resume_bitwise(tmp_path):
         assert la[s] == v, (s, la[s], v)
 
 
+def test_kill_and_resume_bitwise_through_trainer(tmp_path):
+    """The SIGKILL auto-resume bitwise guarantee re-run through
+    ``apex_tpu.trainer`` + ``resilient_loop(trainer=...)``: donation +
+    an in-flight dispatch window of 2 must not break the exit-75/resume
+    contract. The baseline is the HAND-BUILT uninterrupted run — so this
+    also pins trainer-built numerics to the pre-refactor step, not just
+    trainer-to-trainer consistency."""
+    out_a = tmp_path / "a.npz"
+    out_b = tmp_path / "b.npz"
+    _run_worker([6, tmp_path / "snap_a", out_a])     # hand-built, no kill
+
+    p = _run_worker([6, tmp_path / "snap_b", out_b],
+                    extra_env={"USE_TRAINER": "1",
+                               "APEX_TPU_FAULT": "step:3:kill"},
+                    check=False)
+    assert p.returncode in (-signal.SIGKILL, 128 + signal.SIGKILL), \
+        f"expected SIGKILL, got rc={p.returncode}\n{p.stderr}"
+    assert not out_b.exists()
+
+    _run_worker([6, tmp_path / "snap_b", out_b],
+                extra_env={"USE_TRAINER": "1", "SNAP_ASYNC": "1"})
+    a, b = np.load(out_a), np.load(out_b)
+    assert int(b["resumed_from"]) >= 0 and int(a["resumed_from"]) == -1
+    for key in a.files:
+        if key in ("losses", "resumed_from"):
+            continue
+        np.testing.assert_array_equal(a[key], b[key], err_msg=key)
+    la = {int(s): v for s, v in a["losses"]}
+    lb = {int(s): v for s, v in b["losses"]}
+    assert set(lb) == {2, 3, 4, 5}   # resumed from the step-2 snapshot
+    for s, v in lb.items():
+        assert la[s] == v, (s, la[s], v)
+
+
 def test_worker_uninterrupted_is_deterministic(tmp_path):
     """Foundation for the bitwise claim: two independent uninterrupted
     runs agree bit-for-bit (otherwise the kill test proves nothing)."""

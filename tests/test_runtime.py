@@ -69,6 +69,39 @@ def test_prefetch_loader_stats_counters():
     assert 0 <= st["starvations"] <= 12
 
 
+def test_prefetch_loader_device_put_staging():
+    import jax
+    loader = runtime.PrefetchLoader(
+        iter([np.ones((4,), np.float32) * i for i in range(6)]),
+        depth=2, device_put=True)
+    out = list(loader)
+    assert len(out) == 6
+    # staged batches are device-resident jax arrays, values intact
+    assert all(isinstance(b, jax.Array) for b in out)
+    np.testing.assert_array_equal(np.asarray(out[3]),
+                                  np.ones((4,), np.float32) * 3)
+    st = loader.stats()
+    assert st["put_s"] > 0.0
+
+
+def test_prefetch_loader_device_put_callable_and_span():
+    import jax
+    from apex_tpu import telemetry, trace
+    telemetry.enable()
+    trace.enable()
+    try:
+        telemetry.get_collector().clear()
+        loader = runtime.PrefetchLoader(
+            iter(range(4)), depth=2,
+            device_put=lambda x: jax.device_put(np.float32(x)))
+        assert [float(b) for b in loader] == [0.0, 1.0, 2.0, 3.0]
+        rows = trace.span_rows(telemetry.get_collector().snapshot())
+        assert sum(r["name"] == "span/data/put" for r in rows) == 4
+    finally:
+        trace.disable()
+        telemetry.disable()
+
+
 def test_prefetch_loader_multiworker_complete():
     src = iter(range(50))
     loader = runtime.PrefetchLoader(src, depth=8, workers=3)

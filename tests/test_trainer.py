@@ -1,0 +1,680 @@
+"""apex_tpu.trainer — the compiled-step builder.
+
+The load-bearing blocks are the parity tests: (1) jaxpr equality pinning
+trainer-built steps to the pre-refactor hand-built train_lm/bench forms
+(the builder must inject NOTHING into the traced program), and (2)
+bitwise equality across dispatch modes (per_step / scan / unroll) and
+in-flight depths — pipelining moves WHERE the host blocks, never what
+the device computes. Plus the donation audit, the plugin seam, the
+PrefetchLoader device_put staging, and the resilient_loop integration.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu  # noqa: F401  (jax shims)
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import telemetry, trace, trainer
+from apex_tpu.trainer import (DonationReport, InflightWindow, Trainer,
+                              TrainerConfig, build, stack_batches)
+
+
+def _mesh():
+    return Mesh(np.asarray(jax.devices()).reshape(-1), ("data",))
+
+
+REP = P()
+
+
+# a train_lm-shaped per-device step: params + opt state carried, tokens
+# sharded over the mesh axis, grads pmean'd — small but structurally
+# faithful (collective inside, multi-tree carry)
+def per_device(params, opt, tokens, rng, mult):
+    def loss_fn(p):
+        return jnp.mean(p["w"][tokens].sum(-1)) * mult
+    loss = loss_fn(params)
+    g = jax.lax.pmean(jax.grad(loss_fn)(params), "data")
+    new_p = jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, params, g)
+    return new_p, opt + 1.0, jax.lax.pmean(loss, "data")
+
+
+def tstep(state, batch):
+    params, opt = state
+    tokens, rng, mult = batch
+    p, o, loss = per_device(params, opt, tokens, rng, mult)
+    return (p, o), loss
+
+
+def _state():
+    return ({"w": jnp.arange(64.0).reshape(16, 4) / 64.0},
+            jnp.zeros((3,)))
+
+
+def _batch(i=0):
+    tokens = jnp.asarray(
+        np.random.default_rng([11, i]).integers(0, 16, (8, 2)), jnp.int32)
+    return (tokens, jnp.zeros((2,), jnp.uint32), jnp.float32(1.0))
+
+
+BATCH_SPEC = (P("data"), REP, REP)
+
+
+def _build(config=None, plugins=(), state=None, batch=None):
+    return build(tstep, state or _state(), batch or _batch(),
+                 mesh=_mesh(), state_spec=REP, batch_spec=BATCH_SPEC,
+                 config=config, plugins=plugins)
+
+
+def _leaves(tree):
+    return [np.asarray(l) for l in jax.tree_util.tree_leaves(tree)]
+
+
+def _assert_tree_equal(a, b):
+    for x, y in zip(_leaves(a), _leaves(b)):
+        np.testing.assert_array_equal(x, y)
+
+
+# ---------------------------------------------------------------------------
+# jaxpr parity: trainer-built == pre-refactor hand-built
+# ---------------------------------------------------------------------------
+
+def test_per_step_jaxpr_identical_to_hand_built_train_lm_form():
+    """The train_lm pattern before this PR: jit(shard_map(per_device,
+    ...), donate_argnums=(0, 1)) over FIVE positional args. The trainer
+    builds from the (state, batch) wrapper — the flattened jaxprs must
+    be IDENTICAL (tuple repacking is structure, not computation)."""
+    mesh = _mesh()
+    hand = shard_map(per_device, mesh=mesh,
+                     in_specs=(REP, REP, P("data"), REP, REP),
+                     out_specs=(REP, REP, REP), check_vma=False)
+    tr = _build()
+    (params, opt), (tokens, rng, mult) = _state(), _batch()
+    j_hand = str(jax.make_jaxpr(hand)(params, opt, tokens, rng, mult))
+    j_tr = str(jax.make_jaxpr(tr.traced_fn)((params, opt),
+                                            (tokens, rng, mult)))
+    assert j_hand == j_tr
+
+
+def test_scan_shared_jaxpr_identical_to_hand_built_bench_form():
+    """The bench pattern before this PR: a hand-rolled lax.scan of k
+    steps over one shared batch inside shard_map, returning losses[-1].
+    trainer mode="scan", batch_mode="shared" must trace the same
+    program."""
+    mesh = _mesh()
+    k = 3
+
+    def multi_step(params, opt, batch):
+        def body(carry, _):
+            p, o = carry
+            tokens, rng, mult = batch
+            p, o, loss = per_device(p, o, tokens, rng, mult)
+            return (p, o), loss
+        (params, opt), losses = jax.lax.scan(
+            body, (params, opt), None, length=k)
+        return params, opt, losses[-1]
+
+    hand = shard_map(multi_step, mesh=mesh,
+                     in_specs=(REP, REP, BATCH_SPEC),
+                     out_specs=(REP, REP, REP), check_vma=False)
+    tr = _build(TrainerConfig(mode="scan", steps_per_call=k,
+                              batch_mode="shared"))
+    (params, opt), batch = _state(), _batch()
+    j_hand = str(jax.make_jaxpr(hand)(params, opt, batch))
+    j_tr = str(jax.make_jaxpr(tr.traced_fn)((params, opt), batch))
+    assert j_hand == j_tr
+
+
+def test_per_step_bitwise_identical_to_hand_built():
+    tr = _build()
+    state_h = _state()
+    hand = jax.jit(shard_map(
+        per_device, mesh=_mesh(),
+        in_specs=(REP, REP, P("data"), REP, REP),
+        out_specs=(REP, REP, REP), check_vma=False))
+    state_t = _state()
+    for i in range(4):
+        tokens, rng, mult = _batch(i)
+        p, o, loss_h = hand(state_h[0], state_h[1], tokens, rng, mult)
+        state_h = (p, o)
+        state_t, loss_t = tr.step(state_t, (tokens, rng, mult))
+    tr.drain()
+    _assert_tree_equal(state_h, state_t)
+    np.testing.assert_array_equal(np.asarray(loss_h), np.asarray(loss_t))
+
+
+# ---------------------------------------------------------------------------
+# mode parity: per_step == scan == unroll, bitwise
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", ["scan", "unroll"])
+def test_mode_bitwise_parity_stacked(mode):
+    k = 4
+    batches = [_batch(i) for i in range(k)]
+
+    ref = _build(TrainerConfig(in_flight=1))
+    state = _state()
+    for b in batches:
+        state, loss_ref = ref.step(state, b)
+    ref.drain()
+
+    stacked = stack_batches(batches)
+    tr = build(tstep, _state(), stacked, mesh=_mesh(), state_spec=REP,
+               batch_spec=(P(None, "data"), REP, REP),
+               config=TrainerConfig(mode=mode, steps_per_call=k,
+                                    in_flight=1))
+    assert tr.steps_per_call == k
+    state_k, loss_k = tr.step(_state(), stacked)
+    tr.drain()
+    _assert_tree_equal(state, state_k)
+    # scan/unroll return the LAST step's aux (the bench convention)
+    np.testing.assert_array_equal(np.asarray(loss_ref),
+                                  np.asarray(loss_k))
+
+
+def test_stacked_batch_length_mismatch_refused():
+    """A stacked batch whose leading dim disagrees with steps_per_call
+    would run a different number of steps than the trainer accounts
+    for — refused loudly at build (the audit's trace) instead of
+    silently desyncing snapshot step numbers."""
+    stacked8 = stack_batches([_batch(i) for i in range(8)])
+    with pytest.raises(ValueError, match="steps_per_call=4"):
+        build(tstep, _state(), stacked8, mesh=_mesh(), state_spec=REP,
+              batch_spec=(P(None, "data"), REP, REP),
+              config=TrainerConfig(mode="scan", steps_per_call=4,
+                                   in_flight=1))
+    with pytest.raises(ValueError, match="leading dim"):
+        build(tstep, _state(), stacked8,
+              config=TrainerConfig(mode="unroll", steps_per_call=4,
+                                   in_flight=1))
+
+
+def test_donation_report_records_compile_seconds():
+    rep = _build().donation
+    assert rep.compile_s >= 0.0
+    assert "compile_s" in rep.to_json()
+
+
+def test_call_fn_exposes_wrapped_dispatch():
+    telemetry.enable()
+    try:
+        plug = trainer.TelemetryPlugin(sync_every=1)
+        tr = _build(TrainerConfig(in_flight=1), plugins=[plug])
+        # the A/B baseline handle: the instrumented callable, outside
+        # the window
+        assert tr.call_fn is plug.instrument
+        state, aux = tr.call_fn(_state(), _batch())
+        jax.block_until_ready(aux)
+    finally:
+        telemetry.disable()
+    k, b = 3, _batch(7)
+    ref = _build(TrainerConfig(in_flight=1))
+    state = _state()
+    for _ in range(k):
+        state, _ = ref.step(state, b)
+    ref.drain()
+    tr = _build(TrainerConfig(mode="scan", steps_per_call=k,
+                              batch_mode="shared", in_flight=1))
+    state_k, _ = tr.step(_state(), b)
+    tr.drain()
+    _assert_tree_equal(state, state_k)
+
+
+# ---------------------------------------------------------------------------
+# dispatch pipelining: bitwise at every depth, deferred delivery
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("depth", [1, 2, 4])
+def test_in_flight_depth_is_bitwise_inert(depth):
+    ref_state = _state()
+    ref = _build(TrainerConfig(in_flight=1))
+    for i in range(6):
+        ref_state, _ = ref.step(ref_state, _batch(i))
+    ref.drain()
+
+    tr = _build(TrainerConfig(in_flight=depth))
+    state = _state()
+    for i in range(6):
+        state, _ = tr.step(state, _batch(i))
+    tr.drain()
+    _assert_tree_equal(ref_state, state)
+
+
+def test_window_defers_delivery_and_preserves_order():
+    tr = _build(TrainerConfig(in_flight=3))
+    seen = []
+    tr.add_on_step(lambda i, aux: seen.append(i))
+    state = _state()
+    for i in range(5):
+        state, _ = tr.step(state, _batch(i))
+    # depth 3: after 5 dispatches only the first 3 retirements happened
+    # (each push retires down to depth-1=2 pending)
+    assert seen == [0, 1, 2]
+    assert tr.pipeline_stats()["pending"] == 2
+    tr.drain()
+    assert seen == [0, 1, 2, 3, 4]
+    assert tr.pipeline_stats()["pending"] == 0
+    assert tr.pipeline_stats()["retired"] == 5
+
+
+def test_inflight_window_unit():
+    w = InflightWindow(2)
+    assert w.push(0, jnp.float32(0)) == []
+    assert [i for i, _ in w.push(1, jnp.float32(1))] == [0]
+    assert [i for i, _ in w.push(2, jnp.float32(2))] == [1]
+    assert [i for i, _ in w.drain()] == [2]
+    assert len(w) == 0 and w.retired == 3
+
+
+# ---------------------------------------------------------------------------
+# donation audit
+# ---------------------------------------------------------------------------
+
+def test_donation_audit_all_aliased():
+    tr = _build()
+    rep = tr.donation
+    assert isinstance(rep, DonationReport)
+    assert rep.declared == len(jax.tree_util.tree_leaves(_state()))
+    assert rep.aliased == rep.declared
+    assert rep.refused == () and rep.ok
+    assert "0 refused" in rep.summary()
+    assert rep.to_json()["ok"] is True
+
+
+def test_donation_audit_reports_refusal_loudly():
+    # a carried leaf that changes dtype across the step cannot alias —
+    # XLA refuses it and the audit must both record and warn
+    def bad(state, batch):
+        return {"w": (state["w"] + jnp.mean(batch)).astype(jnp.bfloat16),
+                "v": state["v"] * 2.0}, jnp.mean(batch)
+
+    s = {"w": jnp.ones((4,)), "v": jnp.zeros((2,))}
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        tr = build(bad, s, jnp.ones((3,)))
+    rep = tr.donation
+    assert not rep.ok and len(rep.refused) == 1
+    assert "float32[4]" in rep.refused[0]
+    assert any("donation audit" in str(w.message) for w in caught)
+
+
+def test_donation_audit_counts_dead_code_drops():
+    def dropper(state, batch):
+        # 'unused' is read by nothing and its output slot is a fresh
+        # constant: XLA dead-code-eliminates the parameter — a DROP
+        # (nothing double-buffers), not a refusal
+        return {"w": state["w"] + jnp.mean(batch),
+                "unused": jnp.zeros((7,))}, jnp.mean(batch)
+
+    s = {"w": jnp.ones((4,)), "unused": jnp.zeros((7,))}
+    rep = build(dropper, s, jnp.ones((3,))).donation
+    assert rep.ok and rep.refused == ()
+    assert rep.declared == 2
+    assert rep.aliased == 1 and rep.dropped == 1
+    assert "dead-code-dropped" in rep.summary()
+
+
+def test_donation_off_skips_audit():
+    tr = _build(TrainerConfig(donate=False))
+    assert tr.donation is None
+
+
+def test_donation_audit_emits_telemetry_static():
+    telemetry.enable()
+    try:
+        telemetry.get_collector().clear()
+        _build()
+        evs = [e for e in telemetry.get_collector().snapshot()
+               if e.name == "trainer/donation_refused"]
+        assert len(evs) == 1 and evs[0].value == 0.0
+        assert evs[0].meta["ok"] is True
+    finally:
+        telemetry.disable()
+
+
+# ---------------------------------------------------------------------------
+# config validation
+# ---------------------------------------------------------------------------
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="mode"):
+        TrainerConfig(mode="bogus")
+    with pytest.raises(ValueError, match="batch_mode"):
+        TrainerConfig(batch_mode="bogus")
+    with pytest.raises(ValueError, match="in_flight"):
+        TrainerConfig(in_flight=0)
+    with pytest.raises(ValueError, match="steps_per_call"):
+        TrainerConfig(mode="scan", steps_per_call=0)
+
+
+# ---------------------------------------------------------------------------
+# plugin seam
+# ---------------------------------------------------------------------------
+
+class _Recorder:
+    def __init__(self):
+        self.built = 0
+        self.steps = []
+        self.resumes = []
+
+    def on_build(self, tr):
+        self.built += 1
+
+    def on_step(self, i, aux):
+        self.steps.append(i)
+
+    def on_resume(self, tr, step):
+        self.resumes.append(step)
+
+
+def test_plugin_hooks_fire_exactly_once_per_event():
+    rec = _Recorder()
+    tr = _build(TrainerConfig(in_flight=1), plugins=[rec])
+    assert rec.built == 1
+    state = _state()
+    for i in range(3):
+        state, _ = tr.step(state, _batch(i))
+    tr.drain()
+    assert rec.steps == [0, 1, 2]
+    tr.notify_resume(10)
+    assert rec.resumes == [10]
+    assert tr.step_index == 10
+
+
+def test_telemetry_plugin_instruments_dispatch():
+    telemetry.enable()
+    try:
+        telemetry.get_collector().clear()
+        plug = trainer.TelemetryPlugin(examples_per_step=8.0,
+                                       sync_every=1)
+        tr = _build(TrainerConfig(in_flight=1), plugins=[plug])
+        state = _state()
+        for i in range(3):
+            state, _ = tr.step(state, _batch(i))
+        tr.drain()
+        jax.effects_barrier()
+        names = {e.name for e in telemetry.get_collector().snapshot()}
+        assert {"step/time_s", "step/dispatch_s", "step/device_wait_s",
+                "step/examples_per_s", "trainer/in_flight"} <= names
+    finally:
+        telemetry.disable()
+
+
+def test_telemetry_plugin_sync_every_defaults_to_window_depth():
+    telemetry.enable()
+    try:
+        telemetry.get_collector().clear()
+        plug = trainer.TelemetryPlugin()
+        _build(TrainerConfig(in_flight=3), plugins=[plug])
+        assert plug.instrument.sync_every == 3
+        ev = telemetry.get_collector().last("trainer/in_flight")
+        assert ev is not None and ev.value == 3.0
+        assert ev.meta["sync_every"] == 3
+    finally:
+        telemetry.disable()
+
+
+def test_amp_and_tune_plugins_record_statics():
+    telemetry.enable()
+    try:
+        telemetry.get_collector().clear()
+        _build(plugins=[trainer.AmpPlugin("O5"), trainer.TunePlugin()])
+        col = telemetry.get_collector()
+        amp_ev = col.last("trainer/amp_opt_level")
+        assert amp_ev is not None and amp_ev.value == 5.0
+        assert amp_ev.meta["opt_level"] == "O5"
+        tune_ev = col.last("trainer/tune_policy")
+        assert tune_ev is not None and tune_ev.meta["policy"] in (
+            "off", "cache", "auto")
+    finally:
+        telemetry.disable()
+
+
+def test_health_plugin_feeds_detector_from_retired_steps():
+    telemetry.enable()
+    try:
+        telemetry.get_collector().clear()
+        plug = trainer.HealthPlugin(loss_from_aux=float)
+        tr = _build(TrainerConfig(in_flight=2), plugins=[plug])
+        state = _state()
+        for i in range(4):
+            state, _ = tr.step(state, _batch(i))
+        tr.drain()
+        losses = [e for e in telemetry.get_collector().snapshot()
+                  if e.name == "train/loss"]
+        assert [e.step for e in losses] == [0, 1, 2, 3]
+    finally:
+        telemetry.disable()
+
+
+def test_health_plugin_gates_per_step_signals_on_window_depth():
+    """Under a pipelined window the collector's freshest health/*
+    emissions describe a LATER dispatch than the retired loss — the
+    plugin must consume them only at depth 1 (and warn once about the
+    dropped signals otherwise); loss-only rules keep running either
+    way."""
+    import io
+    telemetry.enable()
+    try:
+        out = io.StringIO()
+        plug = trainer.HealthPlugin(loss_from_aux=float, out=out)
+        _build(TrainerConfig(in_flight=1), plugins=[plug])
+        assert plug._synced
+
+        out2 = io.StringIO()
+        plug2 = trainer.HealthPlugin(loss_from_aux=float, out=out2,
+                                     overflow_total=lambda: 0.0)
+        tr = _build(TrainerConfig(in_flight=3), plugins=[plug2])
+        assert not plug2._synced
+        assert "loss-based rules" in out2.getvalue()   # warned at build
+        state = _state()
+        for i in range(3):
+            state, _ = tr.step(state, _batch(i))
+        tr.drain()
+        assert out2.getvalue().count("loss-based rules") == 1  # once
+    finally:
+        telemetry.disable()
+
+
+# ---------------------------------------------------------------------------
+# trainer/retire spans + reconciliation family contract
+# ---------------------------------------------------------------------------
+
+def test_retire_spans_emitted_and_balanced():
+    telemetry.enable()
+    trace.enable()
+    try:
+        telemetry.get_collector().clear()
+        tr = _build(TrainerConfig(in_flight=2))
+        state = _state()
+        for i in range(3):
+            state, _ = tr.step(state, _batch(i))
+        tr.drain()
+        rows = trace.span_rows(telemetry.get_collector().snapshot())
+        retire = [r for r in rows if r["name"] == "span/trainer/retire"]
+        assert len(retire) == 3
+        assert [r["step"] for r in retire] == [0, 1, 2]
+    finally:
+        trace.disable()
+        telemetry.disable()
+
+
+def test_retire_family_never_billed_as_host_overhead():
+    assert "trainer/retire" in trace.DEVICE_WAIT_FAMILIES
+    assert "data/put" in trace.CONCURRENT_FAMILIES
+
+
+# ---------------------------------------------------------------------------
+# Trainer.run + PrefetchLoader double-buffered IO
+# ---------------------------------------------------------------------------
+
+def test_run_over_prefetch_loader_with_device_put_staging():
+    from apex_tpu import runtime
+    telemetry.enable()
+    trace.enable()
+    try:
+        telemetry.get_collector().clear()
+        batches = [_batch(i) for i in range(5)]
+        loader = runtime.PrefetchLoader(
+            iter(batches), depth=2,
+            device_put=lambda b: (jax.device_put(b[0]), b[1], b[2]))
+        tr = _build(TrainerConfig(in_flight=2))
+        seen = []
+        state = tr.run(_state(), loader, steps=5,
+                       on_step=lambda i, aux: seen.append(i))
+        assert seen == [0, 1, 2, 3, 4]
+
+        ref = _build(TrainerConfig(in_flight=1))
+        ref_state = _state()
+        for b in batches:
+            ref_state, _ = ref.step(ref_state, b)
+        ref.drain()
+        _assert_tree_equal(ref_state, state)
+
+        stats = loader.stats()
+        assert stats["consumed"] == 5
+        assert stats["put_s"] > 0.0
+        rows = trace.span_rows(telemetry.get_collector().snapshot())
+        puts = [r for r in rows if r["name"] == "span/data/put"]
+        assert len(puts) == 5
+        loader.close()
+    finally:
+        trace.disable()
+        telemetry.disable()
+
+
+def test_prefetch_loader_put_s_zero_without_staging():
+    from apex_tpu import runtime
+    loader = runtime.PrefetchLoader(iter(range(3)))
+    assert list(loader) == [0, 1, 2]
+    assert loader.stats()["put_s"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# resilient_loop integration
+# ---------------------------------------------------------------------------
+
+def test_resilient_loop_through_trainer_snapshots_and_resumes(tmp_path):
+    from apex_tpu import resilience
+
+    def run(snap_dir, steps):
+        tr = _build(TrainerConfig(in_flight=2))
+        deliveries = []
+        result = resilience.resilient_loop(
+            None, _state(), _batch, steps=steps, trainer=tr,
+            snapshot_dir=str(snap_dir), snapshot_every=2, resume="auto",
+            on_step=lambda i, st, aux: deliveries.append(i))
+        return result, deliveries
+
+    res_a, deliv_a = run(tmp_path / "a", 6)
+    assert res_a.step == 6 and not res_a.preempted
+    assert deliv_a == [0, 1, 2, 3, 4, 5]
+
+    # uninterrupted vs stop-at-4-then-continue: bitwise equal
+    tr_b = _build(TrainerConfig(in_flight=2))
+    from apex_tpu import resilience as res
+    r1 = res.resilient_loop(None, _state(), _batch, steps=4,
+                            trainer=tr_b, snapshot_dir=str(tmp_path / "b"),
+                            snapshot_every=2, resume="auto")
+    tr_c = _build(TrainerConfig(in_flight=2))
+    r2 = res.resilient_loop(None, _state(), _batch, steps=6,
+                            trainer=tr_c, snapshot_dir=str(tmp_path / "b"),
+                            snapshot_every=2, resume="auto")
+    assert r2.resumed_from is not None
+    assert tr_c.step_index == 6
+    _assert_tree_equal(res_a.state, r2.state)
+
+
+def test_resilient_loop_requires_step_fn_or_trainer():
+    from apex_tpu import resilience
+    with pytest.raises(ValueError, match="step_fn is required"):
+        resilience.resilient_loop(None, _state(), _batch, steps=1)
+
+
+def test_resilient_loop_rejects_misaligned_scan_cadence(tmp_path):
+    """A scan trainer only surfaces dispatch-boundary step values: a
+    non-k-aligned snapshot cadence (or a step-targeted fault between
+    boundaries) would silently misfire — the loop must refuse loudly."""
+    from apex_tpu import resilience
+    from apex_tpu.resilience.faults import FaultInjector
+    k = 4
+    batches = [_batch(i) for i in range(k)]
+    stacked = stack_batches(batches)
+    tr = build(tstep, _state(), stacked, mesh=_mesh(), state_spec=REP,
+               batch_spec=(P(None, "data"), REP, REP),
+               config=TrainerConfig(mode="scan", steps_per_call=k,
+                                    in_flight=1))
+    with pytest.raises(ValueError, match="not a multiple"):
+        resilience.resilient_loop(
+            None, _state(), lambda i: stacked, steps=8, trainer=tr,
+            snapshot_dir=str(tmp_path / "s"), snapshot_every=3)
+    with pytest.raises(ValueError, match="never\\s+observes"):
+        resilience.resilient_loop(
+            None, _state(), lambda i: stacked, steps=8, trainer=tr,
+            injector=FaultInjector("nan_grad", step=3))
+    # aligned cadence + boundary-targeted fault are accepted
+    result = resilience.resilient_loop(
+        None, _state(), lambda i: stacked, steps=8, trainer=tr,
+        snapshot_dir=str(tmp_path / "ok"), snapshot_every=4,
+        injector=FaultInjector("nan_grad", step=4))
+    assert result.step == 8
+
+
+def test_resilient_loop_drains_before_preemption_save(tmp_path):
+    from apex_tpu import resilience
+    tr = _build(TrainerConfig(in_flight=4))
+    # deadline already expired: the loop must drain + final-snapshot and
+    # return the exit-75 contract without executing further steps
+    result = resilience.resilient_loop(
+        None, _state(), _batch, steps=50, trainer=tr,
+        snapshot_dir=str(tmp_path / "snap"), snapshot_every=0,
+        resume="none", deadline_s=0.0)
+    assert result.preempted and result.exit_code == 75
+    assert result.final_snapshot_ok
+    assert tr.pipeline_stats()["pending"] == 0
+
+
+# ---------------------------------------------------------------------------
+# builder misc
+# ---------------------------------------------------------------------------
+
+def test_build_without_mesh_plain_jit():
+    def pstep(s, b):
+        return jax.tree_util.tree_map(lambda a: a + jnp.mean(b), s), \
+            jnp.mean(b)
+    tr = build(pstep, {"w": jnp.ones((4,))}, jnp.ones((2,)))
+    st, aux = tr.step({"w": jnp.ones((4,))}, jnp.full((2,), 2.0))
+    tr.drain()
+    np.testing.assert_allclose(np.asarray(st["w"]), 3.0)
+    assert float(aux) == 2.0
+
+
+def test_stack_batches():
+    stacked = stack_batches([_batch(0), _batch(1)])
+    assert stacked[0].shape == (2, 8, 2)
+    assert stacked[1].shape == (2, 2)
+    np.testing.assert_array_equal(np.asarray(stacked[0][1]),
+                                  np.asarray(_batch(1)[0]))
+
+
+def test_build_accepts_avals():
+    (params, opt), batch = _state(), _batch()
+    avals = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+        ((params, opt), batch))
+    tr = build(tstep, avals[0], avals[1], mesh=_mesh(), state_spec=REP,
+               batch_spec=BATCH_SPEC)
+    assert tr.donation is not None and tr.donation.ok
+    state, _ = tr.step((params, opt), batch)
+    tr.drain()
