@@ -5,34 +5,110 @@ extension, apex/contrib/csrc/xentropy/xentropy_kernel.cu).
 
 The reference kernel's trick: forward returns (losses, max_log_sum_exp) so
 backward can rebuild the softmax as ``exp(logits - lse)`` without recomputing
-the max/sum reductions. The custom_vjp below keeps exactly that contract;
-XLA fuses the bwd expression into one pass over the logits.
+the max/sum reductions. The custom_vjp below keeps exactly that contract.
 
 loss_i = logsumexp(x_i) - (1-smoothing) * x_i[y_i] - smoothing * mean_k(x_i[k])
 grad_i = softmax(x_i) - (1-smoothing) * onehot(y_i) - smoothing / K
+
+Two execution paths, selected by :func:`backend`:
+
+  * **jnp** (the default): the plain math below; XLA fuses the bwd
+    expression into one pass over the logits. The default is provably
+    inert — compiled programs are bit-identical to the pre-Pallas build
+    (pinned by tests/test_kernels.py jaxpr equality).
+  * **pallas** (opt-in, ``APEX_TPU_XENT_BACKEND=pallas`` or
+    :func:`set_backend`): the ``ops/pallas_xent`` kernels — one K-blocked
+    online-logsumexp pass producing loss + saved lse, and a backward that
+    writes the gradient blockwise in the logits dtype so the full fp32
+    softmax is never materialized. Falls back to jnp when the vocab is
+    not lane-aligned (K % 128 != 0).
+
+``half_to_float`` mirrors the reference flag: False (default) returns the
+losses in the LOGITS dtype; True computes/returns them in fp32 even for
+low-precision logits. The backward always computes in fp32 (the incoming
+cotangent is upcast first) and returns cotangents in the logits' original
+dtype either way.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+_BACKENDS = ("jnp", "pallas")
+_FORCE = os.environ.get("APEX_TPU_XENT_BACKEND", "auto")  # auto|jnp|pallas
+_OVERRIDE: Optional[str] = None
+
+
+def set_backend(name: Optional[str] = None) -> Optional[str]:
+    """Process-level backend override (None restores the env/default).
+    Returns the previous override so callers can save/restore."""
+    global _OVERRIDE
+    if name is not None and name not in _BACKENDS:
+        raise ValueError(f"xentropy backend must be one of {_BACKENDS}, "
+                         f"got {name!r}")
+    prev = _OVERRIDE
+    _OVERRIDE = name
+    return prev
+
+
+def backend() -> str:
+    """The active execution path: ``set_backend`` override, else the
+    ``APEX_TPU_XENT_BACKEND`` env value; ``auto`` (the default) resolves
+    to ``jnp`` — XLA's fused plain math, bit-identical to the pre-kernel
+    build. An unrecognized env value raises (loud-failure doctrine: a
+    typo'd opt-in must not silently measure the unfused path)."""
+    b = _OVERRIDE if _OVERRIDE is not None else _FORCE
+    if b in _BACKENDS:
+        return b
+    if b in ("auto", ""):
+        return "jnp"
+    raise ValueError(f"APEX_TPU_XENT_BACKEND={b!r} — expected one of "
+                     f"{_BACKENDS} or 'auto'")
+
+
+def _use_pallas(logits) -> bool:
+    if backend() != "pallas":
+        return False
+    from apex_tpu.ops import pallas_xent
+    return pallas_xent.supported(logits.shape[-1])
+
+
+def _loss_out_dtype(logits_dtype, half_to_float: bool):
+    return jnp.float32 if half_to_float else jnp.dtype(logits_dtype)
+
+
+def _cast_loss(losses, logits_dtype, half_to_float: bool):
+    out = _loss_out_dtype(logits_dtype, half_to_float)
+    # python-level guard: fp32 logits (every shipped call site) trace the
+    # exact pre-fix program — no convert op is ever added for them
+    return losses if losses.dtype == out else losses.astype(out)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
 def softmax_cross_entropy_loss(logits: jax.Array, labels: jax.Array,
                                smoothing: float = 0.0,
                                half_to_float: bool = False) -> jax.Array:
-    """Per-example losses, shape (batch,). ``half_to_float`` mirrors the
-    reference flag: compute/return losses in fp32 even for low-prec logits
-    (always true here — TPU reductions want fp32 anyway)."""
+    """Per-example losses, shape ``logits.shape[:-1]``. ``half_to_float``
+    mirrors the reference flag: the losses come back in the logits dtype
+    unless it is set, in which case they stay fp32 (reductions on TPU
+    want fp32 — pass True for low-precision logits feeding a mean)."""
     losses, _ = _xent_fwd_impl(logits, labels, smoothing)
-    return losses
+    return _cast_loss(losses, logits.dtype, half_to_float)
 
 
 def _xent_fwd_impl(logits, labels, smoothing):
+    if _use_pallas(logits):
+        from apex_tpu.ops import pallas_xent
+        shp = logits.shape[:-1]
+        losses, lse = pallas_xent.xent_fwd(
+            logits.reshape(-1, logits.shape[-1]),
+            labels.reshape(-1), smoothing)
+        return losses.reshape(shp), lse.reshape(shp)
     x = logits.astype(jnp.float32)
     mx = jnp.max(x, axis=-1, keepdims=True)
     lse = jnp.log(jnp.sum(jnp.exp(x - mx), axis=-1, keepdims=True)) + mx
@@ -44,18 +120,29 @@ def _xent_fwd_impl(logits, labels, smoothing):
 
 def _xent_fwd(logits, labels, smoothing, half_to_float):
     losses, lse = _xent_fwd_impl(logits, labels, smoothing)
-    return losses, (logits, labels, lse)
+    return (_cast_loss(losses, logits.dtype, half_to_float),
+            (logits, labels, lse))
 
 
 def _xent_bwd(smoothing, half_to_float, res, g):
     logits, labels, lse = res
     k = logits.shape[-1]
+    # the cotangent arrives in the LOSS dtype (logits dtype unless
+    # half_to_float) — upcast before the fp32 softmax math so a bf16 g
+    # cannot poison the rebuild
+    g32 = g if g.dtype == jnp.float32 else g.astype(jnp.float32)
+    if _use_pallas(logits):
+        from apex_tpu.ops import pallas_xent
+        dx = pallas_xent.xent_bwd(
+            logits.reshape(-1, k), labels.reshape(-1),
+            lse.reshape(-1), g32.reshape(-1), smoothing)
+        return dx.reshape(logits.shape), None
     x = logits.astype(jnp.float32)
     # softmax rebuilt from the saved max_log_sum_exp (no re-reduction)
     probs = jnp.exp(x - lse[..., None])
     onehot = jax.nn.one_hot(labels, k, dtype=jnp.float32)
     grad = probs - (1.0 - smoothing) * onehot - smoothing / k
-    grad = grad * g[..., None]
+    grad = grad * g32[..., None]
     return grad.astype(logits.dtype), None
 
 
@@ -65,12 +152,15 @@ softmax_cross_entropy_loss.defvjp(_xent_fwd, _xent_bwd)
 class SoftmaxCrossEntropyLoss:
     """Class shim matching the reference module surface."""
 
-    def __init__(self, smoothing: float = 0.0, reduction: str = "mean"):
+    def __init__(self, smoothing: float = 0.0, reduction: str = "mean",
+                 half_to_float: bool = False):
         self.smoothing = smoothing
         self.reduction = reduction
+        self.half_to_float = half_to_float
 
     def __call__(self, logits, labels):
-        losses = softmax_cross_entropy_loss(logits, labels, self.smoothing)
+        losses = softmax_cross_entropy_loss(logits, labels, self.smoothing,
+                                            self.half_to_float)
         if self.reduction == "mean":
             return jnp.mean(losses)
         if self.reduction == "sum":

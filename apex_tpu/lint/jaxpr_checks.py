@@ -470,10 +470,59 @@ def builtin_entries() -> List[EntrySpec]:
                           check_vma=False)
         return f, (p, p, st)
 
+    def conv_epilogue_fwd_bwd():
+        from apex_tpu.ops import conv_epilogue as ce
+        x = jnp.ones((4, 4, 4, 256), jnp.bfloat16)
+        res = jnp.ones((4, 4, 4, 256), jnp.bfloat16)
+        scale = jnp.ones((256,), jnp.float32)
+        shift = jnp.zeros((256,), jnp.float32)
+
+        def fwd_bwd(x, res):
+            def loss(x, res):
+                y = ce.bn_relu_apply(x, scale, shift, residual=res)
+                return jnp.sum(y.astype(jnp.float32))
+            return jax.grad(loss, argnums=(0, 1))(x, res)
+        return fwd_bwd, (x, res)
+
+    def xentropy_fwd_bwd():
+        from apex_tpu.ops import pallas_xent as px
+        logits = jnp.ones((64, 512), jnp.bfloat16)
+        labels = jnp.zeros((64,), jnp.int32)
+
+        def fwd_bwd(lg):
+            losses, lse = px.xent_fwd(lg, labels, 0.1)
+            dx = px.xent_bwd(lg, labels, lse,
+                             jnp.ones_like(losses), 0.1)
+            return losses, dx
+        return fwd_bwd, (logits,)
+
+    def mt_flat_adam():
+        from apex_tpu import optimizers
+        from apex_tpu.ops import multi_tensor as mt
+        opt = optimizers.FusedAdam(lr=1e-3)
+        p = {"w": jnp.ones((16, 128)), "b": jnp.ones((128,))}
+        st = opt.init(p)
+
+        def step(g, p, s):
+            # trace-time backend override, restored before anything else
+            # in this process traces
+            prev = mt.set_backend("flat")
+            try:
+                return opt.step(g, p, s)
+            finally:
+                mt.set_backend(prev)
+        return step, (p, p, st)
+
     root = _repo_root()
     entries = [
         EntrySpec("gpt_tiny_fwd_loss@O5", "apex_tpu/models/gpt.py",
                   gpt_o5, opt_level="O5"),
+        EntrySpec("fused_conv_epilogue", "apex_tpu/ops/conv_epilogue.py",
+                  conv_epilogue_fwd_bwd),
+        EntrySpec("fused_xentropy", "apex_tpu/ops/pallas_xent.py",
+                  xentropy_fwd_bwd),
+        EntrySpec("mt_flat_adam_step", "apex_tpu/ops/multi_tensor.py",
+                  mt_flat_adam),
         EntrySpec("fused_adam_step", "apex_tpu/optimizers/fused.py",
                   fused_adam),
         EntrySpec("ddp_syncbn_grads", "apex_tpu/parallel/distributed.py",

@@ -25,16 +25,31 @@ class ResNetBlock(nn.Module):
     norm: ModuleDef
     strides: Tuple[int, int] = (1, 1)
     dtype: Any = jnp.float32
+    # Opt-in fused conv epilogue: each conv's BN+ReLU (and the exit's
+    # BN+residual-add+ReLU) runs as ONE Pallas pass instead of separate
+    # memory-bound passes (ops/conv_epilogue.py). False (default) keeps
+    # the exact pre-kernel op sequence.
+    fused_epilogue: bool = False
 
     @nn.compact
     def __call__(self, x):
         residual = x
         y = nn.Conv(self.filters, (3, 3), self.strides, padding=[(1, 1), (1, 1)],
                     use_bias=False, dtype=self.dtype)(x)
-        y = self.norm()(y)
-        y = nn.relu(y)
+        if self.fused_epilogue:
+            y = self.norm()(y, relu=True)
+        else:
+            y = self.norm()(y)
+            y = nn.relu(y)
         y = nn.Conv(self.filters, (3, 3), padding=[(1, 1), (1, 1)],
                     use_bias=False, dtype=self.dtype)(y)
+        if self.fused_epilogue:
+            if residual.shape != y.shape:
+                residual = nn.Conv(self.filters, (1, 1), self.strides,
+                                   use_bias=False, dtype=self.dtype)(residual)
+                residual = self.norm(name="norm_proj")(residual)
+            return self.norm(scale_init=nn.initializers.zeros)(
+                y, residual=residual, relu=True)
         y = self.norm(scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
             residual = nn.Conv(self.filters, (1, 1), self.strides,
@@ -48,21 +63,35 @@ class BottleneckBlock(nn.Module):
     norm: ModuleDef
     strides: Tuple[int, int] = (1, 1)
     dtype: Any = jnp.float32
+    fused_epilogue: bool = False    # see ResNetBlock
 
     @nn.compact
     def __call__(self, x):
         residual = x
         y = nn.Conv(self.filters, (1, 1), use_bias=False,
                     dtype=self.dtype)(x)
-        y = self.norm()(y)
-        y = nn.relu(y)
+        if self.fused_epilogue:
+            y = self.norm()(y, relu=True)
+        else:
+            y = self.norm()(y)
+            y = nn.relu(y)
         y = nn.Conv(self.filters, (3, 3), self.strides,
                     padding=[(1, 1), (1, 1)], use_bias=False,
                     dtype=self.dtype)(y)
-        y = self.norm()(y)
-        y = nn.relu(y)
+        if self.fused_epilogue:
+            y = self.norm()(y, relu=True)
+        else:
+            y = self.norm()(y)
+            y = nn.relu(y)
         y = nn.Conv(self.filters * 4, (1, 1), use_bias=False,
                     dtype=self.dtype)(y)
+        if self.fused_epilogue:
+            if residual.shape != y.shape:
+                residual = nn.Conv(self.filters * 4, (1, 1), self.strides,
+                                   use_bias=False, dtype=self.dtype)(residual)
+                residual = self.norm(name="norm_proj")(residual)
+            return self.norm(scale_init=nn.initializers.zeros)(
+                y, residual=residual, relu=True)
         y = self.norm(scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
             residual = nn.Conv(self.filters * 4, (1, 1), self.strides,
@@ -108,6 +137,10 @@ class ResNet(nn.Module):
     # stem — input space-to-depth (2x2 blocks) + an equivalent 4x4/1 conv
     # (see conv7_to_s2d_kernel for the exact weight correspondence).
     stem: str = "conv7"
+    # Opt-in fused Pallas conv epilogue (BN+ReLU, and BN+residual+ReLU on
+    # block exits) — ops/conv_epilogue.py; threaded to every block and
+    # the stem BN. False (default) traces the exact pre-kernel program.
+    fused_epilogue: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -122,7 +155,8 @@ class ResNet(nn.Module):
             return SyncBatchNorm(
                 momentum=self.bn_momentum, axis_name=self.axis_name,
                 use_running_average=not train, dtype=self.dtype,
-                scale_init=scale_init, name=name)
+                scale_init=scale_init, name=name,
+                fused_epilogue=self.fused_epilogue)
 
         # jax.named_scope annotations ride into XLA op metadata, so
         # profiler traces (pyprof.capture) attribute kernels to stages
@@ -141,8 +175,11 @@ class ResNet(nn.Module):
             else:
                 raise ValueError(f"stem must be 'conv7' or "
                                  f"'space_to_depth', got {self.stem!r}")
-            x = norm_def(name="bn_init")(x)
-            x = nn.relu(x)
+            if self.fused_epilogue:
+                x = norm_def(name="bn_init")(x, relu=True)
+            else:
+                x = norm_def(name="bn_init")(x)
+                x = nn.relu(x)
             x = nn.max_pool(x, (3, 3), strides=(2, 2),
                             padding=((1, 1), (1, 1)))
         for i, block_size in enumerate(self.stage_sizes):
@@ -151,7 +188,8 @@ class ResNet(nn.Module):
                 with jax.named_scope(f"stage{i + 1}/block{j}"):
                     x = self.block_cls(
                         self.num_filters * 2 ** i, norm=norm_def,
-                        strides=strides, dtype=self.dtype)(x)
+                        strides=strides, dtype=self.dtype,
+                        fused_epilogue=self.fused_epilogue)(x)
         with jax.named_scope("head"):
             x = jnp.mean(x, axis=(1, 2))
             x = nn.Dense(self.num_classes, dtype=self.dtype,

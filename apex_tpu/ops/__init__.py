@@ -11,6 +11,7 @@ from apex_tpu.ops.buckets import (
     tree_unflatten_buckets,
 )
 from apex_tpu.ops.staged_vjp import apply_staged, cotangent_transform
+from apex_tpu.ops.conv_epilogue import bn_relu_apply
 from apex_tpu.ops.multi_tensor import (
     multi_tensor_scale,
     multi_tensor_axpby,

@@ -1,0 +1,270 @@
+"""Pallas TPU fused conv epilogue — BatchNorm scale/shift + ReLU (and the
+residual add on block exits) folded into ONE pass over the conv output.
+
+The reference fuses this chain on the CUDA side as ``apex.contrib.groupbn``
+(bn_fwd_nhwc / bn_addrelu kernels over cudnn's BN workspace); on TPU the
+analogous memory-bound chain is the separate normalize / relu / add HBM
+passes trailing every conv. This kernel applies
+
+    y = relu(x * scale + shift [+ residual])
+
+with per-channel fp32 ``scale = gamma * rsqrt(var + eps)`` and
+``shift = beta - mean * scale`` computed OUTSIDE the kernel in plain JAX
+(they are O(C) vectors; autodiff through them carries the batch-stat
+dependence on ``x``, so the custom_vjp below only owns the elementwise
+apply — the math stays exactly BatchNorm's).
+
+Layout: the (..., C) activation is viewed as (rows, C) when C is
+lane-aligned, or — for narrow stems like C=64 — as (rows, 128) with the
+channel vectors tiled ``128 // C`` times (the per-channel affine is
+periodic in C, so a lane-tiled view is exact). The backward is one pass
+too: dx and the optional residual cotangent stream out blockwise while
+dscale/dshift accumulate across the sequential grid into (1, C) fp32
+outputs (the dgamma/dbeta reduction shape of the layer-norm kernels).
+
+Opt-in: ``models.ResNet*(fused_epilogue=True)`` /
+``SyncBatchNorm(fused_epilogue=True)``; the default path is untouched
+(jaxpr-equality pinned by tests/test_kernels.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from apex_tpu.ops._amp_guard import no_amp as _no_amp
+
+LANES = 128
+VMEM_BUDGET = 4 * 1024 * 1024  # per live (rows, d) f32 working array
+
+
+def _interpret() -> bool:
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def supported(c: int, n_elems: int) -> bool:
+    """True when the (rows, lanes) view exists: lane-aligned channels, or
+    a channel count that tiles the 128-lane row exactly (stem C=64)."""
+    if c % LANES == 0:
+        return True
+    return LANES % c == 0 and n_elems % LANES == 0
+
+
+def _rows_per_block(d: int, arrays: int = 3) -> int:
+    """Row-block height for ``arrays`` live (rows, d) f32 working arrays
+    (x, y, residual) within the VMEM budget."""
+    rows = max(8, min(1024, VMEM_BUDGET // (4 * d * arrays)))
+    return (rows // 8) * 8
+
+
+def _resolve_rows(d: int, dtype, rows: Optional[int]) -> int:
+    if rows is not None:
+        return int(rows)
+    from apex_tpu import tune
+    return tune.conv_epilogue_rows(c=d, dtype=dtype)
+
+
+def _as2d(x: jax.Array, scale: jax.Array, shift: jax.Array):
+    """(x2, scale2, shift2): the lane-aligned 2-D view plus matching
+    (possibly lane-tiled) fp32 channel vectors."""
+    c = x.shape[-1]
+    if c % LANES == 0:
+        d = c
+        x2 = x.reshape(-1, d)
+        s2 = scale.astype(jnp.float32)
+        b2 = shift.astype(jnp.float32)
+    else:
+        rep = LANES // c
+        d = LANES
+        x2 = x.reshape(-1, d)
+        s2 = jnp.tile(scale.astype(jnp.float32), rep)
+        b2 = jnp.tile(shift.astype(jnp.float32), rep)
+    return x2, s2, b2, d
+
+
+# -- kernels ----------------------------------------------------------------
+
+def _epi_fwd_kernel(relu, has_res, x_ref, s_ref, b_ref, *rest):
+    if has_res:
+        r_ref, y_ref = rest
+    else:
+        (y_ref,) = rest
+    y = x_ref[:].astype(jnp.float32) * s_ref[:] + b_ref[:]
+    if has_res:
+        y = y + r_ref[:].astype(jnp.float32)
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    y_ref[:] = y.astype(y_ref.dtype)
+
+
+def _epi_bwd_kernel(relu, has_res, g_ref, y_ref, x_ref, s_ref, *out_refs):
+    if has_res:
+        dx_ref, dr_ref, ds_ref, db_ref = out_refs
+    else:
+        dx_ref, ds_ref, db_ref = out_refs
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        ds_ref[:] = jnp.zeros_like(ds_ref)
+        db_ref[:] = jnp.zeros_like(db_ref)
+
+    g = g_ref[:].astype(jnp.float32)
+    if relu:
+        # the saved OUTPUT is the relu mask (y > 0 <=> pre-relu > 0)
+        g = g * (y_ref[:] > 0).astype(jnp.float32)
+    dx_ref[:] = (g * s_ref[:]).astype(dx_ref.dtype)
+    if has_res:
+        dr_ref[:] = g.astype(dr_ref.dtype)
+    ds_ref[:] += jnp.sum(g * x_ref[:].astype(jnp.float32), axis=0,
+                         keepdims=True)
+    db_ref[:] += jnp.sum(g, axis=0, keepdims=True)
+
+
+def _pad_rows(a: jax.Array, padded: int) -> jax.Array:
+    n = a.shape[0]
+    return a if padded == n else jnp.pad(a, ((0, padded - n), (0, 0)))
+
+
+@_no_amp
+def _epi_fwd_call(x2, s2, b2, r2, relu, rows, out_dtype):
+    # Row padding (at most rows-1 dead rows, rows clamped to the minimal
+    # 8-aligned length) is load-bearing for the BACKWARD's cross-row
+    # dscale/dshift reductions — Mosaic reads past the array end are
+    # undefined, so a partial last block could corrupt the accumulators.
+    # The pad does copy the operand (the ln_fwd precedent); row blocks
+    # are tune-picked, so pick `rows` dividing the workload to avoid it.
+    n, d = x2.shape
+    rows = max(8, min(rows, ((n + 7) // 8) * 8))
+    padded = ((n + rows - 1) // rows) * rows
+    has_res = r2 is not None
+    operands = [_pad_rows(x2, padded), s2.reshape(1, d), b2.reshape(1, d)]
+    if has_res:
+        operands.append(_pad_rows(r2, padded))
+    blk = lambda: pl.BlockSpec((rows, d), lambda i: (i, 0))
+    vec = lambda: pl.BlockSpec((1, d), lambda i: (0, 0))
+    y2 = pl.pallas_call(
+        functools.partial(_epi_fwd_kernel, bool(relu), has_res),
+        grid=(padded // rows,),
+        in_specs=[blk(), vec(), vec()] + ([blk()] if has_res else []),
+        out_specs=blk(),
+        out_shape=jax.ShapeDtypeStruct((padded, d), out_dtype),
+        interpret=_interpret(),
+    )(*operands)
+    return y2[:n]
+
+
+@_no_amp
+def _epi_bwd_call(g2, y2, x2, s2, res_dtype, relu, rows):
+    n, d = x2.shape
+    rows = max(8, min(rows, ((n + 7) // 8) * 8))
+    padded = ((n + rows - 1) // rows) * rows
+    has_res = res_dtype is not None
+    blk = lambda dt: pl.BlockSpec((rows, d), lambda i: (i, 0))
+    vec = lambda: pl.BlockSpec((1, d), lambda i: (0, 0))
+    out_specs = [pl.BlockSpec((rows, d), lambda i: (i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((padded, d), x2.dtype)]
+    if has_res:
+        out_specs.append(pl.BlockSpec((rows, d), lambda i: (i, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((padded, d), res_dtype))
+    out_specs += [vec(), vec()]
+    out_shape += [jax.ShapeDtypeStruct((1, d), jnp.float32),
+                  jax.ShapeDtypeStruct((1, d), jnp.float32)]
+    outs = pl.pallas_call(
+        functools.partial(_epi_bwd_kernel, bool(relu), has_res),
+        grid=(padded // rows,),
+        in_specs=[blk(None), blk(None), blk(None), vec()],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=_interpret(),
+        # zero cotangent on the padded rows: their dx/accumulator
+        # contribution vanishes
+    )(_pad_rows(g2, padded), _pad_rows(y2, padded), _pad_rows(x2, padded),
+      s2.reshape(1, d))
+    if has_res:
+        dx2, dr2, ds, db = outs
+        return dx2[:n], dr2[:n], ds.reshape(-1), db.reshape(-1)
+    dx2, ds, db = outs
+    return dx2[:n], None, ds.reshape(-1), db.reshape(-1)
+
+
+# -- custom_vjp over the 2-D apply ------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _apply2d(x2, s2, b2, relu, rows, out_dtype):
+    return _epi_fwd_call(x2, s2, b2, None, relu, rows, out_dtype)
+
+
+def _apply2d_fwd(x2, s2, b2, relu, rows, out_dtype):
+    y2 = _epi_fwd_call(x2, s2, b2, None, relu, rows, out_dtype)
+    return y2, (x2, s2, y2)
+
+
+def _apply2d_bwd(relu, rows, out_dtype, res, g2):
+    x2, s2, y2 = res
+    dx2, _, ds, db = _epi_bwd_call(g2, y2, x2, s2, None, relu, rows)
+    return dx2, ds, db
+
+
+_apply2d.defvjp(_apply2d_fwd, _apply2d_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _apply2d_res(x2, s2, b2, r2, relu, rows, out_dtype):
+    return _epi_fwd_call(x2, s2, b2, r2, relu, rows, out_dtype)
+
+
+def _apply2d_res_fwd(x2, s2, b2, r2, relu, rows, out_dtype):
+    y2 = _epi_fwd_call(x2, s2, b2, r2, relu, rows, out_dtype)
+    # zero-size marker carries the residual DTYPE to the backward (a bare
+    # dtype object is not a pytree leaf) — no residual data is saved
+    return y2, (x2, s2, y2, jnp.zeros((0,), r2.dtype))
+
+
+def _apply2d_res_bwd(relu, rows, out_dtype, res, g2):
+    x2, s2, y2, r_marker = res
+    dx2, dr2, ds, db = _epi_bwd_call(g2, y2, x2, s2, r_marker.dtype,
+                                     relu, rows)
+    return dx2, ds, db, dr2
+
+
+_apply2d_res.defvjp(_apply2d_res_fwd, _apply2d_res_bwd)
+
+
+# -- public entry -----------------------------------------------------------
+
+def bn_relu_apply(x: jax.Array, scale: jax.Array, shift: jax.Array,
+                  residual: Optional[jax.Array] = None, *,
+                  relu: bool = True, out_dtype=None,
+                  rows: Optional[int] = None) -> jax.Array:
+    """``relu(x * scale + shift [+ residual])`` in one Pallas pass.
+
+    ``x``: (..., C) conv output; ``scale``/``shift``: (C,) fp32 effective
+    BatchNorm coefficients; ``residual``: same shape as ``x``. The fp32
+    in-kernel result is written in ``out_dtype`` (default ``x.dtype``) —
+    pass a wider dtype to keep the full normalize precision instead of
+    rounding through the input dtype. ``rows`` resolves through
+    ``apex_tpu.tune`` when None (explicit values win). Differentiable
+    via a one-pass custom_vjp backward producing dx, d(residual), and
+    the per-channel dscale/dshift reductions.
+    """
+    c = x.shape[-1]
+    if not supported(c, x.size):
+        raise ValueError(
+            f"fused conv epilogue needs C % {LANES} == 0 or a row-tiling "
+            f"channel count (128 % C == 0, lane-aligned total); got "
+            f"C={c}, {x.size} elements")
+    out_dtype = jnp.dtype(x.dtype if out_dtype is None else out_dtype)
+    x2, s2, b2, d = _as2d(x, scale, shift)
+    rows = _resolve_rows(d, x.dtype, rows)
+    with jax.named_scope("apex_conv_epilogue"):
+        if residual is None:
+            y2 = _apply2d(x2, s2, b2, bool(relu), rows, out_dtype)
+        else:
+            y2 = _apply2d_res(x2, s2, b2, residual.reshape(x2.shape),
+                              bool(relu), rows, out_dtype)
+    return y2.reshape(x.shape)

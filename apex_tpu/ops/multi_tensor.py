@@ -2,16 +2,23 @@
 reference's ``amp_C`` extension (csrc/amp_C_frontend.cpp:115-136 and the
 ``csrc/multi_tensor_*`` kernels).
 
-Two execution paths, selected by :func:`use_pallas`:
+Three execution paths, selected by :func:`backend`
+(``APEX_TPU_MT_BACKEND`` / :func:`set_backend` / the ``mt_apply`` tune
+sweep under ``auto``):
 
   * **jnp path** (the default everywhere): pure ``jax.numpy`` tree maps.
     Under ``jit`` XLA fuses the whole-model elementwise update into a few
     fusions, which captures what multi_tensor_apply buys on CUDA (batching
     thousands of tiny kernels, csrc/multi_tensor_apply.cuh:12) *without* any
     marshalling.
-  * **Pallas path** (opt-in, ``APEX_TPU_MT_BACKEND=pallas``): parameters are
-    packed into flat per-dtype buckets (ops/buckets.py) and a single Pallas
-    kernel per bucket performs the update, mirroring the reference's chunked
+  * **flat path** (``APEX_TPU_MT_BACKEND=flat``): the whole tree packs into
+    ONE flat bucket per dtype group (ops/buckets.py) and the update applies
+    as O(1) fused jnp ops over the flat buffers — multi-tensor BATCHING
+    without hand-written kernels, collapsing a 593-leaf step's per-leaf op
+    soup into a handful of big fusions. Covers the hot ops (scale, adam,
+    sgd); the rest degrade to jnp.
+  * **Pallas path** (``APEX_TPU_MT_BACKEND=pallas``): the same buckets fed
+    to a single Pallas kernel per bucket, mirroring the reference's chunked
     launches (csrc/multi_tensor_apply.cuh:41-142).
 
 The default is **jnp on TPU too**, by measurement: on a v5e chip over a
@@ -53,7 +60,15 @@ Tree = Any
 # Dispatch control
 # ---------------------------------------------------------------------------
 
-_FORCE = os.environ.get("APEX_TPU_MT_BACKEND", "auto")  # auto | jnp | pallas
+# auto | jnp | flat | pallas. "flat" is the multi-tensor BATCHING path:
+# the whole tree flattens into one bucket per dtype group and the update
+# applies as O(1) fused jnp ops over the flat buffers (instead of one
+# fused op per leaf) — the marshalling of the Pallas path without its
+# kernels. "auto" resolves through apex_tpu.tune's mt_apply sweep (off
+# policy: "jnp", the measured default).
+_FORCE = os.environ.get("APEX_TPU_MT_BACKEND", "auto")
+_BACKEND_NAMES = ("jnp", "flat", "pallas")
+_OVERRIDE: Optional[str] = None
 
 # Backends whose devices are TPU chips. "axon" is a PJRT tunnel to a TPU.
 _TPU_BACKENDS = ("tpu", "axon")
@@ -63,21 +78,70 @@ def on_tpu() -> bool:
     return jax.default_backend() in _TPU_BACKENDS
 
 
-def use_pallas(*trees: Tree) -> bool:
-    """True when the fused Pallas bucket kernels should be used for ``trees``.
+def set_backend(name: Optional[str] = None) -> Optional[str]:
+    """Process-level backend override (None restores the env/default).
+    Returns the previous override so callers can save/restore — the
+    mt_apply sweep runner and the lint entries trace under it."""
+    global _OVERRIDE
+    if name is not None and name not in _BACKEND_NAMES:
+        raise ValueError(f"mt backend must be one of {_BACKEND_NAMES}, "
+                         f"got {name!r}")
+    prev = _OVERRIDE
+    _OVERRIDE = name
+    return prev
 
-    Default **False** (measured: XLA fusion wins on TPU — see module
-    docstring); ``APEX_TPU_MT_BACKEND=pallas`` forces the bucket kernels on.
-    fp16 always takes the jnp path: Mosaic (the Pallas TPU compiler) has no
-    f16 type, while plain XLA handles f16 storage fine.
+
+def backend(*trees: Tree) -> str:
+    """The execution backend for a multi-tensor op over ``trees``:
+    ``set_backend`` override, else ``APEX_TPU_MT_BACKEND``, else (auto)
+    the ``mt_apply`` tune resolution — which under the default ``off``
+    policy returns the frozen ``"jnp"`` (measured: XLA fusion wins on
+    TPU — see module docstring), keeping default programs bit-identical.
+
+    fp16 demotes ``pallas`` to ``jnp``: Mosaic (the Pallas TPU compiler)
+    has no f16 type, while plain XLA handles f16 storage fine.
     """
-    if _FORCE != "pallas":
-        return False
-    for t in trees:
-        for l in jax.tree_util.tree_leaves(t):
-            if l.dtype == jnp.float16:
-                return False
-    return True
+    b = _OVERRIDE if _OVERRIDE is not None else _FORCE
+    if b not in _BACKEND_NAMES:
+        if b not in ("auto", ""):
+            # loud-failure doctrine: a typo'd env value must not
+            # silently measure-under-auto or quietly skip the kernels
+            raise ValueError(
+                f"APEX_TPU_MT_BACKEND={b!r} — expected one of "
+                f"{_BACKEND_NAMES} or 'auto'")
+        from apex_tpu import tune
+        leaves = [l for t in trees for l in jax.tree_util.tree_leaves(t)]
+        total = sum(int(l.size) for l in leaves) or 1
+        dtype = leaves[0].dtype if leaves else jnp.float32
+        b = tune.mt_apply_backend(n=total, dtype=dtype)
+    if b == "pallas":
+        for t in trees:
+            for l in jax.tree_util.tree_leaves(t):
+                if l.dtype == jnp.float16:
+                    return "jnp"
+    return b
+
+
+def use_pallas(*trees: Tree) -> bool:
+    """True when the fused Pallas bucket kernels should be used for
+    ``trees`` (see :func:`backend`)."""
+    return backend(*trees) == "pallas"
+
+
+def _flat_map(trees, fn, out_spec_idx):
+    """Whole-tree flat-buffer application: pack each tree's leaves into
+    ONE flat bucket per dtype-signature group (the ops/pallas_mt
+    marshalling), apply ``fn`` to the flat operands — a single fused
+    elementwise update per group instead of one per leaf — and unflatten.
+    ``out_spec_idx[o]`` names the input tree whose layout unflattens
+    output ``o``."""
+    from apex_tpu.ops import pallas_mt
+
+    def runner(flats, specs, idxs):
+        out = fn(*flats)
+        return out if isinstance(out, tuple) else (out,)
+
+    return pallas_mt._run_grouped(trees, runner, out_spec_idx)
 
 
 def _nonfinite(x: jax.Array) -> jax.Array:
@@ -106,13 +170,33 @@ def multi_tensor_scale(tree: Tree, scale: jax.Array) -> Tuple[Tree, jax.Array]:
     (apex/amp/scaler.py:103-128).
     Returns ``(scaled_tree, overflow)``.
     """
-    if use_pallas(tree):
+    b = backend(tree)
+    if b == "pallas":
         from apex_tpu.ops import pallas_mt
         return pallas_mt.scale_tree(tree, scale)
+    if b == "flat":
+        return _scale_tree_flat(tree, scale)
     overflow = _tree_overflow(tree)
     out = jax.tree_util.tree_map(
         lambda x: (x.astype(jnp.float32) * scale).astype(x.dtype), tree)
     return out, overflow
+
+
+def _scale_tree_flat(tree: Tree, scale) -> Tuple[Tree, jax.Array]:
+    """Flat-bucket scale + nonfinite detect: ONE fused multiply and ONE
+    isfinite reduction per dtype group, whatever the leaf count."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    groups = _buckets.group_by_dtype(leaves)
+    out_leaves = [None] * len(leaves)
+    overflow = jnp.asarray(False)
+    with jax.named_scope("apex_mt_apply"):
+        for _, idxs in groups.items():
+            flat, spec = _buckets.flatten_tensors([leaves[i] for i in idxs])
+            overflow = jnp.logical_or(overflow, _nonfinite(flat))
+            y = (flat.astype(jnp.float32) * scale).astype(flat.dtype)
+            for i, t in zip(idxs, _buckets.unflatten_tensors(y, spec)):
+                out_leaves[i] = t
+    return jax.tree_util.tree_unflatten(treedef, out_leaves), overflow
 
 
 def multi_tensor_axpby(a: jax.Array, x: Tree, b: jax.Array, y: Tree,
@@ -179,7 +263,8 @@ def multi_tensor_adam(
         bc2 = jnp.asarray(1.0, jnp.float32)
     inv_scale = (1.0 / grad_scale) if grad_scale is not None else None
 
-    if use_pallas(grads, params):
+    b = backend(grads, params)
+    if b == "pallas":
         from apex_tpu.ops import pallas_mt
         return pallas_mt.adam_tree(
             grads, params, exp_avg, exp_avg_sq,
@@ -201,6 +286,13 @@ def multi_tensor_adam(
             update = update + weight_decay * p32
         p32 = p32 - lr * update
         return p32.astype(p.dtype), m32.astype(m.dtype), v32.astype(v.dtype)
+
+    if b == "flat":
+        # the SAME elementwise update applied once per flat dtype-group
+        # bucket — O(1) fused ops for the whole tree
+        with jax.named_scope("apex_mt_apply"):
+            return _flat_map([grads, params, exp_avg, exp_avg_sq], upd,
+                             (1, 2, 3))
 
     out = jax.tree_util.tree_map(
         lambda g, p, m, v: upd(g, p, m, v), grads, params, exp_avg, exp_avg_sq)
@@ -235,7 +327,8 @@ def multi_tensor_sgd(
         momentum_buf = jax.tree_util.tree_map(
             lambda g: jnp.zeros_like(g, dtype=jnp.float32), grads)
 
-    if use_pallas(grads, params, momentum_buf):
+    b = backend(grads, params, momentum_buf)
+    if b == "pallas":
         from apex_tpu.ops import pallas_mt
         return pallas_mt.sgd_tree(
             grads, params, momentum_buf, lr=lr, weight_decay=weight_decay,
@@ -260,6 +353,19 @@ def multi_tensor_sgd(
             d = d + weight_decay * p32
         p32 = p32 - lr * d
         return p32.astype(p.dtype), m32.astype(m.dtype)
+
+    if b == "flat":
+        with jax.named_scope("apex_mt_apply"):
+            if model_out_template is not None:
+                # fused low-precision model copy off the flat master
+                # update (the reference kernel's 4-list variant)
+                def upd4(g, p, m, t):
+                    p2, m2 = upd(g, p, m)
+                    return p2, m2, p2.astype(t.dtype)
+                return _flat_map(
+                    [grads, params, momentum_buf, model_out_template],
+                    upd4, (1, 2, 3))
+            return _flat_map([grads, params, momentum_buf], upd, (1, 2))
 
     out = jax.tree_util.tree_map(upd, grads, params, momentum_buf)
     new_p = jax.tree_util.tree_map(lambda t: t[0], out,

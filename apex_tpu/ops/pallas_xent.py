@@ -1,0 +1,227 @@
+"""Pallas TPU fused softmax-cross-entropy kernels — the counterpart of the
+reference ``xentropy_cuda`` extension (apex/contrib/csrc/xentropy/
+xentropy_kernel.cu: one-pass fused logsumexp + picked-logit forward saving
+``max_log_sum_exp``, and a backward that rebuilds the softmax from the saved
+statistic without re-reducing).
+
+Layout: logits viewed as (rows, K); the grid is (row_blocks, k_blocks) with
+the K axis innermost, so each row block streams its vocabulary in VMEM-sized
+chunks with an online (max, sum) update — the flash-attention logsumexp
+recurrence applied to the loss head. One pass produces per-example losses
+AND the saved lse; the backward emits ``(softmax - target) * g`` blockwise,
+writing straight in the logits dtype so the full fp32 softmax is NEVER
+materialized in HBM (at 128k rows x 32k vocab that array alone is ~17 GB).
+
+Constraints: K must be a multiple of 128 (lane width); other widths fall
+back to the jnp implementation in ``apex_tpu/contrib/xentropy.py`` (which is
+also the default — the Pallas path is opt-in via
+``APEX_TPU_XENT_BACKEND=pallas``, see contrib/xentropy.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops._amp_guard import no_amp as _no_amp
+
+LANES = 128
+VMEM_BUDGET = 4 * 1024 * 1024  # per live (rows, block_k) f32 working array
+
+
+def _interpret() -> bool:
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def supported(k: int) -> bool:
+    """The kernel path needs the vocab to be lane-aligned."""
+    return k % LANES == 0
+
+
+def _pick_block_k(k: int, pref: int) -> int:
+    """Largest 128-multiple DIVISOR of ``k`` that is <= ``pref``. The K
+    grid must tile the vocab exactly (no masking pass per block); 128
+    always qualifies because callers guarantee ``supported(k)``."""
+    pref = max(LANES, min(int(pref), k))
+    for cand in range(pref - pref % LANES, LANES - 1, -LANES):
+        if k % cand == 0:
+            return cand
+    return LANES
+
+
+def _rows_per_block(bk: int, arrays: int = 1) -> int:
+    """Row-block height for ``arrays`` live (rows, bk) f32 working arrays
+    within the VMEM budget (same arithmetic as the layer-norm kernels)."""
+    rows = max(8, min(1024, VMEM_BUDGET // (4 * bk * arrays)))
+    return (rows // 8) * 8
+
+
+def _clamp_rows(rows: int, n: int) -> int:
+    """Never pad the row axis past the minimal 8-aligned length (a 127-row
+    batch under a 1024-row preference would compute 8x dead rows)."""
+    return max(8, min(rows, ((n + 7) // 8) * 8))
+
+
+def _resolve(op: str, k: int, dtype, rows: Optional[int],
+             block_k: Optional[int]) -> Tuple[int, int]:
+    if rows is not None and block_k is not None:
+        return int(rows), int(block_k)
+    from apex_tpu import tune
+    t_rows, t_bk = tune.xentropy_blocks(op, k=k, dtype=dtype)
+    return (int(rows) if rows is not None else t_rows,
+            int(block_k) if block_k is not None else t_bk)
+
+
+# -- forward ----------------------------------------------------------------
+
+def _xent_fwd_kernel(smoothing, kdim, x_ref, lab_ref, loss_ref, lse_ref,
+                     m_ref, s_ref, pick_ref, ksum_ref):
+    k = pl.program_id(1)
+    nk = pl.num_programs(1)
+
+    @pl.when(k == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        s_ref[:] = jnp.zeros_like(s_ref)
+        pick_ref[:] = jnp.zeros_like(pick_ref)
+        ksum_ref[:] = jnp.zeros_like(ksum_ref)
+
+    x = x_ref[:].astype(jnp.float32)                    # (rows, bk)
+    bm = jnp.max(x, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_ref[:], bm)
+    # online logsumexp: rescale the running sum to the new max
+    s_ref[:] = s_ref[:] * jnp.exp(m_ref[:] - m_new) \
+        + jnp.sum(jnp.exp(x - m_new), axis=1, keepdims=True)
+    m_ref[:] = m_new
+    cols = k * x.shape[1] + jax.lax.broadcasted_iota(
+        jnp.int32, x.shape, 1)
+    onehot = (cols == lab_ref[:]).astype(jnp.float32)
+    pick_ref[:] += jnp.sum(x * onehot, axis=1, keepdims=True)
+    if smoothing:                                       # static python float
+        ksum_ref[:] += jnp.sum(x, axis=1, keepdims=True)
+
+    @pl.when(k == nk - 1)
+    def _fin():
+        lse = jnp.log(s_ref[:]) + m_ref[:]
+        loss = lse - (1.0 - smoothing) * pick_ref[:]
+        if smoothing:
+            loss = loss - smoothing * (ksum_ref[:] / kdim)
+        loss_ref[:] = loss
+        lse_ref[:] = lse
+
+
+@_no_amp
+def xent_fwd(logits2d: jax.Array, labels: jax.Array, smoothing: float = 0.0,
+             *, rows: Optional[int] = None, block_k: Optional[int] = None,
+             ) -> Tuple[jax.Array, jax.Array]:
+    """One-pass fused loss forward on (n, K) logits + (n,) int labels.
+
+    Returns ``(losses, lse)``, both fp32 (n,) — the ``max_log_sum_exp``
+    save contract of the reference kernel. ``rows``/``block_k`` resolve
+    through ``apex_tpu.tune`` when None (explicit values win).
+    """
+    n, k = logits2d.shape
+    if not supported(k):
+        raise ValueError(f"fused xentropy needs K % {LANES} == 0, got {k}")
+    rows, block_k = _resolve("xentropy_fwd", k, logits2d.dtype,
+                             rows, block_k)
+    bk = _pick_block_k(k, block_k)
+    rows = _clamp_rows(rows, n)
+    padded = ((n + rows - 1) // rows) * rows
+    lab2 = labels.astype(jnp.int32).reshape(n, 1)
+    if padded != n:
+        # at most rows-1 dead rows, but jnp.pad copies the operand —
+        # Mosaic reads past the array end are undefined, so the pad is
+        # the safe route (ln_fwd precedent); row-aligned workloads (or a
+        # tune-picked `rows` dividing n) skip it entirely
+        logits2d = jnp.pad(logits2d, ((0, padded - n), (0, 0)))
+        lab2 = jnp.pad(lab2, ((0, padded - n), (0, 0)))
+    grid = (padded // rows, k // bk)
+    with jax.named_scope("apex_xentropy"):
+        losses, lse = pl.pallas_call(
+            functools.partial(_xent_fwd_kernel, float(smoothing), float(k)),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((rows, bk), lambda i, j: (i, j)),
+                pl.BlockSpec((rows, 1), lambda i, j: (i, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((rows, 1), lambda i, j: (i, 0)),
+                pl.BlockSpec((rows, 1), lambda i, j: (i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((padded, 1), jnp.float32),
+                jax.ShapeDtypeStruct((padded, 1), jnp.float32),
+            ],
+            scratch_shapes=[pltpu.VMEM((rows, 1), jnp.float32)
+                            for _ in range(4)],
+            interpret=_interpret(),
+        )(logits2d, lab2)
+    return losses[:n, 0], lse[:n, 0]
+
+
+# -- backward ---------------------------------------------------------------
+
+def _xent_bwd_kernel(smoothing, inv_k, x_ref, lab_ref, lse_ref, g_ref,
+                     dx_ref):
+    k = pl.program_id(1)
+    x = x_ref[:].astype(jnp.float32)
+    # softmax rebuilt from the saved max_log_sum_exp — no re-reduction
+    probs = jnp.exp(x - lse_ref[:])
+    cols = k * x.shape[1] + jax.lax.broadcasted_iota(
+        jnp.int32, x.shape, 1)
+    onehot = (cols == lab_ref[:]).astype(jnp.float32)
+    grad = probs - (1.0 - smoothing) * onehot
+    if smoothing:
+        grad = grad - smoothing * inv_k
+    dx_ref[:] = (grad * g_ref[:]).astype(dx_ref.dtype)
+
+
+@_no_amp
+def xent_bwd(logits2d: jax.Array, labels: jax.Array, lse: jax.Array,
+             g: jax.Array, smoothing: float = 0.0, *,
+             rows: Optional[int] = None, block_k: Optional[int] = None,
+             ) -> jax.Array:
+    """Blockwise ``(softmax - target) * g`` from the saved ``lse``.
+
+    ``g`` is the per-example loss cotangent (n,). The gradient is written
+    directly in the logits dtype, block by block — the fp32 softmax never
+    exists as a whole array.
+    """
+    n, k = logits2d.shape
+    if not supported(k):
+        raise ValueError(f"fused xentropy needs K % {LANES} == 0, got {k}")
+    rows, block_k = _resolve("xentropy_bwd", k, logits2d.dtype,
+                             rows, block_k)
+    bk = _pick_block_k(k, block_k)
+    rows = _clamp_rows(rows, n)
+    padded = ((n + rows - 1) // rows) * rows
+    lab2 = labels.astype(jnp.int32).reshape(n, 1)
+    lse2 = lse.astype(jnp.float32).reshape(n, 1)
+    g2 = g.astype(jnp.float32).reshape(n, 1)
+    if padded != n:
+        logits2d = jnp.pad(logits2d, ((0, padded - n), (0, 0)))
+        lab2 = jnp.pad(lab2, ((0, padded - n), (0, 0)))
+        lse2 = jnp.pad(lse2, ((0, padded - n), (0, 0)))
+        g2 = jnp.pad(g2, ((0, padded - n), (0, 0)))   # zero g: zero dx rows
+    grid = (padded // rows, k // bk)
+    with jax.named_scope("apex_xentropy"):
+        dx = pl.pallas_call(
+            functools.partial(_xent_bwd_kernel, float(smoothing), 1.0 / k),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((rows, bk), lambda i, j: (i, j)),
+                pl.BlockSpec((rows, 1), lambda i, j: (i, 0)),
+                pl.BlockSpec((rows, 1), lambda i, j: (i, 0)),
+                pl.BlockSpec((rows, 1), lambda i, j: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((rows, bk), lambda i, j: (i, j)),
+            out_shape=jax.ShapeDtypeStruct((padded, k), logits2d.dtype),
+            interpret=_interpret(),
+        )(logits2d, lab2, lse2, g2)
+    return dx[:n]

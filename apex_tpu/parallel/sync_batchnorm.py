@@ -90,9 +90,18 @@ class SyncBatchNorm(nn.Module):
     dtype: Any = jnp.float32
     scale_init: Callable = nn.initializers.ones
     bias_init: Callable = nn.initializers.zeros
+    # Opt-in Pallas epilogue: apply the normalize + affine (+ residual
+    # add + ReLU, via the call kwargs) as ONE fused pass over x
+    # (ops/conv_epilogue.py — the groupbn bn_fwd/bn_addrelu analog).
+    # The stats math above the apply is unchanged; with the flag False
+    # (default) the module traces bit-identically to the pre-kernel
+    # build (pinned by tests/test_kernels.py).
+    fused_epilogue: bool = False
 
     @nn.compact
-    def __call__(self, x, use_running_average: Optional[bool] = None):
+    def __call__(self, x, use_running_average: Optional[bool] = None,
+                 *, residual: Optional[jax.Array] = None,
+                 relu: bool = False):
         use_ra = nn.merge_param(
             "use_running_average", self.use_running_average,
             use_running_average)
@@ -122,6 +131,29 @@ class SyncBatchNorm(nn.Module):
                 ra_mean.value = (1 - m) * ra_mean.value + m * mean
                 ra_var.value = (1 - m) * ra_var.value + m * unbiased
 
+        from apex_tpu.ops import conv_epilogue as _conv_epilogue
+        if (self.fused_epilogue and not self.is_initializing()
+                and _conv_epilogue.supported(features, x.size)):
+            # effective per-channel coefficients: the O(C) plain-JAX
+            # vectors carry the batch-stat dependence on x for autodiff;
+            # the kernel's custom_vjp owns only the elementwise apply
+            rstd = jax.lax.rsqrt(var + self.eps)
+            if self.affine:
+                scale = self.param("scale", self.scale_init,
+                                   (features,), jnp.float32)
+                bias = self.param("bias", self.bias_init,
+                                  (features,), jnp.float32)
+                eff_scale = scale * rstd
+                eff_shift = bias - mean * eff_scale
+            else:
+                eff_scale = rstd
+                eff_shift = -mean * rstd
+            # the kernel writes self.dtype DIRECTLY off its fp32 result —
+            # a wider module dtype is not rounded through x.dtype first
+            return _conv_epilogue.bn_relu_apply(
+                x, eff_scale, eff_shift, residual=residual, relu=relu,
+                out_dtype=self.dtype)
+
         y = (x.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + self.eps)
         if self.affine:
             scale = self.param("scale", self.scale_init,
@@ -129,7 +161,15 @@ class SyncBatchNorm(nn.Module):
             bias = self.param("bias", self.bias_init,
                               (features,), jnp.float32)
             y = y * scale + bias
-        return y.astype(self.dtype)
+        y = y.astype(self.dtype)
+        # unfused composition of the epilogue kwargs (the fused path's
+        # off-switch twin; a no-op — and an unchanged program — when the
+        # kwargs are left at their defaults)
+        if residual is not None:
+            y = residual + y
+        if relu:
+            y = nn.relu(y)
+        return y
 
 
 def convert_syncbn_model(module: nn.Module, *, axis_name: str = "data",

@@ -121,6 +121,46 @@ def mt_block_rows(*, n: int, dtype: Any) -> int:
                        dtype)
 
 
+def conv_epilogue_rows(*, c: int, dtype: Any) -> int:
+    """Row-block height for the fused conv-epilogue (BN+ReLU+residual)
+    kernel at lane width ``c``."""
+    key = {"c": int(c), "dtype": _dtype_name(dtype)}
+    cfg, _ = resolve("conv_epilogue", key)
+    return _rows_valid(cfg.get("rows"),
+                       heuristics.conv_epilogue(key)["rows"], dtype)
+
+
+def xentropy_blocks(op: str, *, k: int, dtype: Any) -> Tuple[int, int]:
+    """(rows, block_k) for ``xentropy_fwd`` / ``xentropy_bwd`` at vocab
+    ``k``. ``block_k`` is a PREFERENCE — the kernel clamps it to a
+    128-multiple divisor of the real vocab (the cache key is
+    shape-bucketed, so a stored block need not divide every served k)."""
+    key = {"k": shape_bucket(k), "dtype": _dtype_name(dtype)}
+    cfg, _ = resolve(op, key)
+    heur = (heuristics.xentropy_bwd(key) if op == "xentropy_bwd"
+            else heuristics.xentropy_fwd(key))
+    rows = _rows_valid(cfg.get("rows"), heur["rows"], dtype)
+    try:
+        bk = int(cfg["block_k"])
+    except (KeyError, TypeError, ValueError):
+        bk = heur["block_k"]
+    if bk < 128 or bk % 128:
+        bk = heur["block_k"]
+    return rows, bk
+
+
+def mt_apply_backend(*, n: int, dtype: Any) -> str:
+    """Execution backend for the whole-tree multi-tensor optimizer apply:
+    ``jnp`` (per-leaf tree maps), ``flat`` (one flat bucket + one fused
+    update per dtype group), or ``pallas`` (the archived bucket kernels).
+    A cache entry outside that set degrades to the heuristic."""
+    cfg, _ = resolve("mt_apply", {"n": shape_bucket(n),
+                                  "dtype": _dtype_name(dtype)})
+    b = cfg.get("backend")
+    return b if b in ("jnp", "flat", "pallas") \
+        else heuristics.MT_APPLY_BACKEND
+
+
 def ddp_message_size(*, total: int, world: int) -> int:
     """Bucket capacity (elements) for the DDP gradient allreduce."""
     cfg, _ = resolve("ddp_message_size",

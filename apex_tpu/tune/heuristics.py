@@ -36,6 +36,18 @@ ATTENTION_BLOCK_K = 1024
 # Multi-tensor bucket kernels: rows per (rows, 128) grid block.
 MT_BLOCK_ROWS = 512
 
+# Multi-tensor APPLICATION backend for the fused-optimizer step: "jnp"
+# (per-leaf tree maps, XLA whole-graph fusion — the r3 measured winner on
+# v5e), "flat" (ONE flat bucket + one fused update per dtype group), or
+# "pallas" (the archived ops/pallas_mt bucket kernels). The mt_apply
+# sweep re-measures this choice per device generation.
+MT_APPLY_BACKEND = "jnp"
+
+# Fused softmax-cross-entropy K-axis block preference (elements of the
+# vocab streamed per grid step; the call site clamps to a 128-multiple
+# divisor of the actual vocab).
+XENT_BLOCK_K = 2048
+
 # Collective bucket granularity (elements per bucket).
 DDP_MESSAGE_SIZE = 2 ** 23
 ZERO_CHUNK_ELEMENTS = 2 ** 23
@@ -123,6 +135,29 @@ def moments(key: Dict) -> Dict:
 
 def mt_block(key: Dict) -> Dict:
     return {"block_rows": MT_BLOCK_ROWS}
+
+
+def mt_apply(key: Dict) -> Dict:
+    return {"backend": MT_APPLY_BACKEND}
+
+
+def conv_epilogue(key: Dict) -> Dict:
+    from apex_tpu.ops import conv_epilogue as _ce
+    return {"rows": _ce._rows_per_block(int(key["c"]))}
+
+
+def xentropy_fwd(key: Dict) -> Dict:
+    from apex_tpu.ops import pallas_xent as _px
+    bk = min(int(key["k"]), XENT_BLOCK_K)
+    return {"rows": _px._rows_per_block(bk), "block_k": XENT_BLOCK_K}
+
+
+def xentropy_bwd(key: Dict) -> Dict:
+    from apex_tpu.ops import pallas_xent as _px
+    # arrays=2: the backward keeps the logits block AND the dx block live
+    bk = min(int(key["k"]), XENT_BLOCK_K)
+    return {"rows": _px._rows_per_block(bk, arrays=2),
+            "block_k": XENT_BLOCK_K}
 
 
 def ddp_message_size(key: Dict) -> Dict:

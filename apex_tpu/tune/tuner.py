@@ -103,8 +103,13 @@ def _merge_config(heur: dict, stored: dict) -> Optional[dict]:
 
 def _emit(op: str, kstr: str, cfg: dict, prov: str, spec) -> None:
     from apex_tpu import telemetry
+    val = cfg.get(spec.primary, 0)
+    try:
+        val = float(val)
+    except (TypeError, ValueError):
+        val = 0.0   # non-numeric primary (mt_apply backend) — see meta
     telemetry.record_static(
-        f"tune/{op}", float(cfg.get(spec.primary, 0)),
+        f"tune/{op}", val,
         meta={"op": op, "key": kstr, "config": dict(cfg),
               "provenance": prov, "policy": policy()},
         dedup_key=(op, kstr, prov, tuple(sorted(cfg.items()))))

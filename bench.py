@@ -64,7 +64,9 @@ def log(*a):
 
 def main():
     from apex_tpu import amp, optimizers, parallel, models
+    from apex_tpu.contrib import xentropy as _xentropy
     from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+    from apex_tpu.ops import multi_tensor as _multi_tensor
 
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
@@ -123,9 +125,17 @@ def main():
     reduce_dtype = os.environ.get("BENCH_REDUCE_DTYPE") or None
     adasum = os.environ.get("BENCH_ADASUM", "").lower() in (
         "1", "true", "yes")
+    # Fused-kernel tier knobs (docs/kernels.md). BENCH_FUSED_EPILOGUE=1
+    # folds each conv's BN+ReLU (and the block exits' BN+residual+ReLU)
+    # into one Pallas pass (the 31.7% conv bucket's memory-bound tail);
+    # the optimizer/xentropy backends ride their own process-level env
+    # knobs (APEX_TPU_MT_BACKEND / APEX_TPU_XENT_BACKEND) and are
+    # recorded in the JSON either way so every row is attributable.
+    fused_epilogue = os.environ.get("BENCH_FUSED_EPILOGUE", "").lower() \
+        in ("1", "true", "yes")
     log(f"bench: resnet50 amp {opt_level} batch={batch} image={image} "
         f"on {dev} overlap={overlap_on} reduce_dtype={reduce_dtype} "
-        f"adasum={adasum}")
+        f"adasum={adasum} fused_epilogue={fused_epilogue}")
 
     mesh = parallel.make_mesh(axis_names=("data",))
     # dtype=bf16: convs/matmuls run bf16 on the MXU (flax BatchNorm still
@@ -139,7 +149,7 @@ def main():
     stem = ("space_to_depth" if os.environ.get("BENCH_STEM") == "s2d"
             else "conv7")
     model = models.ResNet50(num_classes=1000, dtype=compute_dtype,
-                            stem=stem)
+                            stem=stem, fused_epilogue=fused_epilogue)
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.ones((2, image, image, 3)), train=False)
     params32, batch_stats = variables["params"], variables["batch_stats"]
@@ -180,6 +190,20 @@ def main():
             n=n_total, dtype="float32")
         tune_cfg["attention_blocks"] = list(tune.attention_blocks(
             "attention_fwd", sq=4096, sk=4096, d=64, dtype="bfloat16"))
+        # fused-kernel provenance for the JSON — resolved inside the
+        # read-only peek so an auto policy can't trigger an mt_apply
+        # measurement for a key the step itself never resolves. The
+        # mt peek mirrors the OPTIMIZER apply's key: multi_tensor_sgd
+        # resolves backend(grads, params, momentum_buf) — three
+        # n_total-sized trees led by the bf16 grads — so three params
+        # trees land in the same (shape-bucket, dtype) cache cell the
+        # measured step hits (a params-only peek bucketed at n_total
+        # could name a different backend than the step ran).
+        kernels_cfg = {
+            "fused_epilogue": fused_epilogue,
+            "mt_backend": _multi_tensor.backend(params, params, params),
+            "xent_backend": _xentropy.backend(),
+        }
     finally:
         if bench_policy == "auto":
             tune.set_policy(bench_policy)
@@ -391,6 +415,9 @@ def main():
         "tune": tune_cfg,
         "overlap": {"enabled": overlap_on, "reduce_dtype": reduce_dtype,
                     "adasum": adasum},
+        # fused-kernel tier provenance (docs/kernels.md): which epilogue/
+        # optimizer/xentropy paths THIS row executed under
+        "kernels": kernels_cfg,
         # compiled-trainer provenance: dispatch mode, in-flight window,
         # and the construction-time donation audit of the step program
         # (null when BENCH_TRAINER=0 — rows stay schema-comparable)

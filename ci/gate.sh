@@ -12,7 +12,7 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "== 1/13 package import =="
+echo "== 1/14 package import =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import apex_tpu
@@ -20,7 +20,7 @@ from apex_tpu import amp, optimizers, parallel, ops
 print('apex_tpu imports OK')
 "
 
-echo "== 2/13 native host runtime builds (g++ -O3 -shared) =="
+echo "== 2/14 native host runtime builds (g++ -O3 -shared) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 from apex_tpu import runtime
@@ -35,7 +35,7 @@ print('flatten/unflatten path OK')
 assert ok, 'host runtime failed to build — check g++ toolchain'
 "
 
-echo "== 3/13 graft entry compiles (single-device + 8-device dryrun) =="
+echo "== 3/14 graft entry compiles (single-device + 8-device dryrun) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import __graft_entry__ as ge
@@ -45,7 +45,7 @@ print('entry() compiles')
 ge.dryrun_multichip(8)
 "
 
-echo "== 4/13 package install (wheel build + clean --target install) =="
+echo "== 4/14 package install (wheel build + clean --target install) =="
 # The reference gates on Docker extension builds
 # (tests/docker_extension_builds/run.sh); the TPU analog: build the wheel
 # from pyproject.toml, install it into an empty --target dir, and import
@@ -88,14 +88,14 @@ jax.jit(step).lower(params, state).compile()
 print('installed-package train step compiles')
 ")
 
-echo "== 5/13 lint (apex_tpu.lint: trace safety / dtype policy / collectives) =="
+echo "== 5/14 lint (apex_tpu.lint: trace safety / dtype policy / collectives) =="
 # static gate BEFORE the test tier: AST pass over the package + graft
 # entry, jaxpr pass over the registered entry points. --strict: warnings
 # fail too (every intentional exception carries an inline suppression
 # with its why — see docs/lint.md). Use --format=github under CI bots.
 python -m apex_tpu.lint apex_tpu/ __graft_entry__.py --strict
 
-echo "== 6/13 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
+echo "== 6/14 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
 # A 3-step instrumented GPT train step on the CPU mesh must produce a
 # parseable JSONL carrying step timing, amp loss-scale/overflow, comm
 # bytes and MFU, and the summarize CLI must render it (exit 0) — the
@@ -168,7 +168,7 @@ fi
 echo "health CLI gate OK (healthy=0, injected-NaN=nonzero)"
 rm -rf "$(dirname "$HLT_FILE")"
 
-echo "== 7/13 tune smoke (sweep dry-run + auto-policy tuned train) =="
+echo "== 7/14 tune smoke (sweep dry-run + auto-policy tuned train) =="
 # The autotuner must be drivable offline (sweep plan renders, exit 0) and
 # inline: a 3-step train whose kernels resolve their configs through
 # apex_tpu.tune under APEX_TPU_TUNE=auto. On this CPU backend measurement
@@ -245,7 +245,7 @@ print(f'tune smoke OK: {len(tuned)} tune/* series, '
 " "$TUNE_DIR/tune_run.jsonl" "$TUNE_DIR/cache"
 rm -rf "$TUNE_DIR"
 
-echo "== 8/13 resilience smoke (snapshot -> injected kill -> auto-resume) =="
+echo "== 8/14 resilience smoke (snapshot -> injected kill -> auto-resume) =="
 # Kill-and-resume end to end: a 6-step train snapshotting every 2 steps is
 # SIGKILLed by the fault injector at the top of step 4 (exit 137 — an
 # abrupt death, no final snapshot), then the SAME command with --resume
@@ -302,7 +302,7 @@ python -m apex_tpu.telemetry summarize "$RES_DIR/resume.jsonl" \
     || { echo "summarize did not report the resume point" >&2; exit 1; }
 rm -rf "$RES_DIR"
 
-echo "== 9/13 overlap smoke (staged backward + bf16 wire vs fp32 baseline) =="
+echo "== 9/14 overlap smoke (staged backward + bf16 wire vs fp32 baseline) =="
 # The overlap engine end to end on the 8-device CPU mesh: a 3-step fp32
 # baseline train and the same train under --overlap --reduce-dtype bf16
 # must (a) land within 1e-2 of each other's final loss (the compression
@@ -358,7 +358,7 @@ python -m apex_tpu.telemetry summarize "$OVL_DIR/bf16.jsonl" \
     || { echo "summarize did not render overlap efficiency" >&2; exit 1; }
 rm -rf "$OVL_DIR"
 
-echo "== 10/13 profile smoke (capture -> attribution report -> compare gate) =="
+echo "== 10/14 profile smoke (capture -> attribution report -> compare gate) =="
 # The attribution profiler end to end on the CPU backend: a 3-step train
 # with --profile must produce a capture logdir whose offline report
 # parses with nonzero compute time and carries the named
@@ -419,7 +419,7 @@ fi
 echo "compare gate OK (identical=0, doctored-slower=4)"
 rm -rf "$PROF_DIR"
 
-echo "== 11/13 trace smoke (host spans -> unified timeline -> merge/stragglers) =="
+echo "== 11/14 trace smoke (host spans -> unified timeline -> merge/stragglers) =="
 # The host-tracing layer end to end: a 3-step --trace train must emit
 # parseable span/* begin/end pairs, the unified host+device timeline
 # must export as valid Chrome-trace JSON with BOTH lanes populated,
@@ -492,7 +492,7 @@ grep -q "worst: p" "$TRC_DIR/merged.txt" \
 echo "trace smoke OK (spans + timeline + reconciliation + 2-process merge)"
 rm -rf "$TRC_DIR"
 
-echo "== 12/13 trainer smoke (compiled-step builder: pipelined dispatch + donation audit) =="
+echo "== 12/14 trainer smoke (compiled-step builder: pipelined dispatch + donation audit) =="
 # The compiled trainer end to end: a 3-step train_lm built through
 # apex_tpu.trainer with telemetry+trace on must (a) emit balanced
 # span/* begin/end pairs (the in-flight window's trainer/retire spans
@@ -537,7 +537,108 @@ grep -q "donation audit: .* 0 refused" "$TRN_DIR/out.txt" \
     || { echo "train_lm did not print the donation audit" >&2; exit 1; }
 rm -rf "$TRN_DIR"
 
-echo "== 13/13 pytest =="
+echo "== 13/14 fused-kernel regression (Pallas xentropy vs unfused + epilogue/mt scopes) =="
+# The fused-kernel tier end to end (docs/kernels.md): the SAME 3-step GPT
+# train profiled unfused and fused (Pallas xentropy in the loss scope)
+# must (a) surface the apex_xentropy scope in the fused breakdown,
+# (b) value-match the unfused run's final loss, and (c) pass `pyprof
+# compare` under the existing exit-4 regression contract — the fused run
+# may not be slower. NOTE the tolerance: on this CPU backend the Pallas
+# kernel runs in INTERPRET mode (the real speed gate is the on-chip
+# BENCH A/B); --max-regress 40 absorbs interpret + 3-step CPU timing
+# noise while still failing a catastrophic (>1.4x) regression. The mt
+# flat backend is EXCLUDED from the timed pair on purpose: its
+# flat-bucket marshalling is a TPU trade measured by the mt_apply sweep,
+# and on a single CPU core it is reliably slower — its scope + parity
+# gate below runs on a real capture breakdown instead.
+KRN_DIR="$(mktemp -d)"
+KRN_ARGS=(--steps 3 --warmup-steps 0 --vocab 512 --layers 2
+          --embed-dim 64 --heads 2 --seq-len 128 --batch-size 1
+          --opt-level O2)
+python examples/gpt/train_lm.py "${KRN_ARGS[@]}" \
+    --profile "$KRN_DIR/unfused" > "$KRN_DIR/unfused.out"
+APEX_TPU_XENT_BACKEND=pallas \
+python examples/gpt/train_lm.py "${KRN_ARGS[@]}" \
+    --profile "$KRN_DIR/fused" > "$KRN_DIR/fused.out"
+python -m apex_tpu.pyprof report "$KRN_DIR/unfused" \
+    -o "$KRN_DIR/unfused.json" > /dev/null
+python -m apex_tpu.pyprof report "$KRN_DIR/fused" \
+    -o "$KRN_DIR/fused.json" > /dev/null
+python -c "
+import json, re, sys
+fused = json.load(open(sys.argv[1]))
+scopes = set(fused['scopes'])
+assert any('apex_xentropy' in s for s in scopes), \
+    f'apex_xentropy scope missing from the fused breakdown; has ' \
+    f'{sorted(scopes)[:20]}'
+def final_loss(path):
+    steps = dict(re.findall(r'step\s+(\d+) loss ([0-9.naninf-]+)',
+                            open(path).read()))
+    assert steps, f'no per-step loss lines in {path}'
+    return float(steps[max(steps, key=int)])
+lu = final_loss(sys.argv[2]); lf = final_loss(sys.argv[3])
+assert abs(lu - lf) <= 1e-3, \
+    f'fused xentropy changed the loss: {lf} vs unfused {lu}'
+print(f'apex_xentropy scope present; loss delta {abs(lu - lf):.5f}')
+" "$KRN_DIR/fused.json" "$KRN_DIR/unfused.out" "$KRN_DIR/fused.out"
+rc=0
+python -m apex_tpu.pyprof compare "$KRN_DIR/unfused.json" \
+    "$KRN_DIR/fused.json" --max-regress 40 > "$KRN_DIR/cmp.txt" || rc=$?
+if [[ "$rc" -ne 0 ]]; then
+    echo "pyprof compare: fused 3-step profile regressed past the gate" >&2
+    cat "$KRN_DIR/cmp.txt" >&2
+    exit 1
+fi
+cat "$KRN_DIR/cmp.txt"
+# conv epilogue + mt flat apply: capture breakdowns must attribute the
+# apex_conv_epilogue / apex_mt_apply scopes, and both fused paths must
+# match the unfused math
+python -c "
+import jax; jax.config.update('jax_platforms', 'cpu')
+import jax.numpy as jnp
+import numpy as np
+from apex_tpu import optimizers, pyprof
+from apex_tpu.ops import conv_epilogue as ce
+from apex_tpu.ops import multi_tensor as mt
+
+x = jax.random.normal(jax.random.PRNGKey(0), (64, 256), jnp.bfloat16)
+r = jax.random.normal(jax.random.PRNGKey(1), (64, 256), jnp.bfloat16)
+scale = jnp.ones((256,)) * 1.1
+shift = jnp.zeros((256,)) - 0.05
+fused = ce.bn_relu_apply(x, scale, shift, residual=r)
+ref = jnp.maximum(x.astype(jnp.float32) * scale + shift
+                  + r.astype(jnp.float32), 0.0).astype(jnp.bfloat16)
+np.testing.assert_allclose(np.asarray(fused, np.float32),
+                           np.asarray(ref, np.float32), atol=1e-2)
+bd = pyprof.capture(
+    lambda x, r: ce.bn_relu_apply(x, scale, shift, residual=r),
+    x, r, steps=2, write=False)
+assert any('apex_conv_epilogue' in s for s in bd['scopes']), \
+    f'conv epilogue scope missing; has {sorted(bd[\"scopes\"])[:10]}'
+
+p = {f'l{i}': jax.random.normal(jax.random.PRNGKey(i), (257,))
+     for i in range(8)}
+g = jax.tree_util.tree_map(lambda t: t * 0.1, p)
+opt = optimizers.FusedAdam(lr=1e-3)
+st = opt.init(p)
+p_ref, _ = jax.jit(opt.step)(g, p, st)
+prev = mt.set_backend('flat')
+try:
+    p_flat, _ = jax.jit(opt.step)(g, p, st)
+    bd = pyprof.capture(opt.step, g, p, st, steps=2, write=False)
+finally:
+    mt.set_backend(prev)
+for a, b in zip(jax.tree_util.tree_leaves(p_ref),
+                jax.tree_util.tree_leaves(p_flat)):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+assert any('apex_mt_apply' in s for s in bd['scopes']), \
+    f'mt flat scope missing; has {sorted(bd[\"scopes\"])[:10]}'
+print('conv epilogue + mt flat: parity + capture scopes OK')
+"
+echo "fused-kernel gate OK (scopes + parity + compare exit 0)"
+rm -rf "$KRN_DIR"
+
+echo "== 14/14 pytest =="
 if [[ "${1:-}" == "--full" ]]; then
     # full suite + the complete L1 cross-product matrix (reference
     # tests/L1/cross_product{,_distributed}/run.sh); the convergence
@@ -552,7 +653,7 @@ else
         tests/test_amp.py tests/test_param_groups.py tests/test_zero.py \
         tests/test_checkpoint.py tests/test_runtime.py tests/test_tune.py \
         tests/test_resilience.py tests/test_overlap.py \
-        tests/test_trainer.py \
+        tests/test_trainer.py tests/test_kernels.py \
         tests/test_pyprof.py tests/test_trace.py -q -x
 fi
 
