@@ -81,17 +81,60 @@ def _check_layout(saved: Optional[Dict[str, Any]],
     scrambled flat master."""
     if saved == expected:
         return
+    if saved is None:
+        hint = ("The checkpoint predates layout recording (no "
+                "fingerprint saved); re-save it with layout=, or pass "
+                "expected_layout=None to skip the check at your own "
+                "risk.")
+    else:
+        # distinguish "re-shardable world mismatch" (same param tree,
+        # different shard_count/chunk resolution — the state re-maps
+        # deterministically) from "structurally incompatible tree"
+        # (re-sharding cannot help) and print the RECIPE, not just the
+        # fingerprints. Layouts that are not ZeRO fingerprints at all
+        # (layout= accepts any JSON-able dict) keep the generic
+        # message — claiming "different param tree" about them would
+        # be a misdiagnosis.
+        try:
+            from apex_tpu.resilience import elastic as _elastic
+            kind, reason = _elastic.classify_reshard(saved, expected)
+            ok = kind == _elastic.RESHARDABLE
+            structural = kind == _elastic.STRUCTURAL
+        except Exception:   # never mask the mismatch with a helper bug
+            ok, reason, structural = False, "", False
+        if ok:
+            src = saved.get("shard_count")
+            dst = expected.get("shard_count")
+            hint = (
+                f"RE-SHARDABLE world mismatch: saved at world {src} "
+                f"(chunk_elements {saved.get('chunk_elements')}), live "
+                f"configuration expects world {dst} (chunk_elements "
+                f"{expected.get('chunk_elements')}) over the SAME param "
+                "tree. The state re-maps deterministically — resume "
+                "with resilient_loop(..., elastic=resilience.Elastic("
+                "opt, params)), or materialize it once with "
+                "resilience.elastic.reshard_restore(manager, template, "
+                "params=params, optimizer=opt). `python -m "
+                f"apex_tpu.resilience inspect DIR --check {dst}` "
+                "reports feasibility per generation.")
+        elif structural:
+            hint = (
+                "STRUCTURALLY INCOMPATIBLE tree — " + reason + " — "
+                "the checkpoint was written for a different param "
+                "tree (not just a different world size), so an elastic "
+                "re-shard cannot help. Re-create the optimizer/mesh "
+                "with the saved configuration, or re-initialize state "
+                "from params.")
+        else:
+            hint = (
+                "The checkpoint was written under a different "
+                "sharded-state layout (mesh size / chunk resolution / "
+                "param tree) and would restore scrambled. Re-create "
+                "the optimizer/mesh with the saved configuration, or "
+                "re-initialize state from params.")
     raise ValueError(
         f"checkpoint layout fingerprint mismatch for {path}:\n"
-        f"  expected: {expected}\n  found:    {saved}\n"
-        + ("The checkpoint predates layout recording (no fingerprint "
-           "saved); re-save it with layout=, or pass expected_layout=None "
-           "to skip the check at your own risk."
-           if saved is None else
-           "The checkpoint was written under a different sharded-state "
-           "layout (mesh size / chunk resolution / param tree) and would "
-           "restore scrambled. Re-create the optimizer/mesh with the "
-           "saved configuration, or re-initialize state from params."))
+        f"  expected: {expected}\n  found:    {saved}\n" + hint)
 
 
 def save(path: str, train_state: Tree, *, force: bool = True,

@@ -74,6 +74,66 @@ class ZeroState(NamedTuple):
     exp_avg_sq: jax.Array  # (padded_total,) f32 — shard over axis
 
 
+def pack_layout(params: Tree, *, chunk_elements: int,
+                shard_count: int) -> dict:
+    """Deterministic flat-layout spec for ``(params, chunk_elements,
+    shard_count)`` — the pure function underneath :meth:`_ZeroBase._pack`
+    (which adds tune resolution and param-group maps on top).
+
+    Standalone because the layout must be reconstructible from a
+    checkpoint's :meth:`~_ZeroBase.layout_fingerprint` alone: the elastic
+    re-shard path (:mod:`apex_tpu.resilience.elastic`) rebuilds the
+    SOURCE world's spec from the saved fingerprint and the live params
+    tree, then re-maps every flat element into the target world's spec.
+    """
+    if chunk_elements < 0:
+        raise ValueError(
+            f"chunk_elements must be >= 0, got {chunk_elements}")
+    if shard_count < 1:
+        raise ValueError(f"shard_count must be >= 1, got {shard_count}")
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    shapes = [tuple(l.shape) for l in leaves]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    offsets = np.cumsum([0] + sizes[:-1])
+    total = int(sum(sizes))
+    n = int(shard_count)
+    # Contiguous-leaf buckets of at most chunk_elements each; a single
+    # oversize leaf forms its own bucket (leaves never split).
+    runs = _buckets.partition_by_capacity(sizes, chunk_elements)
+    buckets = []
+    for idxs in runs:
+        size_b = int(sum(sizes[i] for i in idxs))
+        padded_b = ((size_b + n - 1) // n) * n
+        buckets.append(dict(
+            idxs=tuple(idxs),
+            start=int(offsets[idxs[0]]),   # canonical flat offset
+            size=size_b,
+            padded=padded_b,
+            k=padded_b // n))              # local shard elements
+    padded = int(sum(b["padded"] for b in buckets))
+    return dict(
+        treedef=treedef, shapes=shapes, sizes=sizes, offsets=offsets,
+        total=total, padded=padded, buckets=buckets,
+        chunk_elements=int(chunk_elements), shard_count=n,
+        dtypes=[l.dtype for l in leaves])
+
+
+def structure_crc(params: Tree) -> int:
+    """Canonical (path, shape) crc32 of a param tree — the fingerprint
+    field that distinguishes "same tree, different world" (re-shardable)
+    from "different tree" (structurally incompatible). Leaf ORDER and
+    shapes determine the interleaved layout even when the aggregate
+    counts coincide (two equal-size layers swapped, a transposed
+    kernel, ...); PyTreeDef repr is deliberately NOT hashed — its format
+    is not stable across jax versions."""
+    import zlib
+
+    from apex_tpu.utils import path_str
+    pairs = [(path_str(p), tuple(l.shape)) for p, l in
+             jax.tree_util.tree_flatten_with_path(params)[0]]
+    return int(zlib.crc32(repr(pairs).encode()))
+
+
 def _bucket_flat(leaves, idxs, pad_to: int) -> jax.Array:
     """Concat ONLY the given leaves (f32, raveled) and zero-pad to pad_to.
     Keeping the concat per bucket — not per tree — is what lets each
@@ -161,33 +221,20 @@ class _ZeroBase(FusedOptimizer):
 
     # -- static packing metadata ------------------------------------------
     def _pack(self, params: Tree):
-        leaves, treedef = jax.tree_util.tree_flatten(params)
-        shapes = [tuple(l.shape) for l in leaves]
-        sizes = [int(np.prod(s)) if s else 1 for s in shapes]
-        offsets = np.cumsum([0] + sizes[:-1])
-        total = int(sum(sizes))
         n = self.shard_count
         from apex_tpu import tune
         chunk_elements = self.chunk_elements
         if chunk_elements is None:
+            leaves = jax.tree_util.tree_leaves(params)
+            total = int(sum(int(np.prod(l.shape)) if l.shape else 1
+                            for l in leaves))
             chunk_elements = tune.zero_chunk_elements(total=total, world=n)
-        # Contiguous-leaf buckets of at most chunk_elements each; a single
-        # oversize leaf forms its own bucket (leaves never split).
-        runs = _buckets.partition_by_capacity(sizes, chunk_elements)
-        tune.warn_bucket_count("zero", len(runs), chunk_elements)
-        buckets = []
-        for idxs in runs:
-            size_b = int(sum(sizes[i] for i in idxs))
-            padded_b = ((size_b + n - 1) // n) * n
-            buckets.append(dict(
-                idxs=tuple(idxs),
-                start=int(offsets[idxs[0]]),   # canonical flat offset
-                size=size_b,
-                padded=padded_b,
-                k=padded_b // n))              # local shard elements
-        padded = int(sum(b["padded"] for b in buckets))
+        spec = pack_layout(params, chunk_elements=chunk_elements,
+                           shard_count=n)
+        tune.warn_bucket_count("zero", len(spec["buckets"]),
+                               chunk_elements)
         # Per-tensor param-group assignment (index into override table).
-        group_of_tensor = np.zeros((len(leaves),), np.int32)
+        group_of_tensor = np.zeros((len(spec["sizes"]),), np.int32)
         overrides: list = [{}]
         if self.param_groups:
             for g in self.param_groups:
@@ -206,12 +253,9 @@ class _ZeroBase(FusedOptimizer):
                     overrides.append(ov)
                 for i in idxs:
                     group_of_tensor[i] = gi
-        self._spec_cache = dict(
-            treedef=treedef, shapes=shapes, sizes=sizes,
-            offsets=offsets, total=total, padded=padded, buckets=buckets,
-            chunk_elements=int(chunk_elements),
-            dtypes=[l.dtype for l in leaves],
-            group_of_tensor=group_of_tensor, group_overrides=overrides)
+        spec["group_of_tensor"] = group_of_tensor
+        spec["group_overrides"] = overrides
+        self._spec_cache = spec
         return self._spec_cache
 
     @property
@@ -251,16 +295,6 @@ class _ZeroBase(FusedOptimizer):
             spec = self._pack(params)
         finally:
             self._spec_cache = prev
-        import zlib
-
-        from apex_tpu.utils import path_str
-        # leaf ORDER and shapes determine the interleaved layout even
-        # when the aggregate counts coincide (two equal-size layers
-        # swapped, a transposed kernel, ...). Hash canonical
-        # (path, shape) pairs — NOT PyTreeDef repr, whose format is not
-        # stable across jax versions.
-        pairs = [(path_str(p), tuple(l.shape)) for p, l in
-                 jax.tree_util.tree_flatten_with_path(params)[0]]
         return {
             # the RESOLVED capacity (chunk_elements=None routes through
             # apex_tpu.tune): the layout guard must record what actually
@@ -270,7 +304,7 @@ class _ZeroBase(FusedOptimizer):
             "total": int(spec["total"]),
             "padded": int(spec["padded"]),
             "n_buckets": len(spec["buckets"]),
-            "structure_crc32": int(zlib.crc32(repr(pairs).encode())),
+            "structure_crc32": structure_crc(params),
         }
 
     def layout_mismatch(self, saved: Optional[dict],
@@ -295,12 +329,32 @@ class _ZeroBase(FusedOptimizer):
         chunk_elements / shard_count changed between save and load."""
         bad = self.layout_mismatch(saved, params)
         if bad:
+            # one classifier for saved-vs-live layout pairs (elastic
+            # module doc) — lazy import keeps the optimizer importable
+            # without the resilience package in degraded environments
+            from apex_tpu.resilience import elastic as _elastic
+            kind, reason = _elastic.classify_reshard(
+                saved, self.layout_fingerprint(params))
+            if kind == _elastic.RESHARDABLE:
+                hint = (
+                    "Same param tree, different world/chunk resolution "
+                    f"({reason}): the state re-maps deterministically — "
+                    "use apex_tpu.resilience.elastic (reshard_restore / "
+                    "resilient_loop(..., elastic=...)) to materialize "
+                    "it at this layout.")
+            elif kind == _elastic.STRUCTURAL:
+                hint = (f"{reason}; re-create the optimizer with the "
+                        "saved configuration, or re-initialize the "
+                        "state from params.")
+            else:
+                hint = ("The saved layout is not a complete ZeRO "
+                        f"fingerprint ({reason}), so it cannot be "
+                        "re-shard-restored; re-initialize the state "
+                        "from params.")
             raise ValueError(
                 "ZeroState layout mismatch — the checkpoint was saved "
                 "under a different flat layout and would restore "
-                f"scrambled. saved vs current: {bad}. Re-create the "
-                "optimizer with the saved chunk_elements/shard_count, or "
-                "re-initialize the state from params.")
+                f"scrambled. saved vs current: {bad}. {hint}")
 
     def state_pspec(self) -> ZeroState:
         """PartitionSpecs for shard_map in_specs/out_specs of the state.

@@ -3,8 +3,14 @@ apex/parallel/). DP gradient sync, SyncBatchNorm, LARC, mesh helpers."""
 
 from apex_tpu.parallel.mesh import (
     make_mesh, data_parallel_mesh, subgroups, init_distributed, hybrid_mesh,
-    require_axis, bound_axis_size,
+    require_axis, bound_axis_size, reform_mesh,
 )
+# NOTE: apex_tpu.parallel.multiproc (Rendezvous, elastic_world, the
+# --elastic supervisor) is deliberately NOT imported here — it doubles
+# as the `python -m apex_tpu.parallel.multiproc` entry point, and an
+# eager package import would shadow runpy's __main__ execution of it.
+# Import the submodule directly: `from apex_tpu.parallel import
+# multiproc`.
 from apex_tpu.parallel.distributed import (
     allreduce_gradients,
     DistributedDataParallel,

@@ -67,6 +67,32 @@ def data_parallel_mesh(name: str = "data") -> Mesh:
     return make_mesh(axis_names=(name,))
 
 
+def reform_mesh(world: Optional[int] = None,
+                axis_names: Sequence[str] = ("data",),
+                devices=None) -> Mesh:
+    """Re-form a 1-D mesh at ``world`` devices after a membership change
+    (the :mod:`apex_tpu.parallel.multiproc` rendezvous/elastic arc): a
+    fleet that lost members rebuilds its data/ZeRO axis over the FIRST
+    ``world`` devices of the (possibly shrunken) pool, so shard ``r`` of
+    the re-sharded optimizer state lands on the device at dense rank
+    ``r``. ``world=None`` reads the membership env contract
+    (``multiproc.elastic_world()``). Raises when the pool holds fewer
+    than ``world`` devices — a membership registry claiming more members
+    than there are devices is a wiring error, not something to truncate
+    silently."""
+    if world is None:
+        from apex_tpu.parallel.multiproc import elastic_world
+        world, _ = elastic_world()
+    world = int(world)
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if world < 1 or world > len(devices):
+        raise ValueError(
+            f"cannot re-form a mesh at world {world}: device pool holds "
+            f"{len(devices)} devices")
+    return make_mesh(axis_sizes=[world], axis_names=axis_names,
+                     devices=devices[:world])
+
+
 def subgroups(world_size: int, group_size: int) -> List[List[int]]:
     """Partition ranks into contiguous groups of ``group_size`` — the analog
     of ``create_syncbn_process_group`` (apex/parallel/__init__.py:58-95),

@@ -11,13 +11,47 @@ hook, so "the launcher" is your scheduler (GKE/xmanager/mpirun) plus::
 
 which initializes the distributed runtime from standard env vars
 (COORDINATOR_ADDRESS, NUM_PROCESSES, PROCESS_ID) and then execs the script.
+
+Elastic extension (ROADMAP item 4 / docs/resilience.md "Elastic
+membership"): the launcher also owns MEMBERSHIP. ::
+
+    python -m apex_tpu.parallel.multiproc --elastic 2 -- \\
+        python train.py --resume auto --telemetry tel-p{rank}.jsonl
+
+spawns one member process per rank with ``APEX_TPU_WORLD`` /
+``APEX_TPU_RANK`` / ``APEX_TPU_RENDEZVOUS`` set (and ``{rank}`` /
+``{world}`` substituted into the command), then supervises: a member
+that dies abnormally (an OOM kill, the ``node_loss`` fault) triggers a
+membership change — the survivors are SIGTERMed, which is the EXISTING
+cooperative-leave contract (each takes a final snapshot and exits 75,
+``EX_TEMPFAIL``), and the fleet relaunches at the smaller world with
+dense re-ranked members. The relaunched run's ``--resume auto`` then
+re-shards the world-``W`` snapshot to world ``W-1`` through
+:mod:`apex_tpu.resilience.elastic`.
+
+:class:`Rendezvous` is the file-based membership registry the members
+and supervisor share: each member announces itself (atomic file +
+heartbeats) and can ask for the agreed ``(world, rank)`` — rank is the
+member's DENSE position among current members, so a re-formed fleet
+always numbers 0..W'-1 regardless of which original ranks survived.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import runpy
+import signal
+import subprocess
 import sys
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: env vars of the elastic membership contract (set by the supervisor,
+#: read by members via :func:`elastic_world`)
+ENV_WORLD = "APEX_TPU_WORLD"
+ENV_RANK = "APEX_TPU_RANK"
+ENV_RENDEZVOUS = "APEX_TPU_RENDEZVOUS"
 
 
 def initialize_distributed() -> None:
@@ -35,12 +69,304 @@ def initialize_distributed() -> None:
             process_id=int(pid))
 
 
+def elastic_world() -> Tuple[int, int]:
+    """``(world, rank)`` of this process under the elastic launcher
+    (``APEX_TPU_WORLD``/``APEX_TPU_RANK``), falling back to the
+    jax.distributed env contract (``NUM_PROCESSES``/``PROCESS_ID``),
+    else ``(1, 0)`` — the graceful single-member path. A PRESENT but
+    malformed value raises (a member silently training at world 1 while
+    the operator believes it joined a fleet is the quiet failure this
+    env contract exists to prevent); only ABSENT vars degrade."""
+    for wvar, rvar in ((ENV_WORLD, ENV_RANK),
+                       ("NUM_PROCESSES", "PROCESS_ID")):
+        w, r = os.environ.get(wvar), os.environ.get(rvar)
+        if w is not None:
+            try:
+                return max(int(w), 1), int(r or 0)
+            except ValueError as e:
+                raise ValueError(
+                    f"malformed membership env: {wvar}={w!r} "
+                    f"{rvar}={r!r} (both must be integers)") from e
+    return 1, 0
+
+
+# ---------------------------------------------------------------------------
+# rendezvous: file-based membership registry
+# ---------------------------------------------------------------------------
+
+class Rendezvous:
+    """Shared-directory membership registry for one training fleet.
+
+    One file per member (``member_<id>``, atomic ``os.replace`` publish,
+    mtime refreshed by :meth:`heartbeat`); a member whose heartbeat is
+    older than ``ttl_s`` is considered departed. :meth:`world` returns
+    the DENSE ``(size, rank)`` over current members sorted by id — the
+    re-rank a re-formed mesh uses, so surviving members always number
+    ``0..W'-1``. :meth:`wait_world` is the join barrier: block until the
+    expected member count is present (mesh formation at the NEW size).
+
+    The registry is advisory bookkeeping, not a lock service: the
+    supervisor owns authoritative membership (it holds the child
+    handles); members use the registry to observe the agreed world and
+    to leave cooperatively (:meth:`leave` on the exit-75 path).
+    """
+
+    def __init__(self, directory: str, member: Optional[str] = None, *,
+                 ttl_s: float = 60.0):
+        self.directory = str(directory)
+        self.member = None if member is None else str(member)
+        self.ttl_s = float(ttl_s)
+
+    def _path(self, member: str) -> str:
+        return os.path.join(self.directory, f"member_{member}")
+
+    def announce(self) -> None:
+        """Publish (or refresh) this member's registration atomically."""
+        if self.member is None:
+            raise ValueError("announce() needs a member id")
+        os.makedirs(self.directory, exist_ok=True)
+        tmp = self._path(self.member) + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"member": self.member, "pid": os.getpid(),
+                       "ts": time.time()}, f)
+        os.replace(tmp, self._path(self.member))
+
+    def heartbeat(self) -> None:
+        """Refresh liveness; re-announces if the registration vanished
+        (a cleaned-up rendezvous dir must not ghost a live member).
+        No-op in observer mode (``member=None``), like :meth:`leave`."""
+        if self.member is None:
+            return
+        try:
+            os.utime(self._path(self.member))
+        except OSError:
+            self.announce()
+
+    def leave(self) -> None:
+        """Cooperative departure (the exit-75 path): drop the
+        registration so the next :meth:`world` excludes this member."""
+        if self.member is None:
+            return
+        try:
+            os.unlink(self._path(self.member))
+        except OSError:
+            pass
+
+    def members(self) -> List[str]:
+        """Sorted ids of members with a fresh heartbeat."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        now = time.time()
+        out = []
+        for n in names:
+            if not n.startswith("member_") or ".tmp." in n:
+                continue
+            try:
+                fresh = now - os.path.getmtime(
+                    os.path.join(self.directory, n)) <= self.ttl_s
+            except OSError:
+                continue   # departed between listdir and stat
+            if fresh:
+                out.append(n[len("member_"):])
+        return sorted(out)
+
+    def world(self) -> Tuple[int, int]:
+        """``(size, rank)`` — rank is this member's dense position among
+        current members (-1 when not announced/this member departed)."""
+        mem = self.members()
+        rank = mem.index(self.member) if self.member in mem else -1
+        return len(mem), rank
+
+    def wait_world(self, n: int, *, timeout_s: float = 60.0,
+                   poll_s: float = 0.05) -> Tuple[int, int]:
+        """Join barrier: block until ``n`` members are registered (mesh
+        formation at the new world size); returns :meth:`world`. Raises
+        ``TimeoutError`` naming who IS present — membership hangs must
+        be debuggable from the message alone."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            size, rank = self.world()
+            if size >= n:
+                return size, rank
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"rendezvous at {self.directory}: {size}/{n} members "
+                    f"after {timeout_s:g}s (present: {self.members()})")
+            time.sleep(poll_s)
+
+
+# ---------------------------------------------------------------------------
+# elastic supervisor
+# ---------------------------------------------------------------------------
+
+def _substitute(cmd: Sequence[str], rank: int, world: int) -> List[str]:
+    return [a.replace("{rank}", str(rank)).replace("{world}", str(world))
+            for a in cmd]
+
+
+def run_elastic(cmd: Sequence[str], *, world: int,
+                rendezvous_dir: Optional[str] = None,
+                grace_s: float = 30.0, max_rounds: int = 8,
+                env: Optional[Dict[str, str]] = None,
+                log=print) -> int:
+    """Spawn ``world`` member processes of ``cmd`` and supervise
+    membership changes (module doc). Returns the exit code for the
+    launcher: 0 when a final round's members all complete.
+
+    Round protocol: members run with ``APEX_TPU_WORLD``/``APEX_TPU_RANK``
+    (+ ``{rank}``/``{world}`` substitution). When a member exits
+    abnormally (not 0, not 75), the round ends: survivors get SIGTERM —
+    the cooperative-leave contract; each snapshots and exits 75 — with a
+    ``grace_s`` escalation to SIGKILL for members stuck in a collective
+    against the dead peer (their last cadence snapshot still resumes).
+    The next round relaunches ``world - lost`` dense-ranked members; a
+    member's own spontaneous exit 75 (deadline preemption) also counts
+    as a cooperative leave. ``--resume auto`` in ``cmd`` is what turns
+    the relaunch into an elastic re-shard resume."""
+    if world < 1:
+        raise ValueError(f"--elastic world must be >= 1, got {world}")
+    cmd = list(cmd)
+    if cmd and cmd[0].endswith(".py"):
+        cmd = [sys.executable] + cmd
+    rounds = 0
+    rc_last = 1
+    while world >= 1 and rounds < max_rounds:
+        rounds += 1
+        if rendezvous_dir and os.path.isdir(rendezvous_dir):
+            # the supervisor owns authoritative membership: clear the
+            # previous round's registrations (including a SIGKILLed
+            # member's never-unlinked file) so wait_world(n) is a REAL
+            # barrier on this round's members, not satisfied by stale
+            # still-within-TTL files
+            for name in os.listdir(rendezvous_dir):
+                if name.startswith("member_"):
+                    try:
+                        os.unlink(os.path.join(rendezvous_dir, name))
+                    except OSError:
+                        pass
+        procs: Dict[int, subprocess.Popen] = {}
+        for rank in range(world):
+            child_env = dict(os.environ)
+            child_env.update(env or {})
+            child_env[ENV_WORLD] = str(world)
+            child_env[ENV_RANK] = str(rank)
+            if rendezvous_dir:
+                child_env[ENV_RENDEZVOUS] = rendezvous_dir
+            procs[rank] = subprocess.Popen(
+                _substitute(cmd, rank, world), env=child_env)
+        log(f"multiproc --elastic: round {rounds} at world {world} "
+            f"(pids {[p.pid for p in procs.values()]})")
+        lost: List[int] = []
+        left: List[int] = []
+        done: List[int] = []
+        signaled = False
+        while len(done) + len(lost) + len(left) < world:
+            for rank, p in procs.items():
+                rc = p.poll()
+                if rc is None or rank in done or rank in lost \
+                        or rank in left:
+                    continue
+                if rc == 0:
+                    done.append(rank)
+                elif signaled:
+                    # leaving at OUR request (75 after the final
+                    # snapshot, or the SIGKILL escalation): a staying
+                    # member of the next round, not another loss
+                    done.append(rank)
+                elif rc == 75:
+                    # spontaneous cooperative leave (deadline/SIGTERM
+                    # from outside): member departs, fleet re-forms
+                    left.append(rank)
+                else:
+                    lost.append(rank)
+                    log(f"multiproc --elastic: rank {rank} LOST "
+                        f"(rc={rc}) at world {world}")
+            if (lost or left) and not signaled:
+                signaled = True
+                for rank, p in procs.items():
+                    if p.poll() is None:
+                        try:
+                            p.send_signal(signal.SIGTERM)
+                        except OSError:
+                            pass
+                log("multiproc --elastic: membership change — SIGTERMed "
+                    "survivors (cooperative leave, exit 75 after final "
+                    "snapshot)")
+                deadline = time.monotonic() + grace_s
+                for rank, p in procs.items():
+                    if p.poll() is not None:
+                        continue
+                    try:
+                        p.wait(max(deadline - time.monotonic(), 0.1))
+                    except subprocess.TimeoutExpired:
+                        # stuck in a collective against the dead peer:
+                        # the last cadence snapshot still resumes
+                        log(f"multiproc --elastic: rank {rank} did not "
+                            f"leave within {grace_s:g}s; SIGKILL")
+                        p.kill()
+                        p.wait()
+            time.sleep(0.05)
+        if not lost and not left:
+            log(f"multiproc --elastic: world {world} completed")
+            return 0
+        new_world = world - len(lost) - len(left)
+        log(f"multiproc --elastic: re-forming at world {new_world} "
+            f"(lost ranks {lost}, left ranks {left})")
+        if new_world < 1:
+            log("multiproc --elastic: no members left")
+            return 1
+        world = new_world
+        rc_last = 1
+    return rc_last
+
+
+def _elastic_main(argv: List[str]) -> None:
+    """``--elastic N [--rendezvous DIR] [--grace S] [--max-rounds R]
+    [--] cmd...``"""
+    world: Optional[int] = None
+    rdzv: Optional[str] = None
+    grace = 30.0
+    max_rounds = 8
+    args = argv[:]
+    cmd: List[str] = []
+    while args:
+        a = args.pop(0)
+        if a == "--elastic":
+            world = int(args.pop(0))
+        elif a == "--rendezvous":
+            rdzv = args.pop(0)
+        elif a == "--grace":
+            grace = float(args.pop(0))
+        elif a == "--max-rounds":
+            max_rounds = int(args.pop(0))
+        elif a == "--":
+            cmd = args
+            break
+        else:
+            cmd = [a] + args
+            break
+    if world is None or not cmd:
+        print("usage: python -m apex_tpu.parallel.multiproc --elastic N "
+              "[--rendezvous DIR] [--grace S] [--max-rounds R] -- "
+              "cmd [args...]", file=sys.stderr)
+        sys.exit(1)
+    sys.exit(run_elastic(cmd, world=world, rendezvous_dir=rdzv,
+                         grace_s=grace, max_rounds=max_rounds))
+
+
 def main() -> None:
     usage = ("usage: python -m apex_tpu.parallel.multiproc script.py "
-             "[args...]")
+             "[args...]\n"
+             "       python -m apex_tpu.parallel.multiproc --elastic N "
+             "[--rendezvous DIR] [--grace S] -- cmd [args...]")
     if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help"):
         print(usage, file=sys.stderr)
         sys.exit(0 if len(sys.argv) >= 2 else 1)
+    if sys.argv[1] == "--elastic":
+        _elastic_main(sys.argv[1:])
+        return
     script = sys.argv[1]
     if not os.path.exists(script):
         print(f"multiproc: no such script: {script}\n{usage}",

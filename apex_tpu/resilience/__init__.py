@@ -22,6 +22,13 @@ or resume correctness. This package turns the one-shot
     and auto-resume-from-latest-valid (corrupt/partial generations skip
     with a loud ``resilience/skipped_generation`` event — the
     ``tune.cache`` degrade-don't-crash contract).
+  * :mod:`elastic` — deterministic re-shard across world sizes: the
+    ZeRO layout fingerprint doubles as a re-map source, so a snapshot
+    written at world ``W`` restores at world ``W'`` bitwise
+    (gather-compare verified). ``resilient_loop(..., elastic=
+    Elastic(opt, params))`` turns a membership change from a hard
+    config error into a resume; ``python -m apex_tpu.resilience
+    inspect DIR --check W`` reports feasibility from the manifests.
 
 Resume telemetry: a resumed run emits a ``resilience/resume`` marker
 (generation, step); ``python -m apex_tpu.telemetry summarize`` reports
@@ -31,6 +38,8 @@ than double-counting them.
 Full guide: ``docs/resilience.md``.
 """
 
+from apex_tpu.resilience import elastic
+from apex_tpu.resilience.elastic import Elastic, reshard_restore
 from apex_tpu.resilience.faults import (ENV_VAR as FAULT_ENV,
                                         FaultInjector, raise_if_io_error)
 from apex_tpu.resilience.loop import LoopResult, resilient_loop
@@ -38,7 +47,7 @@ from apex_tpu.resilience.preempt import EXIT_PREEMPTED, PreemptionHandler
 from apex_tpu.resilience.snapshot import Restored, SnapshotManager
 
 __all__ = [
-    "EXIT_PREEMPTED", "FAULT_ENV", "FaultInjector", "LoopResult",
-    "PreemptionHandler", "Restored", "SnapshotManager",
-    "raise_if_io_error", "resilient_loop",
+    "EXIT_PREEMPTED", "Elastic", "FAULT_ENV", "FaultInjector",
+    "LoopResult", "PreemptionHandler", "Restored", "SnapshotManager",
+    "elastic", "raise_if_io_error", "reshard_restore", "resilient_loop",
 ]

@@ -1,0 +1,141 @@
+"""``python -m apex_tpu.resilience`` — snapshot-store inspection.
+
+::
+
+    python -m apex_tpu.resilience inspect SNAP_DIR
+    python -m apex_tpu.resilience inspect SNAP_DIR --check 4
+    python -m apex_tpu.resilience inspect SNAP_DIR --json
+
+``inspect`` renders one row per generation straight from the manifests
+(step, world = the layout fingerprint's shard_count, chunk resolution,
+payload bytes, complete flag, structure crc) — until now the only way to
+read a manifest was by hand. ``--check W`` additionally reports, per
+generation, whether a re-shard to world ``W`` is possible
+(:func:`apex_tpu.resilience.elastic.check_world`) and sets the exit
+code from the NEWEST complete generation: 0 when it can restore at
+world ``W`` (re-shard or plain), 3 when it cannot, 2 when the store
+holds no COMPLETE generation (missing directory, nothing published
+yet, or every manifest unreadable/incomplete — nothing restorable
+either way).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+from apex_tpu.resilience import elastic as _elastic
+from apex_tpu.resilience.snapshot import SnapshotManager
+
+
+def _rows(mgr: SnapshotManager) -> List[Dict[str, Any]]:
+    """One manifest-level row per generation directory (no payload
+    validation — inspection must work on a store whose newest payload is
+    corrupt). Unreadable manifests become rows with an ``error``."""
+    rows: List[Dict[str, Any]] = []
+    for gen in mgr.generations():
+        row: Dict[str, Any] = {"generation": gen}
+        try:
+            man = mgr.manifest(gen)
+        except (OSError, ValueError) as e:
+            row["error"] = f"unreadable manifest: {e}"
+            rows.append(row)
+            continue
+        layout = man.get("layout")
+        row.update({
+            "step": man.get("step"),
+            "complete": bool(man.get("complete")),
+            "bytes": man.get("bytes"),
+            "layout": layout,
+            "world": (layout or {}).get("shard_count")
+            if isinstance(layout, dict) else None,
+            "chunk_elements": (layout or {}).get("chunk_elements")
+            if isinstance(layout, dict) else None,
+        })
+        rows.append(row)
+    return rows
+
+
+def _fmt_bytes(n: Optional[int]) -> str:
+    if n is None:
+        return "?"
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024 or unit == "GB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}GB"
+
+
+def inspect_main(args: argparse.Namespace) -> int:
+    if not os.path.isdir(args.directory):
+        print(f"inspect: no snapshot directory at {args.directory}",
+              file=sys.stderr)
+        return 2
+    mgr = SnapshotManager(args.directory)
+    rows = _rows(mgr)
+    check_w = args.check
+    if check_w is not None:
+        for row in rows:
+            if "error" in row:
+                row["reshard_to_%d" % check_w] = [False, row["error"]]
+                continue
+            ok, reason = _elastic.check_world(row.get("layout"), check_w)
+            row[f"reshard_to_{check_w}"] = [ok, reason]
+    if args.json:
+        print(json.dumps({"directory": args.directory, "rows": rows},
+                         indent=1, sort_keys=True))
+    else:
+        if not rows:
+            print(f"{args.directory}: no published generations")
+        for row in rows:
+            if "error" in row:
+                print(f"gen {row['generation']:>8}  {row['error']}")
+                continue
+            fp = row.get("layout")
+            crc = (f" crc32={int(fp['structure_crc32']):#010x}"
+                   if isinstance(fp, dict)
+                   and "structure_crc32" in fp else "")
+            print(f"gen {row['generation']:>8}  step {row['step']!s:>6}"
+                  f"  world {row['world'] if row['world'] is not None else '-':>3}"
+                  f"  chunk {row['chunk_elements'] if row['chunk_elements'] is not None else '-':>9}"
+                  f"  {_fmt_bytes(row['bytes']):>9}"
+                  f"  {'complete' if row['complete'] else 'INCOMPLETE'}"
+                  f"{crc}")
+            if check_w is not None:
+                ok, reason = row[f"reshard_to_{check_w}"]
+                print(f"    -> world {check_w}: "
+                      f"{'OK' if ok else 'NO'} — {reason}")
+    complete = [r for r in rows if r.get("complete")]
+    if not complete:
+        return 2
+    if check_w is not None:
+        ok, _ = complete[-1][f"reshard_to_{check_w}"]
+        return 0 if ok else 3
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.resilience",
+        description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+    ins = sub.add_parser(
+        "inspect", help="list a snapshot store's generations "
+        "(step/world/layout/bytes/complete) from the manifests")
+    ins.add_argument("directory", help="snapshot root (SnapshotManager "
+                     "directory)")
+    ins.add_argument("--check", type=int, default=None, metavar="W",
+                     help="report per generation whether a re-shard to "
+                     "world W is possible; exit 0/3 from the newest "
+                     "complete generation")
+    ins.add_argument("--json", action="store_true",
+                     help="machine-readable output")
+    args = p.parse_args(argv)
+    return inspect_main(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,423 @@
+"""Elastic membership: deterministic ZeRO re-shard across world sizes.
+
+PR 5 made same-layout resume bitwise; this module makes a *different*
+world size resumable. The ZeRO ``layout_fingerprint`` — until now only a
+restore *guard* — doubles as a restore *re-map source*: together with
+the live params tree it reconstructs the exact bucket-shard-interleaved
+flat layout a snapshot was written under
+(:func:`apex_tpu.contrib.optimizers.zero.pack_layout` is deterministic
+in ``(params, chunk_elements, shard_count)``), so a snapshot saved at
+world ``W`` materializes at world ``W'`` by round-tripping every flat
+state array through the canonical (tensor-order, unpadded) form::
+
+    canonical = unshard(flat_W,  spec_W)       # drop per-bucket padding
+    flat_W'   = shard(canonical, spec_W')      # re-pad, re-interleave
+
+Both maps are exact permutations-plus-zero-padding — no arithmetic — so
+``gather(reshard(state)) == gather(state)`` **bitwise**, fp32 masters
+and Adam moments included (bucket padding stays zero through training:
+padding gradients are zero, and a zero-grad/zero-master Adam update is
+zero). :func:`reshard_flat` verifies exactly that gather-compare on
+every call unless ``verify=False``.
+
+Compatibility: two fingerprints re-shard iff they describe the SAME
+param tree — equal ``structure_crc32`` and ``total``. Anything else is
+a structurally incompatible checkpoint and still fails fast
+(:func:`can_reshard` is the single classifier; ``checkpoint._check_
+layout``'s mismatch message routes through it).
+
+Wiring (the membership-change arc):
+
+* :class:`Elastic` is the ``resilient_loop(..., elastic=...)`` seam —
+  on ``resume="auto"`` a world-mismatched snapshot restores through
+  :meth:`Elastic.restore` instead of raising, emits the
+  ``resilience/reshard`` marker (``meta.from_world`` / ``to_world``),
+  and the loop re-anchors ``trainer.notify_resume(step, world=...)``.
+* The cooperative leave path is the existing exit-75 contract: the
+  elastic supervisor (``python -m apex_tpu.parallel.multiproc
+  --elastic N``) SIGTERMs survivors of a node loss, each takes its
+  final snapshot and exits 75, and the relaunch at ``W' = W - lost``
+  resumes through this module.
+* ``python -m apex_tpu.resilience inspect DIR --check W`` reports
+  re-shard feasibility per generation from the manifests alone.
+
+Full guide: docs/resilience.md "Elastic membership".
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from apex_tpu.resilience.snapshot import Restored, SnapshotManager
+
+Tree = Any
+
+#: fingerprint fields that may differ between re-shardable layouts (they
+#: are all derived from shard_count/chunk_elements given the same tree)
+WORLD_KEYS = ("shard_count", "chunk_elements", "padded", "n_buckets")
+#: fingerprint fields that must MATCH for a re-shard to be possible
+TREE_KEYS = ("structure_crc32", "total")
+
+
+def _record(name: str, value: float, *, step=None, meta=None) -> None:
+    from apex_tpu import telemetry
+    if telemetry.enabled():
+        telemetry.record(name, value, step=step, meta=meta)
+
+
+# ---------------------------------------------------------------------------
+# fingerprint classification
+# ---------------------------------------------------------------------------
+
+#: :func:`classify_reshard` kinds — the TYPED contract callers branch
+#: on (never parse the human-readable reason strings)
+IDENTICAL = "identical"            # same fingerprint: plain restore
+RESHARDABLE = "reshardable"        # same tree, different world/chunk
+STRUCTURAL = "structural"          # different param tree: cannot help
+UNFINGERPRINTED = "unfingerprinted"   # not a ZeRO layout fingerprint
+
+
+def classify_reshard(source: Any, target: Any) -> Tuple[str, str]:
+    """``(kind, reason)`` — THE single classifier of a saved-vs-live
+    layout pair (``checkpoint._check_layout``, ``zero.check_layout``
+    and :func:`can_reshard` all route through it): ``kind`` is one of
+    :data:`IDENTICAL` / :data:`RESHARDABLE` / :data:`STRUCTURAL` /
+    :data:`UNFINGERPRINTED`; ``reason`` is the human-readable line for
+    error messages."""
+    for name, fp in (("source", source), ("target", target)):
+        if not isinstance(fp, dict):
+            return UNFINGERPRINTED, (
+                f"{name} layout fingerprint missing ({fp!r}) — nothing "
+                "records the flat layout")
+        missing = [k for k in TREE_KEYS + ("shard_count", "chunk_elements")
+                   if k not in fp]
+        if missing:
+            return UNFINGERPRINTED, (
+                f"{name} fingerprint lacks {missing} — not a ZeRO "
+                "layout fingerprint")
+    for k in TREE_KEYS:
+        if source[k] != target[k]:
+            return STRUCTURAL, (
+                f"structurally incompatible tree: {k} differs "
+                f"(saved {source[k]!r} vs live {target[k]!r}) — the "
+                "param tree itself changed, re-sharding cannot help")
+    if source == target:
+        return IDENTICAL, "identical layout (plain restore, no re-shard)"
+    return RESHARDABLE, (
+        f"re-shardable: world {source['shard_count']} "
+        f"(chunk {source['chunk_elements']}) -> world "
+        f"{target['shard_count']} (chunk {target['chunk_elements']})")
+
+
+def can_reshard(source: Any, target: Any) -> Tuple[bool, str]:
+    """``(ok, reason)`` — whether a state saved under ``source`` can be
+    deterministically re-mapped to ``target`` (both ZeRO layout
+    fingerprints). Boolean view of :func:`classify_reshard`."""
+    kind, reason = classify_reshard(source, target)
+    return kind in (IDENTICAL, RESHARDABLE), reason
+
+
+def check_world(fingerprint: Any, world: int) -> Tuple[bool, str]:
+    """Manifest-only feasibility of a re-shard to ``world`` (the
+    ``inspect --check W`` form: no params tree in hand, so this verifies
+    the fingerprint is a complete re-map source and reports what the
+    restore-time check will additionally require)."""
+    if world < 1:
+        return False, f"target world must be >= 1, got {world}"
+    if not isinstance(fingerprint, dict) or any(
+            k not in fingerprint
+            for k in TREE_KEYS + ("shard_count", "chunk_elements")):
+        return False, ("no ZeRO layout fingerprint recorded — the "
+                       "snapshot cannot be re-sharded (re-save with "
+                       "layout=opt.layout_fingerprint(params))")
+    src = int(fingerprint["shard_count"])
+    if src == world:
+        return True, f"same world ({world}): plain restore"
+    return True, (
+        f"re-shard {src} -> {world} possible (restore will verify the "
+        f"live params tree matches structure_crc32="
+        f"{int(fingerprint['structure_crc32']):#010x}, "
+        f"total={int(fingerprint['total'])})")
+
+
+# ---------------------------------------------------------------------------
+# the deterministic re-map
+# ---------------------------------------------------------------------------
+
+def spec_for(params: Tree, fingerprint: Dict[str, Any]) -> dict:
+    """Rebuild the flat-layout spec a fingerprint describes, from the
+    live params tree. Raises when the rebuilt layout disagrees with the
+    recorded one — the fingerprint then does not describe THESE params
+    and a re-map would scramble."""
+    from apex_tpu.contrib.optimizers import zero as _zero
+    spec = _zero.pack_layout(
+        params, chunk_elements=int(fingerprint["chunk_elements"]),
+        shard_count=int(fingerprint["shard_count"]))
+    rebuilt = {
+        "chunk_elements": spec["chunk_elements"],
+        "shard_count": spec["shard_count"],
+        "total": spec["total"],
+        "padded": spec["padded"],
+        "n_buckets": len(spec["buckets"]),
+        "structure_crc32": _zero.structure_crc(params),
+    }
+    bad = {k: (fingerprint.get(k), v) for k, v in rebuilt.items()
+           if fingerprint.get(k) != v}
+    if bad:
+        raise ValueError(
+            "layout fingerprint does not describe this params tree — "
+            f"rebuilt layout disagrees on {bad}. The checkpoint was "
+            "saved for a different model; re-sharding cannot help.")
+    return spec
+
+
+def unshard(flat: Any, spec: dict) -> np.ndarray:
+    """W-sharded flat array (bucket-shard-interleaved, ``(padded,)``) ->
+    canonical tensor-order array ``(total,)`` with per-bucket padding
+    dropped — the "gather" of the gather-compare contract."""
+    flat = np.asarray(flat)
+    n = spec["shard_count"]
+    if flat.shape != (spec["padded"],):
+        raise ValueError(
+            f"flat state has shape {flat.shape}, but the layout spec "
+            f"describes ({spec['padded']},) at world {n}")
+    rows = flat.reshape(n, spec["padded"] // n)
+    out = np.empty((spec["total"],), flat.dtype)
+    off = 0
+    for b in spec["buckets"]:
+        blk = rows[:, off:off + b["k"]].reshape(-1)   # (padded_b,)
+        out[b["start"]:b["start"] + b["size"]] = blk[:b["size"]]
+        off += b["k"]
+    return out
+
+
+def shard(canonical: Any, spec: dict) -> np.ndarray:
+    """Canonical ``(total,)`` array -> the spec's bucket-shard-interleaved
+    flat form ``(padded,)`` (zero padding) — exactly the layout
+    ``_ZeroBase.init`` builds, so sharding the result with
+    ``P(axis_name)`` hands each device its expected slices."""
+    canonical = np.asarray(canonical)
+    if canonical.shape != (spec["total"],):
+        raise ValueError(
+            f"canonical state has shape {canonical.shape}, expected "
+            f"({spec['total']},)")
+    n = spec["shard_count"]
+    cols = []
+    for b in spec["buckets"]:
+        blk = canonical[b["start"]:b["start"] + b["size"]]
+        if b["padded"] > b["size"]:
+            blk = np.concatenate(
+                [blk, np.zeros((b["padded"] - b["size"],), blk.dtype)])
+        cols.append(blk.reshape(n, b["k"]))
+    rows = cols[0] if len(cols) == 1 else np.concatenate(cols, axis=1)
+    return np.ascontiguousarray(rows.reshape(-1))
+
+
+def reshard_flat(flat: Any, src_spec: dict, dst_spec: dict, *,
+                 verify: bool = True) -> np.ndarray:
+    """One flat state array: source layout -> target layout.
+
+    ``verify=True`` (default) pins the module contract on every call:
+    the gather of the re-sharded array must equal the gather of the
+    source bitwise. The check is O(total) numpy compares — noise against
+    the restore I/O it rides."""
+    canonical = unshard(flat, src_spec)
+    out = shard(canonical, dst_spec)
+    if verify and not np.array_equal(unshard(out, dst_spec), canonical):
+        raise AssertionError(
+            "re-shard verification failed: gather(reshard(state)) != "
+            "gather(state) — layout spec bug, refusing to hand back "
+            "scrambled state")
+    return out
+
+
+def reshard_state(state: Any, src_spec: dict, dst_spec: dict, *,
+                  verify: bool = True) -> Any:
+    """One :class:`~apex_tpu.contrib.optimizers.zero.ZeroState` at the
+    source layout -> the target layout (masters + both Adam moments
+    re-mapped, replicated ``step`` preserved)."""
+    from apex_tpu.contrib.optimizers.zero import ZeroState
+    return ZeroState(
+        step=np.asarray(state.step),
+        master=reshard_flat(state.master, src_spec, dst_spec,
+                            verify=verify),
+        exp_avg=reshard_flat(state.exp_avg, src_spec, dst_spec,
+                             verify=verify),
+        exp_avg_sq=reshard_flat(state.exp_avg_sq, src_spec, dst_spec,
+                                verify=verify))
+
+
+def _is_zero_state(x: Any) -> bool:
+    from apex_tpu.contrib.optimizers.zero import ZeroState
+    return isinstance(x, ZeroState)
+
+
+def reshard_tree(tree: Tree, src_spec: dict, dst_spec: dict, *,
+                 verify: bool = True) -> Tree:
+    """Re-map every ``ZeroState`` inside a full training-state pytree;
+    all other leaves (params, scaler state, step counters) are
+    world-independent and pass through untouched. Raises when the tree
+    holds NO ZeroState — an elastic restore that re-shards nothing is a
+    caller wiring bug, not a silent success."""
+    import jax
+    count = 0
+
+    def remap(node):
+        nonlocal count
+        if _is_zero_state(node):
+            count += 1
+            return reshard_state(node, src_spec, dst_spec, verify=verify)
+        return node
+
+    out = jax.tree_util.tree_map(remap, tree, is_leaf=_is_zero_state)
+    if count == 0:
+        raise ValueError(
+            "elastic re-shard found no ZeroState in the training state "
+            "tree — nothing here is sharded by world size; use a plain "
+            "restore instead")
+    return out
+
+
+def source_template(template: Tree, src_spec: dict) -> Tree:
+    """The live (target-world) training-state template with every
+    ``ZeroState``'s flat arrays resized to the SOURCE world's padded
+    length — what ``restore_npz`` needs to accept a W-world payload
+    before the re-map runs. Tree paths are unchanged, so the structure
+    key still matches."""
+    import jax
+    from apex_tpu.contrib.optimizers.zero import ZeroState
+
+    def resize(node):
+        if _is_zero_state(node):
+            flat = np.zeros((src_spec["padded"],), np.float32)
+            return ZeroState(step=np.asarray(node.step),
+                             master=flat, exp_avg=flat, exp_avg_sq=flat)
+        return node
+
+    return jax.tree_util.tree_map(resize, template,
+                                  is_leaf=_is_zero_state)
+
+
+# ---------------------------------------------------------------------------
+# snapshot-store integration
+# ---------------------------------------------------------------------------
+
+def reshard_restore(manager: SnapshotManager, template: Tree, *,
+                    params: Tree,
+                    optimizer: Optional[Any] = None,
+                    target: Optional[Dict[str, Any]] = None,
+                    verify: bool = True) -> Optional[Restored]:
+    """``restore_latest`` that survives a world-size change.
+
+    ``target`` (or ``optimizer.layout_fingerprint(params)``) is the
+    layout the LIVE run wants. A snapshot recorded under the identical
+    fingerprint restores as usual; one recorded under a re-shardable
+    fingerprint (same tree, different world/chunk — :func:`can_reshard`)
+    restores into a source-shaped template and re-maps, emitting the
+    ``resilience/reshard`` marker event with ``from_world``/``to_world``
+    meta. A structurally incompatible snapshot still raises. Returns
+    None when no valid generation exists (same as ``restore_latest``).
+    """
+    if target is None:
+        if optimizer is None:
+            raise ValueError("pass target= or optimizer=")
+        target = optimizer.layout_fingerprint(params)
+    manager.wait()   # an in-flight async write may be the latest gen
+    # Walk generations NEWEST-first, choosing the restore path from EACH
+    # generation's own recorded layout: an elastic fleet writes world-W
+    # and world-W' generations into one store, so the corruption
+    # fallback must be able to cross a layout boundary (a fixed
+    # latest-layout choice would fail fast on the older-world
+    # generation that restore_latest falls back to).
+    for gen in reversed(manager.generations()):
+        try:
+            saved = manager.manifest(gen).get("layout")
+        except (OSError, ValueError, KeyError):
+            # unreadable manifest: restore_generation does the
+            # warn + skipped_generation bookkeeping
+            manager.restore_generation(gen, template, layout=None)
+            continue
+        if saved == target or saved is None:
+            # identical layout — or a pre-elastic snapshot with no
+            # recorded layout, where restore_npz's structure/shape
+            # checks are the only guard left
+            found = manager.restore_generation(
+                gen, template, layout=target if saved is not None
+                else None)
+            if found is not None:
+                return found
+            continue
+        ok, reason = can_reshard(saved, target)
+        if not ok:
+            # a configuration error, not damage: fail fast (the
+            # _check_layout message names re-shardable vs structural)
+            raise ValueError(
+                f"cannot re-shard snapshot generation {gen} at "
+                f"{manager.directory}: {reason}")
+        src_spec = spec_for(params, saved)
+        dst_spec = spec_for(params, target)
+        found = manager.restore_generation(
+            gen, source_template(template, src_spec), layout=saved)
+        if found is None:
+            continue
+        t0 = time.perf_counter()
+        state = reshard_tree(found.state, src_spec, dst_spec,
+                             verify=verify)
+        _record("resilience/reshard", float(target["shard_count"]),
+                step=found.step,
+                meta={"from_world": int(saved["shard_count"]),
+                      "to_world": int(target["shard_count"]),
+                      "from_chunk": int(saved["chunk_elements"]),
+                      "to_chunk": int(target["chunk_elements"]),
+                      "generation": found.generation,
+                      "step": found.step,
+                      "verified": bool(verify),
+                      "reshard_s": round(time.perf_counter() - t0, 6)})
+        return found._replace(state=state)
+    return None
+
+
+class Elastic:
+    """The ``resilient_loop(..., elastic=...)`` seam: owns the live
+    optimizer + params so a resume can compute the target fingerprint
+    and re-shard a world-mismatched snapshot instead of failing fast.
+
+    ``last_reshard`` carries ``{"from_world", "to_world", "step",
+    "generation"}`` after a restore that actually re-mapped (None
+    otherwise) — the loop reads it to re-anchor
+    ``trainer.notify_resume(step, world=..., from_world=...)``.
+    """
+
+    def __init__(self, optimizer: Any, params: Tree, *,
+                 verify: bool = True):
+        self.optimizer = optimizer
+        self.params = params
+        self.verify = verify
+        self.last_reshard: Optional[Dict[str, Any]] = None
+
+    def target_layout(self) -> Dict[str, Any]:
+        return self.optimizer.layout_fingerprint(self.params)
+
+    def restore(self, manager: SnapshotManager, template: Tree, *,
+                layout: Optional[Dict[str, Any]] = None,
+                ) -> Optional[Restored]:
+        self.last_reshard = None
+        target = layout if layout is not None else self.target_layout()
+        found = reshard_restore(manager, template, params=self.params,
+                                target=target, verify=self.verify)
+        if found is not None:
+            # provenance from the manifest of the generation that
+            # ACTUALLY restored — not a second latest_manifest() read,
+            # which could race a concurrent save or name a generation
+            # the corruption fallback skipped past
+            saved = found.manifest.get("layout")
+            if isinstance(saved, dict) and saved != target:
+                self.last_reshard = {
+                    "from_world": int(saved["shard_count"]),
+                    "to_world": int(target["shard_count"]),
+                    "step": found.step,
+                    "generation": found.generation}
+        return found

@@ -11,6 +11,12 @@ resilience stack claims to survive are injectable on demand::
     APEX_TPU_FAULT=step:4:io_error    # first snapshot attempt at/after
                                       # step 4 raises OSError once
     APEX_TPU_FAULT=prob:0.05:kill:7   # seeded Bernoulli(0.05) per step
+    APEX_TPU_FAULT=step:3:node_loss         # SIGKILL — but only on the
+                                            # TARGET RANK (default 1)
+    APEX_TPU_FAULT=step:3:node_loss:0       # ...explicit target rank
+    APEX_TPU_FAULT=step:2:slow_node:250     # straggler: rank 1 sleeps
+                                            # 250 ms EVERY step >= 2
+    APEX_TPU_FAULT=step:2:slow_node:250:0   # ...explicit target rank
 
 Semantics:
 
@@ -27,22 +33,58 @@ Semantics:
 * ``io_error`` — arms a one-shot ``OSError`` consumed by the snapshot
   writer (:func:`raise_if_io_error`), exercising the retry-with-backoff
   path around transient save I/O.
+* ``node_loss`` — the elastic membership fault: SIGKILL, but ONLY when
+  this process's rank (:func:`fault_rank`: ``APEX_TPU_RANK``, else
+  ``PROCESS_ID``, else 0) equals the spec's target rank (optional 4th
+  field, default ``1``). Every member of a multi-process run can share
+  one ``APEX_TPU_FAULT`` env and exactly one process dies — and after
+  the fleet re-forms at world ``W-1`` the departed rank no longer
+  exists, so the fault never re-fires on the resumed run.
+* ``slow_node`` — the straggler fault: the target rank (optional 5th
+  field, default ``1``) sleeps the spec's milliseconds at the top of
+  EVERY step at/after the trigger (``step:N:slow_node:MS`` — recurring,
+  not one-shot: a straggler is a condition, not an event). The injected
+  excess lands inside the step's host span, so the trace merge's
+  straggler attribution names the slowed process.
 
 Determinism: the ``step:N`` form is exact; the ``prob:p[:seed]`` form
 draws one seeded Bernoulli per ``fire`` call, so a given seed reproduces
-the same fault schedule call-for-call.
+the same fault schedule call-for-call (``prob`` seeds for ``slow_node``
+ride the field after the milliseconds: ``prob:P:slow_node:MS[:seed]``).
 """
 
 from __future__ import annotations
 
 import os
 import signal
+import time
 from typing import Optional
 
 import numpy as np
 
 ENV_VAR = "APEX_TPU_FAULT"
-KINDS = ("kill", "sigterm", "nan_grad", "io_error")
+KINDS = ("kill", "sigterm", "nan_grad", "io_error", "node_loss",
+         "slow_node")
+
+#: default target rank for node_loss/slow_node — a NON-coordinator
+#: member, so killing it exercises the membership change without taking
+#: the snapshot-owning rank 0 down with it
+DEFAULT_TARGET_RANK = 1
+
+
+def fault_rank() -> int:
+    """This process's rank for fault targeting: ``APEX_TPU_RANK``, else
+    ``PROCESS_ID`` (the jax.distributed launcher contract), else 0.
+    Environment-only on purpose — fault parsing must not initialize a
+    jax backend."""
+    for var in ("APEX_TPU_RANK", "PROCESS_ID"):
+        v = os.environ.get(var)
+        if v is not None:
+            try:
+                return int(v)
+            except ValueError:
+                pass
+    return 0
 
 # The active injector (set by FaultInjector.install / from_env): the
 # snapshot writer consults it without plumbing an object through every
@@ -61,16 +103,34 @@ class FaultInjector:
     and io_error arm per-step state the producers read."""
 
     def __init__(self, kind: str, *, step: Optional[int] = None,
-                 prob: Optional[float] = None, seed: int = 0):
+                 prob: Optional[float] = None, seed: int = 0,
+                 rank: Optional[int] = None,
+                 delay_ms: Optional[float] = None):
         if kind not in KINDS:
             raise ValueError(
                 f"unknown fault kind {kind!r}; expected one of {KINDS}")
         if (step is None) == (prob is None):
             raise ValueError("exactly one of step=/prob= must be given")
+        if kind == "slow_node":
+            if delay_ms is None or delay_ms < 0:
+                raise ValueError(
+                    "slow_node needs a non-negative delay in ms "
+                    "('step:N:slow_node:MS[:rank]')")
+        elif delay_ms is not None:
+            raise ValueError(f"delay_ms only applies to slow_node, "
+                             f"not {kind!r}")
+        if rank is not None and kind not in ("node_loss", "slow_node"):
+            raise ValueError(f"rank targeting only applies to "
+                             f"node_loss/slow_node, not {kind!r}")
         self.kind = kind
         self.step = step
         self.prob = prob
         self.seed = seed
+        # targeted kinds default to rank 1 (module doc); untargeted
+        # kinds act on whichever process parsed the spec
+        self.rank = (rank if rank is not None else DEFAULT_TARGET_RANK) \
+            if kind in ("node_loss", "slow_node") else None
+        self.delay_ms = delay_ms
         self._rng = np.random.default_rng(seed)
         self._io_armed = False
         self._fired = False
@@ -78,25 +138,51 @@ class FaultInjector:
     # -- construction -------------------------------------------------------
     @classmethod
     def parse(cls, spec: str) -> "FaultInjector":
-        """``step:N:kind`` or ``prob:P:kind[:seed]`` (see module doc)."""
+        """``step:N:kind`` or ``prob:P:kind[:seed]``; targeted kinds
+        extend the tail: ``step:N:node_loss[:rank]``,
+        ``step:N:slow_node:MS[:rank]``, ``prob:P:node_loss[:seed]``,
+        ``prob:P:slow_node:MS[:seed]`` (see module doc)."""
         parts = spec.strip().split(":")
         try:
-            if parts[0] == "step" and len(parts) == 3:
-                return cls(parts[2], step=int(parts[1]))
-            if parts[0] == "prob" and len(parts) in (3, 4):
-                seed = int(parts[3]) if len(parts) == 4 else 0
+            if parts[0] == "step" and len(parts) >= 3:
+                kind, tail = parts[2], parts[3:]
+                kw: dict = {"step": int(parts[1])}
+                if kind == "node_loss" and len(tail) <= 1:
+                    if tail:
+                        kw["rank"] = int(tail[0])
+                    return cls(kind, **kw)
+                if kind == "slow_node" and 1 <= len(tail) <= 2:
+                    kw["delay_ms"] = float(tail[0])
+                    if len(tail) == 2:
+                        kw["rank"] = int(tail[1])
+                    return cls(kind, **kw)
+                if not tail:
+                    return cls(kind, **kw)
+            if parts[0] == "prob" and len(parts) >= 3:
+                kind, tail = parts[2], parts[3:]
                 p = float(parts[1])
                 if not 0.0 <= p <= 1.0:
                     raise ValueError(f"probability {p} outside [0, 1]")
-                return cls(parts[2], prob=p, seed=seed)
+                kw = {"prob": p}
+                if kind == "slow_node" and 1 <= len(tail) <= 2:
+                    kw["delay_ms"] = float(tail[0])
+                    tail = tail[1:]
+                if len(tail) <= 1 and (kind == "slow_node"
+                                       or len(parts) <= 4):
+                    if tail:
+                        kw["seed"] = int(tail[0])
+                    return cls(kind, **kw)
         except ValueError as e:
             raise ValueError(
                 f"bad {ENV_VAR} spec {spec!r}: {e}. Expected "
                 "'step:N:kind' or 'prob:P:kind[:seed]' with kind in "
-                f"{KINDS}") from e
+                f"{KINDS} (node_loss takes an optional trailing rank; "
+                "slow_node takes ':MS[:rank]')") from e
         raise ValueError(
             f"bad {ENV_VAR} spec {spec!r}: expected 'step:N:kind' or "
-            f"'prob:P:kind[:seed]' with kind in {KINDS}")
+            f"'prob:P:kind[:seed]' with kind in {KINDS} (node_loss "
+            "takes an optional trailing rank; slow_node takes "
+            "':MS[:rank]')")
 
     @classmethod
     def from_env(cls, install: bool = True) -> Optional["FaultInjector"]:
@@ -129,12 +215,34 @@ class FaultInjector:
             return step == self.step
         return bool(self._rng.random() < self.prob)
 
+    def targets_me(self) -> bool:
+        """True when THIS process is the fault's target (untargeted
+        kinds target whoever parsed the spec)."""
+        return self.rank is None or self.rank == fault_rank()
+
     def fire(self, step: int) -> None:
-        """Called at the top of step ``step``. kill/sigterm act here;
-        io_error arms the one-shot snapshot failure; nan_grad is read via
+        """Called at the top of step ``step``. kill/sigterm/node_loss
+        act here; slow_node sleeps here (recurring); io_error arms the
+        one-shot snapshot failure; nan_grad is read via
         :meth:`loss_mult` instead (it must flow into the traced loss)."""
+        if self.kind == "slow_node":
+            # recurring by design (module doc): every step at/after the
+            # trigger, on the target rank only — never sets _fired
+            if not self.targets_me():
+                return
+            hit = (step >= self.step if self.step is not None
+                   else bool(self._rng.random() < self.prob))
+            if hit:
+                time.sleep(self.delay_ms / 1000.0)
+            return
         if self.kind == "nan_grad" or not self._matches(step):
             return
+        if self.kind == "node_loss":
+            if self.targets_me():
+                self._fired = True
+                os.kill(os.getpid(), signal.SIGKILL)
+            return   # other ranks: stay armed, harmlessly — their copy
+            # of the shared spec never matches their rank
         self._fired = True
         if self.kind == "io_error":
             self._io_armed = True

@@ -79,6 +79,7 @@ def resilient_loop(step_fn: Callable, state: Tree, data, *, steps: int,
                    snapshot_every: int = 0,
                    resume: str = "auto",
                    layout: Optional[Dict[str, Any]] = None,
+                   elastic: Optional[Any] = None,
                    extra: Optional[Dict[str, Any]] = None,
                    injector: Optional[FaultInjector] = None,
                    handle_signals: bool = True,
@@ -116,6 +117,17 @@ def resilient_loop(step_fn: Callable, state: Tree, data, *, steps: int,
         Layout fingerprint (e.g. ZeRO ``layout_fingerprint``) recorded in
         every manifest and validated at restore — a resume under a
         different sharded-state layout fails fast, never loads scrambled.
+    elastic:
+        An :class:`apex_tpu.resilience.elastic.Elastic` (live optimizer
+        + params). With it, ``resume="auto"`` survives a WORLD-SIZE
+        change: a snapshot recorded under a re-shardable fingerprint
+        (same param tree, different shard_count/chunk resolution)
+        restores through the deterministic re-shard instead of failing
+        fast, emits the ``resilience/reshard`` marker, and — with a
+        trainer — re-anchors ``notify_resume(step, world=...,
+        from_world=...)``. Structurally incompatible snapshots still
+        raise. ``layout=`` keeps meaning the fingerprint SAVED with new
+        generations (the target layout).
     injector:
         Fault injector; default ``FaultInjector.from_env()`` (the
         ``APEX_TPU_FAULT`` env contract). ``fire(step)`` runs at the top
@@ -165,7 +177,9 @@ def resilient_loop(step_fn: Callable, state: Tree, data, *, steps: int,
                 "loop only sees dispatch boundaries, so this cadence "
                 "would silently stretch to their least common multiple")
         if injector is not None and getattr(injector, "step", None) \
-                is not None and injector.step % steps_per_call:
+                is not None \
+                and getattr(injector, "kind", None) != "slow_node" \
+                and injector.step % steps_per_call:
             raise ValueError(
                 f"fault injector targets step {injector.step}, which a "
                 f"steps_per_call={steps_per_call} trainer never "
@@ -175,13 +189,25 @@ def resilient_loop(step_fn: Callable, state: Tree, data, *, steps: int,
     start = 0
     resumed_from = None
     if mgr is not None and resume == "auto":
-        found = mgr.restore_latest(state, layout=layout)
+        if elastic is not None:
+            # world-size changes restore through the deterministic
+            # re-shard (apex_tpu.resilience.elastic module doc); the
+            # marker event lands there
+            found = elastic.restore(mgr, state, layout=layout)
+        else:
+            found = mgr.restore_latest(state, layout=layout)
         if found is not None:
             state, start, resumed_from = found.state, found.step, \
                 found.generation
             _record_resume(found)
             if trainer is not None:
-                trainer.notify_resume(found.step)
+                resharded = getattr(elastic, "last_reshard", None)
+                if resharded:
+                    trainer.notify_resume(
+                        found.step, world=resharded["to_world"],
+                        from_world=resharded["from_world"])
+                else:
+                    trainer.notify_resume(found.step)
             if on_resume is not None:
                 on_resume(found)
     if trainer is not None:

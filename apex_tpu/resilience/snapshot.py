@@ -342,61 +342,80 @@ class SnapshotManager:
                     ignore_errors=True)
 
     # -- restore -------------------------------------------------------------
+    def _skip(self, gen: int, gdir: str, e) -> None:
+        warnings.warn(
+            f"apex_tpu.resilience: skipping corrupt/partial snapshot "
+            f"generation {gen} at {gdir} ({e}); falling back to the "
+            "previous one")
+        _record("resilience/skipped_generation", 1.0, kind="counter",
+                meta={"generation": gen, "error": str(e)})
+
+    def restore_generation(self, gen: int, template: Tree, *,
+                           layout: Optional[Dict[str, Any]] = None,
+                           ) -> Optional[Restored]:
+        """Validate + load ONE generation. Corruption/partial damage
+        returns None after the warn + ``resilience/skipped_generation``
+        counter (the caller falls back to an older generation); a
+        layout-fingerprint or structure mismatch raises — that is a
+        CONFIGURATION error, not damage. The per-generation granularity
+        is what lets the elastic restore
+        (:func:`apex_tpu.resilience.elastic.reshard_restore`) pick the
+        right re-shard source per generation of a MIXED-layout store
+        (a fleet that re-formed writes world-W then world-W' gens into
+        one directory)."""
+        gdir = os.path.join(self.directory, _gen_name(gen))
+        try:
+            man = self.manifest(gen)
+            if not man.get("complete") \
+                    or man.get("manifest_version") != MANIFEST_VERSION:
+                raise ValueError(
+                    f"incomplete or unknown-version manifest: "
+                    f"{man.get('manifest_version')!r}")
+            payload = os.path.join(gdir, man.get("payload", PAYLOAD))
+            if "crc32" in man and _crc32_file(payload) != man["crc32"]:
+                raise ValueError("payload crc32 mismatch")
+            if "step" not in man:
+                raise ValueError("manifest carries no step")
+        except (OSError, ValueError, KeyError) as e:
+            self._skip(gen, gdir, e)
+            return None
+        if layout is not None and man.get("layout") != layout:
+            # configuration mismatch, not corruption — fail fast with
+            # both fingerprints (and, for a re-shardable world
+            # mismatch, the elastic recipe) in the message
+            checkpoint._check_layout(man.get("layout"), layout, gdir)
+        try:
+            state = checkpoint.restore_npz(payload, template,
+                                           expected_layout=layout)
+        except (FileNotFoundError, OSError) as e:
+            self._skip(gen, gdir, e)
+            return None
+        except ValueError as e:
+            if "truncated or corrupt" in str(e) \
+                    or "not an apex_tpu checkpoint" in str(e):
+                self._skip(gen, gdir, e)   # damage: older gens may be ok
+                return None
+            raise   # structure/shape/layout mismatch: config error
+        return Restored(state=state, step=int(man["step"]),
+                        generation=gen, manifest=man, path=gdir)
+
     def restore_latest(self, template: Tree, *,
                        layout: Optional[Dict[str, Any]] = None,
                        ) -> Optional[Restored]:
         """Load the newest VALID generation into ``template``'s
         structure/dtypes. Corrupt or partial generations are skipped with
         a warning + telemetry counter; a layout-fingerprint mismatch
-        raises (module doc). Returns None when no valid generation
-        exists."""
+        raises (module doc) — in a SAME-layout run every older
+        generation would mismatch identically, so skipping would just
+        fail N more times (mixed-layout stores from elastic membership
+        changes restore through ``elastic.reshard_restore``, which walks
+        generations with this per-generation granularity itself).
+        Returns None when no valid generation exists."""
         self.wait()  # an in-flight async write may be the latest gen
-
-        def skip(gen, gdir, e):
-            warnings.warn(
-                f"apex_tpu.resilience: skipping corrupt/partial snapshot "
-                f"generation {gen} at {gdir} ({e}); falling back to the "
-                "previous one")
-            _record("resilience/skipped_generation", 1.0, kind="counter",
-                    meta={"generation": gen, "error": str(e)})
-
         for gen in reversed(self.generations()):
-            gdir = os.path.join(self.directory, _gen_name(gen))
-            try:
-                man = self.manifest(gen)
-                if not man.get("complete") \
-                        or man.get("manifest_version") != MANIFEST_VERSION:
-                    raise ValueError(
-                        f"incomplete or unknown-version manifest: "
-                        f"{man.get('manifest_version')!r}")
-                payload = os.path.join(gdir, man.get("payload", PAYLOAD))
-                if "crc32" in man and _crc32_file(payload) != man["crc32"]:
-                    raise ValueError("payload crc32 mismatch")
-                if "step" not in man:
-                    raise ValueError("manifest carries no step")
-            except (OSError, ValueError, KeyError) as e:
-                skip(gen, gdir, e)
-                continue
-            if layout is not None and man.get("layout") != layout:
-                # configuration mismatch, not corruption: every older
-                # generation of this run carries the same layout, so
-                # skipping would just fail N more times — fail fast with
-                # both fingerprints in the message
-                checkpoint._check_layout(man.get("layout"), layout, gdir)
-            try:
-                state = checkpoint.restore_npz(payload, template,
-                                               expected_layout=layout)
-            except (FileNotFoundError, OSError) as e:
-                skip(gen, gdir, e)
-                continue
-            except ValueError as e:
-                if "truncated or corrupt" in str(e) \
-                        or "not an apex_tpu checkpoint" in str(e):
-                    skip(gen, gdir, e)   # damage: older gens may be fine
-                    continue
-                raise   # structure/shape/layout mismatch: config error
-            return Restored(state=state, step=int(man["step"]),
-                            generation=gen, manifest=man, path=gdir)
+            found = self.restore_generation(gen, template, layout=layout)
+            if found is not None:
+                return found
         return None
 
     def latest_manifest(self) -> Optional[Dict[str, Any]]:

@@ -450,6 +450,17 @@ def summarize(events: List[Dict[str, Any]], *,
         resil["resumes"] = resumes
         if superseded:
             resil["superseded_samples"] = superseded
+    # elastic membership changes: one resilience/reshard marker per
+    # world-size re-map (emitted by resilience.elastic next to the
+    # resume marker), meta carries from/to worlds
+    reshards = [{"step": e.get("step"),
+                 "from_world": (e.get("meta") or {}).get("from_world"),
+                 "to_world": (e.get("meta") or {}).get("to_world"),
+                 "generation": (e.get("meta") or {}).get("generation")}
+                for e in events
+                if e.get("name", "").endswith("resilience/reshard")]
+    if reshards:
+        resil["reshards"] = reshards
     snap_s = [v for name, vs in series.items()
               if name.endswith("resilience/snapshot_s") for v in vs]
     if snap_s:
@@ -896,6 +907,11 @@ def format_summary(s: Dict[str, Any]) -> str:
         for rp in r.get("resumes", []):
             lines.append(f"  resumed from generation {rp['generation']}"
                          f" at step {rp['step']}")
+        for rs in r.get("reshards", []):
+            lines.append(
+                f"  elastic reshard world {rs['from_world']} -> "
+                f"{rs['to_world']} at step {rs['step']} (deterministic "
+                "re-map, gather-verified)")
         if r.get("superseded_samples"):
             lines.append(
                 f"  {r['superseded_samples']} pre-resume samples of "

@@ -332,11 +332,27 @@ class Trainer:
         delivered after the plugin callbacks, same retirement rule."""
         self._user_on_step = cb
 
-    def notify_resume(self, step: int) -> None:
+    def notify_resume(self, step: int, *, world: Optional[int] = None,
+                      from_world: Optional[int] = None) -> None:
         """Re-anchor the global step index after a snapshot restore and
         fan out to every plugin's ``on_resume`` (telemetry re-attributes
-        its ``step/*`` series; see docs/trainer.md)."""
+        its ``step/*`` series; see docs/trainer.md).
+
+        An ELASTIC resume additionally passes ``world``/``from_world``
+        (the re-shard's target/source world sizes): the step counter
+        re-anchors identically, and a ``trainer/resume`` event records
+        the membership change so the post-resume ``step/*`` series is
+        attributable to its new world (per-step comm bytes, MFU and
+        tokens/s all change meaning when the world does)."""
         self.step_index = int(step)
+        if world is not None:
+            from apex_tpu import telemetry
+            if telemetry.enabled():
+                telemetry.record(
+                    "trainer/resume", float(step), step=int(step),
+                    meta={"world": int(world),
+                          "from_world": (None if from_world is None
+                                         else int(from_world))})
         for p in self.plugins:
             hook = getattr(p, "on_resume", None)
             if hook is not None:

@@ -426,6 +426,10 @@ def main():
                      "in_flight": in_flight,
                      "donation": donation.to_json()}
                     if trainer_on else None),
+        # elastic re-shard cost (BENCH_ELASTIC=1: time a world->world/2
+        # deterministic re-map of this model's ZeRO state, gather-
+        # verified); null when off — rows stay schema-comparable
+        "elastic": None,
     }
     if trace_on:
         # the wall-vs-device gap, itemized: top host span families by
@@ -554,6 +558,37 @@ def main():
         log(f"snapshot: {man['bytes'] / 1e6:.1f} MB, sync "
             f"{sync_s * 1e3:.0f} ms, async caller-side block "
             f"{async_block_s * 1e3:.0f} ms -> {snap_dir}")
+
+    # BENCH_ELASTIC=1: the membership-change bill — time the
+    # deterministic W -> W/2 re-shard of THIS model's ZeRO optimizer
+    # state (fp32 master + both Adam moments, gather-verified bitwise
+    # on every call), so elastic-resume budgeting is sized from data.
+    if os.environ.get("BENCH_ELASTIC"):
+        from apex_tpu.contrib.optimizers.zero import DistributedFusedAdam
+        from apex_tpu.resilience import elastic as _elastic
+        params, _, _ = state
+        w_from = jax.device_count()
+        w_to = max(w_from // 2, 1)
+        opt_src = DistributedFusedAdam(shard_count=w_from)
+        opt_dst = DistributedFusedAdam(shard_count=w_to)
+        src_spec = _elastic.spec_for(
+            params, opt_src.layout_fingerprint(params))
+        dst_spec = _elastic.spec_for(
+            params, opt_dst.layout_fingerprint(params))
+        zstate = jax.tree_util.tree_map(np.asarray,
+                                        opt_src.init(params))
+        t0 = time.perf_counter()
+        _elastic.reshard_state(zstate, src_spec, dst_spec)
+        reshard_s = time.perf_counter() - t0
+        result["elastic"] = {
+            "from_world": w_from, "to_world": w_to,
+            "state_bytes": int(3 * 4 * src_spec["padded"]),
+            "reshard_s": round(reshard_s, 4),
+            "verify": "bitwise-gather",
+        }
+        log(f"elastic: reshard world {w_from} -> {w_to} of "
+            f"{3 * 4 * src_spec['padded'] / 1e6:.1f} MB ZeRO state in "
+            f"{reshard_s * 1e3:.1f} ms (gather-verified)")
 
     print(json.dumps(result))
 

@@ -12,7 +12,7 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "== 1/14 package import =="
+echo "== 1/15 package import =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import apex_tpu
@@ -20,7 +20,7 @@ from apex_tpu import amp, optimizers, parallel, ops
 print('apex_tpu imports OK')
 "
 
-echo "== 2/14 native host runtime builds (g++ -O3 -shared) =="
+echo "== 2/15 native host runtime builds (g++ -O3 -shared) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 from apex_tpu import runtime
@@ -35,7 +35,7 @@ print('flatten/unflatten path OK')
 assert ok, 'host runtime failed to build — check g++ toolchain'
 "
 
-echo "== 3/14 graft entry compiles (single-device + 8-device dryrun) =="
+echo "== 3/15 graft entry compiles (single-device + 8-device dryrun) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import __graft_entry__ as ge
@@ -45,7 +45,7 @@ print('entry() compiles')
 ge.dryrun_multichip(8)
 "
 
-echo "== 4/14 package install (wheel build + clean --target install) =="
+echo "== 4/15 package install (wheel build + clean --target install) =="
 # The reference gates on Docker extension builds
 # (tests/docker_extension_builds/run.sh); the TPU analog: build the wheel
 # from pyproject.toml, install it into an empty --target dir, and import
@@ -88,14 +88,14 @@ jax.jit(step).lower(params, state).compile()
 print('installed-package train step compiles')
 ")
 
-echo "== 5/14 lint (apex_tpu.lint: trace safety / dtype policy / collectives) =="
+echo "== 5/15 lint (apex_tpu.lint: trace safety / dtype policy / collectives) =="
 # static gate BEFORE the test tier: AST pass over the package + graft
 # entry, jaxpr pass over the registered entry points. --strict: warnings
 # fail too (every intentional exception carries an inline suppression
 # with its why — see docs/lint.md). Use --format=github under CI bots.
 python -m apex_tpu.lint apex_tpu/ __graft_entry__.py --strict
 
-echo "== 6/14 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
+echo "== 6/15 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
 # A 3-step instrumented GPT train step on the CPU mesh must produce a
 # parseable JSONL carrying step timing, amp loss-scale/overflow, comm
 # bytes and MFU, and the summarize CLI must render it (exit 0) — the
@@ -168,7 +168,7 @@ fi
 echo "health CLI gate OK (healthy=0, injected-NaN=nonzero)"
 rm -rf "$(dirname "$HLT_FILE")"
 
-echo "== 7/14 tune smoke (sweep dry-run + auto-policy tuned train) =="
+echo "== 7/15 tune smoke (sweep dry-run + auto-policy tuned train) =="
 # The autotuner must be drivable offline (sweep plan renders, exit 0) and
 # inline: a 3-step train whose kernels resolve their configs through
 # apex_tpu.tune under APEX_TPU_TUNE=auto. On this CPU backend measurement
@@ -245,7 +245,7 @@ print(f'tune smoke OK: {len(tuned)} tune/* series, '
 " "$TUNE_DIR/tune_run.jsonl" "$TUNE_DIR/cache"
 rm -rf "$TUNE_DIR"
 
-echo "== 8/14 resilience smoke (snapshot -> injected kill -> auto-resume) =="
+echo "== 8/15 resilience smoke (snapshot -> injected kill -> auto-resume) =="
 # Kill-and-resume end to end: a 6-step train snapshotting every 2 steps is
 # SIGKILLed by the fault injector at the top of step 4 (exit 137 — an
 # abrupt death, no final snapshot), then the SAME command with --resume
@@ -302,7 +302,7 @@ python -m apex_tpu.telemetry summarize "$RES_DIR/resume.jsonl" \
     || { echo "summarize did not report the resume point" >&2; exit 1; }
 rm -rf "$RES_DIR"
 
-echo "== 9/14 overlap smoke (staged backward + bf16 wire vs fp32 baseline) =="
+echo "== 9/15 overlap smoke (staged backward + bf16 wire vs fp32 baseline) =="
 # The overlap engine end to end on the 8-device CPU mesh: a 3-step fp32
 # baseline train and the same train under --overlap --reduce-dtype bf16
 # must (a) land within 1e-2 of each other's final loss (the compression
@@ -358,7 +358,7 @@ python -m apex_tpu.telemetry summarize "$OVL_DIR/bf16.jsonl" \
     || { echo "summarize did not render overlap efficiency" >&2; exit 1; }
 rm -rf "$OVL_DIR"
 
-echo "== 10/14 profile smoke (capture -> attribution report -> compare gate) =="
+echo "== 10/15 profile smoke (capture -> attribution report -> compare gate) =="
 # The attribution profiler end to end on the CPU backend: a 3-step train
 # with --profile must produce a capture logdir whose offline report
 # parses with nonzero compute time and carries the named
@@ -419,7 +419,7 @@ fi
 echo "compare gate OK (identical=0, doctored-slower=4)"
 rm -rf "$PROF_DIR"
 
-echo "== 11/14 trace smoke (host spans -> unified timeline -> merge/stragglers) =="
+echo "== 11/15 trace smoke (host spans -> unified timeline -> merge/stragglers) =="
 # The host-tracing layer end to end: a 3-step --trace train must emit
 # parseable span/* begin/end pairs, the unified host+device timeline
 # must export as valid Chrome-trace JSON with BOTH lanes populated,
@@ -492,7 +492,7 @@ grep -q "worst: p" "$TRC_DIR/merged.txt" \
 echo "trace smoke OK (spans + timeline + reconciliation + 2-process merge)"
 rm -rf "$TRC_DIR"
 
-echo "== 12/14 trainer smoke (compiled-step builder: pipelined dispatch + donation audit) =="
+echo "== 12/15 trainer smoke (compiled-step builder: pipelined dispatch + donation audit) =="
 # The compiled trainer end to end: a 3-step train_lm built through
 # apex_tpu.trainer with telemetry+trace on must (a) emit balanced
 # span/* begin/end pairs (the in-flight window's trainer/retire spans
@@ -537,7 +537,7 @@ grep -q "donation audit: .* 0 refused" "$TRN_DIR/out.txt" \
     || { echo "train_lm did not print the donation audit" >&2; exit 1; }
 rm -rf "$TRN_DIR"
 
-echo "== 13/14 fused-kernel regression (Pallas xentropy vs unfused + epilogue/mt scopes) =="
+echo "== 13/15 fused-kernel regression (Pallas xentropy vs unfused + epilogue/mt scopes) =="
 # The fused-kernel tier end to end (docs/kernels.md): the SAME 3-step GPT
 # train profiled unfused and fused (Pallas xentropy in the loss scope)
 # must (a) surface the apex_xentropy scope in the fused breakdown,
@@ -638,7 +638,69 @@ print('conv epilogue + mt flat: parity + capture scopes OK')
 echo "fused-kernel gate OK (scopes + parity + compare exit 0)"
 rm -rf "$KRN_DIR"
 
-echo "== 14/14 pytest =="
+echo "== 14/15 elastic smoke (2-process node_loss -> re-shard resume at world 1) =="
+# Elastic membership end to end (docs/resilience.md "Elastic
+# membership"): a 2-member ZeRO fleet under the multiproc --elastic
+# supervisor loses rank 1 to an injected node_loss SIGKILL at step 3;
+# the survivor leaves cooperatively (SIGTERM -> final snapshot ->
+# exit 75), the fleet re-forms at world 1 and the relaunch resumes via
+# the DETERMINISTIC re-shard (world-2 snapshot materialized at world 1,
+# gather-verified bitwise). The gate then demands: supervisor exit 0,
+# a full 6-step loss trajectory from the resumed member, the
+# resilience/reshard marker with from/to worlds in the telemetry JSONL,
+# and the inspect CLI confirming re-shard feasibility from the
+# manifests alone.
+ELA_DIR="$(mktemp -d)"
+rc=0
+APEX_TPU_FAULT=step:3:node_loss \
+python -m apex_tpu.parallel.multiproc --elastic 2 \
+    --rendezvous "$ELA_DIR/rdzv" --grace 120 -- \
+    python tests/elastic_worker.py --steps 6 \
+    --snap "$ELA_DIR/snap-r{rank}" --out "$ELA_DIR/out-r{rank}.npz" \
+    --telemetry "$ELA_DIR/tel-r{rank}.jsonl" \
+    --resume auto --step-ms 150 > "$ELA_DIR/supervisor.out" || rc=$?
+if [[ "$rc" -ne 0 ]]; then
+    echo "elastic: supervisor did not complete (rc=$rc)" >&2
+    cat "$ELA_DIR/supervisor.out" >&2
+    exit 1
+fi
+grep -q "rank 1 LOST" "$ELA_DIR/supervisor.out" \
+    || { echo "elastic: no node loss observed" >&2; exit 1; }
+grep -q "re-forming at world 1" "$ELA_DIR/supervisor.out" \
+    || { echo "elastic: fleet did not re-form at world 1" >&2; exit 1; }
+python -c "
+import json, sys
+import numpy as np
+d = sys.argv[1]
+out = np.load(d + '/out-r0.npz')
+assert int(out['world']) == 1, f'final run not at world 1: {out[\"world\"]}'
+assert int(out['resumed_from']) >= 0, 'resumed run did not restore'
+steps = sorted(int(s) for s, _ in out['losses'])
+assert steps and steps[-1] == 5, f'resumed run did not complete: {steps}'
+reshard = None
+names = set()
+for line in open(d + '/tel-r0.jsonl'):
+    row = json.loads(line)              # every line must parse
+    names.add(row['name'])
+    if row['name'] == 'resilience/reshard':
+        reshard = row
+assert reshard is not None, f'no resilience/reshard marker in {sorted(names)}'
+meta = reshard['meta']
+assert meta['from_world'] == 2 and meta['to_world'] == 1, meta
+assert meta['verified'], meta
+assert 'resilience/resume' in names, 'reshard without a resume marker'
+print(f'elastic smoke OK: world 2 -> 1 at step {meta[\"step\"]} '
+      f'(generation {meta[\"generation\"]}, gather-verified), '
+      f'resumed run completed 6 steps')
+" "$ELA_DIR"
+# manifest-only feasibility: the inspect CLI agrees, straight from disk
+python -m apex_tpu.resilience inspect "$ELA_DIR/snap-r0" --check 1 \
+    | grep -q "world 1: OK" \
+    || { echo "inspect --check 1 did not confirm re-shardability" >&2; \
+         exit 1; }
+rm -rf "$ELA_DIR"
+
+echo "== 15/15 pytest =="
 if [[ "${1:-}" == "--full" ]]; then
     # full suite + the complete L1 cross-product matrix (reference
     # tests/L1/cross_product{,_distributed}/run.sh); the convergence
@@ -652,7 +714,8 @@ else
     python -m pytest tests/test_multi_tensor.py tests/test_optimizers.py \
         tests/test_amp.py tests/test_param_groups.py tests/test_zero.py \
         tests/test_checkpoint.py tests/test_runtime.py tests/test_tune.py \
-        tests/test_resilience.py tests/test_overlap.py \
+        tests/test_resilience.py tests/test_elastic.py \
+        tests/test_overlap.py \
         tests/test_trainer.py tests/test_kernels.py \
         tests/test_pyprof.py tests/test_trace.py -q -x
 fi

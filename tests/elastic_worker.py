@@ -1,0 +1,156 @@
+"""Subprocess member for the elastic node-loss tests and the CI gate's
+elastic smoke (stage 14): one fleet member running a tiny ZeRO
+(``DistributedFusedAdam``) train at ``world = APEX_TPU_WORLD`` on a
+virtual CPU mesh, driven by ``resilient_loop`` with an
+``elastic=Elastic(opt, params)`` resume seam — so a relaunch at a
+DIFFERENT world size restores through the deterministic re-shard
+(``resilience/reshard`` marker in the telemetry JSONL).
+
+Spawned by ``python -m apex_tpu.parallel.multiproc --elastic N -- ...``
+(which sets APEX_TPU_WORLD/APEX_TPU_RANK/APEX_TPU_RENDEZVOUS and
+substitutes {rank}/{world} in the args), or standalone with the env
+set by hand for the fresh-run baseline.
+
+Usage: python elastic_worker.py --steps N --snap DIR --out OUT.npz
+         [--telemetry PATH] [--resume auto|none] [--snap-every K]
+         [--step-ms MS] [--chunk N]
+
+Writes OUT.npz with the (step, loss) trajectory observed by THIS
+process, the final replicated params, and the CANONICAL (unsharded,
+world-independent) fp32 master + Adam moments — so runs at different
+world sizes compare directly.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, required=True)
+    ap.add_argument("--snap", required=True)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--telemetry", default=None)
+    ap.add_argument("--resume", default="auto", choices=["auto", "none"])
+    ap.add_argument("--snap-every", type=int, default=2)
+    ap.add_argument("--step-ms", type=float, default=0.0,
+                    help="host-side sleep per step — makes the node-loss "
+                    "window deterministic in the supervisor tests")
+    ap.add_argument("--chunk", type=int, default=256)
+    args = ap.parse_args()
+
+    from apex_tpu import parallel, resilience, telemetry
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+    from apex_tpu.parallel import multiproc
+    from jax import shard_map
+
+    world, rank = multiproc.elastic_world()
+    if jax.device_count() < world:
+        print(f"elastic_worker: {jax.device_count()} devices < world "
+              f"{world}", file=sys.stderr)
+        sys.exit(2)
+
+    rdzv = None
+    rdzv_dir = os.environ.get(multiproc.ENV_RENDEZVOUS)
+    if rdzv_dir:
+        # join barrier: the fleet agrees on membership before the mesh
+        # forms at this world size
+        rdzv = multiproc.Rendezvous(rdzv_dir, member=f"{rank:04d}")
+        rdzv.announce()
+        rdzv.wait_world(world, timeout_s=60)
+
+    if args.telemetry:
+        telemetry.enable()
+
+    mesh = parallel.reform_mesh(world)
+    key = jax.random.PRNGKey(7)
+    ks = jax.random.split(key, 3)
+    params = {"w1": jax.random.normal(ks[0], (37, 11)),
+              "w2": jax.random.normal(ks[1], (501,)),
+              "b": jax.random.normal(ks[2], (3,))}
+    opt = DistributedFusedAdam(lr=0.05, shard_count=world,
+                               chunk_elements=args.chunk)
+    zstate = opt.init(params)
+    layout = opt.layout_fingerprint(params)
+    specs = opt.state_pspec()
+
+    def loss_fn(p, x):
+        return sum(jnp.mean((leaf * x - 0.5) ** 2)
+                   for leaf in jax.tree_util.tree_leaves(p))
+
+    sharded_step = shard_map(
+        opt.step, mesh=mesh, in_specs=(P(), P(), specs),
+        out_specs=(P(), specs), check_vma=False)
+
+    @jax.jit
+    def train_step(st, x):
+        p, z = st
+        loss, g = jax.value_and_grad(loss_fn)(p, x)
+        new_p, new_z = sharded_step(g, p, z)
+        return (new_p, new_z), loss
+
+    def make_x(i):
+        # addressable by step index: every member (and every resumed
+        # world) regenerates the identical batch stream
+        return jnp.asarray(
+            np.random.default_rng([11, i]).uniform(0.5, 1.5), jnp.float32)
+
+    losses = []
+
+    def step_fn(st, x, i):
+        if args.step_ms:
+            time.sleep(args.step_ms / 1e3)
+        return train_step(st, x)
+
+    result = resilience.resilient_loop(
+        step_fn, (params, zstate), make_x, steps=args.steps,
+        snapshot_dir=args.snap, snapshot_every=args.snap_every,
+        resume=args.resume, layout=layout,
+        elastic=resilience.Elastic(opt, params),
+        on_step=lambda i, st, loss: losses.append((i, float(loss))))
+
+    if result.preempted and rdzv is not None:
+        rdzv.leave()   # cooperative departure: next world() excludes us
+
+    if args.telemetry:
+        telemetry.write_jsonl(args.telemetry)
+
+    final_params, final_z = result.state
+    src_spec = resilience.elastic.spec_for(params, layout)
+    out = {
+        "losses": np.asarray(losses, np.float64),
+        "world": np.asarray(world),
+        "resumed_from": np.asarray(
+            -1 if result.resumed_from is None else result.resumed_from),
+        # canonical (world-independent) sharded-state views
+        "master": resilience.elastic.unshard(
+            np.asarray(final_z.master), src_spec),
+        "exp_avg": resilience.elastic.unshard(
+            np.asarray(final_z.exp_avg), src_spec),
+        "exp_avg_sq": resilience.elastic.unshard(
+            np.asarray(final_z.exp_avg_sq), src_spec),
+    }
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(final_params)):
+        out[f"param_{i}"] = np.asarray(leaf)
+    np.savez(args.out, **out)
+    print(f"done: rank {rank}/{world} step {result.step} "
+          f"resumed_from={result.resumed_from} "
+          f"preempted={result.preempted}")
+    sys.exit(result.exit_code)
+
+
+if __name__ == "__main__":
+    main()

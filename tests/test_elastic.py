@@ -1,0 +1,587 @@
+"""Elastic membership tests: the deterministic ZeRO re-shard (the
+acceptance pin — gather(W-sharded state) == gather(reshard-to-W' state)
+BITWISE for real trained state, fp32 masters and Adam moments included),
+the snapshot-store re-shard restore path, the resilient_loop elastic
+seam, the multiproc rendezvous + supervisor (real node_loss SIGKILL in a
+2-process fleet, resumed at world 1), the inspect CLI, and the slow_node
+straggler attribution through the PR 8 two-process merge fixture."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu import parallel, resilience, telemetry
+from apex_tpu.contrib.optimizers import DistributedFusedAdam
+from apex_tpu.contrib.optimizers.zero import ZeroState, pack_layout
+from apex_tpu.resilience import elastic
+from apex_tpu.resilience.faults import FaultInjector
+
+WORKER = os.path.join(os.path.dirname(__file__), "elastic_worker.py")
+
+
+def tree_params(key=None):
+    ks = jax.random.split(key or jax.random.PRNGKey(3), 3)
+    # sizes deliberately NOT divisible by any world size in play, so
+    # every bucket carries world-dependent padding
+    return {"w1": jax.random.normal(ks[0], (37, 11)),
+            "w2": jax.random.normal(ks[1], (501,)),
+            "b": jax.random.normal(ks[2], (3,))}
+
+
+def train_zero(world, params, *, steps=3, chunk=256):
+    """Real ZeRO training at ``world`` on a device-subset mesh; returns
+    (opt, final ZeroState, final params) with genuinely nonzero
+    moments."""
+    mesh = parallel.reform_mesh(world)
+    opt = DistributedFusedAdam(lr=0.05, shard_count=world,
+                               chunk_elements=chunk)
+    state = opt.init(params)
+    specs = opt.state_pspec()
+    step = jax.jit(shard_map(
+        opt.step, mesh=mesh, in_specs=(P(), P(), specs),
+        out_specs=(P(), specs), check_vma=False))
+    state = jax.device_put(state, jax.tree_util.tree_map(
+        lambda sp: NamedSharding(mesh, sp), specs))
+    for i in range(steps):
+        ks = jax.random.split(jax.random.PRNGKey(100 + i), len(params))
+        grads = {name: jax.random.normal(k, v.shape, jnp.float32)
+                 for k, (name, v) in zip(ks, sorted(params.items()))}
+        params, state = step(grads, params, state)
+    return opt, state, params
+
+
+# ---------------------------------------------------------------------------
+# the acceptance pin: bitwise gather-compare on real trained state
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("src_w,dst_w", [(2, 1), (1, 2), (4, 2)])
+def test_reshard_gather_bitwise(src_w, dst_w):
+    params = tree_params()
+    opt, state, _ = train_zero(src_w, params)
+    src_fp = opt.layout_fingerprint(params)
+    dst_fp = DistributedFusedAdam(
+        shard_count=dst_w, chunk_elements=256).layout_fingerprint(params)
+    src_spec = elastic.spec_for(params, src_fp)
+    dst_spec = elastic.spec_for(params, dst_fp)
+    out = elastic.reshard_state(state, src_spec, dst_spec)
+    assert out.master.shape == (dst_fp["padded"],)
+    for field in ("master", "exp_avg", "exp_avg_sq"):
+        a = elastic.unshard(np.asarray(getattr(state, field)), src_spec)
+        b = elastic.unshard(np.asarray(getattr(out, field)), dst_spec)
+        np.testing.assert_array_equal(a, b, err_msg=field)
+        assert np.any(a != 0), f"{field} trivially zero — test proves " \
+            "nothing"
+    assert int(np.asarray(out.step)) == int(np.asarray(state.step))
+
+
+def test_reshard_across_chunk_change_bitwise():
+    params = tree_params()
+    opt, state, _ = train_zero(2, params, chunk=256)
+    src_fp = opt.layout_fingerprint(params)
+    src_spec = elastic.spec_for(params, src_fp)
+    dst_fp = DistributedFusedAdam(
+        shard_count=2, chunk_elements=1000).layout_fingerprint(params)
+    # a real bucket-boundary change, not just a relabeled capacity
+    assert dst_fp["n_buckets"] != src_fp["n_buckets"]
+    dst_spec = elastic.spec_for(params, dst_fp)
+    out = elastic.reshard_state(state, src_spec, dst_spec)
+    np.testing.assert_array_equal(
+        elastic.unshard(np.asarray(state.master), src_spec),
+        elastic.unshard(np.asarray(out.master), dst_spec))
+
+
+def test_resharded_state_continues_training_identically():
+    """Continuing at the NEW world from re-sharded state produces the
+    same parameters as continuing at the old world — the trajectory half
+    of the ROADMAP item 4 acceptance, in-process."""
+    params = tree_params()
+    opt2, state2, params2 = train_zero(2, params, steps=2)
+    fp2 = opt2.layout_fingerprint(params)
+    fp1 = DistributedFusedAdam(
+        shard_count=1, chunk_elements=256).layout_fingerprint(params)
+    state1 = elastic.reshard_state(
+        state2, elastic.spec_for(params, fp2),
+        elastic.spec_for(params, fp1))
+
+    def one_more(world, st, p):
+        mesh = parallel.reform_mesh(world)
+        opt = DistributedFusedAdam(lr=0.05, shard_count=world,
+                                   chunk_elements=256)
+        specs = opt.state_pspec()
+        step = jax.jit(shard_map(
+            opt.step, mesh=mesh, in_specs=(P(), P(), specs),
+            out_specs=(P(), specs), check_vma=False))
+        ks = jax.random.split(jax.random.PRNGKey(999), len(p))
+        grads = {name: jax.random.normal(k, v.shape, jnp.float32)
+                 for k, (name, v) in zip(ks, sorted(p.items()))}
+        return step(grads, p, st)[0]
+
+    pa = one_more(2, state2, params2)
+    pb = one_more(1, ZeroState(*map(jnp.asarray, state1)), params2)
+    for k in pa:
+        np.testing.assert_array_equal(np.asarray(pa[k]),
+                                      np.asarray(pb[k]), err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# classification + spec validation
+# ---------------------------------------------------------------------------
+
+def test_can_reshard_classification():
+    params = tree_params()
+    fp2 = DistributedFusedAdam(
+        shard_count=2, chunk_elements=256).layout_fingerprint(params)
+    fp4 = DistributedFusedAdam(
+        shard_count=4, chunk_elements=256).layout_fingerprint(params)
+    ok, reason = elastic.can_reshard(fp2, fp4)
+    assert ok and "re-shardable" in reason
+    ok, reason = elastic.can_reshard(fp2, dict(fp2))
+    assert ok and "identical" in reason
+    other = DistributedFusedAdam(
+        shard_count=2, chunk_elements=256).layout_fingerprint(
+        {"different": jnp.ones((8,))})
+    ok, reason = elastic.can_reshard(fp2, other)
+    assert not ok and "structurally incompatible" in reason
+    ok, reason = elastic.can_reshard(None, fp2)
+    assert not ok and "missing" in reason
+    ok, reason = elastic.can_reshard({"a": 1}, fp2)
+    assert not ok
+    # the TYPED classification all callers branch on (never the strings)
+    assert elastic.classify_reshard(fp2, fp4)[0] == elastic.RESHARDABLE
+    assert elastic.classify_reshard(fp2, dict(fp2))[0] == elastic.IDENTICAL
+    assert elastic.classify_reshard(fp2, other)[0] == elastic.STRUCTURAL
+    assert elastic.classify_reshard({"a": 1}, fp2)[0] \
+        == elastic.UNFINGERPRINTED
+    assert elastic.classify_reshard(None, fp2)[0] \
+        == elastic.UNFINGERPRINTED
+
+
+def test_check_world_fingerprint_only():
+    params = tree_params()
+    fp2 = DistributedFusedAdam(
+        shard_count=2, chunk_elements=256).layout_fingerprint(params)
+    assert elastic.check_world(fp2, 2) == (True, "same world (2): "
+                                           "plain restore")
+    ok, reason = elastic.check_world(fp2, 4)
+    assert ok and "re-shard 2 -> 4" in reason
+    assert not elastic.check_world(fp2, 0)[0]
+    assert not elastic.check_world(None, 2)[0]
+    assert not elastic.check_world({"a": 1}, 2)[0]
+
+
+def test_spec_for_rejects_wrong_params():
+    params = tree_params()
+    fp = DistributedFusedAdam(
+        shard_count=2, chunk_elements=256).layout_fingerprint(params)
+    with pytest.raises(ValueError, match="does not describe"):
+        elastic.spec_for({"other": jnp.ones((5, 5))}, fp)
+
+
+def test_reshard_tree_requires_a_zero_state():
+    params = tree_params()
+    spec = pack_layout(params, chunk_elements=256, shard_count=2)
+    with pytest.raises(ValueError, match="no ZeroState"):
+        elastic.reshard_tree({"just": np.ones(3)}, spec, spec)
+
+
+def test_source_template_keeps_tree_paths():
+    from apex_tpu.checkpoint import _structure_key
+    params = tree_params()
+    opt = DistributedFusedAdam(shard_count=2, chunk_elements=256)
+    tmpl = (params, opt.init(params))
+    spec = pack_layout(params, chunk_elements=256, shard_count=4)
+    resized = elastic.source_template(tmpl, spec)
+    assert _structure_key(resized) == _structure_key(tmpl)
+    assert resized[1].master.shape == (spec["padded"],)
+
+
+# ---------------------------------------------------------------------------
+# snapshot-store integration
+# ---------------------------------------------------------------------------
+
+def test_reshard_restore_roundtrip_and_marker(tmp_path):
+    params = tree_params()
+    opt2, state2, params2 = train_zero(2, params, steps=2)
+    fp2 = opt2.layout_fingerprint(params)
+    mgr = resilience.SnapshotManager(str(tmp_path))
+    mgr.save((params2, state2), step=2, layout=fp2)
+
+    opt1 = DistributedFusedAdam(lr=0.05, shard_count=1,
+                                chunk_elements=256)
+    template = (params, opt1.init(params))
+    with telemetry.capture() as col:
+        found = elastic.reshard_restore(
+            mgr, template, params=params, optimizer=opt1)
+    assert found is not None and found.step == 2
+    _, z1 = found.state
+    fp1 = opt1.layout_fingerprint(params)
+    np.testing.assert_array_equal(
+        elastic.unshard(np.asarray(state2.master),
+                        elastic.spec_for(params, fp2)),
+        elastic.unshard(z1.master, elastic.spec_for(params, fp1)))
+    marks = [e for e in col.snapshot()
+             if e.name == "resilience/reshard"]
+    assert len(marks) == 1
+    assert marks[0].meta["from_world"] == 2
+    assert marks[0].meta["to_world"] == 1
+
+    # identical layout: plain restore, no marker
+    found2 = elastic.reshard_restore(
+        mgr, (params, opt2.init(params)), params=params, optimizer=opt2)
+    assert found2 is not None and found2.step == 2
+
+
+def test_reshard_restore_falls_back_across_layout_boundary(tmp_path):
+    """An elastic fleet writes world-W then world-W' generations into
+    ONE store. When the newest (same-layout) generation is corrupt, the
+    corruption fallback must cross the layout boundary and re-shard the
+    older-world generation — not fail fast on it."""
+    from apex_tpu.resilience.snapshot import PAYLOAD
+    params = tree_params()
+    opt2, state2, params2 = train_zero(2, params, steps=2)
+    opt1 = DistributedFusedAdam(lr=0.05, shard_count=1,
+                                chunk_elements=256)
+    mgr = resilience.SnapshotManager(str(tmp_path))
+    mgr.save((params2, state2), step=2,
+             layout=opt2.layout_fingerprint(params))
+    # the re-formed world-1 fleet saved a newer generation...
+    mgr.save((params2, elastic.reshard_state(
+        state2,
+        elastic.spec_for(params, opt2.layout_fingerprint(params)),
+        elastic.spec_for(params, opt1.layout_fingerprint(params)))),
+        step=4, layout=opt1.layout_fingerprint(params))
+    # ...which then got damaged on disk
+    gen_dir = tmp_path / "gen_00000001"
+    with open(gen_dir / PAYLOAD, "r+b") as f:
+        f.truncate(64)
+    template = (params, opt1.init(params))
+    with pytest.warns(UserWarning, match="skipping corrupt"):
+        found = elastic.reshard_restore(
+            mgr, template, params=params, optimizer=opt1)
+    assert found is not None
+    assert found.generation == 0 and found.step == 2
+    _, z1 = found.state
+    np.testing.assert_array_equal(
+        elastic.unshard(np.asarray(state2.master),
+                        elastic.spec_for(
+                            params, opt2.layout_fingerprint(params))),
+        elastic.unshard(z1.master,
+                        elastic.spec_for(
+                            params, opt1.layout_fingerprint(params))))
+
+
+def test_restore_latest_message_names_the_reshard_recipe(tmp_path):
+    """Satellite bugfix: the fast-fail message must print the re-shard
+    recipe for a world mismatch, and say 'structurally incompatible'
+    when the tree itself differs."""
+    params = tree_params()
+    opt2 = DistributedFusedAdam(shard_count=2, chunk_elements=256)
+    fp2 = opt2.layout_fingerprint(params)
+    mgr = resilience.SnapshotManager(str(tmp_path))
+    mgr.save((params, opt2.init(params)), step=2, layout=fp2)
+
+    fp1 = DistributedFusedAdam(
+        shard_count=1, chunk_elements=256).layout_fingerprint(params)
+    with pytest.raises(ValueError) as ei:
+        mgr.restore_latest((params, opt2.init(params)), layout=fp1)
+    msg = str(ei.value)
+    assert "RE-SHARDABLE world mismatch" in msg
+    assert "elastic" in msg and "inspect" in msg
+
+    other_fp = DistributedFusedAdam(
+        shard_count=2, chunk_elements=256).layout_fingerprint(
+        {"other": jnp.ones((4, 4))})
+    with pytest.raises(ValueError) as ei:
+        mgr.restore_latest((params, opt2.init(params)), layout=other_fp)
+    assert "STRUCTURALLY INCOMPATIBLE" in str(ei.value)
+
+
+def test_resilient_loop_elastic_resume(tmp_path):
+    """The loop seam in-process: a world-2 ZeRO run snapshots, then a
+    world-1 loop with elastic= resumes through the re-shard and its
+    continued trajectory matches a fresh world-1 run exactly."""
+    params = tree_params()
+
+    def build(world):
+        mesh = parallel.reform_mesh(world)
+        opt = DistributedFusedAdam(lr=0.05, shard_count=world,
+                                   chunk_elements=256)
+        specs = opt.state_pspec()
+        sharded = shard_map(opt.step, mesh=mesh,
+                            in_specs=(P(), P(), specs),
+                            out_specs=(P(), specs), check_vma=False)
+
+        @jax.jit
+        def train(st, x):
+            p, z = st
+            loss, g = jax.value_and_grad(
+                lambda p: sum(jnp.mean((l * x - 0.5) ** 2) for l in
+                              jax.tree_util.tree_leaves(p)))(p)
+            new_p, new_z = sharded(g, p, z)
+            return (new_p, new_z), loss
+
+        return opt, train
+
+    def data(i):
+        return jnp.asarray(
+            np.random.default_rng([5, i]).uniform(0.5, 1.5), jnp.float32)
+
+    losses = {}
+
+    def run(world, steps, snap, tag, elastic_seam=True):
+        opt, train = build(world)
+        fp = opt.layout_fingerprint(params)
+        seam = resilience.Elastic(opt, params) if elastic_seam else None
+        losses[tag] = []
+        return resilience.resilient_loop(
+            lambda st, x, i: train(st, x),
+            (params, opt.init(params)), data, steps=steps,
+            snapshot_dir=snap, snapshot_every=2, layout=fp,
+            elastic=seam, handle_signals=False,
+            on_step=lambda i, st, loss: losses[tag].append(
+                (i, float(loss))))
+
+    run(1, 6, str(tmp_path / "fresh"), "fresh")           # baseline
+    run(2, 3, str(tmp_path / "snap"), "w2")               # interrupted
+    cont = run(1, 6, str(tmp_path / "snap"), "resumed")   # elastic
+    assert cont.resumed_from is not None
+    la = dict(losses["fresh"])
+    for s, v in losses["resumed"]:
+        assert la[s] == v, (s, la[s], v)
+
+
+# ---------------------------------------------------------------------------
+# rendezvous + supervisor
+# ---------------------------------------------------------------------------
+
+def test_rendezvous_membership(tmp_path):
+    from apex_tpu.parallel import multiproc
+    a = multiproc.Rendezvous(str(tmp_path / "r"), "0000")
+    b = multiproc.Rendezvous(str(tmp_path / "r"), "0001")
+    a.announce()
+    assert a.world() == (1, 0)
+    b.announce()
+    assert a.members() == ["0000", "0001"]
+    assert b.world() == (2, 1)
+    assert b.wait_world(2, timeout_s=1) == (2, 1)
+    b.leave()
+    assert a.world() == (1, 0)
+    # stale heartbeat == departed
+    a.ttl_s = 0.05
+    old = time.time() - 1.0
+    os.utime(a._path("0000"), (old, old))
+    assert a.members() == []
+    a.heartbeat()   # refresh re-announces
+    assert a.members() == ["0000"]
+    a.ttl_s = 60.0
+    with pytest.raises(TimeoutError, match="1/2 members"):
+        a.wait_world(2, timeout_s=0.1)
+    # observer mode (no member id): liveness calls are guarded no-ops
+    obs = multiproc.Rendezvous(str(tmp_path / "r"))
+    obs.heartbeat()
+    obs.leave()
+    assert obs.members() == ["0000"]
+
+
+def test_run_elastic_substitution_and_world_env():
+    from apex_tpu.parallel import multiproc
+    assert multiproc._substitute(
+        ["a-{rank}", "b-{world}"], 3, 8) == ["a-3", "b-8"]
+    env = dict(os.environ)
+    try:
+        os.environ["APEX_TPU_WORLD"] = "4"
+        os.environ["APEX_TPU_RANK"] = "2"
+        assert multiproc.elastic_world() == (4, 2)
+        del os.environ["APEX_TPU_WORLD"], os.environ["APEX_TPU_RANK"]
+        os.environ.pop("NUM_PROCESSES", None)
+        os.environ.pop("PROCESS_ID", None)
+        assert multiproc.elastic_world() == (1, 0)
+        # a PRESENT but malformed value must raise, not silently
+        # degrade to a single-member world
+        os.environ["APEX_TPU_WORLD"] = "2x"
+        with pytest.raises(ValueError, match="malformed membership"):
+            multiproc.elastic_world()
+    finally:
+        os.environ.clear()
+        os.environ.update(env)
+
+
+def test_node_loss_supervisor_resumes_at_world_1(tmp_path):
+    """ROADMAP item 4 acceptance, end to end with REAL processes: a
+    2-member fleet loses rank 1 to an injected node_loss SIGKILL
+    mid-train, the survivor leaves cooperatively (exit 75 after its
+    final snapshot), the supervisor re-forms at world 1, and the resumed
+    run's post-resume loss trajectory matches a fresh same-layout
+    world-1 run EXACTLY (the re-shard itself is pinned bitwise by
+    test_reshard_gather_bitwise)."""
+    from apex_tpu.parallel import multiproc
+    env = dict(os.environ)
+    env.pop("APEX_TPU_FAULT", None)
+    env.pop("APEX_TPU_RANK", None)
+
+    # fresh world-1 baseline
+    fresh_env = dict(env, APEX_TPU_WORLD="1", APEX_TPU_RANK="0")
+    p = subprocess.run(
+        [sys.executable, WORKER, "--steps", "6",
+         "--snap", str(tmp_path / "fresh"),
+         "--out", str(tmp_path / "fresh.npz"), "--resume", "none"],
+        env=fresh_env, capture_output=True, text=True, timeout=300)
+    assert p.returncode == 0, p.stderr
+
+    env["APEX_TPU_FAULT"] = "step:3:node_loss"   # default target rank 1
+    logs = []
+    rc = multiproc.run_elastic(
+        [sys.executable, WORKER, "--steps", "6",
+         "--snap", str(tmp_path / "snap-r{rank}"),
+         "--out", str(tmp_path / "out-r{rank}.npz"),
+         "--telemetry", str(tmp_path / "tel-r{rank}.jsonl"),
+         "--resume", "auto", "--step-ms", "150"],
+        world=2, rendezvous_dir=str(tmp_path / "rdzv"),
+        grace_s=60.0, env=env, log=logs.append)
+    assert rc == 0, "\n".join(logs)
+    assert any("LOST" in ln for ln in logs)
+    assert any("world 1" in ln for ln in logs)
+
+    fresh = np.load(tmp_path / "fresh.npz")
+    out = np.load(tmp_path / "out-r0.npz")
+    assert int(out["world"]) == 1 and int(out["resumed_from"]) >= 0
+    la = {int(s): v for s, v in fresh["losses"]}
+    lb = {int(s): v for s, v in out["losses"]}
+    assert lb, "resumed run observed no steps"
+    for s, v in lb.items():
+        assert la[s] == v, (s, la[s], v)
+    for k in ("master", "exp_avg", "exp_avg_sq"):
+        np.testing.assert_array_equal(fresh[k], out[k], err_msg=k)
+
+    rows = [json.loads(ln)
+            for ln in open(tmp_path / "tel-r0.jsonl")]
+    marks = [r for r in rows if r["name"] == "resilience/reshard"]
+    assert marks and marks[-1]["meta"]["from_world"] == 2
+    assert marks[-1]["meta"]["to_world"] == 1
+    assert any(r["name"] == "resilience/resume" for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# inspect CLI
+# ---------------------------------------------------------------------------
+
+def test_inspect_cli(tmp_path, capsys):
+    from apex_tpu.resilience import cli
+    params = tree_params()
+    opt = DistributedFusedAdam(shard_count=2, chunk_elements=256)
+    mgr = resilience.SnapshotManager(str(tmp_path / "snap"))
+    mgr.save((params, opt.init(params)), step=2,
+             layout=opt.layout_fingerprint(params))
+
+    assert cli.main(["inspect", str(tmp_path / "snap")]) == 0
+    out = capsys.readouterr().out
+    assert "step      2" in out and "world   2" in out \
+        and "complete" in out
+
+    assert cli.main(["inspect", str(tmp_path / "snap"),
+                     "--check", "4"]) == 0
+    out = capsys.readouterr().out
+    assert "re-shard 2 -> 4 possible" in out
+
+    # a store whose snapshots carry no fingerprint cannot re-shard: 3
+    mgr2 = resilience.SnapshotManager(str(tmp_path / "bare"))
+    mgr2.save({"w": jnp.ones(3)}, step=1)
+    assert cli.main(["inspect", str(tmp_path / "bare"),
+                     "--check", "2"]) == 3
+    capsys.readouterr()
+
+    # --json parses and carries the check verdict
+    assert cli.main(["inspect", str(tmp_path / "snap"), "--check", "1",
+                     "--json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["rows"][0]["reshard_to_1"][0] is True
+
+    assert cli.main(["inspect", str(tmp_path / "nothing")]) == 2
+
+
+# ---------------------------------------------------------------------------
+# telemetry: reshard section + straggler attribution of slow_node
+# ---------------------------------------------------------------------------
+
+def test_summarize_reports_reshard():
+    ev = [{"name": "resilience/resume", "value": 1.0, "ts": 1.0,
+           "step": 4, "meta": {"generation": 1, "step": 4}},
+          {"name": "resilience/reshard", "value": 1.0, "ts": 1.0,
+           "step": 4, "meta": {"from_world": 2, "to_world": 1,
+                               "generation": 1}}]
+    agg = telemetry.summarize(ev)
+    assert agg["resilience"]["reshards"] == [
+        {"step": 4, "from_world": 2, "to_world": 1, "generation": 1}]
+    text = telemetry.format_summary(agg)
+    assert "elastic reshard world 2 -> 1 at step 4" in text
+
+
+def _straggler_stream(path, rank, spec, steps=6):
+    """One simulated fleet member: resilient_loop + per-step dispatch
+    spans + step/time_s points, with the fault injector from ``spec``
+    firing at each step top (the PR 8 merge fixture, slow_node added)."""
+    from apex_tpu import trace
+    inj = FaultInjector.parse(spec) if spec else None
+    with telemetry.capture() as col:
+        trace.enable()
+        try:
+            for i in range(steps):
+                t0 = time.perf_counter()
+                if inj is not None:
+                    inj.fire(i)
+                time.sleep(0.003)
+                t1 = time.perf_counter()
+                trace.emit_span("step/dispatch", t0, t1, step=i)
+                telemetry.record("step/time_s", t1 - t0, step=i)
+        finally:
+            trace.disable()
+        events = col.drain()
+    from apex_tpu.telemetry.export import write_jsonl
+    write_jsonl(path, events)
+
+
+def test_slow_node_named_by_straggler_attribution(tmp_path,
+                                                 monkeypatch):
+    """The satellite contract: a slow_node-injected delay on rank 1
+    shows up in the trace merge's straggler table NAMING that
+    process."""
+    from apex_tpu.telemetry import merge
+    spec = "step:2:slow_node:60:1"
+    monkeypatch.setenv("APEX_TPU_RANK", "0")
+    _straggler_stream(str(tmp_path / "run-p0.jsonl"), 0, spec)
+    monkeypatch.setenv("APEX_TPU_RANK", "1")
+    _straggler_stream(str(tmp_path / "run-p1.jsonl"), 1, spec)
+
+    merged, offsets = merge.merge_files(
+        [str(tmp_path / "run-p0.jsonl"), str(tmp_path / "run-p1.jsonl")])
+    agg = telemetry.summarize(merged)
+    st = agg["stragglers"]
+    assert st["worst"]["process"] == "p1"
+    # with two processes the median is their mean, so the injected
+    # 60 ms surfaces as ~30 ms of max-minus-median skew
+    assert st["skew_s"]["max"] >= 0.02
+    fams = [a["family"] for a in st.get("attribution", [])]
+    assert "step/dispatch" in fams
+
+
+def test_trainer_notify_resume_world_event():
+    from apex_tpu.trainer.builder import Trainer, TrainerConfig
+    tr = Trainer(fn=lambda s, b: (s, None),
+                 traced_fn=lambda s, b: (s, None),
+                 config=TrainerConfig(), donation=None)
+    with telemetry.capture() as col:
+        tr.notify_resume(7, world=1, from_world=2)
+        events = [e for e in col.drain() if e.name == "trainer/resume"]
+    assert tr.step_index == 7
+    assert len(events) == 1
+    assert events[0].meta == {"world": 1, "from_world": 2}

@@ -228,6 +228,83 @@ def test_prob_fault_seeded_reproducible():
     assert sched[0] == sched[1] and any(sched[0])
 
 
+def test_node_loss_slow_node_spec_parsing():
+    inj = resilience.FaultInjector.parse("step:3:node_loss")
+    assert inj.kind == "node_loss" and inj.step == 3 and inj.rank == 1
+    inj = resilience.FaultInjector.parse("step:3:node_loss:0")
+    assert inj.rank == 0
+    inj = resilience.FaultInjector.parse("step:2:slow_node:250")
+    assert inj.kind == "slow_node" and inj.delay_ms == 250.0 \
+        and inj.rank == 1
+    inj = resilience.FaultInjector.parse("step:2:slow_node:250:3")
+    assert inj.delay_ms == 250.0 and inj.rank == 3
+    inj = resilience.FaultInjector.parse("prob:0.5:node_loss:9")
+    assert inj.prob == 0.5 and inj.seed == 9 and inj.rank == 1
+    inj = resilience.FaultInjector.parse("prob:0.5:slow_node:40:9")
+    assert inj.delay_ms == 40.0 and inj.seed == 9
+    for bad in ("step:2:slow_node",          # missing delay
+                "step:2:slow_node:x",        # non-numeric delay
+                "step:2:node_loss:1:2",      # too many fields
+                "step:2:kill:1",             # rank on untargeted kind
+                "step:2:slow_node:10:1:2"):
+        with pytest.raises(ValueError):
+            resilience.FaultInjector.parse(bad)
+
+
+def test_node_loss_targets_only_its_rank(monkeypatch):
+    """fire() on a NON-target rank must be a no-op — every member of a
+    fleet shares one APEX_TPU_FAULT env and exactly one dies."""
+    monkeypatch.setenv("APEX_TPU_RANK", "0")
+    inj = resilience.FaultInjector.parse("step:1:node_loss")  # rank 1
+    assert not inj.targets_me()
+    inj.fire(1)   # would SIGKILL us if mis-targeted
+    assert not inj._fired
+    monkeypatch.setenv("APEX_TPU_RANK", "1")
+    assert inj.targets_me()
+    # PROCESS_ID fallback
+    monkeypatch.delenv("APEX_TPU_RANK")
+    monkeypatch.setenv("PROCESS_ID", "1")
+    assert inj.targets_me()
+
+
+def test_slow_node_recurring_delay(monkeypatch):
+    """slow_node is a CONDITION, not an event: every step at/after the
+    trigger sleeps, on the target rank only."""
+    import time as _time
+    monkeypatch.setenv("APEX_TPU_RANK", "0")
+    inj = resilience.FaultInjector.parse("step:2:slow_node:30:0")
+    t0 = _time.perf_counter()
+    inj.fire(0)
+    inj.fire(1)
+    fast = _time.perf_counter() - t0
+    assert fast < 0.02
+    for step in (2, 3):
+        t0 = _time.perf_counter()
+        inj.fire(step)
+        assert _time.perf_counter() - t0 >= 0.025, step
+    # off-target rank never sleeps
+    monkeypatch.setenv("APEX_TPU_RANK", "5")
+    t0 = _time.perf_counter()
+    inj.fire(4)
+    assert _time.perf_counter() - t0 < 0.02
+
+
+def test_node_loss_kills_target_rank_subprocess(tmp_path):
+    """A real node_loss SIGKILL through resilient_loop: the worker run
+    AS rank 1 dies at the fault step; the same spec run as rank 0
+    completes untouched."""
+    p = _run_worker([6, tmp_path / "snap", tmp_path / "out.npz"],
+                    extra_env={"APEX_TPU_FAULT": "step:3:node_loss",
+                               "APEX_TPU_RANK": "1"},
+                    check=False)
+    assert p.returncode in (-signal.SIGKILL, 128 + signal.SIGKILL), \
+        f"expected SIGKILL, got rc={p.returncode}\n{p.stderr}"
+    _run_worker([6, tmp_path / "snap0", tmp_path / "out0.npz"],
+                extra_env={"APEX_TPU_FAULT": "step:3:node_loss",
+                           "APEX_TPU_RANK": "0"})
+    assert (tmp_path / "out0.npz").exists()
+
+
 def test_io_error_consumed_once():
     inj = resilience.FaultInjector.parse("step:1:io_error").install()
     try:
