@@ -1,24 +1,35 @@
-"""apex_tpu.lint — static trace-safety, dtype-policy, and collective-
-consistency analysis for TPU training code.
+"""apex_tpu.lint — static trace-safety, dtype-policy, collective-
+consistency, and SPMD-correctness analysis for TPU training code.
 
-Two passes (see docs/lint.md for the rule catalog):
+Three passes (see docs/lint.md for the rule catalog):
 
 * AST (``APX0xx``): trace hazards readable from source — Python control
   flow on traced values, concretization, impure state under ``jit``,
   train steps that forget buffer donation, hardcoded dtype literals that
-  bypass the ``amp.policy`` tables.
+  bypass the ``amp.policy`` tables, host syncs inside compiled-step
+  definitions.
 * jaxpr (``APX1xx``): properties of the lowered program — O4/O5 matmul
   dtype conformance, collective axis-name/axis_index_groups consistency
   against the mesh, Pallas (8, 128) block tiling.
+* SPMD (``APX2xx``, ``--spmd``): whole-program single-device-semantics
+  verification — rank-gated collective schedules (deadlocks), replica-
+  divergent RNG, use-after-donation, implicit full replication, reshard
+  thrash, overlap-seam bypass, callback graph re-entry, scan-carry
+  widening. Mesh-aware abstract interpretation; read-only on the traced
+  program.
 
-Run ``python -m apex_tpu.lint apex_tpu/ --strict`` (the CI gate does),
-or lint your own train step programmatically::
+Run ``python -m apex_tpu.lint apex_tpu/ --strict --spmd`` (the CI gate
+does), or lint your own train step programmatically::
 
     from apex_tpu import lint
     findings = lint.check_entry(step_fn, args, name="train_step",
                                 mesh_axes=("data",), opt_level="O5")
+    findings += lint.check_entry_spmd(step_fn, args, mesh_axes=("data",),
+                                      donate_argnums=(0,))
 
-Suppress a finding in place with ``# apexlint: disable=APX00N -- why``.
+Suppress a finding in place with ``# apexlint: disable=APX00N -- why``;
+adopt the gate on an existing codebase with ``--baseline FILE`` (fail on
+NEW findings only); ``--format=sarif`` feeds GitHub code scanning.
 """
 
 from apex_tpu.lint.rules import RULES, Rule
@@ -26,4 +37,6 @@ from apex_tpu.lint.report import Finding
 from apex_tpu.lint.ast_checks import check_source
 from apex_tpu.lint.jaxpr_checks import (EntrySpec, builtin_entries,
                                         check_entry, run_entries)
+from apex_tpu.lint.spmd_checks import (StaticDonation, check_entry_spmd,
+                                       run_entries_spmd, static_donation)
 from apex_tpu.lint.cli import main, run

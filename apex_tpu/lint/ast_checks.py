@@ -1,4 +1,4 @@
-"""AST pass: source-level trace hazards (rules APX001-APX005, APX007).
+"""AST pass: source-level trace hazards (rules APX001-APX007).
 
 The pass is deliberately heuristic-but-precise: every rule is scoped so
 that a firing is near-certainly a real hazard (Python control flow on a
@@ -65,11 +65,14 @@ def _traced_operand_names(call: ast.Call) -> Iterable[ast.AST]:
 
 
 class _TracedCollector(ast.NodeVisitor):
-    """Find names/nodes of functions that end up traced."""
+    """Find names/nodes of functions that end up traced, and functions
+    that become a compiled step (passed to ``trainer.build``)."""
 
     def __init__(self):
         self.traced_names: Set[str] = set()
         self.traced_nodes: List[ast.AST] = []   # lambdas marked in place
+        self.built_names: Set[str] = set()      # step fns given to build
+        self.built_nodes: List[ast.AST] = []
 
     def _is_tracer(self, func: ast.AST) -> bool:
         name = _dotted(func)
@@ -106,6 +109,13 @@ class _TracedCollector(ast.NodeVisitor):
                         for inner in _traced_operand_names(operand):
                             if isinstance(inner, ast.Name):
                                 self.traced_names.add(inner.id)
+        name = _dotted(node.func) or ""
+        if _is_trainer_build(name) or name == "build":
+            for operand in _traced_operand_names(node):
+                if isinstance(operand, ast.Name):
+                    self.built_names.add(operand.id)
+                elif isinstance(operand, ast.Lambda):
+                    self.built_nodes.append(operand)
         self.generic_visit(node)
 
 
@@ -198,6 +208,73 @@ class _TracedBodyChecker:
                 f"call to `{name}` inside traced code — Python-side "
                 "RNG/clock values are constants baked into the compiled "
                 "program; use jax.random with an explicit key")
+
+
+class _HostSyncChecker:
+    """APX006: host synchronization lexically inside a compiled-step
+    definition — a function passed to ``trainer.build`` or traced by
+    ``jit``. ``block_until_ready`` (either spelling) stalls the dispatch
+    pipeline every step; in build-passed steps (which the traced-context
+    rules don't cover) ``.item()`` / ``float()``-family concretizations
+    are the same sync wearing a different name. Concretizations in
+    *traced* functions stay APX002's (one finding per hazard)."""
+
+    def __init__(self, path: str, findings: List[Finding]):
+        self.path = path
+        self.findings = findings
+
+    def _emit(self, node, msg):
+        self.findings.append(Finding("APX006", self.path, node.lineno, msg))
+
+    def check(self, fn: ast.AST, *, include_concretize: bool):
+        params: Set[str] = set()
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)):
+            a = fn.args
+            params |= {x.arg for x in (a.posonlyargs + a.args
+                                       + a.kwonlyargs)}
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            self._walk(stmt, params, include_concretize)
+
+    def _walk(self, node: ast.AST, params: Set[str], concretize: bool):
+        if isinstance(node, ast.Call):
+            self._check_call(node, params, concretize)
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, params, concretize)
+
+    def _check_call(self, node: ast.Call, params: Set[str],
+                    concretize: bool):
+        name = _dotted(node.func) or ""
+        if (name.rsplit(".", 1)[-1] == "block_until_ready"
+                or (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "block_until_ready")):
+            self._emit(
+                node,
+                "block_until_ready inside a compiled-step definition — "
+                "the host blocks on the device every step, defeating "
+                "dispatch pipelining (the trainer's in-flight window); "
+                "sync outside the step, on retirement")
+            return
+        if not concretize:
+            return
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+            self._emit(
+                node,
+                ".item() inside a step passed to trainer.build — a "
+                "host round-trip per step that serializes the dispatch "
+                "pipeline; keep it an array and read it from the "
+                "retired aux instead")
+        elif (isinstance(node.func, ast.Name)
+              and node.func.id in ("float", "int", "bool")
+              and len(node.args) == 1
+              and (_names_in(node.args[0]) & params)):
+            self._emit(
+                node,
+                f"{node.func.id}() on a step argument inside a function "
+                "passed to trainer.build — concretizing per step "
+                "serializes the dispatch pipeline; keep it an array "
+                "(astype) or hoist it out of the step")
 
 
 def _check_jit_donation(tree: ast.Module, path: str,
@@ -358,12 +435,24 @@ def check_source(path: str, text: str) -> List[Finding]:
     collector.visit(tree)
 
     checker = _TracedBodyChecker(path, findings)
+    sync = _HostSyncChecker(path, findings)
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             if node.name in collector.traced_names:
                 checker.check(node, set())
+            if node.name in collector.traced_names \
+                    or node.name in collector.built_names:
+                # concretizations in traced fns are APX002's findings;
+                # build-passed steps (not traced contexts) get the full
+                # host-sync check
+                sync.check(node, include_concretize=(
+                    node.name in collector.built_names
+                    and node.name not in collector.traced_names))
     for node in collector.traced_nodes:
         checker.check(node, set())
+        sync.check(node, include_concretize=False)
+    for node in collector.built_nodes:
+        sync.check(node, include_concretize=True)
 
     _check_jit_donation(tree, path, findings)
     _check_dtype_literals(tree, path, findings)

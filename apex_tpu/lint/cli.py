@@ -41,9 +41,11 @@ def _relpath(p: str) -> str:
         return p
 
 
-def run(paths: Sequence[str], *, jaxpr: bool = True,
+def run(paths: Sequence[str], *, jaxpr: bool = True, spmd: bool = False,
         select: Sequence[str] = (), ignore: Sequence[str] = ()):
-    """Lint ``paths``; returns (active_findings, suppressed_findings)."""
+    """Lint ``paths``; returns (active_findings, suppressed_findings).
+    ``spmd=True`` additionally runs the APX2xx SPMD verifier over the
+    registered entry points."""
     findings: List[report.Finding] = []
     sources: Dict[str, List[str]] = {}
 
@@ -55,15 +57,21 @@ def run(paths: Sequence[str], *, jaxpr: bool = True,
         for finding in ast_checks.check_source(rel, text):
             findings.append(finding)
 
+    entry_findings: List[report.Finding] = []
     if jaxpr:
-        for finding in jaxpr_checks.run_entries():
-            rel = _relpath(finding.path)
-            finding = report.Finding(finding.rule_id, rel, finding.line,
-                                     finding.message)
-            if rel not in sources and os.path.exists(rel):
-                with open(rel, encoding="utf-8") as fh:
-                    sources[rel] = fh.read().splitlines()
-            findings.append(finding)
+        # one build + one lowering per entry, both passes share it
+        entry_findings.extend(jaxpr_checks.run_entries(spmd=spmd))
+    elif spmd:
+        from apex_tpu.lint import spmd_checks
+        entry_findings.extend(spmd_checks.run_entries_spmd())
+    for finding in entry_findings:
+        rel = _relpath(finding.path)
+        finding = report.Finding(finding.rule_id, rel, finding.line,
+                                 finding.message)
+        if rel not in sources and os.path.exists(rel):
+            with open(rel, encoding="utf-8") as fh:
+                sources[rel] = fh.read().splitlines()
+        findings.append(finding)
 
     findings = list(dict.fromkeys(findings))    # drop exact duplicates
     if select:
@@ -82,15 +90,27 @@ def main(argv: Sequence[str] = None) -> int:
                     help="files or directories to lint")
     ap.add_argument("--strict", action="store_true",
                     help="exit nonzero on warnings too, not just errors")
-    ap.add_argument("--format", choices=("text", "github"), default="text",
+    ap.add_argument("--format", choices=("text", "github", "sarif"),
+                    default="text",
                     help="output style; github emits ::error/::warning "
-                         "annotation lines")
+                         "annotation lines, sarif a SARIF 2.1.0 document "
+                         "for GitHub code scanning")
     ap.add_argument("--select", default="",
                     help="comma list of rule IDs to run (default: all)")
     ap.add_argument("--ignore", default="",
                     help="comma list of rule IDs to skip")
     ap.add_argument("--no-jaxpr", action="store_true",
                     help="skip the jaxpr entry-point pass (AST only)")
+    ap.add_argument("--spmd", action="store_true",
+                    help="also run the APX2xx SPMD verifier over the "
+                         "registered entry points (collective schedule, "
+                         "replica RNG, donation liveness, replication)")
+    ap.add_argument("--baseline", metavar="FILE", default=None,
+                    help="fail only on findings NOT recorded in FILE; "
+                         "known findings are reported as baselined")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite --baseline FILE with the current "
+                         "findings and exit 0")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
     args = ap.parse_args(argv)
@@ -109,10 +129,30 @@ def main(argv: Sequence[str] = None) -> int:
         if rid not in RULES:
             print(f"apexlint: unknown rule id {rid!r}", file=sys.stderr)
             return 2
+    if args.update_baseline and not args.baseline:
+        print("apexlint: --update-baseline requires --baseline FILE",
+              file=sys.stderr)
+        return 2
 
     active, suppressed = run(args.paths, jaxpr=not args.no_jaxpr,
-                             select=select, ignore=ignore)
-    out = report.render(active, suppressed, args.format)
+                             spmd=args.spmd, select=select, ignore=ignore)
+
+    if args.baseline and args.update_baseline:
+        report.write_baseline(args.baseline, active)
+        print(f"apexlint: baseline written to {args.baseline} "
+              f"({len(active)} finding(s) recorded)")
+        return 0
+    baselined: List[report.Finding] = []
+    if args.baseline:
+        if not os.path.exists(args.baseline):
+            print(f"apexlint: baseline file not found: {args.baseline} "
+                  "(create it with --update-baseline)", file=sys.stderr)
+            return 2
+        active, baselined = report.split_baseline(
+            active, report.load_baseline(args.baseline))
+
+    out = report.render(active, suppressed, args.format,
+                        baselined=baselined)
     if out:
         print(out)
     return report.exit_code(active, strict=args.strict)

@@ -86,7 +86,11 @@ def _frame_for(eqn, default_path: str, default_line: int
         frames = []
     pick = None
     for fr in frames:
-        fname = getattr(fr, "file_name", "") or ""
+        fname = (getattr(fr, "file_name", "") or "").replace("\\", "/")
+        if "apex_tpu/lint/" in fname:
+            continue    # the analyzer's own make_jaxpr call site is
+            # never the finding's location — without this, entries
+            # traced via check_entry would all point at the linter
         if "apex_tpu" in fname or fname.endswith("__graft_entry__.py"):
             pick = fr
             break
@@ -322,21 +326,28 @@ class EntrySpec:
     ``opt_level`` ties the dtype rules to the amp.policy tables;
     ``mesh_axes`` declares the collectives' legal axis names;
     ``reduce_dtype`` declares the entry's configured 16-bit gradient
-    wire format (arms APX106 against fp32 payload collectives)."""
+    wire format (arms APX106 against fp32 payload collectives);
+    ``donate_argnums`` declares which args the entry donates (arms the
+    SPMD pass's APX203 use-after-donation liveness check)."""
     name: str
     path: str
     make: Callable[[], Tuple[Callable, tuple]]
     mesh_axes: Tuple[str, ...] = ()
     opt_level: Optional[str] = None
     reduce_dtype: Optional[str] = None
+    donate_argnums: Tuple[int, ...] = ()
 
 
 def check_entry(fn: Callable, args: tuple, *, name: str = "<entry>",
                 path: str = "<jaxpr>", mesh_axes: Sequence[str] = (),
                 opt_level: Optional[str] = None,
-                reduce_dtype: Optional[str] = None) -> List[Finding]:
+                reduce_dtype: Optional[str] = None,
+                spmd: bool = False,
+                donate_argnums: Sequence[int] = ()) -> List[Finding]:
     """Trace ``fn(*args)`` and run the jaxpr rules. Public so tests and
-    downstream projects can lint their own train steps."""
+    downstream projects can lint their own train steps. ``spmd=True``
+    additionally runs the APX2xx SPMD verifier on the same program
+    (``donate_argnums`` arms its use-after-donation rule)."""
     from apex_tpu.amp import policy
 
     compute_low = False
@@ -378,6 +389,13 @@ def check_entry(fn: Callable, args: tuple, *, name: str = "<entry>",
     env = {v: _is_low(getattr(v, "aval", None))
            for v in closed.jaxpr.invars}
     _walk(closed.jaxpr, env, ctx)
+    if spmd:
+        from apex_tpu.lint.spmd_checks import check_entry_spmd
+        # hand over the lowering already done above — entries (GPT
+        # forward+loss, trainer builds) are expensive to re-trace
+        ctx.findings.extend(check_entry_spmd(
+            fn, args, name=name, path=path, mesh_axes=mesh_axes,
+            donate_argnums=donate_argnums, closed=closed))
     return ctx.findings
 
 
@@ -513,6 +531,48 @@ def builtin_entries() -> List[EntrySpec]:
                 mt.set_backend(prev)
         return step, (p, p, st)
 
+    def overlap_staged():
+        from jax.sharding import Mesh, PartitionSpec as P
+        from apex_tpu.parallel import overlap
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+        params = {"w": jnp.ones((64, 64)), "b": jnp.ones((64,))}
+        x = jnp.ones((4, 64))
+
+        def per_device(p, x):
+            def loss_fn(p):
+                p = overlap.sync_in_backward(p, "data",
+                                             reduce_dtype="bf16")
+                return jnp.mean((x @ p["w"] + p["b"]) ** 2)
+            return jax.grad(loss_fn)(p)
+
+        f = jax.shard_map(per_device, mesh=mesh,
+                          in_specs=(P(), P("data")), out_specs=P(),
+                          check_vma=False)
+        return f, (params, x)
+
+    def trainer_step():
+        from jax.sharding import Mesh, PartitionSpec as P
+        from apex_tpu import trainer as _trainer
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+
+        def step(state, batch):
+            params, opt = state
+
+            def loss_fn(p):
+                return jnp.mean((batch @ p["w"]) ** 2)
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            g = jax.lax.pmean(g, "data")
+            new_p = jax.tree_util.tree_map(
+                lambda a, b: a - 0.1 * b, params, g)
+            return (new_p, opt + 1.0), jax.lax.pmean(loss, "data")
+
+        state = ({"w": jnp.ones((64, 8))}, jnp.zeros((3,)))
+        batch = jnp.ones((4, 64))
+        tr = _trainer.build(
+            step, state, batch, mesh=mesh, batch_spec=P("data"),
+            config=_trainer.TrainerConfig(audit_donation=False))
+        return tr.traced_fn, (state, batch)
+
     root = _repo_root()
     entries = [
         EntrySpec("gpt_tiny_fwd_loss@O5", "apex_tpu/models/gpt.py",
@@ -532,6 +592,12 @@ def builtin_entries() -> List[EntrySpec]:
                   reduce_dtype="bfloat16"),
         EntrySpec("zero_adam_step", "apex_tpu/contrib/optimizers/zero.py",
                   zero_step, mesh_axes=("data",)),
+        EntrySpec("overlap_staged_grads", "apex_tpu/parallel/overlap.py",
+                  overlap_staged, mesh_axes=("data",),
+                  reduce_dtype="bfloat16"),
+        EntrySpec("trainer_per_step", "apex_tpu/trainer/builder.py",
+                  trainer_step, mesh_axes=("data",),
+                  donate_argnums=(0,)),
     ]
 
     graft = os.path.join(root, "__graft_entry__.py")
@@ -547,11 +613,12 @@ def builtin_entries() -> List[EntrySpec]:
     return entries
 
 
-def run_entries(entries: Optional[Sequence[EntrySpec]] = None
-                ) -> List[Finding]:
-    """Lower every registered entry and collect jaxpr findings. A broken
-    entry fails loudly (with the entry name) rather than being skipped —
-    an unlowerable train step is exactly what the gate must catch."""
+def run_entries(entries: Optional[Sequence[EntrySpec]] = None, *,
+                spmd: bool = False) -> List[Finding]:
+    """Lower every registered entry and collect jaxpr findings (plus the
+    SPMD pass over the SAME lowering when ``spmd``). A broken entry
+    fails loudly (with the entry name) rather than being skipped — an
+    unlowerable train step is exactly what the gate must catch."""
     findings: List[Finding] = []
     for spec in builtin_entries() if entries is None else entries:
         try:
@@ -563,5 +630,6 @@ def run_entries(entries: Optional[Sequence[EntrySpec]] = None
         findings.extend(check_entry(
             fn, args, name=spec.name, path=spec.path,
             mesh_axes=spec.mesh_axes, opt_level=spec.opt_level,
-            reduce_dtype=spec.reduce_dtype))
+            reduce_dtype=spec.reduce_dtype, spmd=spmd,
+            donate_argnums=spec.donate_argnums))
     return findings

@@ -1,4 +1,5 @@
-"""Findings, suppression handling, and output formatting for apex_tpu.lint.
+"""Findings, suppression handling, baselines, and output formatting for
+apex_tpu.lint.
 
 Suppression syntax (same line as the finding)::
 
@@ -8,13 +9,19 @@ Suppression syntax (same line as the finding)::
 out wholesale with ``# apexlint: disable-file=APX005`` (or ``all``) in its
 first 10 lines. Suppressions are counted and reported so a blanket
 disable can't silently rot.
+
+Baselines (``--baseline FILE``) record the *known* findings of a
+codebase so a new strict gate only fails on NEW findings — adoptable
+without a big-bang cleanup. Keys are (rule, path, message), deliberately
+line-free: adding code above a known finding must not resurrect it.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
 import re
-from typing import Dict, Iterable, List, Sequence, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from apex_tpu.lint.rules import ERROR, RULES
 
@@ -83,15 +90,139 @@ def apply_suppressions(
 
 
 def render(findings: Sequence[Finding], suppressed: Sequence[Finding],
-           fmt: str = "text") -> str:
+           fmt: str = "text", baselined: Sequence[Finding] = ()) -> str:
+    if fmt == "sarif":
+        return render_sarif(findings, suppressed, baselined)
     out = [f.format(fmt) for f in sorted(
         findings, key=lambda f: (f.path, f.line, f.rule_id))]
     n_err = sum(1 for f in findings if f.severity == ERROR)
     n_warn = len(findings) - n_err
     if fmt != "github":
-        out.append(f"apexlint: {n_err} error(s), {n_warn} warning(s), "
-                   f"{len(suppressed)} suppressed")
+        tail = (f"apexlint: {n_err} error(s), {n_warn} warning(s), "
+                f"{len(suppressed)} suppressed")
+        if baselined:
+            tail += f", {len(baselined)} baselined"
+        out.append(tail)
     return "\n".join(out)
+
+
+def render_sarif(findings: Sequence[Finding],
+                 suppressed: Sequence[Finding] = (),
+                 baselined: Sequence[Finding] = ()) -> str:
+    """SARIF 2.1.0 document (one run) — the format GitHub code scanning
+    ingests, so ``--format=sarif`` output annotates PRs via the
+    ``codeql-action/upload-sarif`` step. Known-and-tolerated findings
+    are carried, not dropped — in-source-suppressed ones with an
+    ``inSource`` suppression object, baselined ones with ``external``
+    (dropping either would make code scanning auto-close their open
+    alerts and flap them back later)."""
+    used = sorted({f.rule_id for f in (list(findings) + list(suppressed)
+                                       + list(baselined))})
+    rules = [{
+        "id": rid,
+        "name": RULES[rid].name,
+        "shortDescription": {"text": RULES[rid].summary},
+        "defaultConfiguration": {
+            "level": "error" if RULES[rid].severity == ERROR
+            else "warning"},
+    } for rid in used]
+
+    def result(f: Finding, suppress_kind: Optional[str]) -> dict:
+        r = {
+            "ruleId": f.rule_id,
+            "level": ("error" if f.severity == ERROR else "warning"),
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace("\\", "/")},
+                    "region": {"startLine": max(f.line, 1)},
+                },
+            }],
+        }
+        if suppress_kind is not None:
+            r["suppressions"] = [{"kind": suppress_kind}]
+        return r
+
+    doc = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "apexlint",
+                "informationUri":
+                    "https://github.com/apex-tpu/apex_tpu",
+                "rules": rules,
+            }},
+            "results": ([result(f, None) for f in sorted(
+                findings, key=lambda f: (f.path, f.line, f.rule_id))]
+                + [result(f, "inSource") for f in sorted(
+                    suppressed,
+                    key=lambda f: (f.path, f.line, f.rule_id))]
+                + [result(f, "external") for f in sorted(
+                    baselined,
+                    key=lambda f: (f.path, f.line, f.rule_id))]),
+        }],
+    }
+    return json.dumps(doc, indent=2)
+
+
+# ---------------------------------------------------------------------------
+# baselines
+# ---------------------------------------------------------------------------
+
+_BASELINE_VERSION = 1
+
+
+def baseline_key(f: Finding) -> Tuple[str, str, str]:
+    """Line-free identity of a finding: adding code above a known
+    finding (shifting its line) must not make it 'new'."""
+    return (f.rule_id, f.path, f.message)
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for f in findings:
+        k = baseline_key(f)
+        counts[k] = counts.get(k, 0) + 1
+    doc = {"version": _BASELINE_VERSION,
+           "findings": [{"rule": r, "path": p, "message": m, "count": n}
+                        for (r, p, m), n in sorted(counts.items())]}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2)
+        fh.write("\n")
+
+
+def load_baseline(path: str) -> Dict[Tuple[str, str, str], int]:
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if doc.get("version") != _BASELINE_VERSION:
+        raise ValueError(
+            f"unsupported apexlint baseline version "
+            f"{doc.get('version')!r} in {path}")
+    return {(e["rule"], e["path"], e["message"]): int(e.get("count", 1))
+            for e in doc.get("findings", ())}
+
+
+def split_baseline(findings: Iterable[Finding],
+                   known: Dict[Tuple[str, str, str], int],
+                   ) -> Tuple[List[Finding], List[Finding]]:
+    """(new, baselined) — exit codes are computed from ``new`` only.
+    ``known`` carries per-key counts so a SECOND identical finding in a
+    file with one recorded instance is still NEW (line-free keys would
+    otherwise swallow it)."""
+    budget = dict(known)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        k = baseline_key(f)
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
 
 
 def exit_code(findings: Sequence[Finding], strict: bool = False) -> int:

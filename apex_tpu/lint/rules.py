@@ -1,9 +1,10 @@
 """Rule catalog for ``apex_tpu.lint``.
 
 Every rule carries a stable ID (``APX0xx`` = source/AST pass, ``APX1xx`` =
-jaxpr pass), a severity, and a one-line summary. IDs are append-only: a
-rule may be retired (kept here, marked retired) but its ID is never
-reused — suppression comments in user code reference them.
+jaxpr pass, ``APX2xx`` = SPMD verifier pass), a severity, and a one-line
+summary. IDs are append-only: a rule may be retired (kept here, marked
+retired) but its ID is never reused — suppression comments in user code
+reference them.
 
 See ``docs/lint.md`` for the full catalog with TPU rationale and examples.
 """
@@ -44,7 +45,10 @@ _RULES = [
     Rule("APX005", "hardcoded-dtype-literal", WARNING,
          "hardcoded low-precision dtype literal outside amp/ — compute "
          "dtypes should route through the amp.policy opt-level tables"),
-    # APX006 is unassigned (IDs are append-only, not contiguous)
+    Rule("APX006", "host-sync-in-step", WARNING,
+         "block_until_ready / .item() / float() host sync inside a "
+         "compiled-step definition (a function passed to trainer.build "
+         "or jit) — it stalls the dispatch pipeline every step"),
     Rule("APX007", "step-rejit-or-undonated-build", WARNING,
          "step re-jit / trainer.build inside a loop (a fresh compile "
          "per iteration), or a trainer.build call site that opts its "
@@ -69,9 +73,39 @@ _RULES = [
          "psum/reduce-scatter moves a gradient-sized fp32 payload in an "
          "entry configured with a 16-bit reduce_dtype — the call site "
          "bypasses the compressed wire path"),
+    # ---- SPMD verifier pass (whole-program single-device semantics) -------
+    Rule("APX201", "collective-schedule-divergence", ERROR,
+         "collective reachable under rank-dependent control flow "
+         "(axis_index feeding a cond/while predicate) — ranks can "
+         "disagree on the collective schedule and deadlock"),
+    Rule("APX202", "replica-divergent-rng", ERROR,
+         "PRNG key consumed inside a shard_map region is derived from "
+         "sharded data and never folds in the axis index — replicas "
+         "draw different randomness and desynchronize"),
+    Rule("APX203", "use-after-donation", WARNING,
+         "donated carry leaf read after its aliased output is produced "
+         "— XLA must copy or refuse the donation; the leaf "
+         "double-buffers"),
+    Rule("APX204", "implicit-full-replication", WARNING,
+         "all_gather materializes a >= threshold-byte unsharded "
+         "intermediate on every device inside a mesh region"),
+    Rule("APX205", "reshard-thrash", WARNING,
+         "all_gather whose result only feeds a reducing collective of "
+         "the same value — reduce first and drop the gather"),
+    Rule("APX206", "collective-bypasses-overlap-seam", WARNING,
+         "gradient-sized reduction outside the overlap bucket seam in "
+         "an entry that stages its collectives through it — neither "
+         "buckets nor overlaps"),
+    Rule("APX207", "callback-reenters-graph", WARNING,
+         "pure_callback result feeds traced equations — nondeterministic "
+         "under pipelined dispatch; keep callbacks effect-only"),
+    Rule("APX208", "scan-carry-widening", WARNING,
+         "fp32 scan carry produced by widening a bf16/fp16 body value "
+         "every iteration — 2x carry memory/bandwidth for no gain"),
 ]
 
 RULES: Dict[str, Rule] = {r.id: r for r in _RULES}
 
 AST_RULE_IDS = tuple(r.id for r in _RULES if r.id.startswith("APX0"))
 JAXPR_RULE_IDS = tuple(r.id for r in _RULES if r.id.startswith("APX1"))
+SPMD_RULE_IDS = tuple(r.id for r in _RULES if r.id.startswith("APX2"))

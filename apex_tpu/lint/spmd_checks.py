@@ -1,0 +1,800 @@
+"""SPMD pass: whole-program single-device-semantics verification of
+lowered entry points (rules APX201-APX208).
+
+Where the jaxpr pass (APX1xx) checks *local* properties — one matmul's
+dtypes, one collective's axis name — this pass checks the properties that
+make an SPMD program a correct *program*: every rank must execute the
+same collective schedule, every replica must hold the same parameters,
+and the memory/donation story the trainer promises must actually hold in
+the traced graph. veScale (arXiv 2509.07003) frames this as "an SPMD
+program must provably preserve single-device semantics"; the failure
+modes below are exactly the ways a jax program silently stops doing so,
+and every one of them otherwise needs a fleet (and a hang) to observe.
+
+The pass is an abstract interpretation over the jaxpr: a forward
+dataflow walk (built on ``utils.jaxpr_walk.subjaxprs_tagged``'s precise
+operand mapping) threads per-axis taint tags through every variable —
+
+* ``("rank", axis)``    — the value depends on ``axis_index`` over that
+  axis (differs per rank by construction: deliberate divergence),
+* ``("sharded", axis)`` — the value depends on a ``shard_map`` input
+  sharded over that axis (differs per rank by data: accidental
+  divergence unless resolved),
+
+with collectives (full-axis psum/pmin/pmax/all_gather) acting as the
+taint *eraser* — but only for the axes they actually reduce over: on a
+2-D mesh, ``psum(axis_index("model"), "data")`` is still
+model-rank-divergent, and gating a collective on it is still a
+schedule divergence. Mesh context
+(axes, sizes), while/cond nesting, and rank-gating are threaded into
+scan/while/cond bodies; while predicates run to a small fixpoint so a
+carry that *becomes* rank-dependent inside the body still gates it.
+
+Rules:
+
+* **APX201 collective-schedule-divergence** — a collective reachable
+  under control flow whose predicate is rank-tainted (``axis_index``
+  feeding a ``cond``/``while`` predicate). Ranks can disagree on the
+  collective count/order: the canonical SPMD deadlock.
+* **APX202 replica-divergent-rng** — a PRNG key consumed inside a
+  ``shard_map`` region that is sharded-tainted but never folds in the
+  axis index: replicas draw different randomness by accident and their
+  parameters desynchronize. Keys folded with ``axis_index`` (deliberate
+  per-rank streams) or derived only from replicated inputs pass.
+* **APX203 use-after-donation** — a donated carry leaf read by an
+  equation ordered after its aliased output is produced — the static
+  twin of the trainer's runtime :class:`~apex_tpu.trainer.DonationReport`
+  (XLA must copy or refuse; either way the leaf double-buffers).
+  :func:`static_donation` re-derives the full declared/aliased/refused/
+  dropped sets from the program alone.
+* **APX204 implicit-full-replication** — an ``all_gather`` inside a mesh
+  region materializing a >= threshold-byte unsharded intermediate on
+  every device (``APEX_TPU_LINT_REPLICATION_BYTES``, default 1 MiB).
+* **APX205 reshard-thrash** — an ``all_gather`` whose result only feeds
+  reducing collectives of the same value: gather-then-reduce moves
+  ``(n-1) + 2(n-1)/n`` payloads where reduce-first moves one.
+* **APX206 collective-bypasses-overlap-seam** — in an entry that stages
+  its gradient collectives through the overlap bucket seam
+  (``apex_ddp_allreduce`` named scope), a gradient-sized reduction
+  *outside* the seam: it neither buckets nor overlaps, and re-serializes
+  the backward the seam exists to pipeline.
+* **APX207 callback-reenters-graph** — a ``pure_callback`` whose result
+  feeds traced equations: under pipelined dispatch (trainer in-flight
+  window) host callback ordering is not the dispatch order, so a value
+  re-entering the graph from the host is nondeterministic.
+* **APX208 scan-carry-widening** — a ``lax.scan`` carrying fp32 that the
+  body recomputes in bf16/fp16 and widens every iteration: the carry
+  buffer (and its HBM traffic) is 2x the compute precision for no
+  numerical gain (an fp32 *accumulator* of low-precision addends does
+  not fire — only a carry produced directly by a widening convert does).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import (Any, Callable, Dict, FrozenSet, List, Optional,
+                    Sequence, Tuple)
+
+import jax
+import numpy as np
+
+from apex_tpu.lint.report import Finding
+from apex_tpu.utils.jaxpr_walk import mesh_axis_sizes, subjaxprs_tagged
+
+# the collective catalog is telemetry's (one wire-cost table, one rule
+# set); axis_index is rank-*producing*, not a scheduled collective
+from apex_tpu.telemetry.comm import COLLECTIVE_PRIMS
+
+_LOW_DTYPES = ("bfloat16", "float16")
+_REDUCE_PRIMS = frozenset({"psum", "psum_scatter", "reduce_scatter"})
+_UNIFORMIZING_PRIMS = frozenset({"psum", "pmin", "pmax", "all_gather"})
+_RNG_CONSUME_PRIMS = frozenset({"random_bits", "threefry2x32"})
+_SEAM_TAG = "apex_ddp_allreduce"
+_APX206_MIN_ELEMENTS = 2048            # matches APX106's payload threshold
+
+# taint tags are (kind, axis) pairs, kind in {"rank", "sharded"}; axis
+# "?" marks an undiscoverable axis name (conservatively never erased)
+_CLEAN: FrozenSet[Tuple[str, str]] = frozenset()
+
+Taint = FrozenSet[Tuple[str, str]]
+
+
+def _has(taint: Taint, kind: str) -> bool:
+    return any(k == kind for k, _ in taint)
+
+
+def _axes_of(params: dict) -> Tuple[str, ...]:
+    names = params.get("axes", params.get("axis_name", ()))
+    if isinstance(names, str):
+        names = (names,)
+    return tuple(n for n in (names or ()) if isinstance(n, str))
+
+
+def replication_threshold_bytes() -> int:
+    """APX204's 'large intermediate' threshold (bytes), overridable via
+    ``APEX_TPU_LINT_REPLICATION_BYTES``."""
+    try:
+        return int(os.environ.get("APEX_TPU_LINT_REPLICATION_BYTES",
+                                  str(1 << 20)))
+    except ValueError:
+        return 1 << 20
+
+
+def _frame_for(eqn, default_path: str, default_line: int):
+    from apex_tpu.lint.jaxpr_checks import _frame_for as f
+    return f(eqn, default_path, default_line)
+
+
+def _aval(v):
+    return getattr(v, "aval", None)
+
+
+def _dtype_name(aval) -> str:
+    return str(getattr(aval, "dtype", ""))
+
+
+def _nbytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    return n * np.dtype(dtype).itemsize
+
+
+def _nelems(aval) -> int:
+    shape = getattr(aval, "shape", ()) or ()
+    return int(np.prod(shape, dtype=np.int64)) if shape else 1
+
+
+def _name_stack(eqn) -> str:
+    try:
+        return str(eqn.source_info.name_stack)
+    except Exception:
+        return ""
+
+
+class _Env:
+    """Per-var taint environment tolerant of Literal atoms (unhashable,
+    always clean)."""
+
+    def __init__(self):
+        self._m: Dict[Any, Taint] = {}
+
+    def get(self, v) -> Taint:
+        try:
+            return self._m.get(v, _CLEAN)
+        except TypeError:
+            return _CLEAN
+
+    def set(self, v, t: Taint) -> None:
+        try:
+            self._m[v] = t
+        except TypeError:
+            pass
+
+
+@dataclasses.dataclass
+class _Ctx:
+    """Walk state for one entry. ``rank_gated`` is the control-flow
+    taint: True under any cond branch / while body whose predicate is
+    rank-dependent."""
+
+    entry: str
+    path: str
+    findings: List[Finding]
+    declared_axes: set
+    axis_sizes: Dict[str, int]
+    repl_threshold: int
+    seam_present: bool = False
+    in_mesh: bool = False
+    rank_gated: bool = False
+    in_while: bool = False
+    flagged: set = dataclasses.field(default_factory=set)
+
+    def emit(self, rule: str, eqn, msg: str) -> None:
+        path, line = _frame_for(eqn, self.path, 0)
+        key = (rule, id(eqn))
+        if key in self.flagged:
+            return
+        self.flagged.add(key)
+        self.findings.append(Finding(
+            rule, path, line, f"[entry {self.entry}] {msg}"))
+
+    def child(self, **kw) -> "_Ctx":
+        return dataclasses.replace(self, **kw)
+
+
+def _consumers(jaxpr) -> Dict[Any, List[Any]]:
+    """var -> consuming eqns, within one jaxpr body."""
+    cons: Dict[Any, List[Any]] = {}
+    for eqn in jaxpr.eqns:
+        for v in eqn.invars:
+            try:
+                cons.setdefault(v, []).append(eqn)
+            except TypeError:
+                pass
+    return cons
+
+
+def _seed_child_env(env: _Env, operands: Optional[tuple],
+                    invars) -> _Env:
+    child = _Env()
+    if operands is not None and len(operands) == len(invars):
+        for outer, iv in zip(operands, invars):
+            child.set(iv, env.get(outer))
+    return child
+
+
+def _out_taints(jaxpr, env: _Env) -> List[Taint]:
+    return [env.get(v) for v in jaxpr.outvars]
+
+
+# ---------------------------------------------------------------------------
+# per-rule checks (run inside the main walk)
+# ---------------------------------------------------------------------------
+
+def _check_apx201(eqn, ctx: _Ctx) -> None:
+    if eqn.primitive.name not in COLLECTIVE_PRIMS or not ctx.rank_gated:
+        return
+    ctx.emit(
+        "APX201", eqn,
+        f"collective `{eqn.primitive.name}` is reachable under "
+        f"rank-dependent control flow (an axis_index-derived value feeds "
+        f"an enclosing cond/while predicate) — ranks can disagree on the "
+        f"collective schedule and deadlock; hoist the collective out of "
+        f"the gated region, or gate on a replica-uniform value (e.g. "
+        f"psum the predicate first)")
+
+
+def _check_apx202(eqn, env: _Env, ctx: _Ctx) -> None:
+    if eqn.primitive.name not in _RNG_CONSUME_PRIMS or not ctx.in_mesh:
+        return
+    taint: Taint = frozenset()
+    for v in eqn.invars:
+        taint = taint | env.get(v)
+    if _has(taint, "sharded") and not _has(taint, "rank"):
+        ctx.emit(
+            "APX202", eqn,
+            "PRNG key consumed inside a shard_map region is derived from "
+            "sharded (per-replica) data and never folds in the axis "
+            "index — replicas draw different randomness by accident and "
+            "their parameter updates desynchronize; derive the key from "
+            "a replicated input, or make per-rank streams explicit with "
+            "jax.random.fold_in(key, jax.lax.axis_index(axis))")
+
+
+def _check_apx204_205(eqn, ctx: _Ctx, cons: Dict[Any, List[Any]],
+                      out_set: set) -> None:
+    if eqn.primitive.name != "all_gather" or not ctx.in_mesh:
+        return
+    outv = eqn.outvars[0] if eqn.outvars else None
+    if outv is None:
+        return
+    users = cons.get(outv, [])
+    if users and all(u.primitive.name in _REDUCE_PRIMS for u in users) \
+            and (outv not in out_set):
+        ctx.emit(
+            "APX205", eqn,
+            "all_gather result only feeds a reducing collective "
+            f"({', '.join(sorted({u.primitive.name for u in users}))}) of "
+            "the same value — gather-then-reduce pays the all_gather's "
+            "(n-1)x wire bytes for a value a single reduction produces; "
+            "reduce first (psum/reduce_scatter the shard) and drop the "
+            "gather")
+        return
+    nbytes = _nbytes(_aval(outv))
+    if nbytes >= ctx.repl_threshold:
+        ctx.emit(
+            "APX204", eqn,
+            f"all_gather materializes an unsharded {nbytes:,}-byte "
+            f"intermediate on every device of the mesh region (threshold "
+            f"{ctx.repl_threshold:,}; APEX_TPU_LINT_REPLICATION_BYTES "
+            "overrides) — full replication of a tensor this size defeats "
+            "the sharding; keep it sharded (reduce_scatter, or consume "
+            "the shard directly)")
+
+
+def _check_apx206(eqn, ctx: _Ctx) -> None:
+    if not ctx.seam_present or not ctx.in_mesh:
+        return
+    if eqn.primitive.name not in _REDUCE_PRIMS:
+        return
+    if _SEAM_TAG in _name_stack(eqn):
+        return
+    for v in eqn.invars:
+        aval = _aval(v)
+        if aval is None:
+            continue
+        if not np.issubdtype(getattr(aval, "dtype", np.int32),
+                             np.floating):
+            continue
+        if _nelems(aval) >= _APX206_MIN_ELEMENTS:
+            ctx.emit(
+                "APX206", eqn,
+                f"{eqn.primitive.name} moves a gradient-sized payload "
+                f"({_nelems(aval)} elements) outside the overlap bucket "
+                f"seam in an entry that stages its collectives through "
+                f"it — this reduction neither buckets nor overlaps and "
+                "re-serializes the backward; route it through "
+                "overlap.sync_in_backward / allreduce_gradients")
+            return
+
+
+def _check_apx207(eqn, ctx: _Ctx, cons: Dict[Any, List[Any]],
+                  out_set: set) -> None:
+    if eqn.primitive.name != "pure_callback":
+        return
+    used = any(cons.get(ov) for ov in eqn.outvars) or any(
+        ov in out_set for ov in eqn.outvars)
+    if used:
+        ctx.emit(
+            "APX207", eqn,
+            "pure_callback result re-enters the traced graph — under "
+            "pipelined dispatch (trainer in-flight window) host callback "
+            "ordering is not dispatch ordering, so the fed-back value is "
+            "nondeterministic across runs; compute it in the graph, pass "
+            "it in as an argument, or keep callbacks effect-only "
+            "(jax.debug.callback)")
+
+
+def _check_apx208(eqn, ctx: _Ctx) -> None:
+    if eqn.primitive.name != "scan":
+        return
+    closed = eqn.params.get("jaxpr")
+    body = getattr(closed, "jaxpr", closed)
+    if not hasattr(body, "eqns"):
+        return
+    num_consts = int(eqn.params.get("num_consts", 0))
+    num_carry = int(eqn.params.get("num_carry", 0))
+    carry_in = body.invars[num_consts:num_consts + num_carry]
+    carry_out = body.outvars[:num_carry]
+    producers: Dict[Any, Any] = {}
+    for beqn in body.eqns:
+        for ov in beqn.outvars:
+            try:
+                producers[ov] = beqn
+            except TypeError:
+                pass
+    for i, (ci, co) in enumerate(zip(carry_in, carry_out)):
+        if _dtype_name(_aval(ci)) != "float32":
+            continue
+        peqn = producers.get(co)
+        if peqn is None or peqn.primitive.name != "convert_element_type":
+            continue
+        src = _dtype_name(_aval(peqn.invars[0]))
+        if src in _LOW_DTYPES:
+            ctx.emit(
+                "APX208", eqn,
+                f"scan carry leaf {i} is float32 but the loop body "
+                f"produces it by widening a {src} value every iteration "
+                "— the carry buffer and its per-iteration HBM traffic "
+                "are 2x the compute precision for no numerical gain; "
+                "carry the low dtype (or accumulate in fp32 *inside* "
+                "the body if a true accumulator is intended)")
+
+
+# ---------------------------------------------------------------------------
+# the abstract-interpretation walk
+# ---------------------------------------------------------------------------
+
+def _propagate(eqn, env: _Env) -> Taint:
+    """Default forward taint: union of inputs, with collectives erasing
+    the tags of the axes they reduce over (a full-axis reduction/gather
+    result is replica-uniform ALONG THOSE AXES — divergence along the
+    other axes of a multi-axis mesh survives) and axis_index introducing
+    ``("rank", axis)``."""
+    prim = eqn.primitive.name
+    if prim == "axis_index":
+        axes = _axes_of(eqn.params)
+        return frozenset(("rank", a) for a in (axes or ("?",)))
+    t: Taint = frozenset()
+    for v in eqn.invars:
+        t = t | env.get(v)
+    if prim in _UNIFORMIZING_PRIMS \
+            and eqn.params.get("axis_index_groups") is None:
+        reduced = set(_axes_of(eqn.params))
+        return frozenset(tag for tag in t if tag[1] not in reduced)
+    return t
+
+
+def _jaxpr_taint(jaxpr, env: _Env, ctx: _Ctx, *,
+                 check: bool) -> List[Taint]:
+    """Walk one jaxpr body: run rule checks (when ``check``), propagate
+    taint, recurse into sub-jaxprs with role-aware contexts. Returns the
+    outvar taints. ``check=False`` walks are pure dataflow probes (while
+    predicate fixpoints) and emit nothing."""
+    cons = _consumers(jaxpr) if check else {}
+    out_set = set()
+    if check:
+        for ov in jaxpr.outvars:
+            try:
+                out_set.add(ov)
+            except TypeError:
+                pass
+
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+
+        if check:
+            _check_apx201(eqn, ctx)
+            _check_apx202(eqn, env, ctx)
+            _check_apx204_205(eqn, ctx, cons, out_set)
+            _check_apx206(eqn, ctx)
+            _check_apx207(eqn, ctx, cons, out_set)
+            _check_apx208(eqn, ctx)
+
+        subs = subjaxprs_tagged(eqn)
+        sub_out_taints: Optional[List[Taint]] = None
+
+        if prim == "cond" and subs:
+            pred_taint = env.get(eqn.invars[0])
+            gated = ctx.rank_gated or _has(pred_taint, "rank")
+            joined: Optional[List[Taint]] = None
+            for sub in subs:
+                child_env = _seed_child_env(env, sub.operands,
+                                            sub.jaxpr.invars)
+                outs = _jaxpr_taint(
+                    sub.jaxpr, child_env,
+                    ctx.child(rank_gated=gated) if check else ctx,
+                    check=check)
+                joined = outs if joined is None else [
+                    a | b for a, b in zip(joined, outs)]
+            sub_out_taints = joined
+
+        elif prim == "while" and subs:
+            by_role = {s.role: s for s in subs}
+            cond_s, body_s = by_role.get("while_cond"), by_role.get(
+                "while_body")
+            # fixpoint: carry taint grows monotonically through body
+            # applications until stable (taint lattice height 2 => fast)
+            carry_ops = body_s.operands if body_s is not None else None
+            body_in = (list(body_s.jaxpr.invars)
+                       if body_s is not None else [])
+            carry_taints: List[Taint] = []
+            if body_s is not None and carry_ops is not None:
+                nconsts = int(eqn.params.get("body_nconsts", 0))
+                carry_taints = [env.get(op) for op in carry_ops[nconsts:]]
+                for _ in range(4):
+                    probe = _Env()
+                    for op, iv in zip(carry_ops, body_in):
+                        probe.set(iv, env.get(op))
+                    for t, iv in zip(carry_taints, body_in[nconsts:]):
+                        probe.set(iv, probe.get(iv) | t)
+                    outs = _jaxpr_taint(body_s.jaxpr, probe, ctx,
+                                        check=False)
+                    new = [a | b for a, b in zip(carry_taints, outs)]
+                    if new == carry_taints:
+                        break
+                    carry_taints = new
+            pred_rank = ctx.rank_gated
+            if cond_s is not None:
+                probe = _seed_child_env(env, cond_s.operands,
+                                        cond_s.jaxpr.invars)
+                if cond_s.operands is not None and carry_taints:
+                    ncc = int(eqn.params.get("cond_nconsts", 0))
+                    for t, iv in zip(carry_taints,
+                                     cond_s.jaxpr.invars[ncc:]):
+                        probe.set(iv, probe.get(iv) | t)
+                pred_taints = _jaxpr_taint(cond_s.jaxpr, probe, ctx,
+                                           check=False)
+                pred_rank = pred_rank or any(
+                    _has(t, "rank") for t in pred_taints)
+            if check:
+                wctx = ctx.child(rank_gated=pred_rank, in_while=True)
+                for sub in subs:
+                    child_env = _seed_child_env(env, sub.operands,
+                                                sub.jaxpr.invars)
+                    if sub.role == "while_body" and carry_taints \
+                            and sub.operands is not None:
+                        nconsts = int(eqn.params.get("body_nconsts", 0))
+                        for t, iv in zip(carry_taints,
+                                         sub.jaxpr.invars[nconsts:]):
+                            child_env.set(iv, child_env.get(iv) | t)
+                    _jaxpr_taint(sub.jaxpr, child_env, wctx, check=check)
+            sub_out_taints = carry_taints or None
+
+        elif prim == "scan" and subs:
+            sub = subs[0]
+            child_env = _seed_child_env(env, sub.operands,
+                                        sub.jaxpr.invars)
+            outs = _jaxpr_taint(sub.jaxpr, child_env, ctx, check=False)
+            # one reinforcement pass: carry-out taint feeds carry-in
+            num_consts = int(eqn.params.get("num_consts", 0))
+            num_carry = int(eqn.params.get("num_carry", 0))
+            if sub.operands is not None:
+                for i in range(num_carry):
+                    iv = sub.jaxpr.invars[num_consts + i]
+                    child_env.set(iv, child_env.get(iv) | outs[i])
+            sub_out_taints = _jaxpr_taint(sub.jaxpr, child_env, ctx,
+                                          check=check)
+
+        elif prim == "shard_map" and subs:
+            sub = subs[0]
+            child_env = _Env()
+            in_names = eqn.params.get("in_names", ())
+            if sub.operands is not None:
+                for k, (outer, iv) in enumerate(zip(sub.operands,
+                                                    sub.jaxpr.invars)):
+                    t = env.get(outer)
+                    shard_axes: set = set()
+                    try:
+                        for dim_axes in in_names[k].values():
+                            if isinstance(dim_axes, (tuple, list)):
+                                shard_axes.update(dim_axes)
+                            else:
+                                shard_axes.add(dim_axes)
+                    except Exception:
+                        pass
+                    if shard_axes:
+                        t = t | frozenset(
+                            ("sharded", a) for a in shard_axes)
+                    child_env.set(iv, t)
+            mctx = ctx
+            if check:
+                for name, size in mesh_axis_sizes(eqn).items():
+                    ctx.declared_axes.add(name)
+                    ctx.axis_sizes.setdefault(name, size)
+                mctx = ctx.child(in_mesh=True)
+            sub_out_taints = _jaxpr_taint(sub.jaxpr, child_env, mctx,
+                                          check=check)
+
+        else:
+            for sub in subs:
+                child_env = _seed_child_env(env, sub.operands,
+                                            sub.jaxpr.invars)
+                outs = _jaxpr_taint(sub.jaxpr, child_env, ctx,
+                                    check=check)
+                if sub.operands is not None and sub_out_taints is None:
+                    sub_out_taints = outs
+
+        if sub_out_taints is not None \
+                and len(sub_out_taints) == len(eqn.outvars):
+            for t, ov in zip(sub_out_taints, eqn.outvars):
+                env.set(ov, t)
+        else:
+            t = _propagate(eqn, env)
+            for ov in eqn.outvars:
+                env.set(ov, t)
+
+    return _out_taints(jaxpr, env)
+
+
+def _seam_in(jaxpr) -> bool:
+    found = [False]
+
+    def visit(eqn):
+        if eqn.primitive.name in _REDUCE_PRIMS \
+                and _SEAM_TAG in _name_stack(eqn):
+            found[0] = True
+    from apex_tpu.utils.jaxpr_walk import walk_jaxpr
+    walk_jaxpr(jaxpr, visit)
+    return found[0]
+
+
+# ---------------------------------------------------------------------------
+# donation: static facts + use-after-donation (APX203)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class StaticDonation:
+    """Donation facts re-derived from the traced program alone — the
+    static twin of the trainer's runtime
+    :class:`~apex_tpu.trainer.DonationReport` (same fields, derived
+    without compiling): ``declared`` donated leaves, of which ``aliased``
+    have a shape/dtype-compatible output slot, ``refused`` do not (each
+    one a real double-buffer — the aval is named), and ``dropped`` are
+    read by nothing (XLA dead-code-eliminates the parameter)."""
+
+    declared: int
+    aliased: int
+    refused: Tuple[str, ...]
+    dropped: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.refused
+
+    def to_json(self) -> dict:
+        return {"declared": self.declared, "aliased": self.aliased,
+                "refused": list(self.refused), "dropped": self.dropped,
+                "ok": self.ok}
+
+
+def _donated_invar_indices(args: tuple, donate_argnums: Sequence[int]
+                           ) -> List[int]:
+    counts = [len(jax.tree_util.tree_leaves(a)) for a in args]
+    starts = np.cumsum([0] + counts).tolist()
+    idxs: List[int] = []
+    for argnum in donate_argnums:
+        if 0 <= argnum < len(counts):
+            idxs.extend(range(starts[argnum], starts[argnum + 1]))
+    return idxs
+
+
+def _program_body(jaxpr) -> Tuple[Any, bool]:
+    """Descend through a sole top-level wrapper equation (shard_map /
+    pjit) that consumes all invars and produces all outvars in order —
+    the trainer's traced form — so equation *ordering* is read where the
+    real program body lives. Returns (body, descended)."""
+    body = jaxpr
+    descended = False
+    while (len(body.eqns) == 1
+           and body.eqns[0].primitive.name in ("shard_map", "pjit",
+                                               "closed_call")
+           and list(body.eqns[0].invars) == list(body.invars)
+           and list(body.eqns[0].outvars) == list(body.outvars)):
+        subs = subjaxprs_tagged(body.eqns[0])
+        if len(subs) != 1 or subs[0].operands is None:
+            break
+        body = subs[0].jaxpr
+        descended = True
+    return body, descended
+
+
+def _aval_key(aval) -> Tuple:
+    return (tuple(getattr(aval, "shape", ()) or ()),
+            _dtype_name(aval))
+
+
+def analyze_donation(closed, args: tuple,
+                     donate_argnums: Sequence[int],
+                     ctx: Optional[_Ctx] = None) -> StaticDonation:
+    """Static donation facts for a traced program (``closed`` from
+    ``jax.make_jaxpr(fn)(*args)``), emitting APX203 findings into
+    ``ctx`` for donated leaves read after their aliased output exists."""
+    donated = _donated_invar_indices(args, donate_argnums)
+    body, _ = _program_body(closed.jaxpr)
+    invars = list(body.invars)
+    outvars = list(body.outvars)
+
+    read_at: Dict[Any, List[int]] = {}
+    produced_at: Dict[Any, int] = {}
+    for i, eqn in enumerate(body.eqns):
+        for v in eqn.invars:
+            try:
+                read_at.setdefault(v, []).append(i)
+            except TypeError:
+                pass
+        for ov in eqn.outvars:
+            try:
+                produced_at[ov] = i
+            except TypeError:
+                pass
+
+    out_avals = [_aval(v) for v in outvars]
+    out_taken = [False] * len(outvars)
+    try:
+        out_pos = {v: k for k, v in enumerate(outvars)}
+    except TypeError:
+        out_pos = {}
+
+    declared = len(donated)
+    aliased = 0
+    dropped = 0
+    refused: List[str] = []
+
+    for slot, inv_idx in enumerate(donated):
+        if inv_idx >= len(invars):
+            continue
+        v = invars[inv_idx]
+        reads = read_at.get(v, [])
+        is_passthrough = v in out_pos
+        if not reads and not is_passthrough:
+            dropped += 1
+            continue
+
+        partner: Optional[int] = None
+        # carry convention first: donated leaf k pairs with output k
+        if slot < len(outvars) and not out_taken[slot] \
+                and _aval_key(out_avals[slot]) == _aval_key(_aval(v)):
+            partner = slot
+        else:
+            for k, (taken, oa) in enumerate(zip(out_taken, out_avals)):
+                if not taken and _aval_key(oa) == _aval_key(_aval(v)):
+                    partner = k
+                    break
+        if partner is None:
+            refused.append(f"{_dtype_name(_aval(v))}"
+                           f"{list(getattr(_aval(v), 'shape', ()) or ())}")
+            continue
+        out_taken[partner] = True
+        aliased += 1
+
+        if ctx is None:
+            continue
+        w = outvars[partner]
+        if w is v:
+            continue                    # passthrough: trivially aliased
+        def_idx = produced_at.get(w)
+        if def_idx is None:
+            continue
+        late = [i for i in reads if i > def_idx]
+        if late:
+            eqn = body.eqns[late[0]]
+            ctx.emit(
+                "APX203", eqn,
+                f"donated carry leaf {slot} "
+                f"({_dtype_name(_aval(v))}"
+                f"{list(getattr(_aval(v), 'shape', ()) or ())}) is read "
+                f"after its aliased output is produced (equation "
+                f"{late[0]} reads it; the output exists from equation "
+                f"{def_idx}) — XLA must copy or refuse the donation and "
+                "the leaf double-buffers; compute everything that reads "
+                "the old value before producing the new one")
+
+    return StaticDonation(declared=declared, aliased=aliased,
+                          refused=tuple(refused), dropped=dropped)
+
+
+def static_donation(fn: Callable, args: tuple, *,
+                    donate_argnums: Sequence[int] = (0,)
+                    ) -> StaticDonation:
+    """Trace ``fn(*args)`` and re-derive its donation result statically —
+    the aliased/refused/dropped sets the trainer's runtime audit reads
+    off the compiled module, without compiling. Pinned against
+    :class:`~apex_tpu.trainer.DonationReport` by tests."""
+    closed = jax.make_jaxpr(fn)(*args)
+    return analyze_donation(closed, args, donate_argnums)
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def check_entry_spmd(fn: Callable, args: tuple, *, name: str = "<entry>",
+                     path: str = "<jaxpr>",
+                     mesh_axes: Sequence[str] = (),
+                     axis_sizes: Optional[Dict[str, int]] = None,
+                     donate_argnums: Sequence[int] = (),
+                     threshold_bytes: Optional[int] = None,
+                     closed=None) -> List[Finding]:
+    """Trace ``fn(*args)`` (no execution) and run the APX2xx SPMD rules.
+    Read-only: the traced program is never altered (jaxpr-equality is
+    pinned by tests). ``donate_argnums`` arms the use-after-donation
+    rule; ``threshold_bytes`` overrides APX204's replication threshold;
+    ``closed`` accepts an already-lowered ClosedJaxpr of the same
+    ``fn(*args)`` so callers running multiple passes (check_entry's
+    ``spmd=True``) lower once. Public so downstream train steps can
+    verify their own entries::
+
+        from apex_tpu import lint
+        findings = lint.check_entry_spmd(step, (state, batch),
+                                         mesh_axes=("data",),
+                                         donate_argnums=(0,))
+    """
+    if closed is None:
+        closed = jax.make_jaxpr(fn)(*args)
+    ctx = _Ctx(entry=name, path=path, findings=[],
+               declared_axes=set(mesh_axes),
+               axis_sizes=dict(axis_sizes or {}),
+               repl_threshold=(replication_threshold_bytes()
+                               if threshold_bytes is None
+                               else int(threshold_bytes)),
+               seam_present=_seam_in(closed.jaxpr))
+    env = _Env()
+    _jaxpr_taint(closed.jaxpr, env, ctx, check=True)
+    if donate_argnums:
+        analyze_donation(closed, args, donate_argnums, ctx)
+    return ctx.findings
+
+
+def run_entries_spmd(entries=None) -> List[Finding]:
+    """Run the SPMD pass over every registered entry point (the same
+    :class:`~apex_tpu.lint.jaxpr_checks.EntrySpec` list the APX1xx pass
+    lowers — build failures are loud, not skipped)."""
+    from apex_tpu.lint.jaxpr_checks import builtin_entries
+    findings: List[Finding] = []
+    for spec in builtin_entries() if entries is None else entries:
+        try:
+            fn, args = spec.make()
+        except Exception as e:    # pragma: no cover - defensive
+            raise RuntimeError(
+                f"apexlint spmd entry {spec.name!r} failed to build: {e}"
+            ) from e
+        findings.extend(check_entry_spmd(
+            fn, args, name=spec.name, path=spec.path,
+            mesh_axes=spec.mesh_axes,
+            donate_argnums=getattr(spec, "donate_argnums", ())))
+    return findings

@@ -41,7 +41,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
-from apex_tpu.utils.jaxpr_walk import subjaxprs
+from apex_tpu.utils.jaxpr_walk import WalkContext, walk_jaxpr_ctx
 
 # collective primitive -> wire multiplier builder (n = axis size)
 _WIRE = {
@@ -99,63 +99,41 @@ def _operand_bytes(eqn) -> float:
     return total
 
 
-def _accumulate(jaxpr, mult: int, in_while: bool,
-                axis_sizes: Dict[str, int],
-                stats: Dict[Tuple[str, str], CommRecord]) -> None:
-    for eqn in jaxpr.eqns:
-        prim = eqn.primitive.name
-
-        if prim == "shard_map":
-            mesh = eqn.params.get("mesh")
-            shape = getattr(mesh, "shape", None)  # Mapping axis -> size
-            for name in getattr(mesh, "axis_names", ()) or ():
-                try:
-                    axis_sizes.setdefault(name, int(shape[name]))
-                except Exception:
-                    pass
-
-        if prim in COLLECTIVE_PRIMS:
-            names = _axis_names_of(eqn.params)
-            nbytes = _operand_bytes(eqn)
-            # multi-axis collective: total world = product of sizes; the
-            # bill is charged to each named axis with the joint world size
-            # (sizes compose multiplicatively for ring cost estimation)
-            world: Optional[int] = 1
-            for name in names:
-                n = axis_sizes.get(name)
-                world = None if n is None or world is None else world * n
-            # grouped collective: the ring runs within one replica
-            # subset, so the effective world is the GROUP size, not the
-            # axis size (and it is known even when the axis size is not
-            # discoverable — adasum's pairwise levels bill as 2-member
-            # all-reduces, not full-axis ones)
-            groups = eqn.params.get("axis_index_groups")
-            if groups is not None:
-                try:
-                    world = len(groups[0]) or None
-                except Exception:
-                    pass
-            for name in names:
-                rec = stats.setdefault(
-                    (name, prim), CommRecord(axis=name, primitive=prim))
-                rec.count += mult
-                rec.bytes_in += mult * nbytes
-                rec.in_while = rec.in_while or in_while
-                if rec.bytes_wire is not None and world and world > 0:
-                    rec.bytes_wire += mult * nbytes * _WIRE[prim](world)
-                else:
-                    rec.bytes_wire = None
-
-        inner_mult, inner_while = mult, in_while
-        if prim == "scan":
-            try:
-                inner_mult = mult * int(eqn.params.get("length", 1))
-            except Exception:
-                pass
-        elif prim == "while":
-            inner_while = True
-        for inner, _ in subjaxprs(eqn):
-            _accumulate(inner, inner_mult, inner_while, axis_sizes, stats)
+def _visit_collective(eqn, ctx: "WalkContext",
+                      stats: Dict[Tuple[str, str], CommRecord]) -> None:
+    prim = eqn.primitive.name
+    if prim not in COLLECTIVE_PRIMS:
+        return
+    names = _axis_names_of(eqn.params)
+    nbytes = _operand_bytes(eqn)
+    # multi-axis collective: total world = product of sizes; the
+    # bill is charged to each named axis with the joint world size
+    # (sizes compose multiplicatively for ring cost estimation)
+    world: Optional[int] = 1
+    for name in names:
+        n = ctx.axis_size(name)
+        world = None if n is None or world is None else world * n
+    # grouped collective: the ring runs within one replica
+    # subset, so the effective world is the GROUP size, not the
+    # axis size (and it is known even when the axis size is not
+    # discoverable — adasum's pairwise levels bill as 2-member
+    # all-reduces, not full-axis ones)
+    groups = eqn.params.get("axis_index_groups")
+    if groups is not None:
+        try:
+            world = len(groups[0]) or None
+        except Exception:
+            pass
+    for name in names:
+        rec = stats.setdefault(
+            (name, prim), CommRecord(axis=name, primitive=prim))
+        rec.count += ctx.loop_mult
+        rec.bytes_in += ctx.loop_mult * nbytes
+        rec.in_while = rec.in_while or ctx.in_while
+        if rec.bytes_wire is not None and world and world > 0:
+            rec.bytes_wire += ctx.loop_mult * nbytes * _WIRE[prim](world)
+        else:
+            rec.bytes_wire = None
 
 
 def comm_stats(fn: Callable, *args,
@@ -164,13 +142,22 @@ def comm_stats(fn: Callable, *args,
     """Trace ``fn(*args, **kwargs)`` (no execution — avals suffice) and
     return per-(axis, primitive) communication records for ONE call.
 
+    The traversal is :func:`~apex_tpu.utils.jaxpr_walk.walk_jaxpr_ctx` —
+    the context walker threads the scan multipliers, while-body flags,
+    and shard_map-resolved axis sizes this accounting needs (and the
+    lint SPMD verifier shares the same sub-jaxpr discovery tier).
+
     ``axis_sizes`` pre-seeds axis-name -> size for programs whose mesh is
     not discoverable from the jaxpr (bare pmap bodies, check_entry-style
     fragments); sizes found on enclosing ``shard_map`` equations are
     picked up automatically and take precedence only where unset."""
     closed = jax.make_jaxpr(fn)(*args, **kwargs)
     stats: Dict[Tuple[str, str], CommRecord] = {}
-    _accumulate(closed.jaxpr, 1, False, dict(axis_sizes or {}), stats)
+    seed = WalkContext(
+        axis_sizes=tuple(sorted((axis_sizes or {}).items())))
+    walk_jaxpr_ctx(closed.jaxpr,
+                   lambda eqn, ctx: _visit_collective(eqn, ctx, stats),
+                   seed)
     return sorted(stats.values(), key=lambda r: (r.axis, r.primitive))
 
 

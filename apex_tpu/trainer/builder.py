@@ -285,12 +285,22 @@ class Trainer:
     def __init__(self, *, fn: Callable, traced_fn: Callable,
                  config: TrainerConfig,
                  donation: Optional[DonationReport],
-                 plugins: Sequence[Any] = (), name: str = "trainer"):
+                 plugins: Sequence[Any] = (), name: str = "trainer",
+                 donate_argnums: Tuple[int, ...] = (),
+                 mesh_axes: Tuple[str, ...] = (),
+                 example_args: Optional[tuple] = None):
         self.fn = fn
         self.traced_fn = traced_fn
         self.config = config
         self.donation = donation
         self.name = name
+        # the static-analysis seam: enough of the build declaration
+        # (donation argnums, mesh axes, example avals) for the lint SPMD
+        # verifier to re-trace and verify the SAME program the build
+        # compiled — see check_spmd / static_donation
+        self.donate_argnums = tuple(donate_argnums)
+        self.mesh_axes = tuple(mesh_axes)
+        self.example_args = example_args
         self.steps_per_call = (1 if config.mode == "per_step"
                                else config.steps_per_call)
         self.plugins = list(plugins)
@@ -391,6 +401,41 @@ class Trainer:
         bottleneck."""
         return self._window.stats()
 
+    # -- the static-analysis seam ------------------------------------------
+    def check_spmd(self, *, threshold_bytes: Optional[int] = None):
+        """Run the lint SPMD verifier (APX201-APX208) over this
+        trainer's traced program — the exact function the build
+        compiled, with the build's own donation declaration and mesh
+        axes. Trace-only (no execution, no devices); returns the
+        findings list (empty = verified)."""
+        from apex_tpu.lint.spmd_checks import check_entry_spmd
+        if self.example_args is None:
+            raise ValueError(
+                "this Trainer was constructed directly without "
+                "example_args; trainer.build populates the analysis "
+                "seam automatically")
+        return check_entry_spmd(
+            self.traced_fn, self.example_args, name=self.name,
+            path="apex_tpu/trainer/builder.py",
+            mesh_axes=self.mesh_axes,
+            donate_argnums=self.donate_argnums,
+            threshold_bytes=threshold_bytes)
+
+    def static_donation(self):
+        """Statically re-derive this build's donation result from the
+        traced program alone — the same declared/aliased/refused/dropped
+        sets the runtime :class:`DonationReport` reads off the compiled
+        module, without compiling (tests pin the two against each
+        other). Returns :class:`~apex_tpu.lint.StaticDonation`."""
+        from apex_tpu.lint.spmd_checks import static_donation
+        if self.example_args is None:
+            raise ValueError(
+                "this Trainer was constructed directly without "
+                "example_args; trainer.build populates the analysis "
+                "seam automatically")
+        return static_donation(self.traced_fn, self.example_args,
+                               donate_argnums=self.donate_argnums)
+
     # -- convenience loop --------------------------------------------------
     def run(self, state: Tree, data, steps: int,
             on_step: Optional[Callable] = None) -> Tree:
@@ -456,8 +501,20 @@ def build(step_fn: Callable, state: Tree, batch: Tree, *,
     report = None
     if config.donate and config.audit_donation:
         report = _audit_donation(fn, state, batch)
+
+    def _sds(leaf):
+        if isinstance(leaf, jax.ShapeDtypeStruct):
+            return leaf
+        return jax.ShapeDtypeStruct(jnp.shape(leaf),
+                                    jnp.result_type(leaf))
+    example = jax.tree_util.tree_map(_sds, (state, batch))
     trainer = Trainer(fn=fn, traced_fn=traced, config=config,
-                      donation=report, plugins=plugins, name=name)
+                      donation=report, plugins=plugins, name=name,
+                      donate_argnums=donate,
+                      mesh_axes=(tuple(getattr(mesh, "axis_names", ())
+                                       or ()) if mesh is not None
+                                 else ()),
+                      example_args=example)
     for p in trainer.plugins:
         hook = getattr(p, "on_step", None)
         if hook is not None:

@@ -1,19 +1,34 @@
 """Generic jaxpr equation-graph walking, shared by the lint jaxpr pass
-(apex_tpu/lint/jaxpr_checks.py) and the telemetry comm accounting
+(apex_tpu/lint/jaxpr_checks.py), the SPMD verifier
+(apex_tpu/lint/spmd_checks.py), and the telemetry comm accounting
 (apex_tpu/telemetry/comm.py).
 
-Both consumers traverse the same program shape — registered entry points
+All consumers traverse the same program shape — registered entry points
 lowered with ``jax.make_jaxpr`` whose equations nest sub-jaxprs through
 pjit / scan / cond / while / custom-vjp / shard_map / pallas_call — so the
-sub-jaxpr discovery lives here once. Consumers that need to thread their
-own per-subtree state (lint's low-precision provenance env) call
-:func:`subjaxprs` and recurse themselves; consumers that just need every
-equation call :func:`walk_jaxpr`.
+sub-jaxpr discovery lives here once. Three precision tiers:
+
+* :func:`walk_jaxpr` — every equation, no context. For consumers that
+  only need to see each equation once.
+* :func:`subjaxprs` — ``(inner, outer_operands_or_None)`` pairs with the
+  *permissive* operand mapping (operands only when arities line up 1:1).
+  Consumers threading their own per-var state (lint's low-precision
+  provenance env) recurse themselves.
+* :func:`subjaxprs_tagged` / :func:`walk_jaxpr_ctx` — role-tagged
+  discovery with the *precise* operand mapping (``while`` splits its
+  cond/body consts, ``cond`` drops the predicate) plus a threaded
+  :class:`WalkContext` carrying mesh axes/sizes from enclosing
+  ``shard_map``\\ s (via :func:`mesh_axis_sizes`), static loop
+  multipliers, and control-flow nesting. The SPMD verifier's abstract
+  interpretation recurses itself over :func:`subjaxprs_tagged` (it
+  threads a dataflow env the generic walker can't); telemetry's comm
+  accounting consumes :func:`walk_jaxpr_ctx` directly.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional, Tuple
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 
 def subjaxprs(eqn) -> List[Tuple[Any, Optional[tuple]]]:
@@ -55,3 +70,199 @@ def walk_jaxpr(jaxpr, visit: Callable[[Any], None]) -> None:
         visit(eqn)
         for inner, _ in subjaxprs(eqn):
             walk_jaxpr(inner, visit)
+
+
+# ---------------------------------------------------------------------------
+# precise tier: role-tagged sub-jaxprs + context threading
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class SubJaxpr:
+    """One sub-jaxpr of an equation, with its structural role and the
+    outer operands that seed its invars.
+
+    role:
+        ``"cond_branch"`` (one per ``lax.cond``/``lax.switch`` branch),
+        ``"while_cond"`` / ``"while_body"``, ``"scan_body"``,
+        ``"shard_map"``, ``"pallas"``, or ``"call"`` (pjit / closed_call
+        / custom-jvp/vjp primal — plain inlined calls).
+    operands:
+        Outer atoms mapping 1:1 onto ``jaxpr.invars`` — the *precise*
+        mapping (``while`` splits cond/body consts and shares the carry;
+        ``cond`` drops the predicate; ``scan`` maps consts+carry+xs
+        positionally, xs avals differing only in the scanned leading
+        dim). ``None`` when no sound mapping exists (pallas operands
+        pass through BlockSpec index maps; thunk-shaped params).
+    """
+
+    role: str
+    jaxpr: Any
+    operands: Optional[tuple]
+
+
+def _inner(j):
+    inner = getattr(j, "jaxpr", j)              # ClosedJaxpr -> Jaxpr
+    if hasattr(inner, "eqns") and hasattr(inner, "invars"):
+        return inner
+    return None
+
+
+def subjaxprs_tagged(eqn) -> List[SubJaxpr]:
+    """Role-tagged sub-jaxprs with the precise operand mapping (see
+    :class:`SubJaxpr`). Falls back to the permissive :func:`subjaxprs`
+    shapes (role ``"call"``/``"pallas"``, operands where arity allows)
+    for primitives without bespoke handling."""
+    prim = eqn.primitive.name
+    params = eqn.params
+    out: List[SubJaxpr] = []
+
+    if prim == "cond" and isinstance(params.get("branches"), (tuple, list)):
+        ops = tuple(eqn.invars[1:])
+        for br in params["branches"]:
+            j = _inner(br)
+            if j is not None:
+                out.append(SubJaxpr("cond_branch", j,
+                                    ops if len(ops) == len(j.invars)
+                                    else None))
+        return out
+
+    if prim == "while":
+        cn = int(params.get("cond_nconsts", 0))
+        bn = int(params.get("body_nconsts", 0))
+        carry = tuple(eqn.invars[cn + bn:])
+        cj = _inner(params.get("cond_jaxpr"))
+        bj = _inner(params.get("body_jaxpr"))
+        if cj is not None:
+            ops = tuple(eqn.invars[:cn]) + carry
+            out.append(SubJaxpr("while_cond", cj,
+                                ops if len(ops) == len(cj.invars) else None))
+        if bj is not None:
+            ops = tuple(eqn.invars[cn:cn + bn]) + carry
+            out.append(SubJaxpr("while_body", bj,
+                                ops if len(ops) == len(bj.invars) else None))
+        return out
+
+    if prim == "scan":
+        j = _inner(params.get("jaxpr"))
+        if j is not None:
+            ops = tuple(eqn.invars)
+            out.append(SubJaxpr("scan_body", j,
+                                ops if len(ops) == len(j.invars) else None))
+        return out
+
+    if prim == "shard_map":
+        j = _inner(params.get("jaxpr"))
+        if j is not None:
+            ops = tuple(eqn.invars)
+            out.append(SubJaxpr("shard_map", j,
+                                ops if len(ops) == len(j.invars) else None))
+        return out
+
+    role = "pallas" if prim == "pallas_call" else "call"
+    for key, val in params.items():
+        vals = (val if isinstance(val, (tuple, list))
+                else (val,))
+        listed = isinstance(val, (tuple, list))
+        for item in vals:
+            if not (hasattr(item, "eqns") or hasattr(item, "jaxpr")):
+                continue
+            j = _inner(item)
+            if j is None:
+                continue
+            ops = None
+            if not listed and role == "call" \
+                    and len(eqn.invars) == len(j.invars):
+                ops = tuple(eqn.invars)
+            out.append(SubJaxpr(role, j, ops))
+    return out
+
+
+def mesh_axis_sizes(eqn) -> Dict[str, int]:
+    """``{axis_name: size}`` for a ``shard_map`` equation's mesh param
+    (empty for anything else, or when the mesh hides its shape). The one
+    place axis sizes are read off a program — telemetry's comm walker and
+    the SPMD verifier both resolve through here."""
+    sizes: Dict[str, int] = {}
+    mesh = eqn.params.get("mesh") if hasattr(eqn, "params") else None
+    shape = getattr(mesh, "shape", None)        # Mapping axis -> size
+    for name in getattr(mesh, "axis_names", ()) or ():
+        try:
+            sizes[name] = int(shape[name])
+        except Exception:
+            pass
+    return sizes
+
+
+@dataclasses.dataclass(frozen=True)
+class WalkContext:
+    """Structural context threaded by :func:`walk_jaxpr_ctx`.
+
+    path:
+        Role chain from the root (e.g. ``("shard_map", "while_body",
+        "scan_body")``) — the equation's control-flow address.
+    mesh_axes / axis_sizes:
+        Axis names (and sizes, where the mesh exposes them) of every
+        enclosing ``shard_map``. ``axis_sizes`` may be pre-seeded by the
+        caller for programs whose mesh is not discoverable.
+    loop_mult:
+        Product of enclosing static ``scan`` trip counts — the factor a
+        per-iteration cost is multiplied by per call of the entry.
+    in_while / in_cond:
+        Inside a ``while`` cond/body (trip count unknowable — any count
+        derived under it is a lower bound) / inside a ``cond`` branch
+        (both branches are walked — an upper bound).
+    """
+
+    path: Tuple[str, ...] = ()
+    mesh_axes: Tuple[str, ...] = ()
+    axis_sizes: Tuple[Tuple[str, int], ...] = ()
+    loop_mult: int = 1
+    in_while: bool = False
+    in_cond: bool = False
+
+    @property
+    def depth(self) -> int:
+        return len(self.path)
+
+    def axis_size(self, name: str) -> Optional[int]:
+        return dict(self.axis_sizes).get(name)
+
+    def child(self, eqn, role: str) -> "WalkContext":
+        """The context for one of ``eqn``'s sub-jaxprs in ``role``."""
+        mesh_axes, axis_sizes = self.mesh_axes, self.axis_sizes
+        loop_mult, in_while, in_cond = (self.loop_mult, self.in_while,
+                                        self.in_cond)
+        if role == "shard_map":
+            found = mesh_axis_sizes(eqn)
+            mesh_axes = mesh_axes + tuple(
+                n for n in (getattr(eqn.params.get("mesh"), "axis_names",
+                                    ()) or ()) if n not in mesh_axes)
+            known = dict(axis_sizes)
+            for n, s in found.items():
+                known.setdefault(n, s)
+            axis_sizes = tuple(sorted(known.items()))
+        elif role == "scan_body":
+            try:
+                loop_mult *= int(eqn.params.get("length", 1))
+            except Exception:
+                pass
+        elif role in ("while_cond", "while_body"):
+            in_while = True
+        elif role == "cond_branch":
+            in_cond = True
+        return WalkContext(path=self.path + (role,), mesh_axes=mesh_axes,
+                           axis_sizes=axis_sizes, loop_mult=loop_mult,
+                           in_while=in_while, in_cond=in_cond)
+
+
+def walk_jaxpr_ctx(jaxpr, visit: Callable[[Any, WalkContext], None],
+                   ctx: Optional[WalkContext] = None) -> None:
+    """Depth-first visit of every equation with a threaded
+    :class:`WalkContext`. ``visit(eqn, ctx)`` runs before descending; the
+    child context is derived per sub-jaxpr role (mesh axes/sizes from
+    ``shard_map``, loop multipliers from ``scan``, while/cond flags)."""
+    ctx = WalkContext() if ctx is None else ctx
+    for eqn in jaxpr.eqns:
+        visit(eqn, ctx)
+        for sub in subjaxprs_tagged(eqn):
+            walk_jaxpr_ctx(sub.jaxpr, visit, ctx.child(eqn, sub.role))

@@ -12,7 +12,7 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "== 1/15 package import =="
+echo "== 1/16 package import =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import apex_tpu
@@ -20,7 +20,7 @@ from apex_tpu import amp, optimizers, parallel, ops
 print('apex_tpu imports OK')
 "
 
-echo "== 2/15 native host runtime builds (g++ -O3 -shared) =="
+echo "== 2/16 native host runtime builds (g++ -O3 -shared) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 from apex_tpu import runtime
@@ -35,7 +35,7 @@ print('flatten/unflatten path OK')
 assert ok, 'host runtime failed to build — check g++ toolchain'
 "
 
-echo "== 3/15 graft entry compiles (single-device + 8-device dryrun) =="
+echo "== 3/16 graft entry compiles (single-device + 8-device dryrun) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import __graft_entry__ as ge
@@ -45,7 +45,7 @@ print('entry() compiles')
 ge.dryrun_multichip(8)
 "
 
-echo "== 4/15 package install (wheel build + clean --target install) =="
+echo "== 4/16 package install (wheel build + clean --target install) =="
 # The reference gates on Docker extension builds
 # (tests/docker_extension_builds/run.sh); the TPU analog: build the wheel
 # from pyproject.toml, install it into an empty --target dir, and import
@@ -88,14 +88,60 @@ jax.jit(step).lower(params, state).compile()
 print('installed-package train step compiles')
 ")
 
-echo "== 5/15 lint (apex_tpu.lint: trace safety / dtype policy / collectives) =="
+echo "== 5/16 lint (apex_tpu.lint: trace safety / dtype policy / collectives / SPMD) =="
 # static gate BEFORE the test tier: AST pass over the package + graft
-# entry, jaxpr pass over the registered entry points. --strict: warnings
-# fail too (every intentional exception carries an inline suppression
-# with its why — see docs/lint.md). Use --format=github under CI bots.
-python -m apex_tpu.lint apex_tpu/ __graft_entry__.py --strict
+# entry, jaxpr pass over the registered entry points, SPMD verifier
+# (APX2xx) over the same entries. --strict: warnings fail too (every
+# intentional exception carries an inline suppression with its why —
+# see docs/lint.md). Use --format=github under CI bots.
+python -m apex_tpu.lint apex_tpu/ __graft_entry__.py --strict --spmd
 
-echo "== 6/15 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
+echo "== 6/16 spmd verifier (builtin-entry sweep + committed deadlock fixture) =="
+# the whole-program SPMD gate, at the API layer: every registered entry
+# (ddp / zero / overlap / trainer-built / fused kernels / graft) must
+# verify clean, AND the analyzer must still catch the canonical
+# deadlock — the committed rank-gated-psum fixture is flagged APX201
+# while its corrected twin passes. Guards both directions: a silent
+# verifier (false negatives) and a noisy one (false positives on the
+# shipped entries) each fail this stage.
+python -c "
+import jax; jax.config.update('jax_platforms', 'cpu')
+import sys
+from apex_tpu.lint.spmd_checks import check_entry_spmd, run_entries_spmd
+
+findings = run_entries_spmd()
+assert findings == [], 'builtin entries must verify clean: %r' % findings
+print('builtin-entry sweep clean')
+
+sys.path.insert(0, 'tests/fixtures')
+import spmd_deadlock
+fn, args = spmd_deadlock.bad_entry()
+ids = {f.rule_id for f in check_entry_spmd(fn, args, mesh_axes=('data',))}
+assert 'APX201' in ids, 'deadlock fixture must be flagged, got %r' % ids
+fn, args = spmd_deadlock.good_entry()
+clean = check_entry_spmd(fn, args, mesh_axes=('data',))
+assert clean == [], 'corrected twin must pass: %r' % clean
+print('deadlock fixture flagged APX201; corrected twin clean')
+
+# the static donation re-derivation stays pinned to the runtime audit
+import jax.numpy as jnp
+from apex_tpu import trainer
+def step(state, batch):
+    p, o = state
+    loss, g = jax.value_and_grad(
+        lambda p: jnp.mean((batch @ p['w']) ** 2))(p)
+    new_p = jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, p, g)
+    return (new_p, o + 1.0), loss
+tr = trainer.build(step, ({'w': jnp.ones((64, 8))}, jnp.zeros((3,))),
+                   jnp.ones((4, 64)))
+rep, sd = tr.donation, tr.static_donation()
+assert (sd.declared, sd.aliased, len(sd.refused)) == \
+    (rep.declared, rep.aliased, len(rep.refused)), (sd, rep)
+print('static donation == runtime DonationReport '
+      f'({sd.aliased}/{sd.declared} aliased)')
+"
+
+echo "== 7/16 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
 # A 3-step instrumented GPT train step on the CPU mesh must produce a
 # parseable JSONL carrying step timing, amp loss-scale/overflow, comm
 # bytes and MFU, and the summarize CLI must render it (exit 0) — the
@@ -168,7 +214,7 @@ fi
 echo "health CLI gate OK (healthy=0, injected-NaN=nonzero)"
 rm -rf "$(dirname "$HLT_FILE")"
 
-echo "== 7/15 tune smoke (sweep dry-run + auto-policy tuned train) =="
+echo "== 8/16 tune smoke (sweep dry-run + auto-policy tuned train) =="
 # The autotuner must be drivable offline (sweep plan renders, exit 0) and
 # inline: a 3-step train whose kernels resolve their configs through
 # apex_tpu.tune under APEX_TPU_TUNE=auto. On this CPU backend measurement
@@ -245,7 +291,7 @@ print(f'tune smoke OK: {len(tuned)} tune/* series, '
 " "$TUNE_DIR/tune_run.jsonl" "$TUNE_DIR/cache"
 rm -rf "$TUNE_DIR"
 
-echo "== 8/15 resilience smoke (snapshot -> injected kill -> auto-resume) =="
+echo "== 9/16 resilience smoke (snapshot -> injected kill -> auto-resume) =="
 # Kill-and-resume end to end: a 6-step train snapshotting every 2 steps is
 # SIGKILLed by the fault injector at the top of step 4 (exit 137 — an
 # abrupt death, no final snapshot), then the SAME command with --resume
@@ -302,7 +348,7 @@ python -m apex_tpu.telemetry summarize "$RES_DIR/resume.jsonl" \
     || { echo "summarize did not report the resume point" >&2; exit 1; }
 rm -rf "$RES_DIR"
 
-echo "== 9/15 overlap smoke (staged backward + bf16 wire vs fp32 baseline) =="
+echo "== 10/16 overlap smoke (staged backward + bf16 wire vs fp32 baseline) =="
 # The overlap engine end to end on the 8-device CPU mesh: a 3-step fp32
 # baseline train and the same train under --overlap --reduce-dtype bf16
 # must (a) land within 1e-2 of each other's final loss (the compression
@@ -358,7 +404,7 @@ python -m apex_tpu.telemetry summarize "$OVL_DIR/bf16.jsonl" \
     || { echo "summarize did not render overlap efficiency" >&2; exit 1; }
 rm -rf "$OVL_DIR"
 
-echo "== 10/15 profile smoke (capture -> attribution report -> compare gate) =="
+echo "== 11/16 profile smoke (capture -> attribution report -> compare gate) =="
 # The attribution profiler end to end on the CPU backend: a 3-step train
 # with --profile must produce a capture logdir whose offline report
 # parses with nonzero compute time and carries the named
@@ -419,7 +465,7 @@ fi
 echo "compare gate OK (identical=0, doctored-slower=4)"
 rm -rf "$PROF_DIR"
 
-echo "== 11/15 trace smoke (host spans -> unified timeline -> merge/stragglers) =="
+echo "== 12/16 trace smoke (host spans -> unified timeline -> merge/stragglers) =="
 # The host-tracing layer end to end: a 3-step --trace train must emit
 # parseable span/* begin/end pairs, the unified host+device timeline
 # must export as valid Chrome-trace JSON with BOTH lanes populated,
@@ -492,7 +538,7 @@ grep -q "worst: p" "$TRC_DIR/merged.txt" \
 echo "trace smoke OK (spans + timeline + reconciliation + 2-process merge)"
 rm -rf "$TRC_DIR"
 
-echo "== 12/15 trainer smoke (compiled-step builder: pipelined dispatch + donation audit) =="
+echo "== 13/16 trainer smoke (compiled-step builder: pipelined dispatch + donation audit) =="
 # The compiled trainer end to end: a 3-step train_lm built through
 # apex_tpu.trainer with telemetry+trace on must (a) emit balanced
 # span/* begin/end pairs (the in-flight window's trainer/retire spans
@@ -537,7 +583,7 @@ grep -q "donation audit: .* 0 refused" "$TRN_DIR/out.txt" \
     || { echo "train_lm did not print the donation audit" >&2; exit 1; }
 rm -rf "$TRN_DIR"
 
-echo "== 13/15 fused-kernel regression (Pallas xentropy vs unfused + epilogue/mt scopes) =="
+echo "== 14/16 fused-kernel regression (Pallas xentropy vs unfused + epilogue/mt scopes) =="
 # The fused-kernel tier end to end (docs/kernels.md): the SAME 3-step GPT
 # train profiled unfused and fused (Pallas xentropy in the loss scope)
 # must (a) surface the apex_xentropy scope in the fused breakdown,
@@ -638,7 +684,7 @@ print('conv epilogue + mt flat: parity + capture scopes OK')
 echo "fused-kernel gate OK (scopes + parity + compare exit 0)"
 rm -rf "$KRN_DIR"
 
-echo "== 14/15 elastic smoke (2-process node_loss -> re-shard resume at world 1) =="
+echo "== 15/16 elastic smoke (2-process node_loss -> re-shard resume at world 1) =="
 # Elastic membership end to end (docs/resilience.md "Elastic
 # membership"): a 2-member ZeRO fleet under the multiproc --elastic
 # supervisor loses rank 1 to an injected node_loss SIGKILL at step 3;
@@ -700,7 +746,7 @@ python -m apex_tpu.resilience inspect "$ELA_DIR/snap-r0" --check 1 \
          exit 1; }
 rm -rf "$ELA_DIR"
 
-echo "== 15/15 pytest =="
+echo "== 16/16 pytest =="
 if [[ "${1:-}" == "--full" ]]; then
     # full suite + the complete L1 cross-product matrix (reference
     # tests/L1/cross_product{,_distributed}/run.sh); the convergence

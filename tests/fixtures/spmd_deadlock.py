@@ -1,0 +1,52 @@
+"""Committed APX201 deadlock fixture — the canonical SPMD
+collective-schedule divergence, pinned by both tests/test_lint_spmd.py
+and ci/gate.sh's spmd-verifier stage.
+
+``bad_entry`` gates a ``psum`` on ``axis_index``: rank 0 enters the
+collective, every other rank takes the identity branch, and on real
+multi-host hardware the fleet deadlocks waiting for rank 0's partners.
+``good_entry`` is the corrected twin: the collective runs unconditionally
+on every rank and only the *use* of its result is rank-gated (data flow,
+not control flow — ``jnp.where`` is schedule-safe).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+import apex_tpu._compat  # noqa: F401  (jax.shard_map on older jax)
+
+
+def _mesh():
+    return Mesh(np.asarray(jax.devices()[:1]), ("data",))
+
+
+def _smap(fn):
+    return jax.shard_map(fn, mesh=_mesh(), in_specs=(P("data"),),
+                         out_specs=P(), check_vma=False)
+
+
+def bad_entry():
+    """(fn, args) whose psum is reachable only on rank 0 — APX201."""
+
+    def rank_gated(x):
+        i = jax.lax.axis_index("data")
+        return jax.lax.cond(
+            i == 0,
+            lambda v: jax.lax.psum(v, "data"),
+            lambda v: v,
+            x)
+
+    return _smap(rank_gated), (jnp.ones((4, 4)),)
+
+
+def good_entry():
+    """Corrected twin: every rank executes the same collective schedule."""
+
+    def uniform_schedule(x):
+        total = jax.lax.psum(x, "data")
+        i = jax.lax.axis_index("data")
+        return jnp.where(i == 0, total, x)
+
+    return _smap(uniform_schedule), (jnp.ones((4, 4)),)

@@ -1,0 +1,191 @@
+"""utils.jaxpr_walk — direct coverage of the shared walker on deeply
+nested programs (scan-in-while-in-cond with shard_map inside): the
+PR 7 hlo.py nested-parens bug class, at the jaxpr layer. Previously this
+module was only exercised indirectly through telemetry/comm and lint.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+import apex_tpu  # noqa: F401  (compat shims)
+from apex_tpu.utils.jaxpr_walk import (WalkContext, mesh_axis_sizes,
+                                       subjaxprs, subjaxprs_tagged,
+                                       walk_jaxpr, walk_jaxpr_ctx)
+
+
+def _mesh():
+    return Mesh(np.asarray(jax.devices()[:1]), ("data",))
+
+
+def _nested_program():
+    """cond( while( scan( shard_map(psum) ) ) ) — every container the
+    walker knows, nested in one program."""
+    mesh = _mesh()
+
+    def shard_psum(v):
+        return jax.lax.psum(v, "data")
+
+    smapped = jax.shard_map(shard_psum, mesh=mesh, in_specs=(P(),),
+                            out_specs=P(), check_vma=False)
+
+    def scan_body(acc, _):
+        return acc + smapped(acc), acc
+
+    def w_body(c):
+        acc, i = c
+        acc, _ = jax.lax.scan(scan_body, acc, None, length=2)
+        return (acc, i + 1)
+
+    def w_cond(c):
+        return c[1] < 3
+
+    def true_branch(x):
+        return jax.lax.while_loop(w_cond, w_body, (x, 0))[0]
+
+    def prog(x):
+        return jax.lax.cond(jnp.sum(x) > 0, true_branch, lambda v: v, x)
+
+    return jax.make_jaxpr(prog)(jnp.ones((4,)))
+
+
+def test_walk_jaxpr_reaches_every_nesting_level():
+    closed = _nested_program()
+    prims = []
+    walk_jaxpr(closed.jaxpr, lambda e: prims.append(e.primitive.name))
+    # one psum, inside shard_map inside scan inside while inside cond
+    assert prims.count("psum") == 1
+    assert prims.count("cond") == 1
+    assert prims.count("while") == 1
+    assert prims.count("scan") == 1
+    assert prims.count("shard_map") == 1
+
+
+def test_subjaxprs_tagged_roles_and_operand_mapping():
+    closed = _nested_program()
+    cond_eqn = next(e for e in closed.jaxpr.eqns
+                    if e.primitive.name == "cond")
+    branches = subjaxprs_tagged(cond_eqn)
+    assert {s.role for s in branches} == {"cond_branch"}
+    for s in branches:
+        # predicate dropped: operands map 1:1 onto branch invars
+        assert s.operands is not None
+        assert len(s.operands) == len(s.jaxpr.invars)
+
+    # descend: the while lives in the true branch
+    true_j = branches[0].jaxpr if any(
+        e.primitive.name == "while" for e in branches[0].jaxpr.eqns
+    ) else branches[1].jaxpr
+    while_eqn = next(e for e in true_j.eqns
+                     if e.primitive.name == "while")
+    subs = {s.role: s for s in subjaxprs_tagged(while_eqn)}
+    assert set(subs) == {"while_cond", "while_body"}
+    # the precise const/carry split: both map 1:1
+    for s in subs.values():
+        assert s.operands is not None
+        assert len(s.operands) == len(s.jaxpr.invars)
+
+    scan_eqn = next(e for e in subs["while_body"].jaxpr.eqns
+                    if e.primitive.name == "scan")
+    (scan_sub,) = subjaxprs_tagged(scan_eqn)
+    assert scan_sub.role == "scan_body"
+    assert scan_sub.operands is not None
+
+    sm_eqn = next(e for e in scan_sub.jaxpr.eqns
+                  if e.primitive.name == "shard_map")
+    (sm_sub,) = subjaxprs_tagged(sm_eqn)
+    assert sm_sub.role == "shard_map"
+    assert sm_sub.operands is not None
+    assert mesh_axis_sizes(sm_eqn) == {"data": 1}
+
+
+def test_subjaxprs_permissive_tier_unchanged():
+    # the permissive tier must still discover every sub-jaxpr (its
+    # operand mapping is best-effort; discovery is the contract)
+    closed = _nested_program()
+    cond_eqn = next(e for e in closed.jaxpr.eqns
+                    if e.primitive.name == "cond")
+    assert len(subjaxprs(cond_eqn)) == 2       # both branches
+
+
+def test_walk_jaxpr_ctx_threads_context_to_the_psum():
+    closed = _nested_program()
+    seen = []
+
+    def visit(eqn, ctx):
+        if eqn.primitive.name == "psum":
+            seen.append(ctx)
+
+    walk_jaxpr_ctx(closed.jaxpr, visit)
+    assert len(seen) == 1
+    ctx = seen[0]
+    assert ctx.path == ("cond_branch", "while_body", "scan_body",
+                        "shard_map")
+    assert ctx.depth == 4
+    assert ctx.in_cond and ctx.in_while
+    assert ctx.loop_mult == 2                  # the scan's static length
+    assert ctx.mesh_axes == ("data",)
+    assert ctx.axis_size("data") == 1
+    assert ctx.axis_size("model") is None
+
+
+def test_walk_jaxpr_ctx_seeded_axis_sizes_take_precedence():
+    closed = _nested_program()
+    seen = []
+    walk_jaxpr_ctx(closed.jaxpr,
+                   lambda e, c: seen.append(c)
+                   if e.primitive.name == "psum" else None,
+                   WalkContext(axis_sizes=(("data", 8),)))
+    # caller-seeded size wins over the (1-device) mesh param
+    assert seen[0].axis_size("data") == 8
+
+
+def test_walk_jaxpr_ctx_root_context_defaults():
+    closed = _nested_program()
+    roots = []
+
+    def visit(eqn, ctx):
+        if ctx.depth == 0:
+            roots.append((eqn.primitive.name, ctx))
+
+    walk_jaxpr_ctx(closed.jaxpr, visit)
+    assert roots, "top-level equations must see the root context"
+    for _, ctx in roots:
+        assert ctx.path == () and ctx.loop_mult == 1
+        assert not ctx.in_while and not ctx.in_cond
+
+
+def test_comm_stats_on_nested_program_regression():
+    # telemetry's comm walker consumes the same program: the psum must
+    # be counted once per scan iteration (x2), flagged as a while lower
+    # bound, with the shard_map-resolved axis size
+    from apex_tpu.telemetry.comm import comm_stats
+    mesh = _mesh()
+
+    def shard_psum(v):
+        return jax.lax.psum(v, "data")
+
+    smapped = jax.shard_map(shard_psum, mesh=mesh, in_specs=(P(),),
+                            out_specs=P(), check_vma=False)
+
+    def scan_body(acc, _):
+        return acc + smapped(acc), acc
+
+    def w_body(c):
+        acc, i = c
+        acc, _ = jax.lax.scan(scan_body, acc, None, length=2)
+        return (acc, i + 1)
+
+    def prog(x):
+        return jax.lax.cond(
+            jnp.sum(x) > 0,
+            lambda v: jax.lax.while_loop(lambda c: c[1] < 3, w_body,
+                                         (v, 0))[0],
+            lambda v: v, x)
+
+    (rec,) = comm_stats(prog, jnp.ones((4,)))
+    assert (rec.axis, rec.primitive) == ("data", "psum")
+    assert rec.count == 2
+    assert rec.in_while
+    assert rec.bytes_wire is not None          # axis size resolved (1)

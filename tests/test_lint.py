@@ -103,6 +103,26 @@ AST_CASES = [
         def fwd(x, props):
             return x.astype(props.compute_dtype)
      """),
+    ("APX006", """
+        import jax
+        from apex_tpu import trainer
+
+        def step(state, batch):
+            out = state
+            jax.block_until_ready(out)
+            return out, 0.0
+
+        tr = trainer.build(step, None, None)
+     """, """
+        import jax
+        from apex_tpu import trainer
+
+        def step(state, batch):
+            return state, 0.0
+
+        tr = trainer.build(step, None, None)
+        tr.drain()
+     """),
     ("APX007", """
         import jax
 
@@ -184,6 +204,93 @@ def test_ast_global_statement_fires_apx003():
             return x
     """
     assert ast_ids(src) == ["APX003"]
+
+
+# ---------------------------------------------------------------------------
+# APX006: host sync inside a compiled-step definition
+# ---------------------------------------------------------------------------
+
+def test_apx006_block_until_ready_in_jit_fn_fires():
+    # block_until_ready isn't a concretization, so APX002 ignores it —
+    # APX006 owns the host-sync hazard, in jit-traced steps too
+    src = """
+        import jax
+
+        @jax.jit
+        def step(state, batch):
+            jax.block_until_ready(state)
+            return state
+    """
+    assert ast_ids(src) == ["APX006"]
+
+
+def test_apx006_item_in_built_step_fires():
+    src = """
+        from apex_tpu import trainer
+
+        def step(state, batch):
+            loss = (state * batch).sum()
+            print(loss.item())
+            return state, loss
+
+        tr = trainer.build(step, None, None)
+    """
+    assert ast_ids(src) == ["APX006"]
+
+
+def test_apx006_float_on_step_arg_in_built_step_fires():
+    src = """
+        from apex_tpu.trainer import build
+
+        def step(state, batch):
+            lr = float(batch)
+            return state * lr, lr
+
+        tr = build(step, None, None)
+    """
+    assert ast_ids(src) == ["APX006"]
+
+
+def test_apx006_item_in_jit_fn_stays_apx002():
+    # in a TRACED function the concretization is APX002's finding —
+    # exactly one rule per hazard
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.item()
+    """
+    assert ast_ids(src) == ["APX002"]
+
+
+def test_apx006_host_loop_sync_outside_step_is_silent():
+    src = """
+        import jax
+        from apex_tpu import trainer
+
+        def step(state, batch):
+            return state, 0.0
+
+        tr = trainer.build(step, None, None)
+        out = tr.step(None, None)
+        jax.block_until_ready(out)
+    """
+    assert ast_ids(src) == []
+
+
+def test_apx006_suppression(tmp_path):
+    bad = ("import jax\n"
+           "from apex_tpu import trainer\n"
+           "def step(state, batch):\n"
+           "    jax.block_until_ready(state)"
+           "  # apexlint: disable=APX006 -- test fixture\n"
+           "    return state, 0.0\n"
+           "tr = trainer.build(step, None, None)\n")
+    (tmp_path / "sup.py").write_text(bad)
+    active, suppressed = lint_run([str(tmp_path / "sup.py")], jaxpr=False)
+    assert not active
+    assert [f.rule_id for f in suppressed] == ["APX006"]
 
 
 # ---------------------------------------------------------------------------
@@ -616,5 +723,5 @@ def test_ddp_train_step_validates_mesh_axis():
 def test_repo_lint_clean():
     rc = lint_main([os.path.join(REPO, "apex_tpu"),
                     os.path.join(REPO, "__graft_entry__.py"),
-                    "--strict"])
+                    "--strict", "--spmd"])
     assert rc == 0

@@ -1,0 +1,768 @@
+"""apex_tpu.lint SPMD verifier (APX201-APX208) — per-rule firing
+fixtures, corrected twins, and per-line suppressions; the read-only
+(jaxpr-equality) contract; the static donation re-derivation pinned
+against the trainer's runtime DonationReport; baseline + SARIF output;
+and the trainer's check_spmd seam.
+
+The bad/suppressed fixtures live in THIS file on purpose: the verifier
+attributes findings to real source lines via jaxpr source_info, so the
+suppression tests exercise the same file-line mechanics users rely on.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu import trainer
+from apex_tpu.lint import (StaticDonation, builtin_entries,
+                           check_entry_spmd, static_donation)
+from apex_tpu.lint import main as lint_main
+from apex_tpu.lint.report import (Finding, apply_suppressions,
+                                  load_baseline, render_sarif,
+                                  split_baseline, write_baseline)
+from apex_tpu.lint.rules import RULES, SPMD_RULE_IDS
+from apex_tpu.lint.spmd_checks import (replication_threshold_bytes,
+                                       run_entries_spmd)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mesh(n=1):
+    return Mesh(np.asarray(jax.devices()[:n]), ("data",))
+
+
+def _smap(fn, n_in=1, mesh=None, sharded=True):
+    spec = P("data") if sharded else P()
+    return jax.shard_map(fn, mesh=mesh or _mesh(),
+                         in_specs=(spec,) * n_in, out_specs=P(),
+                         check_vma=False)
+
+
+def spmd_ids(fn, args, **kw):
+    return sorted({f.rule_id for f in check_entry_spmd(fn, args, **kw)})
+
+
+def run_suppressions(fn, args, **kw):
+    """check_entry_spmd + the real file/line suppression machinery."""
+    findings = check_entry_spmd(fn, args, **kw)
+    sources = {}
+    for f in findings:
+        if f.path not in sources and os.path.exists(f.path):
+            with open(f.path, encoding="utf-8") as fh:
+                sources[f.path] = fh.read().splitlines()
+    return apply_suppressions(findings, sources)
+
+
+def assert_suppressed(rule, fn, args, **kw):
+    active, suppressed = run_suppressions(fn, args, **kw)
+    assert [f.rule_id for f in active] == []
+    assert [f.rule_id for f in suppressed] == [rule]
+
+
+# ---------------------------------------------------------------------------
+# APX201: collective under rank-dependent control flow
+# ---------------------------------------------------------------------------
+
+def _bad201(x):
+    i = jax.lax.axis_index("data")
+    return jax.lax.cond(
+        i == 0, lambda v: jax.lax.psum(v, "data"), lambda v: v, x)
+
+
+def _good201(x):
+    total = jax.lax.psum(x, "data")
+    i = jax.lax.axis_index("data")
+    return jnp.where(i == 0, total, x)
+
+
+def _sup201(x):
+    i = jax.lax.axis_index("data")
+    return jax.lax.cond(
+        i == 0,
+        lambda v: jax.lax.psum(v, "data"),  # apexlint: disable=APX201 -- test fixture
+        lambda v: v, x)
+
+
+def test_apx201_rank_gated_cond_fires():
+    x = jnp.ones((4, 4))
+    assert spmd_ids(_smap(_bad201), (x,), mesh_axes=("data",)) == ["APX201"]
+    assert check_entry_spmd(_smap(_good201), (x,),
+                            mesh_axes=("data",)) == []
+
+
+def test_apx201_rank_gated_while_fires():
+    def bad(x):
+        i = jax.lax.axis_index("data")
+
+        def cond(c):
+            return c[1] < i
+
+        def body(c):
+            return (jax.lax.psum(c[0], "data"), c[1] + 1)
+        return jax.lax.while_loop(cond, body, (x, 0))[0]
+
+    def good(x):
+        def cond(c):
+            return c[1] < 3
+
+        def body(c):
+            return (jax.lax.psum(c[0], "data"), c[1] + 1)
+        return jax.lax.while_loop(cond, body, (x, 0))[0]
+
+    x = jnp.ones((4,))
+    assert spmd_ids(_smap(bad), (x,), mesh_axes=("data",)) == ["APX201"]
+    assert check_entry_spmd(_smap(good), (x,), mesh_axes=("data",)) == []
+
+
+def test_apx201_while_carry_becomes_rank_dependent():
+    # the predicate reads a carry that only becomes rank-tainted INSIDE
+    # the body: requires the fixpoint, not a single pass
+    def bad(x):
+        def cond(c):
+            return c[1] < 3
+
+        def body(c):
+            i = jax.lax.axis_index("data")
+            return (jax.lax.psum(c[0], "data"), c[1] + i)
+        return jax.lax.while_loop(cond, body, (x, 0))[0]
+
+    x = jnp.ones((4,))
+    assert spmd_ids(_smap(bad), (x,), mesh_axes=("data",)) == ["APX201"]
+
+
+def test_apx201_suppression():
+    assert_suppressed("APX201", _smap(_sup201), (jnp.ones((4, 4)),),
+                      mesh_axes=("data",))
+
+
+def test_apx201_taint_erasure_is_axis_scoped():
+    # a psum over "data" does NOT launder model-rank divergence: on a
+    # 2-D mesh, psum(axis_index("model"), "data") is still divergent
+    # along "model", and gating a collective on it still deadlocks
+    mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1),
+                ("data", "model"))
+
+    def bad(x):
+        i = jax.lax.axis_index("model")
+        s = jax.lax.psum(i, "data")          # erases "data" taint only
+        return jax.lax.cond(
+            s > 0, lambda v: jax.lax.psum(v, "data"), lambda v: v, x)
+
+    def good(x):
+        # reduced over BOTH axes: genuinely replica-uniform predicate
+        s = jax.lax.psum(jax.lax.axis_index("model"), ("data", "model"))
+        return jax.lax.cond(
+            s > 0, lambda v: jax.lax.psum(v, "data"), lambda v: v, x)
+
+    def smap(fn):
+        return jax.shard_map(fn, mesh=mesh, in_specs=(P(),),
+                             out_specs=P(), check_vma=False)
+
+    x = jnp.ones((4,))
+    assert spmd_ids(smap(bad), (x,),
+                    mesh_axes=("data", "model")) == ["APX201"]
+    assert check_entry_spmd(smap(good), (x,),
+                            mesh_axes=("data", "model")) == []
+
+
+def test_apx201_committed_deadlock_fixture():
+    # the fixture ci/gate.sh pins: bad flagged, corrected twin clean
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "spmd_deadlock",
+        os.path.join(REPO, "tests", "fixtures", "spmd_deadlock.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    fn, args = mod.bad_entry()
+    assert "APX201" in {f.rule_id for f in check_entry_spmd(
+        fn, args, mesh_axes=("data",))}
+    fn, args = mod.good_entry()
+    assert check_entry_spmd(fn, args, mesh_axes=("data",)) == []
+
+
+# ---------------------------------------------------------------------------
+# APX202: replica-divergent RNG
+# ---------------------------------------------------------------------------
+
+def _bad202(x):
+    seed = jnp.sum(x).astype(jnp.int32)      # sharded data -> divergent
+    key = jax.random.PRNGKey(seed)
+    return x * jax.random.uniform(key, x.shape)
+
+
+def _good202_uniform_seed(x):
+    seed = jnp.sum(jax.lax.psum(x, "data")).astype(jnp.int32)
+    key = jax.random.PRNGKey(seed)
+    return x * jax.random.uniform(key, x.shape)
+
+
+def _good202_folded(x):
+    seed = jnp.sum(x).astype(jnp.int32)
+    key = jax.random.fold_in(jax.random.PRNGKey(seed),
+                             jax.lax.axis_index("data"))
+    return x * jax.random.uniform(key, x.shape)
+
+
+def _sup202(x):
+    seed = jnp.sum(x).astype(jnp.int32)
+    key = jax.random.PRNGKey(seed)
+    return x * jax.random.uniform(key, x.shape)  # apexlint: disable=APX202 -- test fixture
+
+
+def test_apx202_sharded_seed_fires_and_twins_pass():
+    x = jnp.ones((4, 4))
+    assert spmd_ids(_smap(_bad202), (x,), mesh_axes=("data",)) == ["APX202"]
+    assert check_entry_spmd(_smap(_good202_uniform_seed), (x,),
+                            mesh_axes=("data",)) == []
+    assert check_entry_spmd(_smap(_good202_folded), (x,),
+                            mesh_axes=("data",)) == []
+
+
+def test_apx202_replicated_key_is_uniform():
+    # a key passed in REPLICATED is provably replica-uniform: silent
+    def f(x, key):
+        return x * jax.random.uniform(key, x.shape)
+
+    g = jax.shard_map(f, mesh=_mesh(), in_specs=(P("data"), P()),
+                      out_specs=P(), check_vma=False)
+    assert check_entry_spmd(
+        g, (jnp.ones((4, 4)), jax.random.PRNGKey(0)),
+        mesh_axes=("data",)) == []
+
+
+def test_apx202_outside_mesh_is_silent():
+    # replica semantics only exist inside a mesh region
+    def f(x):
+        key = jax.random.PRNGKey(jnp.sum(x).astype(jnp.int32))
+        return x * jax.random.uniform(key, x.shape)
+
+    assert check_entry_spmd(f, (jnp.ones((4, 4)),)) == []
+
+
+def test_apx202_suppression():
+    # NB distinct shape from _bad202: jax caches the traced _uniform
+    # sub-jaxpr per aval, and a cache hit would carry the FIRST call
+    # site's source lines into this fixture's finding
+    assert_suppressed("APX202", _smap(_sup202), (jnp.ones((4, 12)),),
+                      mesh_axes=("data",))
+
+
+# ---------------------------------------------------------------------------
+# APX203: use-after-donation
+# ---------------------------------------------------------------------------
+
+def _bad203(state, batch):
+    new = jax.tree_util.tree_map(lambda a: a + jnp.mean(batch), state)
+    aux = jnp.sum(state["w"] * 3.0)          # reads donated w after new
+    return new, aux
+
+
+def _good203(state, batch):
+    aux = jnp.sum(state["w"] * 3.0)          # old value read first
+    new = jax.tree_util.tree_map(lambda a: a + jnp.mean(batch), state)
+    return new, aux
+
+
+def _sup203(state, batch):
+    new = jax.tree_util.tree_map(lambda a: a + jnp.mean(batch), state)
+    aux = jnp.sum(state["w"] * 3.0)  # apexlint: disable=APX203 -- test fixture
+    return new, aux
+
+
+_S203 = {"w": jnp.ones((4,)), "v": jnp.zeros((2,))}
+_B203 = jnp.ones((3,))
+
+
+def test_apx203_read_after_aliased_output_fires():
+    assert spmd_ids(_bad203, (_S203, _B203),
+                    donate_argnums=(0,)) == ["APX203"]
+    assert check_entry_spmd(_good203, (_S203, _B203),
+                            donate_argnums=(0,)) == []
+    # donation not declared: rule disarmed on the same program
+    assert check_entry_spmd(_bad203, (_S203, _B203)) == []
+
+
+def test_apx203_suppression():
+    assert_suppressed("APX203", _sup203, (_S203, _B203),
+                      donate_argnums=(0,))
+
+
+# ---------------------------------------------------------------------------
+# APX204: implicit full replication
+# ---------------------------------------------------------------------------
+
+def _bad204(x):
+    g = jax.lax.all_gather(x, "data")
+    return jnp.sum(g)
+
+
+def _good204(x):
+    return jnp.sum(x)                        # stays sharded
+
+
+def _sup204(x):
+    g = jax.lax.all_gather(x, "data")  # apexlint: disable=APX204 -- test fixture
+    return jnp.sum(g)
+
+
+def test_apx204_large_all_gather_fires_small_passes():
+    x = jnp.ones((8, 128))                   # gathered: 4 KiB
+    assert spmd_ids(_smap(_bad204), (x,), mesh_axes=("data",),
+                    threshold_bytes=2048) == ["APX204"]
+    assert check_entry_spmd(_smap(_good204), (x,), mesh_axes=("data",),
+                            threshold_bytes=2048) == []
+    # under the threshold: the gather is small enough to be deliberate
+    assert check_entry_spmd(_smap(_bad204), (x,), mesh_axes=("data",),
+                            threshold_bytes=1 << 20) == []
+
+
+def test_apx204_default_threshold_is_env_overridable(monkeypatch):
+    assert replication_threshold_bytes() == 1 << 20
+    monkeypatch.setenv("APEX_TPU_LINT_REPLICATION_BYTES", "4096")
+    assert replication_threshold_bytes() == 4096
+    monkeypatch.setenv("APEX_TPU_LINT_REPLICATION_BYTES", "bogus")
+    assert replication_threshold_bytes() == 1 << 20
+
+
+def test_apx204_default_threshold_fires_on_megabyte_gather():
+    # ShapeDtypeStruct args: the verifier traces, never executes
+    x = jax.ShapeDtypeStruct((2048, 128), jnp.float32)   # 1 MiB
+    assert spmd_ids(_smap(_bad204), (x,),
+                    mesh_axes=("data",)) == ["APX204"]
+
+
+def test_apx204_suppression():
+    assert_suppressed("APX204", _smap(_sup204), (jnp.ones((8, 128)),),
+                      mesh_axes=("data",), threshold_bytes=2048)
+
+
+# ---------------------------------------------------------------------------
+# APX205: reshard thrash
+# ---------------------------------------------------------------------------
+
+def _bad205(x):
+    g = jax.lax.all_gather(x, "data")
+    return jax.lax.psum(g, "data")
+
+
+def _good205(x):
+    return jax.lax.psum(x, "data")           # reduce first, no gather
+
+
+def _sup205(x):
+    g = jax.lax.all_gather(x, "data")  # apexlint: disable=APX205 -- test fixture
+    return jax.lax.psum(g, "data")
+
+
+def test_apx205_gather_feeding_only_reduce_fires():
+    x = jnp.ones((8, 8))
+    assert spmd_ids(_smap(_bad205), (x,), mesh_axes=("data",)) == ["APX205"]
+    assert check_entry_spmd(_smap(_good205), (x,),
+                            mesh_axes=("data",)) == []
+
+
+def test_apx205_gather_with_real_consumer_is_silent():
+    def f(x):
+        g = jax.lax.all_gather(x, "data")
+        return jax.lax.psum(g, "data") + jnp.sum(g)   # g used for real
+
+    assert check_entry_spmd(_smap(f), (jnp.ones((8, 8)),),
+                            mesh_axes=("data",)) == []
+
+
+def test_apx205_suppression():
+    assert_suppressed("APX205", _smap(_sup205), (jnp.ones((8, 8)),),
+                      mesh_axes=("data",))
+
+
+# ---------------------------------------------------------------------------
+# APX206: collective bypassing the overlap bucket seam
+# ---------------------------------------------------------------------------
+
+def _seam_loss(p, x):
+    from apex_tpu.parallel import overlap
+    p = overlap.sync_in_backward(p, "data")
+    return jnp.mean((x @ p["w"]) ** 2)
+
+
+def _bad206(p, x):
+    g = jax.grad(_seam_loss)(p, x)
+    return jax.lax.psum(g["w"], "data")      # gradient psum off the seam
+
+
+def _good206(p, x):
+    return jax.grad(_seam_loss)(p, x)["w"]   # every collective staged
+
+
+def _sup206(p, x):
+    g = jax.grad(_seam_loss)(p, x)
+    return jax.lax.psum(g["w"], "data")  # apexlint: disable=APX206 -- test fixture
+
+
+_P206 = {"w": jnp.ones((64, 64))}
+_X206 = jnp.ones((4, 64))
+
+
+def _smap206(fn):
+    return jax.shard_map(fn, mesh=_mesh(), in_specs=(P(), P("data")),
+                         out_specs=P(), check_vma=False)
+
+
+def test_apx206_raw_psum_next_to_seam_fires():
+    assert spmd_ids(_smap206(_bad206), (_P206, _X206),
+                    mesh_axes=("data",)) == ["APX206"]
+    assert check_entry_spmd(_smap206(_good206), (_P206, _X206),
+                            mesh_axes=("data",)) == []
+
+
+def test_apx206_no_seam_no_finding():
+    # without the staged seam present, a raw gradient psum is the plain
+    # DDP pattern — not a bypass
+    def f(p, x):
+        def loss(p):
+            return jnp.mean((x @ p["w"]) ** 2)
+        return jax.lax.psum(jax.grad(loss)(p)["w"], "data")
+
+    assert check_entry_spmd(_smap206(f), (_P206, _X206),
+                            mesh_axes=("data",)) == []
+
+
+def test_apx206_scalar_psum_next_to_seam_is_exempt():
+    def f(p, x):
+        g = jax.grad(_seam_loss)(p, x)
+        return jax.lax.psum(jnp.sum(g["w"] ** 2), "data")   # norm scalar
+
+    assert check_entry_spmd(_smap206(f), (_P206, _X206),
+                            mesh_axes=("data",)) == []
+
+
+def test_apx206_suppression():
+    assert_suppressed("APX206", _smap206(_sup206), (_P206, _X206),
+                      mesh_axes=("data",))
+
+
+# ---------------------------------------------------------------------------
+# APX207: host callback re-entering the graph
+# ---------------------------------------------------------------------------
+
+def _bad207(x):
+    y = jax.pure_callback(
+        lambda a: np.asarray(a) * 2,
+        jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+    return y + x
+
+
+def _good207(x):
+    jax.debug.callback(lambda a: None, x)    # effect-only: fine
+    return x * 2
+
+
+def _sup207(x):
+    y = jax.pure_callback(  # apexlint: disable=APX207 -- test fixture
+        lambda a: np.asarray(a) * 2,
+        jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+    return y + x
+
+
+def test_apx207_callback_result_reenters_fires():
+    x = jnp.ones((4,))
+    assert spmd_ids(_bad207, (x,)) == ["APX207"]
+    assert check_entry_spmd(_good207, (x,)) == []
+
+
+def test_apx207_suppression():
+    assert_suppressed("APX207", _sup207, (jnp.ones((4,)),))
+
+
+# ---------------------------------------------------------------------------
+# APX208: scan-carry widening
+# ---------------------------------------------------------------------------
+
+def _bad208(x):
+    def body(c, _):
+        y = (c.astype(jnp.bfloat16) * x).astype(jnp.float32)
+        return y, jnp.float32(0)
+    out, _ = jax.lax.scan(body, jnp.zeros(x.shape, jnp.float32), None, length=4)
+    return out
+
+
+def _good208(x):
+    def body(c, _):
+        return c * x, jnp.float32(0)
+    out, _ = jax.lax.scan(body, jnp.zeros(x.shape, jnp.bfloat16),
+                          None, length=4)
+    return out
+
+
+def _accum208(x):
+    # a TRUE fp32 accumulator of bf16 addends: intended, must not fire
+    def body(c, _):
+        return c + jnp.sum(x.astype(jnp.float32)), jnp.float32(0)
+    out, _ = jax.lax.scan(body, jnp.float32(0), None, length=4)
+    return out
+
+
+def _sup208(x):
+    def body(c, _):
+        y = (c.astype(jnp.bfloat16) * x).astype(jnp.float32)
+        return y, jnp.float32(0)
+    out, _ = jax.lax.scan(body, jnp.zeros(x.shape, jnp.float32), None, length=4)  # apexlint: disable=APX208 -- test fixture
+    return out
+
+
+def test_apx208_widened_carry_fires_twins_pass():
+    x = jnp.ones((8, 8), jnp.bfloat16)
+    assert spmd_ids(_bad208, (x,)) == ["APX208"]
+    assert check_entry_spmd(_good208, (x,)) == []
+    assert check_entry_spmd(_accum208, (x,)) == []
+
+
+def test_apx208_suppression():
+    assert_suppressed("APX208", _sup208, (jnp.ones((8, 8), jnp.bfloat16),))
+
+
+# ---------------------------------------------------------------------------
+# read-only contract: analysis leaves the traced program bit-identical
+# ---------------------------------------------------------------------------
+
+def test_spmd_analysis_is_read_only_on_builtin_entries():
+    specs = {s.name: s for s in builtin_entries()}
+    for name in ("ddp_syncbn_grads", "overlap_staged_grads",
+                 "trainer_per_step"):
+        spec = specs[name]
+        fn, args = spec.make()
+        before = str(jax.make_jaxpr(fn)(*args))
+        check_entry_spmd(fn, args, name=name, mesh_axes=spec.mesh_axes,
+                         donate_argnums=spec.donate_argnums)
+        after = str(jax.make_jaxpr(fn)(*args))
+        assert before == after, f"entry {name} was altered by analysis"
+
+
+def test_spmd_analysis_is_read_only_on_fixtures():
+    x = jnp.ones((4, 4))
+    fn = _smap(_bad201)
+    before = str(jax.make_jaxpr(fn)(x))
+    check_entry_spmd(fn, (x,), mesh_axes=("data",))
+    assert str(jax.make_jaxpr(fn)(x)) == before
+
+
+# ---------------------------------------------------------------------------
+# static donation: re-derives the trainer's runtime DonationReport
+# ---------------------------------------------------------------------------
+
+def _tstep(state, batch):
+    params, opt = state
+
+    def loss_fn(p):
+        return jnp.mean((batch @ p["w"]) ** 2)
+    loss, g = jax.value_and_grad(loss_fn)(params)
+    new_p = jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, params, g)
+    return (new_p, opt + 1.0), loss
+
+
+def _tstate():
+    return ({"w": jnp.ones((64, 8))}, jnp.zeros((3,)))
+
+
+def test_static_donation_matches_runtime_all_aliased():
+    tr = trainer.build(_tstep, _tstate(), jnp.ones((4, 64)))
+    rep, sd = tr.donation, tr.static_donation()
+    assert isinstance(sd, StaticDonation)
+    assert (sd.declared, sd.aliased, sd.dropped) == (
+        rep.declared, rep.aliased, rep.dropped)
+    assert sd.refused == () and len(rep.refused) == 0
+    assert sd.ok and sd.to_json()["ok"] is True
+
+
+def test_static_donation_matches_runtime_refusal():
+    import warnings
+
+    def bad(state, batch):
+        return {"w": (state["w"] + jnp.mean(batch)).astype(jnp.bfloat16),
+                "v": state["v"] * 2.0}, jnp.mean(batch)
+
+    s = {"w": jnp.ones((4,)), "v": jnp.zeros((2,))}
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        tr = trainer.build(bad, s, jnp.ones((3,)))
+    rep, sd = tr.donation, tr.static_donation()
+    assert (sd.declared, sd.aliased, len(sd.refused), sd.dropped) == (
+        rep.declared, rep.aliased, len(rep.refused), rep.dropped)
+    assert not sd.ok and "float32[4]" in sd.refused[0]
+
+
+def test_static_donation_matches_runtime_dead_code_drop():
+    def dropper(state, batch):
+        return {"w": state["w"] + jnp.mean(batch),
+                "unused": jnp.zeros((7,))}, jnp.mean(batch)
+
+    s = {"w": jnp.ones((4,)), "unused": jnp.zeros((7,))}
+    tr = trainer.build(dropper, s, jnp.ones((3,)))
+    rep, sd = tr.donation, tr.static_donation()
+    assert (sd.declared, sd.aliased, sd.dropped) == (
+        rep.declared, rep.aliased, rep.dropped)
+    assert sd.dropped == 1 and sd.refused == ()
+
+
+def test_static_donation_on_mesh_wrapped_bench_shape():
+    # the bench form: shard_map-wrapped step built through the trainer —
+    # the analyzer must read ordering through the wrapper eqn
+    tr = trainer.build(_tstep, _tstate(), jnp.ones((4, 64)),
+                       mesh=_mesh(), batch_spec=P("data"))
+    rep, sd = tr.donation, tr.static_donation()
+    assert (sd.declared, sd.aliased, len(sd.refused)) == (
+        rep.declared, rep.aliased, len(rep.refused))
+    assert sd.declared == sd.aliased == 2
+
+
+def test_trainer_check_spmd_seam():
+    tr = trainer.build(_tstep, _tstate(), jnp.ones((4, 64)),
+                       mesh=_mesh(), batch_spec=P("data"))
+    assert tr.check_spmd() == []
+    assert tr.donate_argnums == (0,)
+    assert tr.mesh_axes == ("data",)
+
+    def late_read(state, batch):
+        new = jax.tree_util.tree_map(lambda a: a + jnp.mean(batch), state)
+        return new, jnp.sum(state["w"])      # use after donation
+
+    tr2 = trainer.build(late_read, {"w": jnp.ones((4,))}, jnp.ones((3,)),
+                        config=trainer.TrainerConfig(audit_donation=False))
+    assert [f.rule_id for f in tr2.check_spmd()] == ["APX203"]
+
+
+def test_trainer_constructed_directly_raises_on_seam():
+    tr = trainer.Trainer(fn=lambda s, b: (s, 0.0),
+                         traced_fn=lambda s, b: (s, 0.0),
+                         config=trainer.TrainerConfig(), donation=None)
+    with pytest.raises(ValueError, match="example_args"):
+        tr.check_spmd()
+    with pytest.raises(ValueError, match="example_args"):
+        tr.static_donation()
+
+
+# ---------------------------------------------------------------------------
+# rules / catalog / entry sweep
+# ---------------------------------------------------------------------------
+
+def test_spmd_rule_ids_registered():
+    assert SPMD_RULE_IDS == tuple(f"APX20{i}" for i in range(1, 9))
+    for rid in SPMD_RULE_IDS:
+        assert RULES[rid].severity in ("error", "warning")
+    assert RULES["APX201"].severity == "error"
+    assert RULES["APX202"].severity == "error"
+
+
+def test_cli_list_rules_includes_spmd(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in SPMD_RULE_IDS:
+        assert rid in out
+
+
+@pytest.mark.apexlint
+def test_builtin_entry_sweep_spmd_clean():
+    assert run_entries_spmd() == []
+
+
+# ---------------------------------------------------------------------------
+# SARIF output
+# ---------------------------------------------------------------------------
+
+def test_sarif_document_shape():
+    import json
+    err = Finding("APX201", "a.py", 3, "deadlock")
+    warn = Finding("APX204", "b.py", 0, "replicated")
+    sup = Finding("APX205", "a.py", 9, "thrash")
+    doc = json.loads(render_sarif([err, warn], [sup]))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "apexlint"
+    rule_ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert rule_ids == ["APX201", "APX204", "APX205"]
+    results = run["results"]
+    assert [r["ruleId"] for r in results] == ["APX201", "APX204", "APX205"]
+    assert results[0]["level"] == "error"
+    assert results[0]["locations"][0]["physicalLocation"]["region"][
+        "startLine"] == 3
+    assert results[1]["locations"][0]["physicalLocation"]["region"][
+        "startLine"] == 1          # line 0 clamps to 1 (SARIF minimum)
+    assert results[2]["suppressions"] == [{"kind": "inSource"}]
+    assert "suppressions" not in results[0]
+
+
+def test_sarif_carries_baselined_as_external_suppressions():
+    import json
+    new = Finding("APX201", "a.py", 3, "deadlock")
+    known = Finding("APX204", "b.py", 5, "replicated")
+    doc = json.loads(render_sarif([new], [], [known]))
+    results = doc["runs"][0]["results"]
+    # baselined findings are carried (code scanning would otherwise
+    # auto-close and later flap their alerts), marked external
+    assert [r["ruleId"] for r in results] == ["APX201", "APX204"]
+    assert results[1]["suppressions"] == [{"kind": "external"}]
+    assert "APX204" in [r["id"] for r in
+                        doc["runs"][0]["tool"]["driver"]["rules"]]
+
+
+def test_cli_sarif_format(tmp_path, capsys):
+    import json
+    bad = "import jax.numpy as jnp\ny = jnp.zeros((4,), jnp.bfloat16)\n"
+    (tmp_path / "bad.py").write_text(bad)
+    rc = lint_main([str(tmp_path / "bad.py"), "--no-jaxpr",
+                    "--format=sarif"])
+    assert rc == 0                  # APX005 is a warning; not strict
+    doc = json.loads(capsys.readouterr().out)
+    assert [r["ruleId"] for r in doc["runs"][0]["results"]] == ["APX005"]
+
+
+# ---------------------------------------------------------------------------
+# baselines
+# ---------------------------------------------------------------------------
+
+def test_baseline_roundtrip_and_count_semantics(tmp_path):
+    f1 = Finding("APX005", "m.py", 2, "msg")
+    f2 = Finding("APX005", "m.py", 9, "msg")     # same key, second hit
+    f3 = Finding("APX007", "m.py", 4, "other")
+    path = str(tmp_path / "base.json")
+    write_baseline(path, [f1, f3])
+    known = load_baseline(path)
+    new, old = split_baseline([f1, f2, f3], known)
+    # one APX005 instance is known; the SECOND identical one is NEW
+    assert [f.line for f in old] == [2, 4]
+    assert [f.line for f in new] == [9]
+
+
+def test_baseline_version_guard(tmp_path):
+    p = tmp_path / "base.json"
+    p.write_text('{"version": 99, "findings": []}')
+    with pytest.raises(ValueError, match="version"):
+        load_baseline(str(p))
+
+
+def test_cli_baseline_gate(tmp_path):
+    bad = "import jax.numpy as jnp\ny = jnp.zeros((4,), jnp.bfloat16)\n"
+    src = tmp_path / "bad.py"
+    src.write_text(bad)
+    base = str(tmp_path / "base.json")
+
+    # no baseline file yet: usage error, with the remedy named
+    assert lint_main([str(src), "--no-jaxpr", "--strict",
+                      "--baseline", base]) == 2
+    # record the current findings
+    assert lint_main([str(src), "--no-jaxpr", "--strict",
+                      "--baseline", base, "--update-baseline"]) == 0
+    # known finding: gate passes
+    assert lint_main([str(src), "--no-jaxpr", "--strict",
+                      "--baseline", base]) == 0
+    # a NEW finding still fails the gate
+    src.write_text(bad + "z = jnp.ones((2,), jnp.float16)\n")
+    assert lint_main([str(src), "--no-jaxpr", "--strict",
+                      "--baseline", base]) == 1
+    # --update-baseline without --baseline: usage error
+    assert lint_main([str(src), "--no-jaxpr",
+                      "--update-baseline"]) == 2
