@@ -67,6 +67,31 @@ def data_parallel_mesh(name: str = "data") -> Mesh:
     return make_mesh(axis_names=(name,))
 
 
+def named_mesh(axes: Sequence[Tuple[str, int]], devices=None) -> Mesh:
+    """Build a mesh from ordered ``(name, size)`` pairs over the first
+    ``prod(sizes)`` devices — the :mod:`apex_tpu.plan` layout-to-mesh
+    hop (a planner candidate is exactly such an ordered axis list).
+    Axes of size 1 are dropped (a 1-extent axis adds nothing but spec
+    noise); an empty/all-1 list degrades to a 1-axis mesh of the first
+    pair's name so collectives still have an axis to bind."""
+    axes = [(str(n), int(s)) for n, s in axes]
+    if not axes:
+        raise ValueError("named_mesh needs at least one (name, size) pair")
+    kept = [(n, s) for n, s in axes if s > 1] or [axes[0]]
+    names = tuple(n for n, _ in kept)
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate mesh axis names: {names}")
+    sizes = [s for _, s in kept]
+    total = int(np.prod(sizes))
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if total > len(devices):
+        raise ValueError(
+            f"mesh {dict(kept)} needs {total} devices, have "
+            f"{len(devices)}")
+    return make_mesh(axis_sizes=sizes, axis_names=names,
+                     devices=devices[:total])
+
+
 def reform_mesh(world: Optional[int] = None,
                 axis_names: Sequence[str] = ("data",),
                 devices=None) -> Mesh:

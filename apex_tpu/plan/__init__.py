@@ -1,0 +1,52 @@
+"""``apex_tpu.plan`` — cost-model-driven automatic parallelism planner
+(ROADMAP item 2; AMP-style strategy search, arXiv 2210.07297; automatic
+cross-replica weight-update sharding, arXiv 2004.13336).
+
+The multichip dryrun proves the layout FAMILIES work; this package
+picks one — plus microbatch, ZeRO stage, bucket capacities, and
+reduce_dtype — and emits a ready-to-train package::
+
+    from apex_tpu import plan
+    p = plan.auto(plan.GPTAdapter(batch=16, seq=256))
+    tr = p.build_trainer()                  # PR 9 compiled trainer
+    state = tr.run(p.init_state(), p.batch_fn, steps=100)
+
+Three tiers (see the module docs):
+
+  * :mod:`~apex_tpu.plan.cost` — the analytic cost model: wire bytes
+    (telemetry.comm's jaxpr walker for traced candidates, matching
+    closed forms for the full space), compute/memory floors (pyprof
+    roofline peaks + XLA cost analysis), HBM footprint, PR 6 overlap
+    credit.
+  * :mod:`~apex_tpu.plan.search` — enumerate/prune/rank over (dp, tp,
+    pp, seq, zero, microbatch, buckets, reduce_dtype); top-k validated
+    by tracing (and on-device measurement on TPU — policy-gated,
+    hermetic off-TPU).
+  * :mod:`~apex_tpu.plan.emit` — TrainerConfig + shard_map layout +
+    tune cache entries (``"planner"`` provenance), every emission
+    verified by ``lint.spmd`` (APX201-208) first.
+
+CLI: ``python -m apex_tpu.plan auto|explain`` (docs/plan.md).
+"""
+
+from apex_tpu.plan.adapters import (ADAPTERS, Built, GPTAdapter,
+                                    ResNetAdapter, get_adapter)
+from apex_tpu.plan.cost import (CostBreakdown, WireItem, analytic_wire,
+                                estimate, hbm_footprint, traced_wire)
+from apex_tpu.plan.describe import ModelDesc
+from apex_tpu.plan.emit import Plan, PlanRejected, emit, format_table, \
+    verify_built
+from apex_tpu.plan.layout import Layout, parse_layout_id
+from apex_tpu.plan.search import (Constraints, PlanError, Verdict, auto,
+                                  enumerate_candidates, estimate_layout,
+                                  prune, rank, replanner)
+
+__all__ = [
+    "auto", "estimate", "estimate_layout", "enumerate_candidates",
+    "prune", "rank", "replanner", "analytic_wire", "traced_wire",
+    "hbm_footprint", "emit", "verify_built", "format_table",
+    "Layout", "parse_layout_id", "Constraints", "Verdict", "Plan",
+    "PlanError", "PlanRejected", "CostBreakdown", "WireItem",
+    "ModelDesc", "Built", "GPTAdapter", "ResNetAdapter", "get_adapter",
+    "ADAPTERS",
+]

@@ -1,0 +1,5 @@
+import sys
+
+from apex_tpu.plan.cli import main
+
+sys.exit(main())

@@ -1,0 +1,400 @@
+"""The analytic cost model: price one layout in seconds and bytes.
+
+Three ingredient families, per the ROADMAP item-2 recipe:
+
+  * **Wire bytes** — the per-step collective bill. The exact number
+    comes from :func:`apex_tpu.telemetry.comm.comm_stats` run over the
+    candidate's traced program (:func:`traced_wire` — axis-size- and
+    ring-algorithm-aware, grouped-collective-correct); the closed-form
+    :func:`analytic_wire` mirrors the same ring multipliers per layout
+    family so the full candidate space can be ranked without tracing
+    hundreds of programs. ``plan.auto`` traces the survivors and
+    reports the analytic-vs-traced drift honestly
+    (``CostBreakdown.wire_drift_pct``).
+  * **Compute/memory floors** — the model's whole-step FLOP/byte totals
+    (XLA cost analysis via the adapter's :meth:`describe`) divided by
+    the layout's parallel degree, against the
+    :func:`apex_tpu.pyprof.roofline.device_peaks` ceilings. The step
+    can never beat ``max(compute_floor, memory_floor)``.
+  * **HBM footprint** — params + optimizer state under the ZeRO stage +
+    the activation estimate; the pruner's feasibility ceiling.
+
+Overlap credit follows the PR 6 staged-backward model: the dp-axis
+gradient collective issues inside the backward graph, so up to
+``OVERLAP_EFFICIENCY`` of it hides behind the backward's compute time
+(the live bench measured ~0.8; ``ddp/overlap_efficiency`` telemetry).
+Pipeline layouts pay the GPipe bubble ``(pp-1)/microbatch``.
+
+All constants that are NOT device-measured (ICI bandwidth, the
+per-collective latency) are env-overridable and recorded in the
+breakdown — the bench's ``plan`` key tracks modeled-vs-measured error
+across rounds so cost-model drift is visible, never silent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional
+
+from apex_tpu.plan.describe import ModelDesc
+from apex_tpu.plan.layout import Layout
+
+__all__ = ["CostBreakdown", "WireItem", "estimate", "analytic_wire",
+           "traced_wire", "hbm_footprint", "OVERLAP_EFFICIENCY",
+           "ici_bytes_per_s", "collective_latency_s"]
+
+# Fraction of a staged dp-collective's time that hides behind backward
+# compute (PR 6 overlap engine; pyprof measured 79.6% on the live GPT
+# profile, ddp/overlap_efficiency). Env-overridable for new fabrics.
+OVERLAP_EFFICIENCY = 0.8
+
+# Backward's share of total step compute (fwd 1x, bwd 2x of the fwd
+# cost for matmul-dominated models) — the window a staged collective
+# can hide in.
+BACKWARD_FRACTION = 2.0 / 3.0
+
+# Interconnect bandwidth per device (bytes/s) the wire bill divides by.
+# ~one v4 ICI link direction; like the roofline CPU constants this is a
+# RELATIVE ranking signal on CPU meshes, not a hardware claim.
+ICI_BW_DEFAULT = 9e10
+
+# Fixed per-collective cost (dispatch + link latency) — prices bucket
+#-count trade-offs so a 10k-bucket schedule ranks worse than 8 buckets.
+COLLECTIVE_LATENCY_S = 8e-6
+
+
+def ici_bytes_per_s() -> float:
+    env = os.environ.get("APEX_TPU_PLAN_ICI_BW")
+    return float(env) if env else ICI_BW_DEFAULT
+
+
+def collective_latency_s() -> float:
+    env = os.environ.get("APEX_TPU_PLAN_COLL_LAT")
+    return float(env) if env else COLLECTIVE_LATENCY_S
+
+
+def _ring(prim: str, n: int) -> float:
+    """The telemetry.comm wire multipliers — ONE definition, imported."""
+    from apex_tpu.telemetry.comm import _WIRE
+    return _WIRE[prim](n)
+
+
+@dataclasses.dataclass
+class WireItem:
+    """One (axis, primitive) line of the communication bill — the same
+    shape as :class:`~apex_tpu.telemetry.comm.CommRecord`, plus whether
+    the overlap engine can hide it (dp grad sync) or it sits on the
+    critical path (per-layer tp/seq collectives)."""
+
+    axis: str
+    primitive: str
+    bytes_in: float
+    bytes_wire: float
+    count: float = 1.0
+    hideable: bool = False
+
+    def to_meta(self) -> Dict[str, Any]:
+        return {"axis": self.axis, "primitive": self.primitive,
+                "bytes_in": round(self.bytes_in),
+                "bytes_wire": round(self.bytes_wire),
+                "count": round(self.count, 2),
+                "hideable": self.hideable}
+
+
+@dataclasses.dataclass
+class CostBreakdown:
+    """Every term of one candidate's modeled step, auditable by the CLI
+    ``explain`` command. Seconds unless suffixed otherwise."""
+
+    layout_id: str
+    compute_s: float
+    memory_s: float
+    roofline_s: float            # max(compute, memory)
+    wire: List[WireItem]
+    wire_bytes: float            # sum of bytes_wire
+    comm_s: float                # wire over the interconnect + latency
+    hidden_s: float              # overlap credit actually granted
+    exposed_comm_s: float
+    bubble_s: float              # GPipe bubble overhead
+    latency_s: float             # per-collective fixed costs
+    step_s: float                # the ranking total
+    hbm: Dict[str, float]        # params/grads/opt/act/total/capacity
+    wire_source: str = "analytic"
+    wire_drift_pct: Optional[float] = None
+    notes: List[str] = dataclasses.field(default_factory=list)
+
+    def to_meta(self) -> Dict[str, Any]:
+        return {
+            "layout": self.layout_id, "step_s": self.step_s,
+            "compute_s": self.compute_s, "memory_s": self.memory_s,
+            "roofline_s": self.roofline_s,
+            "wire_bytes": round(self.wire_bytes),
+            "comm_s": self.comm_s, "hidden_s": self.hidden_s,
+            "exposed_comm_s": self.exposed_comm_s,
+            "bubble_s": self.bubble_s, "latency_s": self.latency_s,
+            "hbm_total": round(self.hbm.get("total", 0.0)),
+            "wire_source": self.wire_source,
+            "wire_drift_pct": self.wire_drift_pct,
+        }
+
+    def explain(self) -> str:
+        """Per-term audit table (the CLI ``explain`` body)."""
+        ms = 1e3
+        mb = 1 / (1 << 20)
+        lines = [f"layout {self.layout_id}  (modeled step "
+                 f"{self.step_s * ms:.3f} ms)",
+                 f"  compute floor      {self.compute_s * ms:10.3f} ms",
+                 f"  memory floor       {self.memory_s * ms:10.3f} ms",
+                 f"  roofline max       {self.roofline_s * ms:10.3f} ms",
+                 f"  comm ({self.wire_source:>8})   "
+                 f"{self.comm_s * ms:10.3f} ms  "
+                 f"({self.wire_bytes * mb:.2f} MiB wire)"]
+        for w in self.wire:
+            hide = " [hideable]" if w.hideable else ""
+            lines.append(
+                f"    {w.axis:<8}{w.primitive:<14}"
+                f"{w.bytes_wire * mb:10.2f} MiB wire  "
+                f"x{w.count:.0f}{hide}")
+        lines += [
+            f"  overlap hidden     {-self.hidden_s * ms:10.3f} ms  "
+            f"(eff {OVERLAP_EFFICIENCY})",
+            f"  exposed comm       {self.exposed_comm_s * ms:10.3f} ms",
+            f"  collective latency {self.latency_s * ms:10.3f} ms",
+            f"  pipeline bubble    {self.bubble_s * ms:10.3f} ms",
+            "  HBM: " + ", ".join(
+                f"{k} {v * mb:.1f}" for k, v in self.hbm.items()
+                if k != "capacity") + " MiB"
+            + (f" (cap {self.hbm['capacity'] * mb:.0f} MiB)"
+               if "capacity" in self.hbm else ""),
+        ]
+        if self.wire_drift_pct is not None:
+            lines.append(f"  analytic-vs-traced wire drift "
+                         f"{self.wire_drift_pct:+.1f}%")
+        for n in self.notes:
+            lines.append(f"  note: {n}")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# wire bills
+# ---------------------------------------------------------------------------
+
+def analytic_wire(desc: ModelDesc, layout: Layout) -> List[WireItem]:
+    """Closed-form per-step communication bill for one layout family —
+    the same ring multipliers the jaxpr walker applies, over payload
+    sizes derived from the model description. Sub-KiB payloads (loss
+    pmeans, scalar counters) are omitted: they never move a ranking and
+    the traced tier accounts them exactly."""
+    items: List[WireItem] = []
+    dims = desc.dims
+    grad_b = desc.param_count * desc.grad_itemsize
+    wire_item = 2 if layout.reduce_dtype else desc.grad_itemsize
+    wire_b = desc.param_count * wire_item
+    n_buckets = max(1, -(-desc.param_count
+                         // (layout.ddp_bucket or 2 ** 23)))
+    if layout.tp > 1:
+        # under tp the dp grad psum carries the LOCAL tree: sharded
+        # params at 1/tp plus the replicated remainder (embeddings,
+        # head, LNs — the adapter's tp_replicated dim)
+        repl = dims.get("tp_replicated", 0)
+        local_count = (desc.param_count - repl) / layout.tp + repl
+        grad_b = local_count * desc.grad_itemsize
+        wire_b = local_count * wire_item
+    if layout.dp > 1:
+        if layout.zero:
+            n = layout.dp
+            chunk = layout.zero_chunk or 2 ** 23
+            n_chunks = max(1, -(-desc.param_count // chunk))
+            # reduce-scatter of the flat grads at the wire dtype, then
+            # all-gather of each shard's updated fp32 params
+            items.append(WireItem(
+                "data", "reduce_scatter", wire_b,
+                wire_b * _ring("reduce_scatter", n), n_chunks,
+                hideable=False))
+            gather_in = grad_b / n
+            items.append(WireItem(
+                "data", "all_gather", gather_in,
+                gather_in * _ring("all_gather", n), n_chunks,
+                hideable=False))
+        else:
+            n = layout.dp
+            # overlap credit applies to PURE-dp layouts only: the tp/seq
+            # builders sync grads with a plain post-backward pmean (no
+            # staged seam — see adapters' APX206 note), so their dp
+            # collective sits fully on the critical path
+            items.append(WireItem(
+                "data", "psum", wire_b, wire_b * _ring("psum", n),
+                n_buckets,
+                hideable=(layout.overlap and layout.microbatch == 1
+                          and layout.tp == 1 and layout.seq == 1
+                          and layout.pp == 1)))
+    if layout.tp > 1:
+        # Megatron f/g: 2 fwd psums per block (attention out, fc2) plus
+        # their backward transposes — 4 activation-sized psums per block
+        act = (dims["batch"] // layout.dp) * dims["seq"] \
+            * dims["embed"] * 4
+        count = 4 * dims["layers"]
+        items.append(WireItem(
+            "model", "psum", act * count,
+            act * count * _ring("psum", layout.tp), count))
+    if layout.seq > 1:
+        n = layout.seq
+        b_loc = dims["batch"] // layout.dp
+        s_loc = dims["seq"] // n
+        act = b_loc * s_loc * dims["embed"] * 4   # one (tokens, E) shard
+        if layout.seq_impl == "ring":
+            # ring attention rotates the FULL K and V past every device
+            # once forward and once backward: per layer each device
+            # moves 2 x (K+V) = 4 full (tokens, E) activations —
+            # INDEPENDENT of n; as shard-sized ppermutes that is 4n
+            # payloads of one KV shard (matches the traced bill at
+            # n=2 and n=4 exactly)
+            count = 4 * n * dims["layers"]
+            items.append(WireItem(
+                "seq", "ppermute", act * count,
+                act * count * _ring("ppermute", n), count))
+        else:
+            # Ulysses: head<->sequence all_to_all around attention,
+            # 2-shard payloads x (qkv pack + out) x fwd+bwd = 8 act per
+            # layer (exactly the traced count)
+            count = 4 * dims["layers"]
+            items.append(WireItem(
+                "seq", "all_to_all", 2 * act * count,
+                2 * act * count * _ring("all_to_all", n), count))
+        # the globally-normalized loss leaves shard CONTRIBUTIONS:
+        # every step psums the FULL grad tree over the seq axis
+        items.append(WireItem(
+            "seq", "psum", grad_b, grad_b * _ring("psum", n), 1))
+    if layout.pp > 1:
+        # stage-boundary activation sends, fwd + bwd, per microbatch
+        b_loc = dims["batch"] // max(layout.dp, 1)
+        act = b_loc * dims.get("seq", 1) * dims.get("embed", 1) * 4
+        count = 2 * (layout.pp - 1)
+        items.append(WireItem(
+            "pipe", "ppermute", act * count,
+            act * count * _ring("ppermute", layout.pp), count))
+    return items
+
+
+def traced_wire(built) -> List[WireItem]:
+    """The EXACT wire bill: run the telemetry.comm jaxpr walker over the
+    candidate's shard_map-wrapped program (trace only — avals in,
+    nothing executes). Collectives on the data axis tagged hideable
+    when the layout stages them into backward."""
+    from apex_tpu.telemetry.comm import comm_stats
+    records = comm_stats(built.wrapped, built.state_avals,
+                         built.batch_avals,
+                         axis_sizes=built.axis_sizes)
+    layout = built.layout
+    hide = (layout.overlap and layout.microbatch == 1
+            and not layout.zero and layout.tp == 1 and layout.seq == 1
+            and layout.pp == 1)
+    items = []
+    for r in records:
+        if r.bytes_wire is None:
+            raise ValueError(
+                f"comm walker could not resolve axis size for "
+                f"{r.axis}/{r.primitive} — planner candidates must "
+                f"carry a fully-sized mesh")
+        items.append(WireItem(
+            r.axis, r.primitive, r.bytes_in, float(r.bytes_wire),
+            r.count,
+            hideable=(hide and r.axis == "data"
+                      and r.primitive == "psum")))
+    return items
+
+
+# ---------------------------------------------------------------------------
+# HBM footprint
+# ---------------------------------------------------------------------------
+
+def hbm_footprint(desc: ModelDesc, layout: Layout,
+                  capacity: Optional[float] = None) -> Dict[str, float]:
+    """Per-device HBM need: params + grads + optimizer state under the
+    ZeRO stage + activation estimate. ``capacity`` (when given) rides
+    along for the pruner's verdict message."""
+    shard = layout.tp * layout.pp            # axes that SHARD params
+    params = desc.param_bytes / shard
+    grads = desc.param_count * desc.grad_itemsize / shard
+    if layout.zero:
+        # fp32 master + both moments, sharded over dp; fp32 compute
+        # params stay replicated (they ARE the dense copy here)
+        opt = 12.0 * desc.param_count / layout.dp / shard
+    else:
+        opt = 8.0 * desc.param_count / shard  # two fp32 Adam moments
+    local_batch = desc.dims.get("batch", 1) / (layout.dp
+                                               * layout.microbatch)
+    act = desc.act_bytes_per_sample * local_batch \
+        / (layout.seq * layout.pp)
+    out = {"params": params, "grads": grads, "opt": opt, "act": act,
+           "total": params + grads + opt + act}
+    if capacity is not None:
+        out["capacity"] = float(capacity)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the estimate
+# ---------------------------------------------------------------------------
+
+def estimate(desc: ModelDesc, layout: Layout, *,
+             peaks: Optional[Dict[str, float]] = None,
+             wire: Optional[List[WireItem]] = None,
+             hbm_capacity: Optional[float] = None) -> CostBreakdown:
+    """Price ``layout`` for ``desc``. ``wire`` (from :func:`traced_wire`)
+    replaces the analytic bill and records the drift between the two;
+    ``peaks`` defaults to :func:`apex_tpu.pyprof.roofline.device_peaks`
+    of the local device."""
+    if peaks is None:
+        from apex_tpu.pyprof.roofline import device_peaks
+        peaks = device_peaks()
+    world = layout.world
+    mb = layout.microbatch
+
+    compute_s = desc.flops_per_step / world / peaks["flops"]
+    memory_s = desc.bytes_per_step / world / peaks["bytes_per_s"]
+    roofline_s = max(compute_s, memory_s)
+
+    analytic = analytic_wire(desc, layout)
+    drift = None
+    source = "analytic"
+    if wire is not None:
+        a_total = sum(w.bytes_wire for w in analytic)
+        t_total = sum(w.bytes_wire for w in wire)
+        if t_total > 0:
+            drift = 100.0 * (a_total - t_total) / t_total
+        source = "traced"
+    else:
+        wire = analytic
+
+    bw = ici_bytes_per_s()
+    lat = collective_latency_s()
+    wire_bytes = sum(w.bytes_wire for w in wire)
+    latency_s = lat * sum(w.count for w in wire)
+    comm_s = wire_bytes / bw
+    hideable_s = sum(w.bytes_wire for w in wire if w.hideable) / bw
+    window = BACKWARD_FRACTION * compute_s
+    hidden_s = min(hideable_s, window) * OVERLAP_EFFICIENCY
+    exposed_s = comm_s - hidden_s
+
+    bubble_s = roofline_s * (layout.pp - 1) / mb if layout.pp > 1 \
+        else 0.0
+
+    step_s = roofline_s + exposed_s + latency_s + bubble_s
+    notes = []
+    if layout.zero == 0 and layout.dp > 1 and not layout.overlap:
+        notes.append("overlap off: dp grad sync fully exposed")
+    if layout.reduce_dtype:
+        notes.append(f"{layout.reduce_dtype} wire compression "
+                     "(pre-scaled, fp32 accumulation)")
+    return CostBreakdown(
+        layout_id=layout.layout_id(),
+        compute_s=compute_s, memory_s=memory_s, roofline_s=roofline_s,
+        wire=list(wire), wire_bytes=wire_bytes, comm_s=comm_s + latency_s,
+        hidden_s=hidden_s, exposed_comm_s=exposed_s, bubble_s=bubble_s,
+        latency_s=latency_s, step_s=step_s,
+        hbm=hbm_footprint(desc, layout, capacity=hbm_capacity
+                          if hbm_capacity is not None
+                          else peaks.get("hbm_bytes")),
+        wire_source=source, wire_drift_pct=drift, notes=notes)

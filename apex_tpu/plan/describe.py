@@ -1,0 +1,119 @@
+"""Model description — everything the analytic cost model needs to
+price a layout WITHOUT building it.
+
+A :class:`ModelDesc` is produced once per ``plan.auto`` call by the
+model adapter (:mod:`apex_tpu.plan.adapters`): parameter counts come
+from ``jax.eval_shape`` over ``model.init`` (nothing executes), and the
+whole-step FLOP/byte totals come from XLA's own cost analysis of a
+single-device reference step (:func:`apex_tpu.pyprof.prof.analyze` —
+the same numbers pyprof's roofline verdicts use). Every candidate's
+compute/memory floor is then a scaling of these totals; the exact
+per-layout communication bill comes from the :mod:`telemetry.comm`
+jaxpr walker when the candidate is actually traced (the validate tier).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+Tree = Any
+
+
+def tree_bytes(tree: Tree) -> int:
+    """Total bytes of a pytree of arrays/ShapeDtypeStructs."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", ())
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is None:
+            continue
+        total += int(np.prod(shape, dtype=np.int64) if shape else 1) \
+            * np.dtype(dtype).itemsize
+    return total
+
+
+def tree_count(tree: Tree) -> int:
+    """Total element count of a pytree of arrays/ShapeDtypeStructs."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", ())
+        total += int(np.prod(shape, dtype=np.int64) if shape else 1)
+    return total
+
+
+@dataclasses.dataclass
+class ModelDesc:
+    """The cost model's view of one (model, workload) pair.
+
+    flops_per_step / bytes_per_step:
+        Whole-step totals (fwd + bwd + optimizer) for the GLOBAL batch
+        on ONE device, from XLA cost analysis of the adapter's
+        single-device reference step. A candidate's per-device floor is
+        these totals divided by its model-parallel degree and batch
+        shards (documented approximation: tensor/sequence/pipeline
+        parallelism divide the matmul-dominated totals near-linearly;
+        the traced tier re-checks the winner's program for real).
+    act_bytes_per_sample:
+        Activation footprint per sample at the FULL sequence length, in
+        the compute dtype — the HBM-feasibility term that microbatching
+        divides. A documented estimate (transformer: ~12 activations of
+        (S, E) per block + logits; resnet: stage feature maps), not a
+        compiled-program claim.
+    opt_state_bytes:
+        Unsharded fp32 optimizer footprint (master + both Adam
+        moments); ZeRO divides it by the shard count.
+    dims:
+        Model-family dimensions for the pruner's divisibility checks
+        (``batch``, ``seq``, ``heads``, ``embed``, ``layers``,
+        ``vocab``, ``mlp_width`` for GPT; ``batch``, ``image``,
+        ``classes`` for resnet).
+    """
+
+    name: str
+    param_count: int
+    param_bytes: int
+    flops_per_step: float
+    bytes_per_step: float
+    act_bytes_per_sample: float
+    opt_state_bytes: int
+    dims: Dict[str, int]
+    grad_itemsize: int = 4        # fp32 gradients everywhere today
+
+    def to_meta(self) -> Dict[str, Any]:
+        return {"name": self.name, "param_count": int(self.param_count),
+                "flops_per_step": float(self.flops_per_step),
+                "dims": dict(self.dims)}
+
+
+def reference_cost(step_fn: Callable, *args) -> Dict[str, Optional[float]]:
+    """XLA cost analysis of the adapter's single-device reference step
+    (one compile; avals suffice — nothing executes). Returns the
+    :func:`~apex_tpu.pyprof.prof.analyze` dict; ``flops``/
+    ``bytes_accessed`` may be None on backends whose cost analysis is
+    silent — the adapter then falls back to its analytic formula."""
+    from apex_tpu.pyprof import prof
+    return prof.analyze(step_fn, *args)
+
+
+def transformer_flops(*, batch: int, seq: int, embed: int, layers: int,
+                      vocab: int, mlp_ratio: int = 4) -> float:
+    """Analytic fwd+bwd FLOPs for one decoder-LM step (the standard
+    6·N·T estimate plus the quadratic attention term and the LM head)
+    — the fallback when XLA cost analysis reports nothing."""
+    tokens = batch * seq
+    block_params = 12 * embed * embed * (1 + mlp_ratio) / 5  # qkv+o+mlp
+    n_block = layers * block_params * 5
+    matmul = 6.0 * tokens * (n_block + embed * vocab)
+    attn = 6.0 * layers * batch * seq * seq * embed * 2 / 2
+    return matmul + attn
+
+
+def resnet_flops(*, batch: int, image: int) -> float:
+    """Analytic fwd+bwd FLOPs for a ResNet-18-family step at ``image``
+    resolution (scaled from the canonical 1.8 GFLOP @224 forward)."""
+    fwd = 1.8e9 * (image / 224.0) ** 2
+    return 3.0 * batch * fwd

@@ -1,0 +1,258 @@
+"""The emitter: turn the winning candidate into a ready-to-train
+package — ``TrainerConfig`` + shard_map layout (mesh/in_specs) + tune
+cache entries — delivered through the PR 9 trainer plugin seam.
+
+The non-negotiable gate: EVERY emitted layout passes the lint SPMD
+verifier (APX201-APX208) over the exact shard_map-wrapped program the
+trainer will compile. A candidate the verifier flags raises
+:class:`PlanRejected` carrying the findings — the planner never hands a
+caller a layout it knows deadlocks or diverges.
+
+Tune cache entries are schema-v1 compatible with ``"planner"``
+provenance: a subsequent ``APEX_TPU_TUNE=cache`` run resolves the
+planner's bucket/chunk choices with zero re-measurement, and
+``python -m apex_tpu.tune show`` renders where they came from.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+from apex_tpu.plan.adapters import Built
+from apex_tpu.plan.cost import CostBreakdown
+from apex_tpu.plan.describe import ModelDesc
+from apex_tpu.plan.layout import Layout
+
+__all__ = ["Plan", "PlanRejected", "verify_built", "emit",
+           "format_table"]
+
+
+class PlanRejected(RuntimeError):
+    """An emit-path candidate failed the SPMD verifier. Carries the
+    findings so callers (and the CI gate) can name the rules."""
+
+    def __init__(self, layout: Layout, findings: Sequence[Any]):
+        self.layout = layout
+        self.findings = list(findings)
+        rules = ", ".join(sorted({f.rule_id for f in self.findings}))
+        super().__init__(
+            f"planner refuses to emit layout {layout.layout_id()}: "
+            f"lint.spmd flagged {rules} — "
+            + "; ".join(f.message for f in self.findings[:3]))
+
+
+def verify_built(built: Built, *,
+                 threshold_bytes: Optional[int] = None) -> List[Any]:
+    """Run APX201-APX208 over the candidate's shard_map-wrapped program
+    (trace-only; the same entry ``Plan.build_trainer`` compiles, with
+    the trainer's donation declaration armed). Returns the findings
+    list — empty means verified."""
+    from apex_tpu import lint
+    if threshold_bytes is None and built.layout.zero:
+        # ZeRO re-materializes the updated params in bucketed
+        # all_gathers BY DESIGN (sharded optimizer state, gathered
+        # params is the zero-2 trade) — at real model sizes those
+        # designed gathers cross APX204's default 1 MiB replication
+        # threshold. Raise it to the step state's own size: no designed
+        # zero gather can exceed the state it re-materializes, so the
+        # param gathers pass while an activation-sized accidental
+        # replication (batch x features dwarfs the state) still fires.
+        from apex_tpu.lint.spmd_checks import replication_threshold_bytes
+        from apex_tpu.plan.describe import tree_bytes
+        threshold_bytes = max(replication_threshold_bytes(),
+                              int(tree_bytes(built.state_avals)) + 1)
+    return lint.check_entry_spmd(
+        built.wrapped, (built.state_avals, built.batch_avals),
+        name=f"plan:{built.layout.layout_id()}",
+        path="apex_tpu/plan/emit.py",
+        mesh_axes=built.mesh_axis_names,
+        axis_sizes=built.axis_sizes,
+        donate_argnums=(0,),
+        threshold_bytes=threshold_bytes)
+
+
+def _cache_entries(desc: ModelDesc, layout: Layout,
+                   est: CostBreakdown) -> List[Dict[str, Any]]:
+    """The schema-v1 tune entries this layout pins: the exact
+    (op, key) pairs the runtime call sites will look up (``total`` goes
+    through ``tune.shape_bucket`` exactly like ``allreduce_gradients``
+    / ``_ZeroBase._pack`` compute it)."""
+    from apex_tpu.tune import shape_bucket
+    from apex_tpu.tune.tuner import cache_key
+    out: List[Dict[str, Any]] = []
+    total = shape_bucket(desc.param_count)
+
+    def _entry(op: str, key: Dict[str, int], config: Dict[str, int]):
+        out.append({
+            "op": op, "key": key, "cache_key": cache_key(op, key),
+            "entry": {"config": dict(config), "provenance": "planner",
+                      "planned_s": est.step_s,
+                      "layout": layout.layout_id()}})
+
+    if layout.dp > 1 and not layout.zero and layout.ddp_bucket:
+        key = {"total": total, "world": layout.dp}
+        cfg = {"message_size": int(layout.ddp_bucket)}
+        _entry("ddp_message_size", key, cfg)
+        if layout.overlap:
+            _entry("ddp_overlap", key, cfg)
+    if layout.zero and layout.zero_chunk:
+        _entry("zero_chunk_elements",
+               {"total": total, "world": layout.dp},
+               {"chunk_elements": int(layout.zero_chunk)})
+    return out
+
+
+def _write_cache(entries: List[Dict[str, Any]]) -> int:
+    from apex_tpu.tune import cache as _cache
+    store = _cache.get_cache()
+    written = 0
+    for e in entries:
+        if store.put(e["cache_key"], dict(e["entry"])):
+            written += 1
+    return written
+
+
+@dataclasses.dataclass
+class Plan:
+    """A ready-to-train emission. ``build_trainer()`` compiles the
+    winning step through :func:`apex_tpu.trainer.build` with the plan's
+    own TrainerConfig and a :class:`~apex_tpu.trainer.plugins.
+    PlanPlugin` attached (the pick lands in the run's telemetry as
+    ``plan/pick``); ``init_state()`` materializes the sharded initial
+    state; the verdict ``table`` keeps every candidate's fate for the
+    CLI/CI."""
+
+    layout: Layout
+    cost: CostBreakdown
+    desc: ModelDesc
+    built: Built
+    table: List[Dict[str, Any]]
+    cache_entries: List[Dict[str, Any]]
+    cache_written: int
+    measured_s: Optional[float] = None
+
+    @property
+    def layout_id(self) -> str:
+        return self.layout.layout_id()
+
+    def trainer_config(self, **overrides):
+        from apex_tpu.trainer import TrainerConfig
+        kw = dict(mode="per_step", in_flight=2, donate=True)
+        kw.update(overrides)
+        return TrainerConfig(**kw)
+
+    def init_state(self):
+        return self.built.init_state()
+
+    def batch_fn(self, i: int):
+        return self.built.batch_fn(i)
+
+    def build_trainer(self, *, config=None, plugins: Sequence[Any] = (),
+                      name: Optional[str] = None):
+        """The delivery point: the PR 9 compiled-step builder over the
+        emitted layout (mesh + in_specs + donation + dispatch window),
+        plan attribution plugin attached exactly once."""
+        from apex_tpu import trainer as _trainer
+        from apex_tpu.trainer.plugins import PlanPlugin
+        cfg = config or self.trainer_config()
+        return _trainer.build(
+            self.built.step, self.built.state_avals,
+            self.built.batch_avals, mesh=self.built.mesh,
+            state_spec=self.built.state_spec,
+            batch_spec=self.built.batch_spec,
+            config=cfg, plugins=list(plugins) + [PlanPlugin(self)],
+            name=name or f"plan:{self.layout_id}")
+
+    def explain(self, layout_id: Optional[str] = None) -> str:
+        """Per-term cost audit of the pick (or any candidate in the
+        table by id) — the CLI ``explain`` body."""
+        if layout_id is None or layout_id == self.layout_id:
+            return self.cost.explain()
+        for row in self.table:
+            if row.get("layout") == layout_id:
+                return "\n".join(f"{k}: {v}" for k, v in row.items())
+        raise KeyError(f"layout {layout_id!r} not in this plan's table; "
+                       f"known: {[r['layout'] for r in self.table]}")
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "pick": self.layout.to_dict(),
+            "modeled_step_s": self.cost.step_s,
+            "measured_step_s": self.measured_s,
+            "wire_bytes": self.cost.wire_bytes,
+            "wire_source": self.cost.wire_source,
+            "wire_drift_pct": self.cost.wire_drift_pct,
+            "hbm_bytes": self.cost.hbm.get("total"),
+            "model": self.desc.to_meta(),
+            "mesh": dict(self.built.axis_sizes),
+            "cache_entries": [
+                {"cache_key": e["cache_key"], **e["entry"]}
+                for e in self.cache_entries],
+            "table": list(self.table),
+        }
+
+
+def format_table(table: List[Dict[str, Any]]) -> str:
+    """The ranked candidate table (CLI ``auto`` body): layout, modeled
+    step ms, wire bytes, HBM, feasibility verdict — parseable (fixed
+    columns, one row per candidate)."""
+    hdr = (f"{'rank':<5}{'layout':<26}{'family':<14}{'step_ms':>10}"
+           f"{'wire_MiB':>10}{'hbm_MiB':>9}  verdict")
+    lines = [hdr, "-" * len(hdr)]
+    rank_i = 0
+    for row in table:
+        feas = row["feasible"]
+        rank_i = rank_i + 1 if feas else rank_i
+        rank = str(rank_i) if feas else "-"
+        step = (f"{row['step_ms']:.3f}" if "step_ms" in row else "-")
+        wire = (f"{row['wire_mib']:.2f}" if "wire_mib" in row else "-")
+        hbm = (f"{row['hbm_mib']:.0f}" if "hbm_mib" in row else "-")
+        verdict = "OK" if feas else f"infeasible: {row['reason']}"
+        if feas and "measured_ms" in row:
+            verdict += f" (measured {row['measured_ms']:.3f} ms)"
+        if feas and row.get("wire_source") == "traced":
+            verdict += " [traced]"
+        lines.append(f"{rank:<5}{row['layout']:<26}{row['family']:<14}"
+                     f"{step:>10}{wire:>10}{hbm:>9}  {verdict}")
+    return "\n".join(lines)
+
+
+def emit(built: Built, est: CostBreakdown, *, desc: ModelDesc,
+         verdicts: Sequence[Any] = (), measured_s: Optional[float] = None,
+         write_cache: bool = True, preverified: bool = False) -> Plan:
+    """Gate + package: verify the candidate (APX201-208), write the tune
+    cache entries, record the ``plan/*`` telemetry statics, return the
+    :class:`Plan`. Raises :class:`PlanRejected` on findings — this is
+    the one door every emitted layout walks through. ``preverified``
+    skips the (expensive, whole-program) re-verification ONLY for the
+    in-process ``plan.auto`` path, which has already run
+    :func:`verify_built` over this exact built program and rejected on
+    findings; every external caller keeps the default gate."""
+    from apex_tpu import telemetry
+    if not preverified:
+        findings = verify_built(built)
+        if findings:
+            raise PlanRejected(built.layout, findings)
+    entries = _cache_entries(desc, built.layout, est)
+    written = _write_cache(entries) if write_cache else 0
+    table = [v.row() for v in verdicts] if verdicts else []
+    plan = Plan(layout=built.layout, cost=est, desc=desc, built=built,
+                table=table, cache_entries=entries,
+                cache_written=written, measured_s=measured_s)
+    if telemetry.enabled():
+        telemetry.record_static(
+            "plan/pick", est.step_s,
+            meta={**est.to_meta(), "mesh": dict(built.axis_sizes),
+                  "model": desc.to_meta(),
+                  "measured_s": measured_s,
+                  "cache_entries": len(entries),
+                  "cache_written": written},
+            dedup_key=("plan/pick", built.layout.layout_id(),
+                       desc.name))
+        telemetry.record_static(
+            "plan/candidates", float(len(table)),
+            meta={"feasible": sum(1 for r in table if r["feasible"]),
+                  "total": len(table)},
+            dedup_key=("plan/candidates", desc.name, len(table)))
+    return plan

@@ -1,0 +1,191 @@
+"""Candidate parallelism layouts — the planner's search coordinates.
+
+A :class:`Layout` names one point in the space the ROADMAP item-2 search
+covers: the mesh factorization (dp x tp x pp x seq), the ZeRO stage, the
+microbatch (gradient-accumulation) count, the gradient-collective bucket
+capacities, and the wire dtype. It is deliberately a frozen value type:
+the cost model prices it, the pruner vetoes it, the emitter builds a
+real step from it — none of them mutate it.
+
+The mesh axis names follow the multichip dryrun conventions
+(``__graft_entry__.py``): ``data`` (batch shards / ZeRO shards),
+``model`` (Megatron tensor parallel), ``pipe`` (GPipe stages), ``seq``
+(ring/Ulysses sequence shards).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import List, Optional, Tuple
+
+REDUCE_DTYPES = (None, "bf16", "fp16")
+SEQ_IMPLS = ("ring", "ulysses")
+
+# ZeRO stages the toolkit implements: 0 = replicated optimizer state
+# (DDP + FusedAdam), 2 = DistributedFusedAdam (fp32 master + both Adam
+# moments sharded over the data axis, grads reduce-scattered). Stages
+# 1/3 are not built; the enumerator never emits them.
+ZERO_STAGES = (0, 2)
+
+
+@dataclasses.dataclass(frozen=True)
+class Layout:
+    """One parallelism candidate. ``dp*tp*pp*seq`` must equal the device
+    count; knobs that do not apply to a family stay at their defaults
+    (the enumerator only produces meaningful combinations, and
+    :meth:`validate` rejects contradictory ones loudly)."""
+
+    dp: int = 1
+    tp: int = 1
+    pp: int = 1
+    seq: int = 1
+    zero: int = 0                        # ZERO_STAGES
+    microbatch: int = 1                  # grad-accumulation chunks
+    reduce_dtype: Optional[str] = None   # wire dtype for grad collectives
+    overlap: bool = True                 # stage dp collectives in backward
+    seq_impl: str = "ring"               # when seq > 1
+    # planner-resolved bucket capacities (elements); None = the tune
+    # heuristic. These are what the emitter writes into the tune cache
+    # with "planner" provenance.
+    ddp_bucket: Optional[int] = None
+    zero_chunk: Optional[int] = None
+
+    def __post_init__(self):
+        self.validate()
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def world(self) -> int:
+        return self.dp * self.tp * self.pp * self.seq
+
+    def family(self) -> str:
+        """Human name of the layout family (the dryrun part names)."""
+        parts = []
+        if self.zero:
+            parts.append(f"zero{self.zero}")
+        elif self.dp > 1 or not parts:
+            parts.append("dp")
+        if self.tp > 1:
+            parts.append("tp")
+        if self.seq > 1:
+            parts.append(self.seq_impl)
+        if self.pp > 1:
+            parts.append("gpipe")
+        return "x".join(parts)
+
+    def layout_id(self) -> str:
+        """Stable parseable id, e.g. ``dp4-tp2``, ``dp8-zero2-mb2-bf16``.
+        Round-trips through :func:`parse_layout_id`."""
+        bits = [f"dp{self.dp}"]
+        if self.tp > 1:
+            bits.append(f"tp{self.tp}")
+        if self.pp > 1:
+            bits.append(f"pp{self.pp}")
+        if self.seq > 1:
+            tag = "sq" if self.seq_impl == "ring" else "uly"
+            bits.append(f"{tag}{self.seq}")
+        if self.zero:
+            bits.append(f"zero{self.zero}")
+        if self.microbatch > 1:
+            bits.append(f"mb{self.microbatch}")
+        if self.reduce_dtype:
+            bits.append(self.reduce_dtype)
+        if not self.overlap:
+            bits.append("noov")
+        return "-".join(bits)
+
+    # -- mesh --------------------------------------------------------------
+    def mesh_axes(self) -> List[Tuple[str, int]]:
+        """Ordered (name, size) pairs for :func:`apex_tpu.parallel.mesh.
+        named_mesh` — slower-varying (DCN-friendly) axes first, the
+        bandwidth-hungry tp/seq axes last (ICI neighbors), matching
+        :func:`~apex_tpu.parallel.mesh.hybrid_mesh` guidance."""
+        axes: List[Tuple[str, int]] = [("data", self.dp)]
+        if self.pp > 1:
+            axes.append(("pipe", self.pp))
+        if self.seq > 1:
+            axes.append(("seq", self.seq))
+        if self.tp > 1:
+            axes.append(("model", self.tp))
+        return axes
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["id"] = self.layout_id()
+        d["family"] = self.family()
+        return d
+
+    # -- validation --------------------------------------------------------
+    def validate(self) -> None:
+        """Structural sanity — raises ``ValueError`` naming the offending
+        knob. Model-shape feasibility (divisibility, HBM) is the
+        pruner's job (:func:`apex_tpu.plan.search.prune`); this catches
+        layouts that are contradictory for EVERY model."""
+        for name in ("dp", "tp", "pp", "seq", "microbatch"):
+            v = getattr(self, name)
+            if not isinstance(v, int) or v < 1:
+                raise ValueError(f"Layout.{name} must be an int >= 1, "
+                                 f"got {v!r}")
+        if self.zero not in ZERO_STAGES:
+            raise ValueError(
+                f"Layout.zero must be one of {ZERO_STAGES} (the stages "
+                f"the toolkit implements), got {self.zero!r}")
+        if self.reduce_dtype not in REDUCE_DTYPES:
+            raise ValueError(
+                f"Layout.reduce_dtype must be one of {REDUCE_DTYPES}, "
+                f"got {self.reduce_dtype!r}")
+        if self.seq_impl not in SEQ_IMPLS:
+            raise ValueError(
+                f"Layout.seq_impl must be one of {SEQ_IMPLS}, "
+                f"got {self.seq_impl!r}")
+        if self.zero and self.dp < 2:
+            raise ValueError(
+                "ZeRO shards optimizer state over the data axis — "
+                f"zero={self.zero} requires dp >= 2, got dp={self.dp}")
+        if self.zero and self.tp > 1:
+            raise ValueError(
+                "zero + tensor parallelism is not a supported "
+                "composition (ZeRO's flat layout assumes replicated "
+                "params over the data axis; TP shards them)")
+        if self.tp > 1 and self.seq > 1:
+            raise ValueError(
+                "tp + sequence parallelism in one layout is not a "
+                "supported composition (attention cannot shard heads "
+                "over two axes at once)")
+        for cap_name in ("ddp_bucket", "zero_chunk"):
+            cap = getattr(self, cap_name)
+            if cap is not None and (not isinstance(cap, int) or cap < 1):
+                raise ValueError(
+                    f"Layout.{cap_name} must be a positive element "
+                    f"count or None (tune heuristic), got {cap!r}")
+
+
+_ID_RE = re.compile(
+    r"^dp(?P<dp>\d+)"
+    r"(?:-tp(?P<tp>\d+))?"
+    r"(?:-pp(?P<pp>\d+))?"
+    r"(?:-(?P<seqtag>sq|uly)(?P<seq>\d+))?"
+    r"(?:-zero(?P<zero>\d+))?"
+    r"(?:-mb(?P<mb>\d+))?"
+    r"(?:-(?P<rd>bf16|fp16))?"
+    r"(?:-(?P<noov>noov))?$")
+
+
+def parse_layout_id(s: str) -> Layout:
+    """Inverse of :meth:`Layout.layout_id` (the CLI's ``explain <pick>``
+    argument). Raises ``ValueError`` with the grammar on mismatch."""
+    m = _ID_RE.match(s.strip())
+    if m is None:
+        raise ValueError(
+            f"unparseable layout id {s!r}; expected e.g. 'dp8', "
+            "'dp4-tp2', 'dp8-zero2-mb2-bf16', 'dp2-sq4' "
+            "(grammar: dpN[-tpN][-ppN][-sqN|-ulyN][-zeroN][-mbN]"
+            "[-bf16|-fp16][-noov])")
+    g = m.groupdict()
+    return Layout(
+        dp=int(g["dp"]), tp=int(g["tp"] or 1), pp=int(g["pp"] or 1),
+        seq=int(g["seq"] or 1), zero=int(g["zero"] or 0),
+        microbatch=int(g["mb"] or 1), reduce_dtype=g["rd"],
+        overlap=g["noov"] is None,
+        seq_impl=("ulysses" if g["seqtag"] == "uly" else "ring"))

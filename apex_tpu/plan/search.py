@@ -1,0 +1,496 @@
+"""The strategy search: enumerate -> prune -> rank -> validate -> pick.
+
+The space is the ROADMAP item-2 tuple (dp, tp, pp, seq, zero stage,
+microbatch, bucket capacities, reduce_dtype), in the spirit of AMP's
+heterogeneity-aware strategy search (arXiv 2210.07297): an ANALYTIC
+first pass prices every structurally-feasible candidate (no tracing),
+then the ``top_k`` survivors are traced for their exact comm bill
+(:func:`~apex_tpu.plan.cost.traced_wire` — the telemetry.comm jaxpr
+walker) and verified by the lint SPMD rules before any of them can be
+emitted; a verifier-rejected candidate is disqualified LOUDLY, never
+silently skipped. On a real TPU (``validate="measure"``) the survivors
+are additionally timed through :mod:`apex_tpu.tune.measure` — on
+CPU/interpret that tier reports "not measurable" and the ranking stays
+analytic, exactly like existing tune sweeps (hermetic CI).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from apex_tpu.plan import cost as _cost
+from apex_tpu.plan.adapters import Built
+from apex_tpu.plan.describe import ModelDesc
+from apex_tpu.plan.layout import Layout
+
+__all__ = ["Constraints", "Verdict", "PlanError", "enumerate_candidates",
+           "prune", "rank", "auto", "replanner"]
+
+
+class PlanError(ValueError):
+    """A planner-level contract violation (estimating an infeasible
+    layout, an empty feasible set, ...) — loud by design."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Constraints:
+    """Search-space bounds + validation policy for one ``auto`` call.
+
+    hbm_bytes:
+        Per-device capacity the footprint model prunes against; None =
+        :func:`apex_tpu.pyprof.roofline.device_hbm_bytes` of the local
+        device.
+    zero_stages / microbatches / reduce_dtypes:
+        The knob values enumerated (defaults cover the proven set).
+    allow_seq / allow_tp / allow_pp:
+        Family gates. ``allow_pp`` defaults False: pp candidates are
+        priced but not emittable (adapters veto them), so they only
+        enter the table when explicitly requested.
+    top_k:
+        Survivors that get the traced comm bill + lint verification
+        (and measurement under ``validate="measure"``).
+    validate:
+        ``"none"`` (analytic only — the replan/bench fast path),
+        ``"trace"`` (default), ``"measure"`` (trace + on-device timing
+        when the backend is measurable; measured candidates then rank
+        by MEASURED step time — the AMP arc: the analytic model's job
+        is to shortlist the true best into the top_k, the device clock
+        settles the pick).
+    measure_force:
+        Time ``validate="measure"`` candidates even on a backend
+        ``tune.measure.measurable()`` declines (CPU/interpret). The
+        hermetic-CI doctrine stays the default — this is the explicit
+        opt-in ``benchmarks/plan_vs_hand.py`` uses, where wall clock IS
+        the ground truth being compared against.
+    """
+
+    hbm_bytes: Optional[float] = None
+    zero_stages: Tuple[int, ...] = (0, 2)
+    microbatches: Tuple[int, ...] = (1, 2)
+    reduce_dtypes: Tuple[Optional[str], ...] = (None, "bf16")
+    allow_seq: bool = True
+    allow_tp: bool = True
+    allow_pp: bool = False
+    seq_impls: Tuple[str, ...] = ("ring", "ulysses")
+    top_k: int = 4
+    validate: str = "trace"
+    measure_force: bool = False
+    target_buckets: int = 8
+
+    def __post_init__(self):
+        if self.validate not in ("none", "trace", "measure"):
+            raise ValueError(
+                f"Constraints.validate must be none|trace|measure, "
+                f"got {self.validate!r}")
+        if self.top_k < 1:
+            raise ValueError("Constraints.top_k must be >= 1")
+
+
+@dataclasses.dataclass
+class Verdict:
+    """One row of the ranked table: a candidate plus its fate."""
+
+    layout: Layout
+    feasible: bool
+    reason: str = ""                     # why infeasible ("" when ok)
+    cost: Optional[_cost.CostBreakdown] = None
+    measured_s: Optional[float] = None   # validate="measure" only
+    lint_findings: List[Any] = dataclasses.field(default_factory=list)
+
+    @property
+    def step_s(self) -> float:
+        return self.cost.step_s if self.cost else float("inf")
+
+    def row(self) -> Dict[str, Any]:
+        out = {"layout": self.layout.layout_id(),
+               "family": self.layout.family(),
+               "feasible": self.feasible, "reason": self.reason}
+        if self.cost is not None:
+            out.update({
+                "step_ms": round(self.cost.step_s * 1e3, 4),
+                "wire_mib": round(self.cost.wire_bytes / (1 << 20), 3),
+                "hbm_mib": round(self.cost.hbm["total"] / (1 << 20), 1),
+                "wire_source": self.cost.wire_source})
+        if self.measured_s is not None:
+            out["measured_ms"] = round(self.measured_s * 1e3, 4)
+        if self.lint_findings:
+            out["lint"] = [f.rule_id for f in self.lint_findings]
+        return out
+
+
+def _pow2_at_most(n: int) -> int:
+    b = 1
+    while b * 2 <= n:
+        b *= 2
+    return b
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def resolve_buckets(desc: ModelDesc, layout: Layout, *,
+                    target_buckets: int = 8) -> Layout:
+    """Planner-resolved bucket capacities: split the flat gradient into
+    ~``target_buckets`` power-of-two-sized buckets (enough pieces for
+    the staged-backward schedule to pipeline, few enough that
+    per-collective latency stays negligible), clamped to the tune
+    heuristics' sane range [2^20, 2^25]."""
+    total = desc.param_count
+    cap = max(1 << 20, min(1 << 25,
+                           _pow2_at_most(max(1, total // target_buckets))))
+    kw = {}
+    pure_dp = layout.tp == 1 and layout.seq == 1 and layout.pp == 1
+    if layout.dp > 1 and not layout.zero and pure_dp:
+        # tp/seq layouts sync grads with plain collectives (adapter
+        # APX206 note) — a bucket capacity would configure nothing
+        kw["ddp_bucket"] = cap
+    if layout.zero:
+        kw["zero_chunk"] = cap
+    return dataclasses.replace(layout, **kw) if kw else layout
+
+
+def enumerate_candidates(n_devices: int, desc: ModelDesc,
+                         constraints: Constraints) -> List[Layout]:
+    """Every structurally-plausible layout over ``n_devices`` — mesh
+    factorizations x zero stages x microbatches x wire dtypes, with the
+    planner's bucket resolution applied. Model-shape feasibility is
+    :func:`prune`'s job."""
+    cands: List[Layout] = []
+    is_lm = "seq" in desc.dims
+
+    def _add(**kw):
+        try:
+            layout = Layout(**kw)
+        except ValueError:
+            return
+        cands.append(resolve_buckets(
+            desc, layout, target_buckets=constraints.target_buckets))
+
+    for dp in _divisors(n_devices):
+        rest = n_devices // dp
+        if rest == 1:
+            # pure data parallelism (dp may be 1 = single device)
+            for zero in constraints.zero_stages:
+                if zero and dp < 2:
+                    continue
+                for mb in constraints.microbatches:
+                    for rd in constraints.reduce_dtypes:
+                        if dp == 1 and (rd or zero):
+                            continue
+                        _add(dp=dp, zero=zero, microbatch=mb,
+                             reduce_dtype=rd)
+            continue
+        # one extra axis: tp, seq, or pp takes the remainder (no
+        # reduce_dtype variants: compression rides the DDP seam the
+        # tp/seq steps deliberately avoid — adapters.veto)
+        if constraints.allow_tp and is_lm:
+            _add(dp=dp, tp=rest)
+        if constraints.allow_seq and is_lm:
+            for impl in constraints.seq_impls:
+                _add(dp=dp, seq=rest, seq_impl=impl)
+        if constraints.allow_pp:
+            _add(dp=dp, pp=rest)
+    # dedup (the dp==1 branches can collide)
+    seen, out = set(), []
+    for c in cands:
+        key = c.layout_id()
+        if key not in seen:
+            seen.add(key)
+            out.append(c)
+    return out
+
+
+def _shape_reason(desc: ModelDesc, layout: Layout) -> Optional[str]:
+    """Divisibility feasibility from the model dims — a named reason or
+    None. These are the non-negotiable vetoes (a non-divisible axis is
+    not a slower layout, it is not a layout)."""
+    d = desc.dims
+    batch = d.get("batch", 1)
+    if batch % layout.dp:
+        return (f"global batch {batch} not divisible by dp={layout.dp}")
+    if (batch // layout.dp) % layout.microbatch:
+        return (f"local batch {batch // layout.dp} not divisible by "
+                f"microbatch={layout.microbatch}")
+    if layout.tp > 1:
+        if d.get("heads", 1) % layout.tp:
+            return f"heads {d.get('heads')} not divisible by tp={layout.tp}"
+        if d.get("mlp_width", 1) % layout.tp:
+            return (f"mlp width {d.get('mlp_width')} not divisible by "
+                    f"tp={layout.tp}")
+    if layout.seq > 1:
+        if d.get("seq", 1) % layout.seq:
+            return (f"sequence {d.get('seq')} not divisible by "
+                    f"seq={layout.seq}")
+        if layout.seq_impl == "ulysses" \
+                and d.get("heads", 1) % layout.seq:
+            return (f"ulysses shards heads: {d.get('heads')} not "
+                    f"divisible by seq={layout.seq}")
+    if layout.pp > 1 and d.get("layers", 1) % layout.pp:
+        return (f"layers {d.get('layers')} not divisible by "
+                f"pp={layout.pp}")
+    return None
+
+
+def prune(candidates: Sequence[Layout], desc: ModelDesc, *,
+          adapter=None, constraints: Optional[Constraints] = None,
+          peaks: Optional[Dict[str, float]] = None) -> List[Verdict]:
+    """Classify every candidate: infeasible ones keep their named reason
+    (non-divisible axis, adapter veto, HBM overflow) and NO cost;
+    feasible ones carry the analytic :class:`CostBreakdown`."""
+    constraints = constraints or Constraints()
+    if peaks is None:
+        from apex_tpu.pyprof.roofline import device_peaks
+        peaks = device_peaks()
+    cap = constraints.hbm_bytes if constraints.hbm_bytes is not None \
+        else peaks.get("hbm_bytes")
+    out: List[Verdict] = []
+    for layout in candidates:
+        reason = _shape_reason(desc, layout)
+        if reason is None and adapter is not None:
+            reason = adapter.veto(layout)
+        if reason is not None:
+            out.append(Verdict(layout, False, reason))
+            continue
+        est = _cost.estimate(desc, layout, peaks=peaks,
+                             hbm_capacity=cap)
+        if cap is not None and est.hbm["total"] > cap:
+            out.append(Verdict(
+                layout, False,
+                f"HBM overflow: need "
+                f"{est.hbm['total'] / (1 << 20):.0f} MiB > "
+                f"{cap / (1 << 20):.0f} MiB", est))
+            continue
+        out.append(Verdict(layout, True, "", est))
+    return out
+
+
+def rank(verdicts: Sequence[Verdict]) -> List[Verdict]:
+    """Feasible candidates by modeled step time (infeasible ones keep
+    their enumeration order at the tail — the table shows everything)."""
+    feas = sorted((v for v in verdicts if v.feasible),
+                  key=lambda v: v.step_s)
+    return feas + [v for v in verdicts if not v.feasible]
+
+
+def estimate_layout(desc: ModelDesc, layout: Layout, *,
+                    constraints: Optional[Constraints] = None,
+                    peaks: Optional[Dict[str, float]] = None
+                    ) -> _cost.CostBreakdown:
+    """Single-layout estimate with the pruner's contract: an infeasible
+    layout RAISES :class:`PlanError` naming the reason (the satellite
+    'raises/filters loudly' requirement), it never returns a price for
+    a layout that cannot exist."""
+    verdicts = prune([layout], desc, constraints=constraints,
+                     peaks=peaks)
+    v = verdicts[0]
+    if not v.feasible:
+        raise PlanError(
+            f"layout {layout.layout_id()} is infeasible: {v.reason}")
+    assert v.cost is not None
+    return v.cost
+
+
+def _measure_built(built: Built, *, force: bool = False,
+                   chain: int = 4) -> Optional[float]:
+    """On-device median step seconds of a built candidate — the
+    tune.measure pathway (policy-gated by the caller; hermetic off-TPU:
+    returns None without touching a clock unless ``force``). Each
+    sample is a ``chain``-step state-threaded run, not an isolated
+    step: sustained throughput is what a training loop pays (isolated
+    single-step timing hid ZeRO's smaller-working-set advantage on the
+    live comparison — the layouts differ exactly in what stays
+    resident between steps)."""
+    from apex_tpu.tune import measure as _measure
+    if not force and not _measure.measurable():
+        return None
+    import jax
+    fn = jax.jit(built.wrapped, donate_argnums=())
+    state = built.init_state()
+    batch = built.batch_fn(0)
+
+    def sample():
+        s = state
+        for _ in range(max(1, chain)):
+            s, _ = fn(s, batch)
+        return s
+
+    try:
+        return _measure.time_fn(sample) / max(1, chain)
+    except Exception as e:
+        warnings.warn(f"apex_tpu.plan: measuring "
+                      f"{built.layout.layout_id()} failed ({e}); "
+                      "keeping the modeled ranking for it")
+        return None
+
+
+def validate_top(verdicts: List[Verdict], adapter, desc: ModelDesc, *,
+                 constraints: Constraints,
+                 peaks: Optional[Dict[str, float]] = None,
+                 devices=None) -> Dict[str, Built]:
+    """Trace + verify (and optionally measure) the top_k feasible
+    candidates IN PLACE: each survivor's cost is re-estimated with the
+    walker's exact wire bill; a candidate the SPMD verifier flags is
+    marked infeasible with its rule ids (disqualified before emission —
+    the planner must never emit a layout the verifier rejects).
+    Returns the Built programs keyed by layout id (the emitter reuses
+    the winner's instead of re-building)."""
+    from apex_tpu.plan.emit import verify_built
+    built_map: Dict[str, Built] = {}
+    if constraints.validate == "none":
+        return built_map
+    # the same capacity prune judged feasibility against — traced rows
+    # must carry the identical hbm["capacity"] annotation the analytic
+    # rows show
+    cap = constraints.hbm_bytes
+    if cap is None and peaks is not None:
+        cap = peaks.get("hbm_bytes")
+    checked = 0
+    for v in verdicts:
+        if not v.feasible or checked >= constraints.top_k:
+            continue
+        checked += 1
+        lid = v.layout.layout_id()
+        try:
+            built = adapter.build(v.layout, devices=devices)
+        except Exception as e:
+            v.feasible = False
+            v.reason = f"build failed: {e}"
+            continue
+        findings = verify_built(built)
+        if findings:
+            v.feasible = False
+            v.lint_findings = list(findings)
+            v.reason = ("rejected by lint.spmd: "
+                        + ", ".join(sorted({f.rule_id for f in findings})))
+            continue
+        wire = _cost.traced_wire(built)
+        v.cost = _cost.estimate(desc, v.layout, peaks=peaks, wire=wire,
+                                hbm_capacity=cap)
+        built_map[lid] = built
+        if constraints.validate == "measure":
+            v.measured_s = _measure_built(
+                built, force=constraints.measure_force)
+    return built_map
+
+
+def auto(adapter, *, n_devices: Optional[int] = None,
+         constraints: Optional[Constraints] = None, devices=None,
+         write_cache: bool = True, compile_reference: bool = True):
+    """The planner entry point: describe -> enumerate -> prune -> rank
+    -> validate top_k -> emit the winner as a ready
+    :class:`~apex_tpu.plan.emit.Plan` (TrainerConfig + shard_map layout
+    + tune cache entries, lint-verified). Raises :class:`PlanError`
+    when nothing survives."""
+    import jax
+    # NOTE: the package re-exports the emit() FUNCTION under the same
+    # name as the submodule, so attribute-style module imports resolve
+    # to the function — import the names straight from the submodule
+    from apex_tpu.plan.emit import PlanRejected
+    from apex_tpu.plan.emit import emit as _emit_plan
+    from apex_tpu.plan.emit import verify_built as _verify_built
+    from apex_tpu.pyprof.roofline import device_peaks
+    constraints = constraints or Constraints()
+    if devices is None:
+        devices = list(jax.devices())
+    n = int(n_devices) if n_devices else len(devices)
+    devices = devices[:n]
+    if len(devices) < n:
+        raise PlanError(f"need {n} devices, have {len(devices)}")
+    peaks = device_peaks(devices[0])
+    cap = constraints.hbm_bytes if constraints.hbm_bytes is not None \
+        else peaks.get("hbm_bytes")
+    desc = adapter.describe(compile_reference=compile_reference)
+    cands = enumerate_candidates(n, desc, constraints)
+    verdicts = rank(prune(cands, desc, adapter=adapter,
+                          constraints=constraints, peaks=peaks))
+    built_map = validate_top(verdicts, adapter, desc,
+                             constraints=constraints, peaks=peaks,
+                             devices=devices)
+    # the pick competes in ONE currency, highest fidelity first: a
+    # MEASURED candidate outranks a traced one (the AMP arc — the
+    # analytic model shortlists, the device clock settles), a traced
+    # one outranks an analytic rival (a traced bill counts every scalar
+    # psum the closed form rounds away — comparing across the two hands
+    # sub-percent artifacts the decision). The table's rank 1 IS the
+    # pick; wire_source / measured_ms name each row's fidelity tier.
+    def _fidelity_key(v):
+        if v.measured_s is not None:
+            return (0, v.measured_s)
+        if built_map and v.layout.layout_id() in built_map:
+            return (1, v.step_s)
+        return (2, v.step_s)
+
+    feas = sorted((v for v in verdicts if v.feasible),
+                  key=_fidelity_key)
+    verdicts = feas + [v for v in verdicts if not v.feasible]
+    winners = feas
+    if not winners:
+        raise PlanError(
+            "no feasible layout survived; reasons: "
+            + "; ".join(f"{v.layout.layout_id()}: {v.reason}"
+                        for v in verdicts[:8]))
+    pick = winners[0]
+    built = built_map.get(pick.layout.layout_id())
+    if built is None:
+        built = adapter.build(pick.layout, devices=devices)
+        # the analytic tier never traced this program — verify + price
+        # it now (the emit gate would catch lint anyway; doing it here
+        # keeps ONE code path producing the emitted numbers)
+        findings = _verify_built(built)
+        if findings:
+            raise PlanRejected(pick.layout, findings)
+        # re-price with the traced bill; no re-sort — this branch is
+        # only reachable when NOTHING was traced (a traced feasible
+        # rival would be fidelity tier 1 and already outrank the
+        # untraced pick), so the pick stays at rank 1 regardless of
+        # how the traced price moves: "the table's rank 1 IS the pick"
+        # is an invariant the CI gate parses
+        pick.cost = _cost.estimate(
+            desc, pick.layout, peaks=peaks,
+            wire=_cost.traced_wire(built),
+            hbm_capacity=cap)
+    return _emit_plan(built, pick.cost, desc=desc, verdicts=verdicts,
+                      measured_s=pick.measured_s,
+                      write_cache=write_cache, preverified=True)
+
+
+# ---------------------------------------------------------------------------
+# elastic replanning seam (ROADMAP item 4 groundwork)
+# ---------------------------------------------------------------------------
+
+def replanner(adapter, *, constraints: Optional[Constraints] = None
+              ) -> Callable[[int, int], Dict[str, Any]]:
+    """A membership-change re-rank hook for
+    :class:`apex_tpu.resilience.elastic.Elastic` — EQUAL-SHARD only
+    (every surviving member gets the same shard; heterogeneity-aware
+    unequal shards are the ROADMAP item-4 follow-up this seam exists
+    for). The returned callable re-runs the ANALYTIC cost model at the
+    old and new world sizes (no tracing, no compiling — a membership
+    change must not pay a search) and returns
+    ``{"old": ..., "new": ..., "old_step_s": ..., "new_step_s": ...}``.
+    """
+    base = constraints or Constraints()
+    cons = dataclasses.replace(base, validate="none")
+    desc = adapter.describe(compile_reference=False)
+
+    def _best(world: int) -> Verdict:
+        cands = enumerate_candidates(world, desc, cons)
+        ranked = rank(prune(cands, desc, adapter=adapter,
+                            constraints=cons))
+        feas = [v for v in ranked if v.feasible]
+        if not feas:
+            raise PlanError(
+                f"replan: no feasible layout at world {world}")
+        return feas[0]
+
+    def replan(old_world: int, new_world: int) -> Dict[str, Any]:
+        old, new = _best(int(old_world)), _best(int(new_world))
+        return {"old": old.layout.layout_id(),
+                "new": new.layout.layout_id(),
+                "old_step_s": old.step_s, "new_step_s": new.step_s,
+                "equal_shard": True}
+
+    return replan

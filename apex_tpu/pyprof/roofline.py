@@ -18,8 +18,10 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, Optional
 
-__all__ = ["device_peak_bytes_per_s", "ridge_intensity", "classify",
-           "program_roofline", "PEAK_HBM_BW", "PEAK_CPU_BW_NOMINAL"]
+__all__ = ["device_peak_bytes_per_s", "device_hbm_bytes", "device_peaks",
+           "ridge_intensity", "classify", "program_roofline",
+           "PEAK_HBM_BW", "PEAK_CPU_BW_NOMINAL", "PEAK_HBM_BYTES",
+           "HBM_CPU_NOMINAL"]
 
 # Peak HBM bandwidth (bytes/s) per chip by device_kind substring — the
 # roofline's memory ceiling (companion of prof.PEAK_BF16). Override with
@@ -33,6 +35,21 @@ PEAK_HBM_BW = [
 # contemporary DDR5 host) — like prof.PEAK_CPU_NOMINAL this makes CPU
 # classification a sane relative signal for CI, not a roofline claim.
 PEAK_CPU_BW_NOMINAL = 1e11
+
+# HBM capacity (bytes) per chip by device_kind substring — the planner's
+# feasibility ceiling (apex_tpu.plan prunes layouts whose modeled
+# footprint exceeds it). Override with APEX_TPU_HBM_BYTES for new chips
+# or to model a different capacity on CPU dry runs.
+PEAK_HBM_BYTES = [
+    ("v5 lite", 16 << 30), ("v5e", 16 << 30),
+    ("v5p", 95 << 30), ("v4", 32 << 30), ("v6", 32 << 30),
+]
+
+# Nominal per-"device" capacity for the XLA CPU backend: CI runs the
+# planner's feasibility model on 8 virtual CPU devices that all share
+# host RAM, so like the CPU peak constants this is a sane relative
+# signal, not a claim (plan.Constraints.hbm_bytes overrides per call).
+HBM_CPU_NOMINAL = 16 << 30
 
 
 def device_peak_bytes_per_s(device=None) -> float:
@@ -52,6 +69,38 @@ def device_peak_bytes_per_s(device=None) -> float:
     if getattr(device, "platform", "") == "cpu":
         return PEAK_CPU_BW_NOMINAL
     return 8.19e11
+
+
+def device_hbm_bytes(device=None) -> float:
+    """HBM capacity of ``device`` (default: first local device), same
+    resolution ladder as :func:`device_peak_bytes_per_s`: known TPU
+    generations from the table, CPU nominal, ``APEX_TPU_HBM_BYTES`` env
+    override wins everywhere."""
+    import jax
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    env = os.environ.get("APEX_TPU_HBM_BYTES")
+    if env is not None:
+        return float(env)
+    for sub, cap in PEAK_HBM_BYTES:
+        if sub in kind:
+            return float(cap)
+    if getattr(device, "platform", "") == "cpu":
+        return float(HBM_CPU_NOMINAL)
+    return float(16 << 30)
+
+
+def device_peaks(device=None) -> Dict[str, float]:
+    """One dict with every hardware ceiling the planner's cost model
+    needs: ``flops`` (peak FLOP/s, :func:`~apex_tpu.pyprof.prof.
+    device_peak_flops`), ``bytes_per_s`` (peak HBM bandwidth),
+    ``hbm_bytes`` (capacity), ``ridge`` (FLOP/byte)."""
+    from apex_tpu.pyprof.prof import device_peak_flops
+    flops = device_peak_flops(device)
+    bw = device_peak_bytes_per_s(device)
+    return {"flops": flops, "bytes_per_s": bw,
+            "hbm_bytes": device_hbm_bytes(device),
+            "ridge": ridge_intensity(flops, bw)}
 
 
 def ridge_intensity(peak_flops: float, peak_bytes_per_s: float) -> float:

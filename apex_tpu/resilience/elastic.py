@@ -389,14 +389,27 @@ class Elastic:
     "generation"}`` after a restore that actually re-mapped (None
     otherwise) — the loop reads it to re-anchor
     ``trainer.notify_resume(step, world=..., from_world=...)``.
+
+    ``replan`` is the ROADMAP item-4 planner seam: a callable
+    ``(old_world, new_world) -> dict`` (see
+    :func:`apex_tpu.plan.replanner`) re-run on every membership change
+    that actually re-sharded. The old/new picks land in telemetry as a
+    ``plan/replan`` static and in ``last_replan`` — EQUAL-SHARD
+    re-ranking only for now (every member gets the same shard;
+    heterogeneity-aware unequal shards are the follow-up this seam
+    exists for). A replan failure degrades to a warning: re-planning is
+    advisory, the re-shard itself must never be blocked by it.
     """
 
     def __init__(self, optimizer: Any, params: Tree, *,
-                 verify: bool = True):
+                 verify: bool = True,
+                 replan: Optional[Any] = None):
         self.optimizer = optimizer
         self.params = params
         self.verify = verify
+        self.replan = replan
         self.last_reshard: Optional[Dict[str, Any]] = None
+        self.last_replan: Optional[Dict[str, Any]] = None
 
     def target_layout(self) -> Dict[str, Any]:
         return self.optimizer.layout_fingerprint(self.params)
@@ -420,4 +433,31 @@ class Elastic:
                     "to_world": int(target["shard_count"]),
                     "step": found.step,
                     "generation": found.generation}
+                if self.last_reshard["from_world"] \
+                        != self.last_reshard["to_world"]:
+                    self._replan(self.last_reshard["from_world"],
+                                 self.last_reshard["to_world"],
+                                 found.step)
         return found
+
+    def _replan(self, from_world: int, to_world: int, step) -> None:
+        """Re-run the planner's cost model at the new membership and
+        record the old/new pick (``plan/replan``). Advisory: failures
+        warn, they never fail the restore."""
+        if self.replan is None:
+            return
+        import warnings
+        try:
+            result = dict(self.replan(from_world, to_world))
+            replan = {"from_world": int(from_world),
+                      "to_world": int(to_world), **result}
+            new_step_s = float(result.get("new_step_s") or 0.0)
+        except Exception as e:
+            # a hook returning a non-dict is as advisory as one that
+            # raises — nothing on the replan path may block the restore
+            warnings.warn(
+                f"apex_tpu.resilience: elastic replan hook failed "
+                f"({e}); continuing with the re-sharded layout")
+            return
+        self.last_replan = replan
+        _record("plan/replan", new_step_s, step=step, meta=dict(replan))

@@ -38,12 +38,12 @@ from apex_tpu.trainer.builder import (DonationReport, Trainer,
                                       TrainerConfig, build, stack_batches)
 from apex_tpu.trainer.pipeline import InflightWindow
 from apex_tpu.trainer.plugins import (AmpPlugin, HealthPlugin,
-                                      ResumePrintPlugin, TelemetryPlugin,
-                                      TunePlugin)
+                                      PlanPlugin, ResumePrintPlugin,
+                                      TelemetryPlugin, TunePlugin)
 
 __all__ = [
     "build", "Trainer", "TrainerConfig", "DonationReport",
     "InflightWindow", "stack_batches",
     "TelemetryPlugin", "AmpPlugin", "TunePlugin", "HealthPlugin",
-    "ResumePrintPlugin",
+    "PlanPlugin", "ResumePrintPlugin",
 ]

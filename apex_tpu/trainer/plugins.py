@@ -200,6 +200,30 @@ class HealthPlugin:
                   f"({alert['detail']})", file=self._out)
 
 
+class PlanPlugin:
+    """Attribution for a planner-emitted trainer
+    (:meth:`apex_tpu.plan.Plan.build_trainer` attaches one): the chosen
+    layout + modeled step time land in the run's telemetry as a
+    ``plan/pick`` static, so any JSONL produced by a planned run names
+    the layout it executed under (and the bench's ``plan`` key can
+    join modeled vs measured without a side channel)."""
+
+    def __init__(self, plan):
+        self.plan = plan
+
+    def on_build(self, trainer) -> None:
+        from apex_tpu import telemetry
+        if not telemetry.enabled():
+            return
+        cost = self.plan.cost
+        telemetry.record_static(
+            "plan/pick", cost.step_s,
+            meta={**cost.to_meta(),
+                  "mesh": dict(self.plan.built.axis_sizes),
+                  "trainer": trainer.name},
+            dedup_key=("plan/pick", self.plan.layout_id, trainer.name))
+
+
 class ResumePrintPlugin:
     """Announce snapshot restores (what every hand loop printed)."""
 

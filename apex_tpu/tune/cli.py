@@ -124,9 +124,15 @@ def cmd_show(args) -> int:
         e = entries[key]
         if not isinstance(e, dict):
             continue
+        # planner entries carry a MODELED step time (planned_s), not a
+        # measurement — rendered in the same column, with the
+        # provenance column naming the source (docs/tune.md)
+        tuned_s = e.get("measured_s")
+        if tuned_s is None and e.get("provenance") == "planner":
+            tuned_s = e.get("planned_s")
         rows.append([key, _fmt_cfg(e.get("config", {})),
                      e.get("provenance", "?"),
-                     _fmt_s(e.get("measured_s")),
+                     _fmt_s(tuned_s),
                      _fmt_s(e.get("default_s"))])
     print(f"cache: {path}")
     print(_table(rows, ["op|key", "config", "provenance", "tuned_t",

@@ -430,6 +430,11 @@ def main():
         # deterministic re-map of this model's ZeRO state, gather-
         # verified); null when off — rows stay schema-comparable
         "elastic": None,
+        # parallelism-planner cross-check (BENCH_PLAN=1: the apex_tpu.plan
+        # cost model priced against THIS measured loop — modeled vs
+        # measured step time tracks the model's error across rounds);
+        # null when off — rows stay schema-comparable
+        "plan": None,
     }
     if trace_on:
         # the wall-vs-device gap, itemized: top host span families by
@@ -589,6 +594,74 @@ def main():
         log(f"elastic: reshard world {w_from} -> {w_to} of "
             f"{3 * 4 * src_spec['padded'] / 1e6:.1f} MB ZeRO state in "
             f"{reshard_s * 1e3:.1f} ms (gather-verified)")
+
+    # BENCH_PLAN=1: the cost-model honesty check — price the EXECUTED
+    # program (flops/bytes from the same XLA cost analysis MFU uses,
+    # wire bytes from the telemetry.comm jaxpr walker over the same
+    # single-step program) against the measured loop, and report what
+    # plan.auto would have picked at this shape. The error_pct is the
+    # number that catches silent cost-model drift across rounds.
+    if os.environ.get("BENCH_PLAN"):
+        from apex_tpu import plan as _plan
+        from apex_tpu.plan.cost import WireItem, estimate as _plan_est
+        from apex_tpu.plan.describe import (ModelDesc, tree_bytes,
+                                            tree_count)
+        from apex_tpu.pyprof import prof as _prof
+        from apex_tpu.telemetry.comm import comm_stats as _comm_stats
+        n_dev = mesh.size
+        bench_layout = _plan.Layout(
+            dp=n_dev, overlap=overlap_on,
+            reduce_dtype={"bf16": "bf16", "fp16": "fp16"}.get(
+                reduce_dtype or ""))
+        p_bench, bs_bench, _ = state
+        cost_an = _prof.analyze(step_fn, state, (x, y))  # jit-cache hit
+        desc_bench = ModelDesc(
+            name="resnet50-bench", param_count=tree_count(p_bench),
+            param_bytes=tree_bytes(p_bench),
+            flops_per_step=float(flops_per_step
+                                 or cost_an.get("flops") or 0.0),
+            bytes_per_step=float(cost_an.get("bytes_accessed") or 0.0),
+            act_bytes_per_sample=0.0,
+            opt_state_bytes=8 * tree_count(p_bench),
+            dims={"batch": batch, "image": image, "classes": 1000})
+        hide = overlap_on
+        wire_items = [
+            WireItem(r.axis, r.primitive, r.bytes_in,
+                     float(r.bytes_wire or 0.0), r.count,
+                     hideable=(hide and r.axis == "data"
+                               and r.primitive == "psum"))
+            for r in _comm_stats(step_fn, state, (x, y))]
+        est = _plan_est(desc_bench, bench_layout, wire=wire_items)
+        measured_step_s = dt / n_steps
+        pick_id = None
+        try:
+            # rank over the EXECUTED model's own description (real
+            # ResNet-50 param/flop/byte numbers from the measured
+            # program) — the ResNetAdapter is the ResNet-18 family and
+            # would price the wrong model by ~2x
+            cons = _plan.Constraints(validate="none")
+            ranked = _plan.rank(_plan.prune(
+                _plan.enumerate_candidates(n_dev, desc_bench, cons),
+                desc_bench, constraints=cons))
+            pick_id = next((v.layout.layout_id() for v in ranked
+                            if v.feasible), None)
+        except Exception as e:
+            log(f"plan: auto pick unavailable ({e})")
+        result["plan"] = {
+            "executed_layout": bench_layout.layout_id(),
+            "pick": pick_id,
+            "modeled_step_s": round(est.step_s, 6),
+            "measured_step_s": round(measured_step_s, 6),
+            "error_pct": (round(100.0 * (est.step_s - measured_step_s)
+                                / measured_step_s, 1)
+                          if measured_step_s > 0 else None),
+            "wire_bytes": round(est.wire_bytes),
+        }
+        log(f"plan: executed {bench_layout.layout_id()} modeled "
+            f"{est.step_s * 1e3:.3f} ms vs measured "
+            f"{measured_step_s * 1e3:.3f} ms "
+            f"({result['plan']['error_pct']}% error); "
+            f"auto pick at this shape: {pick_id}")
 
     print(json.dumps(result))
 

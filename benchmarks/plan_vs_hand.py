@@ -1,0 +1,185 @@
+"""Planner-vs-hand acceptance harness (ISSUE 14 / ROADMAP item 2).
+
+Measures, on the live mesh (8-device CPU in CI, real chips on TPU),
+every feasible candidate layout for >= 3 model shapes — small GPT, the
+ResNet bench shape, and a ZeRO-forced variant — and checks that the
+layout `plan.auto` picks is within --tolerance (default 5%) of the
+best measured layout. "Hand layouts" here means the full feasible set
+the dryrun families span at that shape: each is built through the same
+adapters, timed with the same loop, so the comparison is the planner's
+ranking against ground truth, not against a strawman.
+
+Usage::
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python benchmarks/plan_vs_hand.py [--steps 30] [--tolerance 5]
+
+Exit 0 when every shape's pick is within tolerance; exit 1 (with the
+full measured table printed) when any is not — no silent drift.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS", "cpu").strip().lower() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+from apex_tpu import plan
+
+
+def measure_layout(built, *, steps: int, reps: int) -> float:
+    """Median wall seconds per step of a built candidate's jitted step,
+    after warmup — the same program ``Plan.build_trainer`` compiles."""
+    fn = jax.jit(built.wrapped)
+    state = built.init_state()
+    batch = built.batch_fn(0)
+    for _ in range(3):                                   # warmup/compile
+        state, _ = fn(state, batch)
+    jax.block_until_ready(state)
+    times = []
+    for _ in range(reps):
+        state = built.init_state()
+        jax.block_until_ready((state, batch))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, _ = fn(state, batch)
+        jax.block_until_ready(state)
+        times.append((time.perf_counter() - t0) / steps)
+    return statistics.median(times)
+
+
+def run_shape(name: str, adapter, constraints, *, steps: int,
+              reps: int, tolerance_pct: float) -> dict:
+    n_dev = len(jax.devices())
+    p = plan.auto(adapter, n_devices=n_dev, constraints=constraints,
+                  write_cache=False, compile_reference=False)
+    desc = adapter.describe(compile_reference=False)
+    cands = plan.enumerate_candidates(n_dev, desc, constraints)
+    verdicts = plan.prune(cands, desc, adapter=adapter,
+                          constraints=constraints)
+    rows = []
+    for v in verdicts:
+        if not v.feasible:
+            continue
+        lid = v.layout.layout_id()
+        try:
+            built = adapter.build(v.layout)
+        except Exception as e:          # pragma: no cover - build gap
+            rows.append({"layout": lid, "error": str(e)})
+            continue
+        rows.append({"layout": lid,
+                     "modeled_ms": round(v.step_s * 1e3, 4),
+                     "measured_ms": round(
+                         measure_layout(built, steps=steps,
+                                        reps=reps) * 1e3, 4)})
+    timed = [r for r in rows if "measured_ms" in r]
+    timed.sort(key=lambda r: r["measured_ms"])
+    best = timed[0]
+    pick_row = next(r for r in timed if r["layout"] == p.layout_id)
+    gap_pct = 100.0 * (pick_row["measured_ms"] - best["measured_ms"]) \
+        / best["measured_ms"]
+    ok = gap_pct <= tolerance_pct
+    print(f"\n== {name}: pick {p.layout_id} "
+          f"measured {pick_row['measured_ms']:.3f} ms vs best "
+          f"{best['layout']} {best['measured_ms']:.3f} ms "
+          f"(gap {gap_pct:+.1f}%, tolerance {tolerance_pct:.0f}%) "
+          f"{'OK' if ok else 'FAIL'} ==")
+    for r in timed:
+        mark = " <- pick" if r["layout"] == p.layout_id else ""
+        print(f"  {r['layout']:<26}{r['measured_ms']:>10.3f} ms "
+              f"(modeled {r['modeled_ms']:.3f}){mark}")
+    return {"shape": name, "pick": p.layout_id,
+            "best": best["layout"], "gap_pct": round(gap_pct, 1),
+            "ok": ok, "table": timed}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--steps", type=int, default=30,
+                    help="steps per timing rep (default 30)")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="timing reps; median taken (default 3)")
+    ap.add_argument("--tolerance", type=float, default=5.0,
+                    help="max pick-vs-best gap percent (default 5)")
+    ap.add_argument("--json", help="also write the result JSON here")
+    ap.add_argument("--shapes", default=None,
+                    help="comma list of shape names to run (default all)")
+    args = ap.parse_args(argv)
+
+    # the knob sweep (reduce_dtype, microbatch) is the planner's
+    # refinement tier — the hand comparison is over the layout families
+    # a human actually writes, each at its plain-knob baseline. The
+    # planner runs its full AMP arc: analytic shortlist into top_k,
+    # then the measured tier settles the pick (measure_force: wall
+    # clock IS this harness's ground truth, so the hermetic-CI
+    # measurement gate is explicitly waived here and nowhere else)
+    # top_k=6: the modeled costs of these shapes' leading candidates
+    # sit within ~4% of each other — a near-tie band the analytic
+    # model genuinely cannot separate (that is WHY the measured tier
+    # exists) — so the shortlist must cover the whole band, not just
+    # the modeled top 4
+    base = plan.Constraints(reduce_dtypes=(None,), microbatches=(1,),
+                            validate="measure", measure_force=True,
+                            top_k=6)
+    shapes = [
+        ("gpt-small", plan.GPTAdapter(vocab=256, layers=2, embed=128,
+                                      heads=4, batch=16, seq=128), base),
+        ("resnet-bench", plan.ResNetAdapter(image=64, classes=1000,
+                                            batch=16), base),
+        # ZeRO-forced variant: an HBM budget that rules out replicated
+        # optimizer state — the planner must land on a zero layout and
+        # still beat/equal the hand zero layouts
+        ("gpt-zero", plan.GPTAdapter(vocab=4096, layers=4, embed=256,
+                                     heads=8, batch=16, seq=128),
+         None),  # constraints filled below (needs the desc)
+    ]
+    # size the ZeRO budget off the actual footprints: above the zero-2
+    # need, below the unsharded need
+    zdesc = shapes[2][1].describe(compile_reference=False)
+    unsharded = plan.hbm_footprint(
+        zdesc, plan.Layout(dp=8))["total"]
+    sharded = plan.hbm_footprint(
+        zdesc, plan.Layout(dp=8, zero=2))["total"]
+    budget = (unsharded + sharded) / 2.0
+    shapes[2] = (shapes[2][0], shapes[2][1],
+                 plan.Constraints(reduce_dtypes=(None,),
+                                  microbatches=(1,),
+                                  validate="measure",
+                                  measure_force=True,
+                                  top_k=4, hbm_bytes=budget))
+
+    if args.shapes:
+        want = {s.strip() for s in args.shapes.split(",")}
+        shapes = [s for s in shapes if s[0] in want]
+    results = [run_shape(n, a, c, steps=args.steps, reps=args.reps,
+                         tolerance_pct=args.tolerance)
+               for n, a, c in shapes]
+    ok = all(r["ok"] for r in results)
+    summary = {"n_devices": len(jax.devices()),
+               "platform": jax.devices()[0].platform,
+               "tolerance_pct": args.tolerance,
+               "ok": ok, "shapes": results}
+    print("\n" + json.dumps({k: v for k, v in summary.items()
+                             if k != "shapes"}))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=1)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

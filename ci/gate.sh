@@ -12,7 +12,7 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "== 1/16 package import =="
+echo "== 1/17 package import =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import apex_tpu
@@ -20,7 +20,7 @@ from apex_tpu import amp, optimizers, parallel, ops
 print('apex_tpu imports OK')
 "
 
-echo "== 2/16 native host runtime builds (g++ -O3 -shared) =="
+echo "== 2/17 native host runtime builds (g++ -O3 -shared) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 from apex_tpu import runtime
@@ -35,7 +35,7 @@ print('flatten/unflatten path OK')
 assert ok, 'host runtime failed to build — check g++ toolchain'
 "
 
-echo "== 3/16 graft entry compiles (single-device + 8-device dryrun) =="
+echo "== 3/17 graft entry compiles (single-device + 8-device dryrun) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import __graft_entry__ as ge
@@ -45,7 +45,7 @@ print('entry() compiles')
 ge.dryrun_multichip(8)
 "
 
-echo "== 4/16 package install (wheel build + clean --target install) =="
+echo "== 4/17 package install (wheel build + clean --target install) =="
 # The reference gates on Docker extension builds
 # (tests/docker_extension_builds/run.sh); the TPU analog: build the wheel
 # from pyproject.toml, install it into an empty --target dir, and import
@@ -88,7 +88,7 @@ jax.jit(step).lower(params, state).compile()
 print('installed-package train step compiles')
 ")
 
-echo "== 5/16 lint (apex_tpu.lint: trace safety / dtype policy / collectives / SPMD) =="
+echo "== 5/17 lint (apex_tpu.lint: trace safety / dtype policy / collectives / SPMD) =="
 # static gate BEFORE the test tier: AST pass over the package + graft
 # entry, jaxpr pass over the registered entry points, SPMD verifier
 # (APX2xx) over the same entries. --strict: warnings fail too (every
@@ -96,7 +96,7 @@ echo "== 5/16 lint (apex_tpu.lint: trace safety / dtype policy / collectives / S
 # see docs/lint.md). Use --format=github under CI bots.
 python -m apex_tpu.lint apex_tpu/ __graft_entry__.py --strict --spmd
 
-echo "== 6/16 spmd verifier (builtin-entry sweep + committed deadlock fixture) =="
+echo "== 6/17 spmd verifier (builtin-entry sweep + committed deadlock fixture) =="
 # the whole-program SPMD gate, at the API layer: every registered entry
 # (ddp / zero / overlap / trainer-built / fused kernels / graft) must
 # verify clean, AND the analyzer must still catch the canonical
@@ -141,7 +141,7 @@ print('static donation == runtime DonationReport '
       f'({sd.aliased}/{sd.declared} aliased)')
 "
 
-echo "== 7/16 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
+echo "== 7/17 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
 # A 3-step instrumented GPT train step on the CPU mesh must produce a
 # parseable JSONL carrying step timing, amp loss-scale/overflow, comm
 # bytes and MFU, and the summarize CLI must render it (exit 0) — the
@@ -214,7 +214,7 @@ fi
 echo "health CLI gate OK (healthy=0, injected-NaN=nonzero)"
 rm -rf "$(dirname "$HLT_FILE")"
 
-echo "== 8/16 tune smoke (sweep dry-run + auto-policy tuned train) =="
+echo "== 8/17 tune smoke (sweep dry-run + auto-policy tuned train) =="
 # The autotuner must be drivable offline (sweep plan renders, exit 0) and
 # inline: a 3-step train whose kernels resolve their configs through
 # apex_tpu.tune under APEX_TPU_TUNE=auto. On this CPU backend measurement
@@ -291,7 +291,7 @@ print(f'tune smoke OK: {len(tuned)} tune/* series, '
 " "$TUNE_DIR/tune_run.jsonl" "$TUNE_DIR/cache"
 rm -rf "$TUNE_DIR"
 
-echo "== 9/16 resilience smoke (snapshot -> injected kill -> auto-resume) =="
+echo "== 9/17 resilience smoke (snapshot -> injected kill -> auto-resume) =="
 # Kill-and-resume end to end: a 6-step train snapshotting every 2 steps is
 # SIGKILLed by the fault injector at the top of step 4 (exit 137 — an
 # abrupt death, no final snapshot), then the SAME command with --resume
@@ -348,7 +348,7 @@ python -m apex_tpu.telemetry summarize "$RES_DIR/resume.jsonl" \
     || { echo "summarize did not report the resume point" >&2; exit 1; }
 rm -rf "$RES_DIR"
 
-echo "== 10/16 overlap smoke (staged backward + bf16 wire vs fp32 baseline) =="
+echo "== 10/17 overlap smoke (staged backward + bf16 wire vs fp32 baseline) =="
 # The overlap engine end to end on the 8-device CPU mesh: a 3-step fp32
 # baseline train and the same train under --overlap --reduce-dtype bf16
 # must (a) land within 1e-2 of each other's final loss (the compression
@@ -404,7 +404,7 @@ python -m apex_tpu.telemetry summarize "$OVL_DIR/bf16.jsonl" \
     || { echo "summarize did not render overlap efficiency" >&2; exit 1; }
 rm -rf "$OVL_DIR"
 
-echo "== 11/16 profile smoke (capture -> attribution report -> compare gate) =="
+echo "== 11/17 profile smoke (capture -> attribution report -> compare gate) =="
 # The attribution profiler end to end on the CPU backend: a 3-step train
 # with --profile must produce a capture logdir whose offline report
 # parses with nonzero compute time and carries the named
@@ -465,7 +465,7 @@ fi
 echo "compare gate OK (identical=0, doctored-slower=4)"
 rm -rf "$PROF_DIR"
 
-echo "== 12/16 trace smoke (host spans -> unified timeline -> merge/stragglers) =="
+echo "== 12/17 trace smoke (host spans -> unified timeline -> merge/stragglers) =="
 # The host-tracing layer end to end: a 3-step --trace train must emit
 # parseable span/* begin/end pairs, the unified host+device timeline
 # must export as valid Chrome-trace JSON with BOTH lanes populated,
@@ -538,7 +538,7 @@ grep -q "worst: p" "$TRC_DIR/merged.txt" \
 echo "trace smoke OK (spans + timeline + reconciliation + 2-process merge)"
 rm -rf "$TRC_DIR"
 
-echo "== 13/16 trainer smoke (compiled-step builder: pipelined dispatch + donation audit) =="
+echo "== 13/17 trainer smoke (compiled-step builder: pipelined dispatch + donation audit) =="
 # The compiled trainer end to end: a 3-step train_lm built through
 # apex_tpu.trainer with telemetry+trace on must (a) emit balanced
 # span/* begin/end pairs (the in-flight window's trainer/retire spans
@@ -583,7 +583,7 @@ grep -q "donation audit: .* 0 refused" "$TRN_DIR/out.txt" \
     || { echo "train_lm did not print the donation audit" >&2; exit 1; }
 rm -rf "$TRN_DIR"
 
-echo "== 14/16 fused-kernel regression (Pallas xentropy vs unfused + epilogue/mt scopes) =="
+echo "== 14/17 fused-kernel regression (Pallas xentropy vs unfused + epilogue/mt scopes) =="
 # The fused-kernel tier end to end (docs/kernels.md): the SAME 3-step GPT
 # train profiled unfused and fused (Pallas xentropy in the loss scope)
 # must (a) surface the apex_xentropy scope in the fused breakdown,
@@ -684,7 +684,7 @@ print('conv epilogue + mt flat: parity + capture scopes OK')
 echo "fused-kernel gate OK (scopes + parity + compare exit 0)"
 rm -rf "$KRN_DIR"
 
-echo "== 15/16 elastic smoke (2-process node_loss -> re-shard resume at world 1) =="
+echo "== 15/17 elastic smoke (2-process node_loss -> re-shard resume at world 1) =="
 # Elastic membership end to end (docs/resilience.md "Elastic
 # membership"): a 2-member ZeRO fleet under the multiproc --elastic
 # supervisor loses rank 1 to an injected node_loss SIGKILL at step 3;
@@ -746,7 +746,97 @@ python -m apex_tpu.resilience inspect "$ELA_DIR/snap-r0" --check 1 \
          exit 1; }
 rm -rf "$ELA_DIR"
 
-echo "== 16/16 pytest =="
+echo "== 16/17 plan smoke (auto ranked table -> lint-clean pick -> 3-step train) =="
+# The parallelism planner end to end (docs/plan.md): `plan auto` on the
+# GPT example shape over the 8-device CPU mesh must produce a parseable
+# ranked candidate table, the top pick must pass lint.spmd clean (the
+# CLI exits 1 on a PlanRejected — every emitted layout walks through
+# that gate), and a 3-step train through the emitted TrainerConfig must
+# exit 0 with plan/* telemetry statics present in the JSONL. The tune
+# cache write is redirected so the gate never touches a developer cache.
+PLAN_DIR="$(mktemp -d)"
+APEX_TPU_TUNE_CACHE_DIR="$PLAN_DIR/tunecache" \
+python -m apex_tpu.plan auto --model gpt \
+    --vocab 128 --layers 2 --embed-dim 64 --heads 4 \
+    --batch 16 --seq-len 64 --no-compile --top-k 3 \
+    --train-steps 3 --telemetry "$PLAN_DIR/plan.jsonl" \
+    > "$PLAN_DIR/plan.out"
+python - "$PLAN_DIR" <<'PY'
+import json, re, sys
+d = sys.argv[1]
+out = open(d + "/plan.out").read()
+# parseable ranked table: a header row plus >= 3 ranked OK rows
+assert re.search(r"^rank\s+layout\s+family\s+step_ms", out, re.M), out[:400]
+ranked = re.findall(r"^(\d+)\s+(\S+)\s+\S+\s+([\d.]+)", out, re.M)
+assert len(ranked) >= 3, f"expected >=3 ranked rows, got {len(ranked)}"
+m = re.search(r"^pick: (\S+)\s+\(modeled ([\d.]+) ms/step.*lint\.spmd "
+              r"clean\)", out, re.M)
+assert m, f"no lint-clean pick line in:\n{out}"
+pick = m.group(1)
+assert pick == ranked[0][1], (pick, ranked[0])
+assert "trained 3 steps through " + pick in out, out
+# plan/* statics present in the telemetry the train wrote
+names = set()
+for line in open(d + "/plan.jsonl"):
+    names.add(json.loads(line)["name"])
+plan_names = {n for n in names if n.startswith("plan/")}
+assert "plan/pick" in plan_names and "plan/candidates" in plan_names, \
+    sorted(names)
+# the planner-resolved bucket entries landed schema-v1 with planner
+# provenance (APEX_TPU_TUNE=cache picks them up with zero re-measure)
+import glob
+caches = glob.glob(d + "/tunecache/*.json")
+assert caches, "planner wrote no tune cache"
+entries = json.load(open(caches[0]))["entries"]
+planner = {k: e for k, e in entries.items()
+           if e.get("provenance") == "planner"}
+assert planner, entries
+print(f"plan smoke OK: pick {pick}, {len(ranked)} ranked rows, "
+      f"plan statics {sorted(plan_names)}, "
+      f"{len(planner)} planner cache entrie(s)")
+PY
+# the rejection side of the gate: a deliberately rank-gated candidate
+# must be refused BEFORE emission (PlanRejected naming APX201)
+python - <<'PY'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from apex_tpu import plan
+from apex_tpu.plan.adapters import Built, _wrap
+from apex_tpu.plan.describe import ModelDesc
+from apex_tpu.plan.emit import emit as emit_fn
+from apex_tpu.parallel.mesh import named_mesh
+
+lay = plan.Layout(dp=8)
+mesh = named_mesh(lay.mesh_axes())
+def bad_step(state, batch):
+    g = state * batch.mean()
+    g = jax.lax.cond(jax.lax.axis_index('data') == 0,
+                     lambda v: jax.lax.psum(v, 'data'), lambda v: v, g)
+    return state - 0.01 * g, g.mean()
+built = Built(layout=lay, mesh=mesh, step=bad_step,
+              wrapped=_wrap(bad_step, mesh, P(), P('data')),
+              state_spec=P(), batch_spec=P('data'),
+              state_avals=jax.ShapeDtypeStruct((4096,), jnp.float32),
+              batch_avals=jax.ShapeDtypeStruct((8, 4096), jnp.float32),
+              init_state=lambda: jnp.zeros((4096,)),
+              batch_fn=lambda i: jnp.ones((8, 4096)),
+              axis_sizes={'data': 8})
+desc = ModelDesc('toy', 4096, 16384, 1e9, 1e8, 1e4, 8 * 4096,
+                 {'batch': 8})
+try:
+    emit_fn(built, plan.estimate(desc, lay), desc=desc)
+except plan.PlanRejected as e:
+    assert 'APX201' in str(e), e
+    print('plan rejection gate OK: rank-gated candidate refused '
+          '(APX201) before emission')
+else:
+    raise SystemExit('BUG: planner emitted a rank-gated layout')
+PY
+rm -rf "$PLAN_DIR"
+
+echo "== 17/17 pytest =="
 if [[ "${1:-}" == "--full" ]]; then
     # full suite + the complete L1 cross-product matrix (reference
     # tests/L1/cross_product{,_distributed}/run.sh); the convergence
@@ -763,7 +853,8 @@ else
         tests/test_resilience.py tests/test_elastic.py \
         tests/test_overlap.py \
         tests/test_trainer.py tests/test_kernels.py \
-        tests/test_pyprof.py tests/test_trace.py -q -x
+        tests/test_pyprof.py tests/test_trace.py \
+        tests/test_plan.py -q -x
 fi
 
 echo "CI GATE PASSED"

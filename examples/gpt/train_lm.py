@@ -152,6 +152,15 @@ def parse_args(argv=None):
                         "Implies telemetry; add --telemetry PATH to write "
                         "the JSONL and inspect with `python -m "
                         "apex_tpu.telemetry health PATH`")
+    p.add_argument("--plan", action="store_true",
+                   help="dry-run the automatic parallelism planner "
+                        "(apex_tpu.plan) for THIS model shape over the "
+                        "local devices: print the ranked candidate "
+                        "table (layout, modeled step ms, wire bytes, "
+                        "HBM, feasibility verdict) and the lint-"
+                        "verified pick, then exit without training. "
+                        "Train through a pick with `python -m "
+                        "apex_tpu.plan auto --train-steps N`")
     p.add_argument("--scan", type=int, default=1,
                    help=">1: dispatch-proof mode — N steps per jitted "
                         "lax.scan dispatch with on-device token "
@@ -293,6 +302,25 @@ def main(argv=None):
                   "unavailable; the in-graph health producers (grad "
                   "stats, overflow attribution) still fire",
                   file=sys.stderr)
+    if args.plan:
+        # planner dry run: rank every layout family for THIS shape on
+        # the local mesh, emit (lint-gated) the winner's table, exit —
+        # the human-facing front door to `python -m apex_tpu.plan auto`.
+        # GPTAdapter.batch is the GLOBAL batch; this script's
+        # --batch-size is PER DEVICE on the dp path (see the training
+        # loop below: batch_size * n_dev), so scale it the same way
+        from apex_tpu import plan as _plan
+        global_batch = args.batch_size if args.seq_parallel else \
+            args.batch_size * len(jax.devices())
+        p = _plan.auto(_plan.GPTAdapter(
+            vocab=args.vocab, layers=args.layers, embed=args.embed_dim,
+            heads=args.heads, batch=global_batch, seq=args.seq_len,
+            lr=args.lr), write_cache=False)
+        print(_plan.format_table(p.table))
+        print(f"\npick: {p.layout_id}  (modeled "
+              f"{p.cost.step_s * 1e3:.3f} ms/step, lint.spmd clean)")
+        print(p.explain())
+        return
     if args.generate:
         return _run_generate(args)
     n_dev = len(jax.devices())

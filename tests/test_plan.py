@@ -1,0 +1,573 @@
+"""apex_tpu.plan — the cost-model-driven parallelism planner.
+
+The load-bearing pins:
+
+  * cost-model wire bytes EQUAL hand-computed telemetry.comm numbers on
+    three known layouts (1x8 dp, dp4 x tp2, ZeRO-2) — the numbers are
+    derived from the layout spec (param counts, ring multipliers), not
+    from the walker, so a walker/planner drift cannot self-certify.
+  * infeasible candidates (HBM overflow, non-divisible axis) raise /
+    filter LOUDLY with named reasons.
+  * every emitted layout passes lint.spmd (APX201-208); a deliberately
+    rank-gated candidate raises PlanRejected BEFORE emission.
+  * the planner-emitted TrainerConfig trains 3 steps bitwise-stable on
+    the 8-device CPU mesh.
+  * planner-resolved buckets land in the tune cache schema-v1 with
+    "planner" provenance and resolve under APEX_TPU_TUNE=cache with
+    zero re-measurement.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import plan
+from apex_tpu.plan.adapters import Built, _wrap
+from apex_tpu.plan.describe import ModelDesc, tree_bytes, tree_count
+from apex_tpu.plan.emit import emit as emit_fn
+from apex_tpu.plan.layout import Layout
+
+N_DEV = 8
+
+# one small GPT workload for the whole module (builds are traced, not
+# executed, so sharing them across tests is safe)
+ADAPTER = plan.GPTAdapter(vocab=64, layers=2, embed=64, heads=4,
+                          batch=16, seq=64)
+
+
+@pytest.fixture(scope="module")
+def desc():
+    return ADAPTER.describe(compile_reference=False)
+
+
+_BUILT = {}
+
+
+def built_for(lid: str) -> Built:
+    if lid not in _BUILT:
+        _BUILT[lid] = ADAPTER.build(plan.parse_layout_id(lid))
+    return _BUILT[lid]
+
+
+def traced_est(desc, lid: str):
+    built = built_for(lid)
+    return plan.estimate(desc, built.layout,
+                         wire=plan.traced_wire(built))
+
+
+# ---------------------------------------------------------------------------
+# layout ids
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("lid", [
+    "dp8", "dp4-tp2", "dp8-zero2-mb2-bf16", "dp2-sq4", "dp2-uly4",
+    "dp1", "dp4-pp2", "dp8-noov", "dp8-zero2-fp16",
+])
+def test_layout_id_roundtrip(lid):
+    assert plan.parse_layout_id(lid).layout_id() == lid
+
+
+def test_layout_id_parse_rejects_garbage():
+    with pytest.raises(ValueError, match="grammar"):
+        plan.parse_layout_id("tp4-dp2")
+
+
+@pytest.mark.parametrize("kw,match", [
+    (dict(zero=2, dp=1), "requires dp >= 2"),
+    (dict(zero=2, dp=2, tp=2), "not a supported composition"),
+    (dict(dp=2, tp=2, seq=2), "two axes at once"),
+    (dict(reduce_dtype="int8"), "reduce_dtype"),
+    (dict(zero=3, dp=2), "stages the toolkit implements"),
+    (dict(ddp_bucket=0, dp=2), "positive element count"),
+])
+def test_layout_validate_loud(kw, match):
+    with pytest.raises(ValueError, match=match):
+        Layout(**kw)
+
+
+# ---------------------------------------------------------------------------
+# wire bytes pinned to hand-computed telemetry.comm numbers
+# ---------------------------------------------------------------------------
+
+def test_wire_bytes_dp8_hand_computed(desc):
+    """1x8 dp: one bucketed fp32 grad psum (4P bytes) + the scalar loss
+    pmean; wire = 2(n-1)/n x bytes_in (ring all-reduce)."""
+    est = traced_est(desc, "dp8")
+    p_count = tree_count(ADAPTER._dense_params_sds())
+    bytes_in = 4 * (p_count + 1)           # grads + loss scalar
+    expect = bytes_in * 2 * (N_DEV - 1) / N_DEV
+    assert est.wire_bytes == pytest.approx(expect, rel=1e-9)
+    assert est.wire_source == "traced"
+
+
+def test_wire_bytes_zero2_hand_computed(desc):
+    """ZeRO-2 over 8: reduce_scatter of the flat fp32 grads
+    ((n-1)/n x 4P) + all_gather of each updated shard ((n-1) x 4P/n)
+    + the scalar loss pmean. P divides 8 here, so no chunk padding."""
+    p_count = tree_count(ADAPTER._dense_params_sds())
+    assert p_count % N_DEV == 0
+    est = traced_est(desc, "dp8-zero2")
+    rs = 4 * p_count * (N_DEV - 1) / N_DEV
+    ag = (4 * p_count / N_DEV) * (N_DEV - 1)
+    loss = 4 * 2 * (N_DEV - 1) / N_DEV
+    assert est.wire_bytes == pytest.approx(rs + ag + loss, rel=1e-9)
+
+
+def test_wire_bytes_dp4_tp2_hand_computed(desc):
+    """dp4 x tp2: 4 activation psums per block over the model axis at
+    2(n-1)/n = 1.0, plus the dp psum of the LOCAL (tp-sharded) tree.
+    The local element count is derived from the tp pspecs — the layout
+    spec, not the walker."""
+    from apex_tpu.parallel import lm_tp_pspecs, tp_shard_lm_params
+    est = traced_est(desc, "dp4-tp2")
+    params = ADAPTER._dense_params()
+    sharded = tp_shard_lm_params(params, 2)
+    specs = lm_tp_pspecs(sharded)
+    local = 0
+    for leaf, spec in zip(jax.tree_util.tree_leaves(sharded),
+                          jax.tree_util.tree_leaves(
+                              specs, is_leaf=lambda x: isinstance(x, P))):
+        shard = 2 if any(ax == "model" for ax in spec) else 1
+        local += int(np.prod(leaf.shape)) // shard
+    dp_in = 4 * (local + 1)                # local grads + loss scalar
+    dp_wire = dp_in * 2 * (4 - 1) / 4
+    b_loc, s, e = ADAPTER.batch // 4, ADAPTER.seq, ADAPTER.embed
+    tp_wire = (4 * ADAPTER.layers) * (b_loc * s * e * 4) \
+        * 2 * (2 - 1) / 2
+    assert est.wire_bytes == pytest.approx(dp_wire + tp_wire, rel=1e-9)
+
+
+@pytest.mark.parametrize("lid", [
+    "dp8", "dp8-bf16", "dp8-zero2", "dp4-tp2", "dp4-sq2", "dp2-uly4",
+    "dp2-sq4",
+])
+def test_analytic_bill_matches_walker(desc, lid):
+    """The closed-form bill the full candidate space is ranked with
+    stays within 0.5% of the walker's traced bill for every family —
+    no silent cost-model drift (the drift itself is reported)."""
+    est = traced_est(desc, lid)
+    assert est.wire_drift_pct is not None
+    assert abs(est.wire_drift_pct) < 0.5, (lid, est.wire_drift_pct)
+
+
+# ---------------------------------------------------------------------------
+# pruning: loud infeasibility
+# ---------------------------------------------------------------------------
+
+def test_prune_non_divisible_axis_filters_with_reason(desc):
+    verdicts = plan.prune([Layout(dp=1, tp=8)], desc, adapter=ADAPTER)
+    assert not verdicts[0].feasible
+    assert "heads 4 not divisible by tp=8" in verdicts[0].reason
+
+
+def test_estimate_layout_raises_on_infeasible(desc):
+    with pytest.raises(plan.PlanError, match="not divisible"):
+        plan.estimate_layout(desc, Layout(dp=1, seq=8,
+                                          seq_impl="ulysses"))
+
+
+def test_prune_hbm_overflow_filters_with_reason(desc):
+    cons = plan.Constraints(hbm_bytes=1024.0)     # 1 KiB: nothing fits
+    verdicts = plan.prune([Layout(dp=N_DEV)], desc, adapter=ADAPTER,
+                          constraints=cons)
+    assert not verdicts[0].feasible
+    assert "HBM overflow" in verdicts[0].reason
+    with pytest.raises(plan.PlanError, match="HBM overflow"):
+        plan.estimate_layout(desc, Layout(dp=N_DEV), constraints=cons)
+
+
+def test_auto_raises_when_nothing_survives():
+    with pytest.raises(plan.PlanError, match="no feasible layout"):
+        plan.auto(ADAPTER,
+                  constraints=plan.Constraints(hbm_bytes=1024.0),
+                  write_cache=False, compile_reference=False)
+
+
+def test_adapter_veto_named_reasons():
+    assert "pipeline" in ADAPTER.veto(Layout(dp=4, pp=2))
+    assert "DDP bucketed-allreduce" in ADAPTER.veto(
+        Layout(dp=4, tp=2, reduce_dtype="bf16"))
+    res = plan.ResNetAdapter(batch=16)
+    assert "dp/zero layouts only" in res.veto(Layout(dp=4, tp=2))
+
+
+def test_hbm_footprint_zero_shards_optimizer(desc):
+    full = plan.hbm_footprint(desc, Layout(dp=N_DEV))
+    z = plan.hbm_footprint(desc, Layout(dp=N_DEV, zero=2))
+    # 8 bytes/param replicated Adam vs 12/dp sharded master+moments
+    assert full["opt"] == 8.0 * desc.param_count
+    assert z["opt"] == 12.0 * desc.param_count / N_DEV
+    assert z["total"] < full["total"]
+
+
+def test_no_overlap_credit_off_pure_dp(desc):
+    """tp/seq builders sync grads with a PLAIN post-backward pmean (no
+    staged seam — the adapters' APX206 note), so the cost model must
+    not grant their dp collective the staged-backward overlap credit;
+    pure dp keeps it. Pinned on both the analytic and traced tiers."""
+    for lid in ("dp4-tp2", "dp2-uly4"):
+        for est in (plan.estimate(desc, plan.parse_layout_id(lid)),
+                    traced_est(desc, lid)):
+            assert not any(w.hideable for w in est.wire), (lid, est.wire)
+            assert est.hidden_s == 0.0
+    assert any(w.hideable for w in
+               plan.estimate(desc, plan.parse_layout_id("dp8")).wire)
+
+
+# ---------------------------------------------------------------------------
+# emission: lint gate
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("lid", ["dp8", "dp8-zero2", "dp4-tp2",
+                                 "dp4-sq2", "dp2-uly4"])
+def test_emitted_layouts_lint_spmd_clean(lid):
+    assert plan.verify_built(built_for(lid)) == []
+
+
+def test_verify_built_zero_apx204_threshold_is_state_bound(monkeypatch):
+    """ZeRO candidates verify with APX204's replication threshold
+    raised to the state's own size: the bucketed param all_gathers are
+    the zero-2 DESIGN (at real model sizes they cross the default
+    1 MiB and disqualified every zero candidate — caught live on the
+    resnet-bench comparison), while an activation-sized accidental
+    gather still dwarfs the state and fires. Non-zero layouts keep the
+    rule's own default."""
+    from apex_tpu import lint
+    from apex_tpu.lint.spmd_checks import replication_threshold_bytes
+    from apex_tpu.plan.describe import tree_bytes
+    seen = {}
+
+    def fake(fn, args, **kw):
+        seen.update(kw)
+        return []
+
+    monkeypatch.setattr(lint, "check_entry_spmd", fake)
+    built = built_for("dp8-zero2")
+    plan.verify_built(built)
+    assert seen["threshold_bytes"] == max(
+        replication_threshold_bytes(),
+        int(tree_bytes(built.state_avals)) + 1)
+    seen.clear()
+    plan.verify_built(built_for("dp8"))
+    assert seen["threshold_bytes"] is None
+
+
+def _rank_gated_built():
+    lay = Layout(dp=N_DEV)
+    from apex_tpu.parallel.mesh import named_mesh
+    mesh = named_mesh(lay.mesh_axes())
+
+    def bad_step(state, batch):
+        g = state * batch.mean()
+        g = jax.lax.cond(jax.lax.axis_index("data") == 0,
+                         lambda v: jax.lax.psum(v, "data"),
+                         lambda v: v, g)
+        return state - 0.01 * g, g.mean()
+
+    return Built(
+        layout=lay, mesh=mesh, step=bad_step,
+        wrapped=_wrap(bad_step, mesh, P(), P("data")),
+        state_spec=P(), batch_spec=P("data"),
+        state_avals=jax.ShapeDtypeStruct((4096,), jnp.float32),
+        batch_avals=jax.ShapeDtypeStruct((N_DEV, 4096), jnp.float32),
+        init_state=lambda: jnp.zeros((4096,)),
+        batch_fn=lambda i: jnp.ones((N_DEV, 4096)),
+        axis_sizes={"data": N_DEV})
+
+
+def test_rank_gated_candidate_rejected_before_emission(desc):
+    """The acceptance pin: a deliberately rank-gated collective (the
+    APX201 multi-host deadlock) must raise PlanRejected from emit —
+    the planner never emits a layout the verifier rejects."""
+    built = _rank_gated_built()
+    findings = plan.verify_built(built)
+    assert {f.rule_id for f in findings} == {"APX201"}
+    toy = ModelDesc("toy", 4096, 16384, 1e9, 1e8, 1e4, 8 * 4096,
+                    {"batch": N_DEV})
+    with pytest.raises(plan.PlanRejected, match="APX201"):
+        emit_fn(built, plan.estimate(toy, built.layout), desc=toy)
+
+
+# ---------------------------------------------------------------------------
+# auto end to end
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def auto_plan(tmp_path_factory):
+    cache_dir = tmp_path_factory.mktemp("tunecache")
+    old = os.environ.get("APEX_TPU_TUNE_CACHE_DIR")
+    os.environ["APEX_TPU_TUNE_CACHE_DIR"] = str(cache_dir)
+    try:
+        p = plan.auto(ADAPTER,
+                      constraints=plan.Constraints(validate="trace",
+                                                   top_k=2),
+                      write_cache=True, compile_reference=False)
+    finally:
+        if old is None:
+            os.environ.pop("APEX_TPU_TUNE_CACHE_DIR", None)
+        else:
+            os.environ["APEX_TPU_TUNE_CACHE_DIR"] = old
+    return p, cache_dir
+
+
+def test_auto_pick_is_traced_and_clean(auto_plan):
+    p, _ = auto_plan
+    assert p.cost.wire_source == "traced"
+    assert plan.verify_built(p.built) == []
+    feasible = [r for r in p.table if r["feasible"]]
+    infeasible = [r for r in p.table if not r["feasible"]]
+    assert feasible and infeasible            # both fates in the table
+    assert p.layout_id == feasible[0]["layout"]
+    # parseable table render
+    text = plan.format_table(p.table)
+    assert text.splitlines()[0].startswith("rank")
+    assert "infeasible:" in text
+    # explain names the terms
+    exp = p.explain()
+    assert "compute floor" in exp and "exposed comm" in exp
+
+
+def test_auto_trains_3_steps_bitwise_stable(auto_plan):
+    """Two independent 3-step runs through the planner-emitted
+    TrainerConfig produce bit-identical final states (the emitted
+    package is deterministic end to end on the 8-device CPU mesh)."""
+    p, _ = auto_plan
+
+    def run():
+        tr = p.build_trainer()
+        state = tr.run(p.init_state(), p.batch_fn, 3)
+        jax.block_until_ready(state)
+        return state
+
+    a, b = run(), run()
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_auto_plan_telemetry_statics(auto_plan):
+    from apex_tpu import telemetry
+    p, _ = auto_plan
+    with telemetry.capture() as col:
+        tr = p.build_trainer()
+        state = tr.run(p.init_state(), p.batch_fn, 1)
+        jax.block_until_ready(state)
+        events = col.drain()
+    picks = [e for e in events if e.name == "plan/pick"]
+    assert picks, [e.name for e in events]
+    meta = picks[-1].meta
+    assert meta["layout"] == p.layout_id
+    assert meta["step_s"] == pytest.approx(p.cost.step_s)
+
+
+def test_cache_entries_planner_provenance(auto_plan):
+    """Schema-v1 cache file, 'planner' provenance, and zero-re-measure
+    resolution under APEX_TPU_TUNE=cache with the exact runtime key."""
+    from apex_tpu.tune import cache as _cache, tuner
+    p, cache_dir = auto_plan
+    assert p.cache_entries and p.cache_written == len(p.cache_entries)
+    files = list(cache_dir.glob("*.json"))
+    assert len(files) == 1
+    data = json.loads(files[0].read_text())
+    assert data["version"] == _cache.SCHEMA_VERSION
+    for e in p.cache_entries:
+        stored = data["entries"][e["cache_key"]]
+        assert stored["provenance"] == "planner"
+        assert stored["config"] == e["entry"]["config"]
+        assert stored["planned_s"] == pytest.approx(p.cost.step_s)
+    # runtime resolution: cache policy returns the planner config with
+    # its provenance, without measuring anything
+    old_dir = os.environ.get("APEX_TPU_TUNE_CACHE_DIR")
+    os.environ["APEX_TPU_TUNE_CACHE_DIR"] = str(cache_dir)
+    tuner.reset()
+    tuner.set_policy("cache")
+    try:
+        e = p.cache_entries[0]
+        cfg, prov = tuner.resolve(e["op"], e["key"])
+        assert prov == "planner"
+        assert cfg == e["entry"]["config"]
+    finally:
+        tuner.set_policy(None)
+        tuner.reset()
+        if old_dir is None:
+            os.environ.pop("APEX_TPU_TUNE_CACHE_DIR", None)
+        else:
+            os.environ["APEX_TPU_TUNE_CACHE_DIR"] = old_dir
+
+
+def test_measured_tier_settles_the_pick(desc, monkeypatch):
+    """validate="measure": measured candidates rank by MEASURED step
+    time ahead of every unmeasured rival — the AMP arc: the analytic
+    model shortlists the top_k, the device clock settles the pick.
+    Deterministic here: the 'clock' is a canned table that inverts the
+    modeled order (CI never times a wall clock)."""
+    from apex_tpu.plan import search as _search
+    cons = plan.Constraints(validate="measure", measure_force=True,
+                            top_k=2, reduce_dtypes=(None,),
+                            microbatches=(1,))
+    ranked = plan.rank(plan.prune(
+        plan.enumerate_candidates(N_DEV, desc, cons), desc,
+        adapter=ADAPTER, constraints=cons))
+    top2 = [v.layout.layout_id() for v in ranked if v.feasible][:2]
+    times = {top2[0]: 2.0, top2[1]: 1.0}   # modeled runner-up measures 2x faster
+    monkeypatch.setattr(
+        _search, "_measure_built",
+        lambda built, force=False: times[built.layout.layout_id()])
+    p = plan.auto(ADAPTER, constraints=cons, write_cache=False,
+                  compile_reference=False)
+    assert p.layout_id == top2[1]
+    assert p.measured_s == 1.0
+    row = next(r for r in p.table if r["layout"] == top2[1])
+    assert row["measured_ms"] == pytest.approx(1000.0)
+    # without the measured tier the modeled leader would have won
+    assert top2[0] != p.layout_id
+
+
+# ---------------------------------------------------------------------------
+# elastic replanning seam
+# ---------------------------------------------------------------------------
+
+def test_replanner_equal_shard_rerank():
+    rp = plan.replanner(ADAPTER)
+    out = rp(8, 4)
+    assert out["equal_shard"] is True
+    assert out["old"].startswith("dp8") or "8" in out["old"]
+    assert plan.parse_layout_id(out["new"]).world == 4
+    assert out["new_step_s"] > 0
+
+
+def test_elastic_replan_emits_telemetry():
+    """Elastic(replan=) logs the plan/replan static with the old/new
+    pick on a membership change (exercised via the seam directly — the
+    full snapshot round trip is tests/test_elastic.py's job)."""
+    from apex_tpu import telemetry
+    from apex_tpu.resilience.elastic import Elastic
+
+    calls = []
+
+    def fake_replan(old_world, new_world):
+        calls.append((old_world, new_world))
+        return {"old": f"dp{old_world}-zero2", "new":
+                f"dp{new_world}-zero2", "old_step_s": 2e-3,
+                "new_step_s": 3e-3, "equal_shard": True}
+
+    ela = Elastic(optimizer=None, params=None, replan=fake_replan)
+    with telemetry.capture() as col:
+        ela._replan(2, 1, step=5)
+        events = col.drain()
+    assert calls == [(2, 1)]
+    assert ela.last_replan["new"] == "dp1-zero2"
+    reps = [e for e in events if e.name == "plan/replan"]
+    assert len(reps) == 1
+    assert reps[0].meta["from_world"] == 2
+    assert reps[0].meta["to_world"] == 1
+    assert reps[0].meta["old"] == "dp2-zero2"
+
+
+def test_elastic_replan_failure_degrades_to_warning():
+    from apex_tpu.resilience.elastic import Elastic
+
+    def broken(old, new):
+        raise RuntimeError("boom")
+
+    ela = Elastic(optimizer=None, params=None, replan=broken)
+    with pytest.warns(UserWarning, match="replan hook failed"):
+        ela._replan(2, 1, step=0)
+    assert ela.last_replan is None
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _cli(argv):
+    from apex_tpu.plan.cli import main
+    return main(argv)
+
+
+GPT_ARGS = ["--vocab", "64", "--layers", "2", "--embed-dim", "64",
+            "--heads", "4", "--batch", "16", "--seq-len", "64",
+            "--no-compile"]
+
+
+def test_cli_auto_table(capsys):
+    rc = _cli(["auto", *GPT_ARGS, "--top-k", "1", "--no-cache"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out.splitlines()[0].startswith("rank")
+    assert "pick: " in out and "lint.spmd clean" in out
+
+
+def test_cli_auto_json(capsys):
+    rc = _cli(["auto", *GPT_ARGS, "--top-k", "1", "--no-cache",
+               "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["pick"]["id"] == doc["table"][0]["layout"]
+    assert doc["wire_source"] == "traced"
+
+
+def test_cli_explain(capsys):
+    rc = _cli(["explain", "dp8-zero2", *GPT_ARGS])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "compute floor" in out and "reduce_scatter" in out
+
+
+def test_cli_explain_infeasible_loud(capsys):
+    rc = _cli(["explain", "dp1-tp8", *GPT_ARGS])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "not divisible" in err
+
+
+def test_cli_explain_bad_id_usage(capsys):
+    rc = _cli(["explain", "nonsense!!", *GPT_ARGS])
+    assert rc == 2
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def test_named_mesh_drops_unit_axes_and_validates():
+    from apex_tpu.parallel.mesh import named_mesh
+    m = named_mesh([("data", 4), ("pipe", 1), ("model", 2)])
+    assert m.axis_names == ("data", "model")
+    assert m.devices.shape == (4, 2)
+    with pytest.raises(ValueError, match="needs"):
+        named_mesh([("data", 16)])
+    with pytest.raises(ValueError, match="duplicate"):
+        named_mesh([("data", 2), ("data", 2)])
+
+
+def test_device_peaks_table():
+    from apex_tpu.pyprof.roofline import device_hbm_bytes, device_peaks
+    peaks = device_peaks()
+    assert set(peaks) == {"flops", "bytes_per_s", "hbm_bytes", "ridge"}
+    assert peaks["hbm_bytes"] > 0
+    old = os.environ.get("APEX_TPU_HBM_BYTES")
+    os.environ["APEX_TPU_HBM_BYTES"] = "12345"
+    try:
+        assert device_hbm_bytes() == 12345.0
+    finally:
+        if old is None:
+            os.environ.pop("APEX_TPU_HBM_BYTES", None)
+        else:
+            os.environ["APEX_TPU_HBM_BYTES"] = old
+
+
+def test_resolve_buckets_sane_range(desc):
+    from apex_tpu.plan.search import resolve_buckets
+    lay = resolve_buckets(desc, Layout(dp=8))
+    assert lay.ddp_bucket is not None
+    assert 1 << 20 <= lay.ddp_bucket <= 1 << 25
+    # tp layouts sync with plain collectives: no bucket resolved
+    assert resolve_buckets(desc, Layout(dp=4, tp=2)).ddp_bucket is None
+    z = resolve_buckets(desc, Layout(dp=8, zero=2))
+    assert z.zero_chunk is not None and z.ddp_bucket is None
