@@ -316,11 +316,19 @@ class _ZeroBase(FusedOptimizer):
         field. Shared by :meth:`check_layout` and the resilience
         manifest validation (``resilience.SnapshotManager`` stores
         :meth:`layout_fingerprint` under the manifest's ``layout`` key
-        and refuses to restore across a mismatch)."""
+        and refuses to restore across a mismatch). Keys present ONLY in
+        the saved fingerprint mismatch too: a WEIGHTED snapshot
+        (``weights`` key, apex_tpu.resilience.rebalance) restored by an
+        equal-shard optimizer would otherwise pass every current-key
+        compare and load member-scrambled state."""
         current = self.layout_fingerprint(params)
         saved = saved if isinstance(saved, dict) else {}
-        return {k: (saved.get(k), v) for k, v in current.items()
-                if saved.get(k) != v}
+        out = {k: (saved.get(k), v) for k, v in current.items()
+               if saved.get(k) != v}
+        for k, v in saved.items():
+            if k not in current:
+                out[k] = (v, None)
+        return out
 
     def check_layout(self, saved: dict, params: Tree) -> None:
         """Raise if a restored ZeroState's recorded layout differs from

@@ -105,6 +105,13 @@ class Rendezvous:
     ``0..W'-1``. :meth:`wait_world` is the join barrier: block until the
     expected member count is present (mesh formation at the NEW size).
 
+    The heartbeat also carries the member's CAPABILITY/HEALTH PROFILE
+    (``heartbeat(profile={...})`` — declared peak FLOPs + measured step
+    rate, see :class:`apex_tpu.resilience.rebalance.MemberProfile`);
+    :meth:`profiles` reads every live member's latest published profile,
+    which is how the degradation supervisor sees the whole fleet's rates
+    without any extra channel.
+
     The registry is advisory bookkeeping, not a lock service: the
     supervisor owns authoritative membership (it holds the child
     handles); members use the registry to observe the agreed world and
@@ -116,31 +123,61 @@ class Rendezvous:
         self.directory = str(directory)
         self.member = None if member is None else str(member)
         self.ttl_s = float(ttl_s)
+        self._profile: Optional[Dict] = None
 
     def _path(self, member: str) -> str:
         return os.path.join(self.directory, f"member_{member}")
 
-    def announce(self) -> None:
-        """Publish (or refresh) this member's registration atomically."""
+    def announce(self, profile: Optional[Dict] = None) -> None:
+        """Publish (or refresh) this member's registration atomically;
+        ``profile`` (JSON-able) rides the member file and sticks for
+        subsequent profile-less announces/heartbeats."""
         if self.member is None:
             raise ValueError("announce() needs a member id")
+        if profile is not None:
+            self._profile = dict(profile)
         os.makedirs(self.directory, exist_ok=True)
         tmp = self._path(self.member) + f".tmp.{os.getpid()}"
+        payload = {"member": self.member, "pid": os.getpid(),
+                   "ts": time.time()}
+        if self._profile is not None:
+            payload["profile"] = self._profile
         with open(tmp, "w") as f:
-            json.dump({"member": self.member, "pid": os.getpid(),
-                       "ts": time.time()}, f)
+            json.dump(payload, f)
         os.replace(tmp, self._path(self.member))
 
-    def heartbeat(self) -> None:
+    def heartbeat(self, profile: Optional[Dict] = None) -> None:
         """Refresh liveness; re-announces if the registration vanished
         (a cleaned-up rendezvous dir must not ghost a live member).
-        No-op in observer mode (``member=None``), like :meth:`leave`."""
+        With ``profile=`` the member file is re-published atomically so
+        the fleet sees the updated measurement; without it only the
+        mtime moves (the existing cheap path). No-op in observer mode
+        (``member=None``), like :meth:`leave`."""
         if self.member is None:
+            return
+        if profile is not None:
+            self.announce(profile=profile)
             return
         try:
             os.utime(self._path(self.member))
         except OSError:
             self.announce()
+
+    def profiles(self) -> Dict[str, Dict]:
+        """``{member: profile}`` for every LIVE member (fresh heartbeat),
+        ``{}`` for members that never published one. Unparseable files
+        (a write raced the read) are skipped — the next heartbeat
+        republishes."""
+        out: Dict[str, Dict] = {}
+        for m in self.members():
+            try:
+                with open(self._path(m)) as f:
+                    payload = json.load(f)
+            except (OSError, ValueError):
+                continue
+            prof = payload.get("profile")
+            out[m] = dict(prof) if isinstance(prof, dict) else {}
+        return out
 
     def leave(self) -> None:
         """Cooperative departure (the exit-75 path): drop the

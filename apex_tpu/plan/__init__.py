@@ -31,8 +31,10 @@ CLI: ``python -m apex_tpu.plan auto|explain`` (docs/plan.md).
 
 from apex_tpu.plan.adapters import (ADAPTERS, Built, GPTAdapter,
                                     ResNetAdapter, get_adapter)
-from apex_tpu.plan.cost import (CostBreakdown, WireItem, analytic_wire,
-                                estimate, hbm_footprint, traced_wire)
+from apex_tpu.plan.cost import (CostBreakdown, HeteroCost, WireItem,
+                                analytic_wire, estimate, hbm_footprint,
+                                heterogeneous_step_s, member_speeds,
+                                optimal_weights, traced_wire)
 from apex_tpu.plan.describe import ModelDesc
 from apex_tpu.plan.emit import Plan, PlanRejected, emit, format_table, \
     verify_built
@@ -46,7 +48,8 @@ __all__ = [
     "prune", "rank", "replanner", "analytic_wire", "traced_wire",
     "hbm_footprint", "emit", "verify_built", "format_table",
     "Layout", "parse_layout_id", "Constraints", "Verdict", "Plan",
-    "PlanError", "PlanRejected", "CostBreakdown", "WireItem",
-    "ModelDesc", "Built", "GPTAdapter", "ResNetAdapter", "get_adapter",
-    "ADAPTERS",
+    "PlanError", "PlanRejected", "CostBreakdown", "HeteroCost",
+    "WireItem", "heterogeneous_step_s", "member_speeds",
+    "optimal_weights", "ModelDesc", "Built", "GPTAdapter",
+    "ResNetAdapter", "get_adapter", "ADAPTERS",
 ]

@@ -12,6 +12,14 @@ The built step follows the PR 9 trainer convention — ``(state, batch) ->
 ``Plan.build_trainer`` can hand it straight to ``trainer.build`` and the
 3-step CI train is the same code path a user gets.
 
+``build`` itself touches ONLY avals (``jax.eval_shape`` over the model
+init): the planner traces/verifies every top_k candidate, and at real
+sizes a concrete seeded param init per candidate is real memory + time
+the search never uses. Concrete materialization is deferred to
+``Built.init_state`` — the winner's, called once by
+``Plan.build_trainer`` — which also makes every ``init_state()`` call
+donation-safe by construction (fresh buffers each time).
+
 Supported families (the ones the multichip dryrun proves AND the step
 builder can emit end to end):
 
@@ -49,20 +57,6 @@ Tree = Any
 # remat (qkv, attn out, 2 LN, 2 residual, mlp hidden at ratio 4 counts
 # as 4, gelu). An estimate for HBM feasibility, not a compiled claim.
 GPT_ACT_FACTOR = 14
-
-
-def _tree_sds(tree: Tree) -> Tree:
-    return jax.tree_util.tree_map(
-        lambda l: jax.ShapeDtypeStruct(jnp.shape(l), jnp.result_type(l)),
-        tree)
-
-
-def _fresh(tree: Tree) -> Tree:
-    """A new-buffer copy of every leaf: ``Built.init_state`` hands its
-    result to a DONATING trainer, so returning the closure's own arrays
-    would leave the second ``init_state()`` call holding deleted
-    buffers."""
-    return jax.tree_util.tree_map(jnp.array, tree)
 
 
 @dataclasses.dataclass
@@ -325,16 +319,21 @@ class GPTAdapter:
                 new_p, new_o = opt.step(grads, params, opt_state)
             return (new_p, new_o), jax.lax.pmean(loss, "data")
 
-        params = self._dense_params()
+        # avals only — build() is called for every top_k candidate; the
+        # concrete (seeded) param init is DEFERRED to the winner's
+        # init_state (ROADMAP item 2: the trace tier must not pay
+        # top_k full param inits it never uses)
+        params_sds = self._dense_params_sds()
         if layout.zero:
             state_spec = (P(), opt.state_pspec())
         else:
-            state_spec = (P(), type(jax.eval_shape(opt.init, params))(
+            state_spec = (P(), type(jax.eval_shape(
+                opt.init, params_sds))(
                 step=P(), exp_avg=P(), exp_avg_sq=P()))
         batch_spec = P("data")
 
         def init_state():
-            p = _fresh(params)
+            p = self._dense_params()   # fresh buffers every call
             opt_state = opt.init(p)
             if layout.zero:
                 opt_state = jax.device_put(
@@ -343,7 +342,7 @@ class GPTAdapter:
                         opt.state_pspec()))
             return (p, opt_state)
 
-        st_avals = (_tree_sds(params), jax.eval_shape(opt.init, params))
+        st_avals = (params_sds, jax.eval_shape(opt.init, params_sds))
         toks_shape = (self.batch, self.seq)
         batch_avals = jax.ShapeDtypeStruct(toks_shape, jnp.int32)
         return Built(
@@ -368,11 +367,13 @@ class GPTAdapter:
                             tensor_parallel_size=tp)
         opt = optimizers.FusedAdam(lr=self.lr)
 
-        params = tp_shard_lm_params(self._dense_params(), tp)
-        tp_specs = lm_tp_pspecs(params)
-        st = opt.init(params)
-        st_specs = type(st)(step=P(), exp_avg=tp_specs,
-                            exp_avg_sq=tp_specs)
+        # avals only (winner's init_state materializes — see _build_dp)
+        params_sds = jax.eval_shape(
+            lambda: tp_shard_lm_params(self._dense_params(), tp))
+        tp_specs = lm_tp_pspecs(params_sds)
+        st_sds = jax.eval_shape(opt.init, params_sds)
+        st_specs = type(st_sds)(step=P(), exp_avg=tp_specs,
+                                exp_avg_sq=tp_specs)
         state_spec = (tp_specs, st_specs)
         batch_spec = P("data") if layout.dp > 1 else P()
 
@@ -398,7 +399,8 @@ class GPTAdapter:
 
         def init_state():
             sharded = jax.device_put(
-                _fresh(params), jax.tree_util.tree_map(
+                tp_shard_lm_params(self._dense_params(), tp),
+                jax.tree_util.tree_map(
                     lambda sp: NamedSharding(mesh, sp), tp_specs))
             return (sharded, opt.init(sharded))
 
@@ -407,7 +409,7 @@ class GPTAdapter:
             layout=layout, mesh=mesh, step=step,
             wrapped=_wrap(step, mesh, state_spec, batch_spec),
             state_spec=state_spec, batch_spec=batch_spec,
-            state_avals=(_tree_sds(params), _tree_sds(st)),
+            state_avals=(params_sds, st_sds),
             batch_avals=jax.ShapeDtypeStruct(toks_shape, jnp.int32),
             init_state=init_state, batch_fn=self._batch_fn(toks_shape),
             axis_sizes=axis_sizes)
@@ -447,15 +449,16 @@ class GPTAdapter:
                 loss = jax.lax.pmean(loss, "data")
             return (new_p, new_o), loss
 
-        params = self._dense_params()
-        st = opt.init(params)
-        state_spec = (P(), type(st)(step=P(), exp_avg=P(),
-                                    exp_avg_sq=P()))
+        # avals only (winner's init_state materializes — see _build_dp)
+        params_sds = self._dense_params_sds()
+        st_sds = jax.eval_shape(opt.init, params_sds)
+        state_spec = (P(), type(st_sds)(step=P(), exp_avg=P(),
+                                        exp_avg_sq=P()))
         batch_spec = (P("data", "seq") if layout.dp > 1
                       else P(None, "seq"))
 
         def init_state():
-            p = _fresh(params)
+            p = self._dense_params()
             return (p, opt.init(p))
 
         toks_shape = (self.batch, self.seq)
@@ -463,7 +466,7 @@ class GPTAdapter:
             layout=layout, mesh=mesh, step=step,
             wrapped=_wrap(step, mesh, state_spec, batch_spec),
             state_spec=state_spec, batch_spec=batch_spec,
-            state_avals=(_tree_sds(params), _tree_sds(st)),
+            state_avals=(params_sds, st_sds),
             batch_avals=jax.ShapeDtypeStruct(toks_shape, jnp.int32),
             init_state=init_state, batch_fn=self._batch_fn(toks_shape),
             axis_sizes=axis_sizes)
@@ -568,10 +571,13 @@ class ResNetAdapter:
         mesh = named_mesh(layout.mesh_axes(), devices=devices)
         axis_sizes = dict(zip(mesh.axis_names,
                               (int(s) for s in mesh.devices.shape)))
-        model = self._model("data" if layout.dp > 1 else None)
-        variables = self._init_vars("data" if layout.dp > 1 else None)
-        params, batch_stats = variables["params"], \
-            variables["batch_stats"]
+        axis = "data" if layout.dp > 1 else None
+        model = self._model(axis)
+        # avals only — the concrete init is deferred to the winner's
+        # init_state (see GPTAdapter._build_dp)
+        vars_sds = jax.eval_shape(lambda: self._init_vars(axis))
+        params, batch_stats = vars_sds["params"], \
+            vars_sds["batch_stats"]
         bucket = layout.ddp_bucket or _h.DDP_MESSAGE_SIZE
         if layout.zero:
             from apex_tpu.contrib.optimizers import DistributedFusedAdam
@@ -618,7 +624,8 @@ class ResNetAdapter:
                       else (P(), P()))
 
         def init_state():
-            p, bs = _fresh(params), _fresh(batch_stats)
+            variables = self._init_vars(axis)
+            p, bs = variables["params"], variables["batch_stats"]
             opt_state = opt.init(p)
             if layout.zero:
                 opt_state = jax.device_put(
@@ -637,7 +644,7 @@ class ResNetAdapter:
                                          dtype=np.int32))
             return (x, y)
 
-        st_avals = (_tree_sds(params), _tree_sds(batch_stats),
+        st_avals = (params, batch_stats,
                     jax.eval_shape(opt.init, params))
         batch_avals = (jax.ShapeDtypeStruct(x_shape, jnp.float32),
                        jax.ShapeDtypeStruct((x_shape[0],), jnp.int32))

@@ -35,14 +35,16 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 from apex_tpu.plan.describe import ModelDesc
 from apex_tpu.plan.layout import Layout
 
-__all__ = ["CostBreakdown", "WireItem", "estimate", "analytic_wire",
-           "traced_wire", "hbm_footprint", "OVERLAP_EFFICIENCY",
-           "ici_bytes_per_s", "collective_latency_s"]
+__all__ = ["CostBreakdown", "HeteroCost", "WireItem", "estimate",
+           "analytic_wire", "traced_wire", "hbm_footprint",
+           "heterogeneous_step_s", "member_speeds", "optimal_weights",
+           "OVERLAP_EFFICIENCY", "ici_bytes_per_s",
+           "collective_latency_s"]
 
 # Fraction of a staged dp-collective's time that hides behind backward
 # compute (PR 6 overlap engine; pyprof measured 79.6% on the live GPT
@@ -398,3 +400,97 @@ def estimate(desc: ModelDesc, layout: Layout, *,
                           if hbm_capacity is not None
                           else peaks.get("hbm_bytes")),
         wire_source=source, wire_drift_pct=drift, notes=notes)
+
+
+# ---------------------------------------------------------------------------
+# heterogeneous members (the AMP arc, arXiv 2210.07297)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class HeteroCost:
+    """One weighted-fleet pricing: the step is the SLOWEST member's
+    bill (a lock-step fleet advances at the straggler's pace — the
+    whole point of rebalancing is to shrink that max)."""
+
+    step_s: float                 # max over members
+    per_member_s: List[float]     # each member's modeled bill
+    speeds: List[float]           # relative speeds (fleet median = 1)
+    weights: Optional[List[int]]  # canonical vector (None = equal)
+
+    def to_meta(self) -> Dict[str, Any]:
+        return {"step_s": self.step_s,
+                "per_member_ms": [round(s * 1e3, 4)
+                                  for s in self.per_member_s],
+                "speeds": [round(s, 4) for s in self.speeds],
+                "weights": self.weights}
+
+
+def member_speeds(rates: Dict[str, float]) -> List[float]:
+    """Measured per-member step rates -> relative speeds normalized to
+    the fleet MEDIAN (= 1.0), in dense sorted-member order — the same
+    member ordering the rendezvous rank assignment uses, so index i is
+    member rank i."""
+    members = sorted(rates)
+    if not members:
+        raise ValueError("member_speeds needs at least one rate")
+    vals = [float(rates[m]) for m in members]
+    if any(v <= 0 for v in vals):
+        raise ValueError(f"rates must be positive, got {rates}")
+    med = sorted(vals)[len(vals) // 2]
+    return [v / med for v in vals]
+
+
+def optimal_weights(speeds: Sequence[float], *,
+                    granularity: int = 8) -> Optional[List[int]]:
+    """Speed-proportional canonical weight vector: the fixed
+    (replicated-compute) term of the heterogeneous bill scales with
+    ``1/speed_i`` no matter the assignment, so the minimizing move for
+    the shard-proportional term is to give each member work in
+    proportion to its speed. Quantized to ``granularity`` levels of the
+    fastest member and floored at 1; an all-equal result canonicalizes
+    to None (equal shards) — one definition of canonical weights,
+    shared with :mod:`apex_tpu.resilience.elastic`."""
+    from apex_tpu.resilience.elastic import normalize_weights
+    top = max(speeds)
+    if top <= 0:
+        raise ValueError(f"speeds must be positive, got {speeds}")
+    ws = [max(1, round(granularity * s / top)) for s in speeds]
+    return normalize_weights(ws)
+
+
+def heterogeneous_step_s(cost: CostBreakdown,
+                         speeds: Sequence[float], *,
+                         weights: Optional[Sequence[int]] = None
+                         ) -> HeteroCost:
+    """Price one layout on a fleet of UNEQUAL members: the step time is
+    ``max`` over members of that member's compute+comm bill.
+
+    Member ``i``'s bill splits into the REPLICATED term — the roofline
+    floor plus any pipeline bubble, paid by every member over its own
+    silicon, so it scales with ``1/speed_i`` — and the
+    SHARD-PROPORTIONAL term — the exposed collective bill plus
+    per-collective latency, whose per-member share follows its shard
+    fraction (ZeRO scatter/gather payloads and the optimizer's flat
+    update are both linear in the member's span), normalized so the
+    equal split reproduces ``cost.step_s`` exactly on a homogeneous
+    fleet. ``weights=None`` prices the equal assignment (what the fleet
+    pays BEFORE rebalancing)."""
+    speeds = [float(s) for s in speeds]
+    n = len(speeds)
+    if n < 1:
+        raise ValueError("heterogeneous_step_s needs >= 1 member")
+    if weights is None:
+        fractions = [1.0 / n] * n
+        canon = None
+    else:
+        from apex_tpu.resilience.elastic import normalize_weights
+        canon = normalize_weights(weights, n)
+        ws = canon if canon is not None else [1] * n
+        total = float(sum(ws))
+        fractions = [w / total for w in ws]
+    fixed = cost.roofline_s + cost.bubble_s
+    shardable = cost.exposed_comm_s + cost.latency_s
+    per_member = [fixed / s + shardable * f * n
+                  for s, f in zip(speeds, fractions)]
+    return HeteroCost(step_s=max(per_member), per_member_s=per_member,
+                      speeds=speeds, weights=canon)

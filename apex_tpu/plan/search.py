@@ -458,19 +458,33 @@ def auto(adapter, *, n_devices: Optional[int] = None,
 
 
 # ---------------------------------------------------------------------------
-# elastic replanning seam (ROADMAP item 4 groundwork)
+# elastic replanning seam (ROADMAP item 4 — now heterogeneity-aware)
 # ---------------------------------------------------------------------------
 
-def replanner(adapter, *, constraints: Optional[Constraints] = None
-              ) -> Callable[[int, int], Dict[str, Any]]:
-    """A membership-change re-rank hook for
-    :class:`apex_tpu.resilience.elastic.Elastic` — EQUAL-SHARD only
-    (every surviving member gets the same shard; heterogeneity-aware
-    unequal shards are the ROADMAP item-4 follow-up this seam exists
-    for). The returned callable re-runs the ANALYTIC cost model at the
-    old and new world sizes (no tracing, no compiling — a membership
-    change must not pay a search) and returns
-    ``{"old": ..., "new": ..., "old_step_s": ..., "new_step_s": ...}``.
+def replanner(adapter, *, constraints: Optional[Constraints] = None,
+              heterogeneous: bool = True,
+              granularity: int = 8
+              ) -> Callable[..., Dict[str, Any]]:
+    """The membership-change re-plan hook for
+    :class:`apex_tpu.resilience.elastic.Elastic` — an ACTING
+    incremental re-plan: the returned callable re-runs the ANALYTIC
+    cost model at the old and new world sizes (no tracing, no
+    compiling — a membership change must not pay a search) and, when
+    the caller passes measured per-member ``rates`` (the rendezvous
+    profile feed, ``Elastic(rates=...)``), prices the pick with the
+    heterogeneous-member term (:func:`apex_tpu.plan.cost.
+    heterogeneous_step_s` — step time = max over members of that
+    member's compute+comm bill) and emits the canonical ``weights``
+    vector the pick wants. That vector is what the rebalance
+    supervisor's weighted re-shard consumes
+    (``Elastic.planned_weights`` → ``rebalance.apply_rebalance``): the
+    cost model's choice is CARRIED into the state re-map, not just
+    logged.
+
+    Returns ``{"old", "new", "old_step_s", "new_step_s",
+    "equal_shard"}`` plus — with usable rates —
+    ``{"weights", "speeds", "hetero_step_s", "equal_step_s"}``.
+    ``heterogeneous=False`` restores the PR 14 equal-shard re-rank.
     """
     base = constraints or Constraints()
     cons = dataclasses.replace(base, validate="none")
@@ -486,11 +500,35 @@ def replanner(adapter, *, constraints: Optional[Constraints] = None
                 f"replan: no feasible layout at world {world}")
         return feas[0]
 
-    def replan(old_world: int, new_world: int) -> Dict[str, Any]:
+    def replan(old_world: int, new_world: int,
+               rates: Optional[Dict[str, float]] = None
+               ) -> Dict[str, Any]:
         old, new = _best(int(old_world)), _best(int(new_world))
-        return {"old": old.layout.layout_id(),
-                "new": new.layout.layout_id(),
-                "old_step_s": old.step_s, "new_step_s": new.step_s,
-                "equal_shard": True}
+        out = {"old": old.layout.layout_id(),
+               "new": new.layout.layout_id(),
+               "old_step_s": old.step_s, "new_step_s": new.step_s,
+               "equal_shard": True}
+        if not heterogeneous or not rates:
+            return out
+        if len(rates) != int(new_world):
+            # stale/partial profiles (a member died between the
+            # heartbeat and this replan): weighted pricing would
+            # assign weights to the wrong membership — stay equal
+            out["weights_skipped"] = (
+                f"{len(rates)} rates for world {new_world}")
+            return out
+        speeds = _cost.member_speeds(rates)
+        weights = _cost.optimal_weights(speeds,
+                                        granularity=granularity)
+        hetero = _cost.heterogeneous_step_s(new.cost, speeds,
+                                            weights=weights)
+        equal = _cost.heterogeneous_step_s(new.cost, speeds)
+        out.update({
+            "weights": hetero.weights,
+            "speeds": [round(s, 4) for s in speeds],
+            "hetero_step_s": hetero.step_s,
+            "equal_step_s": equal.step_s,
+            "equal_shard": hetero.weights is None})
+        return out
 
     return replan

@@ -29,6 +29,14 @@ or resume correctness. This package turns the one-shot
     Elastic(opt, params))`` turns a membership change from a hard
     config error into a resume; ``python -m apex_tpu.resilience
     inspect DIR --check W`` reports feasibility from the manifests.
+  * :mod:`rebalance` — heterogeneity-aware rebalancing: member
+    capability/health profiles ride the rendezvous heartbeat, the
+    :class:`~apex_tpu.resilience.rebalance.DegradationSupervisor`
+    detects a SUSTAINED straggler (rolling rate vs fleet median,
+    hysteresis + cooldown) and walks the policy ladder — first shrink
+    the slow member's shard (weighted ZeRO re-map, gather-verified
+    bitwise), then evict it through the cooperative exit-75 leave →
+    ``W-1`` relaunch arc. ``resilient_loop(..., supervisor=...)``.
 
 Resume telemetry: a resumed run emits a ``resilience/resume`` marker
 (generation, step); ``python -m apex_tpu.telemetry summarize`` reports
@@ -38,16 +46,18 @@ than double-counting them.
 Full guide: ``docs/resilience.md``.
 """
 
-from apex_tpu.resilience import elastic
+from apex_tpu.resilience import elastic, rebalance
 from apex_tpu.resilience.elastic import Elastic, reshard_restore
 from apex_tpu.resilience.faults import (ENV_VAR as FAULT_ENV,
                                         FaultInjector, raise_if_io_error)
 from apex_tpu.resilience.loop import LoopResult, resilient_loop
 from apex_tpu.resilience.preempt import EXIT_PREEMPTED, PreemptionHandler
+from apex_tpu.resilience.rebalance import DegradationSupervisor
 from apex_tpu.resilience.snapshot import Restored, SnapshotManager
 
 __all__ = [
-    "EXIT_PREEMPTED", "Elastic", "FAULT_ENV", "FaultInjector",
-    "LoopResult", "PreemptionHandler", "Restored", "SnapshotManager",
-    "elastic", "raise_if_io_error", "reshard_restore", "resilient_loop",
+    "DegradationSupervisor", "EXIT_PREEMPTED", "Elastic", "FAULT_ENV",
+    "FaultInjector", "LoopResult", "PreemptionHandler", "Restored",
+    "SnapshotManager", "elastic", "raise_if_io_error", "rebalance",
+    "reshard_restore", "resilient_loop",
 ]

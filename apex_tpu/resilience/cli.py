@@ -4,19 +4,23 @@
 
     python -m apex_tpu.resilience inspect SNAP_DIR
     python -m apex_tpu.resilience inspect SNAP_DIR --check 4
+    python -m apex_tpu.resilience inspect SNAP_DIR --check 2 --weights 3:1
     python -m apex_tpu.resilience inspect SNAP_DIR --json
 
 ``inspect`` renders one row per generation straight from the manifests
 (step, world = the layout fingerprint's shard_count, chunk resolution,
-payload bytes, complete flag, structure crc) — until now the only way to
-read a manifest was by hand. ``--check W`` additionally reports, per
+weighted shard fractions when the generation was rebalanced, payload
+bytes, complete flag, structure crc) — until now the only way to read a
+manifest was by hand. ``--check W`` additionally reports, per
 generation, whether a re-shard to world ``W`` is possible
-(:func:`apex_tpu.resilience.elastic.check_world`) and sets the exit
-code from the NEWEST complete generation: 0 when it can restore at
-world ``W`` (re-shard or plain), 3 when it cannot, 2 when the store
-holds no COMPLETE generation (missing directory, nothing published
-yet, or every manifest unreadable/incomplete — nothing restorable
-either way).
+(:func:`apex_tpu.resilience.elastic.check_world`); ``--weights``
+(grammar ``3:1`` / ``60,40``) asks about a WEIGHTED target layout —
+the vector must be feasible for ``W`` (length, positive entries) or
+the check says why not. The exit-code contract is UNCHANGED: 0 when
+the newest complete generation can restore at the requested layout, 3
+when it cannot, 2 when the store holds no COMPLETE generation (missing
+directory, nothing published yet, or every manifest
+unreadable/incomplete — nothing restorable either way).
 """
 
 from __future__ import annotations
@@ -45,18 +49,28 @@ def _rows(mgr: SnapshotManager) -> List[Dict[str, Any]]:
             rows.append(row)
             continue
         layout = man.get("layout")
+        lay = layout if isinstance(layout, dict) else {}
         row.update({
             "step": man.get("step"),
             "complete": bool(man.get("complete")),
             "bytes": man.get("bytes"),
             "layout": layout,
-            "world": (layout or {}).get("shard_count")
-            if isinstance(layout, dict) else None,
-            "chunk_elements": (layout or {}).get("chunk_elements")
-            if isinstance(layout, dict) else None,
+            "world": lay.get("shard_count"),
+            "chunk_elements": lay.get("chunk_elements"),
+            # weighted shard assignment (rebalanced generation):
+            # canonical proportions + the per-member fractions they mean
+            "weights": lay.get("weights"),
         })
         rows.append(row)
     return rows
+
+
+def _fmt_weights(weights) -> str:
+    """``3:1 (75.0%/25.0%)`` — proportions plus the fractions they
+    assign (the human answer to "how unequal is this generation?")."""
+    total = float(sum(weights))
+    pcts = "/".join(f"{100.0 * w / total:.1f}%" for w in weights)
+    return f"{':'.join(str(int(w)) for w in weights)} ({pcts})"
 
 
 def _fmt_bytes(n: Optional[int]) -> str:
@@ -77,37 +91,64 @@ def inspect_main(args: argparse.Namespace) -> int:
     mgr = SnapshotManager(args.directory)
     rows = _rows(mgr)
     check_w = args.check
+    weights = None
+    if args.weights is not None:
+        if check_w is None:
+            print("inspect: --weights needs --check W (the target "
+                  "world the vector applies to)", file=sys.stderr)
+            return 2
+        try:
+            weights = _elastic.parse_weights(args.weights)
+        except ValueError as e:
+            print(f"inspect: {e}", file=sys.stderr)
+            return 2
     if check_w is not None:
         for row in rows:
             if "error" in row:
                 row["reshard_to_%d" % check_w] = [False, row["error"]]
                 continue
-            ok, reason = _elastic.check_world(row.get("layout"), check_w)
+            ok, reason = _elastic.check_world(row.get("layout"),
+                                              check_w, weights=weights)
             row[f"reshard_to_{check_w}"] = [ok, reason]
-    if args.json:
-        print(json.dumps({"directory": args.directory, "rows": rows},
-                         indent=1, sort_keys=True))
-    else:
-        if not rows:
-            print(f"{args.directory}: no published generations")
-        for row in rows:
-            if "error" in row:
-                print(f"gen {row['generation']:>8}  {row['error']}")
-                continue
-            fp = row.get("layout")
-            crc = (f" crc32={int(fp['structure_crc32']):#010x}"
-                   if isinstance(fp, dict)
-                   and "structure_crc32" in fp else "")
-            print(f"gen {row['generation']:>8}  step {row['step']!s:>6}"
-                  f"  world {row['world'] if row['world'] is not None else '-':>3}"
-                  f"  chunk {row['chunk_elements'] if row['chunk_elements'] is not None else '-':>9}"
-                  f"  {_fmt_bytes(row['bytes']):>9}"
-                  f"  {'complete' if row['complete'] else 'INCOMPLETE'}"
-                  f"{crc}")
-            if check_w is not None:
-                ok, reason = row[f"reshard_to_{check_w}"]
-                print(f"    -> world {check_w}: "
-                      f"{'OK' if ok else 'NO'} — {reason}")
+    try:
+        if args.json:
+            print(json.dumps({"directory": args.directory, "rows": rows},
+                             indent=1, sort_keys=True))
+        else:
+            if not rows:
+                print(f"{args.directory}: no published generations")
+            for row in rows:
+                if "error" in row:
+                    print(f"gen {row['generation']:>8}  {row['error']}")
+                    continue
+                fp = row.get("layout")
+                crc = (f" crc32={int(fp['structure_crc32']):#010x}"
+                       if isinstance(fp, dict)
+                       and "structure_crc32" in fp else "")
+                wtag = (f"  weights {_fmt_weights(row['weights'])}"
+                        if row.get("weights") else "")
+                print(f"gen {row['generation']:>8}  step {row['step']!s:>6}"
+                      f"  world {row['world'] if row['world'] is not None else '-':>3}"
+                      f"  chunk {row['chunk_elements'] if row['chunk_elements'] is not None else '-':>9}"
+                      f"  {_fmt_bytes(row['bytes']):>9}"
+                      f"  {'complete' if row['complete'] else 'INCOMPLETE'}"
+                      f"{wtag}{crc}")
+                if check_w is not None:
+                    ok, reason = row[f"reshard_to_{check_w}"]
+                    print(f"    -> world {check_w}: "
+                          f"{'OK' if ok else 'NO'} — {reason}")
+    except BrokenPipeError:
+        # the reader (`grep -q` / `head`) closed early — normal CLI
+        # usage. Handle it HERE, not by aborting: the --check exit code
+        # below is a documented 0/3 contract a pipeline may key on, and
+        # it must come from the verdicts, not from how much listing fit
+        # the pipe buffer. Swap stdout to devnull so nothing else
+        # raises.
+        try:
+            os.dup2(os.open(os.devnull, os.O_WRONLY),
+                    sys.stdout.fileno())
+        except OSError:
+            pass
     complete = [r for r in rows if r.get("complete")]
     if not complete:
         return 2
@@ -118,6 +159,23 @@ def inspect_main(args: argparse.Namespace) -> int:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    try:
+        return _run(argv)
+    except BrokenPipeError:
+        # backstop only (inspect_main handles its own listing pipe so
+        # the --check exit code always comes from the verdicts): a
+        # closed reader is normal CLI usage, not a failure. Point
+        # stdout at devnull so the interpreter-shutdown flush doesn't
+        # raise a second time.
+        try:
+            os.dup2(os.open(os.devnull, os.O_WRONLY),
+                    sys.stdout.fileno())
+        except OSError:
+            pass
+        return 0
+
+
+def _run(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m apex_tpu.resilience",
         description=__doc__.splitlines()[0])
@@ -131,6 +189,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                      help="report per generation whether a re-shard to "
                      "world W is possible; exit 0/3 from the newest "
                      "complete generation")
+    ins.add_argument("--weights", default=None, metavar="W0:W1:...",
+                     help="with --check: ask about a WEIGHTED target "
+                     "layout (integer proportions, e.g. 3:1 or 60,40); "
+                     "infeasible vectors are named")
     ins.add_argument("--json", action="store_true",
                      help="machine-readable output")
     args = p.parse_args(argv)

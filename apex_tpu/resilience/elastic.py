@@ -26,6 +26,21 @@ a structurally incompatible checkpoint and still fails fast
 (:func:`can_reshard` is the single classifier; ``checkpoint._check_
 layout``'s mismatch message routes through it).
 
+Weighted shards (heterogeneity-aware rebalancing, ROADMAP item 4's
+second half): a fingerprint may additionally carry ``weights`` — a
+canonical integer-proportion vector (one entry per member, gcd-reduced,
+:func:`normalize_weights`) assigning member ``i`` the fraction
+``w_i / sum(w)`` of every bucket instead of the equal ``1/W`` chunk.
+The padded flat length is UNCHANGED (per-bucket padding still rounds to
+a multiple of W), only the member boundaries inside each bucket move
+(largest-remainder apportionment, :func:`apportion` — deterministic),
+so weighted↔equal re-maps stay exact permutations-plus-zero-padding and
+the gather-compare contract holds bitwise across them. A fingerprint
+WITHOUT ``weights`` is byte-identical to the pre-rebalance form — the
+equal-shard path is provably inert. The weight vector is produced by
+:mod:`apex_tpu.resilience.rebalance` (measured member rates) or the
+planner's heterogeneous cost term (:func:`apex_tpu.plan.replanner`).
+
 Wiring (the membership-change arc):
 
 * :class:`Elastic` is the ``resilient_loop(..., elastic=...)`` seam —
@@ -46,8 +61,9 @@ Full guide: docs/resilience.md "Elastic membership".
 
 from __future__ import annotations
 
+import math
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -56,16 +72,19 @@ from apex_tpu.resilience.snapshot import Restored, SnapshotManager
 Tree = Any
 
 #: fingerprint fields that may differ between re-shardable layouts (they
-#: are all derived from shard_count/chunk_elements given the same tree)
-WORLD_KEYS = ("shard_count", "chunk_elements", "padded", "n_buckets")
+#: are all derived from shard_count/chunk_elements/weights given the
+#: same tree; ``weights`` is OPTIONAL — absent means equal shards)
+WORLD_KEYS = ("shard_count", "chunk_elements", "padded", "n_buckets",
+              "weights")
 #: fingerprint fields that must MATCH for a re-shard to be possible
 TREE_KEYS = ("structure_crc32", "total")
 
 
-def _record(name: str, value: float, *, step=None, meta=None) -> None:
+def _record(name: str, value: float, *, step=None, meta=None,
+            kind: str = "point") -> None:
     from apex_tpu import telemetry
     if telemetry.enabled():
-        telemetry.record(name, value, step=step, meta=meta)
+        telemetry.record(name, value, step=step, meta=meta, kind=kind)
 
 
 # ---------------------------------------------------------------------------
@@ -106,10 +125,15 @@ def classify_reshard(source: Any, target: Any) -> Tuple[str, str]:
                 "param tree itself changed, re-sharding cannot help")
     if source == target:
         return IDENTICAL, "identical layout (plain restore, no re-shard)"
+
+    def _side(fp):
+        w = fp.get("weights")
+        tag = f", weights {':'.join(str(int(x)) for x in w)}" if w else ""
+        return (f"world {fp['shard_count']} "
+                f"(chunk {fp['chunk_elements']}{tag})")
+
     return RESHARDABLE, (
-        f"re-shardable: world {source['shard_count']} "
-        f"(chunk {source['chunk_elements']}) -> world "
-        f"{target['shard_count']} (chunk {target['chunk_elements']})")
+        f"re-shardable: {_side(source)} -> {_side(target)}")
 
 
 def can_reshard(source: Any, target: Any) -> Tuple[bool, str]:
@@ -120,11 +144,15 @@ def can_reshard(source: Any, target: Any) -> Tuple[bool, str]:
     return kind in (IDENTICAL, RESHARDABLE), reason
 
 
-def check_world(fingerprint: Any, world: int) -> Tuple[bool, str]:
+def check_world(fingerprint: Any, world: int,
+                weights: Optional[Sequence] = None) -> Tuple[bool, str]:
     """Manifest-only feasibility of a re-shard to ``world`` (the
     ``inspect --check W`` form: no params tree in hand, so this verifies
     the fingerprint is a complete re-map source and reports what the
-    restore-time check will additionally require)."""
+    restore-time check will additionally require). ``weights`` asks for
+    a WEIGHTED target layout (``inspect --check W --weights 3:1``) —
+    infeasible weight vectors (wrong length, non-positive entries) are
+    named, never silently accepted."""
     if world < 1:
         return False, f"target world must be >= 1, got {world}"
     if not isinstance(fingerprint, dict) or any(
@@ -133,14 +161,156 @@ def check_world(fingerprint: Any, world: int) -> Tuple[bool, str]:
         return False, ("no ZeRO layout fingerprint recorded — the "
                        "snapshot cannot be re-sharded (re-save with "
                        "layout=opt.layout_fingerprint(params))")
+    wtag = ""
+    if weights is not None:
+        try:
+            canon = normalize_weights(weights, world)
+        except ValueError as e:
+            return False, f"infeasible weight vector: {e}"
+        wtag = ("" if canon is None
+                else f" with weights {':'.join(map(str, canon))}")
     src = int(fingerprint["shard_count"])
-    if src == world:
+    if src == world and not wtag and not fingerprint.get("weights"):
         return True, f"same world ({world}): plain restore"
+    if src == world and not wtag:
+        return True, (f"same world ({world}): re-shard drops the saved "
+                      f"weights {fingerprint['weights']} (equal shards)")
     return True, (
-        f"re-shard {src} -> {world} possible (restore will verify the "
-        f"live params tree matches structure_crc32="
+        f"re-shard {src} -> {world}{wtag} possible (restore will verify "
+        f"the live params tree matches structure_crc32="
         f"{int(fingerprint['structure_crc32']):#010x}, "
         f"total={int(fingerprint['total'])})")
+
+
+# ---------------------------------------------------------------------------
+# weighted shard assignment
+# ---------------------------------------------------------------------------
+
+def parse_weights(spec: str) -> List[int]:
+    """The weight GRAMMAR (docs/resilience.md "Rebalancing"): positive
+    integer proportions separated by ``:`` or ``,`` — ``3:1``, ``60,40``
+    and ``6:2`` all mean the same 75%/25% split after
+    :func:`normalize_weights`."""
+    parts = [p for p in spec.replace(",", ":").split(":") if p.strip()]
+    try:
+        out = [int(p) for p in parts]
+    except ValueError as e:
+        raise ValueError(
+            f"bad weight vector {spec!r}: expected positive integers "
+            "separated by ':' or ',' (e.g. '3:1')") from e
+    if not out:
+        raise ValueError(f"bad weight vector {spec!r}: empty")
+    return out
+
+
+def normalize_weights(weights: Sequence, world: Optional[int] = None
+                      ) -> Optional[List[int]]:
+    """Canonical form of a weight vector: a gcd-reduced list of positive
+    ints, or **None for equal shards** — so an all-equal vector
+    canonicalizes to the ABSENT-key fingerprint and the equal-shard
+    layout stays byte-identical to the pre-rebalance form. Weight 0 is
+    rejected: an empty assignment is eviction's job, not rebalancing's.
+    """
+    ws = list(weights)
+    if world is not None and len(ws) != world:
+        raise ValueError(
+            f"weight vector has {len(ws)} entries for world {world}")
+    if not ws:
+        raise ValueError("weight vector is empty")
+    out = []
+    for w in ws:
+        iw = int(w)
+        if iw != w or iw < 1:
+            raise ValueError(
+                f"weights must be positive integers, got {w!r} in {ws} "
+                "(weight 0 would assign a member nothing — that is "
+                "eviction, not rebalancing)")
+        out.append(iw)
+    g = 0
+    for w in out:
+        g = math.gcd(g, w)
+    out = [w // g for w in out]
+    if all(w == out[0] for w in out):
+        return None   # equal shards: the canonical form is NO weights
+    return out
+
+
+def apportion(total: int, weights: Sequence[int]) -> List[int]:
+    """Split ``total`` elements over members proportional to ``weights``
+    — largest-remainder apportionment with index tie-break, so the
+    result is deterministic, sums to ``total`` exactly, and moves each
+    member's count by at most 1 from the real-valued share."""
+    ws = [int(w) for w in weights]
+    s = sum(ws)
+    if s <= 0 or any(w < 0 for w in ws):
+        raise ValueError(
+            f"weights must be non-negative and sum > 0, got {ws}")
+    base = [(total * w) // s for w in ws]
+    rem = total - sum(base)
+    order = sorted(range(len(ws)),
+                   key=lambda i: (-((total * ws[i]) % s), i))
+    for i in order[:rem]:
+        base[i] += 1
+    return base
+
+
+def weighted_fingerprint(fingerprint: Dict[str, Any],
+                         weights: Optional[Sequence]) -> Dict[str, Any]:
+    """An (equal-shard) fingerprint re-labeled with a canonical weight
+    vector — same tree, same padded length, different member boundaries.
+    ``weights=None`` (or an all-equal vector) returns the equal-shard
+    form with NO ``weights`` key, bit-identical to the input."""
+    out = {k: v for k, v in fingerprint.items() if k != "weights"}
+    canon = None if weights is None else normalize_weights(
+        weights, int(fingerprint["shard_count"]))
+    if canon is not None:
+        out["weights"] = canon
+    return out
+
+
+def _spec_ks(spec: dict, bucket: dict) -> List[int]:
+    """Per-member element counts of one bucket: the weighted ``ks`` when
+    present, else the equal ``k`` repeated."""
+    ks = bucket.get("ks")
+    if ks is not None:
+        return list(ks)
+    return [bucket["k"]] * spec["shard_count"]
+
+
+def member_lengths(spec: dict) -> List[int]:
+    """Flat elements (padding included) each member holds under this
+    spec — the shard sizes the weight vector actually produced."""
+    n = spec["shard_count"]
+    out = [0] * n
+    for b in spec["buckets"]:
+        for i, k in enumerate(_spec_ks(spec, b)):
+            out[i] += k
+    return out
+
+
+def member_span(spec: dict, rank: int) -> Tuple[int, int]:
+    """``[start, stop)`` of member ``rank``'s contiguous span in the
+    flat (member-major) state array — after a rebalance the slow
+    member's span is the one that shrank."""
+    lens = member_lengths(spec)
+    if not 0 <= rank < len(lens):
+        raise ValueError(f"rank {rank} outside world {len(lens)}")
+    start = sum(lens[:rank])
+    return start, start + lens[rank]
+
+
+def _apply_weights(spec: dict, weights: Sequence[int]) -> dict:
+    """Attach a canonical weight vector to an equal-shard layout spec:
+    every bucket's padded extent is re-apportioned over the members
+    (``ks``), the padded TOTAL is unchanged."""
+    canon = normalize_weights(weights, spec["shard_count"])
+    if canon is None:
+        return spec
+    out = dict(spec)
+    out["weights"] = canon
+    out["buckets"] = [dict(b, ks=apportion(b["padded"], canon))
+                     for b in spec["buckets"]]
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -151,7 +321,10 @@ def spec_for(params: Tree, fingerprint: Dict[str, Any]) -> dict:
     """Rebuild the flat-layout spec a fingerprint describes, from the
     live params tree. Raises when the rebuilt layout disagrees with the
     recorded one — the fingerprint then does not describe THESE params
-    and a re-map would scramble."""
+    and a re-map would scramble. A ``weights`` key (heterogeneity-aware
+    rebalancing) re-apportions every bucket's padded extent over the
+    members; the padded total — and every other fingerprint field — is
+    unchanged by weighting."""
     from apex_tpu.contrib.optimizers import zero as _zero
     spec = _zero.pack_layout(
         params, chunk_elements=int(fingerprint["chunk_elements"]),
@@ -171,26 +344,56 @@ def spec_for(params: Tree, fingerprint: Dict[str, Any]) -> dict:
             "layout fingerprint does not describe this params tree — "
             f"rebuilt layout disagrees on {bad}. The checkpoint was "
             "saved for a different model; re-sharding cannot help.")
+    weights = fingerprint.get("weights")
+    if weights is not None:
+        canon = normalize_weights(weights, spec["shard_count"])
+        if canon != list(int(w) for w in weights):
+            raise ValueError(
+                f"fingerprint weights {weights} are not canonical "
+                f"(expected {canon or 'no weights key (equal shards)'})"
+                " — normalize with elastic.normalize_weights before "
+                "recording a layout")
+        spec = _apply_weights(spec, canon)
     return spec
 
 
 def unshard(flat: Any, spec: dict) -> np.ndarray:
     """W-sharded flat array (bucket-shard-interleaved, ``(padded,)``) ->
     canonical tensor-order array ``(total,)`` with per-bucket padding
-    dropped — the "gather" of the gather-compare contract."""
+    dropped — the "gather" of the gather-compare contract.
+
+    The flat form is member-major: member ``i``'s local state is the
+    contiguous span :func:`member_span` ``(spec, i)``, itself the concat
+    of that member's chunk of every bucket — the equal-shard chunk
+    ``k``, or the weighted ``ks[i]`` when the spec carries weights."""
     flat = np.asarray(flat)
     n = spec["shard_count"]
     if flat.shape != (spec["padded"],):
         raise ValueError(
             f"flat state has shape {flat.shape}, but the layout spec "
             f"describes ({spec['padded']},) at world {n}")
-    rows = flat.reshape(n, spec["padded"] // n)
+    if "weights" not in spec:
+        # equal shards: the vectorized fast path (bit-identical to the
+        # generic one below — the weighted tests pin it)
+        rows = flat.reshape(n, spec["padded"] // n)
+        out = np.empty((spec["total"],), flat.dtype)
+        off = 0
+        for b in spec["buckets"]:
+            blk = rows[:, off:off + b["k"]].reshape(-1)   # (padded_b,)
+            out[b["start"]:b["start"] + b["size"]] = blk[:b["size"]]
+            off += b["k"]
+        return out
+    starts = np.cumsum([0] + member_lengths(spec))
     out = np.empty((spec["total"],), flat.dtype)
-    off = 0
+    off = [0] * n
     for b in spec["buckets"]:
-        blk = rows[:, off:off + b["k"]].reshape(-1)   # (padded_b,)
+        ks = _spec_ks(spec, b)
+        blk = np.concatenate(
+            [flat[starts[i] + off[i]:starts[i] + off[i] + ks[i]]
+             for i in range(n)])
         out[b["start"]:b["start"] + b["size"]] = blk[:b["size"]]
-        off += b["k"]
+        for i in range(n):
+            off[i] += ks[i]
     return out
 
 
@@ -198,22 +401,38 @@ def shard(canonical: Any, spec: dict) -> np.ndarray:
     """Canonical ``(total,)`` array -> the spec's bucket-shard-interleaved
     flat form ``(padded,)`` (zero padding) — exactly the layout
     ``_ZeroBase.init`` builds, so sharding the result with
-    ``P(axis_name)`` hands each device its expected slices."""
+    ``P(axis_name)`` hands each device its expected slices. A weighted
+    spec splits each bucket at the apportioned boundaries instead of the
+    equal ``k`` — the flat form stays member-major either way."""
     canonical = np.asarray(canonical)
     if canonical.shape != (spec["total"],):
         raise ValueError(
             f"canonical state has shape {canonical.shape}, expected "
             f"({spec['total']},)")
     n = spec["shard_count"]
-    cols = []
+    if "weights" not in spec:
+        cols = []
+        for b in spec["buckets"]:
+            blk = canonical[b["start"]:b["start"] + b["size"]]
+            if b["padded"] > b["size"]:
+                blk = np.concatenate(
+                    [blk, np.zeros((b["padded"] - b["size"],), blk.dtype)])
+            cols.append(blk.reshape(n, b["k"]))
+        rows = cols[0] if len(cols) == 1 else np.concatenate(cols, axis=1)
+        return np.ascontiguousarray(rows.reshape(-1))
+    locals_: List[List[np.ndarray]] = [[] for _ in range(n)]
     for b in spec["buckets"]:
         blk = canonical[b["start"]:b["start"] + b["size"]]
         if b["padded"] > b["size"]:
             blk = np.concatenate(
                 [blk, np.zeros((b["padded"] - b["size"],), blk.dtype)])
-        cols.append(blk.reshape(n, b["k"]))
-    rows = cols[0] if len(cols) == 1 else np.concatenate(cols, axis=1)
-    return np.ascontiguousarray(rows.reshape(-1))
+        ks = _spec_ks(spec, b)
+        off = 0
+        for i in range(n):
+            locals_[i].append(blk[off:off + ks[i]])
+            off += ks[i]
+    return np.ascontiguousarray(np.concatenate(
+        [piece for parts in locals_ for piece in parts]))
 
 
 def reshard_flat(flat: Any, src_spec: dict, dst_spec: dict, *,
@@ -366,16 +585,21 @@ def reshard_restore(manager: SnapshotManager, template: Tree, *,
         t0 = time.perf_counter()
         state = reshard_tree(found.state, src_spec, dst_spec,
                              verify=verify)
+        meta = {"from_world": int(saved["shard_count"]),
+                "to_world": int(target["shard_count"]),
+                "from_chunk": int(saved["chunk_elements"]),
+                "to_chunk": int(target["chunk_elements"]),
+                "generation": found.generation,
+                "step": found.step,
+                "verified": bool(verify),
+                "reshard_s": round(time.perf_counter() - t0, 6)}
+        if saved.get("weights") or target.get("weights"):
+            # weighted↔equal crossing: record both assignments (None =
+            # equal shards) so summarize can show what moved
+            meta["from_weights"] = saved.get("weights")
+            meta["to_weights"] = target.get("weights")
         _record("resilience/reshard", float(target["shard_count"]),
-                step=found.step,
-                meta={"from_world": int(saved["shard_count"]),
-                      "to_world": int(target["shard_count"]),
-                      "from_chunk": int(saved["chunk_elements"]),
-                      "to_chunk": int(target["chunk_elements"]),
-                      "generation": found.generation,
-                      "step": found.step,
-                      "verified": bool(verify),
-                      "reshard_s": round(time.perf_counter() - t0, 6)})
+                step=found.step, meta=meta)
         return found._replace(state=state)
     return None
 
@@ -390,29 +614,60 @@ class Elastic:
     otherwise) — the loop reads it to re-anchor
     ``trainer.notify_resume(step, world=..., from_world=...)``.
 
-    ``replan`` is the ROADMAP item-4 planner seam: a callable
-    ``(old_world, new_world) -> dict`` (see
-    :func:`apex_tpu.plan.replanner`) re-run on every membership change
-    that actually re-sharded. The old/new picks land in telemetry as a
-    ``plan/replan`` static and in ``last_replan`` — EQUAL-SHARD
-    re-ranking only for now (every member gets the same shard;
-    heterogeneity-aware unequal shards are the follow-up this seam
-    exists for). A replan failure degrades to a warning: re-planning is
-    advisory, the re-shard itself must never be blocked by it.
+    ``replan`` is the planner seam, now ACTING (ROADMAP item 4 closed):
+    a callable ``(old_world, new_world) -> dict`` — or, heterogeneity-
+    aware, ``(old_world, new_world, rates=...) -> dict`` (see
+    :func:`apex_tpu.plan.replanner`) — re-run on every membership
+    change that actually re-sharded. When ``rates`` (a callable
+    returning ``{member: steps_per_s}``, e.g.
+    :func:`apex_tpu.resilience.rebalance.member_rates`) is wired, the
+    hook receives the measured per-member rates and its emitted pick
+    carries a ``weights`` vector; :meth:`planned_weights` hands that
+    vector to the rebalance supervisor's weighted re-shard. The old/new
+    picks land in telemetry as a ``plan/replan`` static and in
+    ``last_replan``. A replan failure degrades to a warning PLUS a
+    ``plan/replan_failed`` telemetry static (a fleet that never
+    successfully re-plans must be visible in ``summarize``, not just on
+    a scrolled-away stderr): re-planning is advisory, the re-shard
+    itself must never be blocked by it.
     """
 
     def __init__(self, optimizer: Any, params: Tree, *,
                  verify: bool = True,
-                 replan: Optional[Any] = None):
+                 replan: Optional[Any] = None,
+                 rates: Optional[Any] = None):
         self.optimizer = optimizer
         self.params = params
         self.verify = verify
         self.replan = replan
+        self.rates = rates
         self.last_reshard: Optional[Dict[str, Any]] = None
         self.last_replan: Optional[Dict[str, Any]] = None
 
     def target_layout(self) -> Dict[str, Any]:
         return self.optimizer.layout_fingerprint(self.params)
+
+    def weighted_target(self, weights: Optional[Sequence]
+                        ) -> Dict[str, Any]:
+        """The live layout re-labeled with a canonical weight vector
+        (:func:`weighted_fingerprint`) — the rebalance supervisor's
+        re-shard target."""
+        return weighted_fingerprint(self.target_layout(), weights)
+
+    def planned_weights(self, rates: Dict[str, float]
+                        ) -> Optional[List[int]]:
+        """The weight vector the planner's heterogeneous cost term picks
+        for the measured ``rates`` — by running the ``replan`` hook at
+        the CURRENT world — or None when no replan hook is wired or the
+        hook does not produce weights (the supervisor then falls back to
+        rate-proportional weights)."""
+        if self.replan is None:
+            return None
+        world = int(self.target_layout()["shard_count"])
+        out = self._run_replan(world, world, rates=rates)
+        if not isinstance(out, dict) or not out.get("weights"):
+            return None
+        return normalize_weights(out["weights"], world)
 
     def restore(self, manager: SnapshotManager, template: Tree, *,
                 layout: Optional[Dict[str, Any]] = None,
@@ -431,6 +686,8 @@ class Elastic:
                 self.last_reshard = {
                     "from_world": int(saved["shard_count"]),
                     "to_world": int(target["shard_count"]),
+                    "from_weights": saved.get("weights"),
+                    "to_weights": target.get("weights"),
                     "step": found.step,
                     "generation": found.generation}
                 if self.last_reshard["from_world"] \
@@ -440,24 +697,56 @@ class Elastic:
                                  found.step)
         return found
 
-    def _replan(self, from_world: int, to_world: int, step) -> None:
-        """Re-run the planner's cost model at the new membership and
-        record the old/new pick (``plan/replan``). Advisory: failures
-        warn, they never fail the restore."""
-        if self.replan is None:
-            return
+    def _run_replan(self, from_world: int, to_world: int, *,
+                    rates: Optional[Dict[str, float]] = None
+                    ) -> Optional[Dict[str, Any]]:
+        """Invoke the replan hook, heterogeneity-aware when it takes a
+        ``rates`` kwarg. Advisory by contract: any failure warns AND
+        emits the ``plan/replan_failed`` static (so a fleet whose
+        re-planning never succeeds shows up in ``summarize``), then
+        returns None — nothing on this path may block a restore."""
+        import inspect
         import warnings
+        if rates is None and self.rates is not None:
+            try:
+                rates = (self.rates() if callable(self.rates)
+                         else dict(self.rates))
+            except Exception:
+                rates = None
         try:
-            result = dict(self.replan(from_world, to_world))
-            replan = {"from_world": int(from_world),
-                      "to_world": int(to_world), **result}
-            new_step_s = float(result.get("new_step_s") or 0.0)
+            sig = inspect.signature(self.replan).parameters
+            takes_rates = ("rates" in sig or any(
+                p.kind == p.VAR_KEYWORD for p in sig.values()))
+            if rates and takes_rates:
+                result = dict(self.replan(from_world, to_world,
+                                          rates=rates))
+            else:
+                result = dict(self.replan(from_world, to_world))
         except Exception as e:
             # a hook returning a non-dict is as advisory as one that
             # raises — nothing on the replan path may block the restore
             warnings.warn(
                 f"apex_tpu.resilience: elastic replan hook failed "
                 f"({e}); continuing with the re-sharded layout")
+            _record("plan/replan_failed", 1.0, kind="counter",
+                    meta={"from_world": int(from_world),
+                          "to_world": int(to_world),
+                          "error": f"{type(e).__name__}: {e}"})
+            return None
+        return result
+
+    def _replan(self, from_world: int, to_world: int, step) -> None:
+        """Re-run the planner's cost model at the new membership and
+        record the old/new pick (``plan/replan``) — with measured member
+        rates wired (``rates=``), the pick carries the weight vector the
+        heterogeneous cost term chose."""
+        if self.replan is None:
             return
+        result = self._run_replan(from_world, to_world)
+        if result is None:
+            return
+        replan = {"from_world": int(from_world),
+                  "to_world": int(to_world), **result}
+        new_step_s = float(result.get("new_step_s") or 0.0)
         self.last_replan = replan
         _record("plan/replan", new_step_s, step=step, meta=dict(replan))

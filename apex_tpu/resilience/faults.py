@@ -45,7 +45,12 @@ Semantics:
   EVERY step at/after the trigger (``step:N:slow_node:MS`` — recurring,
   not one-shot: a straggler is a condition, not an event). The injected
   excess lands inside the step's host span, so the trace merge's
-  straggler attribution names the slowed process.
+  straggler attribution names the slowed process — and it inflates the
+  member's heartbeat-published step rate, so the degradation
+  supervisor (:mod:`apex_tpu.resilience.rebalance`) detects it,
+  rebalances the fleet to weighted shards, and ultimately evicts the
+  rank through the cooperative exit-75 leave (CI gate stage 16 drives
+  exactly this arc).
 
 Determinism: the ``step:N`` form is exact; the ``prob:p[:seed]`` form
 draws one seeded Bernoulli per ``fire`` call, so a given seed reproduces

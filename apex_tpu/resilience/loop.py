@@ -80,6 +80,7 @@ def resilient_loop(step_fn: Callable, state: Tree, data, *, steps: int,
                    resume: str = "auto",
                    layout: Optional[Dict[str, Any]] = None,
                    elastic: Optional[Any] = None,
+                   supervisor: Optional[Any] = None,
                    extra: Optional[Dict[str, Any]] = None,
                    injector: Optional[FaultInjector] = None,
                    handle_signals: bool = True,
@@ -128,6 +129,16 @@ def resilient_loop(step_fn: Callable, state: Tree, data, *, steps: int,
         from_world=...)``. Structurally incompatible snapshots still
         raise. ``layout=`` keeps meaning the fingerprint SAVED with new
         generations (the target layout).
+    supervisor:
+        A :class:`apex_tpu.resilience.rebalance.DegradationSupervisor`.
+        The loop feeds it every completed step; on a ``rebalance``
+        decision it drains the trainer and applies the weighted
+        re-shard + save (:func:`~apex_tpu.resilience.rebalance.
+        apply_rebalance` — needs ``elastic=`` and a snapshot manager);
+        on an ``evict`` decision targeting THIS member it requests
+        preemption, so the run takes its final snapshot and exits 75 —
+        the cooperative-leave contract the ``multiproc --elastic``
+        supervisor turns into a ``W-1`` relaunch.
     injector:
         Fault injector; default ``FaultInjector.from_env()`` (the
         ``APEX_TPU_FAULT`` env contract). ``fire(step)`` runs at the top
@@ -205,7 +216,9 @@ def resilient_loop(step_fn: Callable, state: Tree, data, *, steps: int,
                 if resharded:
                     trainer.notify_resume(
                         found.step, world=resharded["to_world"],
-                        from_world=resharded["from_world"])
+                        from_world=resharded["from_world"],
+                        weights=resharded.get("to_weights"),
+                        from_weights=resharded.get("from_weights"))
                 else:
                     trainer.notify_resume(found.step)
             if on_resume is not None:
@@ -273,6 +286,26 @@ def resilient_loop(step_fn: Callable, state: Tree, data, *, steps: int,
                 state, aux = out if (isinstance(out, tuple)
                                      and len(out) == 2) else (out, None)
                 step += 1
+            if supervisor is not None:
+                decision = supervisor.observe(step)
+                if decision.kind == "rebalance":
+                    from apex_tpu.resilience import rebalance as _rb
+                    if trainer is not None:
+                        trainer.drain()   # the re-map reads the state
+                    loader_state = getattr(data, "loader_state", None)
+                    _rb.apply_rebalance(
+                        mgr, elastic, state, step=step,
+                        weights=decision.weights, rates=decision.rates,
+                        straggler=decision.straggler,
+                        straggler_rank=decision.straggler_rank,
+                        loader=(loader_state()
+                                if callable(loader_state) else None),
+                        extra=extra)
+                elif decision.kind == "evict" and decision.evict_me:
+                    # cooperative self-eviction: the existing exit-75
+                    # path (final snapshot below, then the launcher
+                    # re-forms the fleet at W-1)
+                    pre.request(f"evict:{decision.reason}")
             if snapshot_every and step % snapshot_every == 0:
                 if trainer is not None:
                     trainer.drain()   # a snapshot never races in-flight work

@@ -1,0 +1,466 @@
+"""Heterogeneity-aware rebalancing + straggler degradation supervisor.
+
+PR 11 made a membership CHANGE survivable; this module makes a
+membership DEGRADATION survivable at speed. Until now a slow or weaker
+member silently rate-limited the whole fleet: the trace merge *names*
+the straggler and ``FaultInjector`` can *inject* one
+(``slow_node:ms``), but nothing *acted*. Three pieces close the loop
+(the AMP heterogeneity-aware strategy search, arXiv 2210.07297, is the
+blueprint):
+
+* **Capability/health profiles** — every member publishes
+  ``{peak_flops, step_s, steps}`` through its :class:`~apex_tpu.
+  parallel.multiproc.Rendezvous` heartbeat (declared peak FLOPs +
+  the measured rolling per-step rate), so the whole fleet sees who is
+  fast and who is falling behind (:func:`member_rates`).
+* **Weighted shard assignment** — the acting half: the ZeRO flat state
+  re-maps from equal ``1/W`` chunks to proportional fractions
+  (:func:`apex_tpu.resilience.elastic.weighted_fingerprint` /
+  ``spec_for``), keeping the ``gather(reshard(state)) ==
+  gather(state)`` **bitwise** contract. :func:`apply_rebalance`
+  performs the re-map — planner-picked weights when an
+  ``Elastic(replan=)`` hook is wired (the heterogeneous cost term,
+  :mod:`apex_tpu.plan.cost`), rate-proportional otherwise — verifies
+  the gather-compare per call, and persists the weighted generation so
+  every subsequent restore (including the eviction relaunch) re-shards
+  from the recorded assignment.
+* **The degradation supervisor** — :class:`DegradationSupervisor`, a
+  policy LADDER driven from ``resilient_loop(supervisor=...)``:
+
+  1. *detect*: a member whose rolling-median step time exceeds
+     ``threshold`` x the fleet median for ``hysteresis`` consecutive
+     observations is a SUSTAINED straggler (``rebalance/detect`` names
+     it; a single slow step never trips the median+hysteresis pair —
+     transient jitter must not flap the fleet).
+  2. *rebalance*: shrink the slow member's shard
+     (:func:`apply_rebalance`, ``rebalance/apply`` with the weight
+     vector) — at most once per ``cooldown`` observed steps.
+  3. *evict*: when degradation persists ``evict_after`` steps past the
+     first rebalance, the straggler leaves COOPERATIVELY — the existing
+     exit-75 contract (final snapshot, ``rendezvous.leave()``, the
+     ``multiproc --elastic`` supervisor re-forms at ``W-1`` and the
+     relaunch resumes through the deterministic re-shard).
+
+Honesty note (the simulation boundary, docs/resilience.md): inside one
+lock-step SPMD program every device executes the same instructions, so
+the weighted assignment cannot make the *traced* step cheaper on the
+CPU-simulated fleet — what it changes is the recorded member-ownership
+layout (``member_span``) that snapshots, restores, and a real
+heterogeneous multi-host deployment's host-level ZeRO consume. The
+machinery — detection, weighted re-map, bitwise contract, escalation —
+is exercised end to end by CI's injected-straggler arc either way.
+
+Defaults provably inert: no supervisor, no weighted spec -> bit-
+identical programs and byte-identical equal-shard fingerprints (the
+``weights`` key simply never exists).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import warnings
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence
+
+from apex_tpu.resilience import elastic as _elastic
+
+__all__ = ["MemberProfile", "Decision", "DegradationSupervisor",
+           "apply_rebalance", "member_rates", "weights_from_rates"]
+
+
+def _record(name, value, *, step=None, meta=None, kind="point"):
+    from apex_tpu import telemetry
+    if telemetry.enabled():
+        telemetry.record(name, value, step=step, meta=meta, kind=kind)
+
+
+# ---------------------------------------------------------------------------
+# capability/health profiles
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class MemberProfile:
+    """One member's capability + measured health, as published through
+    the rendezvous heartbeat (JSON-able; :meth:`to_dict` is the wire
+    form). ``peak_flops`` is DECLARED capability (``None`` = unknown);
+    ``step_s`` is the MEASURED rolling-median step wall time over the
+    supervisor's window — the live signal the ladder acts on."""
+
+    member: str
+    rank: int = 0
+    peak_flops: Optional[float] = None
+    step_s: Optional[float] = None
+    steps: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"member": self.member, "rank": int(self.rank),
+                "peak_flops": self.peak_flops,
+                "step_s": self.step_s, "steps": int(self.steps)}
+
+    @classmethod
+    def from_dict(cls, member: str, d: Any) -> "MemberProfile":
+        d = d if isinstance(d, dict) else {}
+        step_s = d.get("step_s")
+        return cls(member=member, rank=int(d.get("rank") or 0),
+                   peak_flops=d.get("peak_flops"),
+                   step_s=None if step_s is None else float(step_s),
+                   steps=int(d.get("steps") or 0))
+
+    @property
+    def rate(self) -> Optional[float]:
+        """Steps per second (None until measured)."""
+        if not self.step_s or self.step_s <= 0:
+            return None
+        return 1.0 / self.step_s
+
+
+def fleet_profiles(rendezvous) -> Dict[str, MemberProfile]:
+    """Every live member's :class:`MemberProfile` from the registry
+    (members that never published a profile appear with no
+    measurement)."""
+    return {m: MemberProfile.from_dict(m, p)
+            for m, p in rendezvous.profiles().items()}
+
+
+def member_rates(rendezvous, *, min_steps: int = 1
+                 ) -> Dict[str, float]:
+    """``{member: steps_per_s}`` over members with at least
+    ``min_steps`` measured steps — the ``rates=`` feed for
+    :class:`~apex_tpu.resilience.elastic.Elastic` and the planner's
+    heterogeneous cost term."""
+    out = {}
+    for m, p in fleet_profiles(rendezvous).items():
+        if p.rate is not None and p.steps >= min_steps:
+            out[m] = p.rate
+    return out
+
+
+def weights_from_rates(rates: Dict[str, float], *,
+                       granularity: int = 8) -> Optional[List[int]]:
+    """Rate-proportional integer weight vector, member order = dense
+    sorted member ids (the Rendezvous rank order). Each member's share
+    is quantized to ``granularity`` levels of the fastest member's rate
+    and floored at 1 (weight 0 is eviction's job); an all-equal result
+    canonicalizes to None (equal shards). The quantization also makes
+    the vector stable across members computing it from slightly
+    different heartbeat snapshots."""
+    if not rates:
+        return None
+    members = sorted(rates)
+    top = max(rates[m] for m in members)
+    if top <= 0:
+        return None
+    ws = [max(1, round(granularity * rates[m] / top)) for m in members]
+    return _elastic.normalize_weights(ws)
+
+
+# ---------------------------------------------------------------------------
+# the degradation supervisor
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Decision:
+    """One :meth:`DegradationSupervisor.observe` verdict. ``kind`` walks
+    the ladder: ``"none"`` / ``"rebalance"`` / ``"evict"``;
+    ``evict_me`` is True only on the straggler's own process (eviction
+    is a COOPERATIVE self-leave, never a remote kill)."""
+
+    kind: str
+    step: int
+    straggler: Optional[str] = None
+    straggler_rank: Optional[int] = None
+    ratio: Optional[float] = None          # straggler vs fleet median
+    weights: Optional[List[int]] = None
+    rates: Optional[Dict[str, float]] = None
+    evict_me: bool = False
+    reason: str = ""
+
+
+class DegradationSupervisor:
+    """Sustained-straggler detection + the rebalance/evict policy
+    ladder (module doc). One instance runs on EVERY member; decisions
+    are derived from the shared rendezvous profiles, so the fleet
+    converges on the same straggler without a coordinator.
+
+    Parameters
+    ----------
+    rendezvous:
+        The fleet's :class:`~apex_tpu.parallel.multiproc.Rendezvous`
+        (member mode — this process must have announced).
+    rank:
+        This member's rank (``multiproc.elastic_world()[1]``).
+    peak_flops:
+        Declared capability published in the profile (optional;
+        ``pyprof.device_peak_flops()`` is the usual source).
+    window:
+        Rolling window of own step times; the published ``step_s`` is
+        the window MEDIAN, so one slow step cannot move it (the
+        jitter-never-flaps pin).
+    threshold:
+        Straggler condition: member median step time > ``threshold`` x
+        the median over the OTHER members.
+    hysteresis:
+        Consecutive sustained observations required before the first
+        action — detection latency traded against flap immunity.
+    cooldown:
+        Minimum observed steps between rebalance actions.
+    evict_after:
+        Observed steps of CONTINUED degradation past the first
+        rebalance before the straggler self-evicts (the policy floor).
+    granularity:
+        Weight quantization levels (:func:`weights_from_rates`).
+    min_steps:
+        Profile measurements a member needs before it participates in
+        fleet statistics.
+    io_every:
+        Touch the rendezvous registry only every Nth observed step
+        (both the profile re-publish and the fleet read — otherwise
+        every member pays O(W) file reads per step, O(W^2) fleet-wide,
+        against a directory that is NFS/GCS-fuse on real pods).
+        Detection latency grows by at most ``io_every`` steps; the
+        default 1 keeps single-host fleets (and CI) exact.
+    """
+
+    def __init__(self, rendezvous, *, rank: int = 0,
+                 peak_flops: Optional[float] = None,
+                 window: int = 5, threshold: float = 1.5,
+                 hysteresis: int = 3, cooldown: int = 8,
+                 evict_after: int = 6, granularity: int = 8,
+                 min_steps: int = 2, io_every: int = 1,
+                 clock=time.perf_counter):
+        if window < 1 or hysteresis < 1 or cooldown < 1 \
+                or evict_after < 1 or io_every < 1:
+            raise ValueError(
+                "window/hysteresis/cooldown/evict_after/io_every must "
+                "all be >= 1")
+        if threshold <= 1.0:
+            raise ValueError(
+                f"threshold must be > 1.0 (a member is a straggler when "
+                f"SLOWER than the fleet median), got {threshold}")
+        self.rendezvous = rendezvous
+        self.rank = int(rank)
+        self.peak_flops = peak_flops
+        self.window = int(window)
+        self.threshold = float(threshold)
+        self.hysteresis = int(hysteresis)
+        self.cooldown = int(cooldown)
+        self.evict_after = int(evict_after)
+        self.granularity = int(granularity)
+        self.min_steps = int(min_steps)
+        self.io_every = int(io_every)
+        self._clock = clock
+        self._dts: deque = deque(maxlen=self.window)
+        self._last_t: Optional[float] = None
+        self._steps = 0
+        self._hot = 0                     # consecutive sustained obs
+        self._detected = False            # current episode announced?
+        self._last_rebalance: Optional[int] = None   # observation index
+        self._first_rebalance: Optional[int] = None
+        self._evicted = False
+        self.last_decision: Optional[Decision] = None
+
+    # -- own measurement + profile publication -----------------------------
+    def _own_step_s(self) -> Optional[float]:
+        if len(self._dts) < self.min_steps:
+            return None
+        dts = sorted(self._dts)
+        return float(dts[len(dts) // 2])   # median: jitter-immune
+
+    def _publish(self) -> None:
+        if self.rendezvous is None or self.rendezvous.member is None:
+            return
+        prof = MemberProfile(
+            member=self.rendezvous.member, rank=self.rank,
+            peak_flops=self.peak_flops, step_s=self._own_step_s(),
+            steps=self._steps)
+        try:
+            self.rendezvous.heartbeat(profile=prof.to_dict())
+        except OSError:
+            pass   # registry hiccups are liveness noise, not fatal
+
+    def rates(self) -> Dict[str, float]:
+        """Current fleet rates (the ``Elastic(rates=...)`` feed)."""
+        return member_rates(self.rendezvous, min_steps=self.min_steps)
+
+    # -- the ladder ---------------------------------------------------------
+    def observe(self, step: int,
+                step_s: Optional[float] = None) -> Decision:
+        """Feed one completed training step; returns the ladder's
+        decision. ``step_s`` overrides the internal inter-arrival
+        timing (tests; loops that already measure)."""
+        now = self._clock()
+        if step_s is not None:
+            self._dts.append(float(step_s))
+        elif self._last_t is not None:
+            self._dts.append(now - self._last_t)
+        self._last_t = now
+        self._steps += 1
+        if self._steps % self.io_every:
+            # registry-quiet step (io_every throttle): timing recorded,
+            # no publish, no fleet read, no decision
+            decision = Decision(kind="none", step=int(step))
+        else:
+            self._publish()
+            decision = self._evaluate(int(step))
+        self.last_decision = decision
+        return decision
+
+    def _evaluate(self, step: int) -> Decision:
+        none = Decision(kind="none", step=step)
+        if self._evicted:
+            return none
+        profiles = [p for p in fleet_profiles(self.rendezvous).values()
+                    if p.step_s is not None and p.steps >= self.min_steps]
+        if len(profiles) < 2:
+            self._hot = 0
+            return none
+        worst = max(profiles, key=lambda p: p.step_s)
+        others = sorted(p.step_s for p in profiles if p is not worst)
+        median_others = others[len(others) // 2]
+        if median_others <= 0:
+            self._hot = 0
+            return none
+        ratio = worst.step_s / median_others
+        if ratio <= self.threshold:
+            # healthy observation: the episode (and any pending
+            # escalation clock) resets — hysteresis means recovery is
+            # believed as slowly as degradation was
+            self._hot = 0
+            self._detected = False
+            self._first_rebalance = None
+            return none
+        self._hot += 1
+        if self._hot < self.hysteresis:
+            return none
+        rates = {p.member: p.rate for p in profiles
+                 if p.rate is not None}
+        base = dict(step=step, straggler=worst.member,
+                    straggler_rank=worst.rank, ratio=ratio, rates=rates)
+        if not self._detected:
+            # first sustained observation of this episode: NAME the
+            # straggler (the detect rung — CI greps this event)
+            self._detected = True
+            _record("rebalance/detect", float(worst.rank), step=step,
+                    meta={"straggler": worst.member,
+                          "straggler_rank": worst.rank,
+                          "step_s": worst.step_s,
+                          "fleet_median_s": median_others,
+                          "ratio": round(ratio, 3),
+                          "observer_rank": self.rank})
+        if self._first_rebalance is not None \
+                and self._steps - self._first_rebalance \
+                >= self.evict_after:
+            # the floor: rebalancing did not recover the fleet — the
+            # straggler leaves cooperatively (exit-75 arc)
+            self._evicted = True
+            _record("rebalance/evict", float(worst.rank), step=step,
+                    kind="counter",
+                    meta={"straggler": worst.member,
+                          "straggler_rank": worst.rank,
+                          "ratio": round(ratio, 3),
+                          "after_rebalance_steps":
+                              self._steps - self._first_rebalance,
+                          "observer_rank": self.rank})
+            return Decision(kind="evict",
+                            evict_me=(worst.rank == self.rank),
+                            reason=(f"sustained straggler "
+                                    f"{worst.member} (x{ratio:.2f}) "
+                                    f"past the rebalance floor"),
+                            **base)
+        if self._last_rebalance is not None \
+                and self._steps - self._last_rebalance < self.cooldown:
+            return none
+        self._last_rebalance = self._steps
+        if self._first_rebalance is None:
+            self._first_rebalance = self._steps
+        return Decision(kind="rebalance",
+                        weights=weights_from_rates(
+                            rates, granularity=self.granularity),
+                        reason=(f"sustained straggler {worst.member} "
+                                f"(x{ratio:.2f} the fleet median)"),
+                        **base)
+
+
+# ---------------------------------------------------------------------------
+# the rebalance action
+# ---------------------------------------------------------------------------
+
+def apply_rebalance(manager, elastic, state, *, step: int,
+                    weights: Optional[Sequence] = None,
+                    rates: Optional[Dict[str, float]] = None,
+                    straggler: Optional[str] = None,
+                    straggler_rank: Optional[int] = None,
+                    loader: Optional[Dict[str, Any]] = None,
+                    extra: Optional[Dict[str, Any]] = None
+                    ) -> Optional[Dict[str, Any]]:
+    """Shrink the slow member's shard: re-map the live training state
+    from the equal-shard layout to the WEIGHTED layout and persist it as
+    a snapshot generation recorded under the weighted fingerprint.
+
+    The weight vector is, in priority order: the planner's pick
+    (``elastic.planned_weights(rates)`` — the heterogeneous cost term
+    of :mod:`apex_tpu.plan.cost`, carried straight into the re-shard),
+    the caller's ``weights``, or :func:`weights_from_rates`. The re-map
+    is gather-compare verified BITWISE per call (``elastic.verify``),
+    and the ``rebalance/apply`` event records the vector + verification.
+
+    Degrade-don't-crash: every failure path warns + returns None — a
+    rebalance must never take down the training step that just
+    succeeded. Returns the applied-info dict on success."""
+    if manager is None or elastic is None:
+        warnings.warn(
+            "apex_tpu.resilience: rebalance decision without a "
+            "snapshot manager + elastic seam — nothing to apply")
+        return None
+    try:
+        target_eq = elastic.target_layout()
+        world = int(target_eq["shard_count"])
+        planned = None
+        if rates:
+            planned = elastic.planned_weights(rates)
+        if planned is not None:
+            weights = planned
+        elif weights is None and rates:
+            weights = weights_from_rates(rates)
+        canon = (None if weights is None
+                 else _elastic.normalize_weights(weights, world))
+        if canon is None:
+            warnings.warn(
+                "apex_tpu.resilience: rebalance resolved an EQUAL "
+                "weight vector — nothing to apply")
+            return None
+        wfp = _elastic.weighted_fingerprint(target_eq, canon)
+        src = _elastic.spec_for(elastic.params, target_eq)
+        dst = _elastic.spec_for(elastic.params, wfp)
+        t0 = time.perf_counter()
+        wstate = _elastic.reshard_tree(state, src, dst,
+                                       verify=elastic.verify)
+        reshard_s = time.perf_counter() - t0
+        # loader= rides the manifest exactly like the loop's cadence
+        # saves: the weighted generation IS the newest restore source
+        # (the eviction relaunch restores from it), so dropping the
+        # data-loader offset here would silently replay consumed data
+        ok = manager.save(wstate, step=int(step), layout=wfp,
+                          loader=loader,
+                          extra=dict(extra or {}, rebalance={
+                              "weights": canon,
+                              "straggler": straggler,
+                              "straggler_rank": straggler_rank}))
+    except Exception as e:
+        warnings.warn(
+            f"apex_tpu.resilience: rebalance apply failed ({e}); "
+            "continuing on the equal-shard layout")
+        _record("rebalance/failed", 1.0, step=step, kind="counter",
+                meta={"error": f"{type(e).__name__}: {e}"})
+        return None
+    spans = [list(_elastic.member_span(dst, r)) for r in range(world)]
+    info = {"weights": canon, "world": world,
+            "planned": planned is not None,
+            "straggler": straggler, "straggler_rank": straggler_rank,
+            "member_spans": spans,
+            "verified": bool(elastic.verify),
+            "reshard_s": round(reshard_s, 6), "saved": bool(ok),
+            "step": int(step)}
+    _record("rebalance/apply", float(world), step=step, meta=info)
+    return info

@@ -452,15 +452,47 @@ def summarize(events: List[Dict[str, Any]], *,
             resil["superseded_samples"] = superseded
     # elastic membership changes: one resilience/reshard marker per
     # world-size re-map (emitted by resilience.elastic next to the
-    # resume marker), meta carries from/to worlds
-    reshards = [{"step": e.get("step"),
-                 "from_world": (e.get("meta") or {}).get("from_world"),
-                 "to_world": (e.get("meta") or {}).get("to_world"),
-                 "generation": (e.get("meta") or {}).get("generation")}
-                for e in events
-                if e.get("name", "").endswith("resilience/reshard")]
+    # resume marker), meta carries from/to worlds (+ weight vectors
+    # when the re-map crossed a weighted layout)
+    reshards = []
+    for e in events:
+        if not e.get("name", "").endswith("resilience/reshard"):
+            continue
+        m = e.get("meta") or {}
+        row = {"step": e.get("step"),
+               "from_world": m.get("from_world"),
+               "to_world": m.get("to_world"),
+               "generation": m.get("generation")}
+        if m.get("from_weights") or m.get("to_weights"):
+            row["from_weights"] = m.get("from_weights")
+            row["to_weights"] = m.get("to_weights")
+        reshards.append(row)
     if reshards:
         resil["reshards"] = reshards
+    # the degradation supervisor's policy ladder (producer:
+    # resilience.rebalance): sustained-straggler detections, applied
+    # weighted re-shards, and evictions — plus the replan-failure
+    # counter, so a fleet that never successfully re-plans is visible
+    # here rather than only on a scrolled-away stderr warning
+    for name, key, fields in (
+            ("rebalance/detect", "rebalance_detects",
+             ("straggler", "straggler_rank", "ratio")),
+            ("rebalance/apply", "rebalance_applies",
+             ("weights", "straggler", "straggler_rank", "verified",
+              "saved", "planned")),
+            ("rebalance/evict", "rebalance_evicts",
+             ("straggler", "straggler_rank", "ratio",
+              "after_rebalance_steps"))):
+        rows = [dict({"step": e.get("step")},
+                     **{f: (e.get("meta") or {}).get(f)
+                        for f in fields})
+                for e in events if e.get("name", "").endswith(name)]
+        if rows:
+            resil[key] = rows
+    replan_failed = sum(
+        v for n, v in counters.items() if n.endswith("plan/replan_failed"))
+    if replan_failed:
+        resil["replan_failures"] = int(replan_failed)
     snap_s = [v for name, vs in series.items()
               if name.endswith("resilience/snapshot_s") for v in vs]
     if snap_s:
@@ -908,10 +940,45 @@ def format_summary(s: Dict[str, Any]) -> str:
             lines.append(f"  resumed from generation {rp['generation']}"
                          f" at step {rp['step']}")
         for rs in r.get("reshards", []):
+            wtag = ""
+            if "from_weights" in rs or "to_weights" in rs:
+                def _w(v):
+                    return ("equal" if not v
+                            else ":".join(str(x) for x in v))
+                wtag = (f", weights {_w(rs.get('from_weights'))} -> "
+                        f"{_w(rs.get('to_weights'))}")
             lines.append(
                 f"  elastic reshard world {rs['from_world']} -> "
                 f"{rs['to_world']} at step {rs['step']} (deterministic "
-                "re-map, gather-verified)")
+                f"re-map, gather-verified{wtag})")
+        for d in r.get("rebalance_detects", []):
+            lines.append(
+                f"  straggler detected: member {d['straggler']} "
+                f"(rank {d['straggler_rank']}) at step {d['step']}"
+                + (f", x{d['ratio']:.2f} the fleet median"
+                   if d.get("ratio") else ""))
+        for a in r.get("rebalance_applies", []):
+            w = a.get("weights")
+            lines.append(
+                f"  rebalanced to weights "
+                f"{':'.join(str(x) for x in w) if w else '?'} at step "
+                f"{a['step']} ("
+                + ("planner-picked" if a.get("planned")
+                   else "rate-proportional")
+                + (", gather-verified bitwise" if a.get("verified")
+                   else ", UNVERIFIED")
+                + (", persisted" if a.get("saved") else ", save FAILED")
+                + ")")
+        for ev in r.get("rebalance_evicts", []):
+            lines.append(
+                f"  EVICTED straggler member {ev['straggler']} "
+                f"(rank {ev['straggler_rank']}) at step {ev['step']} — "
+                "degradation persisted past the rebalance floor")
+        if r.get("replan_failures"):
+            lines.append(
+                f"  {r['replan_failures']} replan FAILURE(s) — the "
+                "planner hook never produced a pick (see "
+                "plan/replan_failed meta)")
         if r.get("superseded_samples"):
             lines.append(
                 f"  {r['superseded_samples']} pre-resume samples of "

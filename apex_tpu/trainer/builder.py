@@ -343,7 +343,9 @@ class Trainer:
         self._user_on_step = cb
 
     def notify_resume(self, step: int, *, world: Optional[int] = None,
-                      from_world: Optional[int] = None) -> None:
+                      from_world: Optional[int] = None,
+                      weights: Optional[Any] = None,
+                      from_weights: Optional[Any] = None) -> None:
         """Re-anchor the global step index after a snapshot restore and
         fan out to every plugin's ``on_resume`` (telemetry re-attributes
         its ``step/*`` series; see docs/trainer.md).
@@ -353,16 +355,24 @@ class Trainer:
         re-anchors identically, and a ``trainer/resume`` event records
         the membership change so the post-resume ``step/*`` series is
         attributable to its new world (per-step comm bytes, MFU and
-        tokens/s all change meaning when the world does)."""
+        tokens/s all change meaning when the world does).
+        ``weights``/``from_weights`` record a weighted-shard crossing
+        (heterogeneity-aware rebalancing — None means equal shards)
+        for the same reason: a member's share of the optimizer bill
+        changes meaning when its assignment does."""
         self.step_index = int(step)
         if world is not None:
             from apex_tpu import telemetry
             if telemetry.enabled():
+                meta = {"world": int(world),
+                        "from_world": (None if from_world is None
+                                       else int(from_world))}
+                if weights is not None or from_weights is not None:
+                    meta["weights"] = weights
+                    meta["from_weights"] = from_weights
                 telemetry.record(
                     "trainer/resume", float(step), step=int(step),
-                    meta={"world": int(world),
-                          "from_world": (None if from_world is None
-                                         else int(from_world))})
+                    meta=meta)
         for p in self.plugins:
             hook = getattr(p, "on_resume", None)
             if hook is not None:

@@ -12,7 +12,7 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "== 1/17 package import =="
+echo "== 1/18 package import =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import apex_tpu
@@ -20,7 +20,7 @@ from apex_tpu import amp, optimizers, parallel, ops
 print('apex_tpu imports OK')
 "
 
-echo "== 2/17 native host runtime builds (g++ -O3 -shared) =="
+echo "== 2/18 native host runtime builds (g++ -O3 -shared) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 from apex_tpu import runtime
@@ -35,7 +35,7 @@ print('flatten/unflatten path OK')
 assert ok, 'host runtime failed to build — check g++ toolchain'
 "
 
-echo "== 3/17 graft entry compiles (single-device + 8-device dryrun) =="
+echo "== 3/18 graft entry compiles (single-device + 8-device dryrun) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import __graft_entry__ as ge
@@ -45,7 +45,7 @@ print('entry() compiles')
 ge.dryrun_multichip(8)
 "
 
-echo "== 4/17 package install (wheel build + clean --target install) =="
+echo "== 4/18 package install (wheel build + clean --target install) =="
 # The reference gates on Docker extension builds
 # (tests/docker_extension_builds/run.sh); the TPU analog: build the wheel
 # from pyproject.toml, install it into an empty --target dir, and import
@@ -88,7 +88,7 @@ jax.jit(step).lower(params, state).compile()
 print('installed-package train step compiles')
 ")
 
-echo "== 5/17 lint (apex_tpu.lint: trace safety / dtype policy / collectives / SPMD) =="
+echo "== 5/18 lint (apex_tpu.lint: trace safety / dtype policy / collectives / SPMD) =="
 # static gate BEFORE the test tier: AST pass over the package + graft
 # entry, jaxpr pass over the registered entry points, SPMD verifier
 # (APX2xx) over the same entries. --strict: warnings fail too (every
@@ -96,7 +96,7 @@ echo "== 5/17 lint (apex_tpu.lint: trace safety / dtype policy / collectives / S
 # see docs/lint.md). Use --format=github under CI bots.
 python -m apex_tpu.lint apex_tpu/ __graft_entry__.py --strict --spmd
 
-echo "== 6/17 spmd verifier (builtin-entry sweep + committed deadlock fixture) =="
+echo "== 6/18 spmd verifier (builtin-entry sweep + committed deadlock fixture) =="
 # the whole-program SPMD gate, at the API layer: every registered entry
 # (ddp / zero / overlap / trainer-built / fused kernels / graft) must
 # verify clean, AND the analyzer must still catch the canonical
@@ -141,7 +141,7 @@ print('static donation == runtime DonationReport '
       f'({sd.aliased}/{sd.declared} aliased)')
 "
 
-echo "== 7/17 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
+echo "== 7/18 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
 # A 3-step instrumented GPT train step on the CPU mesh must produce a
 # parseable JSONL carrying step timing, amp loss-scale/overflow, comm
 # bytes and MFU, and the summarize CLI must render it (exit 0) — the
@@ -214,7 +214,7 @@ fi
 echo "health CLI gate OK (healthy=0, injected-NaN=nonzero)"
 rm -rf "$(dirname "$HLT_FILE")"
 
-echo "== 8/17 tune smoke (sweep dry-run + auto-policy tuned train) =="
+echo "== 8/18 tune smoke (sweep dry-run + auto-policy tuned train) =="
 # The autotuner must be drivable offline (sweep plan renders, exit 0) and
 # inline: a 3-step train whose kernels resolve their configs through
 # apex_tpu.tune under APEX_TPU_TUNE=auto. On this CPU backend measurement
@@ -291,7 +291,7 @@ print(f'tune smoke OK: {len(tuned)} tune/* series, '
 " "$TUNE_DIR/tune_run.jsonl" "$TUNE_DIR/cache"
 rm -rf "$TUNE_DIR"
 
-echo "== 9/17 resilience smoke (snapshot -> injected kill -> auto-resume) =="
+echo "== 9/18 resilience smoke (snapshot -> injected kill -> auto-resume) =="
 # Kill-and-resume end to end: a 6-step train snapshotting every 2 steps is
 # SIGKILLed by the fault injector at the top of step 4 (exit 137 — an
 # abrupt death, no final snapshot), then the SAME command with --resume
@@ -348,7 +348,7 @@ python -m apex_tpu.telemetry summarize "$RES_DIR/resume.jsonl" \
     || { echo "summarize did not report the resume point" >&2; exit 1; }
 rm -rf "$RES_DIR"
 
-echo "== 10/17 overlap smoke (staged backward + bf16 wire vs fp32 baseline) =="
+echo "== 10/18 overlap smoke (staged backward + bf16 wire vs fp32 baseline) =="
 # The overlap engine end to end on the 8-device CPU mesh: a 3-step fp32
 # baseline train and the same train under --overlap --reduce-dtype bf16
 # must (a) land within 1e-2 of each other's final loss (the compression
@@ -404,7 +404,7 @@ python -m apex_tpu.telemetry summarize "$OVL_DIR/bf16.jsonl" \
     || { echo "summarize did not render overlap efficiency" >&2; exit 1; }
 rm -rf "$OVL_DIR"
 
-echo "== 11/17 profile smoke (capture -> attribution report -> compare gate) =="
+echo "== 11/18 profile smoke (capture -> attribution report -> compare gate) =="
 # The attribution profiler end to end on the CPU backend: a 3-step train
 # with --profile must produce a capture logdir whose offline report
 # parses with nonzero compute time and carries the named
@@ -465,7 +465,7 @@ fi
 echo "compare gate OK (identical=0, doctored-slower=4)"
 rm -rf "$PROF_DIR"
 
-echo "== 12/17 trace smoke (host spans -> unified timeline -> merge/stragglers) =="
+echo "== 12/18 trace smoke (host spans -> unified timeline -> merge/stragglers) =="
 # The host-tracing layer end to end: a 3-step --trace train must emit
 # parseable span/* begin/end pairs, the unified host+device timeline
 # must export as valid Chrome-trace JSON with BOTH lanes populated,
@@ -538,7 +538,7 @@ grep -q "worst: p" "$TRC_DIR/merged.txt" \
 echo "trace smoke OK (spans + timeline + reconciliation + 2-process merge)"
 rm -rf "$TRC_DIR"
 
-echo "== 13/17 trainer smoke (compiled-step builder: pipelined dispatch + donation audit) =="
+echo "== 13/18 trainer smoke (compiled-step builder: pipelined dispatch + donation audit) =="
 # The compiled trainer end to end: a 3-step train_lm built through
 # apex_tpu.trainer with telemetry+trace on must (a) emit balanced
 # span/* begin/end pairs (the in-flight window's trainer/retire spans
@@ -583,7 +583,7 @@ grep -q "donation audit: .* 0 refused" "$TRN_DIR/out.txt" \
     || { echo "train_lm did not print the donation audit" >&2; exit 1; }
 rm -rf "$TRN_DIR"
 
-echo "== 14/17 fused-kernel regression (Pallas xentropy vs unfused + epilogue/mt scopes) =="
+echo "== 14/18 fused-kernel regression (Pallas xentropy vs unfused + epilogue/mt scopes) =="
 # The fused-kernel tier end to end (docs/kernels.md): the SAME 3-step GPT
 # train profiled unfused and fused (Pallas xentropy in the loss scope)
 # must (a) surface the apex_xentropy scope in the fused breakdown,
@@ -684,7 +684,7 @@ print('conv epilogue + mt flat: parity + capture scopes OK')
 echo "fused-kernel gate OK (scopes + parity + compare exit 0)"
 rm -rf "$KRN_DIR"
 
-echo "== 15/17 elastic smoke (2-process node_loss -> re-shard resume at world 1) =="
+echo "== 15/18 elastic smoke (2-process node_loss -> re-shard resume at world 1) =="
 # Elastic membership end to end (docs/resilience.md "Elastic
 # membership"): a 2-member ZeRO fleet under the multiproc --elastic
 # supervisor loses rank 1 to an injected node_loss SIGKILL at step 3;
@@ -746,7 +746,87 @@ python -m apex_tpu.resilience inspect "$ELA_DIR/snap-r0" --check 1 \
          exit 1; }
 rm -rf "$ELA_DIR"
 
-echo "== 16/17 plan smoke (auto ranked table -> lint-clean pick -> 3-step train) =="
+echo "== 16/18 rebalance smoke (slow_node straggler -> weighted re-shard -> exit-75 eviction -> world 1) =="
+# Heterogeneity-aware rebalancing end to end (docs/resilience.md
+# "Rebalancing"): rank 1 is an injected straggler (slow_node: +250 ms
+# on every step >= 2 while the base step is ~60 ms). The degradation
+# supervisor must NAME the faulted rank (rebalance/detect), rebalance
+# to an UNEQUAL weight vector with the bitwise gather contract verified
+# per call (rebalance/apply meta), and — the straggler persisting past
+# the policy floor — escalate to the cooperative exit-75 eviction: the
+# multiproc supervisor re-forms the fleet at world 1 and the relaunch
+# resumes through the deterministic re-shard. The inspect CLI must
+# render the persisted weighted generation's shard fractions.
+RB_DIR="$(mktemp -d)"
+rc=0
+APEX_TPU_FAULT=step:2:slow_node:250 \
+python -m apex_tpu.parallel.multiproc --elastic 2 \
+    --rendezvous "$RB_DIR/rdzv" --grace 120 -- \
+    python tests/elastic_worker.py --steps 60 --snap-every 4 \
+    --snap "$RB_DIR/snap-r{rank}" --out "$RB_DIR/out-r{rank}.npz" \
+    --telemetry "$RB_DIR/tel-r{rank}.jsonl" \
+    --resume auto --step-ms 60 --keep-last 50 \
+    --supervise --sup-evict-after 3 \
+    > "$RB_DIR/supervisor.out" || rc=$?
+if [[ "$rc" -ne 0 ]]; then
+    echo "rebalance: supervisor did not complete (rc=$rc)" >&2
+    cat "$RB_DIR/supervisor.out" >&2
+    exit 1
+fi
+grep -q "left ranks \[1\]" "$RB_DIR/supervisor.out" \
+    || { echo "rebalance: straggler did not leave cooperatively" >&2; \
+         cat "$RB_DIR/supervisor.out" >&2; exit 1; }
+grep -q "re-forming at world 1" "$RB_DIR/supervisor.out" \
+    || { echo "rebalance: fleet did not re-form at world 1" >&2; \
+         exit 1; }
+python - "$RB_DIR" <<'PY'
+import json, sys
+import numpy as np
+d = sys.argv[1]
+out = np.load(d + '/out-r0.npz')
+assert int(out['world']) == 1, f'final run not at world 1: {out["world"]}'
+assert int(out['resumed_from']) >= 0, 'relaunched run did not restore'
+steps = sorted(int(s) for s, _ in out['losses'])
+assert steps and steps[-1] == 59, f'resumed run did not complete: {steps[-5:]}'
+by = {}
+for line in open(d + '/tel-r0.jsonl'):
+    row = json.loads(line)              # every line must parse
+    by.setdefault(row['name'], []).append(row)
+det = by['rebalance/detect'][0]['meta']
+assert det['straggler_rank'] == 1, det   # NAMES the injected straggler
+app = by['rebalance/apply'][0]['meta']
+w = app['weights']
+assert w and len(set(w)) > 1, f'weight vector not unequal: {w}'
+assert app['verified'], app              # bitwise gather contract, per call
+assert app['saved'], app                 # weighted generation persisted
+assert app['straggler_rank'] == 1, app
+ev = by['rebalance/evict'][0]['meta']
+assert ev['straggler_rank'] == 1, ev     # escalation reached the floor
+rs = by['resilience/reshard'][-1]['meta']
+assert rs['from_world'] == 2 and rs['to_world'] == 1 and rs['verified'], rs
+assert 'resilience/resume' in by, sorted(by)
+print(f'rebalance smoke OK: straggler rank 1 detected (x{det["ratio"]}), '
+      f'rebalanced to weights {w} (gather-verified), evicted after '
+      f'{ev["after_rebalance_steps"]} steps, re-shard {rs["from_world"]} -> '
+      f'{rs["to_world"]} resumed to step 59')
+PY
+# the persisted weighted generation renders with shard fractions, and
+# the summarize resilience section shows the whole ladder
+python -m apex_tpu.resilience inspect "$RB_DIR/snap-r0" \
+    | grep -Eq "weights [0-9]+:[0-9]+ \([0-9.]+%" \
+    || { echo "inspect did not render the weighted generation" >&2; \
+         python -m apex_tpu.resilience inspect "$RB_DIR/snap-r0" >&2; \
+         exit 1; }
+python -m apex_tpu.telemetry summarize "$RB_DIR/tel-r0.jsonl" \
+    > "$RB_DIR/summary.out"
+grep -q "straggler detected" "$RB_DIR/summary.out" \
+    && grep -q "rebalanced to weights" "$RB_DIR/summary.out" \
+    && grep -q "EVICTED straggler" "$RB_DIR/summary.out" \
+    || { echo "summarize missing the rebalance ladder" >&2; \
+         cat "$RB_DIR/summary.out" >&2; exit 1; }
+rm -rf "$RB_DIR"
+
+echo "== 17/18 plan smoke (auto ranked table -> lint-clean pick -> 3-step train) =="
 # The parallelism planner end to end (docs/plan.md): `plan auto` on the
 # GPT example shape over the 8-device CPU mesh must produce a parseable
 # ranked candidate table, the top pick must pass lint.spmd clean (the
@@ -836,7 +916,7 @@ else:
 PY
 rm -rf "$PLAN_DIR"
 
-echo "== 17/17 pytest =="
+echo "== 18/18 pytest =="
 if [[ "${1:-}" == "--full" ]]; then
     # full suite + the complete L1 cross-product matrix (reference
     # tests/L1/cross_product{,_distributed}/run.sh); the convergence
@@ -851,6 +931,7 @@ else
         tests/test_amp.py tests/test_param_groups.py tests/test_zero.py \
         tests/test_checkpoint.py tests/test_runtime.py tests/test_tune.py \
         tests/test_resilience.py tests/test_elastic.py \
+        tests/test_rebalance.py \
         tests/test_overlap.py \
         tests/test_trainer.py tests/test_kernels.py \
         tests/test_pyprof.py tests/test_trace.py \

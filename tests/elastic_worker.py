@@ -14,6 +14,15 @@ set by hand for the fresh-run baseline.
 Usage: python elastic_worker.py --steps N --snap DIR --out OUT.npz
          [--telemetry PATH] [--resume auto|none] [--snap-every K]
          [--step-ms MS] [--chunk N]
+         [--supervise] [--sup-window W] [--sup-threshold X]
+         [--sup-hysteresis H] [--sup-cooldown C] [--sup-evict-after E]
+
+``--supervise`` runs the degradation supervisor
+(apex_tpu.resilience.rebalance) over the rendezvous profiles: a
+sustained straggler (e.g. the ``slow_node`` fault) is detected, the
+fleet rebalances to weighted shards (gather-verified, persisted), and
+a persisting straggler self-evicts through the exit-75 cooperative
+leave — the CI rebalance smoke drives exactly this path.
 
 Writes OUT.npz with the (step, loss) trajectory observed by THIS
 process, the final replicated params, and the CANONICAL (unsharded,
@@ -50,6 +59,18 @@ def main() -> None:
                     help="host-side sleep per step — makes the node-loss "
                     "window deterministic in the supervisor tests")
     ap.add_argument("--chunk", type=int, default=256)
+    ap.add_argument("--keep-last", type=int, default=None,
+                    help="snapshot retention (default: manager's) — the "
+                    "rebalance smoke keeps everything so the weighted "
+                    "generation survives for inspection")
+    ap.add_argument("--supervise", action="store_true",
+                    help="run the degradation supervisor (needs the "
+                    "rendezvous env from multiproc --elastic)")
+    ap.add_argument("--sup-window", type=int, default=3)
+    ap.add_argument("--sup-threshold", type=float, default=1.5)
+    ap.add_argument("--sup-hysteresis", type=int, default=2)
+    ap.add_argument("--sup-cooldown", type=int, default=4)
+    ap.add_argument("--sup-evict-after", type=int, default=4)
     args = ap.parse_args()
 
     from apex_tpu import parallel, resilience, telemetry
@@ -115,12 +136,30 @@ def main() -> None:
             time.sleep(args.step_ms / 1e3)
         return train_step(st, x)
 
+    supervisor = None
+    if args.supervise:
+        if rdzv is None:
+            print("elastic_worker: --supervise needs the rendezvous "
+                  "env (multiproc --elastic --rendezvous DIR)",
+                  file=sys.stderr)
+            sys.exit(2)
+        supervisor = resilience.DegradationSupervisor(
+            rdzv, rank=rank,
+            window=args.sup_window, threshold=args.sup_threshold,
+            hysteresis=args.sup_hysteresis, cooldown=args.sup_cooldown,
+            evict_after=args.sup_evict_after)
+
+    mgr_kwargs = {}
+    if args.keep_last is not None:
+        mgr_kwargs["keep_last"] = args.keep_last
     result = resilience.resilient_loop(
         step_fn, (params, zstate), make_x, steps=args.steps,
         snapshot_dir=args.snap, snapshot_every=args.snap_every,
         resume=args.resume, layout=layout,
         elastic=resilience.Elastic(opt, params),
-        on_step=lambda i, st, loss: losses.append((i, float(loss))))
+        supervisor=supervisor,
+        on_step=lambda i, st, loss: losses.append((i, float(loss))),
+        **mgr_kwargs)
 
     if result.preempted and rdzv is not None:
         rdzv.leave()   # cooperative departure: next world() excludes us

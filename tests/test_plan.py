@@ -571,3 +571,38 @@ def test_resolve_buckets_sane_range(desc):
     assert resolve_buckets(desc, Layout(dp=4, tp=2)).ddp_bucket is None
     z = resolve_buckets(desc, Layout(dp=8, zero=2))
     assert z.zero_chunk is not None and z.ddp_bucket is None
+
+
+def test_build_defers_param_materialization(monkeypatch):
+    """The ROADMAP item-2 satellite: adapter.build touches ONLY avals —
+    the concrete (seeded) param init is deferred to the winner's
+    init_state, so the top_k trace tier never pays per-candidate full
+    param inits."""
+    ad = plan.GPTAdapter(vocab=64, layers=1, embed=32, heads=2,
+                         batch=8, seq=32)
+    calls = []
+    orig = plan.GPTAdapter._dense_params
+
+    def spy(self):
+        calls.append(1)
+        return orig(self)
+
+    monkeypatch.setattr(plan.GPTAdapter, "_dense_params", spy)
+    for lay in (Layout(dp=2), Layout(dp=2, zero=2, zero_chunk=256)):
+        calls.clear()
+        built = ad.build(lay, devices=jax.devices()[:2])
+        assert not calls, \
+            f"build({lay.layout_id()}) materialized concrete params"
+        # every build-time aval is abstract, no device arrays
+        for leaf in jax.tree_util.tree_leaves(built.state_avals):
+            assert isinstance(leaf, jax.ShapeDtypeStruct), type(leaf)
+        state = built.init_state()
+        assert calls, "init_state() did not materialize"
+        assert all(hasattr(l, "addressable_shards") or
+                   isinstance(l, jax.Array)
+                   for l in jax.tree_util.tree_leaves(state))
+    # resnet rides the same contract (eval_shape'd init)
+    rad = plan.ResNetAdapter(image=8, classes=4, batch=8)
+    rbuilt = rad.build(Layout(dp=2), devices=jax.devices()[:2])
+    for leaf in jax.tree_util.tree_leaves(rbuilt.state_avals):
+        assert isinstance(leaf, jax.ShapeDtypeStruct), type(leaf)

@@ -1,0 +1,840 @@
+"""Heterogeneity-aware rebalancing tests: the weighted ZeRO shard
+assignment (bitwise gather-compare round-trips on REAL trained state —
+60/40 two-member, 3-member uneven, weighted→equal and back,
+chunk-boundary-straddling fractions), the layout-fingerprint restore
+guard for weighted specs, the degradation supervisor's policy ladder
+(hysteresis: a single slow step never triggers; a sustained straggler
+triggers exactly once per cooldown; escalation to the cooperative
+eviction), the planner's heterogeneous cost term + acting replanner,
+the rendezvous profile channel, the inspect CLI weighted rendering,
+and the off-switch pins (equal fingerprints byte-identical, supervisor
+construction traces nothing)."""
+
+import json
+import os
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu import parallel, resilience, telemetry
+from apex_tpu.contrib.optimizers import DistributedFusedAdam
+from apex_tpu.parallel import multiproc
+from apex_tpu.plan import cost as plan_cost
+from apex_tpu.resilience import elastic, rebalance
+
+
+def tree_params(key=None):
+    ks = jax.random.split(key or jax.random.PRNGKey(3), 3)
+    # sizes deliberately NOT divisible by any world size in play, so
+    # every bucket carries world-dependent padding
+    return {"w1": jax.random.normal(ks[0], (37, 11)),
+            "w2": jax.random.normal(ks[1], (501,)),
+            "b": jax.random.normal(ks[2], (3,))}
+
+
+def train_zero(world, params, *, steps=3, chunk=256):
+    """Real ZeRO training at ``world``; returns (opt, final ZeroState)
+    with genuinely nonzero fp32 masters and both Adam moments."""
+    mesh = parallel.reform_mesh(world)
+    opt = DistributedFusedAdam(lr=0.05, shard_count=world,
+                               chunk_elements=chunk)
+    state = opt.init(params)
+    specs = opt.state_pspec()
+    step = jax.jit(shard_map(
+        opt.step, mesh=mesh, in_specs=(P(), P(), specs),
+        out_specs=(P(), specs), check_vma=False))
+    state = jax.device_put(state, jax.tree_util.tree_map(
+        lambda sp: NamedSharding(mesh, sp), specs))
+    p = params
+    for i in range(steps):
+        ks = jax.random.split(jax.random.PRNGKey(100 + i), len(params))
+        grads = {name: jax.random.normal(k, v.shape, jnp.float32)
+                 for k, (name, v) in zip(ks, sorted(params.items()))}
+        p, state = step(grads, p, state)
+    return opt, state
+
+
+# ---------------------------------------------------------------------------
+# weight grammar + apportionment
+# ---------------------------------------------------------------------------
+
+def test_parse_and_normalize_weights():
+    assert elastic.parse_weights("3:1") == [3, 1]
+    assert elastic.parse_weights("60,40") == [60, 40]
+    assert elastic.normalize_weights([60, 40]) == [3, 2]
+    assert elastic.normalize_weights([6, 2]) == [3, 1]
+    # equal vectors canonicalize to None — the ABSENT-key fingerprint
+    assert elastic.normalize_weights([1, 1]) is None
+    assert elastic.normalize_weights([4, 4, 4]) is None
+    with pytest.raises(ValueError, match="positive integers"):
+        elastic.parse_weights("3:x")
+    with pytest.raises(ValueError, match="eviction"):
+        elastic.normalize_weights([3, 0])
+    with pytest.raises(ValueError, match="2 entries for world 3"):
+        elastic.normalize_weights([3, 1], 3)
+
+
+def test_apportion_exact_and_deterministic():
+    for total in (0, 1, 7, 256, 911):
+        for ws in ([1, 1], [3, 1], [5, 2, 1], [8, 1, 1, 1]):
+            parts = elastic.apportion(total, ws)
+            assert sum(parts) == total
+            assert parts == elastic.apportion(total, ws)
+            # within 1 of the real-valued share
+            s = sum(ws)
+            for p, w in zip(parts, ws):
+                assert abs(p - total * w / s) < 1.0 + 1e-9
+
+
+def test_weighted_fingerprint_equal_is_byte_identical():
+    """The off-switch pin: no weights -> the fingerprint has NO weights
+    key and equals the pre-rebalance form exactly."""
+    params = tree_params()
+    fp = DistributedFusedAdam(
+        shard_count=2, chunk_elements=256).layout_fingerprint(params)
+    assert set(fp) == {"chunk_elements", "shard_count", "total",
+                       "padded", "n_buckets", "structure_crc32"}
+    assert elastic.weighted_fingerprint(fp, None) == fp
+    assert elastic.weighted_fingerprint(fp, [2, 2]) == fp
+    wfp = elastic.weighted_fingerprint(fp, [3, 1])
+    assert wfp["weights"] == [3, 1]
+    assert {k: v for k, v in wfp.items() if k != "weights"} == fp
+    # weighted weighting is idempotent and re-weightable
+    assert elastic.weighted_fingerprint(wfp, None) == fp
+    assert elastic.weighted_fingerprint(wfp, [1, 3])["weights"] == [1, 3]
+
+
+# ---------------------------------------------------------------------------
+# the acceptance pin: weighted bitwise gather round-trips on real state
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("world,weights", [
+    (2, [3, 2]),          # the 60/40 two-member split
+    (3, [5, 2, 1]),       # 3-member uneven
+    (2, [8, 1]),          # extreme skew: small buckets apportion to 0
+])
+def test_weighted_reshard_gather_bitwise(world, weights):
+    params = tree_params()
+    opt, state = train_zero(world, params)
+    fp = opt.layout_fingerprint(params)
+    wfp = elastic.weighted_fingerprint(fp, weights)
+    eq_spec = elastic.spec_for(params, fp)
+    w_spec = elastic.spec_for(params, wfp)
+    out = elastic.reshard_state(state, eq_spec, w_spec)
+    assert out.master.shape == (fp["padded"],)   # padded UNCHANGED
+    for field in ("master", "exp_avg", "exp_avg_sq"):
+        a = elastic.unshard(np.asarray(getattr(state, field)), eq_spec)
+        b = elastic.unshard(np.asarray(getattr(out, field)), w_spec)
+        np.testing.assert_array_equal(a, b, err_msg=field)
+        assert np.any(a != 0), f"{field} trivially zero"
+    # ...and back: weighted -> equal recovers the canonical form
+    back = elastic.reshard_state(out, w_spec, eq_spec)
+    np.testing.assert_array_equal(
+        elastic.unshard(np.asarray(state.master), eq_spec),
+        elastic.unshard(np.asarray(back.master), eq_spec))
+
+
+def test_weighted_to_weighted_and_world_change_bitwise():
+    """weighted(W) -> weighted(W') crossing a world-size change stays a
+    pure permutation."""
+    params = tree_params()
+    opt, state = train_zero(2, params)
+    fp2 = opt.layout_fingerprint(params)
+    w2 = elastic.spec_for(params, elastic.weighted_fingerprint(
+        fp2, [3, 1]))
+    fp3 = DistributedFusedAdam(
+        shard_count=3, chunk_elements=256).layout_fingerprint(params)
+    w3 = elastic.spec_for(params, elastic.weighted_fingerprint(
+        fp3, [1, 2, 4]))
+    eq2 = elastic.spec_for(params, fp2)
+    mid = elastic.reshard_state(state, eq2, w2)
+    out = elastic.reshard_state(mid, w2, w3)
+    np.testing.assert_array_equal(
+        elastic.unshard(np.asarray(state.master), eq2),
+        elastic.unshard(np.asarray(out.master), w3))
+
+
+def test_weighted_boundaries_straddle_chunks():
+    """The weighted member boundary lands MID-bucket (never on the
+    equal k boundary) for at least one bucket, and a skewed vector
+    apportions a small bucket's extent entirely to the heavy member —
+    the round trip stays exact through both."""
+    params = tree_params()
+    fp = DistributedFusedAdam(
+        shard_count=2, chunk_elements=256).layout_fingerprint(params)
+    spec = elastic.spec_for(
+        params, elastic.weighted_fingerprint(fp, [8, 1]))
+    ks = [elastic._spec_ks(spec, b) for b in spec["buckets"]]
+    eq = [b["k"] for b in spec["buckets"]]
+    assert any(k[0] != e for k, e in zip(ks, eq)), (ks, eq)
+    assert any(0 in k for k in ks), \
+        f"expected an all-to-one bucket under 8:1, got {ks}"
+    flat = np.arange(spec["padded"], dtype=np.float64) + 1
+    eq_spec = elastic.spec_for(params, fp)
+    out = elastic.reshard_flat(flat, eq_spec, spec)   # verify=True
+    np.testing.assert_array_equal(
+        elastic.unshard(out, spec), elastic.unshard(flat, eq_spec))
+
+
+def test_member_span_shrinks_for_light_member():
+    params = tree_params()
+    fp = DistributedFusedAdam(
+        shard_count=2, chunk_elements=256).layout_fingerprint(params)
+    eq = elastic.spec_for(params, fp)
+    ws = elastic.spec_for(params, elastic.weighted_fingerprint(
+        fp, [3, 1]))
+    eq_lens = elastic.member_lengths(eq)
+    w_lens = elastic.member_lengths(ws)
+    assert sum(w_lens) == sum(eq_lens) == fp["padded"]
+    assert w_lens[1] < eq_lens[1] < w_lens[0]
+    s0, s1 = elastic.member_span(ws, 0), elastic.member_span(ws, 1)
+    assert s0 == (0, w_lens[0]) and s1 == (w_lens[0], fp["padded"])
+    with pytest.raises(ValueError, match="outside world"):
+        elastic.member_span(ws, 2)
+
+
+def test_weighted_classification_and_json_roundtrip():
+    params = tree_params()
+    fp = DistributedFusedAdam(
+        shard_count=2, chunk_elements=256).layout_fingerprint(params)
+    wfp = elastic.weighted_fingerprint(fp, [3, 1])
+    kind, reason = elastic.classify_reshard(wfp, fp)
+    assert kind == elastic.RESHARDABLE and "weights 3:1" in reason
+    assert elastic.classify_reshard(fp, wfp)[0] == elastic.RESHARDABLE
+    assert elastic.classify_reshard(wfp, dict(wfp))[0] \
+        == elastic.IDENTICAL
+    # the manifest stores JSON: the fingerprint must survive the trip
+    back = json.loads(json.dumps(wfp))
+    assert back == wfp
+    assert elastic.spec_for(params, back)["weights"] == [3, 1]
+    # non-canonical weights are refused loudly, never silently re-read
+    with pytest.raises(ValueError, match="not canonical"):
+        elastic.spec_for(params, dict(fp, weights=[6, 2]))
+
+
+def test_check_world_weights_feasibility():
+    params = tree_params()
+    fp = DistributedFusedAdam(
+        shard_count=2, chunk_elements=256).layout_fingerprint(params)
+    ok, reason = elastic.check_world(fp, 2, weights=[3, 1])
+    assert ok and "weights 3:1" in reason
+    ok, reason = elastic.check_world(fp, 3, weights=[3, 1])
+    assert not ok and "infeasible weight vector" in reason
+    ok, reason = elastic.check_world(fp, 2, weights=[3, 0])
+    assert not ok and "infeasible" in reason
+    # equal-weight ask degrades to the plain form
+    assert elastic.check_world(fp, 2, weights=[2, 2])[0]
+
+
+def test_weighted_restore_guard_fails_fast_without_elastic(tmp_path):
+    """The restore-guard satellite of the tentpole: a WEIGHTED snapshot
+    restored by an equal-shard optimizer must fail fast naming the
+    re-shard recipe — before this PR, a saved-only fingerprint key was
+    invisible to layout_mismatch and the state loaded scrambled."""
+    params = tree_params()
+    opt, state = train_zero(2, params)
+    fp = opt.layout_fingerprint(params)
+    wfp = elastic.weighted_fingerprint(fp, [3, 1])
+    assert opt.layout_mismatch(wfp, params) == {"weights": ([3, 1],
+                                                            None)}
+    wstate = elastic.reshard_state(
+        state, elastic.spec_for(params, fp),
+        elastic.spec_for(params, wfp))
+    mgr = resilience.SnapshotManager(str(tmp_path))
+    mgr.save((params, wstate), step=2, layout=wfp)
+    with pytest.raises(ValueError) as ei:
+        mgr.restore_latest((params, opt.init(params)), layout=fp)
+    assert "RE-SHARDABLE" in str(ei.value)
+    # ...and through the elastic seam it restores bitwise
+    found = elastic.reshard_restore(
+        mgr, (params, opt.init(params)), params=params, optimizer=opt)
+    assert found is not None
+    np.testing.assert_array_equal(
+        elastic.unshard(np.asarray(state.master),
+                        elastic.spec_for(params, fp)),
+        elastic.unshard(np.asarray(found.state[1].master),
+                        elastic.spec_for(params, fp)))
+
+
+# ---------------------------------------------------------------------------
+# rendezvous profile channel
+# ---------------------------------------------------------------------------
+
+def test_rendezvous_profiles_roundtrip(tmp_path):
+    a = multiproc.Rendezvous(str(tmp_path / "r"), "0000")
+    b = multiproc.Rendezvous(str(tmp_path / "r"), "0001")
+    a.announce()
+    b.announce(profile={"rank": 1, "step_s": 0.25, "steps": 4})
+    assert a.profiles() == {
+        "0000": {}, "0001": {"rank": 1, "step_s": 0.25, "steps": 4}}
+    # heartbeat with a profile republishes; without one it sticks
+    a.heartbeat(profile={"rank": 0, "step_s": 0.05, "steps": 9})
+    a.heartbeat()
+    assert a.profiles()["0000"]["step_s"] == 0.05
+    # departed members drop out of the profile view
+    b.leave()
+    assert "0001" not in a.profiles()
+
+
+# ---------------------------------------------------------------------------
+# the degradation supervisor ladder
+# ---------------------------------------------------------------------------
+
+def _fleet(tmp_path, peer_step_s=0.01, peer_steps=50):
+    """A 2-member registry where the PEER has a published profile and
+    we supervise the 'self' member."""
+    rdzv = multiproc.Rendezvous(str(tmp_path / "rdzv"), "0000")
+    rdzv.announce()
+    peer = multiproc.Rendezvous(str(tmp_path / "rdzv"), "0001")
+    peer.announce(profile={"rank": 1, "step_s": peer_step_s,
+                           "steps": peer_steps})
+    return rdzv, peer
+
+
+def test_single_slow_step_never_triggers(tmp_path):
+    """THE hysteresis pin: one slow step among fast ones moves neither
+    the rolling median nor the consecutive counter far enough — no
+    decision, no detect event, ever."""
+    rdzv, _ = _fleet(tmp_path)
+    sup = rebalance.DegradationSupervisor(
+        rdzv, rank=0, window=5, threshold=1.5, hysteresis=3,
+        cooldown=4, evict_after=4, min_steps=2)
+    with telemetry.capture() as col:
+        kinds = []
+        for i in range(30):
+            dt = 0.5 if i == 10 else 0.01   # ONE slow step
+            kinds.append(sup.observe(i, step_s=dt).kind)
+        events = [e.name for e in col.drain()]
+    assert set(kinds) == {"none"}, kinds
+    assert not [n for n in events if n.startswith("rebalance/")]
+
+
+def test_sustained_straggler_triggers_once_per_cooldown(tmp_path):
+    """A sustained straggler triggers a rebalance exactly once per
+    cooldown window, names itself in ONE detect event per episode, and
+    (with a high evict floor) never escalates."""
+    rdzv, _ = _fleet(tmp_path)
+    sup = rebalance.DegradationSupervisor(
+        rdzv, rank=0, window=3, threshold=1.5, hysteresis=2,
+        cooldown=5, evict_after=1000, min_steps=2)
+    with telemetry.capture() as col:
+        decisions = []
+        for i in range(26):
+            d = sup.observe(i, step_s=0.5)   # sustained: every step slow
+            decisions.append(d)
+        events = [e for e in col.drain()
+                  if e.name == "rebalance/detect"]
+    reb = [i for i, d in enumerate(decisions) if d.kind == "rebalance"]
+    assert reb, "sustained straggler never triggered"
+    diffs = [b - a for a, b in zip(reb, reb[1:])]
+    assert all(d == 5 for d in diffs), (reb, diffs)
+    assert len(events) == 1                      # one episode, one name
+    assert events[0].meta["straggler"] == "0000"
+    assert events[0].meta["straggler_rank"] == 0
+    d = decisions[reb[0]]
+    assert d.weights is not None and len(set(d.weights)) > 1
+    assert not any(x.kind == "evict" for x in decisions)
+
+
+def test_recovery_resets_the_episode(tmp_path):
+    rdzv, _ = _fleet(tmp_path)
+    sup = rebalance.DegradationSupervisor(
+        rdzv, rank=0, window=3, threshold=1.5, hysteresis=2,
+        cooldown=3, evict_after=1000, min_steps=2)
+    with telemetry.capture() as col:
+        ks = [sup.observe(i, step_s=0.5).kind for i in range(6)]
+        assert "rebalance" in ks
+        # recovery: fast steps flush the window, the episode ends
+        ks = [sup.observe(6 + i, step_s=0.01).kind for i in range(8)]
+        assert set(ks) == {"none"}
+        # a NEW sustained episode detects (and names) again
+        ks = [sup.observe(20 + i, step_s=0.5).kind for i in range(6)]
+        assert "rebalance" in ks
+        detects = [e for e in col.drain()
+                   if e.name == "rebalance/detect"]
+    assert len(detects) == 2
+
+
+def test_escalation_to_evict_me(tmp_path):
+    rdzv, _ = _fleet(tmp_path)
+    sup = rebalance.DegradationSupervisor(
+        rdzv, rank=0, window=3, threshold=1.5, hysteresis=2,
+        cooldown=10, evict_after=3, min_steps=2)
+    with telemetry.capture() as col:
+        kinds = [sup.observe(i, step_s=0.5).kind for i in range(12)]
+        events = [e.name for e in col.drain()]
+    assert "rebalance" in kinds and "evict" in kinds
+    assert kinds.index("evict") > kinds.index("rebalance")
+    evict = [d for d in [sup.last_decision] if d is not None]
+    # after eviction the supervisor goes quiet
+    assert kinds[kinds.index("evict") + 1:] == ["none"] * (
+        len(kinds) - kinds.index("evict") - 1)
+    assert "rebalance/evict" in events
+    # the straggler is THIS member: the decision says evict ME
+    assert any(k == "evict" for k in kinds)
+
+
+def test_evict_decision_targets_only_the_straggler(tmp_path):
+    """The fast member sees the same evict verdict but with
+    evict_me=False — eviction is a cooperative SELF-leave."""
+    rdzv, peer = _fleet(tmp_path, peer_step_s=0.6)   # PEER is slow
+    sup = rebalance.DegradationSupervisor(
+        rdzv, rank=0, window=3, threshold=1.5, hysteresis=2,
+        cooldown=10, evict_after=2, min_steps=2)
+    evicts = []
+    for i in range(12):
+        d = sup.observe(i, step_s=0.01)
+        if d.kind == "evict":
+            evicts.append(d)
+    assert evicts and all(not d.evict_me for d in evicts)
+    assert evicts[0].straggler == "0001"
+    assert evicts[0].straggler_rank == 1
+
+
+def test_supervisor_validation():
+    with pytest.raises(ValueError, match="threshold"):
+        rebalance.DegradationSupervisor(None, threshold=0.9)
+    with pytest.raises(ValueError, match=">= 1"):
+        rebalance.DegradationSupervisor(None, window=0)
+    with pytest.raises(ValueError, match=">= 1"):
+        rebalance.DegradationSupervisor(None, io_every=0)
+
+
+def test_supervisor_io_every_throttles_registry_traffic(tmp_path):
+    """io_every=N touches the rendezvous (publish + fleet read) only
+    every Nth step: quiet steps decide nothing and leave the published
+    profile untouched; detection still happens, just up to N steps
+    later."""
+    rdzv, _ = _fleet(tmp_path)
+    sup = rebalance.DegradationSupervisor(
+        rdzv, rank=0, window=3, threshold=1.5, hysteresis=2,
+        cooldown=100, evict_after=1000, min_steps=2, io_every=3)
+    published = []
+    decisions = []
+    for i in range(12):
+        decisions.append(sup.observe(i, step_s=0.5).kind)
+        prof = rdzv.profiles().get("0000") or {}
+        published.append(prof.get("steps"))
+    # quiet steps (observed count not a multiple of 3) decide nothing
+    # and publish nothing
+    for i, (kind, steps) in enumerate(zip(decisions, published), 1):
+        if i % 3:
+            assert kind == "none", (i, kind)
+    assert sorted(set(p for p in published if p is not None)) \
+        == [3, 6, 9, 12]
+    assert "rebalance" in decisions   # detection still lands
+
+
+def test_weights_from_rates_quantized_and_stable():
+    w = rebalance.weights_from_rates({"a": 25.0, "b": 3.2})
+    assert w == [8, 1]
+    # near-equal rates quantize to EQUAL (None): jitter never produces
+    # a gratuitous weighted layout
+    assert rebalance.weights_from_rates({"a": 10.0, "b": 9.6}) is None
+    assert rebalance.weights_from_rates({}) is None
+    # member order is dense sorted id order (= rank order)
+    w = rebalance.weights_from_rates({"0001": 24.0, "0000": 3.0})
+    assert w == [1, 8]
+
+
+# ---------------------------------------------------------------------------
+# the rebalance action + loop integration
+# ---------------------------------------------------------------------------
+
+def test_apply_rebalance_persists_weighted_generation(tmp_path):
+    params = tree_params()
+    opt, state = train_zero(2, params)
+    fp = opt.layout_fingerprint(params)
+    mgr = resilience.SnapshotManager(str(tmp_path))
+    seam = resilience.Elastic(opt, params)
+    with telemetry.capture() as col:
+        info = rebalance.apply_rebalance(
+            mgr, seam, (params, state), step=4,
+            rates={"0000": 25.0, "0001": 3.2},
+            straggler="0001", straggler_rank=1,
+            loader={"offset": 7})
+        events = [e for e in col.drain() if e.name == "rebalance/apply"]
+    assert info["saved"] and info["verified"]
+    assert info["weights"] == [8, 1] and not info["planned"]
+    assert events[0].meta["weights"] == [8, 1]
+    man = mgr.latest_manifest()
+    assert man["layout"]["weights"] == [8, 1]
+    assert man["extra"]["rebalance"]["straggler_rank"] == 1
+    # the weighted generation is the NEWEST restore source: it must
+    # carry the data-loader offset exactly like the loop's cadence
+    # saves, or a stateful loader would silently replay consumed data
+    assert man["loader"] == {"offset": 7}
+    # the slow member's span SHRANK
+    spans = info["member_spans"]
+    assert spans[1][1] - spans[1][0] < fp["padded"] // 2
+    # the weighted generation restores bitwise at the equal layout
+    found = elastic.reshard_restore(
+        mgr, (params, opt.init(params)), params=params, optimizer=opt)
+    np.testing.assert_array_equal(
+        elastic.unshard(np.asarray(state.master),
+                        elastic.spec_for(params, fp)),
+        elastic.unshard(np.asarray(found.state[1].master),
+                        elastic.spec_for(params, fp)))
+
+
+def test_apply_rebalance_prefers_planner_weights(tmp_path):
+    """The acting-replan carry: when the Elastic has a replan hook that
+    produces a weight vector (the heterogeneous cost term), THAT vector
+    goes into the re-shard — not the rate-proportional fallback."""
+    params = tree_params()
+    opt, state = train_zero(2, params)
+
+    def hook(old_world, new_world, rates=None):
+        return {"old": "x", "new": "x", "old_step_s": 1.0,
+                "new_step_s": 1.0, "weights": [3, 1],
+                "equal_shard": False}
+
+    mgr = resilience.SnapshotManager(str(tmp_path))
+    seam = resilience.Elastic(opt, params, replan=hook)
+    info = rebalance.apply_rebalance(
+        mgr, seam, (params, state), step=2,
+        rates={"0000": 25.0, "0001": 3.2})
+    assert info["planned"] and info["weights"] == [3, 1]
+    assert mgr.latest_manifest()["layout"]["weights"] == [3, 1]
+
+
+def test_apply_rebalance_degrades_dont_crash(tmp_path):
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert rebalance.apply_rebalance(None, None, {}, step=0) is None
+        # equal weights: nothing to apply
+        params = tree_params()
+        opt, state = train_zero(2, params)
+        mgr = resilience.SnapshotManager(str(tmp_path))
+        seam = resilience.Elastic(opt, params)
+        assert rebalance.apply_rebalance(
+            mgr, seam, (params, state), step=0,
+            weights=[1, 1]) is None
+    assert any("nothing to apply" in str(x.message) for x in w)
+
+
+def test_resilient_loop_supervisor_rebalances_and_continues(tmp_path):
+    """Loop integration, straggler is the PEER: the supervisor applies
+    the weighted re-shard mid-run (weighted generation in the store,
+    rebalance/apply emitted), the evict verdict targets the peer, and
+    THIS member runs to completion."""
+    params = tree_params()
+    world = 2
+    mesh = parallel.reform_mesh(world)
+    opt = DistributedFusedAdam(lr=0.05, shard_count=world,
+                               chunk_elements=256)
+    specs = opt.state_pspec()
+    sharded = shard_map(opt.step, mesh=mesh, in_specs=(P(), P(), specs),
+                        out_specs=(P(), specs), check_vma=False)
+
+    @jax.jit
+    def train(st, x):
+        p, z = st
+        loss, g = jax.value_and_grad(
+            lambda p: sum(jnp.mean((l * x - 0.5) ** 2) for l in
+                          jax.tree_util.tree_leaves(p)))(p)
+        new_p, new_z = sharded(g, p, z)
+        return (new_p, new_z), loss
+
+    rdzv, peer = _fleet(tmp_path, peer_step_s=5.0)   # peer VERY slow
+    sup = rebalance.DegradationSupervisor(
+        rdzv, rank=0, window=3, threshold=1.5, hysteresis=2,
+        cooldown=100, evict_after=3, min_steps=2)
+    with telemetry.capture() as col:
+        result = resilience.resilient_loop(
+            lambda st, x, i: train(st, x),
+            (params, opt.init(params)),
+            lambda i: jnp.float32(1.0), steps=12,
+            snapshot_dir=str(tmp_path / "snap"), snapshot_every=4,
+            layout=opt.layout_fingerprint(params),
+            elastic=resilience.Elastic(opt, params),
+            supervisor=sup, handle_signals=False, keep_last=50)
+        names = [e.name for e in col.drain()]
+    assert result.step == 12 and not result.preempted
+    assert "rebalance/detect" in names
+    assert "rebalance/apply" in names
+    assert "rebalance/evict" in names   # verdict recorded, peer's to act
+    mgr = resilience.SnapshotManager(str(tmp_path / "snap"))
+    weighted = [g for g in mgr.generations()
+                if (mgr.manifest(g).get("layout") or {}).get("weights")]
+    assert weighted, "no weighted generation persisted"
+
+
+def test_resilient_loop_supervisor_self_evicts_exit_75(tmp_path):
+    """Loop integration, straggler is SELF: the ladder escalates to the
+    cooperative self-eviction — preempted, final snapshot, exit 75 (the
+    W-1 relaunch contract the multiproc supervisor consumes)."""
+    params = tree_params()
+    opt = DistributedFusedAdam(lr=0.05, shard_count=1,
+                               chunk_elements=256)
+
+    def slow_step(st, x, i):
+        time.sleep(0.03)
+        return st, 0.0
+
+    rdzv, peer = _fleet(tmp_path, peer_step_s=0.001)   # peer is fast
+    sup = rebalance.DegradationSupervisor(
+        rdzv, rank=0, window=3, threshold=1.5, hysteresis=2,
+        cooldown=3, evict_after=2, min_steps=2)
+    result = resilience.resilient_loop(
+        slow_step, (params, opt.init(params)),
+        lambda i: None, steps=100,
+        snapshot_dir=str(tmp_path / "snap"), snapshot_every=10,
+        layout=opt.layout_fingerprint(params),
+        elastic=resilience.Elastic(opt, params),
+        supervisor=sup, handle_signals=False)
+    assert result.preempted and result.exit_code == 75
+    assert result.reason and result.reason.startswith("evict:")
+    assert result.step < 100
+    assert result.final_snapshot_ok
+
+
+# ---------------------------------------------------------------------------
+# planner: heterogeneous cost term + acting replanner
+# ---------------------------------------------------------------------------
+
+def _toy_cost(exposed=0.004, roofline=0.01):
+    return plan_cost.CostBreakdown(
+        layout_id="dp2", compute_s=roofline, memory_s=0.0,
+        roofline_s=roofline, wire=[], wire_bytes=0.0,
+        comm_s=exposed, hidden_s=0.0, exposed_comm_s=exposed,
+        bubble_s=0.0, latency_s=0.0, step_s=roofline + exposed,
+        hbm={"total": 0.0})
+
+
+def test_heterogeneous_step_homogeneous_reduces_exactly():
+    c = _toy_cost()
+    h = plan_cost.heterogeneous_step_s(c, [1.0, 1.0])
+    assert h.step_s == pytest.approx(c.step_s, abs=1e-15)
+    assert h.weights is None
+
+
+def test_heterogeneous_step_max_over_members_and_weights_help():
+    c = _toy_cost(exposed=0.004, roofline=0.01)
+    speeds = [1.0, 0.5]                      # member 1 at half speed
+    equal = plan_cost.heterogeneous_step_s(c, speeds)
+    # the slow member dominates: fixed/0.5 + equal shard term
+    assert equal.step_s == pytest.approx(0.01 / 0.5 + 0.004)
+    weighted = plan_cost.heterogeneous_step_s(
+        c, speeds, weights=plan_cost.optimal_weights(speeds))
+    assert weighted.step_s < equal.step_s
+    assert weighted.weights == [2, 1]
+    # per-member bills: the light member's shard term shrank
+    assert weighted.per_member_s[1] < equal.per_member_s[1]
+
+
+def test_member_speeds_and_optimal_weights():
+    s = plan_cost.member_speeds({"b": 10.0, "a": 20.0})
+    assert s == [1.0, 0.5]                   # dense member order, a first
+    assert plan_cost.optimal_weights([1.0, 1.0]) is None
+    assert plan_cost.optimal_weights([1.0, 0.25]) == [4, 1]
+    with pytest.raises(ValueError):
+        plan_cost.member_speeds({"a": -1.0})
+
+
+def test_replanner_emits_weights_and_elastic_carries_them():
+    from apex_tpu import plan
+    from apex_tpu.plan.adapters import GPTAdapter
+    ad = GPTAdapter(vocab=128, layers=2, embed=64, heads=4, batch=16,
+                    seq=64)
+    hook = plan.replanner(ad)
+    rates = {"0000": 25.0, "0001": 3.4}
+    out = hook(2, 2, rates=rates)
+    assert out["weights"] == [8, 1]
+    assert out["hetero_step_s"] <= out["equal_step_s"]
+    assert out["equal_shard"] is False
+    # stale/partial rates stay equal-shard, loudly annotated
+    out = hook(2, 1, rates=rates)
+    assert "weights" not in out and out["weights_skipped"]
+    # no rates: the PR 14 equal-shard re-rank, field-compatible
+    out = hook(2, 1)
+    assert out["equal_shard"] is True and "weights" not in out
+
+    class FakeOpt:
+        def layout_fingerprint(self, p):
+            return {"shard_count": 2, "chunk_elements": 256,
+                    "total": 911, "padded": 914, "n_buckets": 3,
+                    "structure_crc32": 1}
+
+    seam = resilience.Elastic(FakeOpt(), {}, replan=hook)
+    assert seam.planned_weights(rates) == [8, 1]
+
+
+def test_replan_failure_emits_telemetry_static():
+    """The satellite: a failing replan hook warns AND lands a
+    plan/replan_failed counter, so summarize can surface it."""
+    class FakeOpt:
+        def layout_fingerprint(self, p):
+            return {"shard_count": 2, "chunk_elements": 256,
+                    "total": 911, "padded": 914, "n_buckets": 3,
+                    "structure_crc32": 1}
+
+    def bad(a, b):
+        raise RuntimeError("boom")
+
+    seam = resilience.Elastic(FakeOpt(), {}, replan=bad)
+    with telemetry.capture() as col:
+        with pytest.warns(UserWarning, match="replan hook failed"):
+            seam._replan(2, 1, step=4)
+        ev = [e for e in col.drain() if e.name == "plan/replan_failed"]
+    assert len(ev) == 1 and ev[0].kind == "counter"
+    assert "boom" in ev[0].meta["error"]
+    assert seam.last_replan is None
+
+
+# ---------------------------------------------------------------------------
+# telemetry summarize + inspect CLI + trainer resume
+# ---------------------------------------------------------------------------
+
+def test_summarize_rebalance_section_renders():
+    ev = [{"name": "rebalance/detect", "value": 1.0, "ts": 1.0,
+           "step": 8, "meta": {"straggler": "0001", "straggler_rank": 1,
+                               "ratio": 5.9}},
+          {"name": "rebalance/apply", "value": 2.0, "ts": 1.0,
+           "step": 8, "meta": {"weights": [8, 1], "straggler": "0001",
+                               "straggler_rank": 1, "verified": True,
+                               "saved": True, "planned": True}},
+          {"name": "rebalance/evict", "value": 1.0, "ts": 1.0,
+           "step": 11, "kind": "counter",
+           "meta": {"straggler": "0001", "straggler_rank": 1,
+                    "ratio": 5.8, "after_rebalance_steps": 3}},
+          {"name": "plan/replan_failed", "value": 1.0, "ts": 1.0,
+           "kind": "counter", "meta": {"error": "RuntimeError: x"}},
+          {"name": "resilience/reshard", "value": 1.0, "ts": 1.0,
+           "step": 12, "meta": {"from_world": 2, "to_world": 2,
+                                "generation": 3,
+                                "from_weights": [8, 1],
+                                "to_weights": None}}]
+    agg = telemetry.summarize(ev)
+    r = agg["resilience"]
+    assert r["rebalance_detects"][0]["straggler_rank"] == 1
+    assert r["rebalance_applies"][0]["weights"] == [8, 1]
+    assert r["rebalance_evicts"][0]["after_rebalance_steps"] == 3
+    assert r["replan_failures"] == 1
+    assert r["reshards"][0]["from_weights"] == [8, 1]
+    text = telemetry.format_summary(agg)
+    assert "straggler detected: member 0001 (rank 1)" in text
+    assert "rebalanced to weights 8:1" in text
+    assert "planner-picked" in text
+    assert "gather-verified bitwise" in text
+    assert "EVICTED straggler member 0001" in text
+    assert "replan FAILURE" in text
+    assert "weights 8:1 -> equal" in text
+
+
+def test_inspect_cli_weighted_rendering_and_check(tmp_path, capsys):
+    from apex_tpu.resilience import cli
+    params = tree_params()
+    opt, state = train_zero(2, params)
+    fp = opt.layout_fingerprint(params)
+    wfp = elastic.weighted_fingerprint(fp, [3, 1])
+    wstate = elastic.reshard_state(
+        state, elastic.spec_for(params, fp),
+        elastic.spec_for(params, wfp))
+    mgr = resilience.SnapshotManager(str(tmp_path / "snap"))
+    mgr.save((params, state), step=2, layout=fp)
+    mgr.save((params, wstate), step=4, layout=wfp)
+
+    assert cli.main(["inspect", str(tmp_path / "snap")]) == 0
+    out = capsys.readouterr().out
+    assert "weights 3:1 (75.0%/25.0%)" in out
+
+    # --check W --weights: feasibility with the documented grammar,
+    # exit-code contract unchanged
+    assert cli.main(["inspect", str(tmp_path / "snap"),
+                     "--check", "2", "--weights", "3:1"]) == 0
+    out = capsys.readouterr().out
+    assert "with weights 3:1 possible" in out
+    assert cli.main(["inspect", str(tmp_path / "snap"),
+                     "--check", "1", "--weights", "3:1"]) == 3
+    capsys.readouterr()
+    # malformed vector / --weights without --check: usage (2)
+    assert cli.main(["inspect", str(tmp_path / "snap"),
+                     "--check", "2", "--weights", "3:x"]) == 2
+    assert cli.main(["inspect", str(tmp_path / "snap"),
+                     "--weights", "3:1"]) == 2
+    capsys.readouterr()
+    # --json carries the weights row
+    assert cli.main(["inspect", str(tmp_path / "snap"), "--json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["rows"][1]["weights"] == [3, 1]
+    assert data["rows"][0]["weights"] is None
+
+
+def test_trainer_notify_resume_carries_weights():
+    from apex_tpu.trainer.builder import Trainer, TrainerConfig
+    tr = Trainer(fn=lambda s, b: (s, None),
+                 traced_fn=lambda s, b: (s, None),
+                 config=TrainerConfig(), donation=None)
+    with telemetry.capture() as col:
+        tr.notify_resume(7, world=2, from_world=2,
+                         weights=None, from_weights=[8, 1])
+        events = [e for e in col.drain() if e.name == "trainer/resume"]
+    assert events[0].meta == {"world": 2, "from_world": 2,
+                              "weights": None, "from_weights": [8, 1]}
+
+
+def test_elastic_restore_records_weight_crossing(tmp_path):
+    params = tree_params()
+    opt, state = train_zero(2, params)
+    fp = opt.layout_fingerprint(params)
+    wfp = elastic.weighted_fingerprint(fp, [3, 1])
+    wstate = elastic.reshard_state(
+        state, elastic.spec_for(params, fp),
+        elastic.spec_for(params, wfp))
+    mgr = resilience.SnapshotManager(str(tmp_path))
+    mgr.save((params, wstate), step=4, layout=wfp)
+    seam = resilience.Elastic(opt, params)
+    found = seam.restore(mgr, (params, opt.init(params)))
+    assert found is not None
+    assert seam.last_reshard["from_weights"] == [3, 1]
+    assert seam.last_reshard["to_weights"] is None
+    assert seam.last_reshard["from_world"] == 2
+    assert seam.last_reshard["to_world"] == 2
+
+
+# ---------------------------------------------------------------------------
+# off-switch pins
+# ---------------------------------------------------------------------------
+
+def test_supervisor_off_traced_program_unchanged(tmp_path):
+    """The whole rebalance stack is HOST-side: constructing supervisors
+    and weighted fingerprints must not change a traced ZeRO step by a
+    single equation (jaxpr-pinned), and the equal-shard fingerprint
+    stays byte-identical."""
+    params = tree_params()
+    world = 2
+    mesh = parallel.reform_mesh(world)
+
+    def build():
+        opt = DistributedFusedAdam(lr=0.05, shard_count=world,
+                                   chunk_elements=256)
+        specs = opt.state_pspec()
+        sharded = shard_map(opt.step, mesh=mesh,
+                            in_specs=(P(), P(), specs),
+                            out_specs=(P(), specs), check_vma=False)
+        state = opt.init(params)
+        grads = jax.tree_util.tree_map(jnp.ones_like, params)
+        return opt, jax.make_jaxpr(sharded)(grads, params, state)
+
+    opt_a, jaxpr_a = build()
+    fp_a = opt_a.layout_fingerprint(params)
+    # arm the whole rebalance stack...
+    rdzv = multiproc.Rendezvous(str(tmp_path / "r"), "0000")
+    rdzv.announce()
+    sup = rebalance.DegradationSupervisor(rdzv, rank=0)
+    for i in range(3):
+        sup.observe(i, step_s=0.01)
+    elastic.weighted_fingerprint(fp_a, [3, 1])
+    # ...and the traced program + equal fingerprint are unchanged
+    opt_b, jaxpr_b = build()
+    assert str(jaxpr_a) == str(jaxpr_b)
+    assert opt_b.layout_fingerprint(params) == fp_a
+    assert "weights" not in fp_a
