@@ -1,7 +1,7 @@
 """apex_tpu.lint — static trace-safety, dtype-policy, collective-
 consistency, and SPMD-correctness analysis for TPU training code.
 
-Three passes (see docs/lint.md for the rule catalog):
+Four passes (see docs/lint.md for the rule catalog):
 
 * AST (``APX0xx``): trace hazards readable from source — Python control
   flow on traced values, concretization, impure state under ``jit``,
@@ -17,15 +17,25 @@ Three passes (see docs/lint.md for the rule catalog):
   thrash, overlap-seam bypass, callback graph re-entry, scan-carry
   widening. Mesh-aware abstract interpretation; read-only on the traced
   program.
+* mem (``APX3xx``, ``--mem``): whole-program peak-HBM and live-range
+  verification — a buffer-lifetime timeline of the lowered program
+  (donation aliasing, loop bodies composed structurally) judged against
+  device capacity, plus undonated carried state, activations parked
+  into the late backward, ZeRO full-parameter materialization,
+  scan-carry concat growth, host transfers inside the step, and
+  peak-memory regression vs a committed baseline
+  (``--mem-baseline ci/mem_baseline.json``).
 
-Run ``python -m apex_tpu.lint apex_tpu/ --strict --spmd`` (the CI gate
-does), or lint your own train step programmatically::
+Run ``python -m apex_tpu.lint apex_tpu/ --strict --spmd --mem`` (the CI
+gate does), or lint your own train step programmatically::
 
     from apex_tpu import lint
     findings = lint.check_entry(step_fn, args, name="train_step",
                                 mesh_axes=("data",), opt_level="O5")
     findings += lint.check_entry_spmd(step_fn, args, mesh_axes=("data",),
                                       donate_argnums=(0,))
+    findings += lint.check_entry_mem(step_fn, args, donate_argnums=(0,),
+                                     state_argnums=(0,))
 
 Suppress a finding in place with ``# apexlint: disable=APX00N -- why``;
 adopt the gate on an existing codebase with ``--baseline FILE`` (fail on
@@ -39,4 +49,10 @@ from apex_tpu.lint.jaxpr_checks import (EntrySpec, builtin_entries,
                                         check_entry, run_entries)
 from apex_tpu.lint.spmd_checks import (StaticDonation, check_entry_spmd,
                                        run_entries_spmd, static_donation)
+from apex_tpu.lint.liveness import Buffer, MemTimeline, compute_timeline
+from apex_tpu.lint.mem_checks import (MemReport, analyze_entry_mem,
+                                      check_entry_mem, entry_peaks,
+                                      load_peak_baseline, run_entries_mem,
+                                      verified_peak_bytes,
+                                      write_peak_baseline)
 from apex_tpu.lint.cli import main, run

@@ -42,10 +42,12 @@ def _relpath(p: str) -> str:
 
 
 def run(paths: Sequence[str], *, jaxpr: bool = True, spmd: bool = False,
+        mem: bool = False, mem_baseline=None,
         select: Sequence[str] = (), ignore: Sequence[str] = ()):
     """Lint ``paths``; returns (active_findings, suppressed_findings).
     ``spmd=True`` additionally runs the APX2xx SPMD verifier over the
-    registered entry points."""
+    registered entry points; ``mem=True`` the APX3xx peak-HBM/live-range
+    verifier (``mem_baseline`` — a dict or file path — arms APX307)."""
     findings: List[report.Finding] = []
     sources: Dict[str, List[str]] = {}
 
@@ -59,11 +61,17 @@ def run(paths: Sequence[str], *, jaxpr: bool = True, spmd: bool = False,
 
     entry_findings: List[report.Finding] = []
     if jaxpr:
-        # one build + one lowering per entry, both passes share it
-        entry_findings.extend(jaxpr_checks.run_entries(spmd=spmd))
-    elif spmd:
-        from apex_tpu.lint import spmd_checks
-        entry_findings.extend(spmd_checks.run_entries_spmd())
+        # one build + one lowering per entry, all passes share it
+        entry_findings.extend(jaxpr_checks.run_entries(
+            spmd=spmd, mem=mem, mem_baseline=mem_baseline))
+    else:
+        if spmd:
+            from apex_tpu.lint import spmd_checks
+            entry_findings.extend(spmd_checks.run_entries_spmd())
+        if mem:
+            from apex_tpu.lint import mem_checks
+            entry_findings.extend(mem_checks.run_entries_mem(
+                baseline=mem_baseline))
     for finding in entry_findings:
         rel = _relpath(finding.path)
         finding = report.Finding(finding.rule_id, rel, finding.line,
@@ -105,6 +113,18 @@ def main(argv: Sequence[str] = None) -> int:
                     help="also run the APX2xx SPMD verifier over the "
                          "registered entry points (collective schedule, "
                          "replica RNG, donation liveness, replication)")
+    ap.add_argument("--mem", action="store_true",
+                    help="also run the APX3xx peak-HBM / live-range "
+                         "verifier over the registered entry points "
+                         "(capacity, donation residency, activation "
+                         "lifetimes, ZeRO materialization, regression)")
+    ap.add_argument("--mem-baseline", metavar="FILE", default=None,
+                    help="per-entry peak-bytes baseline for APX307 "
+                         "(ci/mem_baseline.json); peaks grown beyond "
+                         "tolerance over FILE fail the mem pass")
+    ap.add_argument("--update-mem-baseline", action="store_true",
+                    help="rewrite --mem-baseline FILE with the current "
+                         "per-entry analyzer peaks and exit 0")
     ap.add_argument("--baseline", metavar="FILE", default=None,
                     help="fail only on findings NOT recorded in FILE; "
                          "known findings are reported as baselined")
@@ -133,9 +153,22 @@ def main(argv: Sequence[str] = None) -> int:
         print("apexlint: --update-baseline requires --baseline FILE",
               file=sys.stderr)
         return 2
+    if args.update_mem_baseline:
+        if not args.mem_baseline:
+            print("apexlint: --update-mem-baseline requires "
+                  "--mem-baseline FILE", file=sys.stderr)
+            return 2
+        from apex_tpu.lint import mem_checks
+        peaks = mem_checks.entry_peaks()
+        mem_checks.write_peak_baseline(args.mem_baseline, peaks)
+        print(f"apexlint: mem baseline written to {args.mem_baseline} "
+              f"({len(peaks)} entry peak(s) recorded)")
+        return 0
 
     active, suppressed = run(args.paths, jaxpr=not args.no_jaxpr,
-                             spmd=args.spmd, select=select, ignore=ignore)
+                             spmd=args.spmd, mem=args.mem,
+                             mem_baseline=args.mem_baseline,
+                             select=select, ignore=ignore)
 
     if args.baseline and args.update_baseline:
         report.write_baseline(args.baseline, active)

@@ -343,11 +343,16 @@ def check_entry(fn: Callable, args: tuple, *, name: str = "<entry>",
                 opt_level: Optional[str] = None,
                 reduce_dtype: Optional[str] = None,
                 spmd: bool = False,
-                donate_argnums: Sequence[int] = ()) -> List[Finding]:
+                donate_argnums: Sequence[int] = (),
+                mem: bool = False,
+                mem_baseline_bytes: Optional[float] = None
+                ) -> List[Finding]:
     """Trace ``fn(*args)`` and run the jaxpr rules. Public so tests and
     downstream projects can lint their own train steps. ``spmd=True``
     additionally runs the APX2xx SPMD verifier on the same program
-    (``donate_argnums`` arms its use-after-donation rule)."""
+    (``donate_argnums`` arms its use-after-donation rule); ``mem=True``
+    runs the APX3xx peak-HBM/live-range verifier, again on the SAME
+    lowering (``mem_baseline_bytes`` arms its regression rule)."""
     from apex_tpu.amp import policy
 
     compute_low = False
@@ -396,6 +401,12 @@ def check_entry(fn: Callable, args: tuple, *, name: str = "<entry>",
         ctx.findings.extend(check_entry_spmd(
             fn, args, name=name, path=path, mesh_axes=mesh_axes,
             donate_argnums=donate_argnums, closed=closed))
+    if mem:
+        from apex_tpu.lint.mem_checks import check_entry_mem
+        ctx.findings.extend(check_entry_mem(
+            fn, args, name=name, path=path,
+            donate_argnums=donate_argnums, closed=closed,
+            baseline_bytes=mem_baseline_bytes))
     return ctx.findings
 
 
@@ -614,11 +625,17 @@ def builtin_entries() -> List[EntrySpec]:
 
 
 def run_entries(entries: Optional[Sequence[EntrySpec]] = None, *,
-                spmd: bool = False) -> List[Finding]:
+                spmd: bool = False, mem: bool = False,
+                mem_baseline: Optional[Any] = None) -> List[Finding]:
     """Lower every registered entry and collect jaxpr findings (plus the
-    SPMD pass over the SAME lowering when ``spmd``). A broken entry
-    fails loudly (with the entry name) rather than being skipped — an
-    unlowerable train step is exactly what the gate must catch."""
+    SPMD and/or mem passes over the SAME lowering when ``spmd`` /
+    ``mem``; ``mem_baseline`` is a ``{entry: peak bytes}`` dict or
+    baseline file path arming APX307). A broken entry fails loudly
+    (with the entry name) rather than being skipped — an unlowerable
+    train step is exactly what the gate must catch."""
+    if isinstance(mem_baseline, str):
+        from apex_tpu.lint.mem_checks import load_peak_baseline
+        mem_baseline = load_peak_baseline(mem_baseline)
     findings: List[Finding] = []
     for spec in builtin_entries() if entries is None else entries:
         try:
@@ -631,5 +648,6 @@ def run_entries(entries: Optional[Sequence[EntrySpec]] = None, *,
             fn, args, name=spec.name, path=spec.path,
             mesh_axes=spec.mesh_axes, opt_level=spec.opt_level,
             reduce_dtype=spec.reduce_dtype, spmd=spmd,
-            donate_argnums=spec.donate_argnums))
+            donate_argnums=spec.donate_argnums, mem=mem,
+            mem_baseline_bytes=(mem_baseline or {}).get(spec.name)))
     return findings

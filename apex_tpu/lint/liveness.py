@@ -1,0 +1,339 @@
+"""Live-range timeline of a lowered program — the engine under the mem
+verifier (:mod:`apex_tpu.lint.mem_checks`, rules APX301-APX307).
+
+The analysis is an abstract interpretation over the closed jaxpr's
+equation order (the same descended body the SPMD pass reads ordering
+from, :func:`~apex_tpu.lint.spmd_checks._program_body`): every variable
+becomes a :class:`Buffer` with a birth equation, a death equation, and a
+byte size from its aval (sharded programs analyze the shard_map BODY, so
+avals are already per-device block shapes — the sharding division has
+happened by construction; enclosing mesh axis sizes are still collected
+for the rule messages). The buffer model mirrors XLA's allocator:
+
+* a program INPUT is resident for the whole call — the caller's buffer
+  cannot be overwritten — unless it is DONATED and cleanly aliased, in
+  which case the input and its aliased output are ONE buffer (the
+  donation pairing convention is shared with
+  :func:`~apex_tpu.lint.spmd_checks.analyze_donation`: carry slot k
+  pairs with output slot k, else the first shape/dtype-compatible free
+  output). A donated leaf read AFTER its aliased output is produced
+  forces a copy (APX203's finding) and is modeled as two buffers —
+  exactly the double residency the donation was meant to avoid.
+* a TEMP lives from its producing equation to its last read.
+* a program OUTPUT lives from its producing equation to the end.
+
+``live_bytes[i]`` is the total resident at equation ``i``; the peak is
+its max, with the top-k resident buffers named at the peak equation.
+
+Control-flow bodies (``scan`` / ``while`` / ``cond`` / pjit calls) are
+analyzed ONCE each — the composition with the trip count is structural,
+not multiplicative: a loop body's interior working set is the same every
+iteration, while the length-scaled buffers (stacked ``xs``/``ys``) are
+already priced at trip count x per-iteration size by their OUTER avals.
+A sub-jaxpr equation therefore contributes its body's peak BEYOND the
+boundary buffers the outer timeline already holds::
+
+    extra(eqn) = max over bodies of
+        max(0, peak(body) - bytes(body invars) - bytes(body outvars))
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from apex_tpu.utils.jaxpr_walk import (aval_bytes, mesh_axis_sizes,
+                                       subjaxprs_tagged, walk_jaxpr)
+
+__all__ = ["Buffer", "MemTimeline", "compute_timeline", "aval_str"]
+
+# recursion guard for pathological nesting (real entries are < 6 deep)
+_MAX_DEPTH = 16
+
+
+def _aval(v):
+    return getattr(v, "aval", None)
+
+
+def aval_str(aval) -> str:
+    """Compact ``dtype[dims]`` rendering for buffer names/messages."""
+    dt = str(getattr(aval, "dtype", "?"))
+    shape = getattr(aval, "shape", None)
+    return f"{dt}[{','.join(str(d) for d in (shape or ()))}]"
+
+
+@dataclasses.dataclass(frozen=True)
+class Buffer:
+    """One live range. ``birth`` is -1 for program inputs; ``death`` is
+    the last equation index holding the buffer (``n_eqns`` for outputs —
+    they outlive the program). ``kind`` is ``"input"`` / ``"temp"`` /
+    ``"output"``; a donated input cleanly merged with its aliased output
+    is ONE ``"input"`` buffer spanning the whole program, and the output
+    slot contributes no separate bytes."""
+
+    name: str
+    nbytes: int
+    birth: int
+    death: int
+    kind: str
+    producer: str = ""                  # producing primitive, "" = input
+    var: Any = dataclasses.field(default=None, repr=False, compare=False)
+
+    @property
+    def span(self) -> int:
+        return self.death - self.birth
+
+
+@dataclasses.dataclass
+class MemTimeline:
+    """The per-equation live-set timeline of one program body.
+
+    ``live_bytes[i]`` (one entry per equation) folds in ``extra_bytes[i]``
+    — the interior working set of equation i's sub-jaxpr bodies beyond
+    their boundary buffers. ``peak_residents`` names the top-k buffers
+    live at the peak equation, largest first."""
+
+    buffers: List[Buffer]
+    live_bytes: List[int]
+    extra_bytes: List[int]
+    peak_bytes: int
+    peak_index: int
+    peak_residents: List[Tuple[str, int]]
+    n_eqns: int
+    input_bytes: int
+    output_bytes: int
+    donated_pairs: List[Tuple[int, int]]     # merged (invar, outvar) slots
+    donation_copies: List[int]               # donated slots forced to copy
+    axis_sizes: Dict[str, int] = dataclasses.field(default_factory=dict)
+    body: Any = dataclasses.field(default=None, repr=False)
+
+    def residents_at(self, index: int) -> List[Buffer]:
+        """Buffers live at equation ``index``, largest first."""
+        return sorted((b for b in self.buffers
+                       if b.birth <= index <= b.death and b.nbytes > 0),
+                      key=lambda b: -b.nbytes)
+
+
+def _donation_pairs(body, donated_idx: Sequence[int]
+                    ) -> Tuple[List[Tuple[int, int]], List[int]]:
+    """(merged pairs, copy-forced slots) for donated invar positions —
+    the analyze_donation pairing convention (carry slot k with output k,
+    else first compatible free output), plus the late-read test: a
+    donated leaf read after its aliased output is produced cannot share
+    the buffer (XLA copies; APX203 names it)."""
+    from apex_tpu.lint.spmd_checks import _aval_key
+    invars = list(body.invars)
+    outvars = list(body.outvars)
+    read_at: Dict[Any, List[int]] = {}
+    produced_at: Dict[Any, int] = {}
+    for i, eqn in enumerate(body.eqns):
+        for v in eqn.invars:
+            try:
+                read_at.setdefault(v, []).append(i)
+            except TypeError:
+                pass
+        for ov in eqn.outvars:
+            try:
+                produced_at[ov] = i
+            except TypeError:
+                pass
+    out_avals = [_aval(v) for v in outvars]
+    out_taken = [False] * len(outvars)
+    pairs: List[Tuple[int, int]] = []
+    copies: List[int] = []
+    for slot, inv_idx in enumerate(donated_idx):
+        if inv_idx >= len(invars):
+            continue
+        v = invars[inv_idx]
+        partner: Optional[int] = None
+        if slot < len(outvars) and not out_taken[slot] \
+                and _aval_key(out_avals[slot]) == _aval_key(_aval(v)):
+            partner = slot
+        else:
+            for k, (taken, oa) in enumerate(zip(out_taken, out_avals)):
+                if not taken and _aval_key(oa) == _aval_key(_aval(v)):
+                    partner = k
+                    break
+        if partner is None:
+            continue                     # refused: stays a plain input
+        out_taken[partner] = True
+        w = outvars[partner]
+        if w is v:                       # passthrough, trivially aliased
+            pairs.append((inv_idx, partner))
+            continue
+        def_idx = produced_at.get(w)
+        reads = read_at.get(v, [])
+        if def_idx is not None and any(i > def_idx for i in reads):
+            copies.append(inv_idx)       # late read: two real buffers
+            continue
+        pairs.append((inv_idx, partner))
+    return pairs, copies
+
+
+def _body_timeline(body, *, donated_idx: Sequence[int] = (), top_k: int = 5,
+                   axis_sizes: Optional[Dict[str, int]] = None,
+                   _cache: Optional[Dict[int, int]] = None,
+                   _depth: int = 0) -> MemTimeline:
+    eqns = list(body.eqns)
+    n = len(eqns)
+    cache = {} if _cache is None else _cache
+
+    # ---- births / deaths ------------------------------------------------
+    last_read: Dict[Any, int] = {}
+    birth: Dict[Any, int] = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.invars:
+            try:
+                last_read[v] = i
+            except TypeError:
+                pass
+        for ov in eqn.outvars:
+            try:
+                birth[ov] = i
+            except TypeError:
+                pass
+    out_set = set()
+    for ov in body.outvars:
+        try:
+            out_set.add(ov)
+        except TypeError:
+            pass
+
+    pairs, copies = (_donation_pairs(body, donated_idx)
+                     if donated_idx else ([], []))
+    merged_in = {inv for inv, _ in pairs}
+    merged_out = set()
+    for _, out_slot in pairs:
+        try:
+            merged_out.add(body.outvars[out_slot])
+        except (IndexError, TypeError):
+            pass
+
+    buffers: List[Buffer] = []
+    invars = list(body.invars)
+    for k, v in enumerate(invars):
+        nb = aval_bytes(_aval(v))
+        if nb <= 0:
+            continue
+        tag = " (donated)" if k in merged_in else (
+            " (donation copied)" if k in copies else "")
+        # inputs are resident for the whole call; a cleanly-merged
+        # donated input carries its aliased output's lifetime too
+        buffers.append(Buffer(
+            name=f"{aval_str(_aval(v))} input {k}{tag}",
+            nbytes=nb, birth=-1, death=n, kind="input", var=v))
+    seen_out = set()
+    for i, eqn in enumerate(eqns):
+        for ov in eqn.outvars:
+            try:
+                hash(ov)
+            except TypeError:
+                continue
+            nb = aval_bytes(_aval(ov))
+            if nb <= 0:
+                continue
+            if ov in out_set:
+                if ov in merged_out:
+                    continue            # aliased into its donated input
+                if ov in seen_out:
+                    continue
+                seen_out.add(ov)
+                buffers.append(Buffer(
+                    name=f"{aval_str(_aval(ov))} output "
+                         f"<- {eqn.primitive.name} @eqn {i}",
+                    nbytes=nb, birth=i, death=n, kind="output",
+                    producer=eqn.primitive.name, var=ov))
+            else:
+                buffers.append(Buffer(
+                    name=f"{aval_str(_aval(ov))} "
+                         f"<- {eqn.primitive.name} @eqn {i}",
+                    nbytes=nb, birth=i, death=last_read.get(ov, i),
+                    kind="temp", producer=eqn.primitive.name, var=ov))
+
+    # ---- sub-jaxpr interiors (analyzed once, composed structurally) -----
+    extra = [0] * n
+    if _depth < _MAX_DEPTH:
+        for i, eqn in enumerate(eqns):
+            worst = 0
+            for sub in subjaxprs_tagged(eqn):
+                key = id(sub.jaxpr)
+                if key not in cache:
+                    inner = _body_timeline(
+                        sub.jaxpr, top_k=1, _cache=cache,
+                        _depth=_depth + 1)
+                    boundary = sum(aval_bytes(_aval(v))
+                                   for v in sub.jaxpr.invars)
+                    boundary += sum(aval_bytes(_aval(v))
+                                    for v in sub.jaxpr.outvars)
+                    cache[key] = max(0, inner.peak_bytes - boundary)
+                worst = max(worst, cache[key])
+            extra[i] = worst
+
+    # ---- the timeline (interval diff-sum, O(buffers + eqns)) ------------
+    delta = [0] * (n + 1)
+    for b in buffers:
+        lo = max(0, b.birth)
+        hi = min(n - 1, b.death)
+        if n == 0 or hi < lo:
+            continue
+        delta[lo] += b.nbytes
+        delta[hi + 1] -= b.nbytes
+    live: List[int] = []
+    running = 0
+    for i in range(n):
+        running += delta[i]
+        live.append(running + extra[i])
+
+    if live:
+        peak_index = max(range(n), key=lambda i: live[i])
+        peak = live[peak_index]
+    else:
+        peak_index = -1
+        peak = sum(b.nbytes for b in buffers)   # equations-free body
+
+    residents = [(b.name, b.nbytes)
+                 for b in sorted(
+                     (b for b in buffers
+                      if b.birth <= peak_index <= b.death),
+                     key=lambda b: -b.nbytes)[:top_k]] \
+        if peak_index >= 0 else [(b.name, b.nbytes) for b in buffers[:top_k]]
+    if peak_index >= 0 and extra[peak_index] > 0:
+        residents = residents[:max(0, top_k - 1)] + [
+            (f"sub-jaxpr interior @eqn {peak_index} "
+             f"({eqns[peak_index].primitive.name})", extra[peak_index])]
+
+    return MemTimeline(
+        buffers=buffers, live_bytes=live, extra_bytes=extra,
+        peak_bytes=peak, peak_index=peak_index,
+        peak_residents=residents, n_eqns=n,
+        input_bytes=sum(b.nbytes for b in buffers if b.kind == "input"),
+        output_bytes=sum(b.nbytes for b in buffers if b.kind == "output"),
+        donated_pairs=pairs, donation_copies=copies,
+        axis_sizes=dict(axis_sizes or {}), body=body)
+
+
+def compute_timeline(closed, args: Optional[tuple] = None, *,
+                     donate_argnums: Sequence[int] = (),
+                     axis_sizes: Optional[Dict[str, int]] = None,
+                     top_k: int = 5) -> MemTimeline:
+    """The live-range timeline of a traced program (``closed`` from
+    ``jax.make_jaxpr(fn)(*args)``). Descends the trainer's sole
+    top-level shard_map/pjit wrapper (so per-device block avals are what
+    get sized), retires donated inputs into their aliased outputs, and
+    collects enclosing mesh axis sizes for the rule messages. ``args``
+    is only needed to resolve ``donate_argnums`` into flat leaf slots."""
+    from apex_tpu.lint.spmd_checks import (_donated_invar_indices,
+                                           _program_body)
+    body, _ = _program_body(closed.jaxpr)
+    donated: List[int] = []
+    if donate_argnums and args is not None:
+        donated = _donated_invar_indices(args, donate_argnums)
+    sizes: Dict[str, int] = dict(axis_sizes or {})
+
+    def visit(eqn):
+        if eqn.primitive.name == "shard_map":
+            for name, size in mesh_axis_sizes(eqn).items():
+                sizes.setdefault(name, size)
+    walk_jaxpr(closed.jaxpr, visit)
+    return _body_timeline(body, donated_idx=donated, top_k=top_k,
+                          axis_sizes=sizes)

@@ -1,0 +1,566 @@
+"""Mem pass: whole-program peak-HBM and live-range verification of
+lowered entry points (rules APX301-APX307).
+
+Where the SPMD pass (APX2xx) proves a program is *correct* across
+ranks, this pass proves it *fits* — and that the memory story the
+trainer and planner tell (donation, ZeRO sharding, activation
+residency) actually holds in the traced graph. The engine is
+:mod:`apex_tpu.lint.liveness`: an abstract interpretation computing a
+per-equation live-set timeline with buffer sizes from avals (per-device
+block shapes inside shard_map bodies), donation aliasing, and loop
+bodies analyzed once and composed structurally with their trip counts.
+
+Rules:
+
+* **APX301 peak-exceeds-hbm** — the timeline's peak live bytes exceed
+  the device capacity (:func:`apex_tpu.pyprof.roofline.
+  device_hbm_bytes`; ``APEX_TPU_HBM_BYTES`` overrides). The finding
+  names the peak equation and the top-k resident buffers — the ones to
+  shard, remat, or offload first.
+* **APX302 undonated-carried-state** — an argument DECLARED as carried
+  state (``state_argnums`` — the trainer seam passes its state arg)
+  whose leaves have aval-compatible outputs (the update exists) but is
+  not in ``donate_argnums``: old and new state double-buffer, exactly
+  what the trainer's runtime :class:`~apex_tpu.trainer.DonationReport`
+  would show as unaliased. Below
+  ``APEX_TPU_LINT_MEM_STATE_BYTES`` (default 1 MiB) the double
+  residency is noise and stays silent.
+* **APX303 long-lived-activation** — a forward-born temp above
+  ``APEX_TPU_LINT_MEM_ACT_BYTES`` (default 8 MiB) that stays live deep
+  into the backward (the first ``transpose(...)``-scoped equation marks
+  the fwd/bwd boundary; span fractions are the fallback when no
+  backward markers exist): the canonical remat / host-offload
+  candidate.
+* **APX304 zero-full-materialization** — an ``all_gather`` result at
+  least the SPMD pass's replication threshold that stays live across
+  more than ``APEX_TPU_LINT_MEM_GATHER_SPAN`` equations (default 8): a
+  ZeRO step that gathers params chunk-by-chunk consumes each gather
+  promptly; a gather parked across the step is the full-parameter
+  materialization weight-update sharding exists to avoid.
+* **APX305 scan-carry-growth** — a ``concatenate``/``pad`` inside a
+  scan body on the dataflow path from a carry input to a carry output:
+  the carry is rebuilt from its own previous value plus new data every
+  iteration — the O(steps^2)-traffic accumulation pattern (and the
+  unbounded-growth pattern when unrolled).
+* **APX306 host-transfer-in-step** — a host callback
+  (``pure_callback`` / ``io_callback`` / ``debug_callback``) moving at
+  least ``APEX_TPU_LINT_MEM_HOST_BYTES`` (default 64 KiB) inside the
+  compiled region: the payload crosses PCIe/host memory every step and
+  pins its operands while it does. Scalar debug taps stay silent.
+* **APX307 peak-memory-regression** — the entry's peak grew more than
+  ``APEX_TPU_LINT_MEM_TOL_PCT`` (default 5%) over a committed
+  per-entry baseline (:func:`load_peak_baseline` /
+  :func:`write_peak_baseline`; the CI gate keeps ``ci/mem_baseline.
+  json``). Findings route through the same suppression / SARIF /
+  baseline plumbing as every other pass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from apex_tpu.lint.liveness import (Buffer, MemTimeline, aval_str,
+                                    compute_timeline)
+from apex_tpu.lint.report import Finding
+from apex_tpu.utils.jaxpr_walk import aval_bytes, operand_bytes
+
+__all__ = ["MemReport", "analyze_entry_mem", "check_entry_mem",
+           "run_entries_mem", "entry_peaks", "verified_peak_bytes",
+           "load_peak_baseline", "write_peak_baseline",
+           "mem_tolerance_pct"]
+
+_HOST_PRIMS = frozenset({"pure_callback", "io_callback", "debug_callback",
+                         "infeed", "outfeed"})
+_GROWTH_PRIMS = frozenset({"concatenate", "pad"})
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def state_bytes_threshold() -> int:
+    return _env_int("APEX_TPU_LINT_MEM_STATE_BYTES", 1 << 20)
+
+
+def act_bytes_threshold() -> int:
+    return _env_int("APEX_TPU_LINT_MEM_ACT_BYTES", 8 << 20)
+
+
+def gather_span_threshold() -> int:
+    return _env_int("APEX_TPU_LINT_MEM_GATHER_SPAN", 8)
+
+
+def host_bytes_threshold() -> int:
+    return _env_int("APEX_TPU_LINT_MEM_HOST_BYTES", 64 << 10)
+
+
+def mem_tolerance_pct() -> float:
+    """APX307's regression tolerance (percent over the committed
+    baseline), overridable via ``APEX_TPU_LINT_MEM_TOL_PCT``."""
+    try:
+        return float(os.environ.get("APEX_TPU_LINT_MEM_TOL_PCT", "5"))
+    except ValueError:
+        return 5.0
+
+
+def _frame_for(eqn, default_path: str, default_line: int):
+    from apex_tpu.lint.jaxpr_checks import _frame_for as f
+    return f(eqn, default_path, default_line)
+
+
+def _mib(n: float) -> str:
+    return f"{n / (1 << 20):.1f} MiB"
+
+
+@dataclasses.dataclass
+class _Ctx:
+    entry: str
+    path: str
+    findings: List[Finding]
+    flagged: set = dataclasses.field(default_factory=set)
+
+    def emit(self, rule: str, eqn, msg: str) -> None:
+        path, line = _frame_for(eqn, self.path, 0) if eqn is not None \
+            else (self.path, 0)
+        key = (rule, id(eqn))
+        if key in self.flagged:
+            return
+        self.flagged.add(key)
+        self.findings.append(Finding(
+            rule, path, line, f"[entry {self.entry}] {msg}"))
+
+
+@dataclasses.dataclass
+class MemReport:
+    """One entry's verified memory story: the timeline, its peak, the
+    capacity judged against, and the findings."""
+
+    entry: str
+    peak_bytes: int
+    capacity_bytes: float
+    timeline: MemTimeline
+    findings: List[Finding]
+
+    def to_json(self) -> dict:
+        return {"entry": self.entry, "peak_bytes": int(self.peak_bytes),
+                "capacity_bytes": float(self.capacity_bytes),
+                "peak_index": self.timeline.peak_index,
+                "peak_residents": [
+                    {"name": n, "bytes": int(b)}
+                    for n, b in self.timeline.peak_residents],
+                "findings": [f.rule_id for f in self.findings]}
+
+
+# ---------------------------------------------------------------------------
+# per-rule checks
+# ---------------------------------------------------------------------------
+
+def _check_apx301(tl: MemTimeline, capacity: float, ctx: _Ctx) -> None:
+    if tl.peak_bytes <= capacity:
+        return
+    eqn = (tl.body.eqns[tl.peak_index]
+           if tl.body is not None and 0 <= tl.peak_index < tl.n_eqns
+           else None)
+    top = "; ".join(f"{name} ({_mib(nb)})"
+                    for name, nb in tl.peak_residents)
+    ctx.emit(
+        "APX301", eqn,
+        f"peak live bytes {_mib(tl.peak_bytes)} exceed device HBM "
+        f"capacity {_mib(capacity)} (APEX_TPU_HBM_BYTES overrides) at "
+        f"equation {tl.peak_index}; largest residents: {top} — shard, "
+        f"remat, or offload these first")
+
+
+def _check_apx302(tl: MemTimeline, args: Optional[tuple],
+                  state_argnums: Sequence[int],
+                  donate_argnums: Sequence[int], ctx: _Ctx) -> None:
+    if args is None or not state_argnums:
+        return
+    undonated = [a for a in state_argnums if a not in set(donate_argnums)]
+    if not undonated:
+        return
+    from apex_tpu.lint.spmd_checks import (_aval_key,
+                                           _donated_invar_indices)
+    body = tl.body
+    if body is None:
+        return
+    slots = _donated_invar_indices(args, undonated)
+    invars = list(body.invars)
+    out_avals = [getattr(v, "aval", None) for v in body.outvars]
+    out_taken = [False] * len(out_avals)
+    double = 0
+    first_slot = None
+    for idx in slots:
+        if idx >= len(invars):
+            continue
+        v = invars[idx]
+        key = _aval_key(getattr(v, "aval", None))
+        for k, (taken, oa) in enumerate(zip(out_taken, out_avals)):
+            if not taken and _aval_key(oa) == key:
+                out_taken[k] = True
+                double += aval_bytes(getattr(v, "aval", None))
+                if first_slot is None:
+                    first_slot = idx
+                break
+    if double < state_bytes_threshold():
+        return
+    ctx.emit(
+        "APX302", None,
+        f"carried state ({_mib(double)} across "
+        f"{sum(out_taken)} leaves, first leaf slot {first_slot}) is "
+        f"updated by this step but NOT donated — old and new state "
+        f"double-buffer in HBM every step (the runtime DonationReport "
+        f"would show these leaves unaliased); declare the state arg in "
+        f"donate_argnums (trainer.build does by default)")
+
+
+def _backward_start(body) -> Optional[int]:
+    """First equation index whose name stack carries a ``transpose(``
+    scope — where jax's reverse-mode backward begins. None when the
+    program has no backward markers."""
+    from apex_tpu.lint.spmd_checks import _name_stack
+    for i, eqn in enumerate(body.eqns):
+        if "transpose(" in _name_stack(eqn):
+            return i
+    return None
+
+
+def _check_apx303(tl: MemTimeline, ctx: _Ctx) -> None:
+    if tl.body is None or tl.n_eqns < 10:
+        return
+    n = tl.n_eqns
+    bwd = _backward_start(tl.body)
+    threshold = act_bytes_threshold()
+    for b in tl.buffers:
+        if b.kind != "temp" or b.nbytes < threshold:
+            continue
+        if bwd is not None:
+            # born in the forward, still live past the midpoint of the
+            # backward: every remat/offload framework's target set
+            if not (b.birth < bwd and b.death >= bwd + (n - bwd) // 2):
+                continue
+        else:
+            # no backward markers: fall back to span fractions (born in
+            # the first 40%, live into the last 20%)
+            if not (b.birth < 0.4 * n and b.death >= 0.8 * n):
+                continue
+        eqn = tl.body.eqns[b.birth] if 0 <= b.birth < n else None
+        ctx.emit(
+            "APX303", eqn,
+            f"activation {b.name} ({_mib(b.nbytes)}) is born in the "
+            f"forward (equation {b.birth}) and stays live into the late "
+            f"backward (last read at equation {b.death} of {n}) — it "
+            f"sits in HBM across the whole step; a remat "
+            f"(jax.checkpoint) or host-offload candidate "
+            f"(APEX_TPU_LINT_MEM_ACT_BYTES tunes the size floor)")
+
+
+def _check_apx304(tl: MemTimeline, ctx: _Ctx) -> None:
+    from apex_tpu.lint.spmd_checks import replication_threshold_bytes
+    if tl.body is None:
+        return
+    span_max = gather_span_threshold()
+    size_min = replication_threshold_bytes()
+    for b in tl.buffers:
+        if b.producer != "all_gather" or b.nbytes < size_min:
+            continue
+        if b.span <= span_max:
+            continue
+        eqn = tl.body.eqns[b.birth] if 0 <= b.birth < tl.n_eqns else None
+        ctx.emit(
+            "APX304", eqn,
+            f"all_gather result {b.name} ({_mib(b.nbytes)}) stays live "
+            f"across {b.span} equations (threshold {span_max}; "
+            f"APEX_TPU_LINT_MEM_GATHER_SPAN overrides) — a full-"
+            f"parameter materialization parked inside the step defeats "
+            f"ZeRO-style sharding; gather chunk-by-chunk and consume "
+            f"each chunk before gathering the next")
+
+
+def _reachable_from(body, seeds) -> set:
+    """Vars reachable forward from ``seeds`` through the body's
+    equations (ids — Literals and DropVars excluded)."""
+    ids = set()
+    for s in seeds:
+        try:
+            ids.add(s)
+        except TypeError:
+            pass
+    for eqn in body.eqns:
+        hit = False
+        for v in eqn.invars:
+            try:
+                if v in ids:
+                    hit = True
+                    break
+            except TypeError:
+                pass
+        if not hit:
+            continue
+        for ov in eqn.outvars:
+            try:
+                ids.add(ov)
+            except TypeError:
+                pass
+    return ids
+
+
+def _reaches(body, seeds) -> set:
+    """Vars from which ``seeds`` are reachable (backward closure)."""
+    want = set()
+    for s in seeds:
+        try:
+            want.add(s)
+        except TypeError:
+            pass
+    for eqn in reversed(body.eqns):
+        hit = False
+        for ov in eqn.outvars:
+            try:
+                if ov in want:
+                    hit = True
+                    break
+            except TypeError:
+                pass
+        if not hit:
+            continue
+        for v in eqn.invars:
+            try:
+                want.add(v)
+            except TypeError:
+                pass
+    return want
+
+
+def _check_apx305_scan(eqn, ctx: _Ctx) -> None:
+    closed = eqn.params.get("jaxpr")
+    body = getattr(closed, "jaxpr", closed)
+    if not hasattr(body, "eqns"):
+        return
+    num_consts = int(eqn.params.get("num_consts", 0))
+    num_carry = int(eqn.params.get("num_carry", 0))
+    if num_carry == 0:
+        return
+    carry_in = body.invars[num_consts:num_consts + num_carry]
+    carry_out = body.outvars[:num_carry]
+    from_carry = _reachable_from(body, carry_in)
+    to_carry = _reaches(body, carry_out)
+    for beqn in body.eqns:
+        if beqn.primitive.name not in _GROWTH_PRIMS:
+            continue
+        reads_carry = False
+        for v in beqn.invars:
+            try:
+                if v in from_carry:
+                    reads_carry = True
+                    break
+            except TypeError:
+                pass
+        feeds_carry = False
+        for ov in beqn.outvars:
+            try:
+                if ov in to_carry:
+                    feeds_carry = True
+                    break
+            except TypeError:
+                pass
+        if reads_carry and feeds_carry:
+            ctx.emit(
+                "APX305", eqn,
+                f"scan carry is rebuilt through `{beqn.primitive.name}` "
+                f"of its own previous value every iteration — the "
+                f"concat/pad accumulation pattern: each step re-copies "
+                f"the whole carry (O(steps^2) HBM traffic; unbounded "
+                f"growth when unrolled); preallocate and write with "
+                f"dynamic_update_slice, or carry a running reduction")
+            return
+
+
+def _check_apx306(eqn, ctx: _Ctx) -> None:
+    if eqn.primitive.name not in _HOST_PRIMS:
+        return
+    payload = operand_bytes(eqn) + sum(
+        aval_bytes(getattr(ov, "aval", None)) for ov in eqn.outvars)
+    if payload < host_bytes_threshold():
+        return
+    ctx.emit(
+        "APX306", eqn,
+        f"`{eqn.primitive.name}` moves {_mib(payload)} between device "
+        f"and host inside the compiled region (threshold "
+        f"{_mib(host_bytes_threshold())}; APEX_TPU_LINT_MEM_HOST_BYTES "
+        f"overrides) — the transfer crosses PCIe every step and pins "
+        f"its operands while it waits; keep the data on device, or "
+        f"move the tap outside the compiled step")
+
+
+def _walk_rules(body, ctx: _Ctx, _depth: int = 0) -> None:
+    """Structural rules (APX305/306) over every nesting level."""
+    from apex_tpu.utils.jaxpr_walk import subjaxprs_tagged
+    if _depth > 16:
+        return
+    for eqn in body.eqns:
+        if eqn.primitive.name == "scan":
+            _check_apx305_scan(eqn, ctx)
+        _check_apx306(eqn, ctx)
+        for sub in subjaxprs_tagged(eqn):
+            _walk_rules(sub.jaxpr, ctx, _depth + 1)
+
+
+def _check_apx307(tl: MemTimeline, baseline_bytes: Optional[float],
+                  ctx: _Ctx) -> None:
+    if baseline_bytes is None or baseline_bytes <= 0:
+        return
+    tol = mem_tolerance_pct()
+    if tl.peak_bytes <= baseline_bytes * (1.0 + tol / 100.0):
+        return
+    grew = 100.0 * (tl.peak_bytes - baseline_bytes) / baseline_bytes
+    eqn = (tl.body.eqns[tl.peak_index]
+           if tl.body is not None and 0 <= tl.peak_index < tl.n_eqns
+           else None)
+    top = "; ".join(f"{name} ({_mib(nb)})"
+                    for name, nb in tl.peak_residents[:3])
+    ctx.emit(
+        "APX307", eqn,
+        f"peak memory regression: {_mib(tl.peak_bytes)} vs committed "
+        f"baseline {_mib(baseline_bytes)} (+{grew:.1f}%, tolerance "
+        f"{tol:.0f}%; APEX_TPU_LINT_MEM_TOL_PCT overrides) — largest "
+        f"residents at the new peak: {top}; re-baseline deliberately "
+        f"(write_peak_baseline / the gate's --update path) or fix the "
+        f"regression")
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def analyze_entry_mem(fn: Callable, args: tuple, *, name: str = "<entry>",
+                      path: str = "<jaxpr>",
+                      mesh_axes: Sequence[str] = (),
+                      axis_sizes: Optional[Dict[str, int]] = None,
+                      donate_argnums: Sequence[int] = (),
+                      state_argnums: Sequence[int] = (),
+                      capacity_bytes: Optional[float] = None,
+                      baseline_bytes: Optional[float] = None,
+                      closed=None, top_k: int = 5) -> MemReport:
+    """Trace ``fn(*args)`` (no execution) and run the APX3xx mem rules,
+    returning the full :class:`MemReport` (timeline + peak + findings).
+    ``closed`` accepts an already-lowered ClosedJaxpr of the same
+    ``fn(*args)`` so callers running multiple passes lower once;
+    ``state_argnums`` declares which args are carried state (arms
+    APX302 when they are not donated); ``capacity_bytes`` overrides the
+    device HBM table; ``baseline_bytes`` arms APX307."""
+    del mesh_axes  # sizes come from the program's own shard_map meshes
+    if closed is None:
+        closed = jax.make_jaxpr(fn)(*args)
+    tl = compute_timeline(closed, args, donate_argnums=donate_argnums,
+                          axis_sizes=axis_sizes, top_k=top_k)
+    if capacity_bytes is None:
+        from apex_tpu.pyprof.roofline import device_hbm_bytes
+        capacity_bytes = device_hbm_bytes()
+    ctx = _Ctx(entry=name, path=path, findings=[])
+    _check_apx301(tl, float(capacity_bytes), ctx)
+    _check_apx302(tl, args, state_argnums, donate_argnums, ctx)
+    _check_apx303(tl, ctx)
+    _check_apx304(tl, ctx)
+    if tl.body is not None:
+        _walk_rules(tl.body, ctx)
+    _check_apx307(tl, baseline_bytes, ctx)
+    return MemReport(entry=name, peak_bytes=tl.peak_bytes,
+                     capacity_bytes=float(capacity_bytes), timeline=tl,
+                     findings=ctx.findings)
+
+
+def check_entry_mem(fn: Callable, args: tuple, **kwargs) -> List[Finding]:
+    """The findings-only form of :func:`analyze_entry_mem` — the same
+    call shape as :func:`~apex_tpu.lint.spmd_checks.check_entry_spmd`::
+
+        from apex_tpu import lint
+        findings = lint.check_entry_mem(step, (state, batch),
+                                        donate_argnums=(0,),
+                                        state_argnums=(0,))
+    """
+    return analyze_entry_mem(fn, args, **kwargs).findings
+
+
+def verified_peak_bytes(fn: Callable, args: tuple, *,
+                        donate_argnums: Sequence[int] = (),
+                        axis_sizes: Optional[Dict[str, int]] = None,
+                        closed=None) -> int:
+    """Just the analyzer's peak — the number the planner cross-checks
+    its analytic ``hbm_footprint`` against and the trainer emits as the
+    ``trainer/peak_hbm_bytes`` telemetry static."""
+    if closed is None:
+        closed = jax.make_jaxpr(fn)(*args)
+    tl = compute_timeline(closed, args, donate_argnums=donate_argnums,
+                          axis_sizes=axis_sizes, top_k=1)
+    return int(tl.peak_bytes)
+
+
+# ---------------------------------------------------------------------------
+# the committed per-entry peak baseline (APX307)
+# ---------------------------------------------------------------------------
+
+def load_peak_baseline(path: str) -> Dict[str, int]:
+    """``{entry name: peak bytes}`` from a baseline file written by
+    :func:`write_peak_baseline` (schema-versioned; unknown versions
+    refuse loudly rather than silently passing every regression)."""
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if doc.get("version") != 1:
+        raise ValueError(
+            f"mem baseline {path}: unsupported version "
+            f"{doc.get('version')!r} (expected 1)")
+    return {str(k): int(v) for k, v in doc.get("entries", {}).items()}
+
+
+def write_peak_baseline(path: str, peaks: Dict[str, int]) -> None:
+    doc = {"version": 1,
+           "tolerance_pct": mem_tolerance_pct(),
+           "entries": {k: int(v) for k, v in sorted(peaks.items())}}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def entry_peaks(entries=None) -> Dict[str, int]:
+    """Analyzer peak per registered entry — the values
+    :func:`write_peak_baseline` commits and the CI gate re-derives."""
+    from apex_tpu.lint.jaxpr_checks import builtin_entries
+    peaks: Dict[str, int] = {}
+    for spec in builtin_entries() if entries is None else entries:
+        fn, args = spec.make()
+        peaks[spec.name] = verified_peak_bytes(
+            fn, args, donate_argnums=getattr(spec, "donate_argnums", ()))
+    return peaks
+
+
+def run_entries_mem(entries=None, *,
+                    baseline: Optional[Any] = None) -> List[Finding]:
+    """Run the mem pass over every registered entry point (the same
+    EntrySpec list the jaxpr/SPMD passes lower — build failures are
+    loud, not skipped). ``baseline`` is a ``{entry: peak bytes}`` dict
+    or a baseline file path (arms APX307 per entry)."""
+    from apex_tpu.lint.jaxpr_checks import builtin_entries
+    if isinstance(baseline, str):
+        baseline = load_peak_baseline(baseline)
+    findings: List[Finding] = []
+    for spec in builtin_entries() if entries is None else entries:
+        try:
+            fn, args = spec.make()
+        except Exception as e:    # pragma: no cover - defensive
+            raise RuntimeError(
+                f"apexlint mem entry {spec.name!r} failed to build: {e}"
+            ) from e
+        findings.extend(check_entry_mem(
+            fn, args, name=spec.name, path=spec.path,
+            donate_argnums=getattr(spec, "donate_argnums", ()),
+            baseline_bytes=(baseline or {}).get(spec.name)))
+    return findings

@@ -102,6 +102,30 @@ _RULES = [
     Rule("APX208", "scan-carry-widening", WARNING,
          "fp32 scan carry produced by widening a bf16/fp16 body value "
          "every iteration — 2x carry memory/bandwidth for no gain"),
+    Rule("APX301", "peak-exceeds-hbm", ERROR,
+         "the program's peak live bytes (static live-range timeline) "
+         "exceed the device HBM capacity — it cannot compile to the "
+         "target without sharding/remat/offload"),
+    Rule("APX302", "undonated-carried-state", WARNING,
+         "a declared carried-state argument is updated but not donated "
+         "— old and new state double-buffer in HBM every step"),
+    Rule("APX303", "long-lived-activation", WARNING,
+         "a large forward activation stays live into the late backward "
+         "— resident across the whole step; remat/offload candidate"),
+    Rule("APX304", "zero-full-materialization", WARNING,
+         "an all_gather'd buffer stays live across many equations "
+         "inside a sharded step — full-parameter materialization "
+         "defeating ZeRO-style weight-update sharding"),
+    Rule("APX305", "scan-carry-growth", ERROR,
+         "concatenate/pad accumulation through a scan carry — the "
+         "carry is recopied every iteration (O(steps^2) traffic; "
+         "unbounded growth unrolled)"),
+    Rule("APX306", "host-transfer-in-step", WARNING,
+         "a host callback moves >= threshold bytes inside the compiled "
+         "region — PCIe round-trip pinning its operands every step"),
+    Rule("APX307", "peak-memory-regression", ERROR,
+         "entry peak memory grew beyond tolerance over the committed "
+         "per-entry baseline (ci/mem_baseline.json)"),
 ]
 
 RULES: Dict[str, Rule] = {r.id: r for r in _RULES}
@@ -109,3 +133,4 @@ RULES: Dict[str, Rule] = {r.id: r for r in _RULES}
 AST_RULE_IDS = tuple(r.id for r in _RULES if r.id.startswith("APX0"))
 JAXPR_RULE_IDS = tuple(r.id for r in _RULES if r.id.startswith("APX1"))
 SPMD_RULE_IDS = tuple(r.id for r in _RULES if r.id.startswith("APX2"))
+MEM_RULE_IDS = tuple(r.id for r in _RULES if r.id.startswith("APX3"))

@@ -80,7 +80,8 @@ import jax
 import numpy as np
 
 from apex_tpu.lint.report import Finding
-from apex_tpu.utils.jaxpr_walk import mesh_axis_sizes, subjaxprs_tagged
+from apex_tpu.utils.jaxpr_walk import (aval_bytes, mesh_axis_sizes,
+                                       subjaxprs_tagged)
 
 # the collective catalog is telemetry's (one wire-cost table, one rule
 # set); axis_index is rank-*producing*, not a scheduled collective
@@ -135,12 +136,7 @@ def _dtype_name(aval) -> str:
 
 
 def _nbytes(aval) -> int:
-    shape = getattr(aval, "shape", None)
-    dtype = getattr(aval, "dtype", None)
-    if shape is None or dtype is None:
-        return 0
-    n = int(np.prod(shape, dtype=np.int64)) if shape else 1
-    return n * np.dtype(dtype).itemsize
+    return aval_bytes(aval)      # jaxpr_walk: ONE byte definition
 
 
 def _nelems(aval) -> int:

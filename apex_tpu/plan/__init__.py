@@ -34,7 +34,8 @@ from apex_tpu.plan.adapters import (ADAPTERS, Built, GPTAdapter,
 from apex_tpu.plan.cost import (CostBreakdown, HeteroCost, WireItem,
                                 analytic_wire, estimate, hbm_footprint,
                                 heterogeneous_step_s, member_speeds,
-                                optimal_weights, traced_wire)
+                                optimal_weights, plan_hbm_tolerance_pct,
+                                traced_wire)
 from apex_tpu.plan.describe import ModelDesc
 from apex_tpu.plan.emit import Plan, PlanRejected, emit, format_table, \
     verify_built
@@ -46,7 +47,8 @@ from apex_tpu.plan.search import (Constraints, PlanError, Verdict, auto,
 __all__ = [
     "auto", "estimate", "estimate_layout", "enumerate_candidates",
     "prune", "rank", "replanner", "analytic_wire", "traced_wire",
-    "hbm_footprint", "emit", "verify_built", "format_table",
+    "hbm_footprint", "plan_hbm_tolerance_pct", "emit", "verify_built",
+    "format_table",
     "Layout", "parse_layout_id", "Constraints", "Verdict", "Plan",
     "PlanError", "PlanRejected", "CostBreakdown", "HeteroCost",
     "WireItem", "heterogeneous_step_s", "member_speeds",

@@ -315,10 +315,23 @@ def hbm_footprint(desc: ModelDesc, layout: Layout,
                   capacity: Optional[float] = None) -> Dict[str, float]:
     """Per-device HBM need: params + grads + optimizer state under the
     ZeRO stage + activation estimate. ``capacity`` (when given) rides
-    along for the pruner's verdict message."""
+    along for the pruner's verdict message.
+
+    Microbatch accumulation changes BOTH memory terms, in opposite
+    directions: only one microbatch's activations are live at a time
+    (the scan body re-stashes per chunk — ``act`` divides by
+    ``microbatch``), but the accumulation CARRIES a full gradient-sized
+    accumulator through the scan, live simultaneously with each chunk's
+    fresh gradients at the combine — the ``grads`` term doubles. The
+    static analyzer (:func:`apex_tpu.lint.verified_peak_bytes`)
+    confirms both movements on the adapters' scan-mode builds; the
+    residual level gap is the activation estimate's documented
+    structural underestimate (see :func:`plan_hbm_tolerance_pct`)."""
     shard = layout.tp * layout.pp            # axes that SHARD params
     params = desc.param_bytes / shard
     grads = desc.param_count * desc.grad_itemsize / shard
+    if layout.microbatch > 1:
+        grads *= 2.0                         # accumulator + chunk grads
     if layout.zero:
         # fp32 master + both moments, sharded over dp; fp32 compute
         # params stay replicated (they ARE the dense copy here)
@@ -334,6 +347,28 @@ def hbm_footprint(desc: ModelDesc, layout: Layout,
     if capacity is not None:
         out["capacity"] = float(capacity)
     return out
+
+
+def plan_hbm_tolerance_pct() -> float:
+    """How far the lint mem analyzer's verified peak may sit ABOVE the
+    analytic ``hbm_footprint`` before the planner demotes a candidate
+    (``APEX_TPU_PLAN_HBM_TOL_PCT`` overrides; default 600).
+
+    The default is deliberately wide and deliberately named: the
+    analytic activation term is a forward-stash scaling model — it does
+    not price backward temporaries or the quadratic attention
+    matrices, so the compiled program's true peak runs ~1.2-2.2x the
+    formula on the shipped adapters (worst ~5.5x on toy configs; pinned
+    in tests/test_plan.py). The tolerance exists to pass that
+    structural band while still demoting pathological blow-ups (an
+    accidental full replication or O(steps^2) accumulation is 10-50x,
+    not 2x). The hard edge is separate and un-tolerated: a verified
+    peak above device capacity demotes regardless."""
+    import os
+    try:
+        return float(os.environ.get("APEX_TPU_PLAN_HBM_TOL_PCT", "600"))
+    except ValueError:
+        return 600.0
 
 
 # ---------------------------------------------------------------------------

@@ -25,15 +25,9 @@ Tree = Any
 
 def tree_bytes(tree: Tree) -> int:
     """Total bytes of a pytree of arrays/ShapeDtypeStructs."""
-    total = 0
-    for leaf in jax.tree_util.tree_leaves(tree):
-        shape = getattr(leaf, "shape", ())
-        dtype = getattr(leaf, "dtype", None)
-        if dtype is None:
-            continue
-        total += int(np.prod(shape, dtype=np.int64) if shape else 1) \
-            * np.dtype(dtype).itemsize
-    return total
+    from apex_tpu.utils.jaxpr_walk import aval_bytes
+    return sum(aval_bytes(leaf)
+               for leaf in jax.tree_util.tree_leaves(tree))
 
 
 def tree_count(tree: Tree) -> int:

@@ -98,6 +98,11 @@ class Verdict:
     cost: Optional[_cost.CostBreakdown] = None
     measured_s: Optional[float] = None   # validate="measure" only
     lint_findings: List[Any] = dataclasses.field(default_factory=list)
+    # lint.mem analyzer cross-check (traced candidates only): the
+    # verified per-device peak and the analytic formula's drift from it
+    # (positive = formula overestimates), the HBM twin of wire drift
+    hbm_verified_bytes: Optional[int] = None
+    hbm_error_pct: Optional[float] = None
 
     @property
     def step_s(self) -> float:
@@ -113,6 +118,11 @@ class Verdict:
                 "wire_mib": round(self.cost.wire_bytes / (1 << 20), 3),
                 "hbm_mib": round(self.cost.hbm["total"] / (1 << 20), 1),
                 "wire_source": self.cost.wire_source})
+        if self.hbm_verified_bytes is not None:
+            out["hbm_verified_mib"] = round(
+                self.hbm_verified_bytes / (1 << 20), 1)
+        if self.hbm_error_pct is not None:
+            out["hbm_error_pct"] = round(self.hbm_error_pct, 1)
         if self.measured_s is not None:
             out["measured_ms"] = round(self.measured_s * 1e3, 4)
         if self.lint_findings:
@@ -369,6 +379,40 @@ def validate_top(verdicts: List[Verdict], adapter, desc: ModelDesc, *,
         wire = _cost.traced_wire(built)
         v.cost = _cost.estimate(desc, v.layout, peaks=peaks, wire=wire,
                                 hbm_capacity=cap)
+        # the HBM honesty cross-check: the lint mem analyzer's verified
+        # per-device peak vs the analytic formula that pruned on HBM.
+        # Drift is always REPORTED (the bench tracks it across rounds
+        # like wire drift); a verified peak above capacity demotes
+        # unconditionally — the formula admitted a layout the program
+        # does not fit — and a peak beyond the named structural
+        # tolerance above the formula demotes too (a pathological
+        # blow-up the scaling model cannot see)
+        from apex_tpu.lint.mem_checks import verified_peak_bytes
+        verified = verified_peak_bytes(
+            built.wrapped, (built.state_avals, built.batch_avals),
+            donate_argnums=(0,), axis_sizes=built.axis_sizes)
+        analytic_hbm = v.cost.hbm["total"]
+        v.hbm_verified_bytes = verified
+        v.hbm_error_pct = (100.0 * (analytic_hbm - verified) / verified
+                           if verified else None)
+        tol = _cost.plan_hbm_tolerance_pct()
+        if cap is not None and verified > cap:
+            v.feasible = False
+            v.reason = (
+                f"verified HBM overflow: analyzer peak "
+                f"{verified / (1 << 20):.0f} MiB > capacity "
+                f"{cap / (1 << 20):.0f} MiB (analytic footprint said "
+                f"{analytic_hbm / (1 << 20):.0f} MiB)")
+            continue
+        if verified > analytic_hbm * (1.0 + tol / 100.0):
+            v.feasible = False
+            v.reason = (
+                f"HBM model disagreement: analyzer peak "
+                f"{verified / (1 << 20):.0f} MiB exceeds the analytic "
+                f"footprint {analytic_hbm / (1 << 20):.0f} MiB by more "
+                f"than the structural tolerance ({tol:.0f}%; "
+                f"APEX_TPU_PLAN_HBM_TOL_PCT overrides)")
+            continue
         built_map[lid] = built
         if constraints.validate == "measure":
             v.measured_s = _measure_built(

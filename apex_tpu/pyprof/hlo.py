@@ -35,15 +35,9 @@ from typing import Dict, List, Optional, Tuple
 __all__ = ["Instruction", "HloModule", "parse_hlo_text", "clean_op_name",
            "scope_of"]
 
-# dtype token -> bytes per element (HLO shape prefixes)
-_DTYPE_BYTES = {
-    "pred": 1, "s2": 1, "u2": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
-    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
-    "s32": 4, "u32": 4, "f32": 4,
-    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
-    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1,
-    "f8e5m2fnuz": 1, "token": 0, "opaque": 0,
-}
+# dtype token -> bytes per element (HLO shape prefixes) — the shared
+# jaxpr_walk table (ONE byte definition across comm/plan/lint/pyprof)
+from apex_tpu.utils.jaxpr_walk import HLO_DTYPE_BYTES as _DTYPE_BYTES
 
 _SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
 _METADATA_RE = re.compile(r'op_name="([^"]*)"')

@@ -41,7 +41,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
-from apex_tpu.utils.jaxpr_walk import WalkContext, walk_jaxpr_ctx
+from apex_tpu.utils.jaxpr_walk import (WalkContext, operand_bytes,
+                                       walk_jaxpr_ctx)
 
 # collective primitive -> wire multiplier builder (n = axis size)
 _WIRE = {
@@ -87,16 +88,7 @@ def _axis_names_of(params: dict) -> Tuple[str, ...]:
 
 
 def _operand_bytes(eqn) -> float:
-    total = 0.0
-    for v in eqn.invars:
-        aval = getattr(v, "aval", None)
-        shape = getattr(aval, "shape", None)
-        dtype = getattr(aval, "dtype", None)
-        if shape is None or dtype is None:
-            continue
-        total += float(np.prod(shape, dtype=np.float64) if shape else 1.0
-                       ) * np.dtype(dtype).itemsize
-    return total
+    return operand_bytes(eqn)    # jaxpr_walk: ONE byte definition
 
 
 def _visit_collective(eqn, ctx: "WalkContext",

@@ -431,6 +431,36 @@ class Trainer:
             donate_argnums=self.donate_argnums,
             threshold_bytes=threshold_bytes)
 
+    def check_mem(self, *, capacity_bytes: Optional[float] = None,
+                  baseline_bytes: Optional[float] = None):
+        """Run the lint mem verifier (APX301-APX307) over this trainer's
+        traced program — the build's own donation declaration, with arg 0
+        declared as the carried state (arms the undonated-state rule
+        exactly when the build opted out of donation). Trace-only;
+        returns the findings list (empty = verified) and, when telemetry
+        is enabled, records the analyzer's peak as the
+        ``trainer/peak_hbm_bytes`` static so dashboards can watch the
+        step's verified footprint next to its measured one."""
+        from apex_tpu.lint.mem_checks import analyze_entry_mem
+        if self.example_args is None:
+            raise ValueError(
+                "this Trainer was constructed directly without "
+                "example_args; trainer.build populates the analysis "
+                "seam automatically")
+        report = analyze_entry_mem(
+            self.traced_fn, self.example_args, name=self.name,
+            path="apex_tpu/trainer/builder.py",
+            donate_argnums=self.donate_argnums,
+            state_argnums=(0,),
+            capacity_bytes=capacity_bytes,
+            baseline_bytes=baseline_bytes)
+        from apex_tpu import telemetry
+        if telemetry.enabled():
+            telemetry.record_static(
+                "trainer/peak_hbm_bytes", float(report.peak_bytes),
+                meta=report.to_json(), dedup_key=("trainer",))
+        return report.findings
+
     def static_donation(self):
         """Statically re-derive this build's donation result from the
         traced program alone — the same declared/aliased/refused/dropped

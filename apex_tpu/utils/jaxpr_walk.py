@@ -30,6 +30,63 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# byte accounting — ONE definition
+# ---------------------------------------------------------------------------
+# Every byte count the toolkit derives from a program routes through
+# here: the SPMD verifier's replication threshold (spmd_checks), the
+# telemetry comm walker's payload sizes (telemetry/comm), the planner's
+# pytree sizing (plan/describe.tree_bytes), the mem verifier's buffer
+# sizes (lint/liveness), and — via HLO_DTYPE_BYTES — pyprof's HLO-text
+# byte estimates (pyprof/hlo). One table, one product, no drift.
+
+# dtype token -> bytes per element (HLO shape prefixes). pyprof's HLO
+# parser aliases this as its _DTYPE_BYTES.
+HLO_DTYPE_BYTES = {
+    "pred": 1, "s2": 1, "u2": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1,
+    "f8e5m2fnuz": 1, "token": 0, "opaque": 0,
+}
+
+
+def aval_elements(aval) -> int:
+    """Element count of one aval / array / ShapeDtypeStruct (1 for a
+    scalar, 0 when the shape is unreadable)."""
+    shape = getattr(aval, "shape", None)
+    if shape is None:
+        return 0
+    return int(np.prod(shape, dtype=np.int64)) if shape else 1
+
+
+def aval_bytes(aval) -> int:
+    """Buffer bytes of one aval / array / ShapeDtypeStruct: element
+    count x dtype itemsize. 0 when shape or dtype is unreadable (Literal
+    scalars, abstract tokens) — sizing must never be the thing that
+    crashes an analysis."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        return 0
+    return aval_elements(aval) * itemsize
+
+
+def operand_bytes(eqn) -> float:
+    """Total bytes of an equation's input operands (the comm walker's
+    collective payload measure)."""
+    total = 0.0
+    for v in eqn.invars:
+        total += float(aval_bytes(getattr(v, "aval", None)))
+    return total
+
 
 def subjaxprs(eqn) -> List[Tuple[Any, Optional[tuple]]]:
     """(inner_jaxpr, outer_operands_or_None) pairs for every sub-jaxpr in

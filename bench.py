@@ -633,6 +633,20 @@ def main():
             for r in _comm_stats(step_fn, state, (x, y))]
         est = _plan_est(desc_bench, bench_layout, wire=wire_items)
         measured_step_s = dt / n_steps
+        # HBM honesty twin of error_pct: the lint mem analyzer's
+        # verified peak of the EXECUTED step vs the analytic footprint
+        # the planner prunes with (positive = formula overestimates)
+        hbm_error_pct = None
+        try:
+            from apex_tpu.lint.mem_checks import verified_peak_bytes
+            hbm_verified = verified_peak_bytes(
+                step_fn, (state, (x, y)), donate_argnums=(0,))
+            if hbm_verified:
+                hbm_error_pct = round(
+                    100.0 * (est.hbm["total"] - hbm_verified)
+                    / hbm_verified, 1)
+        except Exception as e:
+            log(f"plan: hbm cross-check unavailable ({e})")
         pick_id = None
         try:
             # rank over the EXECUTED model's own description (real
@@ -656,6 +670,7 @@ def main():
                                 / measured_step_s, 1)
                           if measured_step_s > 0 else None),
             "wire_bytes": round(est.wire_bytes),
+            "hbm_error_pct": hbm_error_pct,
         }
         log(f"plan: executed {bench_layout.layout_id()} modeled "
             f"{est.step_s * 1e3:.3f} ms vs measured "

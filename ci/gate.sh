@@ -12,7 +12,7 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "== 1/18 package import =="
+echo "== 1/19 package import =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import apex_tpu
@@ -20,7 +20,7 @@ from apex_tpu import amp, optimizers, parallel, ops
 print('apex_tpu imports OK')
 "
 
-echo "== 2/18 native host runtime builds (g++ -O3 -shared) =="
+echo "== 2/19 native host runtime builds (g++ -O3 -shared) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 from apex_tpu import runtime
@@ -35,7 +35,7 @@ print('flatten/unflatten path OK')
 assert ok, 'host runtime failed to build — check g++ toolchain'
 "
 
-echo "== 3/18 graft entry compiles (single-device + 8-device dryrun) =="
+echo "== 3/19 graft entry compiles (single-device + 8-device dryrun) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import __graft_entry__ as ge
@@ -45,7 +45,7 @@ print('entry() compiles')
 ge.dryrun_multichip(8)
 "
 
-echo "== 4/18 package install (wheel build + clean --target install) =="
+echo "== 4/19 package install (wheel build + clean --target install) =="
 # The reference gates on Docker extension builds
 # (tests/docker_extension_builds/run.sh); the TPU analog: build the wheel
 # from pyproject.toml, install it into an empty --target dir, and import
@@ -88,15 +88,18 @@ jax.jit(step).lower(params, state).compile()
 print('installed-package train step compiles')
 ")
 
-echo "== 5/18 lint (apex_tpu.lint: trace safety / dtype policy / collectives / SPMD) =="
+echo "== 5/19 lint (apex_tpu.lint: trace safety / dtype policy / collectives / SPMD / mem) =="
 # static gate BEFORE the test tier: AST pass over the package + graft
 # entry, jaxpr pass over the registered entry points, SPMD verifier
-# (APX2xx) over the same entries. --strict: warnings fail too (every
-# intentional exception carries an inline suppression with its why —
-# see docs/lint.md). Use --format=github under CI bots.
-python -m apex_tpu.lint apex_tpu/ __graft_entry__.py --strict --spmd
+# (APX2xx) and mem verifier (APX3xx) over the same lowerings, with
+# the committed peak baseline arming the regression rule. --strict:
+# warnings fail too (every intentional exception carries an inline
+# suppression with its why — see docs/lint.md). Use --format=github
+# under CI bots.
+python -m apex_tpu.lint apex_tpu/ __graft_entry__.py --strict --spmd \
+    --mem --mem-baseline ci/mem_baseline.json
 
-echo "== 6/18 spmd verifier (builtin-entry sweep + committed deadlock fixture) =="
+echo "== 6/19 spmd verifier (builtin-entry sweep + committed deadlock fixture) =="
 # the whole-program SPMD gate, at the API layer: every registered entry
 # (ddp / zero / overlap / trainer-built / fused kernels / graft) must
 # verify clean, AND the analyzer must still catch the canonical
@@ -141,7 +144,43 @@ print('static donation == runtime DonationReport '
       f'({sd.aliased}/{sd.declared} aliased)')
 "
 
-echo "== 7/18 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
+echo "== 7/19 mem verifier (builtin-entry sweep + APX307 doctored-baseline regression gate) =="
+# the peak-HBM/live-range gate, at the API layer: every registered
+# entry must verify clean against the COMMITTED per-entry baseline
+# (ci/mem_baseline.json — re-baseline deliberately with
+# `lint --mem-baseline ci/mem_baseline.json --update-mem-baseline`),
+# AND the regression rule must still have teeth: against a doctored
+# baseline whose recorded peaks are scaled DOWN by 1.2x (so every
+# current peak reads as +20%, far past the 5% tolerance) the sweep
+# must FAIL with APX307 naming the regressed entries. Guards both
+# directions: a silent regression rule and a noisy analyzer each
+# fail this stage.
+python -c "
+import jax; jax.config.update('jax_platforms', 'cpu')
+import json
+from apex_tpu.lint.mem_checks import load_peak_baseline, run_entries_mem
+
+baseline = load_peak_baseline('ci/mem_baseline.json')
+findings = run_entries_mem(baseline=baseline)
+assert findings == [], \
+    'entries must verify clean vs the committed baseline: %r' % findings
+print('builtin-entry mem sweep clean vs ci/mem_baseline.json '
+      '(%d entries)' % len(baseline))
+
+doctored = {name: int(peak / 1.2) for name, peak in baseline.items()}
+regressed = run_entries_mem(baseline=doctored)
+assert regressed, 'doctored +20%% baseline produced NO findings — ' \
+    'the APX307 regression rule is silent'
+assert all(f.rule_id == 'APX307' for f in regressed), regressed
+named = {f.message.split(']')[0].split('entry ')[1] for f in regressed}
+missing = set(baseline) - named
+assert not missing, \
+    'doctored baseline did not name regressions for %r' % sorted(missing)
+print('APX307 gate OK: doctored +20%% baseline fails naming all '
+      '%d entries' % len(named))
+"
+
+echo "== 8/19 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
 # A 3-step instrumented GPT train step on the CPU mesh must produce a
 # parseable JSONL carrying step timing, amp loss-scale/overflow, comm
 # bytes and MFU, and the summarize CLI must render it (exit 0) — the
@@ -214,7 +253,7 @@ fi
 echo "health CLI gate OK (healthy=0, injected-NaN=nonzero)"
 rm -rf "$(dirname "$HLT_FILE")"
 
-echo "== 8/18 tune smoke (sweep dry-run + auto-policy tuned train) =="
+echo "== 9/19 tune smoke (sweep dry-run + auto-policy tuned train) =="
 # The autotuner must be drivable offline (sweep plan renders, exit 0) and
 # inline: a 3-step train whose kernels resolve their configs through
 # apex_tpu.tune under APEX_TPU_TUNE=auto. On this CPU backend measurement
@@ -291,7 +330,7 @@ print(f'tune smoke OK: {len(tuned)} tune/* series, '
 " "$TUNE_DIR/tune_run.jsonl" "$TUNE_DIR/cache"
 rm -rf "$TUNE_DIR"
 
-echo "== 9/18 resilience smoke (snapshot -> injected kill -> auto-resume) =="
+echo "== 10/19 resilience smoke (snapshot -> injected kill -> auto-resume) =="
 # Kill-and-resume end to end: a 6-step train snapshotting every 2 steps is
 # SIGKILLed by the fault injector at the top of step 4 (exit 137 — an
 # abrupt death, no final snapshot), then the SAME command with --resume
@@ -348,7 +387,7 @@ python -m apex_tpu.telemetry summarize "$RES_DIR/resume.jsonl" \
     || { echo "summarize did not report the resume point" >&2; exit 1; }
 rm -rf "$RES_DIR"
 
-echo "== 10/18 overlap smoke (staged backward + bf16 wire vs fp32 baseline) =="
+echo "== 11/19 overlap smoke (staged backward + bf16 wire vs fp32 baseline) =="
 # The overlap engine end to end on the 8-device CPU mesh: a 3-step fp32
 # baseline train and the same train under --overlap --reduce-dtype bf16
 # must (a) land within 1e-2 of each other's final loss (the compression
@@ -404,7 +443,7 @@ python -m apex_tpu.telemetry summarize "$OVL_DIR/bf16.jsonl" \
     || { echo "summarize did not render overlap efficiency" >&2; exit 1; }
 rm -rf "$OVL_DIR"
 
-echo "== 11/18 profile smoke (capture -> attribution report -> compare gate) =="
+echo "== 12/19 profile smoke (capture -> attribution report -> compare gate) =="
 # The attribution profiler end to end on the CPU backend: a 3-step train
 # with --profile must produce a capture logdir whose offline report
 # parses with nonzero compute time and carries the named
@@ -465,7 +504,7 @@ fi
 echo "compare gate OK (identical=0, doctored-slower=4)"
 rm -rf "$PROF_DIR"
 
-echo "== 12/18 trace smoke (host spans -> unified timeline -> merge/stragglers) =="
+echo "== 13/19 trace smoke (host spans -> unified timeline -> merge/stragglers) =="
 # The host-tracing layer end to end: a 3-step --trace train must emit
 # parseable span/* begin/end pairs, the unified host+device timeline
 # must export as valid Chrome-trace JSON with BOTH lanes populated,
@@ -538,7 +577,7 @@ grep -q "worst: p" "$TRC_DIR/merged.txt" \
 echo "trace smoke OK (spans + timeline + reconciliation + 2-process merge)"
 rm -rf "$TRC_DIR"
 
-echo "== 13/18 trainer smoke (compiled-step builder: pipelined dispatch + donation audit) =="
+echo "== 14/19 trainer smoke (compiled-step builder: pipelined dispatch + donation audit) =="
 # The compiled trainer end to end: a 3-step train_lm built through
 # apex_tpu.trainer with telemetry+trace on must (a) emit balanced
 # span/* begin/end pairs (the in-flight window's trainer/retire spans
@@ -583,7 +622,7 @@ grep -q "donation audit: .* 0 refused" "$TRN_DIR/out.txt" \
     || { echo "train_lm did not print the donation audit" >&2; exit 1; }
 rm -rf "$TRN_DIR"
 
-echo "== 14/18 fused-kernel regression (Pallas xentropy vs unfused + epilogue/mt scopes) =="
+echo "== 15/19 fused-kernel regression (Pallas xentropy vs unfused + epilogue/mt scopes) =="
 # The fused-kernel tier end to end (docs/kernels.md): the SAME 3-step GPT
 # train profiled unfused and fused (Pallas xentropy in the loss scope)
 # must (a) surface the apex_xentropy scope in the fused breakdown,
@@ -684,7 +723,7 @@ print('conv epilogue + mt flat: parity + capture scopes OK')
 echo "fused-kernel gate OK (scopes + parity + compare exit 0)"
 rm -rf "$KRN_DIR"
 
-echo "== 15/18 elastic smoke (2-process node_loss -> re-shard resume at world 1) =="
+echo "== 16/19 elastic smoke (2-process node_loss -> re-shard resume at world 1) =="
 # Elastic membership end to end (docs/resilience.md "Elastic
 # membership"): a 2-member ZeRO fleet under the multiproc --elastic
 # supervisor loses rank 1 to an injected node_loss SIGKILL at step 3;
@@ -746,7 +785,7 @@ python -m apex_tpu.resilience inspect "$ELA_DIR/snap-r0" --check 1 \
          exit 1; }
 rm -rf "$ELA_DIR"
 
-echo "== 16/18 rebalance smoke (slow_node straggler -> weighted re-shard -> exit-75 eviction -> world 1) =="
+echo "== 17/19 rebalance smoke (slow_node straggler -> weighted re-shard -> exit-75 eviction -> world 1) =="
 # Heterogeneity-aware rebalancing end to end (docs/resilience.md
 # "Rebalancing"): rank 1 is an injected straggler (slow_node: +250 ms
 # on every step >= 2 while the base step is ~60 ms). The degradation
@@ -826,7 +865,7 @@ grep -q "straggler detected" "$RB_DIR/summary.out" \
          cat "$RB_DIR/summary.out" >&2; exit 1; }
 rm -rf "$RB_DIR"
 
-echo "== 17/18 plan smoke (auto ranked table -> lint-clean pick -> 3-step train) =="
+echo "== 18/19 plan smoke (auto ranked table -> lint-clean pick -> 3-step train) =="
 # The parallelism planner end to end (docs/plan.md): `plan auto` on the
 # GPT example shape over the 8-device CPU mesh must produce a parseable
 # ranked candidate table, the top pick must pass lint.spmd clean (the
@@ -916,7 +955,7 @@ else:
 PY
 rm -rf "$PLAN_DIR"
 
-echo "== 18/18 pytest =="
+echo "== 19/19 pytest =="
 if [[ "${1:-}" == "--full" ]]; then
     # full suite + the complete L1 cross-product matrix (reference
     # tests/L1/cross_product{,_distributed}/run.sh); the convergence
@@ -935,7 +974,7 @@ else
         tests/test_overlap.py \
         tests/test_trainer.py tests/test_kernels.py \
         tests/test_pyprof.py tests/test_trace.py \
-        tests/test_plan.py -q -x
+        tests/test_plan.py tests/test_lint_mem.py -q -x
 fi
 
 echo "CI GATE PASSED"

@@ -606,3 +606,37 @@ def test_build_defers_param_materialization(monkeypatch):
     rbuilt = rad.build(Layout(dp=2), devices=jax.devices()[:2])
     for leaf in jax.tree_util.tree_leaves(rbuilt.state_avals):
         assert isinstance(leaf, jax.ShapeDtypeStruct), type(leaf)
+
+
+# ---------------------------------------------------------------------------
+# HBM honesty: microbatch-aware footprint + the lint.mem cross-check
+# ---------------------------------------------------------------------------
+
+def test_hbm_footprint_microbatch_moves_both_terms(desc):
+    """Gradient accumulation carries a full grad-sized accumulator
+    through the scan (grads x2) while only one chunk's activations are
+    live at a time (act / microbatch) — both movements pinned, and the
+    static analyzer confirms the direction on real builds (the
+    validate-tier cross-check below)."""
+    mb1 = plan.hbm_footprint(desc, Layout(dp=4))
+    mb2 = plan.hbm_footprint(desc, Layout(dp=4, microbatch=2))
+    assert mb2["grads"] == 2.0 * mb1["grads"]
+    assert mb2["act"] == mb1["act"] / 2.0
+    assert mb2["params"] == mb1["params"] and mb2["opt"] == mb1["opt"]
+
+
+def test_validated_rows_carry_hbm_cross_check(auto_plan):
+    """Every traced candidate's row reports the analyzer's verified
+    peak next to the analytic estimate's drift from it — the HBM twin
+    of the wire-drift column."""
+    p, _ = auto_plan
+    checked = [r for r in p.table if "hbm_verified_mib" in r]
+    assert checked, "no validated row carries the mem cross-check"
+    for r in checked:
+        assert r["feasible"], r               # survivors, not demotions
+        assert r["hbm_verified_mib"] > 0
+        assert isinstance(r["hbm_error_pct"], float)
+        # the formula's structural gap stays inside the demotion band
+        assert r["hbm_error_pct"] > -plan.plan_hbm_tolerance_pct(), r
+    # the pick itself was cross-checked
+    assert "hbm_verified_mib" in p.table[0] or not p.table[0]["feasible"]
