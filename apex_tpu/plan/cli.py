@@ -63,6 +63,8 @@ def _constraints(args):
         kw["top_k"] = args.top_k     # 0 reaches Constraints' loud raise
     if getattr(args, "validate", None):
         kw["validate"] = args.validate
+    if getattr(args, "objective", None):
+        kw["objective"] = args.objective
     return Constraints(**kw)
 
 
@@ -177,6 +179,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "TPU)")
     pa.add_argument("--validate", default="trace",
                     choices=["none", "trace", "measure"])
+    pa.add_argument("--objective", default="throughput",
+                    choices=["throughput", "p99_decode"],
+                    help="ranking currency: training step time, or "
+                         "modeled per-token decode latency (the serving "
+                         "objective — memory-bound, so the axis algebra "
+                         "flips; see plan.cost.decode_step_s)")
     pa.add_argument("--json", action="store_true")
     pa.add_argument("--no-cache", action="store_true",
                     help="do not write tune cache entries")

@@ -42,8 +42,8 @@ from apex_tpu.plan.layout import Layout
 
 __all__ = ["CostBreakdown", "HeteroCost", "WireItem", "estimate",
            "analytic_wire", "traced_wire", "hbm_footprint",
-           "heterogeneous_step_s", "member_speeds", "optimal_weights",
-           "OVERLAP_EFFICIENCY", "ici_bytes_per_s",
+           "decode_step_s", "heterogeneous_step_s", "member_speeds",
+           "optimal_weights", "OVERLAP_EFFICIENCY", "ici_bytes_per_s",
            "collective_latency_s"]
 
 # Fraction of a staged dp-collective's time that hides behind backward
@@ -435,6 +435,69 @@ def estimate(desc: ModelDesc, layout: Layout, *,
                           if hbm_capacity is not None
                           else peaks.get("hbm_bytes")),
         wire_source=source, wire_drift_pct=drift, notes=notes)
+
+
+# ---------------------------------------------------------------------------
+# decode latency (the serving objective, plan.auto(objective="p99_decode"))
+# ---------------------------------------------------------------------------
+
+def decode_step_s(desc: ModelDesc, layout: Layout, *,
+                  peaks: Optional[Dict[str, float]] = None) -> float:
+    """Modeled per-token decode step latency for one layout — the
+    ranking currency of ``objective="p99_decode"``.
+
+    Decode flips the training roofline: one token's forward is ~0
+    FLOPs against the bytes it must move, so the step is MEMORY-BOUND —
+    every resident weight is read once per token, plus the live KV
+    history. The parallel-axis algebra is therefore different from
+    :func:`estimate`'s throughput model, which is the whole reason this
+    is a separate objective and not a re-weighting:
+
+      * **tp** divides the critical-path weight AND KV reads (each rank
+        reads only its head/mlp shard) but buys that with 2 per-layer
+        psums on the token's critical path — pure latency at one
+        token's payload, priced via :func:`collective_latency_s`.
+      * **pp** shards weights per DEVICE but not per TOKEN: the token
+        still traverses every stage serially, so pipeline parallelism
+        does NOT reduce the bytes on its critical path — it only adds
+        stage-boundary hops. (Great for training throughput, useless
+        for p99 decode — the objective flip the test pins.)
+      * **dp** replicates weights (no read reduction); it divides the
+        batch, shrinking only the KV term.
+      * **seq** has nothing to shard at s=1 — no benefit, and its
+        layouts keep their per-layer collectives on the path.
+    """
+    if peaks is None:
+        from apex_tpu.pyprof.roofline import device_peaks
+        peaks = device_peaks()
+    d = desc.dims
+    itemsize = (desc.param_bytes / desc.param_count
+                if desc.param_count else 4.0)
+    # critical-path weight bytes: tp shards the reads; pp does not
+    # (serial stage traversal reads every stage's shard in sequence)
+    weight_b = desc.param_bytes / layout.tp
+    local_batch = max(1.0, d.get("batch", 1) / layout.dp
+                      / max(1, layout.microbatch))
+    kv_b = (2.0 * d.get("layers", 1) * local_batch * d.get("seq", 1)
+            * d.get("embed", 0) * itemsize / layout.tp)
+    mem_s = (weight_b + kv_b) / peaks["bytes_per_s"]
+    lat = collective_latency_s()
+    coll_s = 0.0
+    if layout.tp > 1:
+        # Megatron forward: 2 psums per block (attention out, fc2) on
+        # the token's critical path; payloads are one token's
+        # activations — latency-dominated, plus their (tiny) wire time
+        n_ps = 2 * d.get("layers", 1)
+        act_b = local_batch * d.get("embed", 0) * 4.0
+        coll_s += n_ps * (lat + act_b * _ring("psum", layout.tp)
+                          / ici_bytes_per_s())
+    if layout.seq > 1:
+        # per-layer seq collectives stay on the path even with nothing
+        # to shard (the builders' all-to-all/ppermute structure)
+        coll_s += 2 * d.get("layers", 1) * lat
+    if layout.pp > 1:
+        coll_s += 2.0 * (layout.pp - 1) * lat
+    return mem_s + coll_s
 
 
 # ---------------------------------------------------------------------------

@@ -64,6 +64,13 @@ class Constraints:
         hermetic-CI doctrine stays the default — this is the explicit
         opt-in ``benchmarks/plan_vs_hand.py`` uses, where wall clock IS
         the ground truth being compared against.
+    objective:
+        The ranking currency. ``"throughput"`` (default) ranks by the
+        modeled TRAINING step time; ``"p99_decode"`` ranks by the
+        modeled per-token decode latency
+        (:func:`apex_tpu.plan.cost.decode_step_s` — memory-bound, so
+        the parallel-axis algebra flips: pp stops helping, tp starts).
+        Every verdict row carries both numbers either way.
     """
 
     hbm_bytes: Optional[float] = None
@@ -78,6 +85,7 @@ class Constraints:
     validate: str = "trace"
     measure_force: bool = False
     target_buckets: int = 8
+    objective: str = "throughput"
 
     def __post_init__(self):
         if self.validate not in ("none", "trace", "measure"):
@@ -86,6 +94,10 @@ class Constraints:
                 f"got {self.validate!r}")
         if self.top_k < 1:
             raise ValueError("Constraints.top_k must be >= 1")
+        if self.objective not in ("throughput", "p99_decode"):
+            raise ValueError(
+                f"Constraints.objective must be throughput|p99_decode, "
+                f"got {self.objective!r}")
 
 
 @dataclasses.dataclass
@@ -97,6 +109,10 @@ class Verdict:
     reason: str = ""                     # why infeasible ("" when ok)
     cost: Optional[_cost.CostBreakdown] = None
     measured_s: Optional[float] = None   # validate="measure" only
+    # modeled per-token decode latency (cost.decode_step_s) — the
+    # p99_decode objective's ranking currency, carried on every
+    # feasible row so both objectives' tables are comparable
+    decode_s: Optional[float] = None
     lint_findings: List[Any] = dataclasses.field(default_factory=list)
     # lint.mem analyzer cross-check (traced candidates only): the
     # verified per-device peak and the analytic formula's drift from it
@@ -118,6 +134,8 @@ class Verdict:
                 "wire_mib": round(self.cost.wire_bytes / (1 << 20), 3),
                 "hbm_mib": round(self.cost.hbm["total"] / (1 << 20), 1),
                 "wire_source": self.cost.wire_source})
+        if self.decode_s is not None:
+            out["decode_ms"] = round(self.decode_s * 1e3, 4)
         if self.hbm_verified_bytes is not None:
             out["hbm_verified_mib"] = round(
                 self.hbm_verified_bytes / (1 << 20), 1)
@@ -273,15 +291,26 @@ def prune(candidates: Sequence[Layout], desc: ModelDesc, *,
                 f"{est.hbm['total'] / (1 << 20):.0f} MiB > "
                 f"{cap / (1 << 20):.0f} MiB", est))
             continue
-        out.append(Verdict(layout, True, "", est))
+        out.append(Verdict(
+            layout, True, "", est,
+            decode_s=_cost.decode_step_s(desc, layout, peaks=peaks)))
     return out
 
 
-def rank(verdicts: Sequence[Verdict]) -> List[Verdict]:
-    """Feasible candidates by modeled step time (infeasible ones keep
-    their enumeration order at the tail — the table shows everything)."""
+def _objective_s(v: Verdict, objective: str) -> float:
+    if objective == "p99_decode":
+        return v.decode_s if v.decode_s is not None else float("inf")
+    return v.step_s
+
+
+def rank(verdicts: Sequence[Verdict],
+         objective: str = "throughput") -> List[Verdict]:
+    """Feasible candidates by the objective's modeled time — training
+    step seconds for ``"throughput"``, per-token decode latency for
+    ``"p99_decode"`` (infeasible ones keep their enumeration order at
+    the tail — the table shows everything)."""
     feas = sorted((v for v in verdicts if v.feasible),
-                  key=lambda v: v.step_s)
+                  key=lambda v: _objective_s(v, objective))
     return feas + [v for v in verdicts if not v.feasible]
 
 
@@ -449,7 +478,8 @@ def auto(adapter, *, n_devices: Optional[int] = None,
     desc = adapter.describe(compile_reference=compile_reference)
     cands = enumerate_candidates(n, desc, constraints)
     verdicts = rank(prune(cands, desc, adapter=adapter,
-                          constraints=constraints, peaks=peaks))
+                          constraints=constraints, peaks=peaks),
+                    constraints.objective)
     built_map = validate_top(verdicts, adapter, desc,
                              constraints=constraints, peaks=peaks,
                              devices=devices)
@@ -460,7 +490,13 @@ def auto(adapter, *, n_devices: Optional[int] = None,
     # psum the closed form rounds away — comparing across the two hands
     # sub-percent artifacts the decision). The table's rank 1 IS the
     # pick; wire_source / measured_ms name each row's fidelity tier.
+    # Under objective="p99_decode" the currency is the modeled decode
+    # latency on EVERY tier — tracing/measuring verify the candidate's
+    # program and price its training step, but the decode model is the
+    # only decode clock there is (nothing measures a serving step here).
     def _fidelity_key(v):
+        if constraints.objective == "p99_decode":
+            return (0, _objective_s(v, constraints.objective))
         if v.measured_s is not None:
             return (0, v.measured_s)
         if built_map and v.layout.layout_id() in built_map:

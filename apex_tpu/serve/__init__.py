@@ -1,0 +1,55 @@
+"""``apex_tpu.serve`` — continuous-batching inference on the trained
+stack (ROADMAP north star: the serving path for "heavy traffic from
+millions of users").
+
+The training side of this repo ends at a snapshot; this package turns
+one into a running service:
+
+  * :mod:`~apex_tpu.serve.kvcache` — paged KV cache: fixed-size pages,
+    a host-side free-list allocator, per-request page lists in block
+    tables. Static shapes everywhere (recompile-free).
+  * :mod:`~apex_tpu.serve.decode` — paged decode attention: the jnp
+    reference chain (bit-identical to the dense-cache decode path) and
+    an opt-in Pallas kernel with block-table-indexed page DMA + dead-
+    page elision, behind the same backend-select pattern as
+    ``contrib.xentropy``.
+  * :mod:`~apex_tpu.serve.model` — the functional decode forward over
+    ``TransformerLM`` params (prefill reuses the model's own flash
+    forward).
+  * :mod:`~apex_tpu.serve.loader` — ``load_model(dir)`` from
+    SnapshotManager manifests (layout fingerprint validated BEFORE the
+    payload materializes), opt-in bf16/int8 quantization
+    (:mod:`~apex_tpu.serve.quant`) and 2:4 pruning
+    (``sparsity.prune_for_serving``).
+  * :mod:`~apex_tpu.serve.engine` — continuous batching: admit/retire
+    between decode steps, fixed-shape slot packing, N decode dispatches
+    in flight via the trainer's ``InflightWindow``.
+  * :mod:`~apex_tpu.serve.admission` — bounded queue + SLO-aware
+    shedding; goodput counted against every submitted request.
+  * :mod:`~apex_tpu.serve.bench` / ``python -m apex_tpu.serve bench`` —
+    synthetic closed/open-loop load driver emitting ``serve/*``
+    telemetry (docs/telemetry.md).
+
+Architecture notes: docs/serve.md.
+"""
+
+from apex_tpu.serve import bench
+from apex_tpu.serve.admission import AdmissionController, Rejected
+from apex_tpu.serve.bench import run_bench
+from apex_tpu.serve.decode import (backend as decode_backend,
+                                   paged_decode_attention,
+                                   set_backend as set_decode_backend)
+from apex_tpu.serve.engine import Engine, Request
+from apex_tpu.serve.kvcache import (KVPool, PageAllocator, PoolFullError,
+                                    create_pool)
+from apex_tpu.serve.loader import LoadedModel, load_model
+from apex_tpu.serve.model import ModelSpec
+from apex_tpu.serve.quant import QuantReport, quantize_params
+
+__all__ = [
+    "AdmissionController", "Engine", "KVPool", "LoadedModel",
+    "ModelSpec", "PageAllocator", "PoolFullError", "QuantReport",
+    "Rejected", "Request", "bench", "create_pool", "decode_backend",
+    "load_model", "paged_decode_attention", "quantize_params",
+    "run_bench", "set_decode_backend",
+]

@@ -1,0 +1,117 @@
+"""Admission control — the bounded front door of the serving engine.
+
+Overload policy (docs/serve.md "shedding"): a bounded FIFO queue sheds
+at SUBMIT when full (``queue_full``), and sheds QUEUED requests whose
+SLO deadline has already passed at pop time (``deadline`` — running a
+request that cannot possibly meet its deadline burns decode capacity
+that on-time requests need; rejecting it at admission is the honest
+form of the same failure). Requests carrying a deadline are also
+screened at submit against the running TTFT estimate: if the queue wait
+already makes the deadline unreachable, shedding NOW beats shedding
+after the tokens are half-generated.
+
+Goodput is counted honestly: every submitted request lands in exactly
+one of completed-in-deadline / completed-late / shed, and the
+denominator is ALL submissions — a shed request is a failure of the
+service, not a statistics exemption.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Deque, List, NamedTuple, Optional
+
+from apex_tpu.serve import metrics
+
+QUEUE_FULL = "queue_full"
+DEADLINE = "deadline"
+TOO_LARGE = "too_large"
+
+
+class Rejected(NamedTuple):
+    """One shed decision, kept for the bench/goodput report."""
+
+    rid: int
+    reason: str
+    t: float
+
+
+class AdmissionController:
+    """Bounded queue + SLO-aware shedding.
+
+    ``max_queue``: requests allowed to WAIT (running slots are the
+    engine's concern). ``clock``: injectable monotonic clock for the
+    deterministic shedding tests.
+    """
+
+    def __init__(self, *, max_queue: int = 64, clock=time.monotonic):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_queue = int(max_queue)
+        self._clock = clock
+        self._queue: Deque = collections.deque()
+        self.submitted = 0
+        self.rejected: List[Rejected] = []
+        # EWMA of observed TTFT — the submit-time reachability screen.
+        # Starts at None (no screening until the first observation; an
+        # optimistic cold start only delays shedding by one request).
+        self._ttft_ewma: Optional[float] = None
+
+    @property
+    def depth(self) -> int:
+        return len(self._queue)
+
+    def submit(self, req, now: Optional[float] = None) -> bool:
+        """True = queued; False = shed (the request's ``state`` /
+        ``reject_reason`` are set either way)."""
+        now = self._clock() if now is None else now
+        self.submitted += 1
+        if req.submitted_s is None:
+            req.submitted_s = now
+        if len(self._queue) >= self.max_queue:
+            self._shed(req, QUEUE_FULL, now)
+            return False
+        if req.deadline_s is not None:
+            waited = now - req.submitted_s
+            est = self._ttft_ewma or 0.0
+            if waited + est > req.deadline_s:
+                self._shed(req, DEADLINE, now)
+                return False
+        req.state = "queued"
+        self._queue.append(req)
+        return True
+
+    def pop_ready(self, now: Optional[float] = None):
+        """Next runnable request, shedding queued requests whose
+        deadline already passed. None when the queue is empty."""
+        now = self._clock() if now is None else now
+        while self._queue:
+            req = self._queue.popleft()
+            if (req.deadline_s is not None
+                    and now - req.submitted_s > req.deadline_s):
+                self._shed(req, DEADLINE, now, expired=True)
+                continue
+            return req
+        return None
+
+    def push_back(self, req) -> None:
+        """Return a popped request to the queue head (the engine could
+        not place it this step — e.g. the page pool is momentarily
+        full). Not a shed: the request keeps its submission time."""
+        self._queue.appendleft(req)
+
+    def observe_ttft(self, ttft_s: float) -> None:
+        if self._ttft_ewma is None:
+            self._ttft_ewma = float(ttft_s)
+        else:
+            self._ttft_ewma = 0.8 * self._ttft_ewma + 0.2 * float(ttft_s)
+
+    def _shed(self, req, reason: str, now: float,
+              expired: bool = False) -> None:
+        req.state = "rejected"
+        req.reject_reason = reason
+        self.rejected.append(Rejected(req.rid, reason, now))
+        metrics.count(metrics.REJECTED, meta={"reason": reason})
+        if expired:
+            metrics.count(metrics.EXPIRED)

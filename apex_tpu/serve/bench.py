@@ -1,0 +1,170 @@
+"""Synthetic load driver for the serving engine — the measurement side
+of ``python -m apex_tpu.serve bench`` and benchmarks/serve_bench.py.
+
+Two phases, one report:
+
+  * **steady** (closed loop): ``requests`` synthetic prompts submitted
+    up front, the engine drains them at its own pace. Measures the
+    headline tokens/s plus p50/p99 TTFT and inter-token latency (from
+    per-token host observation times — the same numbers the
+    ``serve/ttft`` / ``serve/intertoken`` trace spans carry).
+  * **overload** (2x offered load): twice the steady request count is
+    thrown at an admission queue sized for HALF of it, with per-request
+    SLO deadlines. The point is the shedding contract: rejected > 0
+    (queue-full + deadline sheds), while every ADMITTED request still
+    completes — goodput degrades by refusing work, never by corrupting
+    accepted work. Goodput is completed-within-deadline over ALL
+    submissions (shed requests count against it; see
+    serve/admission.py).
+
+The report dict is the SERVE_r*.json row schema — keys are stable;
+unmeasured values are null, never absent.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from apex_tpu.serve import metrics
+from apex_tpu.serve.admission import AdmissionController
+from apex_tpu.serve.engine import Engine, Request
+from apex_tpu.serve.loader import LoadedModel
+
+
+def _pct(samples: List[float], q: float) -> Optional[float]:
+    if not samples:
+        return None
+    return float(np.percentile(np.asarray(samples, np.float64), q))
+
+
+def _latency_stats(reqs: List[Request]) -> dict:
+    ttft = [r.ttft_s for r in reqs if r.ttft_s is not None]
+    inter: List[float] = []
+    for r in reqs:
+        ts = r.token_times
+        inter.extend(b - a for a, b in zip(ts, ts[1:]))
+    return {
+        "ttft_ms": {"p50": _ms(_pct(ttft, 50)), "p99": _ms(_pct(ttft, 99))},
+        "intertoken_ms": {"p50": _ms(_pct(inter, 50)),
+                          "p99": _ms(_pct(inter, 99))},
+    }
+
+
+def _ms(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(v * 1e3, 3)
+
+
+def _goodput(reqs: List[Request]) -> float:
+    """Completed-in-deadline over ALL submissions. Requests without a
+    deadline count as good when completed — and shed either way."""
+    if not reqs:
+        return 0.0
+    good = 0
+    for r in reqs:
+        if r.state != "done":
+            continue
+        ind = r.in_deadline()
+        good += 1 if (ind is None or ind) else 0
+    return good / len(reqs)
+
+
+def _prompts(n: int, vocab: int, prompt_len: int, seed: int
+             ) -> List[List[int]]:
+    rng = np.random.default_rng(seed)
+    return [[int(t) for t in rng.integers(0, vocab, prompt_len)]
+            for _ in range(n)]
+
+
+def run_bench(loaded: LoadedModel, *, requests: int = 50,
+              prompt_len: int = 8, max_new: int = 8, max_batch: int = 4,
+              page: int = 16, max_context: Optional[int] = None,
+              max_prompt: Optional[int] = None, in_flight: int = 2,
+              overload: bool = True, deadline_s: float = 30.0,
+              seed: int = 0) -> dict:
+    """Run the two-phase synthetic load against ``loaded`` and return
+    the SERVE report row (see the module docstring)."""
+    if requests < 1:
+        raise ValueError(f"requests must be >= 1, got {requests}")
+    max_prompt = prompt_len if max_prompt is None else max_prompt
+    if max_context is None:
+        max_context = -(-(max_prompt + max_new) // page) * page
+    vocab = loaded.spec.vocab
+    prompts = _prompts(requests, vocab, prompt_len, seed)
+
+    # -- steady phase ------------------------------------------------------
+    eng = Engine(loaded, max_batch=max_batch, page=page,
+                 max_context=max_context, max_prompt=max_prompt,
+                 in_flight=in_flight,
+                 admission=AdmissionController(max_queue=requests))
+    reqs = [eng.request(p, max_new) for p in prompts]
+    t0 = time.perf_counter()
+    eng.run(reqs)
+    elapsed = time.perf_counter() - t0
+    tokens = eng.tokens_emitted
+    tps = tokens / elapsed if elapsed > 0 else 0.0
+    metrics.gauge(metrics.TOKENS_PER_S, tps)
+    completed = sum(r.state == "done" for r in reqs)
+    steady = {
+        "requests": requests,
+        "completed": completed,
+        "tokens": tokens,
+        "tokens_per_s": round(tps, 2),
+        "elapsed_s": round(elapsed, 4),
+        **_latency_stats(reqs),
+    }
+
+    # -- overload phase (2x offered load, queue sized for half) -----------
+    over = None
+    if overload:
+        n_over = 2 * requests
+        over_prompts = _prompts(n_over, vocab, prompt_len, seed + 1)
+        adm = AdmissionController(max_queue=max(1, requests // 2))
+        eng2 = Engine(loaded, max_batch=max_batch, page=page,
+                      max_context=max_context, max_prompt=max_prompt,
+                      in_flight=in_flight, admission=adm)
+        oreqs = [eng2.request(p, max_new, deadline_s=deadline_s)
+                 for p in over_prompts]
+        t0 = time.perf_counter()
+        eng2.run(oreqs)
+        oelapsed = time.perf_counter() - t0
+        admitted = sum(r.state == "done" for r in oreqs) \
+            + sum(r.state == "running" for r in oreqs)
+        rejected = sum(r.state == "rejected" for r in oreqs)
+        expired = sum(1 for rj in adm.rejected
+                      if rj.reason == "deadline")
+        over = {
+            "requests": n_over,
+            "admitted": n_over - rejected,
+            "completed": sum(r.state == "done" for r in oreqs),
+            "rejected": rejected,
+            "expired": expired,
+            "goodput": round(_goodput(oreqs), 4),
+            "tokens_per_s": round(
+                eng2.tokens_emitted / oelapsed, 2) if oelapsed else 0.0,
+            "elapsed_s": round(oelapsed, 4),
+        }
+        # the shedding contract: admitted requests COMPLETE — a request
+        # that was neither shed nor finished is an engine bug the bench
+        # must surface, not average away
+        over["stranded"] = n_over - over["completed"] - rejected
+        del admitted
+
+    return {
+        "metric": "serve_tokens_per_s",
+        "value": steady["tokens_per_s"],
+        "unit": "tokens/s",
+        "model": {"step": loaded.step, "spec": loaded.spec.to_dict(),
+                  "quant": (loaded.quant.row() if loaded.quant else None),
+                  "pruned": loaded.pruned,
+                  "directory": loaded.directory},
+        "config": {"max_batch": max_batch, "page": page,
+                   "max_context": max_context, "max_prompt": max_prompt,
+                   "in_flight": in_flight, "prompt_len": prompt_len,
+                   "max_new": max_new, "deadline_s": deadline_s,
+                   "seed": seed},
+        "steady": steady,
+        "overload": over,
+    }

@@ -1,0 +1,131 @@
+"""``python -m apex_tpu.serve`` — the serving command line.
+
+Subcommands:
+
+  * ``bench`` — load the newest snapshot from ``--snapshot-dir`` and
+    run the two-phase synthetic load of :mod:`apex_tpu.serve.bench`,
+    printing the SERVE report row as ONE JSON line on stdout (progress
+    on stderr).
+
+Exit codes follow the repo CLI contract (telemetry/plan CLIs): 0 on a
+healthy run, 2 for usage errors (argparse), nonzero for bad input — a
+missing/empty snapshot directory or an unloadable checkpoint is exit 1
+with the reason on stderr, not a traceback.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.serve",
+        description="apex_tpu serving: paged KV-cache continuous-"
+                    "batching inference (docs/serve.md)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    b = sub.add_parser(
+        "bench",
+        help="synthetic closed-loop + 2x-overload load run against the "
+             "newest snapshot")
+    b.add_argument("--snapshot-dir", required=True, metavar="DIR",
+                   help="SnapshotManager directory (train with "
+                        "examples/gpt/train_lm.py --snapshot-dir)")
+    b.add_argument("--requests", type=int, default=50,
+                   help="steady-phase request count (overload phase "
+                        "offers 2x this)")
+    b.add_argument("--prompt-len", type=int, default=8)
+    b.add_argument("--max-new", type=int, default=8,
+                   help="tokens generated per request")
+    b.add_argument("--max-batch", type=int, default=4,
+                   help="decode slots (static batch shape)")
+    b.add_argument("--page", type=int, default=16,
+                   help="tokens per KV page")
+    b.add_argument("--in-flight", type=int, default=2,
+                   help="decode dispatches in flight (InflightWindow "
+                        "depth; token streams are depth-inert)")
+    b.add_argument("--deadline-s", type=float, default=30.0,
+                   help="per-request SLO deadline in the overload phase")
+    b.add_argument("--no-overload", action="store_true",
+                   help="skip the 2x-overload shedding phase")
+    b.add_argument("--quantize", choices=["bf16", "int8"], default=None,
+                   help="opt-in weight quantization at load "
+                        "(serve.quant)")
+    b.add_argument("--prune", action="store_true",
+                   help="apply one-shot 2:4 pruning at load "
+                        "(sparsity.prune_for_serving)")
+    b.add_argument("--seed", type=int, default=0)
+    b.add_argument("--telemetry", default=None, metavar="PATH",
+                   help="also write serve/* telemetry events to a "
+                        "JSONL (render: python -m apex_tpu.telemetry "
+                        "summarize PATH)")
+    return p
+
+
+def _run_bench(args) -> int:
+    if args.telemetry:
+        from apex_tpu import telemetry, trace
+        telemetry.enable()
+        trace.enable()
+    from apex_tpu.serve.bench import run_bench
+    from apex_tpu.serve.loader import load_model
+    try:
+        loaded = load_model(args.snapshot_dir, quantize=args.quantize,
+                            prune=args.prune)
+    except (ValueError, NotImplementedError, OSError) as e:
+        print(f"serve bench: {e}", file=sys.stderr)
+        return 1
+    print(f"serve bench: loaded step {loaded.step} "
+          f"(generation {loaded.generation}) from "
+          f"{loaded.directory}", file=sys.stderr)
+    if loaded.quant:
+        print(f"serve bench: quantized {loaded.quant.mode} "
+              f"({loaded.quant.quantized_leaves} leaves, max_abs_err "
+              f"{loaded.quant.max_abs_err:.3e})", file=sys.stderr)
+    try:
+        report = run_bench(
+            loaded, requests=args.requests, prompt_len=args.prompt_len,
+            max_new=args.max_new, max_batch=args.max_batch,
+            page=args.page, in_flight=args.in_flight,
+            overload=not args.no_overload, deadline_s=args.deadline_s,
+            seed=args.seed)
+    except ValueError as e:
+        print(f"serve bench: {e}", file=sys.stderr)
+        return 1
+    if args.telemetry:
+        from apex_tpu import telemetry
+        telemetry.write_jsonl(args.telemetry)
+        print(f"serve bench: telemetry -> {args.telemetry}",
+              file=sys.stderr)
+    print(json.dumps(report))
+    return 0
+
+
+def _run(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.cmd == "bench":
+        return _run_bench(args)
+    raise AssertionError(f"unhandled subcommand {args.cmd!r}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    try:
+        return _run(argv)
+    except BrokenPipeError:
+        # piped into `head -1` / `grep -q`: the reader closing early is
+        # normal CLI usage, not a failure. Point stdout at devnull so
+        # Python's interpreter-shutdown flush doesn't raise a second
+        # time (same guard as telemetry/cli.py).
+        import os
+        try:
+            os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        except OSError:
+            pass
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,246 @@
+"""Paged decode attention — the serving variant of
+``ops/attention.py``'s fused decode kernel, reading K/V through a block
+table instead of a dense per-sequence cache.
+
+One new token per sequence attends over that sequence's resident pages
+(``kvcache.gather_pages`` semantics: token ``t`` lives at logical row
+``t``). Two execution paths behind the same backend-select pattern as
+``contrib.xentropy`` (``APEX_TPU_SERVE_DECODE_BACKEND`` /
+:func:`set_backend`):
+
+  * **jnp** (the default): gather the pages dense, then run EXACTLY the
+    einsum/softmax chain of ``SelfMultiheadAttn.decode``'s einsum path —
+    same einsum strings, same fp32 promotion, same ``-1e30`` mask — so
+    paged decode is bit-identical to the dense-cache decode the training
+    stack already pins against the full forward.
+  * **pallas** (opt-in): one kernel per step, grid ``(B, H, pages)``,
+    the block table scalar-prefetched so each grid step's page id feeds
+    the BlockSpec index map directly — the pages DMA straight from the
+    pool with no host-side gather, and dead grid steps (pages past the
+    sequence's live length) clamp to the last live page so consecutive
+    identical indices elide the fetch entirely (the same dead-block DMA
+    elision as ``ops.attention.decode_attention``, which is the whole
+    bandwidth story of a ~0-FLOP decode step). Blockwise online softmax
+    in base 2, f32 accumulators.
+
+Prefill never comes through here — it reuses the existing flash forward
+(``SelfMultiheadAttn``'s fresh-cache prefill path), per the serving
+architecture in docs/serve.md.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops.attention import LOG2E, NEG_INF, _interpret
+from apex_tpu.serve.kvcache import gather_pages
+
+_BACKENDS = ("jnp", "pallas")
+_FORCE = os.environ.get("APEX_TPU_SERVE_DECODE_BACKEND", "auto")
+_OVERRIDE: Optional[str] = None
+
+
+def set_backend(name: Optional[str] = None) -> Optional[str]:
+    """Process-level backend override (None restores the env/default).
+    Returns the previous override so callers can save/restore."""
+    global _OVERRIDE
+    if name is not None and name not in _BACKENDS:
+        raise ValueError(
+            f"serve decode backend must be one of {_BACKENDS}, "
+            f"got {name!r}")
+    prev = _OVERRIDE
+    _OVERRIDE = name
+    return prev
+
+
+def backend() -> str:
+    """The active execution path: ``set_backend`` override, else the
+    ``APEX_TPU_SERVE_DECODE_BACKEND`` env value; ``auto`` (the default)
+    resolves to ``jnp`` — the gather+einsum chain that is bit-identical
+    to the dense-cache decode path. An unrecognized value raises (loud
+    failure: a typo'd opt-in must not silently serve the wrong path)."""
+    b = _OVERRIDE if _OVERRIDE is not None else _FORCE
+    if b in _BACKENDS:
+        return b
+    if b in ("auto", ""):
+        return "jnp"
+    raise ValueError(
+        f"APEX_TPU_SERVE_DECODE_BACKEND={b!r} — expected one of "
+        f"{_BACKENDS} or 'auto'")
+
+
+def paged_native_shapes(page: int, head_dim: int) -> bool:
+    """True when the Pallas path serves this (page, head_dim) without a
+    pad copy: the page is the kernel's KV block row count (sublane
+    multiple) and the head dim its lane dim (128-multiple, or a
+    power-of-two minor Mosaic accepts as block minor == array minor —
+    same rule as ``ops.attention.decode_native_head_dim``)."""
+    return page % 16 == 0 and (head_dim % 128 == 0
+                               or head_dim in (64, 32, 16, 8))
+
+
+def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
+                           v_pages: jax.Array, block_table: jax.Array,
+                           seq_lens: jax.Array, *,
+                           scale: Optional[float] = None) -> jax.Array:
+    """Attention of one new token per sequence over its paged K/V.
+
+    ``q``: (B, H, 1, D) — the current step's queries. ``k_pages`` /
+    ``v_pages``: (num_pages, H, page, D) — the shared pool, with the
+    step's token ALREADY written at row ``seq_lens[b] - 1`` of each live
+    sequence. ``block_table``: (B, pages_per_slot) int32 position-ordered
+    page ids. ``seq_lens``: (B,) int32 valid-token counts INCLUDING the
+    current token. Returns (B, H, 1, D).
+
+    Dead slots (``seq_lens[b] == 0``) produce a zero context row rather
+    than NaN (the all-masked softmax denominator is guarded), so the
+    engine can run a partially-occupied batch without poisoning the
+    shared batch math.
+    """
+    if q.ndim != 4 or q.shape[2] != 1:
+        raise ValueError(
+            f"paged decode is the 1-token step path: q must be "
+            f"(B, H, 1, D), got {q.shape}")
+    b, h, _, d = q.shape
+    if k_pages.shape != v_pages.shape:
+        raise ValueError(
+            f"k_pages {k_pages.shape} != v_pages {v_pages.shape}")
+    if k_pages.shape[1] != h or k_pages.shape[3] != d:
+        raise ValueError(
+            f"pool {k_pages.shape} does not match q heads/dim {q.shape}")
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    if backend() == "pallas" and paged_native_shapes(k_pages.shape[2], d):
+        return _paged_decode_pallas(q, k_pages, v_pages, block_table,
+                                    seq_lens, scale)
+    return _paged_decode_jnp(q, k_pages, v_pages, block_table, seq_lens,
+                             scale)
+
+
+def _paged_decode_jnp(q, k_pages, v_pages, block_table, seq_lens, scale):
+    """Reference path: gather pages dense, then the exact decode einsum
+    chain of ``SelfMultiheadAttn.decode`` (same einsum strings, fp32
+    score promotion, -1e30 mask, fp32 softmax) — token ``t`` sits at
+    row ``t`` after the gather, so ``col < seq_len`` is precisely the
+    dense path's ``col <= idx + row`` at ``row = 0``."""
+    k_all = gather_pages(k_pages, block_table)     # (B, H, L, D)
+    v_all = gather_pages(v_pages, block_table)
+    s_mat = jnp.einsum("bhqd,bhkd->bhqk", q, k_all,
+                       preferred_element_type=jnp.float32) * scale
+    col = jnp.arange(k_all.shape[2])[None, None, None, :]
+    live = col < seq_lens[:, None, None, None]
+    s_mat = jnp.where(live, s_mat, NEG_INF)
+    # all-masked rows (dead slots): NEG_INF everywhere softmaxes to a
+    # uniform distribution over garbage — force the context to zero
+    p = jax.nn.softmax(s_mat, axis=-1).astype(v_all.dtype)
+    p = jnp.where(live, p, jnp.zeros((), p.dtype))
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v_all)
+
+
+# ---------------------------------------------------------------------------
+# Pallas path — block-table-indexed page DMA with dead-page elision
+# ---------------------------------------------------------------------------
+
+def _paged_decode_kernel(scale, bq, page, n_pages, *refs):
+    """Grid (B, H, ip): one page of one sequence's K/V per step,
+    blockwise online softmax in base 2 (the ``_decode_attn_kernel``
+    recipe, re-indexed through the block table). The query block is the
+    step's single token row-padded to ``bq`` sublanes; every padded row
+    computes the same masked softmax and is sliced away outside.
+    Validity: logical column ``ip * page + r < seq_lens[b]``. Dead
+    pages never DMA: the index map clamps them to the last live page,
+    and ``@pl.when`` skips their compute."""
+    bt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr = refs
+    ip = pl.program_id(2)
+    b_ = pl.program_id(0)
+    n = sl_ref[b_]
+
+    @pl.when(ip == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    @pl.when(ip * page < n)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * (scale * LOG2E)  # (bq, d)
+        k = k_ref[0, 0].astype(jnp.float32)                    # (page, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)                # (bq, page)
+        col = ip * page + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, page), 1)
+        s = jnp.where(col < n, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp2(s - m_new)
+        corr = jnp.exp2(m_prev - m_new)
+        l_scr[:, :1] = corr * l_scr[:, :1] \
+            + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc[:] = corr * acc[:] + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(ip == n_pages - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        o_ref[0, 0] = (acc[:] / jnp.where(l == 0.0, 1.0, l)).astype(
+            o_ref.dtype)
+
+
+def _paged_decode_pallas(q, k_pages, v_pages, block_table, seq_lens,
+                         scale):
+    b, h, _, d = q.shape
+    page = k_pages.shape[2]
+    n_pages = block_table.shape[1]
+    bq = 8          # minimum sublane tile; rows 1.. are inert padding
+    qf = jnp.pad(q.reshape(b, h, 1, d), ((0, 0), (0, 0), (0, bq - 1),
+                                         (0, 0)))
+    bt = jnp.asarray(block_table, jnp.int32)
+    sl = jnp.asarray(seq_lens, jnp.int32)
+
+    def kv_index(b_, h_, ip, bt_ref, sl_ref):
+        # dead pages (entirely past the live prefix) clamp to the LAST
+        # live page: consecutive identical page ids elide the DMA. A
+        # fully-dead slot (n == 0) pins to page 0 of its table.
+        last = jnp.maximum(
+            jnp.minimum((sl_ref[b_] - 1) // page, n_pages - 1), 0)
+        return (bt_ref[b_, jnp.minimum(ip, last)], h_, 0, 0)
+
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, scale, bq, page,
+                          n_pages),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, h, n_pages),
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d),
+                             lambda b_, h_, ip, bt_ref, sl_ref:
+                             (b_, h_, 0, 0)),
+                pl.BlockSpec((1, 1, page, d),
+                             lambda b_, h_, ip, bt_ref, sl_ref:
+                             kv_index(b_, h_, ip, bt_ref, sl_ref)),
+                pl.BlockSpec((1, 1, page, d),
+                             lambda b_, h_, ip, bt_ref, sl_ref:
+                             kv_index(b_, h_, ip, bt_ref, sl_ref)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, bq, d),
+                                   lambda b_, h_, ip, bt_ref, sl_ref:
+                                   (b_, h_, 0, 0)),
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
+                            pltpu.VMEM((bq, 128), jnp.float32),
+                            pltpu.VMEM((bq, 128), jnp.float32)]),
+        out_shape=jax.ShapeDtypeStruct((b, h, bq, d), q.dtype),
+        interpret=_interpret(),
+    )(bt, sl, qf, k_pages, v_pages)[:, :, :1, :]
+    return out

@@ -1,0 +1,373 @@
+"""Continuous-batching engine — admit/retire between decode steps over
+a fixed-shape slot array.
+
+The recompile-free contract: ``max_batch`` slots, one shared page pool,
+one block table of static shape. Requests come and go by MUTATING slot
+contents (page lists, positions, active masks) — never by changing an
+array shape, so the decode step compiles exactly once. Dead slots ride
+along masked (their page writes drop, their logits are discarded).
+
+Dispatch pipelining reuses the trainer's ``InflightWindow``: the decode
+chain advances on DEVICE state (the pool and the last-token vector feed
+the next dispatch directly, so autoregression never waits on the host),
+while the host observes tokens only at retirement — detokenization,
+EOS/finish bookkeeping, TTFT/inter-token spans all happen off the
+critical path. The window changes WHEN the host observes, never what
+the device computes: token streams are bit-identical at every depth
+(pinned by tests/test_serve_engine.py).
+
+Scheduler states (docs/serve.md): ``queued`` (admission queue) ->
+``running`` (slot assigned, prefilled) -> ``done``; or ``rejected``
+(shed at admission — queue full, SLO-unreachable, or oversized).
+Finished slots linger as DRAINING until their in-flight dispatches
+retire, then their pages return to the free list.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.serve import kvcache, metrics
+from apex_tpu.serve import model as smodel
+from apex_tpu.serve.admission import (TOO_LARGE, AdmissionController,
+                                      Rejected)
+from apex_tpu.serve.loader import LoadedModel
+from apex_tpu.trainer.pipeline import InflightWindow
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its observed lifecycle."""
+
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    deadline_s: Optional[float] = None
+    eos_token_id: Optional[int] = None
+    # lifecycle (engine/admission-owned)
+    state: str = "new"         # new|queued|running|done|rejected
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    # host observation time of each token — TTFT / inter-token
+    # percentiles in the bench report come from diffs of this list
+    token_times: List[float] = dataclasses.field(default_factory=list)
+    submitted_s: Optional[float] = None
+    t_admit: Optional[float] = None
+    t_first: Optional[float] = None
+    t_last: Optional[float] = None
+    t_done: Optional[float] = None
+    reject_reason: Optional[str] = None
+
+    @property
+    def done(self) -> bool:
+        return self.state == "done"
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.t_first is None or self.submitted_s is None:
+            return None
+        return self.t_first - self.submitted_s
+
+    def in_deadline(self) -> Optional[bool]:
+        """Completed within its SLO? None when no deadline was set."""
+        if self.deadline_s is None:
+            return None
+        if self.t_done is None or self.submitted_s is None:
+            return False
+        return (self.t_done - self.submitted_s) <= self.deadline_s
+
+
+@dataclasses.dataclass
+class _Slot:
+    req: Request
+    pages: List[int]
+    prompt_len: int
+    outstanding: int = 0       # dispatches not yet retired
+    finished: bool = False     # logical completion observed (eos/budget)
+
+
+class Engine:
+    """Continuous-batching decode engine over a :class:`LoadedModel`.
+
+    ``max_batch``: decode slots. ``page``: tokens per KV page.
+    ``max_context``: per-request context ceiling (prompt + generated);
+    sets ``pages_per_slot``. ``max_prompt``: static prefill width (one
+    prefill compile). ``in_flight``: InflightWindow depth — decode
+    dispatches the host may run ahead of retirement.
+    """
+
+    def __init__(self, loaded: LoadedModel, *, max_batch: int = 4,
+                 page: int = 16, max_context: int = 128,
+                 max_prompt: int = 32, in_flight: int = 2,
+                 admission: Optional[AdmissionController] = None,
+                 clock=time.monotonic):
+        if max_prompt > max_context:
+            raise ValueError(
+                f"max_prompt ({max_prompt}) > max_context "
+                f"({max_context})")
+        if max_context > loaded.spec.max_seq:
+            raise ValueError(
+                f"max_context ({max_context}) exceeds the model's "
+                f"position table (max_seq={loaded.spec.max_seq})")
+        self.loaded = loaded
+        self.spec = loaded.spec
+        self.params = loaded.params
+        self.max_batch = int(max_batch)
+        self.page = int(page)
+        self.max_context = int(max_context)
+        self.max_prompt = int(max_prompt)
+        self.pages_per_slot = -(-self.max_context // self.page)
+        self.num_pages = self.max_batch * self.pages_per_slot
+        self._clock = clock
+        self.admission = admission or AdmissionController(clock=clock)
+        self.window = InflightWindow(in_flight)
+
+        spec = self.spec
+        emb = self.params["tok_emb"]["embedding"]
+        kernel = self.params["block_0"]["attn"]["in_proj"]["kernel"]
+        kv_dtype = jnp.result_type(emb.dtype, kernel.dtype)
+        self.pool = kvcache.create_pool(
+            layers=spec.layers, num_pages=self.num_pages,
+            heads=spec.heads, page=self.page, head_dim=spec.head_dim,
+            dtype=kv_dtype)
+        self.allocator = kvcache.PageAllocator(self.num_pages)
+        # static-shape host mirrors of the device scheduling state
+        self.block_tables = np.full(
+            (self.max_batch, self.pages_per_slot), self.num_pages,
+            np.int32)
+        self.positions = np.zeros((self.max_batch,), np.int32)
+        self.limits = np.zeros((self.max_batch,), np.int32)
+        self.slots: List[Optional[_Slot]] = [None] * self.max_batch
+        self.last_tokens = jnp.zeros((self.max_batch,), jnp.int32)
+        self.completed: List[Request] = []
+        self.tokens_emitted = 0
+        self._seq = 0          # dispatch sequence number
+        self._meta: Dict[int, Any] = {}
+        self._next_rid = 0
+
+        def _decode(params, pool, last_tokens, block_tables, positions,
+                    active):
+            logits, pool = smodel.decode_step(
+                params, spec, pool, last_tokens, positions,
+                block_tables, active)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return pool, jnp.where(active, nxt, last_tokens)
+
+        def _prefill(params, pool, prompt, length, block_row):
+            _, first, pool = smodel.prefill(
+                params, spec, prompt, length, pool, block_row)
+            return pool, first
+
+        self._decode_fn = jax.jit(_decode, donate_argnums=(1,))
+        self._prefill_fn = jax.jit(_prefill, donate_argnums=(1,))
+
+    # -- submission ---------------------------------------------------------
+
+    def request(self, prompt, max_new_tokens: int, *,
+                deadline_s: Optional[float] = None,
+                eos_token_id: Optional[int] = None) -> Request:
+        r = Request(rid=self._next_rid, prompt=list(map(int, prompt)),
+                    max_new_tokens=int(max_new_tokens),
+                    deadline_s=deadline_s, eos_token_id=eos_token_id)
+        self._next_rid += 1
+        return r
+
+    def submit(self, req: Request, now: Optional[float] = None) -> bool:
+        """Queue a request through admission control. Oversized
+        requests (prompt past the static prefill width, or context past
+        the per-slot page budget) shed here — they could never run."""
+        now = self._clock() if now is None else now
+        if (len(req.prompt) > self.max_prompt
+                or len(req.prompt) + req.max_new_tokens
+                > self.max_context):
+            self.admission.submitted += 1
+            req.submitted_s = req.submitted_s or now
+            req.state = "rejected"
+            req.reject_reason = TOO_LARGE
+            self.admission.rejected.append(
+                Rejected(req.rid, TOO_LARGE, now))
+            metrics.count(metrics.REJECTED, meta={"reason": TOO_LARGE})
+            return False
+        return self.admission.submit(req, now)
+
+    # -- scheduling ---------------------------------------------------------
+
+    def _free_slot_index(self) -> Optional[int]:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def _admit(self, now: float) -> None:
+        while True:
+            slot_idx = self._free_slot_index()
+            if slot_idx is None:
+                return
+            req = self.admission.pop_ready(now)
+            if req is None:
+                return
+            plen = len(req.prompt)
+            need = -(-(plen + req.max_new_tokens) // self.page)
+            try:
+                pages = self.allocator.alloc(need)
+            except kvcache.PoolFullError:
+                # back-pressure, not a shed: retry when pages free up
+                self.admission.push_back(req)
+                return
+            slot = _Slot(req=req, pages=pages, prompt_len=plen)
+            self.slots[slot_idx] = slot
+            row = np.full((self.pages_per_slot,), self.num_pages,
+                          np.int32)
+            row[:need] = pages
+            self.block_tables[slot_idx] = row
+            prompt = np.zeros((self.max_prompt,), np.int32)
+            prompt[:plen] = req.prompt
+            self.pool, first = self._prefill_fn(
+                self.params, self.pool, jnp.asarray(prompt),
+                jnp.int32(plen), jnp.asarray(row))
+            self.last_tokens = self.last_tokens.at[slot_idx].set(first)
+            # next decode step consumes the first generated token at
+            # position plen; a request of max_new N needs N-1 steps
+            self.positions[slot_idx] = plen
+            self.limits[slot_idx] = plen + req.max_new_tokens - 1
+            req.state = "running"
+            req.t_admit = now
+            metrics.count(metrics.ADMITTED)
+            slot.outstanding += 1
+            self._meta[self._seq] = ("prefill", self._clock(), slot_idx)
+            for idx, payload in self.window.push(self._seq, first):
+                self._retire(idx, payload)
+            self._seq += 1
+
+    def _active_mask(self) -> np.ndarray:
+        act = np.zeros((self.max_batch,), bool)
+        for i, s in enumerate(self.slots):
+            if s is not None and not s.finished \
+                    and self.positions[i] < self.limits[i]:
+                act[i] = True
+        return act
+
+    def step(self) -> bool:
+        """One engine iteration: admit, dispatch one decode step over
+        the active slots, process retirements. Returns False when there
+        was nothing to do (no queue, no occupied slots, nothing in
+        flight)."""
+        now = self._clock()
+        self._admit(now)
+        metrics.gauge(metrics.QUEUE_DEPTH, self.admission.depth,
+                      step=self._seq)
+        occupied = sum(s is not None for s in self.slots)
+        metrics.gauge(metrics.OCCUPANCY, occupied / self.max_batch,
+                      step=self._seq)
+        active = self._active_mask()
+        if active.any():
+            snapshot = [(i, self.slots[i].req,
+                         int(self.positions[i]) - self.slots[i].prompt_len
+                         + 1)
+                        for i in np.flatnonzero(active)]
+            self.pool, self.last_tokens = self._decode_fn(
+                self.params, self.pool, self.last_tokens,
+                jnp.asarray(self.block_tables),
+                jnp.asarray(self.positions), jnp.asarray(active))
+            for i, _, _ in snapshot:
+                self.positions[i] += 1
+                self.slots[i].outstanding += 1
+            self._meta[self._seq] = ("decode", self._clock(), snapshot)
+            for idx, payload in self.window.push(self._seq,
+                                                 self.last_tokens):
+                self._retire(idx, payload)
+            self._seq += 1
+            return True
+        if self.window.stats()["pending"]:
+            for idx, payload in self.window.drain():
+                self._retire(idx, payload)
+            return True
+        # Nothing active, nothing in flight: every finished slot was
+        # reaped at retirement, so stepping again cannot make progress
+        # (queued work, if any, is waiting on capacity that only a
+        # retirement can free — and there are no retirements coming).
+        return False
+
+    def run(self, requests: List[Request]) -> List[Request]:
+        """Closed-loop driver: submit everything, step until drained."""
+        now = self._clock()
+        for r in requests:
+            self.submit(r, now)
+        while self.step():
+            pass
+        for idx, payload in self.window.drain():
+            self._retire(idx, payload)
+        return requests
+
+    # -- retirement (host-side, off the dispatch critical path) -------------
+
+    def _retire(self, idx: int, payload) -> None:
+        kind, t_dispatch, info = self._meta.pop(idx)
+        now = self._clock()
+        toks = np.asarray(payload)
+        if kind == "prefill":
+            slot_idx = info
+            slot = self.slots[slot_idx]
+            slot.outstanding -= 1
+            req = slot.req
+            tok = int(toks) if toks.ndim == 0 else int(toks.reshape(-1)[0])
+            self._observe_token(slot_idx, slot, req, tok, now,
+                                first=True)
+        else:
+            n = 0
+            for slot_idx, req, _gen_idx in info:
+                slot = self.slots[slot_idx]
+                if slot is None or slot.req is not req:
+                    continue   # unreachable: reap waits on outstanding
+                slot.outstanding -= 1
+                self._observe_token(slot_idx, slot, req,
+                                    int(toks[slot_idx]), now,
+                                    first=False)
+                n += 1
+            if n:
+                metrics.count(metrics.TOKENS, n)
+        self._reap()
+
+    def _observe_token(self, slot_idx: int, slot: _Slot, req: Request,
+                       tok: int, now: float, *, first: bool) -> None:
+        if slot.finished:
+            return                      # post-EOS overrun token
+        if first:
+            req.t_first = now
+            metrics.span(metrics.TTFT, req.submitted_s, now)
+            if req.ttft_s is not None:
+                self.admission.observe_ttft(req.ttft_s)
+            metrics.count(metrics.TOKENS, 1)
+        elif req.t_last is not None:
+            metrics.span(metrics.INTERTOKEN, req.t_last, now)
+        req.t_last = now
+        req.tokens.append(tok)
+        req.token_times.append(now)
+        self.tokens_emitted += 1
+        hit_eos = (req.eos_token_id is not None
+                   and tok == req.eos_token_id)
+        if hit_eos or len(req.tokens) >= req.max_new_tokens:
+            slot.finished = True
+            # stop any further dispatch of this slot
+            self.limits[slot_idx] = self.positions[slot_idx]
+            req.state = "done"
+            req.t_done = now
+            metrics.count(metrics.COMPLETED)
+            self.completed.append(req)
+
+    def _reap(self) -> None:
+        """Free slots whose request finished and whose in-flight
+        dispatches have all retired."""
+        for i, slot in enumerate(self.slots):
+            if slot is None or not slot.finished or slot.outstanding:
+                continue
+            self.allocator.free(slot.pages)
+            self.block_tables[i] = self.num_pages
+            self.positions[i] = 0
+            self.limits[i] = 0
+            self.slots[i] = None

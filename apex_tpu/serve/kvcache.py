@@ -1,0 +1,185 @@
+"""Paged KV cache — the block-table memory layout of the serving stack
+(vLLM-style PagedAttention, adapted to the repo's static-shape TPU
+doctrine).
+
+The training decode path (``SelfMultiheadAttn.decode``) allocates one
+dense ``(B, H, max_len, D)`` cache per layer: every sequence pays for
+the WORST-CASE context whether it uses it or not. Under continuous
+batching that over-reservation is the capacity ceiling — a mixed pool
+of short and long requests wants memory proportional to the tokens
+actually resident. Paging fixes it: the cache is a pool of fixed-size
+pages (``(num_pages, H, page, D)`` per layer), each request holds an
+ordered page list in a block table, and a host-side free-list allocator
+recycles pages on retirement.
+
+Static shapes throughout (the recompile-free contract the engine
+depends on): the pool, the block tables (``(max_batch,
+pages_per_slot)``), and the per-step index vectors never change shape —
+only their CONTENTS change as requests come and go. Dead slots are
+masked with an out-of-range page id (`=num_pages`), which the scatter
+writes drop (``mode='drop'``) and the attention masks by sequence
+length, so there is no per-request reshape or recompile anywhere on the
+hot path.
+
+Device-side helpers are functional (pool in, pool out) so the engine
+can thread the pool through a donated jit chain; the allocator is plain
+host Python (page ids are scheduling state, not tensor state).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+class PoolFullError(RuntimeError):
+    """Raised by :meth:`PageAllocator.alloc` when no free page remains.
+    The engine treats this as back-pressure (the request waits in the
+    admission queue), never as a fatal error."""
+
+
+class PageAllocator:
+    """Host-side free-list allocator over ``num_pages`` page ids.
+
+    LIFO recycling (a stack): the most recently freed pages are handed
+    out first, which keeps the live working set dense at the low end of
+    the pool — the same locality argument as a slab allocator, and it
+    makes allocator behaviour deterministic for the bitwise replay
+    tests."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 1:
+            raise ValueError(f"num_pages must be >= 1, got {num_pages}")
+        self.num_pages = int(num_pages)
+        self._free: List[int] = list(range(self.num_pages - 1, -1, -1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def alloc(self, n: int = 1) -> List[int]:
+        """Allocate ``n`` pages atomically — all or nothing (a partial
+        grant would leak pages when the caller aborts admission)."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} pages")
+        if n > len(self._free):
+            raise PoolFullError(
+                f"paged KV pool exhausted: need {n} pages, "
+                f"{len(self._free)}/{self.num_pages} free")
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            p = int(p)
+            if not 0 <= p < self.num_pages:
+                raise ValueError(
+                    f"page id {p} out of range [0, {self.num_pages})")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+            self._free.append(p)
+
+
+class KVPool(NamedTuple):
+    """Device-side paged K/V storage: one entry per transformer layer,
+    each shaped ``(num_pages, heads, page, head_dim)``. A NamedTuple of
+    per-layer arrays (not one stacked array) so a jitted step updates
+    layers in place without a lifetime-doubling stack/unstack."""
+
+    k: tuple
+    v: tuple
+
+    @property
+    def num_pages(self) -> int:
+        return self.k[0].shape[0]
+
+    @property
+    def page(self) -> int:
+        return self.k[0].shape[2]
+
+    @property
+    def layers(self) -> int:
+        return len(self.k)
+
+    def bytes(self) -> int:
+        return sum(a.size * a.dtype.itemsize for a in self.k + self.v)
+
+
+def create_pool(*, layers: int, num_pages: int, heads: int, page: int,
+                head_dim: int, dtype=jnp.float32) -> KVPool:
+    shape = (num_pages, heads, page, head_dim)
+    k = tuple(jnp.zeros(shape, dtype) for _ in range(layers))
+    v = tuple(jnp.zeros(shape, dtype) for _ in range(layers))
+    return KVPool(k=k, v=v)
+
+
+# ---------------------------------------------------------------------------
+# Device-side page access (functional, jit-friendly)
+# ---------------------------------------------------------------------------
+
+def write_token(k_pages: jax.Array, v_pages: jax.Array, k: jax.Array,
+                v: jax.Array, page_ids: jax.Array, offsets: jax.Array):
+    """Scatter one new token's K/V per sequence into the pool.
+
+    ``k``/``v``: (B, H, D) — this step's projected key/value, one token
+    per slot. ``page_ids``: (B,) int32 — the destination page of each
+    slot's current position (pass ``num_pages`` for dead slots: the
+    out-of-range index makes the scatter a no-op via ``mode='drop'``).
+    ``offsets``: (B,) int32 row within the page. Returns the updated
+    ``(k_pages, v_pages)``.
+    """
+    k_pages = k_pages.at[page_ids, :, offsets, :].set(k, mode="drop")
+    v_pages = v_pages.at[page_ids, :, offsets, :].set(v, mode="drop")
+    return k_pages, v_pages
+
+
+def write_prompt(k_pages: jax.Array, v_pages: jax.Array, k: jax.Array,
+                 v: jax.Array, block_row: jax.Array, length: jax.Array):
+    """Scatter a prefilled prompt's K/V (one request, one layer) into
+    its pages. ``k``/``v``: (H, S_max, D) — the dense prefill cache,
+    rows past ``length`` are padding and are dropped. ``block_row``:
+    (pages_per_slot,) int32 page list of the request."""
+    h, s_max, d = k.shape
+    page = k_pages.shape[2]
+    pos = jnp.arange(s_max)
+    pid = block_row[pos // page]
+    # padding rows route out of range -> dropped by the scatter
+    pid = jnp.where(pos < length, pid, k_pages.shape[0])
+    off = pos % page
+    k_pages = k_pages.at[pid, :, off, :].set(
+        k.transpose(1, 0, 2), mode="drop")
+    v_pages = v_pages.at[pid, :, off, :].set(
+        v.transpose(1, 0, 2), mode="drop")
+    return k_pages, v_pages
+
+
+def gather_pages(pages: jax.Array, block_table: jax.Array) -> jax.Array:
+    """Gather each slot's page list into a dense per-slot view:
+    ``(num_pages, H, page, D)`` x ``(B, pages_per_slot)`` ->
+    ``(B, H, pages_per_slot * page, D)``. Token ``t`` of a slot lands at
+    row ``t`` (page lists are position-ordered), so downstream masking
+    is a plain ``col < seq_len``. Out-of-range ids (dead slots) clamp —
+    the rows they produce are garbage by construction and MUST be
+    masked by sequence length."""
+    g = pages[block_table]                     # (B, P_s, H, page, D)
+    b, ps, h, page, d = g.shape
+    return g.transpose(0, 2, 1, 3, 4).reshape(b, h, ps * page, d)
+
+
+@dataclasses.dataclass
+class SlotPages:
+    """Host-side bookkeeping for one occupied slot: the ordered page
+    list and the number of resident tokens (mirrors the device
+    ``seq_lens`` entry; kept host-side for retirement/free)."""
+
+    pages: List[int]
+    tokens: int = 0
+
+    def capacity(self, page: int) -> int:
+        return len(self.pages) * page

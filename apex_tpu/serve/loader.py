@@ -1,0 +1,137 @@
+"""``serve.load_model`` — from a SnapshotManager directory to a
+servable model.
+
+Order of operations is the safety story:
+
+  1. ``latest_manifest()`` — the newest READABLE manifest, no payload
+     touched yet.
+  2. Layout fingerprint check — if the caller expects a layout, it is
+     validated against the manifest BEFORE any array materializes (a
+     wrong-topology restore is a config error; failing it after loading
+     gigabytes is the failure mode ``checkpoint._check_layout`` exists
+     to prevent).
+  3. Model spec — from the manifest's ``extra["model"]`` (written by
+     examples/gpt/train_lm.py) or an explicit ``spec=``; unsupported
+     trained-in features (MoE, attention biases) are rejected here,
+     still before materialization.
+  4. Template build — the exact (params, opt_state) structure the
+     trainer saved, rebuilt from the spec + the manifest's recorded
+     ``opt_level`` via the same ``amp.initialize`` / ``amp.cast_model``
+     recipe train_lm runs (``restore_npz``'s structure fingerprint
+     demands an exact match). Shapes only — ``jax.eval_shape``, no
+     weights allocated.
+  5. Restore, keep ``params``, drop the optimizer state. A params-only
+     snapshot (the serve-side re-publish format) restores against the
+     params-only template as a fallback.
+  6. Opt-in transforms: ``quantize="bf16"|"int8"``
+     (:mod:`~apex_tpu.serve.quant`) and ``prune=True``
+     (``sparsity.prune_for_serving`` — 2:4 checkpoints load like any
+     other; the flag applies one-shot pruning at load).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import amp, checkpoint, optimizers
+from apex_tpu.resilience.snapshot import SnapshotManager
+from apex_tpu.serve.model import ModelSpec
+from apex_tpu.serve.quant import QuantReport, quantize_params
+
+
+class LoadedModel(NamedTuple):
+    """Everything the engine needs, plus provenance for the bench
+    report."""
+
+    model: Any                     # TransformerLM (dense decode config)
+    params: Any
+    spec: ModelSpec
+    step: int
+    generation: int
+    manifest: dict
+    directory: str
+    quant: Optional[QuantReport] = None
+    pruned: bool = False
+
+
+def _template(spec: ModelSpec, opt_level: str):
+    """The (params, opt_state) structure train_lm snapshots — rebuilt
+    shape-only. Mirrors train_lm's init exactly: fp32 flax init, amp
+    model cast (no-bn policy: transformers have no batchnorm), then the
+    amp-wrapped FusedAdam state over the CAST params."""
+    model = spec.model()
+    init_tokens = jnp.zeros((1, min(spec.max_seq, 128)), jnp.int32)
+    _, aopt = amp.initialize(None, optimizers.FusedAdam(lr=1e-3),
+                             opt_level=opt_level, verbosity=0)
+
+    def build():
+        p32 = model.init(jax.random.PRNGKey(0), init_tokens)["params"]
+        p = amp.cast_model(p32, amp.resolve(
+            opt_level, keep_batchnorm_fp32=False))
+        return p, aopt.init(p)
+
+    return jax.eval_shape(build)
+
+
+def load_model(directory: str, *, spec: Optional[ModelSpec] = None,
+               layout=None, quantize: Optional[str] = None,
+               prune: bool = False) -> LoadedModel:
+    """Load the newest complete snapshot under ``directory`` for
+    serving. See the module docstring for the validation order.
+
+    ``layout``: expected parallelism layout — its fingerprint is
+    checked against the manifest before the payload loads (pass the
+    layout the checkpoint was TRAINED under; None skips the check, the
+    ``checkpoint.restore_npz`` convention). ``quantize``: None |
+    ``"bf16"`` | ``"int8"``. ``prune``: apply one-shot 2:4 pruning
+    (``sparsity.prune_for_serving``) to the loaded params.
+    """
+    mgr = SnapshotManager(directory)
+    man = mgr.latest_manifest()
+    if man is None:
+        raise ValueError(
+            f"no readable snapshot manifest under {directory!r} — "
+            f"train with --snapshot-dir (examples/gpt/train_lm.py) or "
+            f"point at an existing SnapshotManager directory")
+    if layout is not None:
+        # BEFORE materialization: a layout mismatch must cost zero
+        # array bytes (restore_latest would also catch it, but only
+        # per-generation during the load)
+        checkpoint._check_layout(man.get("layout"), layout, directory)
+    extra = man.get("extra") or {}
+    if spec is None:
+        md = extra.get("model")
+        if not md:
+            raise ValueError(
+                f"snapshot manifest under {directory!r} records no "
+                f"model dimensions (extra['model']) — it predates the "
+                f"serving manifest extension; pass spec=ModelSpec(...) "
+                f"matching the training run")
+        spec = ModelSpec.from_dict(md)
+    opt_level = str(extra.get("opt_level", "O0"))
+
+    template = _template(spec, opt_level)
+    try:
+        restored = mgr.restore_latest(template, layout=layout)
+        params = restored.state[0]
+    except ValueError:
+        # params-only snapshot (serve re-publish format): retry against
+        # the params template alone before giving up
+        restored = mgr.restore_latest(template[0], layout=layout)
+        params = restored.state
+    spec.check_params(params)
+
+    report = None
+    if quantize is not None:
+        params, report = quantize_params(params, quantize)
+    if prune:
+        from apex_tpu import sparsity
+        params = sparsity.prune_for_serving(params)
+    return LoadedModel(
+        model=spec.model(), params=params, spec=spec,
+        step=restored.step, generation=restored.generation,
+        manifest=man, directory=str(directory), quant=report,
+        pruned=bool(prune))

@@ -1,0 +1,58 @@
+"""``serve/*`` telemetry event families (documented in
+docs/telemetry.md; aggregated by the ``serve`` section of
+``telemetry.summarize``).
+
+Gauges (kind=point, per engine step):
+  * ``serve/queue_depth``  — admission queue length
+  * ``serve/occupancy``    — occupied slots / max_batch (0..1)
+  * ``serve/tokens_per_s`` — bench-window decode throughput
+
+Counters (kind=counter):
+  * ``serve/admitted`` / ``serve/rejected`` / ``serve/expired`` /
+    ``serve/completed`` / ``serve/tokens`` (``rejected`` carries the
+    shed reason in ``meta``; ``expired`` counts deadline expiries of
+    QUEUED requests, a subset of honest goodput accounting)
+
+Trace spans (aggregated from span rows, like the trainer's step
+timing):
+  * ``serve/ttft``       — submit -> first token observed on host
+  * ``serve/intertoken`` — consecutive host-observed tokens of one
+    request
+
+All emission is gated by ``telemetry.enabled()`` inside the collector /
+trace layer — a disabled server pays only the no-op call.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from apex_tpu import telemetry, trace
+
+QUEUE_DEPTH = "serve/queue_depth"
+OCCUPANCY = "serve/occupancy"
+TOKENS_PER_S = "serve/tokens_per_s"
+ADMITTED = "serve/admitted"
+REJECTED = "serve/rejected"
+EXPIRED = "serve/expired"
+COMPLETED = "serve/completed"
+TOKENS = "serve/tokens"
+TTFT = "serve/ttft"
+INTERTOKEN = "serve/intertoken"
+
+GAUGES = (QUEUE_DEPTH, OCCUPANCY, TOKENS_PER_S)
+COUNTERS = (ADMITTED, REJECTED, EXPIRED, COMPLETED, TOKENS)
+SPAN_FAMILIES = (TTFT, INTERTOKEN)
+
+
+def gauge(name: str, value, *, step: Optional[int] = None) -> None:
+    telemetry.record(name, value, step=step, kind="point")
+
+
+def count(name: str, n: float = 1, *, meta: Optional[dict] = None) -> None:
+    telemetry.record(name, n, kind="counter", meta=meta)
+
+
+def span(name: str, begin: float, end: float, *,
+         step: Optional[int] = None, meta: Optional[dict] = None) -> None:
+    trace.emit_span(name, begin, end, step=step, meta=meta)

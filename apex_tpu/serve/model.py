@@ -1,0 +1,241 @@
+"""Functional decode forward over ``TransformerLM`` params — the model
+side of the paged serving stack.
+
+The training decode path stores K/V in per-module flax ``"cache"``
+variables: one dense ``(B, H, max_len, D)`` buffer per layer per batch.
+Paging replaces those buffers with the shared pool + block tables of
+:mod:`~apex_tpu.serve.kvcache`, which no flax variable can express — so
+the serve stack runs the decode step FUNCTIONALLY over the same param
+tree, mirroring ``TransformerLM``'s per-token math op for op
+(``layer_norm`` is literally the same function the flax module wraps;
+the dense/einsum chains reproduce flax's dtype-promotion rules). The
+bitwise pin in tests/test_serve_decode.py holds this mirror to the
+dense-cache decode path exactly.
+
+Prefill is NOT re-implemented: it runs the model's own fresh-cache
+decode apply (which takes the existing causal flash forward — see
+``SelfMultiheadAttn.decode``'s fresh-prefill path), and the resulting
+dense prompt cache is scattered into pages.
+
+Supported model surface (validated by :meth:`ModelSpec.check_params`):
+the dense decoder configuration ``TransformerLM(vocab, layers, embed,
+heads)`` with learned absolute positions, tied or untied head. MoE,
+relative-bias/ALiBi and tensor/sequence-parallel checkpoints are
+rejected loudly at load — serving them is future work, and a silent
+wrong-math forward is the one failure mode this module must not have.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Mapping, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models import TransformerLM
+from apex_tpu.normalization.fused_layer_norm import layer_norm
+from apex_tpu.serve import kvcache
+from apex_tpu.serve.decode import paged_decode_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """The minimal model description serving needs — written into
+    snapshot manifests by examples/gpt/train_lm.py (``extra["model"]``)
+    so :func:`serve.load_model` is self-contained."""
+
+    vocab: int
+    layers: int
+    embed_dim: int
+    heads: int
+    max_seq: int = 4096
+    mlp_ratio: int = 4
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.heads
+
+    def model(self, **overrides) -> TransformerLM:
+        return TransformerLM(
+            vocab_size=self.vocab, num_layers=self.layers,
+            embed_dim=self.embed_dim, num_heads=self.heads,
+            max_seq=self.max_seq, mlp_ratio=self.mlp_ratio,
+            tie_embeddings=self.tie_embeddings, **overrides)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "ModelSpec":
+        """Build from a manifest ``extra["model"]`` dict. Unsupported
+        trained-in features recorded there (MoE, attention position
+        biases) are rejected here — before any payload materializes."""
+        for flag in ("moe", "relative_bias", "alibi"):
+            if d.get(flag):
+                raise NotImplementedError(
+                    f"serve does not support checkpoints trained with "
+                    f"{flag!r} yet (the paged decode forward mirrors "
+                    f"the dense learned-position configuration only)")
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def check_params(self, params: Mapping[str, Any]) -> None:
+        """Loud validation that a param tree is the configuration the
+        functional decode mirrors — unsupported trained-in features
+        would otherwise silently produce wrong logits."""
+        if "pos_emb" not in params:
+            raise NotImplementedError(
+                "serve decode requires the learned-absolute-position "
+                "configuration (no pos_emb table found: relative_bias/"
+                "alibi checkpoints are not supported yet)")
+        blk = params.get("block_0", {})
+        attn = blk.get("attn", {})
+        for bad in ("rel_bias", "alibi_slopes"):
+            if bad in attn:
+                raise NotImplementedError(
+                    f"serve decode does not support attention position "
+                    f"biases ({bad} present in checkpoint)")
+        if "moe" in blk:
+            raise NotImplementedError(
+                "serve decode does not support MoE checkpoints")
+        if self.tie_embeddings != ("head" not in params):
+            raise ValueError(
+                f"tie_embeddings={self.tie_embeddings} but checkpoint "
+                f"{'has no' if 'head' not in params else 'has a'} "
+                f"separate head — spec/params mismatch")
+
+
+# ---------------------------------------------------------------------------
+# flax-equivalent primitive ops (dtype promotion mirrored exactly)
+# ---------------------------------------------------------------------------
+
+def _dense(x, p):
+    """``flax.linen.Dense`` with ``dtype=None``: inputs/kernel/bias
+    promote to a common dtype, then dot + bias — the promotion rule is
+    what keeps bf16 checkpoints bit-compatible with the flax path."""
+    kernel = p["kernel"]
+    bias = p.get("bias")
+    args = [x, kernel] + ([] if bias is None else [bias])
+    dt = jnp.result_type(*(a.dtype for a in args))
+    y = jnp.dot(x.astype(dt), kernel.astype(dt))
+    if bias is not None:
+        y = y + bias.astype(dt)
+    return y
+
+
+def _ln(x, p):
+    return layer_norm(x, p["weight"], p["bias"]).astype(x.dtype)
+
+
+def _split_heads(x, num_heads):
+    b, s, e = x.shape
+    return x.reshape(b, s, num_heads, e // num_heads).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(x):
+    b, h, s, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+
+
+def decode_step(params, spec: ModelSpec, pool: kvcache.KVPool,
+                tokens: jax.Array, positions: jax.Array,
+                block_tables: jax.Array, active: jax.Array
+                ) -> Tuple[jax.Array, kvcache.KVPool]:
+    """One batched decode step: embed ``tokens`` at ``positions``, write
+    each layer's new K/V into the pool, attend over the resident pages,
+    and return fp32 logits for the NEXT position.
+
+    ``tokens``: (B,) int32 current input token per slot. ``positions``:
+    (B,) int32 global position of that token (== tokens already
+    resident). ``block_tables``: (B, pages_per_slot) int32. ``active``:
+    (B,) bool — dead slots neither write pages nor produce meaningful
+    logits (their rows are garbage by contract; the engine discards
+    them). Returns ``(logits (B, vocab) fp32, updated pool)``.
+
+    Every op mirrors ``TransformerLM.__call__`` with ``decode=True`` on
+    a 1-token input — pinned bitwise against that path in
+    tests/test_serve_decode.py.
+    """
+    h = spec.heads
+    scale = 1.0 / math.sqrt(spec.head_dim)
+    page = pool.page
+    num_pages = pool.num_pages
+    seq_lens = jnp.where(active, positions + 1, 0).astype(jnp.int32)
+    # page/row of the incoming token; dead slots route out of range so
+    # the page scatter drops them
+    pid = jnp.take_along_axis(
+        block_tables, (positions[:, None] // page), axis=1)[:, 0]
+    pid = jnp.where(active, pid, num_pages).astype(jnp.int32)
+    off = (positions % page).astype(jnp.int32)
+
+    emb_table = params["tok_emb"]["embedding"]
+    x = jnp.take(emb_table, tokens[:, None], axis=0)      # (B, 1, E)
+    pos_table = params["pos_emb"]["embedding"]
+    x = x + jnp.take(pos_table, positions[:, None], axis=0)
+
+    new_k, new_v = list(pool.k), list(pool.v)
+    for i in range(spec.layers):
+        p = params[f"block_{i}"]
+        y = _ln(x, p["ln1"])
+        qkv = _dense(y, p["attn"]["in_proj"])             # (B, 1, 3E)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = _split_heads(q, h)                            # (B, H, 1, D)
+        k = _split_heads(k, h)
+        v = _split_heads(v, h)
+        kp, vp = kvcache.write_token(
+            new_k[i], new_v[i], k[:, :, 0], v[:, :, 0], pid, off)
+        new_k[i], new_v[i] = kp, vp
+        ctx = paged_decode_attention(q, kp, vp, block_tables, seq_lens,
+                                     scale=scale)
+        a = _dense(_merge_heads(ctx).astype(x.dtype),
+                   p["attn"]["out_proj"])
+        x = x + a
+        y = _ln(x, p["ln2"])
+        m = jax.nn.gelu(_dense(y, p["fc1"]))
+        x = x + _dense(m, p["fc2"])
+
+    x = _ln(x, params["ln_f"])
+    if spec.tie_embeddings:
+        # flax Embed.attend: promote then dot against the table^T
+        dt = jnp.result_type(x.dtype, emb_table.dtype)
+        logits = jnp.dot(x.astype(dt), emb_table.astype(dt).T)
+    else:
+        logits = _dense(x, params["head"])
+    return logits[:, 0].astype(jnp.float32), kvcache.KVPool(
+        k=tuple(new_k), v=tuple(new_v))
+
+
+def prefill(params, spec: ModelSpec, prompt: jax.Array,
+            length: jax.Array, pool: kvcache.KVPool,
+            block_row: jax.Array
+            ) -> Tuple[jax.Array, jax.Array, kvcache.KVPool]:
+    """Prefill ONE request: run the model's own fresh-cache decode apply
+    over the padded prompt (this takes the existing causal flash
+    forward — see SelfMultiheadAttn's fresh-prefill path), scatter the
+    resulting dense prompt K/V into the request's pages, and return
+    ``(logits_at_last_valid (vocab,) fp32, first_token, updated pool)``.
+
+    ``prompt``: (S_max,) int32 padded to the engine's static prompt
+    width (one compile regardless of true length — trailing padding is
+    causally invisible to the valid prefix). ``length``: scalar int32
+    true prompt length. ``block_row``: (pages_per_slot,) page list.
+    """
+    s_max = prompt.shape[0]
+    dec = spec.model(decode=True, decode_max_len=s_max, dropout=0.0,
+                     decode_impl="einsum")
+    logits, vs = dec.apply({"params": params}, prompt[None],
+                           mutable=["cache"])
+    last = logits[0, length - 1].astype(jnp.float32)      # (vocab,)
+    first_token = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    new_k, new_v = list(pool.k), list(pool.v)
+    cache = vs["cache"]
+    for i in range(spec.layers):
+        ck = cache[f"block_{i}"]["attn"]["cached_key"][0]    # (H, S, D)
+        cv = cache[f"block_{i}"]["attn"]["cached_value"][0]
+        new_k[i], new_v[i] = kvcache.write_prompt(
+            new_k[i], new_v[i], ck, cv, block_row, length)
+    return last, first_token, kvcache.KVPool(k=tuple(new_k),
+                                             v=tuple(new_v))

@@ -1,0 +1,120 @@
+"""Opt-in weight quantization for serving — threaded through the amp
+cast registry.
+
+Two modes (the ``quantize=`` knob of :func:`serve.load_model`):
+
+  * ``"bf16"``: the whole param tree casts through
+    ``amp.cast_model`` with the O5 bf16 Properties — EXACTLY the cast
+    the training stack's opt levels use, so serving inherits amp's
+    variables-dict handling and batchnorm policy rather than growing a
+    second cast implementation.
+  * ``"int8"``: per-channel symmetric weight quantization of the matmul
+    kernels (scale = amax over the input fan-in per OUTPUT channel /
+    127). The int8 payload + fp32 scales are what a TPU deployment
+    keeps resident (halving weight HBM vs bf16); this CPU-backed stack
+    dequantizes back to the compute dtype at load (simulated storage —
+    the forward then exercises the exact dequantized values a fused
+    int8 matmul would see, which is what the parity tests pin).
+    Quantization error is bounded per element by ``scale/2`` (round to
+    nearest), asserted by tests/test_serve_loader.py.
+
+Non-kernel leaves (biases, layer norms, embeddings) stay in their
+checkpoint dtype under int8 — the embed table is a gather (no MXU win)
+and norms are fp32 by repo convention.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import amp
+
+MODES = ("bf16", "int8")
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantReport:
+    """What the quantization pass did — surfaced by the serve CLI and
+    the loader so 'quantized' is never a silent property of a server."""
+
+    mode: str
+    quantized_leaves: int
+    skipped_leaves: int
+    dense_bytes: int
+    quant_bytes: int
+    max_abs_err: float
+
+    def row(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def per_channel_int8(w: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-output-channel int8 quantization of a (.., out)
+    kernel: scale over every axis but the last. Zero channels get scale
+    1 (their quantized values are exactly 0 either way)."""
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)),
+                   axis=tuple(range(w.ndim - 1)))
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_int8(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def _is_kernel(path) -> bool:
+    keys = [getattr(k, "key", getattr(k, "name", None)) for k in path]
+    return bool(keys) and keys[-1] == "kernel"
+
+
+def quantize_params(params: Any, mode: str
+                    ) -> Tuple[Any, QuantReport]:
+    """Quantize a serving param tree. Returns ``(params, report)`` —
+    under ``"bf16"`` the tree is the amp cast output; under ``"int8"``
+    the matmul kernels are round-tripped through per-channel int8 (see
+    the module docstring for the storage contract)."""
+    if mode not in MODES:
+        raise ValueError(
+            f"quantize mode must be one of {MODES}, got {mode!r}")
+    leaves = jax.tree_util.tree_leaves_with_path(params)
+    dense_bytes = sum(v.size * v.dtype.itemsize for _, v in leaves)
+    if mode == "bf16":
+        props = amp.resolve("O5", keep_batchnorm_fp32=False)
+        out = amp.cast_model(params, props)
+        out_leaves = jax.tree_util.tree_leaves(out)
+        quant_bytes = sum(v.size * v.dtype.itemsize for v in out_leaves)
+        n_cast = sum(
+            1 for (_, a), b in zip(leaves, out_leaves)
+            if b.dtype != a.dtype)
+        err = max((float(jnp.max(jnp.abs(
+            a.astype(jnp.float32) - b.astype(jnp.float32))))
+            for (_, a), b in zip(leaves, out_leaves)), default=0.0)
+        return out, QuantReport("bf16", n_cast, len(leaves) - n_cast,
+                                dense_bytes, quant_bytes, err)
+
+    quantized = 0
+    quant_bytes = 0
+    max_err = 0.0
+
+    def one(path, v):
+        nonlocal quantized, quant_bytes, max_err
+        if v.ndim < 2 or not _is_kernel(path):
+            quant_bytes += v.size * v.dtype.itemsize
+            return v
+        q, scale = per_channel_int8(v)
+        dq = dequantize_int8(q, scale, v.dtype)
+        quantized += 1
+        quant_bytes += q.size + scale.size * scale.dtype.itemsize
+        max_err = max(max_err, float(jnp.max(jnp.abs(
+            v.astype(jnp.float32) - dq.astype(jnp.float32)))))
+        return dq
+
+    out = jax.tree_util.tree_map_with_path(one, params)
+    return out, QuantReport("int8", quantized,
+                            len(leaves) - quantized, dense_bytes,
+                            quant_bytes, max_err)

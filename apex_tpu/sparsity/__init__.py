@@ -212,6 +212,22 @@ def sparsity_ratio(params: Tree, masks: Tree) -> float:
     return zeros / max(total, 1)
 
 
+def prune_for_serving(params: Tree,
+                      pattern: Callable = m4n2_mask_1d,
+                      allowed: Callable = _default_allowed) -> Tree:
+    """One-shot dense -> 2:4 pruning for inference (the serve-loader
+    entry point, ``serve.load_model(..., prune=True)``): compute masks
+    and apply them, no optimizer wrapper — there is no training step to
+    re-mask. Non-prunable leaves (norms, biases, embeddings, dims not %
+    4) pass through untouched; every pruned kernel is exactly 2:4 along
+    its reduction dim (structure asserted by tests/test_sparsity.py's
+    serving test). TPU note per the module docstring: no hardware
+    speedup on TPU — this preserves the prune-then-serve WORKFLOW
+    (checkpoint continuity with GPU sparse deployments), not FLOPs."""
+    return apply_masks(params, compute_sparse_masks(
+        params, allowed, pattern))
+
+
 class SparseOptimizer:
     """Wraps a FusedOptimizer so each step re-applies the masks — the
     reference patches ``optimizer.step`` (asp.py hooks); here the wrapper's

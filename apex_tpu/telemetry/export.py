@@ -307,7 +307,10 @@ def summarize(events: List[Dict[str, Any]], *,
                         ("mfu", "mfu")):
         vals: List[float] = []
         for name, v in series.items():
-            if name.endswith("/" + suffix):
+            # serve/tokens_per_s is decode throughput, not a training
+            # step series — it aggregates under the serve section
+            if (name.endswith("/" + suffix)
+                    and not name.endswith("serve/" + suffix)):
                 vals.extend(v)
         if vals:
             out[key] = _series_stats(vals)
@@ -525,6 +528,48 @@ def summarize(events: List[Dict[str, Any]], *,
     stragglers = _stragglers(events, rows)
     if stragglers:
         out["stragglers"] = stragglers
+
+    # serving (producer: apex_tpu.serve) — steady-state gauges, the
+    # admission ledger, and per-request latency order statistics from
+    # the serve/ttft + serve/intertoken trace spans. Reported only when
+    # a serve producer ran; the gauges reuse the same NaN-aware
+    # _series_stats as training series.
+    srv: Dict[str, Any] = {}
+    for suffix, key in (("serve/queue_depth", "queue_depth"),
+                        ("serve/occupancy", "occupancy"),
+                        ("serve/tokens_per_s", "tokens_per_s")):
+        vals = [v for name, vs in series.items()
+                if name.endswith(suffix) for v in vs]
+        if vals:
+            srv[key] = _series_stats(vals)
+    for cname, key in (("serve/admitted", "admitted"),
+                       ("serve/rejected", "rejected"),
+                       ("serve/expired", "expired"),
+                       ("serve/completed", "completed"),
+                       ("serve/tokens", "tokens")):
+        total = sum(v for n, v in counters.items() if n.endswith(cname))
+        if total:
+            srv[key] = int(total)
+    # shed-reason breakdown: serve/rejected carries the admission
+    # controller's reason in meta, so an operator can tell queue
+    # pressure (queue_full) from SLO shedding (deadline) from
+    # malformed traffic (too_large) without re-reading the stream
+    reasons: Dict[str, int] = collections.defaultdict(int)
+    for e in events:
+        if (e.get("kind") == "counter"
+                and e.get("name", "").endswith("serve/rejected")):
+            reason = (e.get("meta") or {}).get("reason")
+            if reason:
+                reasons[str(reason)] += int(e["value"])
+    if reasons:
+        srv["rejected_by_reason"] = dict(reasons)
+    for fam, key in (("serve/ttft", "ttft_s"),
+                     ("serve/intertoken", "intertoken_s")):
+        durs = [r["dur_s"] for r in rows if r["family"] == fam]
+        if durs:
+            srv[key] = _series_stats(durs)
+    if srv:
+        out["serve"] = srv
 
     # numerics health (producers: telemetry.health)
     health = _health_section(events, series, detect_kwargs=health_detect)
@@ -1009,6 +1054,36 @@ def format_summary(s: Dict[str, Any]) -> str:
                 f" total {st['total_s'] * 1e3:9.2f} ms"
                 f"   mean {st['mean'] * 1e3:8.3f}"
                 f"   max {st['max'] * 1e3:8.3f}")
+    if s.get("serve"):
+        sv = s["serve"]
+        lines.append("serving (apex_tpu.serve):")
+        ledger = [f"{k} {sv[k]}" for k in
+                  ("admitted", "completed", "rejected", "expired",
+                   "tokens") if k in sv]
+        if ledger:
+            lines.append("  " + "   ".join(ledger))
+        if sv.get("rejected_by_reason"):
+            lines.append("  shed reasons: " + ", ".join(
+                f"{r}={n}" for r, n in
+                sorted(sv["rejected_by_reason"].items())))
+        for key, label, scale, unit in (
+                ("ttft_s", "ttft", 1e3, "ms"),
+                ("intertoken_s", "inter-token", 1e3, "ms")):
+            t = sv.get(key)
+            if t:
+                lines.append(
+                    f"  {label:<12} n={t['count']:<5}"
+                    f" p50 {t['p50'] * scale:9.2f} {unit}"
+                    f"   p99 {t['p99'] * scale:9.2f}"
+                    f"   max {t['max'] * scale:9.2f}")
+        for key, label in (("queue_depth", "queue depth"),
+                           ("occupancy", "occupancy"),
+                           ("tokens_per_s", "tokens/s")):
+            t = sv.get(key)
+            if t:
+                lines.append(f"  {label:<12} mean {t['mean']:9.2f}"
+                             f"   p50 {t['p50']:9.2f}"
+                             f"   max {t['max']:9.2f}")
     if s.get("reconciliation"):
         rc = s["reconciliation"]
         res_pct = rc.get("residual_pct")

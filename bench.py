@@ -435,6 +435,10 @@ def main():
         # measured step time tracks the model's error across rounds);
         # null when off — rows stay schema-comparable
         "plan": None,
+        # serving throughput/latency (benchmarks/serve_bench.py writes
+        # the full SERVE_r*.json row; this training-bench row never
+        # measures serving itself) — null keeps the schema stable
+        "serve": None,
     }
     if trace_on:
         # the wall-vs-device gap, itemized: top host span families by

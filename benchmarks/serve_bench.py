@@ -1,0 +1,159 @@
+"""Serving benchmark harness (ISSUE 17) — SERVE_r*.json trajectory
+rows, the serving counterpart of the driver's BENCH_r*.json.
+
+Runs :func:`apex_tpu.serve.bench.run_bench` (continuous-batching engine
+over the paged KV cache) and writes one JSON row: steady-state decode
+tokens/s, p50/p99 time-to-first-token and inter-token latency, and the
+2x-overload admission ledger (admitted / rejected / expired / goodput).
+
+Model source, in preference order:
+
+* ``--snapshot-dir DIR`` — a SnapshotManager directory (train one with
+  ``examples/gpt/train_lm.py --snapshot-dir DIR``); exercises the full
+  ``serve.load_model`` arc including manifest spec recovery.
+* otherwise an in-memory randomly-initialized model at the ``--vocab/
+  --layers/--embed-dim/--heads/--seq-len`` shape — throughput numbers
+  are identical (decode cost does not depend on the weights' values),
+  only the loader arc is skipped.
+
+Usage::
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python benchmarks/serve_bench.py [--snapshot-dir DIR] \
+        [--requests 50] [--quantize int8] [--out SERVE_r07.json]
+
+Exit 0 on a completed run (row written), 1 on a load/bench error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS", "cpu").strip().lower() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+
+
+def _next_round_path() -> str:
+    """SERVE_r<NN>.json in the repo root, numbered after the newest
+    existing row — same trajectory convention as BENCH_r*.json."""
+    rounds = [0]
+    for p in glob.glob(os.path.join(_ROOT, "SERVE_r*.json")):
+        m = re.match(r"SERVE_r(\d+)\.json$", os.path.basename(p))
+        if m:
+            rounds.append(int(m.group(1)))
+    return os.path.join(_ROOT, f"SERVE_r{max(rounds) + 1:02d}.json")
+
+
+def _in_memory(args):
+    """A LoadedModel without a checkpoint: fresh init at the requested
+    shape. Decode throughput is weight-value-independent, so the row is
+    representative; ``generation=-1`` marks the skipped loader arc."""
+    from apex_tpu.serve.loader import LoadedModel
+    from apex_tpu.serve.model import ModelSpec
+    spec = ModelSpec(vocab=args.vocab, layers=args.layers,
+                     embed_dim=args.embed_dim, heads=args.heads,
+                     max_seq=args.seq_len)
+    model = spec.model()
+    toks = jnp.zeros((1, min(spec.max_seq, 128)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(args.seed), toks)["params"]
+    if args.quantize:
+        from apex_tpu.serve.quant import quantize_params
+        params, report = quantize_params(params, args.quantize)
+    else:
+        report = None
+    pruned = False
+    if args.prune:
+        from apex_tpu import sparsity
+        params = sparsity.prune_for_serving(params)
+        pruned = True
+    return LoadedModel(model=model, params=params, spec=spec, step=0,
+                       generation=-1, manifest={},
+                       directory="<in-memory>", quant=report,
+                       pruned=pruned)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="serving benchmark -> SERVE_r*.json")
+    p.add_argument("--snapshot-dir", default=None, metavar="DIR")
+    p.add_argument("--requests", type=int, default=50)
+    p.add_argument("--prompt-len", type=int, default=8)
+    p.add_argument("--max-new", type=int, default=8)
+    p.add_argument("--max-batch", type=int, default=4)
+    p.add_argument("--page", type=int, default=16)
+    p.add_argument("--in-flight", type=int, default=2)
+    p.add_argument("--deadline-s", type=float, default=30.0)
+    p.add_argument("--no-overload", action="store_true",
+                   help="skip the 2x-overload shedding phase")
+    p.add_argument("--quantize", default=None, choices=["bf16", "int8"])
+    p.add_argument("--prune", action="store_true",
+                   help="one-shot 2:4 prune before serving")
+    p.add_argument("--seed", type=int, default=0)
+    # in-memory model shape (ignored with --snapshot-dir)
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--embed-dim", type=int, default=128)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--out", default=None, metavar="PATH",
+                   help="row path (default: next SERVE_r*.json)")
+    args = p.parse_args(argv)
+
+    from apex_tpu import serve
+    try:
+        if args.snapshot_dir:
+            loaded = serve.load_model(args.snapshot_dir,
+                                      quantize=args.quantize,
+                                      prune=args.prune)
+        else:
+            loaded = _in_memory(args)
+    except (ValueError, NotImplementedError, OSError) as e:
+        print(f"serve_bench: {e}", file=sys.stderr)
+        return 1
+
+    try:
+        report = serve.bench.run_bench(
+            loaded, requests=args.requests, prompt_len=args.prompt_len,
+            max_new=args.max_new, max_batch=args.max_batch,
+            page=args.page, in_flight=args.in_flight,
+            overload=not args.no_overload, deadline_s=args.deadline_s,
+            seed=args.seed)
+    except ValueError as e:
+        print(f"serve_bench: {e}", file=sys.stderr)
+        return 1
+
+    out_path = args.out or _next_round_path()
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    st = report["steady"]
+    print(f"serve_bench: {st['tokens_per_s']:.1f} tokens/s "
+          f"(ttft p50 {st['ttft_ms']['p50']:.1f} ms, p99 "
+          f"{st['ttft_ms']['p99']:.1f} ms; inter-token p50 "
+          f"{st['intertoken_ms']['p50']:.2f} ms)")
+    ov = report.get("overload")
+    if ov:
+        print(f"serve_bench: overload {ov['requests']} reqs -> "
+              f"admitted {ov['admitted']}, rejected {ov['rejected']}, "
+              f"goodput {ov['goodput']:.2f}")
+    print(f"row -> {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

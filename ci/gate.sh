@@ -12,7 +12,7 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "== 1/19 package import =="
+echo "== 1/20 package import =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import apex_tpu
@@ -20,7 +20,7 @@ from apex_tpu import amp, optimizers, parallel, ops
 print('apex_tpu imports OK')
 "
 
-echo "== 2/19 native host runtime builds (g++ -O3 -shared) =="
+echo "== 2/20 native host runtime builds (g++ -O3 -shared) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 from apex_tpu import runtime
@@ -35,7 +35,7 @@ print('flatten/unflatten path OK')
 assert ok, 'host runtime failed to build — check g++ toolchain'
 "
 
-echo "== 3/19 graft entry compiles (single-device + 8-device dryrun) =="
+echo "== 3/20 graft entry compiles (single-device + 8-device dryrun) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import __graft_entry__ as ge
@@ -45,7 +45,7 @@ print('entry() compiles')
 ge.dryrun_multichip(8)
 "
 
-echo "== 4/19 package install (wheel build + clean --target install) =="
+echo "== 4/20 package install (wheel build + clean --target install) =="
 # The reference gates on Docker extension builds
 # (tests/docker_extension_builds/run.sh); the TPU analog: build the wheel
 # from pyproject.toml, install it into an empty --target dir, and import
@@ -88,7 +88,7 @@ jax.jit(step).lower(params, state).compile()
 print('installed-package train step compiles')
 ")
 
-echo "== 5/19 lint (apex_tpu.lint: trace safety / dtype policy / collectives / SPMD / mem) =="
+echo "== 5/20 lint (apex_tpu.lint: trace safety / dtype policy / collectives / SPMD / mem) =="
 # static gate BEFORE the test tier: AST pass over the package + graft
 # entry, jaxpr pass over the registered entry points, SPMD verifier
 # (APX2xx) and mem verifier (APX3xx) over the same lowerings, with
@@ -99,7 +99,7 @@ echo "== 5/19 lint (apex_tpu.lint: trace safety / dtype policy / collectives / S
 python -m apex_tpu.lint apex_tpu/ __graft_entry__.py --strict --spmd \
     --mem --mem-baseline ci/mem_baseline.json
 
-echo "== 6/19 spmd verifier (builtin-entry sweep + committed deadlock fixture) =="
+echo "== 6/20 spmd verifier (builtin-entry sweep + committed deadlock fixture) =="
 # the whole-program SPMD gate, at the API layer: every registered entry
 # (ddp / zero / overlap / trainer-built / fused kernels / graft) must
 # verify clean, AND the analyzer must still catch the canonical
@@ -144,7 +144,7 @@ print('static donation == runtime DonationReport '
       f'({sd.aliased}/{sd.declared} aliased)')
 "
 
-echo "== 7/19 mem verifier (builtin-entry sweep + APX307 doctored-baseline regression gate) =="
+echo "== 7/20 mem verifier (builtin-entry sweep + APX307 doctored-baseline regression gate) =="
 # the peak-HBM/live-range gate, at the API layer: every registered
 # entry must verify clean against the COMMITTED per-entry baseline
 # (ci/mem_baseline.json — re-baseline deliberately with
@@ -180,7 +180,7 @@ print('APX307 gate OK: doctored +20%% baseline fails naming all '
       '%d entries' % len(named))
 "
 
-echo "== 8/19 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
+echo "== 8/20 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
 # A 3-step instrumented GPT train step on the CPU mesh must produce a
 # parseable JSONL carrying step timing, amp loss-scale/overflow, comm
 # bytes and MFU, and the summarize CLI must render it (exit 0) — the
@@ -253,7 +253,7 @@ fi
 echo "health CLI gate OK (healthy=0, injected-NaN=nonzero)"
 rm -rf "$(dirname "$HLT_FILE")"
 
-echo "== 9/19 tune smoke (sweep dry-run + auto-policy tuned train) =="
+echo "== 9/20 tune smoke (sweep dry-run + auto-policy tuned train) =="
 # The autotuner must be drivable offline (sweep plan renders, exit 0) and
 # inline: a 3-step train whose kernels resolve their configs through
 # apex_tpu.tune under APEX_TPU_TUNE=auto. On this CPU backend measurement
@@ -330,7 +330,7 @@ print(f'tune smoke OK: {len(tuned)} tune/* series, '
 " "$TUNE_DIR/tune_run.jsonl" "$TUNE_DIR/cache"
 rm -rf "$TUNE_DIR"
 
-echo "== 10/19 resilience smoke (snapshot -> injected kill -> auto-resume) =="
+echo "== 10/20 resilience smoke (snapshot -> injected kill -> auto-resume) =="
 # Kill-and-resume end to end: a 6-step train snapshotting every 2 steps is
 # SIGKILLed by the fault injector at the top of step 4 (exit 137 — an
 # abrupt death, no final snapshot), then the SAME command with --resume
@@ -387,7 +387,7 @@ python -m apex_tpu.telemetry summarize "$RES_DIR/resume.jsonl" \
     || { echo "summarize did not report the resume point" >&2; exit 1; }
 rm -rf "$RES_DIR"
 
-echo "== 11/19 overlap smoke (staged backward + bf16 wire vs fp32 baseline) =="
+echo "== 11/20 overlap smoke (staged backward + bf16 wire vs fp32 baseline) =="
 # The overlap engine end to end on the 8-device CPU mesh: a 3-step fp32
 # baseline train and the same train under --overlap --reduce-dtype bf16
 # must (a) land within 1e-2 of each other's final loss (the compression
@@ -443,7 +443,7 @@ python -m apex_tpu.telemetry summarize "$OVL_DIR/bf16.jsonl" \
     || { echo "summarize did not render overlap efficiency" >&2; exit 1; }
 rm -rf "$OVL_DIR"
 
-echo "== 12/19 profile smoke (capture -> attribution report -> compare gate) =="
+echo "== 12/20 profile smoke (capture -> attribution report -> compare gate) =="
 # The attribution profiler end to end on the CPU backend: a 3-step train
 # with --profile must produce a capture logdir whose offline report
 # parses with nonzero compute time and carries the named
@@ -504,7 +504,7 @@ fi
 echo "compare gate OK (identical=0, doctored-slower=4)"
 rm -rf "$PROF_DIR"
 
-echo "== 13/19 trace smoke (host spans -> unified timeline -> merge/stragglers) =="
+echo "== 13/20 trace smoke (host spans -> unified timeline -> merge/stragglers) =="
 # The host-tracing layer end to end: a 3-step --trace train must emit
 # parseable span/* begin/end pairs, the unified host+device timeline
 # must export as valid Chrome-trace JSON with BOTH lanes populated,
@@ -577,7 +577,7 @@ grep -q "worst: p" "$TRC_DIR/merged.txt" \
 echo "trace smoke OK (spans + timeline + reconciliation + 2-process merge)"
 rm -rf "$TRC_DIR"
 
-echo "== 14/19 trainer smoke (compiled-step builder: pipelined dispatch + donation audit) =="
+echo "== 14/20 trainer smoke (compiled-step builder: pipelined dispatch + donation audit) =="
 # The compiled trainer end to end: a 3-step train_lm built through
 # apex_tpu.trainer with telemetry+trace on must (a) emit balanced
 # span/* begin/end pairs (the in-flight window's trainer/retire spans
@@ -622,7 +622,7 @@ grep -q "donation audit: .* 0 refused" "$TRN_DIR/out.txt" \
     || { echo "train_lm did not print the donation audit" >&2; exit 1; }
 rm -rf "$TRN_DIR"
 
-echo "== 15/19 fused-kernel regression (Pallas xentropy vs unfused + epilogue/mt scopes) =="
+echo "== 15/20 fused-kernel regression (Pallas xentropy vs unfused + epilogue/mt scopes) =="
 # The fused-kernel tier end to end (docs/kernels.md): the SAME 3-step GPT
 # train profiled unfused and fused (Pallas xentropy in the loss scope)
 # must (a) surface the apex_xentropy scope in the fused breakdown,
@@ -723,7 +723,7 @@ print('conv epilogue + mt flat: parity + capture scopes OK')
 echo "fused-kernel gate OK (scopes + parity + compare exit 0)"
 rm -rf "$KRN_DIR"
 
-echo "== 16/19 elastic smoke (2-process node_loss -> re-shard resume at world 1) =="
+echo "== 16/20 elastic smoke (2-process node_loss -> re-shard resume at world 1) =="
 # Elastic membership end to end (docs/resilience.md "Elastic
 # membership"): a 2-member ZeRO fleet under the multiproc --elastic
 # supervisor loses rank 1 to an injected node_loss SIGKILL at step 3;
@@ -785,7 +785,7 @@ python -m apex_tpu.resilience inspect "$ELA_DIR/snap-r0" --check 1 \
          exit 1; }
 rm -rf "$ELA_DIR"
 
-echo "== 17/19 rebalance smoke (slow_node straggler -> weighted re-shard -> exit-75 eviction -> world 1) =="
+echo "== 17/20 rebalance smoke (slow_node straggler -> weighted re-shard -> exit-75 eviction -> world 1) =="
 # Heterogeneity-aware rebalancing end to end (docs/resilience.md
 # "Rebalancing"): rank 1 is an injected straggler (slow_node: +250 ms
 # on every step >= 2 while the base step is ~60 ms). The degradation
@@ -865,7 +865,7 @@ grep -q "straggler detected" "$RB_DIR/summary.out" \
          cat "$RB_DIR/summary.out" >&2; exit 1; }
 rm -rf "$RB_DIR"
 
-echo "== 18/19 plan smoke (auto ranked table -> lint-clean pick -> 3-step train) =="
+echo "== 18/20 plan smoke (auto ranked table -> lint-clean pick -> 3-step train) =="
 # The parallelism planner end to end (docs/plan.md): `plan auto` on the
 # GPT example shape over the 8-device CPU mesh must produce a parseable
 # ranked candidate table, the top pick must pass lint.spmd clean (the
@@ -955,7 +955,52 @@ else:
 PY
 rm -rf "$PLAN_DIR"
 
-echo "== 19/19 pytest =="
+echo "== 19/20 serve smoke (train snapshot -> paged continuous-batching bench -> shed gate) =="
+# The serving stack end to end (docs/serve.md): train a tiny LM to a
+# final snapshot (the manifest records the model spec for the serve
+# loader), run the serve CLI bench (50 requests over the 8-device CPU
+# mesh) against it with telemetry, and assert the honest-service
+# invariants: every steady request completes, the 2x-overload phase
+# really sheds (rejected > 0), the latency percentiles are finite, and
+# the serve/* events render a summarize section. A final run piped into
+# `head` exercises the CLI's BrokenPipeError guard.
+SERVE_DIR="$(mktemp -d)"
+python examples/gpt/train_lm.py --steps 3 --vocab 64 --layers 2 \
+    --embed-dim 64 --heads 4 --seq-len 64 --batch 8 \
+    --snapshot-dir "$SERVE_DIR/ckpt" > "$SERVE_DIR/train.out"
+python -m apex_tpu.serve bench --snapshot-dir "$SERVE_DIR/ckpt" \
+    --requests 50 --prompt-len 8 --max-new 8 --max-batch 4 --page 16 \
+    --telemetry "$SERVE_DIR/serve.jsonl" > "$SERVE_DIR/serve.json"
+python - "$SERVE_DIR" <<'PY'
+import json, math, sys
+d = sys.argv[1]
+row = json.loads(open(d + "/serve.json").read())
+st = row["steady"]
+assert st["requests"] == 50 and st["completed"] == 50, st
+assert st["tokens"] == 50 * 8 and st["tokens_per_s"] > 0, st
+for phase in ("ttft_ms", "intertoken_ms"):
+    for pct in ("p50", "p99"):
+        assert math.isfinite(st[phase][pct]), (phase, st[phase])
+ov = row["overload"]
+assert ov["requests"] == 100 and ov["rejected"] > 0, ov
+assert ov["admitted"] + ov["rejected"] == 100, ov
+assert 0.0 <= ov["goodput"] <= 1.0, ov
+print(f"serve bench OK: {st['tokens_per_s']:.1f} tok/s steady, "
+      f"overload rejected {ov['rejected']}/100, "
+      f"goodput {ov['goodput']:.2f}")
+PY
+python -m apex_tpu.telemetry summarize "$SERVE_DIR/serve.jsonl" \
+    > "$SERVE_DIR/summary.out"
+grep -q "serving (apex_tpu.serve):" "$SERVE_DIR/summary.out"
+grep -q "shed reasons: queue_full=" "$SERVE_DIR/summary.out"
+# early-closing reader (pipe into head) must still exit 0
+python -m apex_tpu.serve bench --snapshot-dir "$SERVE_DIR/ckpt" \
+    --requests 4 --prompt-len 4 --max-new 2 --no-overload \
+    2>/dev/null | head -c 64 > /dev/null
+echo "serve smoke OK (bench + shed + summarize + pipe guard)"
+rm -rf "$SERVE_DIR"
+
+echo "== 20/20 pytest =="
 if [[ "${1:-}" == "--full" ]]; then
     # full suite + the complete L1 cross-product matrix (reference
     # tests/L1/cross_product{,_distributed}/run.sh); the convergence
@@ -974,7 +1019,10 @@ else
         tests/test_overlap.py \
         tests/test_trainer.py tests/test_kernels.py \
         tests/test_pyprof.py tests/test_trace.py \
-        tests/test_plan.py tests/test_lint_mem.py -q -x
+        tests/test_plan.py tests/test_lint_mem.py \
+        tests/test_serve_kvcache.py tests/test_serve_decode.py \
+        tests/test_serve_engine.py tests/test_serve_loader.py \
+        tests/test_serve_cli.py tests/test_plan_objective.py -q -x
 fi
 
 echo "CI GATE PASSED"

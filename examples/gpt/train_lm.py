@@ -634,7 +634,18 @@ def main(argv=None):
         handle_signals=manager is not None,
         deadline_s=args.preempt_deadline,
         extra={"seed": args.seed, "opt_level": args.opt_level,
-               "seq_len": args.seq_len, "batch": batch},
+               "seq_len": args.seq_len, "batch": batch,
+               # model dimensions for apex_tpu.serve.load_model — the
+               # serving loader rebuilds the snapshot's exact param
+               # structure from this dict (docs/serve.md); the feature
+               # flags let it reject unsupported configurations before
+               # any payload materializes
+               "model": {"vocab": args.vocab, "layers": args.layers,
+                         "embed_dim": args.embed_dim,
+                         "heads": args.heads, "max_seq": args.seq_len,
+                         "mlp_ratio": 4, "moe": bool(args.moe),
+                         "relative_bias": bool(args.relative_bias),
+                         "alibi": bool(args.alibi)}},
         on_step=on_step,
         on_resume=on_resume)
     params, opt_state = result.state

@@ -188,6 +188,28 @@ def test_asp_workflow_and_checkpoint():
         np.asarray(asp.masks["dense"]["kernel"]))
 
 
+def test_prune_for_serving_one_shot():
+    """The serving entry point: one-shot dense -> 2:4, no optimizer/
+    workflow state — masked kernels keep <= 2 of 4 along the last
+    axis, non-kernel leaves come back bitwise."""
+    params = {"dense": {"kernel": jax.random.normal(jax.random.PRNGKey(9),
+                                                    (16, 8)),
+                        "bias": jnp.ones((8,))},
+              "norm": {"scale": jnp.ones((8,))}}
+    pruned = sparsity.prune_for_serving(params)
+    k = np.asarray(pruned["dense"]["kernel"])
+    assert (k == 0).mean() == 0.5
+    groups = (k.reshape(-1) != 0).reshape(-1, 4)
+    assert (groups.sum(axis=1) == 2).all()
+    # surviving weights are the dense values, not rescaled
+    dense = np.asarray(params["dense"]["kernel"])
+    assert (k[k != 0] == dense[k != 0]).all()
+    np.testing.assert_array_equal(np.asarray(pruned["dense"]["bias"]),
+                                  1.0)
+    np.testing.assert_array_equal(np.asarray(pruned["norm"]["scale"]),
+                                  1.0)
+
+
 # ---------------------------------------------------------------------------
 # pyprof
 # ---------------------------------------------------------------------------

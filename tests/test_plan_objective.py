@@ -1,0 +1,100 @@
+"""plan objective=p99_decode tests (ISSUE 17): the serving objective
+ranks by modeled per-token decode latency (memory-bound roofline — the
+axis algebra flips vs training throughput), and the flip is pinned on a
+shape where the two objectives disagree."""
+
+import pytest
+
+from apex_tpu import plan as _plan
+from apex_tpu.plan import cost as _cost
+from apex_tpu.plan import get_adapter
+from apex_tpu.plan.search import (Constraints, enumerate_candidates,
+                                  prune, rank)
+
+# a serving-sized shape: big vocab + embed makes decode weight-read
+# bound, so tensor parallelism (divides the weight bytes each token
+# must stream) beats pure data parallelism (which only helps batch
+# throughput) on the decode clock
+SHAPE = dict(vocab=32000, layers=8, embed=1024, heads=16, batch=16,
+             seq=512)
+
+
+@pytest.fixture(scope="module")
+def verdicts():
+    ad = get_adapter("gpt", **SHAPE)
+    desc = ad.describe(compile_reference=False)
+    cons = Constraints(validate="none", hbm_bytes=float(1 << 40))
+    cands = enumerate_candidates(8, desc, cons)
+    return prune(cands, desc, adapter=ad, constraints=cons), desc
+
+
+def test_objective_flips_the_pick(verdicts):
+    """The acceptance pin: at this shape on 8 devices the two
+    objectives choose DIFFERENT layouts — throughput wants data
+    parallelism, p99_decode wants the weight stream divided."""
+    vs, _ = verdicts
+    thr = [v for v in rank(vs, "throughput") if v.feasible]
+    dec = [v for v in rank(vs, "p99_decode") if v.feasible]
+    assert thr and dec
+    thr_pick = thr[0].layout.layout_id()
+    dec_pick = dec[0].layout.layout_id()
+    assert thr_pick != dec_pick
+    assert thr[0].layout.dp == 8          # pure data parallel wins tput
+    assert dec[0].layout.tp > 1           # decode wants tensor parallel
+
+
+def test_rank_orders_by_decode_latency(verdicts):
+    vs, _ = verdicts
+    dec = [v for v in rank(vs, "p99_decode") if v.feasible]
+    times = [v.decode_s for v in dec]
+    assert all(t is not None for t in times)
+    assert times == sorted(times)
+
+
+def test_decode_model_monotone_in_tp(verdicts):
+    """More tensor parallelism streams fewer weight bytes per token —
+    decode_step_s must fall from tp=1 to tp=2 at fixed dp=1 (the
+    memory-bound regime this shape sits in)."""
+    vs, desc = verdicts
+    by_id = {v.layout.layout_id(): v for v in vs if v.feasible}
+    t1 = _cost.decode_step_s(desc, by_id["dp1-tp8"].layout)
+    t0 = _cost.decode_step_s(desc, by_id["dp8"].layout)
+    assert t1 < t0
+
+
+def test_verdict_row_carries_decode_ms(verdicts):
+    vs, _ = verdicts
+    row = next(v for v in vs if v.feasible).row()
+    assert "decode_ms" in row
+    assert row["decode_ms"] is not None and row["decode_ms"] > 0
+
+
+def test_constraints_validates_objective():
+    assert Constraints(objective="p99_decode").objective == "p99_decode"
+    assert Constraints().objective == "throughput"
+    with pytest.raises(ValueError, match="objective"):
+        Constraints(objective="latency")
+
+
+def test_auto_honors_objective():
+    """plan.auto end-to-end (validate='none' keeps it analytic): the
+    emitted pick follows the constraint's objective."""
+    ad = get_adapter("gpt", **SHAPE)
+    picks = {}
+    for obj in ("throughput", "p99_decode"):
+        p = _plan.auto(ad, n_devices=8,
+                       constraints=Constraints(
+                           validate="none", objective=obj,
+                           hbm_bytes=float(1 << 40)),
+                       write_cache=False, compile_reference=False)
+        picks[obj] = p.layout_id
+    assert picks["throughput"] != picks["p99_decode"]
+
+
+def test_cli_objective_flag():
+    from apex_tpu.plan.cli import build_parser
+    args = build_parser().parse_args(
+        ["auto", "--objective", "p99_decode", "--validate", "none"])
+    assert args.objective == "p99_decode"
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["auto", "--objective", "qps"])

@@ -1,0 +1,112 @@
+"""``python -m apex_tpu.serve`` CLI contract: exit 0 on a healthy
+bench run (one JSON row on stdout, progress on stderr), exit 2 on
+usage errors, exit 1 on bad input (missing snapshot dir); plus the
+serve/* telemetry arc into the summarize section."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu import amp, optimizers
+from apex_tpu.resilience.snapshot import SnapshotManager
+from apex_tpu.serve.cli import main
+from apex_tpu.serve.model import ModelSpec
+
+MODEL_MD = {"vocab": 31, "layers": 1, "embed_dim": 16, "heads": 2,
+            "max_seq": 32, "mlp_ratio": 4, "moe": False,
+            "relative_bias": False, "alibi": False}
+
+
+@pytest.fixture(autouse=True)
+def _reset_telemetry():
+    """The CLI enables telemetry/trace process-wide for --telemetry
+    runs (normally the process exits right after); in-process tests
+    must not leak that into the rest of the suite."""
+    yield
+    from apex_tpu import telemetry, trace
+    telemetry.disable()
+    trace.disable()
+    telemetry.get_collector().drain()
+
+
+@pytest.fixture(scope="module")
+def snap_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cli_snap")
+    spec = ModelSpec.from_dict(MODEL_MD)
+    model = spec.model()
+    p = model.init(jax.random.PRNGKey(0),
+                   jnp.zeros((1, 8), jnp.int32))["params"]
+    _, aopt = amp.initialize(None, optimizers.FusedAdam(lr=1e-3),
+                             opt_level="O0", verbosity=0)
+    mgr = SnapshotManager(str(d))
+    assert mgr.save((p, aopt.init(p)), step=1,
+                    extra={"opt_level": "O0", "model": MODEL_MD})
+    return str(d)
+
+
+def test_usage_error_is_exit_2(capsys):
+    with pytest.raises(SystemExit) as e:
+        main([])                      # no subcommand
+    assert e.value.code == 2
+    with pytest.raises(SystemExit) as e:
+        main(["bench"])               # missing --snapshot-dir
+    assert e.value.code == 2
+
+
+def test_bad_snapshot_dir_is_exit_1(tmp_path, capsys):
+    rc = main(["bench", "--snapshot-dir", str(tmp_path / "absent"),
+               "--requests", "1"])
+    assert rc == 1
+    cap = capsys.readouterr()
+    assert cap.out == ""              # nothing half-printed on stdout
+    assert "--snapshot-dir" in cap.err
+
+
+def test_healthy_run_json_contract(snap_dir, capsys, tmp_path):
+    tel = str(tmp_path / "serve.jsonl")
+    rc = main(["bench", "--snapshot-dir", snap_dir,
+               "--requests", "6", "--prompt-len", "4", "--max-new", "3",
+               "--max-batch", "2", "--page", "8", "--telemetry", tel])
+    assert rc == 0
+    cap = capsys.readouterr()
+    lines = [l for l in cap.out.splitlines() if l.strip()]
+    assert len(lines) == 1            # exactly one JSON row on stdout
+    report = json.loads(lines[0])
+    assert report["metric"] == "serve_tokens_per_s"
+    assert report["value"] > 0
+    st = report["steady"]
+    assert st["requests"] == 6 and st["completed"] == 6
+    assert st["tokens"] == 6 * 3
+    for key in ("p50", "p99"):
+        assert st["ttft_ms"][key] > 0
+        assert st["intertoken_ms"][key] >= 0
+    ov = report["overload"]
+    assert ov["requests"] == 12
+    assert ov["rejected"] > 0         # shedding really happened
+    assert 0.0 <= ov["goodput"] <= 1.0
+    assert "loaded step 1" in cap.err
+
+    # the telemetry arc: the JSONL renders a serve summarize section
+    from apex_tpu import telemetry
+    s = telemetry.summarize(telemetry.read_jsonl(tel))
+    srv = s["serve"]
+    assert srv["completed"] == 6 + ov["completed"]
+    assert srv["rejected"] == ov["rejected"]
+    assert srv["rejected_by_reason"]["queue_full"] == ov["rejected"]
+    assert srv["ttft_s"]["count"] >= 6
+    assert srv["intertoken_s"]["p99"] >= 0
+    assert srv["occupancy"]["max"] <= 1.0
+    text = telemetry.format_summary(s)
+    assert "serving (apex_tpu.serve):" in text
+    assert "shed reasons: queue_full=" in text
+
+
+def test_no_overload_skips_phase(snap_dir, capsys):
+    rc = main(["bench", "--snapshot-dir", snap_dir,
+               "--requests", "2", "--prompt-len", "4", "--max-new", "2",
+               "--max-batch", "2", "--page", "8", "--no-overload"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip())
+    assert report["overload"] is None

@@ -1,0 +1,213 @@
+"""Paged-decode correctness pins (ISSUE 17 tentpole).
+
+The pin chain: ``serve.model.decode_step`` (paged, jnp backend) is
+BITWISE equal to the dense-cache einsum decode path
+(``TransformerLM(decode=True, decode_impl="einsum")``) at matched batch
+shapes, per dtype, over T consecutive steps — and that dense decode
+path is itself pinned against the full-context flash forward at 2e-4
+(tests/test_gpt.py::test_decode_logits_match_full_forward). Here we
+also pin paged vs the full forward directly at the same tolerance.
+
+Matched batch shapes matter: XLA reduces a batch-1 and a batch-2
+matmul in different orders on CPU, so the dense reference runs at the
+SAME batch as the paged step (1-ulp differences otherwise — not a
+correctness signal, just reduction order).
+
+Plus: the Pallas kernel vs the jnp reference (interpret mode on CPU),
+the dead-slot zero guard, and the backend-select contract."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.serve import decode, kvcache
+from apex_tpu.serve.model import ModelSpec, decode_step, prefill
+
+VOCAB, LAYERS, EMBED, HEADS, MAX_SEQ = 97, 2, 32, 4, 32
+PAGE, PPS = 8, 4          # pages_per_slot: 4*8 = 32 token capacity
+PLEN, STEPS = 8, 4        # prefill 8, then 4 pinned decode steps
+
+
+@pytest.fixture(scope="module")
+def setup():
+    spec = ModelSpec(vocab=VOCAB, layers=LAYERS, embed_dim=EMBED,
+                     heads=HEADS, max_seq=MAX_SEQ)
+    lm = spec.model()
+    toks1 = jax.random.randint(jax.random.PRNGKey(0), (1, PLEN + STEPS),
+                               0, VOCAB)
+    toks = jnp.concatenate([toks1, toks1], 0)       # batch 2, same seq
+    params = lm.init(jax.random.PRNGKey(1), toks)["params"]
+    return spec, params, toks
+
+
+def _paged_prefill(spec, params, toks, dtype):
+    """Prefill both slots of a batch-2 paged pool; returns (pool, bt)."""
+    b = toks.shape[0]
+    pool = kvcache.create_pool(layers=spec.layers, num_pages=b * PPS,
+                               heads=spec.heads, page=PAGE,
+                               head_dim=spec.head_dim, dtype=dtype)
+    alloc = kvcache.PageAllocator(pool.num_pages)
+    bt = np.full((b, PPS), pool.num_pages, np.int32)
+    n = -(-(PLEN + STEPS) // PAGE)
+    prompt = np.zeros((16,), np.int32)
+    prompt[:PLEN] = np.asarray(toks[0, :PLEN])
+    for s in range(b):
+        bt[s, :n] = alloc.alloc(n)
+        _, _, pool = prefill(params, spec, jnp.asarray(prompt),
+                             jnp.int32(PLEN), pool, jnp.asarray(bt[s]))
+    return pool, jnp.asarray(bt)
+
+
+def _dense_reference(spec, params, toks):
+    """Per-step last-token logits from the dense-cache einsum decode —
+    the training stack's decode path, run at the SAME batch."""
+    dec = spec.model(decode=True, decode_max_len=MAX_SEQ, dropout=0.0,
+                     decode_impl="einsum")
+    _, vs = dec.apply({"params": params}, toks[:, :PLEN],
+                      mutable=["cache"])
+    cache, out = vs["cache"], []
+    for p in range(PLEN, PLEN + STEPS):
+        logits, vs = dec.apply({"params": params, "cache": cache},
+                               toks[:, p:p + 1], pos_offset=p,
+                               mutable=["cache"])
+        cache = vs["cache"]
+        out.append(logits[:, 0].astype(jnp.float32))
+    return out
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_paged_decode_bitwise_vs_dense_cache(setup, dtype):
+    """T consecutive paged decode steps == the dense-cache decode,
+    bit for bit, at matched batch shapes — per dtype."""
+    spec, params, toks = setup
+    if dtype == "bfloat16":
+        params = amp.cast_model(
+            params, amp.resolve("O5", keep_batchnorm_fp32=False))
+    kv_dtype = jnp.result_type(
+        params["tok_emb"]["embedding"].dtype,
+        params["block_0"]["attn"]["in_proj"]["kernel"].dtype)
+    pool, bt = _paged_prefill(spec, params, toks, kv_dtype)
+    refs = _dense_reference(spec, params, toks)
+    b = toks.shape[0]
+    active = jnp.ones((b,), bool)
+    for i, p in enumerate(range(PLEN, PLEN + STEPS)):
+        tokens = jnp.full((b,), int(toks[0, p]), jnp.int32)
+        positions = jnp.full((b,), p, jnp.int32)
+        logits, pool = decode_step(params, spec, pool, tokens,
+                                   positions, bt, active)
+        assert logits.dtype == jnp.float32
+        np.testing.assert_array_equal(
+            np.asarray(logits), np.asarray(refs[i]),
+            err_msg=f"paged decode diverged from the dense-cache "
+                    f"decode at position {p} ({dtype})")
+
+
+def test_paged_decode_close_to_full_forward(setup):
+    """Paged last-token logits vs the full-context flash forward at the
+    repo's decode tolerance (2e-4 — same pin as test_gpt's dense decode
+    vs full forward)."""
+    spec, params, toks = setup
+    lm = spec.model()
+    pool, bt = _paged_prefill(spec, params, toks, jnp.float32)
+    b = toks.shape[0]
+    active = jnp.ones((b,), bool)
+    for p in range(PLEN, PLEN + STEPS):
+        tokens = jnp.full((b,), int(toks[0, p]), jnp.int32)
+        positions = jnp.full((b,), p, jnp.int32)
+        logits, pool = decode_step(params, spec, pool, tokens,
+                                   positions, bt, active)
+        full = lm.apply({"params": params}, toks[:, :p + 1])
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(full[:, -1], np.float32),
+            rtol=2e-4, atol=2e-4)
+
+
+class TestPagedAttentionKernel:
+    """paged_decode_attention directly: jnp vs Pallas (interpret on
+    CPU), ragged lengths, dead slots."""
+
+    def _inputs(self, seq_lens):
+        b, h, d, pps = len(seq_lens), 4, 64, 4
+        num_pages = b * pps
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(k1, (b, h, 1, d), jnp.float32)
+        kp = jax.random.normal(k2, (num_pages, h, 16, d), jnp.float32)
+        vp = jax.random.normal(k3, (num_pages, h, 16, d), jnp.float32)
+        bt = jnp.arange(num_pages, dtype=jnp.int32).reshape(b, pps)
+        return q, kp, vp, bt, jnp.asarray(seq_lens, jnp.int32)
+
+    def test_pallas_matches_jnp(self):
+        q, kp, vp, bt, sl = self._inputs([1, 17, 64])
+        ref = decode.paged_decode_attention(q, kp, vp, bt, sl)
+        prev = decode.set_backend("pallas")
+        try:
+            out = decode.paged_decode_attention(q, kp, vp, bt, sl)
+        finally:
+            decode.set_backend(prev)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("backend", ["jnp", "pallas"])
+    def test_dead_slot_is_finite(self, backend):
+        """seq_len == 0 must produce finite output (the all-masked
+        softmax is guarded), never NaN into the shared batch."""
+        q, kp, vp, bt, sl = self._inputs([0, 17, 64])
+        prev = decode.set_backend(backend)
+        try:
+            out = decode.paged_decode_attention(q, kp, vp, bt, sl)
+        finally:
+            decode.set_backend(prev)
+        assert bool(jnp.all(jnp.isfinite(out[0])))
+        if backend == "jnp":
+            assert bool(jnp.all(out[0] == 0))
+
+    def test_rejects_multi_token_q(self):
+        q, kp, vp, bt, sl = self._inputs([4])
+        with pytest.raises(ValueError, match="1-token step"):
+            decode.paged_decode_attention(
+                jnp.concatenate([q, q], axis=2), kp, vp, bt, sl)
+
+    def test_rejects_mismatched_pool(self):
+        q, kp, vp, bt, sl = self._inputs([4])
+        with pytest.raises(ValueError, match="does not match"):
+            decode.paged_decode_attention(q, kp[:, :2], vp[:, :2],
+                                          bt, sl)
+
+
+class TestBackendSelect:
+    """The xentropy-style backend contract: set_backend override wins,
+    env value second, 'auto' -> jnp, unknown values raise loudly."""
+
+    def test_default_is_jnp(self):
+        assert decode.backend() == "jnp"
+
+    def test_set_backend_roundtrip(self):
+        prev = decode.set_backend("pallas")
+        try:
+            assert decode.backend() == "pallas"
+        finally:
+            decode.set_backend(prev)
+        assert decode.backend() == "jnp"
+
+    def test_set_backend_rejects_unknown(self):
+        with pytest.raises(ValueError, match="must be one of"):
+            decode.set_backend("cuda")
+
+    def test_env_value(self, monkeypatch):
+        monkeypatch.setattr(decode, "_FORCE", "pallas")
+        assert decode.backend() == "pallas"
+        monkeypatch.setattr(decode, "_FORCE", "auto")
+        assert decode.backend() == "jnp"
+
+    def test_env_unknown_raises(self, monkeypatch):
+        monkeypatch.setattr(decode, "_FORCE", "rocm")
+        with pytest.raises(ValueError, match="APEX_TPU_SERVE_DECODE"):
+            decode.backend()
+
+    def test_native_shapes(self):
+        assert decode.paged_native_shapes(16, 64)
+        assert decode.paged_native_shapes(32, 128)
+        assert not decode.paged_native_shapes(10, 64)
+        assert not decode.paged_native_shapes(16, 100)

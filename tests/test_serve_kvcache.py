@@ -1,0 +1,138 @@
+"""Paged KV-cache unit tests: allocator semantics (atomicity, LIFO
+determinism, double-free), page write/gather round-trips, and the
+dead-slot drop contract the engine's static shapes depend on."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.serve import kvcache
+from apex_tpu.serve.kvcache import (KVPool, PageAllocator, PoolFullError,
+                                    SlotPages, create_pool, gather_pages,
+                                    write_prompt, write_token)
+
+
+class TestPageAllocator:
+    def test_alloc_free_roundtrip(self):
+        a = PageAllocator(8)
+        assert a.free_pages == 8 and a.used_pages == 0
+        got = a.alloc(3)
+        assert len(got) == 3 and len(set(got)) == 3
+        assert a.free_pages == 5 and a.used_pages == 3
+        a.free(got)
+        assert a.free_pages == 8
+
+    def test_lifo_determinism(self):
+        """Most recently freed pages come back first — the property the
+        bitwise replay tests rely on (identical schedules allocate
+        identical page ids)."""
+        a = PageAllocator(8)
+        first = a.alloc(2)
+        a.free(first)
+        assert a.alloc(2) == list(reversed(first))
+
+    def test_alloc_atomic_on_exhaustion(self):
+        """A too-large request takes NOTHING — a partial grant would
+        leak pages when admission aborts."""
+        a = PageAllocator(4)
+        a.alloc(3)
+        before = a.free_pages
+        with pytest.raises(PoolFullError):
+            a.alloc(2)
+        assert a.free_pages == before
+
+    def test_alloc_zero_and_negative(self):
+        a = PageAllocator(2)
+        assert a.alloc(0) == []
+        with pytest.raises(ValueError):
+            a.alloc(-1)
+
+    def test_double_free_raises(self):
+        a = PageAllocator(4)
+        got = a.alloc(1)
+        a.free(got)
+        with pytest.raises(ValueError, match="double free"):
+            a.free(got)
+
+    def test_out_of_range_free_raises(self):
+        a = PageAllocator(4)
+        with pytest.raises(ValueError, match="out of range"):
+            a.free([4])
+
+    def test_invalid_pool_size(self):
+        with pytest.raises(ValueError):
+            PageAllocator(0)
+
+
+class TestPool:
+    def test_create_pool_shapes(self):
+        pool = create_pool(layers=3, num_pages=6, heads=2, page=4,
+                           head_dim=8, dtype=jnp.bfloat16)
+        assert isinstance(pool, KVPool)
+        assert pool.layers == 3
+        assert pool.num_pages == 6
+        assert pool.page == 4
+        assert pool.k[0].shape == (6, 2, 4, 8)
+        assert pool.k[0].dtype == jnp.bfloat16
+        assert pool.bytes() == 3 * 2 * 6 * 2 * 4 * 8 * 2
+
+    def test_write_token_and_dead_slot_drop(self):
+        pool = create_pool(layers=1, num_pages=4, heads=2, page=4,
+                           head_dim=8)
+        k = jnp.ones((2, 2, 8))          # (B, H, D), B=2
+        v = 2.0 * jnp.ones((2, 2, 8))
+        # slot 0 writes page 1 row 2; slot 1 is dead (id == num_pages)
+        page_ids = jnp.array([1, 4], jnp.int32)
+        offsets = jnp.array([2, 0], jnp.int32)
+        kp, vp = write_token(pool.k[0], pool.v[0], k, v, page_ids,
+                             offsets)
+        assert bool(jnp.all(kp[1, :, 2, :] == 1.0))
+        assert bool(jnp.all(vp[1, :, 2, :] == 2.0))
+        # everything else (including the dead slot's would-be target)
+        # stays zero
+        mask = jnp.ones_like(kp, bool).at[1, :, 2, :].set(False)
+        assert bool(jnp.all(jnp.where(mask, kp, 0) == 0))
+        assert bool(jnp.all(jnp.where(mask, vp, 0) == 0))
+
+    def test_write_prompt_gather_roundtrip(self):
+        """A dense (H, S, D) prompt cache scattered into pages gathers
+        back exactly, rows past `length` dropped."""
+        h, s_max, d, page = 2, 12, 8, 4
+        key = jax.random.PRNGKey(0)
+        k = jax.random.normal(key, (h, s_max, d))
+        v = jax.random.normal(jax.random.fold_in(key, 1), (h, s_max, d))
+        pool = create_pool(layers=1, num_pages=5, heads=h, page=page,
+                           head_dim=d)
+        block_row = jnp.array([3, 1, 0], jnp.int32)     # 3 pages
+        length = 9                                      # partial page 3
+        kp, vp = write_prompt(pool.k[0], pool.v[0], k, v, block_row,
+                              jnp.int32(length))
+        gk = gather_pages(kp, block_row[None])[0]       # (H, 12, D)
+        gv = gather_pages(vp, block_row[None])[0]
+        np.testing.assert_array_equal(np.asarray(gk[:, :length]),
+                                      np.asarray(k[:, :length]))
+        np.testing.assert_array_equal(np.asarray(gv[:, :length]),
+                                      np.asarray(v[:, :length]))
+        # padding rows were dropped, not written
+        assert bool(jnp.all(gk[:, length:] == 0))
+        # page 2 (never in the block row) untouched
+        assert bool(jnp.all(kp[2] == 0))
+
+    def test_gather_pages_order(self):
+        """Token t of a slot lands at row t — page lists are
+        position-ordered, masking is a plain col < seq_len."""
+        page, d = 4, 8
+        pool_k = jnp.arange(3 * 1 * page * d, dtype=jnp.float32).reshape(
+            3, 1, page, d)
+        bt = jnp.array([[2, 0]], jnp.int32)
+        g = gather_pages(pool_k, bt)
+        assert g.shape == (1, 1, 2 * page, d)
+        np.testing.assert_array_equal(np.asarray(g[0, 0, :page]),
+                                      np.asarray(pool_k[2, 0]))
+        np.testing.assert_array_equal(np.asarray(g[0, 0, page:]),
+                                      np.asarray(pool_k[0, 0]))
+
+    def test_slot_pages_capacity(self):
+        sp = SlotPages(pages=[1, 2, 3], tokens=5)
+        assert sp.capacity(16) == 48

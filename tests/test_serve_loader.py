@@ -1,0 +1,187 @@
+"""serve.load_model tests: the SnapshotManager round-trip, the
+validation ORDER (layout fingerprint and model-spec rejection both fire
+before any payload materializes), the params-only fallback, and the
+opt-in quantize/prune transforms with their parity bounds."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp, optimizers, serve
+from apex_tpu.resilience.snapshot import SnapshotManager
+from apex_tpu.serve.model import ModelSpec
+from apex_tpu.serve.quant import (dequantize_int8, per_channel_int8,
+                                  quantize_params)
+
+MODEL_MD = {"vocab": 61, "layers": 2, "embed_dim": 32, "heads": 4,
+            "max_seq": 64, "mlp_ratio": 4, "moe": False,
+            "relative_bias": False, "alibi": False}
+
+
+def _train_lm_state(opt_level="O0"):
+    """The exact (params, opt_state) structure train_lm snapshots —
+    fp32 flax init, amp model cast, amp-wrapped FusedAdam state over
+    the cast params (mirrors serve.loader._template)."""
+    spec = ModelSpec.from_dict(MODEL_MD)
+    model = spec.model()
+    p32 = model.init(jax.random.PRNGKey(0),
+                     jnp.zeros((1, 16), jnp.int32))["params"]
+    p = amp.cast_model(p32, amp.resolve(opt_level,
+                                        keep_batchnorm_fp32=False))
+    _, aopt = amp.initialize(None, optimizers.FusedAdam(lr=1e-3),
+                             opt_level=opt_level, verbosity=0)
+    return spec, p, aopt.init(p)
+
+
+def _save(tmp_path, state, *, extra=None, layout=None, step=5):
+    mgr = SnapshotManager(str(tmp_path))
+    assert mgr.save(state, step=step, layout=layout, extra=extra)
+    return str(tmp_path)
+
+
+@pytest.fixture(scope="module")
+def snap(tmp_path_factory):
+    d = tmp_path_factory.mktemp("snap")
+    spec, p, opt = _train_lm_state()
+    _save(d, (p, opt), extra={"opt_level": "O0", "model": MODEL_MD})
+    return str(d), p
+
+
+def test_roundtrip(snap):
+    d, p = snap
+    loaded = serve.load_model(d)
+    assert loaded.step == 5
+    assert loaded.spec.vocab == 61 and loaded.spec.max_seq == 64
+    assert loaded.quant is None and loaded.pruned is False
+    for a, b in zip(jax.tree_util.tree_leaves(loaded.params),
+                    jax.tree_util.tree_leaves(p)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_params_only_snapshot(tmp_path):
+    """The serve-side re-publish format (params, no optimizer state)
+    restores through the fallback template."""
+    spec, p, _ = _train_lm_state()
+    d = _save(tmp_path, p,
+              extra={"opt_level": "O0", "model": MODEL_MD})
+    loaded = serve.load_model(d)
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree_util.tree_leaves(loaded.params)[0]),
+        np.asarray(jax.tree_util.tree_leaves(p)[0]))
+
+
+def test_missing_snapshot_dir(tmp_path):
+    with pytest.raises(ValueError, match="--snapshot-dir"):
+        serve.load_model(str(tmp_path / "nope"))
+
+
+def test_missing_model_extra(tmp_path):
+    """A manifest without extra['model'] (pre-serving trainer) fails
+    with the pass-spec hint — and an explicit spec= unblocks it."""
+    spec, p, opt = _train_lm_state()
+    d = _save(tmp_path, (p, opt), extra={"opt_level": "O0"})
+    with pytest.raises(ValueError, match="pass spec="):
+        serve.load_model(d)
+    loaded = serve.load_model(d, spec=spec)
+    assert loaded.spec.vocab == 61
+
+
+def test_layout_mismatch_before_materialization(tmp_path):
+    """A wrong expected layout fails on the manifest alone — zero
+    array bytes touched (the manifest read is the only I/O)."""
+    spec, p, opt = _train_lm_state()
+    d = _save(tmp_path, (p, opt), layout={"world": 8},
+              extra={"opt_level": "O0", "model": MODEL_MD})
+    with pytest.raises(ValueError, match="layout"):
+        serve.load_model(d, layout={"world": 4})
+    # matching fingerprint loads
+    assert serve.load_model(d, layout={"world": 8}).step == 5
+
+
+def test_rejects_unsupported_features(tmp_path):
+    """Trained-in MoE is rejected at spec construction — still before
+    materialization."""
+    spec, p, opt = _train_lm_state()
+    md = dict(MODEL_MD, moe=True)
+    d = _save(tmp_path, (p, opt),
+              extra={"opt_level": "O0", "model": md})
+    with pytest.raises((ValueError, NotImplementedError), match="[Mm]o[Ee]"):
+        serve.load_model(d)
+
+
+class TestQuantization:
+    def test_bf16_is_the_amp_cast(self, snap):
+        d, p = snap
+        loaded = serve.load_model(d, quantize="bf16")
+        ref = amp.cast_model(p, amp.resolve(
+            "O5", keep_batchnorm_fp32=False))
+        for a, b in zip(jax.tree_util.tree_leaves(loaded.params),
+                        jax.tree_util.tree_leaves(ref)):
+            assert a.dtype == b.dtype
+            np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                          np.asarray(b, np.float32))
+        assert loaded.quant.mode == "bf16"
+        assert loaded.quant.quantized_leaves > 0
+        assert loaded.quant.quant_bytes < loaded.quant.dense_bytes
+
+    def test_int8_error_bound(self, snap):
+        """Per-channel symmetric round-to-nearest: every kernel element
+        within scale/2 of its dense value; non-kernel leaves bitwise."""
+        d, p = snap
+        loaded = serve.load_model(d, quantize="int8")
+
+        def check(path, dense, got):
+            keys = [getattr(k, "key", None) for k in path]
+            if keys[-1] == "kernel" and dense.ndim >= 2:
+                _, scale = per_channel_int8(dense)
+                err = jnp.abs(dense.astype(jnp.float32)
+                              - got.astype(jnp.float32))
+                assert bool(jnp.all(err <= scale * 0.5 + 1e-7))
+            else:
+                np.testing.assert_array_equal(np.asarray(dense),
+                                              np.asarray(got))
+
+        flat_d = jax.tree_util.tree_leaves_with_path(p)
+        flat_g = jax.tree_util.tree_leaves(loaded.params)
+        for (path, dense), got in zip(flat_d, flat_g):
+            check(path, dense, got)
+        assert loaded.quant.mode == "int8"
+        assert loaded.quant.max_abs_err >= 0
+
+    def test_int8_roundtrip_primitive(self):
+        w = jax.random.normal(jax.random.PRNGKey(7), (16, 8))
+        q, scale = per_channel_int8(w)
+        assert q.dtype == jnp.int8
+        dq = dequantize_int8(q, scale, jnp.float32)
+        assert bool(jnp.all(jnp.abs(w - dq) <= scale * 0.5 + 1e-7))
+        # zero channel: scale 1, exact zeros
+        wz = w.at[:, 3].set(0.0)
+        qz, sz = per_channel_int8(wz)
+        assert float(sz[3]) == 1.0
+        assert bool(jnp.all(qz[:, 3] == 0))
+
+    def test_unknown_mode_raises(self, snap):
+        with pytest.raises(ValueError, match="one of"):
+            quantize_params({}, "fp4")
+
+
+def test_prune_for_serving_loads(snap):
+    """prune=True applies one-shot 2:4 pruning: every masked kernel
+    group of 4 along the last axis keeps at most 2 nonzeros; unmasked
+    leaves are bitwise-untouched."""
+    d, p = snap
+    loaded = serve.load_model(d, prune=True)
+    assert loaded.pruned is True
+    changed = 0
+    flat_d = jax.tree_util.tree_leaves_with_path(p)
+    flat_g = jax.tree_util.tree_leaves(loaded.params)
+    for (path, dense), got in zip(flat_d, flat_g):
+        if np.array_equal(np.asarray(dense), np.asarray(got)):
+            continue
+        changed += 1
+        w = np.asarray(got, np.float32).reshape(-1)
+        k = (w.size // 4) * 4
+        groups = (w[:k] != 0).reshape(-1, 4)
+        assert (groups.sum(axis=1) <= 2).all()
+    assert changed > 0
