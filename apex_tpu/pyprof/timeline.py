@@ -14,6 +14,12 @@ them:
   * device lane(s): the existing kernel events, one thread per original
     trace lane, args carrying the HLO op and (when the sidecar is
     present) the joined ``named_scope`` path.
+  * request lanes (serving runs): ``req/*`` phase spans render under
+    their own ``requests`` pid, one lane row per decode SLOT (a slot is
+    the engine's unit of batching, so a request's queued/prefill/decode
+    intervals line up against the ``serve/step`` engine-dispatch lane
+    it shared the batch with) — a slow request is visibly pinned to its
+    queue wait or a straggling decode stretch.
 
 Clock join: the device trace's timestamps use an ARBITRARY epoch
 (measured: process-uptime-like on XLA:CPU — neither unix time nor
@@ -44,12 +50,13 @@ def _anchor_offset_us(kernels, host_spans) -> float:
     """Offset to ADD to a host ``perf_counter``-microsecond timestamp to
     land on the device trace's clock. Anchor preference: the capture's
     per-step ``profile/step`` spans, then ``step/dispatch`` spans, then
-    any span — each aligning its earliest begin with the device window
-    start."""
+    ``serve/step`` engine-dispatch spans (a traced serving run has no
+    trainer dispatches), then any span — each aligning its earliest
+    begin with the device window start."""
     if not kernels:
         return 0.0
     w0 = min(e.ts_us for e in kernels)
-    for fam in ("profile/step", "step/dispatch"):
+    for fam in ("profile/step", "step/dispatch", "serve/step"):
         begins = [s["begin_mono"] for s in host_spans
                   if s.get("family") == fam
                   and s.get("begin_mono") is not None]
@@ -69,8 +76,13 @@ def build_timeline(trace: Trace, host_spans: List[Dict[str, Any]], *,
     :func:`apex_tpu.trace.span_rows` shape) into a Chrome-trace dict."""
     instr_map = instr_map or {}
     kernels = trace.kernel_events()
-    spans = [s for s in host_spans if s.get("begin_mono") is not None]
-    offset = _anchor_offset_us(kernels, spans)
+    all_spans = [s for s in host_spans
+                 if s.get("begin_mono") is not None]
+    offset = _anchor_offset_us(kernels, all_spans)
+    req_spans = [s for s in all_spans
+                 if str(s.get("family", "")).startswith("req/")]
+    spans = [s for s in all_spans
+             if not str(s.get("family", "")).startswith("req/")]
 
     events: List[Dict[str, Any]] = []
     # lane bookkeeping: stable small tids, named via metadata events
@@ -78,6 +90,9 @@ def build_timeline(trace: Trace, host_spans: List[Dict[str, Any]], *,
                    "args": {"name": "host"}})
     events.append({"ph": "M", "pid": 2, "name": "process_name",
                    "args": {"name": "device"}})
+    if req_spans:
+        events.append({"ph": "M", "pid": 3, "name": "process_name",
+                       "args": {"name": "requests"}})
 
     host_tids: Dict[Any, int] = {}
     for s in spans:
@@ -99,6 +114,35 @@ def build_timeline(trace: Trace, host_spans: List[Dict[str, Any]], *,
             name = name[len("span/"):]
         events.append({
             "ph": "X", "pid": 1, "tid": host_tids[key], "name": name,
+            "ts": round(s["begin_mono"] * 1e6 + offset, 3),
+            "dur": round(max(s["dur_s"], 0.0) * 1e6, 3),
+            "args": args,
+        })
+
+    # request lanes: one row per decode slot, so the queued/prefill/
+    # decode phases of successive requests through a slot tile the lane
+    req_tids: Dict[Any, int] = {}
+    for s in req_spans:
+        key = (s.get("process"), s.get("slot"))
+        if key not in req_tids:
+            tid = len(req_tids) + 1
+            req_tids[key] = tid
+            slot = s.get("slot")
+            label = "queue" if slot is None else f"slot {slot}"
+            if s.get("process") is not None:
+                label = f"{s['process']}/{label}"
+            events.append({"ph": "M", "pid": 3, "tid": tid,
+                           "name": "thread_name",
+                           "args": {"name": label}})
+        phase = str(s.get("family", "req/?")).split("/", 1)[-1]
+        rid = s.get("rid")
+        name = phase if rid is None else f"r{rid}/{phase}"
+        args = {"family": s.get("family"), "rid": rid,
+                "slot": s.get("slot")}
+        if s.get("step") is not None:
+            args["step"] = s["step"]
+        events.append({
+            "ph": "X", "pid": 3, "tid": req_tids[key], "name": name,
             "ts": round(s["begin_mono"] * 1e6 + offset, 3),
             "dur": round(max(s["dur_s"], 0.0) * 1e6, 3),
             "args": args,
@@ -139,6 +183,7 @@ def build_timeline(trace: Trace, host_spans: List[Dict[str, Any]], *,
             "clock_join": ("host spans anchored to the device window at "
                            "the first profiled step boundary"),
             "host_spans": len(spans),
+            "request_spans": len(req_spans),
             "device_events": len(kernels),
         },
         "traceEvents": events,

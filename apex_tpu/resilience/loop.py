@@ -267,6 +267,8 @@ def resilient_loop(step_fn: Callable, state: Tree, data, *, steps: int,
             last_saved_step = step
         return ok
 
+    from apex_tpu import telemetry as _telemetry
+    import time as _time
     with PreemptionHandler(enabled=handle_signals,
                            deadline_s=deadline_s) as pre:
         step = start
@@ -276,6 +278,7 @@ def resilient_loop(step_fn: Callable, state: Tree, data, *, steps: int,
             if pre.requested():
                 break
             batch = batch_fn(step)
+            t_step = _time.perf_counter()
             if trainer is not None:
                 # pipelined dispatch: aux lands via the deferred on_step
                 # deliveries at retirement, not here
@@ -286,6 +289,16 @@ def resilient_loop(step_fn: Callable, state: Tree, data, *, steps: int,
                 state, aux = out if (isinstance(out, tuple)
                                      and len(out) == 2) else (out, None)
                 step += 1
+            if _telemetry.enabled():
+                # per-step wall-clock sample: the goodput ledger's
+                # cadence series (telemetry.ledger picks any */time_s;
+                # namespaced so an instrument_step wrapper's own
+                # step/time_s — device-synced, more precise — wins the
+                # endswith-preference when both are present)
+                _telemetry.record(
+                    "resilience/step/time_s",
+                    _time.perf_counter() - t_step, step=step - 1,
+                    kind="point")
             if supervisor is not None:
                 decision = supervisor.observe(step)
                 if decision.kind == "rebalance":

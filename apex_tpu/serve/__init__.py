@@ -27,13 +27,19 @@ one into a running service:
   * :mod:`~apex_tpu.serve.admission` — bounded queue + SLO-aware
     shedding; goodput counted against every submitted request.
   * :mod:`~apex_tpu.serve.bench` / ``python -m apex_tpu.serve bench`` —
-    synthetic closed/open-loop load driver emitting ``serve/*``
-    telemetry (docs/telemetry.md).
+    synthetic closed/open-loop load driver emitting ``serve/*`` +
+    ``req/*`` telemetry (docs/telemetry.md).
+  * :mod:`~apex_tpu.serve.slo` / ``python -m apex_tpu.serve slo`` —
+    declarative SLO specs scored over per-request records (attainment,
+    multi-window burn rates, violator attribution; exit 0 met / 3
+    violated / 1 bad input).
 
-Architecture notes: docs/serve.md.
+Architecture notes: docs/serve.md ("Observability" covers the request
+lifecycle records, the SLO engine, and the goodput ledger).
 """
 
 from apex_tpu.serve import bench
+from apex_tpu.serve import slo
 from apex_tpu.serve.admission import AdmissionController, Rejected
 from apex_tpu.serve.bench import run_bench
 from apex_tpu.serve.decode import (backend as decode_backend,
@@ -45,11 +51,12 @@ from apex_tpu.serve.kvcache import (KVPool, PageAllocator, PoolFullError,
 from apex_tpu.serve.loader import LoadedModel, load_model
 from apex_tpu.serve.model import ModelSpec
 from apex_tpu.serve.quant import QuantReport, quantize_params
+from apex_tpu.serve.slo import SLOSpec
 
 __all__ = [
     "AdmissionController", "Engine", "KVPool", "LoadedModel",
     "ModelSpec", "PageAllocator", "PoolFullError", "QuantReport",
-    "Rejected", "Request", "bench", "create_pool", "decode_backend",
-    "load_model", "paged_decode_attention", "quantize_params",
-    "run_bench", "set_decode_backend",
+    "Rejected", "Request", "SLOSpec", "bench", "create_pool",
+    "decode_backend", "load_model", "paged_decode_attention",
+    "quantize_params", "run_bench", "set_decode_backend", "slo",
 ]

@@ -23,10 +23,10 @@ import time
 from typing import Deque, List, NamedTuple, Optional
 
 from apex_tpu.serve import metrics
-
-QUEUE_FULL = "queue_full"
-DEADLINE = "deadline"
-TOO_LARGE = "too_large"
+# Canonical shed reasons live in metrics.SHED_REASONS (one enum shared
+# with the summarize serve section); re-exported here for callers.
+from apex_tpu.serve.metrics import (DEADLINE, QUEUE_FULL,  # noqa: F401
+                                    SHED_REASONS, TOO_LARGE)
 
 
 class Rejected(NamedTuple):
@@ -109,9 +109,15 @@ class AdmissionController:
 
     def _shed(self, req, reason: str, now: float,
               expired: bool = False) -> None:
+        metrics.check_reason(reason)
         req.state = "rejected"
         req.reject_reason = reason
         self.rejected.append(Rejected(req.rid, reason, now))
         metrics.count(metrics.REJECTED, meta={"reason": reason})
+        metrics.req_event(
+            metrics.REQ_REJECT, req.rid,
+            meta={"reason": reason, "expired": bool(expired),
+                  "queued_s": (None if req.submitted_s is None
+                               else now - req.submitted_s)})
         if expired:
             metrics.count(metrics.EXPIRED)

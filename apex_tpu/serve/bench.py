@@ -29,9 +29,11 @@ from typing import List, Optional
 import numpy as np
 
 from apex_tpu.serve import metrics
+from apex_tpu.serve import slo as slo_mod
 from apex_tpu.serve.admission import AdmissionController
 from apex_tpu.serve.engine import Engine, Request
 from apex_tpu.serve.loader import LoadedModel
+from apex_tpu.telemetry import ledger as ledger_mod
 
 
 def _pct(samples: List[float], q: float) -> Optional[float]:
@@ -83,9 +85,15 @@ def run_bench(loaded: LoadedModel, *, requests: int = 50,
               page: int = 16, max_context: Optional[int] = None,
               max_prompt: Optional[int] = None, in_flight: int = 2,
               overload: bool = True, deadline_s: float = 30.0,
+              slo: Optional["slo_mod.SLOSpec"] = None,
               seed: int = 0) -> dict:
     """Run the two-phase synthetic load against ``loaded`` and return
-    the SERVE report row (see the module docstring)."""
+    the SERVE report row (see the module docstring). ``slo`` (an
+    :class:`apex_tpu.serve.slo.SLOSpec` or a spec dict) scores the
+    run's whole request population; the report's ``slo`` key is null
+    when no spec is given — stable schema, never absent."""
+    if isinstance(slo, dict):
+        slo = slo_mod.SLOSpec.from_dict(slo)
     if requests < 1:
         raise ValueError(f"requests must be >= 1, got {requests}")
     max_prompt = prompt_len if max_prompt is None else max_prompt
@@ -130,27 +138,41 @@ def run_bench(loaded: LoadedModel, *, requests: int = 50,
         t0 = time.perf_counter()
         eng2.run(oreqs)
         oelapsed = time.perf_counter() - t0
-        admitted = sum(r.state == "done" for r in oreqs) \
-            + sum(r.state == "running" for r in oreqs)
         rejected = sum(r.state == "rejected" for r in oreqs)
         expired = sum(1 for rj in adm.rejected
                       if rj.reason == "deadline")
+        expired_inflight = len(eng2.expired_inflight)
         over = {
             "requests": n_over,
             "admitted": n_over - rejected,
             "completed": sum(r.state == "done" for r in oreqs),
             "rejected": rejected,
+            # the shed-gate reads the SUM of both expiry paths:
+            # ``expired`` counts queued requests shed at pop time,
+            # ``expired_inflight`` counts deadlines that passed
+            # mid-decode (wasted tokens the ledger prices)
             "expired": expired,
+            "expired_inflight": expired_inflight,
+            "expired_total": expired + expired_inflight,
             "goodput": round(_goodput(oreqs), 4),
             "tokens_per_s": round(
                 eng2.tokens_emitted / oelapsed, 2) if oelapsed else 0.0,
             "elapsed_s": round(oelapsed, 4),
         }
-        # the shedding contract: admitted requests COMPLETE — a request
-        # that was neither shed nor finished is an engine bug the bench
-        # must surface, not average away
-        over["stranded"] = n_over - over["completed"] - rejected
-        del admitted
+        # the shedding contract: admitted requests COMPLETE (or expire
+        # mid-decode, which the gate reads separately) — a request that
+        # was neither shed, finished, nor expired is an engine bug the
+        # bench must surface, not average away
+        over["stranded"] = (n_over - over["completed"] - rejected
+                            - expired_inflight)
+
+    all_reqs = reqs + (oreqs if overload else [])
+    slo_report = None
+    if slo is not None:
+        slo_report = slo_mod.evaluate(
+            slo_mod.records_from_requests(all_reqs), slo)
+    led = ledger_mod.serve_ledger_from_requests(all_reqs)
+    ledger_mod.emit_serve(led)
 
     return {
         "metric": "serve_tokens_per_s",
@@ -167,4 +189,6 @@ def run_bench(loaded: LoadedModel, *, requests: int = 50,
                    "seed": seed},
         "steady": steady,
         "overload": over,
+        "slo": slo_report,
+        "ledger": led,
     }

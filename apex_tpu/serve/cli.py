@@ -5,12 +5,19 @@ Subcommands:
   * ``bench`` — load the newest snapshot from ``--snapshot-dir`` and
     run the two-phase synthetic load of :mod:`apex_tpu.serve.bench`,
     printing the SERVE report row as ONE JSON line on stdout (progress
-    on stderr).
+    on stderr). ``--slo SPEC.json`` scores the run in the report's
+    ``slo`` key; ``--profile DIR`` wraps the run in a ``jax.profiler``
+    capture for ``pyprof report DIR --timeline`` (request lanes).
+  * ``slo`` — score a telemetry JSONL (a ``bench --telemetry`` run, or
+    any stream carrying ``req/*`` events) against a declarative SLO
+    spec (:mod:`apex_tpu.serve.slo`).
 
 Exit codes follow the repo CLI contract (telemetry/plan CLIs): 0 on a
-healthy run, 2 for usage errors (argparse), nonzero for bad input — a
-missing/empty snapshot directory or an unloadable checkpoint is exit 1
-with the reason on stderr, not a traceback.
+healthy run / every SLO target met, 2 for usage errors (argparse),
+3 when an SLO target is VIOLATED (the ``telemetry health`` unhealthy
+code), and 1 for bad input — a missing/empty snapshot directory, an
+unloadable checkpoint, an unreadable spec, or a stream with no
+``req/*`` events is exit 1 with the reason on stderr, not a traceback.
 """
 
 from __future__ import annotations
@@ -59,9 +66,38 @@ def _build_parser() -> argparse.ArgumentParser:
                         "(sparsity.prune_for_serving)")
     b.add_argument("--seed", type=int, default=0)
     b.add_argument("--telemetry", default=None, metavar="PATH",
-                   help="also write serve/* telemetry events to a "
-                        "JSONL (render: python -m apex_tpu.telemetry "
-                        "summarize PATH)")
+                   help="also write serve/* + req/* telemetry events "
+                        "to a JSONL (render: python -m "
+                        "apex_tpu.telemetry summarize PATH; score: "
+                        "python -m apex_tpu.serve slo PATH)")
+    b.add_argument("--slo", default=None, metavar="SPEC.json",
+                   help="score the run against an SLO spec; the "
+                        "report's 'slo' key carries the result (null "
+                        "without this flag)")
+    b.add_argument("--profile", default=None, metavar="DIR",
+                   help="wrap the run in a jax.profiler capture for "
+                        "pyprof report DIR --timeline (request lanes)")
+    s = sub.add_parser(
+        "slo",
+        help="score a telemetry JSONL's req/* records against an SLO "
+             "spec (exit 0 met / 3 violated / 1 bad input)")
+    s.add_argument("jsonl", metavar="RUN.jsonl",
+                   help="telemetry JSONL carrying req/* events "
+                        "(serve bench --telemetry)")
+    s.add_argument("--spec", default=None, metavar="SPEC.json",
+                   help="SLO spec file (JSON object of serve.slo."
+                        "SLOSpec fields)")
+    for metric in ("ttft", "tpot", "e2e"):
+        for q in (50, 99):
+            s.add_argument(f"--{metric}-p{q}-ms", type=float,
+                           default=None, dest=f"{metric}_p{q}_ms",
+                           help=f"{metric} p{q} target in ms")
+    s.add_argument("--goodput-min", type=float, default=None,
+                   help="minimum request goodput (completed-in-"
+                        "deadline / all submissions, 0..1)")
+    s.add_argument("--json", action="store_true",
+                   help="print the full report dict as JSON instead "
+                        "of the text rendering")
     return p
 
 
@@ -85,13 +121,32 @@ def _run_bench(args) -> int:
         print(f"serve bench: quantized {loaded.quant.mode} "
               f"({loaded.quant.quantized_leaves} leaves, max_abs_err "
               f"{loaded.quant.max_abs_err:.3e})", file=sys.stderr)
+    spec = None
+    if args.slo:
+        from apex_tpu.serve.slo import SLOSpec
+        try:
+            spec = SLOSpec.from_file(args.slo)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"serve bench: bad SLO spec: {e}", file=sys.stderr)
+            return 1
     try:
-        report = run_bench(
-            loaded, requests=args.requests, prompt_len=args.prompt_len,
-            max_new=args.max_new, max_batch=args.max_batch,
-            page=args.page, in_flight=args.in_flight,
-            overload=not args.no_overload, deadline_s=args.deadline_s,
-            seed=args.seed)
+        if args.profile:
+            import jax
+            jax.profiler.start_trace(args.profile)
+        try:
+            report = run_bench(
+                loaded, requests=args.requests,
+                prompt_len=args.prompt_len,
+                max_new=args.max_new, max_batch=args.max_batch,
+                page=args.page, in_flight=args.in_flight,
+                overload=not args.no_overload,
+                deadline_s=args.deadline_s, slo=spec, seed=args.seed)
+        finally:
+            if args.profile:
+                import jax
+                jax.profiler.stop_trace()
+                print(f"serve bench: profile -> {args.profile}",
+                      file=sys.stderr)
     except ValueError as e:
         print(f"serve bench: {e}", file=sys.stderr)
         return 1
@@ -104,10 +159,56 @@ def _run_bench(args) -> int:
     return 0
 
 
+EXIT_SLO_VIOLATED = 3          # matches telemetry health's unhealthy
+
+
+def _run_slo(args) -> int:
+    from apex_tpu.serve import slo as slo_mod
+    from apex_tpu.telemetry import requests as requests_mod
+    from apex_tpu.telemetry.export import load
+    if args.spec:
+        try:
+            spec = slo_mod.SLOSpec.from_file(args.spec)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"serve slo: bad spec: {e}", file=sys.stderr)
+            return 1
+    else:
+        spec = slo_mod.SLOSpec(
+            ttft_p50_ms=args.ttft_p50_ms, ttft_p99_ms=args.ttft_p99_ms,
+            tpot_p50_ms=args.tpot_p50_ms, tpot_p99_ms=args.tpot_p99_ms,
+            e2e_p50_ms=args.e2e_p50_ms, e2e_p99_ms=args.e2e_p99_ms,
+            goodput_min=args.goodput_min)
+    if spec.empty():
+        print("serve slo: spec sets no targets (use --spec or "
+              "--ttft-p99-ms / --tpot-p99-ms / --e2e-p99-ms / "
+              "--goodput-min)", file=sys.stderr)
+        return 1
+    try:
+        events = load(args.jsonl)
+    except (OSError, ValueError) as e:
+        print(f"serve slo: cannot read {args.jsonl}: {e}",
+              file=sys.stderr)
+        return 1
+    records = requests_mod.join(events)
+    if not records:
+        print(f"serve slo: {args.jsonl} carries no req/* events "
+              "(record a run with serve bench --telemetry)",
+              file=sys.stderr)
+        return 1
+    report = slo_mod.evaluate(records, spec)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(slo_mod.format_report(report))
+    return 0 if report["met"] else EXIT_SLO_VIOLATED
+
+
 def _run(argv: Optional[List[str]] = None) -> int:
     args = _build_parser().parse_args(argv)
     if args.cmd == "bench":
         return _run_bench(args)
+    if args.cmd == "slo":
+        return _run_slo(args)
     raise AssertionError(f"unhandled subcommand {args.cmd!r}")
 
 

@@ -18,14 +18,22 @@ the device computes: token streams are bit-identical at every depth
 
 Scheduler states (docs/serve.md): ``queued`` (admission queue) ->
 ``running`` (slot assigned, prefilled) -> ``done``; or ``rejected``
-(shed at admission — queue full, SLO-unreachable, or oversized).
-Finished slots linger as DRAINING until their in-flight dispatches
-retire, then their pages return to the free list.
+(shed at admission — queue full, SLO-unreachable, or oversized); or
+``expired`` (deadline passed MID-DECODE — the slot is cut off and its
+decoded tokens are wasted work, counted by ``serve/expired_inflight``
+and priced by the goodput ledger). Finished slots linger as DRAINING
+until their in-flight dispatches retire, then their pages return to the
+free list.
+
+Every lifecycle transition additionally emits a ``req/*`` event (see
+serve/metrics.py) so ``telemetry.requests.join`` can reconstruct one
+record per request offline — all host-side Python, never traced.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import time
 from typing import Any, Dict, List, Optional
 
@@ -40,6 +48,9 @@ from apex_tpu.serve.admission import (TOO_LARGE, AdmissionController,
 from apex_tpu.serve.loader import LoadedModel
 from apex_tpu.trainer.pipeline import InflightWindow
 
+# process-wide request id allocator (see Engine.request)
+_RIDS = itertools.count()
+
 
 @dataclasses.dataclass
 class Request:
@@ -51,7 +62,7 @@ class Request:
     deadline_s: Optional[float] = None
     eos_token_id: Optional[int] = None
     # lifecycle (engine/admission-owned)
-    state: str = "new"         # new|queued|running|done|rejected
+    state: str = "new"         # new|queued|running|done|rejected|expired
     tokens: List[int] = dataclasses.field(default_factory=list)
     # host observation time of each token — TTFT / inter-token
     # percentiles in the bench report come from diffs of this list
@@ -145,10 +156,10 @@ class Engine:
         self.slots: List[Optional[_Slot]] = [None] * self.max_batch
         self.last_tokens = jnp.zeros((self.max_batch,), jnp.int32)
         self.completed: List[Request] = []
+        self.expired_inflight: List[Request] = []
         self.tokens_emitted = 0
         self._seq = 0          # dispatch sequence number
         self._meta: Dict[int, Any] = {}
-        self._next_rid = 0
 
         def _decode(params, pool, last_tokens, block_tables, positions,
                     active):
@@ -171,10 +182,14 @@ class Engine:
     def request(self, prompt, max_new_tokens: int, *,
                 deadline_s: Optional[float] = None,
                 eos_token_id: Optional[int] = None) -> Request:
-        r = Request(rid=self._next_rid, prompt=list(map(int, prompt)),
+        # rids come from a PROCESS-wide counter, not a per-engine one:
+        # every engine in a process shares one telemetry collector, and
+        # per-engine numbering would alias distinct requests under one
+        # (process, rid) key in the offline join (the bench runs two
+        # engines — steady and overload — into one JSONL)
+        r = Request(rid=next(_RIDS), prompt=list(map(int, prompt)),
                     max_new_tokens=int(max_new_tokens),
                     deadline_s=deadline_s, eos_token_id=eos_token_id)
-        self._next_rid += 1
         return r
 
     def submit(self, req: Request, now: Optional[float] = None) -> bool:
@@ -182,6 +197,11 @@ class Engine:
         requests (prompt past the static prefill width, or context past
         the per-slot page budget) shed here — they could never run."""
         now = self._clock() if now is None else now
+        metrics.req_event(
+            metrics.REQ_SUBMIT, req.rid,
+            meta={"prompt_len": len(req.prompt),
+                  "max_new": req.max_new_tokens,
+                  "deadline_s": req.deadline_s})
         if (len(req.prompt) > self.max_prompt
                 or len(req.prompt) + req.max_new_tokens
                 > self.max_context):
@@ -192,6 +212,9 @@ class Engine:
             self.admission.rejected.append(
                 Rejected(req.rid, TOO_LARGE, now))
             metrics.count(metrics.REJECTED, meta={"reason": TOO_LARGE})
+            metrics.req_event(metrics.REQ_REJECT, req.rid,
+                              meta={"reason": TOO_LARGE,
+                                    "expired": False, "queued_s": 0.0})
             return False
         return self.admission.submit(req, now)
 
@@ -238,11 +261,51 @@ class Engine:
             req.state = "running"
             req.t_admit = now
             metrics.count(metrics.ADMITTED)
+            metrics.count(metrics.PREFILL_TOKENS, plen)
+            queued_s = (None if req.submitted_s is None
+                        else now - req.submitted_s)
+            metrics.req_event(
+                metrics.REQ_ADMIT, req.rid,
+                meta={"slot": slot_idx, "pages": need,
+                      "queued_s": queued_s})
+            if req.submitted_s is not None:
+                metrics.span(metrics.REQ_QUEUED, req.submitted_s, now,
+                             meta={"rid": req.rid, "slot": slot_idx})
             slot.outstanding += 1
             self._meta[self._seq] = ("prefill", self._clock(), slot_idx)
             for idx, payload in self.window.push(self._seq, first):
                 self._retire(idx, payload)
             self._seq += 1
+
+    def _expire_running(self, now: float) -> None:
+        """Cut off running slots whose deadline has already passed —
+        every further decoded token would be wasted work. The slot
+        drains like a completed one (in-flight dispatches retire, pages
+        free), but the request ends ``expired``: its decoded tokens are
+        counted by ``serve/expired_inflight`` accounting so the goodput
+        ledger can price them."""
+        for i, slot in enumerate(self.slots):
+            if slot is None or slot.finished:
+                continue
+            req = slot.req
+            if (req.deadline_s is None or req.submitted_s is None
+                    or now - req.submitted_s <= req.deadline_s):
+                continue
+            slot.finished = True
+            self.limits[i] = self.positions[i]
+            req.state = "expired"
+            self.expired_inflight.append(req)
+            metrics.count(metrics.EXPIRED_INFLIGHT)
+            metrics.req_event(
+                metrics.REQ_EXPIRE_INFLIGHT, req.rid,
+                meta={"slot": i, "tokens": len(req.tokens),
+                      "e2e_s": now - req.submitted_s})
+            if req.t_first is not None:
+                metrics.span(metrics.REQ_DECODE, req.t_first, now,
+                             meta={"rid": req.rid, "slot": i,
+                                   "tokens": len(req.tokens),
+                                   "expired": True})
+        self._reap()
 
     def _active_mask(self) -> np.ndarray:
         act = np.zeros((self.max_batch,), bool)
@@ -259,17 +322,31 @@ class Engine:
         flight)."""
         now = self._clock()
         self._admit(now)
+        self._expire_running(now)
         metrics.gauge(metrics.QUEUE_DEPTH, self.admission.depth,
                       step=self._seq)
         occupied = sum(s is not None for s in self.slots)
         metrics.gauge(metrics.OCCUPANCY, occupied / self.max_batch,
                       step=self._seq)
+        kv = self.allocator.stats()
+        metrics.gauge(metrics.KV_USED_PAGES, kv["used"], step=self._seq)
+        metrics.gauge(metrics.KV_FREE_PAGES, kv["free"], step=self._seq)
+        metrics.gauge(metrics.KV_OCCUPANCY, kv["occupancy"],
+                      step=self._seq)
+        metrics.gauge(metrics.KV_FRAGMENTATION, kv["fragmentation"],
+                      step=self._seq)
         active = self._active_mask()
+        metrics.gauge(metrics.SLOT_ACTIVE,
+                      int(active.sum()) / self.max_batch, step=self._seq)
         if active.any():
+            # int() the slot indices: np.flatnonzero yields np.int64,
+            # which would leak into span/req event metas and break the
+            # JSONL writer (json can't serialize numpy scalars)
             snapshot = [(i, self.slots[i].req,
                          int(self.positions[i]) - self.slots[i].prompt_len
                          + 1)
-                        for i in np.flatnonzero(active)]
+                        for i in map(int, np.flatnonzero(active))]
+            t_dispatch = self._clock()
             self.pool, self.last_tokens = self._decode_fn(
                 self.params, self.pool, self.last_tokens,
                 jnp.asarray(self.block_tables),
@@ -277,7 +354,10 @@ class Engine:
             for i, _, _ in snapshot:
                 self.positions[i] += 1
                 self.slots[i].outstanding += 1
-            self._meta[self._seq] = ("decode", self._clock(), snapshot)
+            metrics.count(metrics.DECODE_TOKENS, len(snapshot))
+            self._meta[self._seq] = ("decode", t_dispatch, snapshot)
+            metrics.span(metrics.ENGINE_STEP, t_dispatch, self._clock(),
+                         step=self._seq)
             for idx, payload in self.window.push(self._seq,
                                                  self.last_tokens):
                 self._retire(idx, payload)
@@ -337,14 +417,26 @@ class Engine:
                        tok: int, now: float, *, first: bool) -> None:
         if slot.finished:
             return                      # post-EOS overrun token
+        rid_meta = {"rid": req.rid, "slot": slot_idx}
         if first:
             req.t_first = now
-            metrics.span(metrics.TTFT, req.submitted_s, now)
+            metrics.span(metrics.TTFT, req.submitted_s, now,
+                         meta=rid_meta)
             if req.ttft_s is not None:
                 self.admission.observe_ttft(req.ttft_s)
             metrics.count(metrics.TOKENS, 1)
+            prefill_s = (None if req.t_admit is None
+                         else now - req.t_admit)
+            metrics.req_event(
+                metrics.REQ_FIRST, req.rid,
+                meta={"slot": slot_idx, "ttft_s": req.ttft_s,
+                      "prefill_s": prefill_s})
+            if req.t_admit is not None:
+                metrics.span(metrics.REQ_PREFILL, req.t_admit, now,
+                             meta=rid_meta)
         elif req.t_last is not None:
-            metrics.span(metrics.INTERTOKEN, req.t_last, now)
+            metrics.span(metrics.INTERTOKEN, req.t_last, now,
+                         meta=rid_meta)
         req.t_last = now
         req.tokens.append(tok)
         req.token_times.append(now)
@@ -358,6 +450,27 @@ class Engine:
             req.state = "done"
             req.t_done = now
             metrics.count(metrics.COMPLETED)
+            decode_s = (None if req.t_first is None
+                        else now - req.t_first)
+            metrics.req_event(
+                metrics.REQ_FINISH, req.rid,
+                meta={"slot": slot_idx, "tokens": len(req.tokens),
+                      "queued_s": (None if req.t_admit is None
+                                   or req.submitted_s is None
+                                   else req.t_admit - req.submitted_s),
+                      "prefill_s": (None if req.t_first is None
+                                    or req.t_admit is None
+                                    else req.t_first - req.t_admit),
+                      "decode_s": decode_s,
+                      "ttft_s": req.ttft_s,
+                      "e2e_s": (None if req.submitted_s is None
+                                else now - req.submitted_s),
+                      "deadline_s": req.deadline_s,
+                      "in_deadline": req.in_deadline()})
+            if req.t_first is not None:
+                metrics.span(metrics.REQ_DECODE, req.t_first, now,
+                             meta={**rid_meta,
+                                   "tokens": len(req.tokens)})
             self.completed.append(req)
 
     def _reap(self) -> None:

@@ -85,6 +85,31 @@ class PageAllocator:
                 raise ValueError(f"double free of page {p}")
             self._free.append(p)
 
+    def stats(self) -> dict:
+        """Free-list health snapshot for the ``serve/kv_*`` gauges.
+
+        ``fragmentation`` is free-list shatter: ``1 - largest
+        contiguous free run / free pages`` — 0.0 when the free space is
+        one clean run (or the pool is full/empty), approaching 1.0 when
+        it is scattered single pages. Paged attention doesn't need
+        contiguity to FUNCTION, but a shattered free list is the
+        leading indicator of pathological churn (every retirement
+        interleaved with an admission), which is what the gauge exists
+        to surface."""
+        free = len(self._free)
+        used = self.num_pages - free
+        frag = 0.0
+        if free > 1:
+            ordered = sorted(self._free)
+            longest = run = 1
+            for a, b in zip(ordered, ordered[1:]):
+                run = run + 1 if b == a + 1 else 1
+                longest = max(longest, run)
+            frag = 1.0 - longest / free
+        return {"num_pages": self.num_pages, "used": used, "free": free,
+                "occupancy": used / self.num_pages,
+                "fragmentation": frag}
+
 
 class KVPool(NamedTuple):
     """Device-side paged K/V storage: one entry per transformer layer,

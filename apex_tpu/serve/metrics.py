@@ -1,26 +1,54 @@
-"""``serve/*`` telemetry event families (documented in
+"""``serve/*`` and ``req/*`` telemetry event families (documented in
 docs/telemetry.md; aggregated by the ``serve`` section of
-``telemetry.summarize``).
+``telemetry.summarize`` and joined per-request by
+``telemetry.requests``).
 
 Gauges (kind=point, per engine step):
-  * ``serve/queue_depth``  — admission queue length
-  * ``serve/occupancy``    — occupied slots / max_batch (0..1)
-  * ``serve/tokens_per_s`` — bench-window decode throughput
+  * ``serve/queue_depth``      — admission queue length
+  * ``serve/occupancy``        — occupied slots / max_batch (0..1)
+  * ``serve/slot_active``      — slots actively decoding / max_batch
+    (occupancy counts draining slots too; the gap between the two is
+    the drain tax)
+  * ``serve/tokens_per_s``     — bench-window decode throughput
+  * ``serve/kv_used_pages``    — block-allocator pages in use
+  * ``serve/kv_free_pages``    — block-allocator free-list length
+  * ``serve/kv_occupancy``     — used pages / total pages (0..1)
+  * ``serve/kv_fragmentation`` — 1 - largest contiguous free run /
+    free pages (0 = one clean run, ->1 = free list shattered)
 
 Counters (kind=counter):
   * ``serve/admitted`` / ``serve/rejected`` / ``serve/expired`` /
-    ``serve/completed`` / ``serve/tokens`` (``rejected`` carries the
-    shed reason in ``meta``; ``expired`` counts deadline expiries of
-    QUEUED requests, a subset of honest goodput accounting)
+    ``serve/expired_inflight`` / ``serve/completed`` / ``serve/tokens``
+    / ``serve/prefill_tokens`` / ``serve/decode_tokens``
+    (``rejected`` carries the shed reason in ``meta`` — values come
+    from the canonical ``SHED_REASONS`` tuple; ``expired`` counts
+    deadline expiries of QUEUED requests, ``expired_inflight`` counts
+    deadlines that passed MID-DECODE — their decoded tokens are wasted
+    work the goodput ledger prices)
 
 Trace spans (aggregated from span rows, like the trainer's step
 timing):
   * ``serve/ttft``       — submit -> first token observed on host
+    (meta carries ``rid``/``slot``)
   * ``serve/intertoken`` — consecutive host-observed tokens of one
-    request
+    request (meta carries ``rid``/``slot``)
+  * ``serve/step``       — one decode dispatch interval, ``step`` = the
+    engine sequence number (the multi-process clock-join anchor and the
+    timeline's engine-step lane)
+  * ``req/queued`` / ``req/prefill`` / ``req/decode`` — per-request
+    phase intervals (meta ``rid``/``slot``) — the requests pid lanes in
+    ``pyprof report --timeline``
+
+Request lifecycle events (kind="req", value = rid; joined offline by
+``telemetry.requests.join`` into one record per request):
+  * ``req/submit`` / ``req/admit`` / ``req/reject`` /
+    ``req/first_token`` / ``req/finish`` / ``req/expire_inflight``
 
 All emission is gated by ``telemetry.enabled()`` inside the collector /
-trace layer — a disabled server pays only the no-op call.
+trace layer — a disabled server pays only the no-op call, and the
+decode program is jaxpr-identical (every emission here is host-side
+Python around the jit, never inside it; pinned by
+tests/test_serve_obs.py).
 """
 
 from __future__ import annotations
@@ -31,18 +59,63 @@ from apex_tpu import telemetry, trace
 
 QUEUE_DEPTH = "serve/queue_depth"
 OCCUPANCY = "serve/occupancy"
+SLOT_ACTIVE = "serve/slot_active"
 TOKENS_PER_S = "serve/tokens_per_s"
+KV_USED_PAGES = "serve/kv_used_pages"
+KV_FREE_PAGES = "serve/kv_free_pages"
+KV_OCCUPANCY = "serve/kv_occupancy"
+KV_FRAGMENTATION = "serve/kv_fragmentation"
 ADMITTED = "serve/admitted"
 REJECTED = "serve/rejected"
 EXPIRED = "serve/expired"
+EXPIRED_INFLIGHT = "serve/expired_inflight"
 COMPLETED = "serve/completed"
 TOKENS = "serve/tokens"
+PREFILL_TOKENS = "serve/prefill_tokens"
+DECODE_TOKENS = "serve/decode_tokens"
 TTFT = "serve/ttft"
 INTERTOKEN = "serve/intertoken"
+ENGINE_STEP = "serve/step"
 
-GAUGES = (QUEUE_DEPTH, OCCUPANCY, TOKENS_PER_S)
-COUNTERS = (ADMITTED, REJECTED, EXPIRED, COMPLETED, TOKENS)
-SPAN_FAMILIES = (TTFT, INTERTOKEN)
+# per-request phase spans (timeline request lanes / SLO attribution)
+REQ_QUEUED = "req/queued"
+REQ_PREFILL = "req/prefill"
+REQ_DECODE = "req/decode"
+
+# per-request lifecycle events (kind="req")
+REQ_SUBMIT = "req/submit"
+REQ_ADMIT = "req/admit"
+REQ_REJECT = "req/reject"
+REQ_FIRST = "req/first_token"
+REQ_FINISH = "req/finish"
+REQ_EXPIRE_INFLIGHT = "req/expire_inflight"
+
+GAUGES = (QUEUE_DEPTH, OCCUPANCY, SLOT_ACTIVE, TOKENS_PER_S,
+          KV_USED_PAGES, KV_FREE_PAGES, KV_OCCUPANCY, KV_FRAGMENTATION)
+COUNTERS = (ADMITTED, REJECTED, EXPIRED, EXPIRED_INFLIGHT, COMPLETED,
+            TOKENS, PREFILL_TOKENS, DECODE_TOKENS)
+SPAN_FAMILIES = (TTFT, INTERTOKEN, ENGINE_STEP)
+REQ_SPAN_FAMILIES = (REQ_QUEUED, REQ_PREFILL, REQ_DECODE)
+REQ_EVENTS = (REQ_SUBMIT, REQ_ADMIT, REQ_REJECT, REQ_FIRST, REQ_FINISH,
+              REQ_EXPIRE_INFLIGHT)
+
+# Canonical shed reasons — the ONLY values ``serve/rejected`` meta may
+# carry (and a ``req/reject`` meta ``reason``). admission.py re-exports
+# these; the summarize serve section iterates this tuple so the
+# breakdown table cannot silently split one reason into two rows.
+QUEUE_FULL = "queue_full"
+DEADLINE = "deadline"
+TOO_LARGE = "too_large"
+SHED_REASONS = (QUEUE_FULL, DEADLINE, TOO_LARGE)
+
+
+def check_reason(reason: str) -> str:
+    """Validate a shed reason against the canonical enum — a free-form
+    string here would silently split the summarize breakdown table."""
+    if reason not in SHED_REASONS:
+        raise ValueError(
+            f"unknown shed reason {reason!r} (canonical: {SHED_REASONS})")
+    return reason
 
 
 def gauge(name: str, value, *, step: Optional[int] = None) -> None:
@@ -56,3 +129,13 @@ def count(name: str, n: float = 1, *, meta: Optional[dict] = None) -> None:
 def span(name: str, begin: float, end: float, *,
          step: Optional[int] = None, meta: Optional[dict] = None) -> None:
     trace.emit_span(name, begin, end, step=step, meta=meta)
+
+
+def req_event(name: str, rid: int, *, meta: Optional[dict] = None) -> None:
+    """One request-lifecycle fact (kind="req"). value is the rid so the
+    event is self-identifying even without meta; structured context
+    (slot, reason, phase durations) rides in meta."""
+    m = {"rid": int(rid)}
+    if meta:
+        m.update(meta)
+    telemetry.record(name, rid, kind="req", meta=m)

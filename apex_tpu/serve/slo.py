@@ -1,0 +1,354 @@
+"""Declarative serving SLOs — attainment, burn rates, and violator
+attribution over per-request records.
+
+An :class:`SLOSpec` names latency/goodput targets; :func:`evaluate`
+scores a set of request records (from ``telemetry.requests.join`` on a
+telemetry JSONL, or :func:`records_from_requests` on live ``Request``
+objects) against it. The CLI half is ``python -m apex_tpu.serve slo
+run.jsonl`` (serve/cli.py) with the repo exit-code contract: 0 = every
+target met, 3 = violated, 1 = bad input, 2 = usage.
+
+Scoring is SRE-honest:
+
+  * A latency target is ``<metric>_p<q>_ms``: "the q-th percentile of
+    <metric> stays under this many milliseconds". Attainment is the
+    fraction of ALL terminal requests under the threshold — a request
+    that was shed or expired never produced the metric and counts as a
+    MISS (value = +inf), not an exemption.
+  * Burn rate is the SRE error-budget form: with target percentile q
+    the violation budget is ``1 - q/100``; burn = observed violation
+    fraction / budget, reported over three windows of the run (full,
+    last half, last quarter by submit time) so a late-run regression
+    shows as short-window burn >> long-window burn.
+  * ``goodput_min`` prices shed work the same way the bench does:
+    completed-in-deadline over ALL submissions.
+
+Violators are ranked by worst relative excess over any target, each
+with per-phase time attribution (queued vs prefill vs decode vs shed)
+so "which requests missed p99 and where did their time go" is one table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+# metric key -> record field holding seconds
+_METRIC_FIELDS = {"ttft": "ttft_s", "tpot": "tpot_s", "e2e": "e2e_s"}
+_TERMINAL = ("done", "rejected", "expired")
+
+
+@dataclasses.dataclass
+class SLOSpec:
+    """Declarative SLO targets. Every field is optional — None means
+    "no target on this axis"; at least one must be set for a spec to be
+    evaluable. Latency thresholds are milliseconds; ``goodput_min`` is
+    a fraction of submissions (0..1)."""
+
+    ttft_p50_ms: Optional[float] = None
+    ttft_p99_ms: Optional[float] = None
+    tpot_p50_ms: Optional[float] = None
+    tpot_p99_ms: Optional[float] = None
+    e2e_p50_ms: Optional[float] = None
+    e2e_p99_ms: Optional[float] = None
+    goodput_min: Optional[float] = None
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SLOSpec":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - fields
+        if unknown:
+            raise ValueError(
+                f"unknown SLO spec keys {sorted(unknown)} "
+                f"(known: {sorted(fields)})")
+        vals = {k: (None if v is None else float(v))
+                for k, v in d.items()}
+        return cls(**vals)
+
+    @classmethod
+    def from_file(cls, path: str) -> "SLOSpec":
+        with open(path, "r", encoding="utf-8") as f:
+            d = json.load(f)
+        if not isinstance(d, dict):
+            raise ValueError(f"SLO spec {path} must be a JSON object")
+        return cls.from_dict(d)
+
+    def to_dict(self) -> Dict[str, Optional[float]]:
+        return dataclasses.asdict(self)
+
+    def latency_targets(self) -> List[Dict[str, float]]:
+        """[{metric, percentile, target_ms}] for every set latency
+        field."""
+        out = []
+        for metric in _METRIC_FIELDS:
+            for q in (50, 99):
+                v = getattr(self, f"{metric}_p{q}_ms")
+                if v is not None:
+                    out.append({"metric": metric, "percentile": q,
+                                "target_ms": float(v)})
+        return out
+
+    def empty(self) -> bool:
+        return not self.latency_targets() and self.goodput_min is None
+
+
+def records_from_requests(reqs) -> List[dict]:
+    """Build SLO records directly from live ``serve.engine.Request``
+    objects — same shape as ``telemetry.requests.join`` produces from a
+    JSONL, so the bench can score a run without a telemetry sink."""
+    out = []
+    for r in reqs:
+        queued_s = (None if r.t_admit is None or r.submitted_s is None
+                    else r.t_admit - r.submitted_s)
+        if queued_s is None and r.state == "rejected":
+            queued_s = 0.0
+        prefill_s = (None if r.t_first is None or r.t_admit is None
+                     else r.t_first - r.t_admit)
+        decode_s = (None if r.t_last is None or r.t_first is None
+                    else r.t_last - r.t_first)
+        end = r.t_done if r.t_done is not None else r.t_last
+        e2e_s = (None if end is None or r.submitted_s is None
+                 else end - r.submitted_s)
+        tokens = len(r.tokens)
+        tpot_s = (decode_s / (tokens - 1)
+                  if decode_s is not None and tokens > 1 else None)
+        out.append({
+            "rid": r.rid, "process": 0, "state": r.state,
+            "prompt_len": len(r.prompt), "max_new": r.max_new_tokens,
+            "deadline_s": r.deadline_s, "ts_submit": r.submitted_s,
+            "queued_s": queued_s, "prefill_s": prefill_s,
+            "decode_s": decode_s, "e2e_s": e2e_s, "ttft_s": r.ttft_s,
+            "tpot_s": tpot_s, "tokens": tokens, "slot": None,
+            "reason": r.reject_reason, "in_deadline": r.in_deadline(),
+        })
+    return out
+
+
+def _metric_ms(rec: dict, metric: str) -> float:
+    """A record's value for one latency metric, in ms. A request that
+    never produced the measurement (shed, expired before first token)
+    is an SLO miss, not a sampling gap: +inf."""
+    v = rec.get(_METRIC_FIELDS[metric])
+    if v is None:
+        return math.inf
+    return float(v) * 1e3
+
+
+def _pctile(values: List[float], q: float) -> Optional[float]:
+    if not values:
+        return None
+    arr = np.asarray(values, np.float64)
+    if np.isinf(arr).any():
+        # percentile over a set containing inf: sort-based definition
+        arr = np.sort(arr)
+        idx = min(len(arr) - 1, int(math.ceil(q / 100.0 * len(arr))) - 1)
+        return float(arr[max(0, idx)])
+    return float(np.percentile(arr, q))
+
+
+def _windows(records: List[dict]) -> List[Dict[str, Any]]:
+    """(label, record subset) over the submit-time span: full run, last
+    half, last quarter. Records without a submit time ride in every
+    window (they cannot be placed, and dropping them would hide
+    misses)."""
+    stamped = [r for r in records if r.get("ts_submit") is not None]
+    if not stamped:
+        return [{"label": "full", "records": records}]
+    t0 = min(r["ts_submit"] for r in stamped)
+    t1 = max(r["ts_submit"] for r in stamped)
+    span = t1 - t0
+    out = [{"label": "full", "records": records}]
+    for label, frac in (("half", 0.5), ("quarter", 0.25)):
+        cut = t1 - span * frac
+        sub = [r for r in records
+               if r.get("ts_submit") is None or r["ts_submit"] >= cut]
+        out.append({"label": label, "records": sub})
+    return out
+
+
+def _goodput(records: List[dict]) -> Optional[float]:
+    if not records:
+        return None
+    good = 0
+    for r in records:
+        if r["state"] != "done":
+            continue
+        ind = r.get("in_deadline")
+        good += 1 if (ind is None or ind) else 0
+    return good / len(records)
+
+
+def evaluate(records: List[dict], spec: SLOSpec) -> Dict[str, Any]:
+    """Score ``records`` against ``spec``. Returns the SLO report dict
+    (JSON-able; the SERVE_r*.json ``slo`` key and the CLI's --json
+    output). ``met`` is the exit-code verdict: every set target held."""
+    if spec.empty():
+        raise ValueError("SLO spec sets no targets")
+    terminal = [r for r in records if r["state"] in _TERMINAL]
+    windows = _windows(terminal)
+    targets = []
+    for t in spec.latency_targets():
+        metric, q, thr = t["metric"], t["percentile"], t["target_ms"]
+        values = [_metric_ms(r, metric) for r in terminal]
+        obs = _pctile(values, q)
+        met = obs is not None and obs <= thr
+        budget = 1.0 - q / 100.0
+        burn = {}
+        for w in windows:
+            wv = [_metric_ms(r, metric) for r in w["records"]]
+            viol = (sum(1 for v in wv if v > thr) / len(wv)
+                    if wv else 0.0)
+            burn[w["label"]] = (round(viol / budget, 3) if budget > 0
+                                else (math.inf if viol else 0.0))
+        targets.append({
+            "metric": metric, "percentile": q, "target_ms": thr,
+            "observed_ms": (None if obs is None or math.isinf(obs)
+                            else round(obs, 3)),
+            "unbounded": obs is not None and math.isinf(obs),
+            "attainment": (round(
+                sum(1 for v in values if v <= thr) / len(values), 4)
+                if values else None),
+            "met": bool(met),
+            "burn": burn,
+        })
+    goodput = None
+    if spec.goodput_min is not None:
+        g = _goodput(terminal)
+        goodput = {"min": spec.goodput_min,
+                   "observed": (None if g is None else round(g, 4)),
+                   "met": g is not None and g >= spec.goodput_min}
+    met = all(t["met"] for t in targets) \
+        and (goodput is None or goodput["met"])
+    return {
+        "spec": spec.to_dict(),
+        "requests": len(terminal),
+        "targets": targets,
+        "goodput": goodput,
+        "violators": violators(terminal, spec),
+        "met": bool(met),
+    }
+
+
+def violators(records: List[dict], spec: Optional[SLOSpec] = None,
+              top: int = 5) -> List[dict]:
+    """Worst offenders with per-phase attribution. With a spec, a
+    violator exceeds at least one latency target (score = worst
+    relative excess); without one, ranks by e2e latency with
+    deadline-missers and shed/expired requests first."""
+    targets = spec.latency_targets() if spec is not None else []
+    scored = []
+    for r in records:
+        if r["state"] not in _TERMINAL:
+            continue
+        if targets:
+            score = 0.0
+            for t in targets:
+                v = _metric_ms(r, t["metric"])
+                if t["target_ms"] > 0:
+                    score = max(score, v / t["target_ms"])
+            if score <= 1.0:
+                continue
+        else:
+            missed = (r["state"] != "done"
+                      or r.get("in_deadline") is False)
+            e2e = r.get("e2e_s")
+            score = (math.inf if missed
+                     else (0.0 if e2e is None else e2e))
+            if score == 0.0:
+                continue
+        scored.append((score, r))
+    scored.sort(key=lambda sr: (sr[0], sr[1].get("e2e_s") or 0.0),
+                reverse=True)
+    out = []
+    for score, r in scored[:top]:
+        out.append({
+            "rid": r["rid"], "process": r.get("process", 0),
+            "state": r["state"], "reason": r.get("reason"),
+            "score": (None if math.isinf(score) else round(score, 3)),
+            "e2e_ms": (None if r.get("e2e_s") is None
+                       else round(r["e2e_s"] * 1e3, 3)),
+            "queued_ms": (None if r.get("queued_s") is None
+                          else round(r["queued_s"] * 1e3, 3)),
+            "prefill_ms": (None if r.get("prefill_s") is None
+                           else round(r["prefill_s"] * 1e3, 3)),
+            "decode_ms": (None if r.get("decode_s") is None
+                          else round(r["decode_s"] * 1e3, 3)),
+        })
+    return out
+
+
+def describe(records: List[dict]) -> Optional[Dict[str, Any]]:
+    """Spec-free per-request summary for ``telemetry summarize``:
+    TTFT/TPOT/e2e percentiles over terminal requests, deadline
+    attainment, and the top violators (slowest / deadline-missing) with
+    phase attribution. None when there are no terminal records."""
+    terminal = [r for r in records if r["state"] in _TERMINAL]
+    if not terminal:
+        return None
+    out: Dict[str, Any] = {"requests": len(terminal)}
+    states: Dict[str, int] = {}
+    for r in terminal:
+        states[r["state"]] = states.get(r["state"], 0) + 1
+    out["by_state"] = states
+    for metric, field in _METRIC_FIELDS.items():
+        vals = [r[field] * 1e3 for r in terminal
+                if r.get(field) is not None]
+        out[f"{metric}_ms"] = (
+            None if not vals else
+            {"p50": round(_pctile(vals, 50), 3),
+             "p99": round(_pctile(vals, 99), 3),
+             "max": round(max(vals), 3), "n": len(vals)})
+    with_deadline = [r for r in terminal
+                     if r.get("deadline_s") is not None]
+    out["deadline_attainment"] = (
+        None if not with_deadline else
+        round(sum(1 for r in with_deadline
+                  if r["state"] == "done"
+                  and r.get("in_deadline") is not False)
+              / len(with_deadline), 4))
+    out["goodput"] = (None if _goodput(terminal) is None
+                      else round(_goodput(terminal), 4))
+    out["top_violators"] = violators(terminal)
+    return out
+
+
+def format_report(report: Dict[str, Any]) -> str:
+    """Human rendering of an :func:`evaluate` report (the CLI's default
+    output; --json prints the dict instead)."""
+    lines = [f"slo: {report['requests']} requests, "
+             f"{'MET' if report['met'] else 'VIOLATED'}"]
+    for t in report["targets"]:
+        obs = ("unbounded (shed/expired in tail)" if t["unbounded"]
+               else "n/a" if t["observed_ms"] is None
+               else f"{t['observed_ms']:.3f}ms")
+        att = ("n/a" if t["attainment"] is None
+               else f"{t['attainment'] * 100:.2f}%")
+        burn = ", ".join(f"{k}={v}" for k, v in t["burn"].items())
+        lines.append(
+            f"  {t['metric']} p{t['percentile']} <= "
+            f"{t['target_ms']:g}ms: observed {obs} "
+            f"[{'ok' if t['met'] else 'VIOLATED'}] "
+            f"attainment {att} burn({burn})")
+    g = report.get("goodput")
+    if g:
+        obs = "n/a" if g["observed"] is None else f"{g['observed']:.4f}"
+        lines.append(f"  goodput >= {g['min']:g}: observed {obs} "
+                     f"[{'ok' if g['met'] else 'VIOLATED'}]")
+    if report["violators"]:
+        lines.append("  top violators (time attribution):")
+        for v in report["violators"]:
+            phases = ", ".join(
+                f"{k[:-3]}={v[k]:.1f}ms" for k in
+                ("queued_ms", "prefill_ms", "decode_ms")
+                if v[k] is not None)
+            tail = f" shed={v['reason']}" if v["reason"] else ""
+            e2e = ("n/a" if v["e2e_ms"] is None
+                   else f"{v['e2e_ms']:.1f}ms")
+            lines.append(
+                f"    r{v['rid']} [{v['state']}{tail}] "
+                f"e2e={e2e} ({phases or 'no phases observed'})")
+    return "\n".join(lines)

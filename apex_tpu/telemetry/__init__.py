@@ -24,6 +24,13 @@ while it happens:
   * :mod:`export`      — JSONL/CSV writers with rotation; ``load`` with
     rotation-following; ``summarize`` aggregation (incl. the health
     section).
+  * :mod:`requests`    — offline join of ``req/*`` request-lifecycle
+    events (kind ``"req"``) into one record per serving request
+    (:func:`requests.join`); consumed by ``serve slo`` and summarize.
+  * :mod:`ledger`      — the unified goodput ledger: equivalent
+    full-fleet seconds lost per membership event on the training side,
+    useful-vs-wasted decode tokens on the serving side
+    (:func:`ledger.compute`; ROADMAP item 6).
   * :mod:`cli`         — ``python -m apex_tpu.telemetry
     summarize|health|tail|csv run.jsonl`` (``health`` exits 3 on
     divergence alerts).
@@ -59,6 +66,8 @@ from apex_tpu.telemetry.export import (JsonlWriter, format_summary, load,
 from apex_tpu.telemetry import health
 from apex_tpu.telemetry.health import (DivergenceDetector,
                                        attribute_overflow, grad_stats)
+from apex_tpu.telemetry import ledger
+from apex_tpu.telemetry import requests
 
 
 def write_jsonl(path: str, events=None, **kwargs) -> str:

@@ -537,7 +537,12 @@ def summarize(events: List[Dict[str, Any]], *,
     srv: Dict[str, Any] = {}
     for suffix, key in (("serve/queue_depth", "queue_depth"),
                         ("serve/occupancy", "occupancy"),
-                        ("serve/tokens_per_s", "tokens_per_s")):
+                        ("serve/slot_active", "slot_active"),
+                        ("serve/tokens_per_s", "tokens_per_s"),
+                        ("serve/kv_used_pages", "kv_used_pages"),
+                        ("serve/kv_free_pages", "kv_free_pages"),
+                        ("serve/kv_occupancy", "kv_occupancy"),
+                        ("serve/kv_fragmentation", "kv_fragmentation")):
         vals = [v for name, vs in series.items()
                 if name.endswith(suffix) for v in vs]
         if vals:
@@ -545,15 +550,19 @@ def summarize(events: List[Dict[str, Any]], *,
     for cname, key in (("serve/admitted", "admitted"),
                        ("serve/rejected", "rejected"),
                        ("serve/expired", "expired"),
+                       ("serve/expired_inflight", "expired_inflight"),
                        ("serve/completed", "completed"),
-                       ("serve/tokens", "tokens")):
+                       ("serve/tokens", "tokens"),
+                       ("serve/prefill_tokens", "prefill_tokens"),
+                       ("serve/decode_tokens", "decode_tokens")):
         total = sum(v for n, v in counters.items() if n.endswith(cname))
         if total:
             srv[key] = int(total)
     # shed-reason breakdown: serve/rejected carries the admission
-    # controller's reason in meta, so an operator can tell queue
-    # pressure (queue_full) from SLO shedding (deadline) from
-    # malformed traffic (too_large) without re-reading the stream
+    # controller's reason in meta. Reasons are the canonical
+    # serve.metrics.SHED_REASONS enum — the table canonicalizes against
+    # THAT tuple (free-form strings land in an explicit "unknown:"
+    # bucket instead of silently splitting one reason into two rows).
     reasons: Dict[str, int] = collections.defaultdict(int)
     for e in events:
         if (e.get("kind") == "counter"
@@ -562,14 +571,36 @@ def summarize(events: List[Dict[str, Any]], *,
             if reason:
                 reasons[str(reason)] += int(e["value"])
     if reasons:
-        srv["rejected_by_reason"] = dict(reasons)
+        from apex_tpu.serve.metrics import SHED_REASONS as _shed
+        srv["rejected_by_reason"] = {
+            (r if r in _shed else f"unknown:{r}"): n
+            for r, n in reasons.items()}
     for fam, key in (("serve/ttft", "ttft_s"),
-                     ("serve/intertoken", "intertoken_s")):
+                     ("serve/intertoken", "intertoken_s"),
+                     ("serve/step", "engine_step_s")):
         durs = [r["dur_s"] for r in rows if r["family"] == fam]
         if durs:
             srv[key] = _series_stats(durs)
+    # per-request SLO view: join req/* lifecycle events into records
+    # and report percentiles/attainment + the top violators with
+    # per-phase attribution (serve/slo.describe)
+    from apex_tpu.telemetry import requests as _requests
+    req_records = _requests.join(events)
+    if req_records:
+        from apex_tpu.serve import slo as _slo
+        desc = _slo.describe(req_records)
+        if desc:
+            srv["requests"] = desc
     if srv:
         out["serve"] = srv
+
+    # goodput ledger (telemetry.ledger): membership-event time
+    # accounting for elastic training runs, wasted-token pricing for
+    # serve runs — one section, both producers
+    from apex_tpu.telemetry import ledger as _ledger
+    led = _ledger.compute(events)
+    if led:
+        out["ledger"] = led
 
     # numerics health (producers: telemetry.health)
     health = _health_section(events, series, detect_kwargs=health_detect)
@@ -659,6 +690,11 @@ def _reconciliation(out: Dict[str, Any], rows: List[Dict[str, Any]],
         if fam in ("step/dispatch", "profile/step") \
                 or fam in _trace.DEVICE_WAIT_FAMILIES \
                 or fam in _trace.CONCURRENT_FAMILIES:
+            continue
+        if fam.startswith(("serve/", "req/")):
+            # serving spans are request lifecycle intervals (many
+            # overlapping per engine step) — billing them as per-step
+            # wall components would over-attribute by construction
             continue
         components[fam] = sum(durs) / (steps * n_procs)
     attributed = sum(components.values())
@@ -1059,16 +1095,24 @@ def format_summary(s: Dict[str, Any]) -> str:
         lines.append("serving (apex_tpu.serve):")
         ledger = [f"{k} {sv[k]}" for k in
                   ("admitted", "completed", "rejected", "expired",
-                   "tokens") if k in sv]
+                   "expired_inflight", "tokens") if k in sv]
         if ledger:
             lines.append("  " + "   ".join(ledger))
+        if sv.get("prefill_tokens") or sv.get("decode_tokens"):
+            pf = sv.get("prefill_tokens", 0)
+            dc = sv.get("decode_tokens", 0)
+            tot = pf + dc
+            mix = f" ({100.0 * pf / tot:.1f}% prefill)" if tot else ""
+            lines.append(
+                f"  token mix: prefill {pf}   decode {dc}{mix}")
         if sv.get("rejected_by_reason"):
             lines.append("  shed reasons: " + ", ".join(
                 f"{r}={n}" for r, n in
                 sorted(sv["rejected_by_reason"].items())))
         for key, label, scale, unit in (
                 ("ttft_s", "ttft", 1e3, "ms"),
-                ("intertoken_s", "inter-token", 1e3, "ms")):
+                ("intertoken_s", "inter-token", 1e3, "ms"),
+                ("engine_step_s", "engine step", 1e3, "ms")):
             t = sv.get(key)
             if t:
                 lines.append(
@@ -1078,12 +1122,53 @@ def format_summary(s: Dict[str, Any]) -> str:
                     f"   max {t['max'] * scale:9.2f}")
         for key, label in (("queue_depth", "queue depth"),
                            ("occupancy", "occupancy"),
-                           ("tokens_per_s", "tokens/s")):
+                           ("slot_active", "slots active"),
+                           ("tokens_per_s", "tokens/s"),
+                           ("kv_used_pages", "kv used pages"),
+                           ("kv_free_pages", "kv free pages"),
+                           ("kv_occupancy", "kv occupancy"),
+                           ("kv_fragmentation", "kv fragment'n")):
             t = sv.get(key)
             if t:
-                lines.append(f"  {label:<12} mean {t['mean']:9.2f}"
+                lines.append(f"  {label:<13} mean {t['mean']:9.2f}"
                              f"   p50 {t['p50']:9.2f}"
                              f"   max {t['max']:9.2f}")
+        rq = sv.get("requests")
+        if rq:
+            states = ", ".join(f"{k}={v}" for k, v in
+                               sorted(rq["by_state"].items()))
+            lines.append(f"  requests (slo): {rq['requests']} "
+                         f"terminal ({states})")
+            for mkey, label in (("ttft_ms", "ttft"),
+                                ("tpot_ms", "tpot"),
+                                ("e2e_ms", "e2e")):
+                t = rq.get(mkey)
+                if t:
+                    lines.append(
+                        f"    {label:<6} n={t['n']:<5}"
+                        f" p50 {t['p50']:9.2f} ms"
+                        f"   p99 {t['p99']:9.2f}"
+                        f"   max {t['max']:9.2f}")
+            if rq.get("deadline_attainment") is not None:
+                lines.append(
+                    f"    deadline attainment "
+                    f"{rq['deadline_attainment'] * 100:.2f}%"
+                    + (f"   goodput {rq['goodput']:.4f}"
+                       if rq.get("goodput") is not None else ""))
+            for v in rq.get("top_violators") or []:
+                phases = ", ".join(
+                    f"{k[:-3]}={v[k]:.1f}ms" for k in
+                    ("queued_ms", "prefill_ms", "decode_ms")
+                    if v.get(k) is not None)
+                tail = f" shed={v['reason']}" if v.get("reason") else ""
+                e2e = ("n/a" if v.get("e2e_ms") is None
+                       else f"{v['e2e_ms']:.1f}ms")
+                lines.append(
+                    f"    violator r{v['rid']} [{v['state']}{tail}] "
+                    f"e2e={e2e} ({phases or 'no phases observed'})")
+    if s.get("ledger"):
+        from apex_tpu.telemetry import ledger as _ledger
+        lines.extend(_ledger.format_ledger(s["ledger"]))
     if s.get("reconciliation"):
         rc = s["reconciliation"]
         res_pct = rc.get("residual_pct")

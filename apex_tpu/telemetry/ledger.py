@@ -1,0 +1,300 @@
+"""Unified goodput ledger — useful work ÷ reserved capacity, for both
+the trainer's elastic fleet and the serving engine (ROADMAP item 6).
+
+One abstraction, two consumers:
+
+  * **train**: the membership timeline (``resilience/resume`` /
+    ``resilience/reshard`` markers, ``rebalance/*`` events,
+    ``resilience/preempted``) crossed with the per-step wall clock
+    (any ``*/time_s`` series) yields time lost to each membership
+    event: the STALL around the event (wall gap between the bracketing
+    steps beyond the run's median step cadence) plus the DEGRADED
+    capacity while running below the largest world seen (a W-1 segment
+    burns 1/W of the fleet's reservation for its whole duration).
+    ``telemetry summarize`` renders this as the goodput section naming
+    time lost per event.
+  * **serve**: per-request records (``telemetry.requests.join``) price
+    wasted decode work — tokens of completed requests ÷ tokens decoded
+    (expired-in-flight requests decoded tokens nobody will read), and
+    request goodput with shed work counted against the denominator.
+
+Everything here is OFFLINE arithmetic over an event list — no emission
+and no device work. The ``ledger/*`` static family (docs/telemetry.md)
+is the optional RE-EMISSION of a computed serve ledger into a run's
+telemetry (``emit_serve``), which the serve bench uses so the JSONL is
+self-describing.
+"""
+
+from __future__ import annotations
+
+import statistics
+from typing import Any, Dict, List, Optional
+
+# event-name suffixes that mark a fleet-membership change (matched with
+# endswith, like the summarize resilience section)
+MEMBERSHIP_EVENTS = ("resilience/resume", "resilience/reshard",
+                     "rebalance/apply", "rebalance/evict",
+                     "resilience/preempted")
+
+LEDGER_TOKENS_DECODED = "ledger/tokens_decoded"
+LEDGER_TOKENS_USEFUL = "ledger/tokens_useful"
+LEDGER_TOKENS_WASTED = "ledger/tokens_wasted"
+LEDGER_GOODPUT_TOKENS = "ledger/goodput_tokens"
+LEDGER_GOODPUT_REQUESTS = "ledger/goodput_requests"
+
+
+def _step_samples(events: List[dict]) -> List[dict]:
+    """Per-step wall-clock samples from the run's ``*/time_s`` series —
+    ``step/time_s`` preferred (the trainer's synced step time), else
+    the first suffix-matching series. One sample per step: earliest ts
+    (multi-process merged streams carry one row per process)."""
+    by_name: Dict[str, List[dict]] = {}
+    for e in events:
+        name = e.get("name", "")
+        if (e.get("kind", "point") == "point" and e.get("step") is not None
+                and name.endswith("/time_s")):
+            by_name.setdefault(name, []).append(e)
+    if not by_name:
+        return []
+    # preference: the trainer's device-synced step/time_s, then any
+    # namespaced *step/time_s (resilient_loop's host-side sample), then
+    # the first sorted name — deterministic regardless of file order
+    if "step/time_s" in by_name:
+        pick = "step/time_s"
+    else:
+        pick = next((n for n in sorted(by_name)
+                     if n.endswith("step/time_s")), sorted(by_name)[0])
+    per_step: Dict[int, dict] = {}
+    for e in by_name[pick]:
+        s = int(e["step"])
+        if s not in per_step or e["ts"] < per_step[s]["ts"]:
+            per_step[s] = e
+    return [per_step[s] for s in sorted(per_step)]
+
+
+def _membership_rows(events: List[dict]) -> List[dict]:
+    rows = [e for e in events
+            if any(e.get("name", "").endswith(m)
+                   for m in MEMBERSHIP_EVENTS)]
+    rows.sort(key=lambda e: float(e.get("ts", 0.0)))
+    return rows
+
+
+def _event_detail(e: dict) -> str:
+    name = e.get("name", "")
+    meta = e.get("meta") or {}
+    if name.endswith("resilience/reshard"):
+        return (f"reshard world {meta.get('from_world', '?')} -> "
+                f"{meta.get('to_world', '?')}")
+    if name.endswith("resilience/resume"):
+        return (f"resume generation {meta.get('generation', '?')} "
+                f"at step {meta.get('step', e.get('step', '?'))}")
+    if name.endswith("rebalance/apply"):
+        return f"rebalance weights {meta.get('weights', '?')}"
+    if name.endswith("rebalance/evict"):
+        return f"evict rank {meta.get('straggler_rank', '?')}"
+    if name.endswith("resilience/preempted"):
+        return f"preempted ({meta.get('reason', '?')})"
+    return name
+
+
+def train_ledger(events: List[dict]) -> Optional[Dict[str, Any]]:
+    """Membership-event time accounting. None when the stream has no
+    membership events or too few step samples to establish a cadence.
+
+    All losses are expressed in EQUIVALENT FULL-FLEET SECONDS so stall
+    and degraded-capacity terms add: a stall idles the whole fleet for
+    its duration; a segment at world w < W loses ``dur * (1 - w/W)``.
+    ``goodput = 1 - lost/wall``."""
+    marks = _membership_rows(events)
+    steps = _step_samples(events)
+    if not marks or len(steps) < 3:
+        return None
+    ts0, ts1 = steps[0]["ts"], steps[-1]["ts"]
+    wall = ts1 - ts0
+    if wall <= 0:
+        return None
+    gaps = [b["ts"] - a["ts"] for a, b in zip(steps, steps[1:])]
+    cadence = statistics.median(gaps)
+
+    # world timeline: segments opened by reshard markers (the only
+    # events that change the member count); the pre-event world comes
+    # from the first reshard's from_world, defaulting to 1-segment
+    # full-capacity when no reshard ever fired
+    worlds = []          # (start_ts, world, opening event index or None)
+    first_world = None
+    for e in marks:
+        if e.get("name", "").endswith("resilience/reshard"):
+            meta = e.get("meta") or {}
+            if first_world is None and meta.get("from_world") is not None:
+                first_world = float(meta["from_world"])
+    if first_world is None:
+        first_world = 1.0
+    worlds.append((ts0, first_world, None))
+    for i, e in enumerate(marks):
+        if e.get("name", "").endswith("resilience/reshard"):
+            meta = e.get("meta") or {}
+            w = meta.get("to_world")
+            if w is None:
+                w = e.get("value")
+            worlds.append((float(e.get("ts", ts0)), float(w), i))
+    max_world = max(w for _, w, _ in worlds)
+
+    entries = []
+    billed_gaps = set()
+    for i, e in enumerate(marks):
+        t = float(e.get("ts", ts0))
+        prev = next((s for s in reversed(steps) if s["ts"] <= t), None)
+        nxt = next((s for s in steps if s["ts"] >= t), None)
+        stall = 0.0
+        if prev is not None and nxt is not None and nxt is not prev:
+            # a restart emits several co-located markers (preempted,
+            # then resume + reshard) inside ONE step gap — bill that
+            # gap's stall once, to the earliest marker in it
+            gap = (prev["ts"], nxt["ts"])
+            if gap not in billed_gaps:
+                billed_gaps.add(gap)
+                stall = max(0.0, (nxt["ts"] - prev["ts"]) - cadence)
+        entries.append({
+            "kind": e.get("name", "").rsplit("/", 1)[-1],
+            "name": e.get("name"), "step": e.get("step"),
+            "t_s": round(t - ts0, 3), "detail": _event_detail(e),
+            "stall_s": round(stall, 4), "degraded_s": 0.0,
+            "lost_s": round(stall, 4)})
+
+    # degraded capacity per segment, attributed to the opening event
+    for seg_idx, (start, w, opener) in enumerate(worlds):
+        end = (worlds[seg_idx + 1][0] if seg_idx + 1 < len(worlds)
+               else ts1)
+        dur = max(0.0, min(end, ts1) - max(start, ts0))
+        lost_frac = 1.0 - (w / max_world if max_world > 0 else 1.0)
+        if opener is None or dur <= 0 or lost_frac <= 0:
+            continue
+        deg = dur * lost_frac
+        entries[opener]["degraded_s"] = round(
+            entries[opener]["degraded_s"] + deg, 4)
+        entries[opener]["lost_s"] = round(
+            entries[opener]["stall_s"] + entries[opener]["degraded_s"],
+            4)
+
+    lost = sum(en["lost_s"] for en in entries)
+    return {
+        "wall_s": round(wall, 4),
+        "steps": len(steps),
+        "step_s_median": round(cadence, 6),
+        "max_world": max_world,
+        "events": entries,
+        "lost_s_total": round(lost, 4),
+        "goodput": round(max(0.0, 1.0 - lost / wall), 4),
+    }
+
+
+def _serve_account(records: List[dict]) -> Dict[str, Any]:
+    decoded = useful = wasted = 0
+    completed = shed = expired_inflight = 0
+    good_req = 0
+    for r in records:
+        toks = int(r.get("tokens") or 0)
+        decoded += toks
+        if r["state"] == "done":
+            completed += 1
+            useful += toks
+            if r.get("in_deadline") is not False:
+                good_req += 1
+        elif r["state"] == "expired":
+            expired_inflight += 1
+            wasted += toks
+        elif r["state"] == "rejected":
+            shed += 1
+    n = len(records)
+    return {
+        "requests": n,
+        "completed": completed,
+        "shed": shed,
+        "expired_inflight": expired_inflight,
+        "tokens_decoded": decoded,
+        "tokens_useful": useful,
+        "tokens_wasted": wasted,
+        "goodput_tokens": (round(useful / decoded, 4) if decoded
+                           else None),
+        "goodput_requests": round(good_req / n, 4) if n else None,
+    }
+
+
+def serve_ledger(events: List[dict]) -> Optional[Dict[str, Any]]:
+    """Token-level goodput of a serving run: useful tokens (completed
+    requests) over decoded tokens, wasted work priced per cause. None
+    when the stream has no ``req/*`` records."""
+    from apex_tpu.telemetry import requests as _requests
+    records = _requests.join(events)
+    if not records:
+        return None
+    return _serve_account(records)
+
+
+def serve_ledger_from_requests(reqs) -> Dict[str, Any]:
+    """Same account, computed from live ``serve.engine.Request``
+    objects (the bench path — no telemetry sink required)."""
+    from apex_tpu.serve import slo as _slo
+    return _serve_account(_slo.records_from_requests(reqs))
+
+
+def emit_serve(led: Dict[str, Any]) -> None:
+    """Re-emit a computed serve ledger as ``ledger/*`` statics so the
+    run's JSONL is self-describing (no-op when telemetry is off)."""
+    from apex_tpu.telemetry import record_static
+    record_static(LEDGER_TOKENS_DECODED, led["tokens_decoded"])
+    record_static(LEDGER_TOKENS_USEFUL, led["tokens_useful"])
+    record_static(LEDGER_TOKENS_WASTED, led["tokens_wasted"])
+    if led.get("goodput_tokens") is not None:
+        record_static(LEDGER_GOODPUT_TOKENS, led["goodput_tokens"])
+    if led.get("goodput_requests") is not None:
+        record_static(LEDGER_GOODPUT_REQUESTS, led["goodput_requests"])
+
+
+def compute(events: List[dict]) -> Dict[str, Any]:
+    """The summarize entry point: both sides, keys present only when
+    the stream carries the corresponding producers."""
+    out: Dict[str, Any] = {}
+    t = train_ledger(events)
+    if t is not None:
+        out["train"] = t
+    s = serve_ledger(events)
+    if s is not None:
+        out["serve"] = s
+    return out
+
+
+def format_ledger(led: Dict[str, Any]) -> List[str]:
+    """Text lines for ``telemetry summarize`` (format_summary)."""
+    lines: List[str] = ["goodput ledger:"]
+    t = led.get("train")
+    if t:
+        lines.append(
+            f"  train: wall {t['wall_s']:.1f}s over {t['steps']} steps "
+            f"(median step {t['step_s_median'] * 1e3:.1f}ms), "
+            f"max world {t['max_world']:g}")
+        for en in t["events"]:
+            lines.append(
+                f"    t+{en['t_s']:.1f}s {en['detail']}: lost "
+                f"{en['lost_s']:.2f}s (stall {en['stall_s']:.2f}s + "
+                f"degraded {en['degraded_s']:.2f}s)")
+        lines.append(
+            f"  train goodput: {t['goodput']:.4f} "
+            f"({t['lost_s_total']:.2f}s of {t['wall_s']:.1f}s lost to "
+            f"{len(t['events'])} membership events)")
+    s = led.get("serve")
+    if s:
+        gp = s.get("goodput_tokens")
+        lines.append(
+            f"  serve: {s['tokens_useful']}/{s['tokens_decoded']} "
+            f"decoded tokens useful "
+            f"(goodput {'n/a' if gp is None else format(gp, '.4f')}; "
+            f"{s['tokens_wasted']} wasted by "
+            f"{s['expired_inflight']} in-flight expiries, "
+            f"{s['shed']} requests shed)")
+        if s.get("goodput_requests") is not None:
+            lines.append(
+                f"  serve request goodput: {s['goodput_requests']:.4f} "
+                f"({s['completed']}/{s['requests']} completed)")
+    return lines

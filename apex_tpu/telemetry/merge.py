@@ -50,19 +50,25 @@ def process_label(path: str, index: int) -> str:
 
 def step_anchors(events: Sequence[Dict[str, Any]]) -> Dict[int, float]:
     """``{step: begin wall-ts}`` from this stream's step-start spans
-    (``span/step/dispatch`` end events: begin = ts − duration). Streams
-    recorded without tracing fall back to ONE ``*/time_s`` point series
-    — ``step/time_s`` when present, else the first sorted name (same
-    begin arithmetic). One series only: anchoring each step on whichever
-    ``/time_s`` name happened to appear first in the file would compute
-    offsets from MISMATCHED series when two processes' files interleave
-    them differently (the blended-loss-series lesson)."""
-    out: Dict[int, float] = {}
-    for r in _trace.span_rows(events):
-        if r["family"] == "step/dispatch" and r["step"] is not None:
-            out.setdefault(int(r["step"]), r["ts"] - r["dur_s"])
-    if out:
-        return out
+    (``span/step/dispatch`` end events: begin = ts − duration). Serving
+    streams carry no trainer dispatch spans — their ``span/serve/step``
+    engine-dispatch spans (step = the engine sequence number) anchor on
+    the same median-offset path. Streams recorded without tracing fall
+    back to ONE ``*/time_s`` point series — ``step/time_s`` when
+    present, else the first sorted name (same begin arithmetic). One
+    series only: anchoring each step on whichever ``/time_s`` name
+    happened to appear first in the file would compute offsets from
+    MISMATCHED series when two processes' files interleave them
+    differently (the blended-loss-series lesson)."""
+    rows = _trace.span_rows(events)
+    for anchor_family in ("step/dispatch", "serve/step"):
+        out: Dict[int, float] = {}
+        for r in rows:
+            if r["family"] == anchor_family and r["step"] is not None:
+                out.setdefault(int(r["step"]), r["ts"] - r["dur_s"])
+        if out:
+            return out
+    out = {}
     by_name: Dict[str, Dict[int, float]] = {}
     for e in events:
         if (e.get("kind", "point") == "point"

@@ -1,0 +1,132 @@
+"""Offline join of ``req/*`` lifecycle events into one record per
+request.
+
+The serving engine emits one ``kind="req"`` event per lifecycle
+transition (submit -> admit/reject -> first token -> finish/expire; see
+serve/metrics.py for the family). Each event is a flat scalar fact —
+this module is the OFFLINE half: it folds a run's events back into one
+record per request, the shape the SLO engine (serve/slo.py), the
+goodput ledger (telemetry/ledger.py), and the summarize serve section
+all consume.
+
+Multi-process runs joined by ``telemetry.merge`` keep per-process rid
+spaces: records are keyed on ``(process, rid)`` (``meta.process`` is
+stamped by the merge; single-stream files key on process 0).
+
+Record schema (missing measurements are None, never absent):
+
+  rid, process, state (submitted|rejected|running|done|expired),
+  prompt_len, max_new, deadline_s, ts_submit (wall clock),
+  queued_s, prefill_s, decode_s, e2e_s, ttft_s, tpot_s,
+  tokens, slot, reason (shed reason, else None), in_deadline
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+REQ_KIND = "req"
+
+_FIELDS = ("rid", "process", "state", "prompt_len", "max_new",
+           "deadline_s", "ts_submit", "queued_s", "prefill_s",
+           "decode_s", "e2e_s", "ttft_s", "tpot_s", "tokens", "slot",
+           "reason", "in_deadline")
+
+
+def _blank(rid: int, process) -> Dict[str, Any]:
+    rec: Dict[str, Any] = {k: None for k in _FIELDS}
+    rec["rid"] = rid
+    rec["process"] = process
+    rec["state"] = "submitted"
+    return rec
+
+
+def join(events: List[dict]) -> List[dict]:
+    """Fold ``req/*`` events into one record per ``(process, rid)``.
+
+    Events are applied in timestamp order so a terminal state always
+    wins over the transitions that led to it. Returns records sorted by
+    (process, ts_submit, rid); an empty list when the stream carries no
+    ``req/*`` events (e.g. a training run)."""
+    rows = [e for e in events
+            if e.get("kind") == REQ_KIND
+            and str(e.get("name", "")).startswith("req/")]
+    rows.sort(key=lambda e: float(e.get("ts", 0.0)))
+    recs: Dict[Tuple[int, int], Dict[str, Any]] = {}
+    for e in rows:
+        meta = e.get("meta") or {}
+        rid = meta.get("rid")
+        if rid is None:
+            rid = int(e.get("value", -1))
+        rid = int(rid)
+        # merge_streams stamps the label as a STRING ("p0"); unmerged
+        # single-stream files have no label and key on 0
+        process = meta.get("process", 0)
+        rec = recs.setdefault((process, rid), _blank(rid, process))
+        name = e["name"]
+        if name == "req/submit":
+            rec["ts_submit"] = float(e.get("ts", 0.0))
+            for k in ("prompt_len", "max_new", "deadline_s"):
+                if meta.get(k) is not None:
+                    rec[k] = meta[k]
+        elif name == "req/reject":
+            rec["state"] = "rejected"
+            rec["reason"] = meta.get("reason")
+            if meta.get("queued_s") is not None:
+                rec["queued_s"] = float(meta["queued_s"])
+        elif name == "req/admit":
+            rec["state"] = "running"
+            rec["slot"] = meta.get("slot")
+            if meta.get("queued_s") is not None:
+                rec["queued_s"] = float(meta["queued_s"])
+        elif name == "req/first_token":
+            for k in ("ttft_s", "prefill_s"):
+                if meta.get(k) is not None:
+                    rec[k] = float(meta[k])
+            if meta.get("slot") is not None:
+                rec["slot"] = meta["slot"]
+        elif name == "req/finish":
+            rec["state"] = "done"
+            for k in ("queued_s", "prefill_s", "decode_s", "e2e_s",
+                      "ttft_s", "deadline_s"):
+                if meta.get(k) is not None:
+                    rec[k] = float(meta[k])
+            for k in ("tokens", "slot"):
+                if meta.get(k) is not None:
+                    rec[k] = int(meta[k])
+            if meta.get("in_deadline") is not None:
+                rec["in_deadline"] = bool(meta["in_deadline"])
+            if (rec["tokens"] is not None and rec["tokens"] > 1
+                    and rec["decode_s"] is not None):
+                rec["tpot_s"] = rec["decode_s"] / (rec["tokens"] - 1)
+        elif name == "req/expire_inflight":
+            rec["state"] = "expired"
+            rec["in_deadline"] = False
+            if meta.get("tokens") is not None:
+                rec["tokens"] = int(meta["tokens"])
+            if meta.get("e2e_s") is not None:
+                rec["e2e_s"] = float(meta["e2e_s"])
+            if meta.get("slot") is not None:
+                rec["slot"] = meta["slot"]
+    out = list(recs.values())
+    out.sort(key=lambda r: (str(r["process"]),
+                            r["ts_submit"] if r["ts_submit"] is not None
+                            else float("inf"),
+                            r["rid"]))
+    return out
+
+
+def by_state(records: List[dict]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for r in records:
+        counts[r["state"]] = counts.get(r["state"], 0) + 1
+    return counts
+
+
+def phase_attribution(rec: dict) -> Dict[str, Optional[float]]:
+    """Where one request's time went — the queued/prefill/decode split
+    the SLO violator table renders (a shed request has only queue
+    time)."""
+    return {"queued_s": rec.get("queued_s"),
+            "prefill_s": rec.get("prefill_s"),
+            "decode_s": rec.get("decode_s")}

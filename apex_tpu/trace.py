@@ -214,9 +214,11 @@ def emit_span(name: str, begin: float, end: float, *,
 def span_rows(events: Iterable) -> List[Dict[str, Any]]:
     """Completed spans from an event stream (dicts or Events): one row
     per END event — ``{name, family, dur_s, begin_mono, end_mono, ts,
-    step, tid, thread, depth, process}``. Begin events (crash forensics)
-    are skipped; a span that never ended therefore never shows a bogus
-    duration."""
+    step, tid, thread, depth, process, rid, slot}``. Begin events
+    (crash forensics) are skipped; a span that never ended therefore
+    never shows a bogus duration. ``rid``/``slot`` are the serving
+    request attribution (None on trainer spans) — the pyprof timeline's
+    request lanes key on them."""
     rows: List[Dict[str, Any]] = []
     for e in events:
         d = e.to_dict() if isinstance(e, _ev.Event) else e
@@ -239,6 +241,8 @@ def span_rows(events: Iterable) -> List[Dict[str, Any]]:
             "thread": meta.get("thread", ""),
             "depth": meta.get("depth", 0),
             "process": meta.get("process"),
+            "rid": meta.get("rid"),
+            "slot": meta.get("slot"),
         })
     return rows
 

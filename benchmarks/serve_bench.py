@@ -5,6 +5,9 @@ Runs :func:`apex_tpu.serve.bench.run_bench` (continuous-batching engine
 over the paged KV cache) and writes one JSON row: steady-state decode
 tokens/s, p50/p99 time-to-first-token and inter-token latency, and the
 2x-overload admission ledger (admitted / rejected / expired / goodput).
+Every row carries stable ``slo`` (null unless ``--slo SPEC.json``
+scores the run) and ``ledger`` (token-goodput accounting) keys —
+unmeasured values are null, never absent.
 
 Model source, in preference order:
 
@@ -110,11 +113,30 @@ def main(argv=None) -> int:
     p.add_argument("--embed-dim", type=int, default=128)
     p.add_argument("--heads", type=int, default=4)
     p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--slo", default=None, metavar="SPEC.json",
+                   help="score the run against an SLO spec "
+                        "(apex_tpu.serve.slo); fills the row's 'slo' "
+                        "key (null without this flag)")
+    p.add_argument("--telemetry", default=None, metavar="PATH",
+                   help="also write serve/* + req/* telemetry events "
+                        "to a JSONL")
     p.add_argument("--out", default=None, metavar="PATH",
                    help="row path (default: next SERVE_r*.json)")
     args = p.parse_args(argv)
 
     from apex_tpu import serve
+    if args.telemetry:
+        from apex_tpu import telemetry, trace
+        telemetry.enable()
+        trace.enable()
+    spec = None
+    if args.slo:
+        from apex_tpu.serve.slo import SLOSpec
+        try:
+            spec = SLOSpec.from_file(args.slo)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"serve_bench: bad SLO spec: {e}", file=sys.stderr)
+            return 1
     try:
         if args.snapshot_dir:
             loaded = serve.load_model(args.snapshot_dir,
@@ -132,11 +154,16 @@ def main(argv=None) -> int:
             max_new=args.max_new, max_batch=args.max_batch,
             page=args.page, in_flight=args.in_flight,
             overload=not args.no_overload, deadline_s=args.deadline_s,
-            seed=args.seed)
+            slo=spec, seed=args.seed)
     except ValueError as e:
         print(f"serve_bench: {e}", file=sys.stderr)
         return 1
 
+    if args.telemetry:
+        from apex_tpu import telemetry
+        telemetry.write_jsonl(args.telemetry)
+        print(f"serve_bench: telemetry -> {args.telemetry}",
+              file=sys.stderr)
     out_path = args.out or _next_round_path()
     with open(out_path, "w") as f:
         json.dump(report, f, indent=1, sort_keys=True)
@@ -151,6 +178,13 @@ def main(argv=None) -> int:
         print(f"serve_bench: overload {ov['requests']} reqs -> "
               f"admitted {ov['admitted']}, rejected {ov['rejected']}, "
               f"goodput {ov['goodput']:.2f}")
+    if report.get("slo") is not None:
+        print("serve_bench: slo "
+              + ("MET" if report["slo"]["met"] else "VIOLATED"))
+    led = report.get("ledger")
+    if led and led.get("goodput_tokens") is not None:
+        print(f"serve_bench: token goodput {led['goodput_tokens']:.3f} "
+              f"({led['tokens_wasted']} wasted)")
     print(f"row -> {out_path}")
     return 0
 

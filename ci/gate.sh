@@ -783,6 +783,18 @@ python -m apex_tpu.resilience inspect "$ELA_DIR/snap-r0" --check 1 \
     | grep -q "world 1: OK" \
     || { echo "inspect --check 1 did not confirm re-shardability" >&2; \
          exit 1; }
+# goodput ledger (ROADMAP item 6): the resumed run's summarize must
+# NAME the time lost to the membership event — the world 2 -> 1
+# reshard leaves the survivor degraded to half the fleet's reservation
+python -m apex_tpu.telemetry summarize "$ELA_DIR/tel-r0.jsonl" \
+    > "$ELA_DIR/summary.out"
+grep -q "goodput ledger:" "$ELA_DIR/summary.out" \
+    || { echo "elastic: summarize has no goodput ledger" >&2; \
+         cat "$ELA_DIR/summary.out" >&2; exit 1; }
+grep -q "reshard world 2 -> 1" "$ELA_DIR/summary.out" \
+    || { echo "elastic: ledger does not name the reshard" >&2; exit 1; }
+grep -q "train goodput:" "$ELA_DIR/summary.out" \
+    || { echo "elastic: ledger has no train goodput line" >&2; exit 1; }
 rm -rf "$ELA_DIR"
 
 echo "== 17/20 rebalance smoke (slow_node straggler -> weighted re-shard -> exit-75 eviction -> world 1) =="
@@ -955,15 +967,21 @@ else:
 PY
 rm -rf "$PLAN_DIR"
 
-echo "== 19/20 serve smoke (train snapshot -> paged continuous-batching bench -> shed gate) =="
+echo "== 19/20 serve smoke (train snapshot -> paged continuous-batching bench -> shed + SLO gates) =="
 # The serving stack end to end (docs/serve.md): train a tiny LM to a
 # final snapshot (the manifest records the model spec for the serve
 # loader), run the serve CLI bench (50 requests over the 8-device CPU
 # mesh) against it with telemetry, and assert the honest-service
 # invariants: every steady request completes, the 2x-overload phase
 # really sheds (rejected > 0), the latency percentiles are finite, and
-# the serve/* events render a summarize section. A final run piped into
-# `head` exercises the CLI's BrokenPipeError guard.
+# the serve/* + req/* events render a summarize section with the SLO
+# subsection and the goodput ledger. The `serve slo` CLI exit contract
+# is pinned on the SAME run: a generous spec must exit 0 and a doctored
+# impossible spec must exit 3 (never a flat "pass"). Healthy targets
+# use p50 — the overload phase sheds ~1/3 of the population, so p99 is
+# legitimately unbounded (+inf: shed = miss) even on a healthy run. A
+# final run piped into `head` exercises the CLI's BrokenPipeError
+# guard.
 SERVE_DIR="$(mktemp -d)"
 python examples/gpt/train_lm.py --steps 3 --vocab 64 --layers 2 \
     --embed-dim 64 --heads 4 --seq-len 64 --batch 8 \
@@ -985,19 +1003,42 @@ ov = row["overload"]
 assert ov["requests"] == 100 and ov["rejected"] > 0, ov
 assert ov["admitted"] + ov["rejected"] == 100, ov
 assert 0.0 <= ov["goodput"] <= 1.0, ov
+# admitted work completes or expires mid-decode, never strands; both
+# expiry paths are accounted (queued sheds vs in-flight deadline cuts)
+assert ov["stranded"] == 0, ov
+assert ov["expired_total"] == ov["expired"] + ov["expired_inflight"], ov
+# the row's observability keys are stable (null, never absent)
+assert "slo" in row and row["slo"] is None, "no --slo spec -> null"
+led = row["ledger"]
+assert led["tokens_decoded"] >= led["tokens_useful"] > 0, led
 print(f"serve bench OK: {st['tokens_per_s']:.1f} tok/s steady, "
       f"overload rejected {ov['rejected']}/100, "
-      f"goodput {ov['goodput']:.2f}")
+      f"goodput {ov['goodput']:.2f}, "
+      f"token goodput {led['goodput_tokens']}")
 PY
 python -m apex_tpu.telemetry summarize "$SERVE_DIR/serve.jsonl" \
     > "$SERVE_DIR/summary.out"
 grep -q "serving (apex_tpu.serve):" "$SERVE_DIR/summary.out"
 grep -q "shed reasons: queue_full=" "$SERVE_DIR/summary.out"
+grep -q "requests (slo):" "$SERVE_DIR/summary.out"
+grep -q "kv occupancy" "$SERVE_DIR/summary.out"
+grep -q "goodput ledger:" "$SERVE_DIR/summary.out"
+# SLO exit contract on the recorded run: generous spec -> 0 (healthy),
+# doctored impossible spec -> 3 (violated). Both sides must trip — a
+# gate that can only pass proves nothing.
+python -m apex_tpu.serve slo "$SERVE_DIR/serve.jsonl" \
+    --e2e-p50-ms 600000 --ttft-p50-ms 600000 > "$SERVE_DIR/slo_ok.out"
+python -m apex_tpu.serve slo "$SERVE_DIR/serve.jsonl" \
+    --ttft-p50-ms 0.0001 > "$SERVE_DIR/slo_bad.out" \
+    && { echo "FAIL: impossible SLO spec did not exit 3"; exit 1; } \
+    || [[ $? -eq 3 ]]
+grep -q "MET" "$SERVE_DIR/slo_ok.out"
+grep -q "VIOLATED" "$SERVE_DIR/slo_bad.out"
 # early-closing reader (pipe into head) must still exit 0
 python -m apex_tpu.serve bench --snapshot-dir "$SERVE_DIR/ckpt" \
     --requests 4 --prompt-len 4 --max-new 2 --no-overload \
     2>/dev/null | head -c 64 > /dev/null
-echo "serve smoke OK (bench + shed + summarize + pipe guard)"
+echo "serve smoke OK (bench + shed + summarize + slo gate + pipe guard)"
 rm -rf "$SERVE_DIR"
 
 echo "== 20/20 pytest =="
@@ -1022,7 +1063,8 @@ else
         tests/test_plan.py tests/test_lint_mem.py \
         tests/test_serve_kvcache.py tests/test_serve_decode.py \
         tests/test_serve_engine.py tests/test_serve_loader.py \
-        tests/test_serve_cli.py tests/test_plan_objective.py -q -x
+        tests/test_serve_cli.py tests/test_serve_obs.py \
+        tests/test_ledger.py tests/test_plan_objective.py -q -x
 fi
 
 echo "CI GATE PASSED"

@@ -1,0 +1,134 @@
+"""Unified goodput ledger (telemetry.ledger, ROADMAP item 6): train-
+side membership-event time accounting (stall + degraded capacity in
+equivalent full-fleet seconds), serve-side token goodput, the
+summarize rendering that names time lost per event, and the ledger/*
+re-emission."""
+
+import pytest
+
+from apex_tpu import telemetry
+from apex_tpu.telemetry import ledger
+
+
+def _step(step, ts, value=1.0, name="step/time_s"):
+    return {"name": name, "value": value, "ts": ts, "step": step,
+            "kind": "point", "meta": {}}
+
+
+def _train_events():
+    """10 steps at a 1s cadence with a reshard (world 4 -> 3) that
+    stalls the run 3s at t=104.2 and leaves it degraded to the end, and
+    an earlier resume marker inside the normal cadence."""
+    ev = [_step(i, 100.0 + i) for i in range(5)]            # 100..104
+    ev.append({"name": "resilience/resume", "value": 1.0, "ts": 101.5,
+               "step": 1, "kind": "counter",
+               "meta": {"generation": 1, "step": 1,
+                        "path": "/snap/gen1"}})
+    ev.append({"name": "resilience/reshard", "value": 3.0, "ts": 104.2,
+               "step": 4, "kind": "counter",
+               "meta": {"from_world": 4, "to_world": 3,
+                        "reshard_s": 2.8}})
+    ev += [_step(5 + i, 107.0 + i) for i in range(5)]       # 107..111
+    return ev
+
+
+class TestTrainLedger:
+    def test_names_time_lost_per_membership_event(self):
+        led = ledger.train_ledger(_train_events())
+        assert led is not None
+        assert led["wall_s"] == pytest.approx(11.0)
+        assert led["step_s_median"] == pytest.approx(1.0)
+        assert led["max_world"] == 4.0
+        by_kind = {e["kind"]: e for e in led["events"]}
+        assert set(by_kind) == {"resume", "reshard"}
+        # the resume sat inside the normal cadence: no stall billed
+        assert by_kind["resume"]["lost_s"] == pytest.approx(0.0)
+        # the reshard: 3s gap - 1s cadence = 2s stall, plus the
+        # degraded 3/4-capacity tail 104.2 -> 111 = 6.8s * 1/4 = 1.7s
+        assert by_kind["reshard"]["stall_s"] == pytest.approx(2.0)
+        assert by_kind["reshard"]["degraded_s"] == pytest.approx(1.7)
+        assert by_kind["reshard"]["lost_s"] == pytest.approx(3.7)
+        assert by_kind["reshard"]["detail"] == "reshard world 4 -> 3"
+        assert led["lost_s_total"] == pytest.approx(3.7)
+        assert led["goodput"] == pytest.approx(1.0 - 3.7 / 11.0,
+                                               abs=1e-3)
+
+    def test_none_without_membership_events_or_cadence(self):
+        assert ledger.train_ledger(
+            [_step(i, 100.0 + i) for i in range(5)]) is None
+        assert ledger.train_ledger([
+            _step(0, 100.0),
+            {"name": "resilience/reshard", "value": 2.0, "ts": 100.5,
+             "step": 0, "kind": "counter",
+             "meta": {"from_world": 4, "to_world": 2}}]) is None
+
+    def test_summarize_renders_goodput_section(self):
+        s = telemetry.summarize(_train_events())
+        t = s["ledger"]["train"]
+        assert len(t["events"]) == 2
+        text = telemetry.format_summary(s)
+        assert "goodput ledger:" in text
+        assert "reshard world 4 -> 3" in text
+        assert "train goodput:" in text
+
+
+def _rec(rid, state="done", tokens=3, in_deadline=True):
+    return {"rid": rid, "process": 0, "state": state, "prompt_len": 4,
+            "max_new": 3, "deadline_s": 1.0, "ts_submit": 100.0 + rid,
+            "queued_s": 0.01, "prefill_s": 0.02, "decode_s": 0.03,
+            "e2e_s": 0.06, "ttft_s": 0.03, "tpot_s": 0.015,
+            "tokens": tokens, "slot": 0,
+            "reason": "queue_full" if state == "rejected" else None,
+            "in_deadline": in_deadline}
+
+
+class TestServeLedger:
+    def test_token_accounting(self):
+        recs = ([_rec(i) for i in range(3)]
+                + [_rec(3, state="expired", tokens=2,
+                        in_deadline=False)]
+                + [_rec(4, state="rejected", tokens=0)])
+        led = ledger._serve_account(recs)
+        assert led["requests"] == 5
+        assert led["completed"] == 3 and led["shed"] == 1
+        assert led["expired_inflight"] == 1
+        assert led["tokens_decoded"] == 11
+        assert led["tokens_useful"] == 9
+        assert led["tokens_wasted"] == 2
+        assert led["goodput_tokens"] == pytest.approx(9 / 11, abs=1e-3)
+        assert led["goodput_requests"] == pytest.approx(0.6)
+
+    def test_emit_serve_writes_ledger_statics(self):
+        led = ledger._serve_account([_rec(0)])
+        with telemetry.capture() as col:
+            ledger.emit_serve(led)
+        names = {e.name for e in col.drain()}
+        assert {ledger.LEDGER_TOKENS_DECODED, ledger.LEDGER_TOKENS_USEFUL,
+                ledger.LEDGER_TOKENS_WASTED,
+                ledger.LEDGER_GOODPUT_TOKENS,
+                ledger.LEDGER_GOODPUT_REQUESTS} <= names
+
+    def test_compute_keys_present_only_with_producers(self):
+        assert ledger.compute([_step(0, 1.0)]) == {}
+        both = _train_events()
+        both.append({"name": "req/submit", "value": 0.0, "ts": 200.0,
+                     "step": None, "kind": "req",
+                     "meta": {"rid": 0, "prompt_len": 4, "max_new": 2}})
+        both.append({"name": "req/finish", "value": 0.0, "ts": 200.5,
+                     "step": None, "kind": "req",
+                     "meta": {"rid": 0, "slot": 0, "tokens": 2,
+                              "decode_s": 0.1, "e2e_s": 0.5,
+                              "in_deadline": True}})
+        out = ledger.compute(both)
+        assert set(out) == {"train", "serve"}
+        assert out["serve"]["tokens_useful"] == 2
+
+    def test_format_ledger_text(self):
+        led = {"serve": ledger._serve_account(
+            [_rec(0), _rec(1, state="expired", tokens=1,
+                           in_deadline=False)])}
+        lines = ledger.format_ledger(led)
+        assert lines[0] == "goodput ledger:"
+        joined = "\n".join(lines)
+        assert "decoded tokens useful" in joined
+        assert "1 in-flight expiries" in joined

@@ -1,0 +1,415 @@
+"""Serving observability (PR 18): req/* lifecycle emission + offline
+join, expired-in-flight accounting, canonical shed reasons, the SLO
+engine + CLI exit contract (0 met / 3 violated / 1 bad input), the
+two-process clock-join on serve streams (committed fixture, known
++1.75s skew), the pyprof timeline's requests pid, the summarize serve
+section, and the disabled-telemetry jaxpr pin."""
+
+import itertools
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import telemetry, trace
+from apex_tpu.serve import metrics, slo
+from apex_tpu.serve.admission import AdmissionController
+from apex_tpu.serve.cli import main as serve_main
+from apex_tpu.serve.engine import Engine
+from apex_tpu.serve.loader import LoadedModel
+from apex_tpu.serve.model import ModelSpec
+from apex_tpu.telemetry import merge, requests
+
+VOCAB = 61
+FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures")
+P0 = os.path.join(FIXDIR, "serve_run-p0.jsonl")
+P1 = os.path.join(FIXDIR, "serve_run-p1.jsonl")
+
+
+@pytest.fixture(scope="module")
+def loaded():
+    spec = ModelSpec(vocab=VOCAB, layers=2, embed_dim=32, heads=4,
+                     max_seq=64)
+    lm = spec.model()
+    params = lm.init(jax.random.PRNGKey(3),
+                     jnp.zeros((1, 8), jnp.int32))["params"]
+    return LoadedModel(model=lm, params=params, spec=spec, step=0,
+                       generation=0, manifest={}, directory="<mem>")
+
+
+def _prompts(n, length=6):
+    return [[int(t) for t in np.asarray(jax.random.randint(
+        jax.random.PRNGKey(i), (length,), 0, VOCAB))] for i in range(n)]
+
+
+def _capture_run(loaded, n=4, max_new=3, **eng_kw):
+    """Run n requests through a fresh engine with telemetry+trace
+    captured; returns (requests, event dicts)."""
+    with telemetry.capture() as col:
+        trace.enable()
+        try:
+            eng = Engine(loaded, max_batch=2, page=8, max_context=16,
+                         max_prompt=8, in_flight=1, **eng_kw)
+            reqs = [eng.request(p, max_new) for p in _prompts(n)]
+            eng.run(reqs)
+        finally:
+            trace.disable()
+    return reqs, [e.to_dict() for e in col.drain()]
+
+
+# ---------------------------------------------------------------------------
+# request lifecycle events + offline join
+# ---------------------------------------------------------------------------
+
+class TestLifecycle:
+    def test_every_request_joins_to_a_done_record(self, loaded):
+        reqs, events = _capture_run(loaded, n=4, max_new=3)
+        recs = requests.join(events)
+        assert len(recs) == 4
+        assert {r["rid"] for r in recs} == {r.rid for r in reqs}
+        for rec in recs:
+            assert rec["state"] == "done"
+            assert rec["tokens"] == 3
+            assert rec["slot"] in (0, 1)
+            assert rec["prompt_len"] == 6 and rec["max_new"] == 3
+            # every phase measured, and they compose into e2e
+            for k in ("queued_s", "prefill_s", "decode_s", "e2e_s",
+                      "ttft_s", "tpot_s"):
+                assert rec[k] is not None and rec[k] >= 0.0, k
+            total = rec["queued_s"] + rec["prefill_s"] + rec["decode_s"]
+            assert total == pytest.approx(rec["e2e_s"], abs=0.05)
+            assert rec["ttft_s"] == pytest.approx(
+                rec["queued_s"] + rec["prefill_s"], abs=0.05)
+
+    def test_req_events_ride_kind_req(self, loaded):
+        """kind="req" keeps lifecycle events invisible to the existing
+        point/counter/span aggregations (summarize tables stay clean)."""
+        _, events = _capture_run(loaded, n=2)
+        req_rows = [e for e in events
+                    if str(e["name"]).startswith("req/")
+                    and e["kind"] == "req"]
+        assert {e["name"] for e in req_rows} >= {
+            metrics.REQ_SUBMIT, metrics.REQ_ADMIT, metrics.REQ_FIRST,
+            metrics.REQ_FINISH}
+        for e in req_rows:
+            assert e["meta"]["rid"] == int(e["value"])
+
+    def test_phase_spans_carry_rid_and_slot(self, loaded):
+        _, events = _capture_run(loaded, n=2)
+        rows = trace.span_rows(events)
+        fams = {r["family"] for r in rows}
+        assert {metrics.REQ_QUEUED, metrics.REQ_PREFILL,
+                metrics.REQ_DECODE, metrics.ENGINE_STEP,
+                metrics.TTFT} <= fams
+        for r in rows:
+            if r["family"].startswith("req/") or r["family"] in (
+                    metrics.TTFT, metrics.INTERTOKEN):
+                assert r["rid"] is not None
+        # engine-step spans carry the engine sequence as step
+        steps = [r["step"] for r in rows
+                 if r["family"] == metrics.ENGINE_STEP]
+        assert steps and all(s is not None for s in steps)
+
+    def test_kv_and_slot_gauges_emitted(self, loaded):
+        _, events = _capture_run(loaded, n=3)
+        names = {e["name"] for e in events}
+        assert {metrics.KV_USED_PAGES, metrics.KV_FREE_PAGES,
+                metrics.KV_OCCUPANCY, metrics.KV_FRAGMENTATION,
+                metrics.SLOT_ACTIVE, metrics.PREFILL_TOKENS,
+                metrics.DECODE_TOKENS} <= names
+        occ = [e["value"] for e in events
+               if e["name"] == metrics.KV_OCCUPANCY]
+        assert all(0.0 <= v <= 1.0 for v in occ)
+
+
+class TestExpiredInflight:
+    def test_mid_decode_expiry_is_counted_separately(self, loaded):
+        """A request whose deadline passes AFTER admission (1s fake-
+        clock decode steps, 0.5s deadline screened too late) ends
+        ``expired``, joins as such, and rides serve/expired_inflight —
+        not the queued-expiry counter."""
+        t = itertools.count()
+        clock = lambda: float(next(t))                  # noqa: E731
+        with telemetry.capture() as col:
+            trace.enable()
+            try:
+                adm = AdmissionController(max_queue=4, clock=clock)
+                eng = Engine(loaded, max_batch=1, page=8, max_context=16,
+                             max_prompt=8, in_flight=1, admission=adm,
+                             clock=clock)
+                req = eng.request(_prompts(1)[0], 4, deadline_s=2.5)
+                eng.run([req])
+            finally:
+                trace.disable()
+        events = [e.to_dict() for e in col.drain()]
+        assert req.state == "expired"
+        assert eng.expired_inflight == [req]
+        names = [e["name"] for e in events]
+        assert metrics.EXPIRED_INFLIGHT in names
+        assert metrics.REQ_EXPIRE_INFLIGHT in names
+        rec = requests.join(events)[0]
+        assert rec["state"] == "expired"
+        assert rec["in_deadline"] is False
+        assert rec["tokens"] >= 1          # wasted decode work recorded
+        # its pages were reclaimed: the engine can serve another request
+        nxt = eng.request(_prompts(2)[1], 2)
+        eng.run([nxt])
+        assert nxt.state == "done"
+
+
+class TestShedReasons:
+    def test_reasons_are_canonical(self):
+        assert metrics.SHED_REASONS == ("queue_full", "deadline",
+                                        "too_large")
+        for r in metrics.SHED_REASONS:
+            assert metrics.check_reason(r) == r
+        with pytest.raises(ValueError, match="unknown shed reason"):
+            metrics.check_reason("overloaded")
+
+    def test_admission_emits_canonical_reject_events(self, loaded):
+        with telemetry.capture() as col:
+            eng = Engine(loaded, max_batch=1, page=8, max_context=16,
+                         max_prompt=8, in_flight=1,
+                         admission=AdmissionController(max_queue=1))
+            reqs = [eng.request(p, 2) for p in _prompts(4)]
+            eng.run(reqs)
+        events = [e.to_dict() for e in col.drain()]
+        rejects = [e for e in events if e["name"] == metrics.REQ_REJECT]
+        assert rejects
+        for e in rejects:
+            assert e["meta"]["reason"] in metrics.SHED_REASONS
+        recs = requests.join(events)
+        assert {r["reason"] for r in recs
+                if r["state"] == "rejected"} == {"queue_full"}
+
+
+# ---------------------------------------------------------------------------
+# the disabled-telemetry contract
+# ---------------------------------------------------------------------------
+
+class TestDisabledInert:
+    def test_decode_jaxpr_identical_with_and_without_telemetry(
+            self, loaded):
+        """All observability is host-side Python around the jit: the
+        decode program must be jaxpr-identical whether telemetry is on
+        or off (the disabled path costs only no-op calls)."""
+        def decode_jaxpr():
+            eng = Engine(loaded, max_batch=2, page=8, max_context=16,
+                         max_prompt=8, in_flight=1)
+            active = jnp.zeros((eng.max_batch,), bool).at[0].set(True)
+            return str(jax.make_jaxpr(eng._decode_fn)(
+                eng.params, eng.pool, eng.last_tokens,
+                jnp.asarray(eng.block_tables),
+                jnp.asarray(eng.positions), active))
+
+        telemetry.disable()
+        off = decode_jaxpr()
+        with telemetry.capture():
+            trace.enable()
+            try:
+                on = decode_jaxpr()
+            finally:
+                trace.disable()
+        assert on == off
+
+    def test_disabled_run_emits_nothing(self, loaded):
+        telemetry.disable()
+        col = telemetry.get_collector()
+        col.drain()                                # flush leftovers
+        eng = Engine(loaded, max_batch=1, page=8, max_context=16,
+                     max_prompt=8, in_flight=1)
+        reqs = [eng.request(p, 2) for p in _prompts(2)]
+        eng.run(reqs)
+        assert all(r.state == "done" for r in reqs)
+        assert col.drain() == []
+
+
+# ---------------------------------------------------------------------------
+# SLO engine + CLI exit contract
+# ---------------------------------------------------------------------------
+
+def _rec(rid, state="done", **kw):
+    base = {"rid": rid, "process": 0, "state": state, "prompt_len": 4,
+            "max_new": 3, "deadline_s": 1.0, "ts_submit": 100.0 + rid,
+            "queued_s": 0.01, "prefill_s": 0.02, "decode_s": 0.03,
+            "e2e_s": 0.06, "ttft_s": 0.03, "tpot_s": 0.015, "tokens": 3,
+            "slot": 0, "reason": None, "in_deadline": True}
+    if state == "rejected":
+        base.update({k: None for k in
+                     ("prefill_s", "decode_s", "e2e_s", "ttft_s",
+                      "tpot_s", "slot", "in_deadline")},
+                    tokens=0, reason="queue_full", queued_s=0.0)
+    base.update(kw)
+    return base
+
+
+class TestSLO:
+    def test_spec_rejects_unknown_keys(self):
+        with pytest.raises(ValueError, match="unknown SLO spec keys"):
+            slo.SLOSpec.from_dict({"ttft_p95_ms": 1.0})
+
+    def test_met_and_violated(self):
+        recs = [_rec(i) for i in range(8)]
+        ok = slo.evaluate(recs, slo.SLOSpec(ttft_p99_ms=100.0,
+                                            goodput_min=0.9))
+        assert ok["met"] and not ok["violators"]
+        bad = slo.evaluate(recs, slo.SLOSpec(ttft_p99_ms=1.0))
+        assert not bad["met"]
+        t = bad["targets"][0]
+        assert t["attainment"] == 0.0 and t["burn"]["full"] > 1.0
+        assert len(bad["violators"]) == 5           # top-5 of 8
+
+    def test_shed_requests_are_misses_not_exemptions(self):
+        recs = [_rec(i) for i in range(4)] + \
+               [_rec(10 + i, state="rejected") for i in range(4)]
+        rep = slo.evaluate(recs, slo.SLOSpec(e2e_p99_ms=100.0,
+                                             goodput_min=0.9))
+        t = rep["targets"][0]
+        assert t["unbounded"] and not t["met"]      # p99 rides the inf tail
+        assert t["attainment"] == 0.5
+        assert rep["goodput"]["observed"] == 0.5
+        assert not rep["met"]
+        v = rep["violators"][0]
+        assert v["state"] == "rejected" and v["reason"] == "queue_full"
+        assert v["e2e_ms"] is None and v["queued_ms"] is not None
+
+    def test_burn_rate_flags_late_run_regression(self):
+        """Healthy early run, all misses in the last quarter: the
+        quarter-window burn must exceed the full-window burn."""
+        recs = [_rec(i, ts_submit=100.0 + i) for i in range(12)] + \
+               [_rec(20 + i, ts_submit=115.0 + i * 0.1, e2e_s=5.0)
+                for i in range(4)]
+        rep = slo.evaluate(recs, slo.SLOSpec(e2e_p50_ms=100.0))
+        burn = rep["targets"][0]["burn"]
+        assert burn["quarter"] > burn["full"]
+
+    def test_cli_exit_contract(self, tmp_path, capsys):
+        jsonl = str(tmp_path / "run.jsonl")
+        with telemetry.capture() as col:
+            for i in range(3):
+                metrics.req_event(metrics.REQ_SUBMIT, i,
+                                  meta={"prompt_len": 4, "max_new": 2})
+                metrics.req_event(
+                    metrics.REQ_FINISH, i,
+                    meta={"slot": 0, "tokens": 2, "queued_s": 0.001,
+                          "prefill_s": 0.002, "decode_s": 0.003,
+                          "ttft_s": 0.003, "e2e_s": 0.006,
+                          "in_deadline": True})
+            telemetry.write_jsonl(jsonl, col.drain())
+        assert serve_main(["slo", jsonl, "--e2e-p99-ms", "1000"]) == 0
+        out = capsys.readouterr().out
+        assert "MET" in out
+        assert serve_main(["slo", jsonl, "--e2e-p99-ms", "0.0001"]) == 3
+        assert "VIOLATED" in capsys.readouterr().out
+        # --json prints the full report dict
+        assert serve_main(["slo", jsonl, "--e2e-p99-ms", "1000",
+                           "--json"]) == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["met"] and rep["requests"] == 3
+
+    def test_cli_bad_input_is_exit_1(self, tmp_path, capsys):
+        empty = str(tmp_path / "empty.jsonl")
+        with telemetry.capture() as col:
+            telemetry.record("train/loss", 1.0)
+            telemetry.write_jsonl(empty, col.drain())
+        # no req/* events -> 1; no targets -> 1; unreadable spec -> 1
+        assert serve_main(["slo", empty, "--ttft-p99-ms", "5"]) == 1
+        assert serve_main(["slo", empty]) == 1
+        assert serve_main(["slo", empty, "--spec",
+                           str(tmp_path / "missing.json")]) == 1
+        assert serve_main(["slo", str(tmp_path / "nope.jsonl"),
+                           "--ttft-p99-ms", "5"]) == 1
+        capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# two-process clock join (committed fixture, +1.75s known skew)
+# ---------------------------------------------------------------------------
+
+class TestMergeServeStreams:
+    def test_offset_recovered_from_serve_step_anchors(self):
+        merged, offsets = merge.merge_files([P0, P1])
+        assert offsets["p0"]["offset_s"] == 0.0
+        assert offsets["p1"]["offset_s"] == pytest.approx(1.75, abs=1e-6)
+        assert offsets["p1"]["anchors"] == 5
+
+    def test_ttft_spans_align_after_merge(self):
+        """Both processes saw rid 0's first token at the same true
+        time; after the median-offset join their serve/ttft span ends
+        coincide on the reference clock."""
+        merged, _ = merge.merge_files([P0, P1])
+        rows = trace.span_rows(merged)
+        ends = {}
+        for r in rows:
+            if r["family"] == "serve/ttft" and r["rid"] == 0:
+                ends[r["process"]] = r["ts"]
+        assert set(ends) == {"p0", "p1"}
+        assert ends["p0"] == pytest.approx(ends["p1"], abs=1e-6)
+
+    def test_req_records_keep_per_process_rid_spaces(self):
+        merged, _ = merge.merge_files([P0, P1])
+        recs = requests.join(merged)
+        assert len(recs) == 4                   # rid 0+1 in BOTH streams
+        key = {(r["process"], r["rid"]): r["state"] for r in recs}
+        assert key[("p0", 0)] == "done"
+        assert key[("p0", 1)] == "rejected"
+        assert key[("p1", 0)] == "done"
+        assert key[("p1", 1)] == "expired"
+
+    def test_summarize_renders_merged_serve_section(self):
+        merged, _ = merge.merge_files([P0, P1])
+        s = telemetry.summarize(merged)
+        srv = s["serve"]
+        assert srv["completed"] == 2
+        assert srv["expired_inflight"] == 1
+        assert srv["rejected_by_reason"] == {"queue_full": 1}
+        assert srv["requests"]["by_state"] == {
+            "done": 2, "rejected": 1, "expired": 1}
+        assert s["ledger"]["serve"]["tokens_wasted"] == 1
+        text = telemetry.format_summary(s)
+        assert "serving (apex_tpu.serve):" in text
+        assert "goodput ledger:" in text
+
+
+# ---------------------------------------------------------------------------
+# pyprof timeline: the requests pid
+# ---------------------------------------------------------------------------
+
+class TestTimelineRequestLanes:
+    def test_request_lanes_render_under_their_own_pid(self):
+        from apex_tpu.pyprof.parse import load_trace
+        from apex_tpu.pyprof.timeline import build_timeline
+        from apex_tpu.telemetry.export import load
+        device = load_trace(os.path.join(FIXDIR, "synthetic_trace.json"))
+        rows = trace.span_rows(load(P1))
+        tl = build_timeline(device, rows)
+        evs = tl["traceEvents"]
+        pids = {e["args"]["name"] for e in evs
+                if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert pids == {"host", "device", "requests"}
+        req_x = [e for e in evs
+                 if e.get("ph") == "X" and e["pid"] == 3]
+        assert req_x and tl["metadata"]["request_spans"] == len(req_x)
+        names = {e["name"] for e in req_x}
+        assert {"r0/queued", "r0/prefill", "r0/decode"} <= names
+        lanes = {e["args"]["name"] for e in evs
+                 if e.get("ph") == "M" and e["name"] == "thread_name"
+                 and e["pid"] == 3}
+        assert {"slot 0", "slot 1"} <= lanes
+        # valid Chrome trace: every X event JSON-serializes with ts/dur
+        for e in req_x:
+            assert e["dur"] >= 0 and e["ts"] >= 0
+        json.dumps(tl)
+
+    def test_no_requests_pid_without_req_spans(self):
+        from apex_tpu.pyprof.parse import load_trace
+        from apex_tpu.pyprof.timeline import build_timeline
+        from apex_tpu.telemetry.export import load
+        device = load_trace(os.path.join(FIXDIR, "synthetic_trace.json"))
+        rows = [r for r in trace.span_rows(load(P0))
+                if not r["family"].startswith("req/")]
+        tl = build_timeline(device, rows)
+        assert not any(e.get("pid") == 3 for e in tl["traceEvents"])
