@@ -102,6 +102,11 @@ _RULES = [
     Rule("APX208", "scan-carry-widening", WARNING,
          "fp32 scan carry produced by widening a bf16/fp16 body value "
          "every iteration — 2x carry memory/bandwidth for no gain"),
+    Rule("APX209", "pipeline-schedule-divergence", ERROR,
+         "ppermute gated by control flow whose predicate is rank-derived "
+         "on the ppermute's own axis — neighbour stages disagree on the "
+         "send schedule; run the permute unconditionally and mask the "
+         "payload"),
     Rule("APX301", "peak-exceeds-hbm", ERROR,
          "the program's peak live bytes (static live-range timeline) "
          "exceed the device HBM capacity — it cannot compile to the "

@@ -1,5 +1,5 @@
 """SPMD pass: whole-program single-device-semantics verification of
-lowered entry points (rules APX201-APX208).
+lowered entry points (rules APX201-APX209).
 
 Where the jaxpr pass (APX1xx) checks *local* properties — one matmul's
 dtypes, one collective's axis name — this pass checks the properties that
@@ -67,6 +67,18 @@ Rules:
   buffer (and its HBM traffic) is 2x the compute precision for no
   numerical gain (an fp32 *accumulator* of low-precision addends does
   not fire — only a carry produced directly by a widening convert does).
+* **APX209 pipeline-schedule-divergence** — a ``ppermute`` gated by
+  control flow whose predicate is rank-tainted *on the ppermute's own
+  axis*: the canonical hand-rolled-pipeline bug. Stage ``i`` decides
+  "do I send this tick?" from its own stage index, stage ``i+1`` makes
+  the mirror decision one tick later, and the permute pair deadlocks
+  (or silently exchanges garbage). The fix is structural, and it is
+  what :mod:`apex_tpu.parallel.pipeline_schedule` does: every rank
+  executes the *same* ppermute every tick and masks the payload with
+  ``where`` instead of gating the send. APX201 covers the generic
+  rank-gated-collective case; APX209 narrows to the pipeline-axis
+  self-gating pattern and names the structural fix, and APX201 defers
+  to it there so one defect yields one finding.
 """
 
 from __future__ import annotations
@@ -187,6 +199,10 @@ class _Ctx:
     in_mesh: bool = False
     rank_gated: bool = False
     in_while: bool = False
+    # mesh axes whose rank taint feeds an enclosing cond/while
+    # predicate — the *which axis* refinement of ``rank_gated`` that
+    # lets APX209 recognize a ppermute gated on its own axis
+    gating_axes: FrozenSet[str] = frozenset()
     flagged: set = dataclasses.field(default_factory=set)
 
     def emit(self, rule: str, eqn, msg: str) -> None:
@@ -231,9 +247,18 @@ def _out_taints(jaxpr, env: _Env) -> List[Taint]:
 # per-rule checks (run inside the main walk)
 # ---------------------------------------------------------------------------
 
+def _is_apx209_case(eqn, ctx: _Ctx) -> bool:
+    """A ppermute gated on rank taint of one of its *own* axes — the
+    case APX209 owns (and APX201 therefore skips)."""
+    return (eqn.primitive.name == "ppermute"
+            and bool(set(_axes_of(eqn.params)) & ctx.gating_axes))
+
+
 def _check_apx201(eqn, ctx: _Ctx) -> None:
     if eqn.primitive.name not in COLLECTIVE_PRIMS or not ctx.rank_gated:
         return
+    if _is_apx209_case(eqn, ctx):
+        return                         # APX209 owns this exact pattern
     ctx.emit(
         "APX201", eqn,
         f"collective `{eqn.primitive.name}` is reachable under "
@@ -371,6 +396,22 @@ def _check_apx208(eqn, ctx: _Ctx) -> None:
                 "the body if a true accumulator is intended)")
 
 
+def _check_apx209(eqn, ctx: _Ctx) -> None:
+    if not ctx.in_mesh or not _is_apx209_case(eqn, ctx):
+        return
+    axes = sorted(set(_axes_of(eqn.params)) & ctx.gating_axes)
+    ctx.emit(
+        "APX209", eqn,
+        f"ppermute over {axes} is gated by control flow whose predicate "
+        f"is derived from the rank on that same axis — the canonical "
+        "pipeline-schedule bug: each stage decides per-rank whether to "
+        "send, neighbour stages make mirror decisions on different "
+        "ticks, and the permute pair deadlocks (or pairs stale data). "
+        "Run the same ppermute on every rank every tick and mask the "
+        "payload instead (`jnp.where(active, x, 0)`), as "
+        "parallel.pipeline_schedule's timetable executor does")
+
+
 # ---------------------------------------------------------------------------
 # the abstract-interpretation walk
 # ---------------------------------------------------------------------------
@@ -392,6 +433,11 @@ def _propagate(eqn, env: _Env) -> Taint:
             and eqn.params.get("axis_index_groups") is None:
         reduced = set(_axes_of(eqn.params))
         return frozenset(tag for tag in t if tag[1] not in reduced)
+    if prim == "ppermute":
+        # a permuted value is a rank-indexed read of the axis: each rank
+        # holds its neighbour's data, so the result is rank-divergent
+        # along the permuted axes even if the input was uniform
+        return t | frozenset(("rank", a) for a in _axes_of(eqn.params))
     return t
 
 
@@ -420,6 +466,7 @@ def _jaxpr_taint(jaxpr, env: _Env, ctx: _Ctx, *,
             _check_apx206(eqn, ctx)
             _check_apx207(eqn, ctx, cons, out_set)
             _check_apx208(eqn, ctx)
+            _check_apx209(eqn, ctx)
 
         subs = subjaxprs_tagged(eqn)
         sub_out_taints: Optional[List[Taint]] = None
@@ -427,13 +474,16 @@ def _jaxpr_taint(jaxpr, env: _Env, ctx: _Ctx, *,
         if prim == "cond" and subs:
             pred_taint = env.get(eqn.invars[0])
             gated = ctx.rank_gated or _has(pred_taint, "rank")
+            gaxes = ctx.gating_axes | frozenset(
+                a for k, a in pred_taint if k == "rank")
             joined: Optional[List[Taint]] = None
             for sub in subs:
                 child_env = _seed_child_env(env, sub.operands,
                                             sub.jaxpr.invars)
                 outs = _jaxpr_taint(
                     sub.jaxpr, child_env,
-                    ctx.child(rank_gated=gated) if check else ctx,
+                    ctx.child(rank_gated=gated, gating_axes=gaxes)
+                    if check else ctx,
                     check=check)
                 joined = outs if joined is None else [
                     a | b for a, b in zip(joined, outs)]
@@ -465,6 +515,7 @@ def _jaxpr_taint(jaxpr, env: _Env, ctx: _Ctx, *,
                         break
                     carry_taints = new
             pred_rank = ctx.rank_gated
+            pred_axes = ctx.gating_axes
             if cond_s is not None:
                 probe = _seed_child_env(env, cond_s.operands,
                                         cond_s.jaxpr.invars)
@@ -477,8 +528,11 @@ def _jaxpr_taint(jaxpr, env: _Env, ctx: _Ctx, *,
                                            check=False)
                 pred_rank = pred_rank or any(
                     _has(t, "rank") for t in pred_taints)
+                pred_axes = pred_axes | frozenset(
+                    a for t in pred_taints for k, a in t if k == "rank")
             if check:
-                wctx = ctx.child(rank_gated=pred_rank, in_while=True)
+                wctx = ctx.child(rank_gated=pred_rank, in_while=True,
+                                 gating_axes=pred_axes)
                 for sub in subs:
                     child_env = _seed_child_env(env, sub.operands,
                                                 sub.jaxpr.invars)

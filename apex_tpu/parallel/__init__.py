@@ -54,3 +54,13 @@ from apex_tpu.parallel.pipeline import (
     lm_unstack_blocks,
     stacked_block_pspecs,
 )
+from apex_tpu.parallel import pipeline_schedule
+from apex_tpu.parallel.pipeline_schedule import (
+    accumulate_grads,
+    bubble_fraction,
+    make_schedule,
+    pipelined_grads,
+    schedule_1f1b,
+    schedule_gpipe,
+    stage_partition,
+)

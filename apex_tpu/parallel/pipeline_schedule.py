@@ -1,0 +1,352 @@
+"""Microbatch pipeline schedules — GPipe and 1F1B as ONE shard_map
+program over the ``pipe`` mesh axis.
+
+The sibling :mod:`~apex_tpu.parallel.pipeline` module is the
+autodiff-scheduled GPipe forward (``pipeline_apply``): hand it the block
+stack and let ``jax.grad`` transpose the ticks. That is the right shape
+for a loss the caller differentiates, but the schedule it yields is
+whatever autodiff emits — it cannot express 1F1B, and its accumulation
+order is not the trainer's. This module is the TRAINER-grade tier: the
+schedule is an explicit static timetable (which microbatch every stage
+forwards/backwards at every tick), baked into a ``lax.scan`` whose body
+does one masked forward, one masked recompute-backward (``jax.vjp``),
+and two ``ppermute`` hops (activations right, cotangents left). GPipe
+and 1F1B are the SAME executor with different tables.
+
+Why a timetable: per-stage gradients accumulate in ascending-microbatch
+order on every stage under both schedules (idle slots contribute exact
+float zeros — both cotangents are zeroed, so the pulled gradients are
+zeros, and ``acc + 0`` is the identity), which makes GPipe, 1F1B, and
+the single-stage :func:`accumulate_grads` baseline produce
+bitwise-identical sums — the equality tests/test_pipeline_schedule.py
+pins. 1F1B's classic win — at most ``stages - rank`` activations live
+per stage instead of all M — is a property of the TABLE (pinned by
+test); this executor keeps M-slot buffers either way (CI shapes are
+small; a ring buffer is a follow-up, the table already proves the
+bound).
+
+Inert default: at ``pipe`` axis size 1, :func:`pipelined_grads` does not
+build a degenerate one-stage pipeline — it literally calls
+:func:`accumulate_grads` on the composed (embed → stage → loss)
+function, so a pp=1 layout traces the identical jaxpr to the
+non-pipelined trainer (the jaxpr-equality pin, same doctrine as every
+other opt-in axis in this repo).
+
+Masking is ``where``, not ``lax.cond``: every tick pays forward +
+recompute + backward on every stage (the repo's masked-pipeline idiom —
+``pipeline_apply`` does the same). That is the uniform-program price of
+SPMD-safe control flow: a ``cond``-gated send is exactly the
+schedule-divergence bug lint rule APX209 exists to catch.
+
+Bubble math: both tables run ``T = 2*(M + P - 1)`` ticks and every
+stage is busy for exactly ``2*M`` of them, so each stage idles
+``2*(P - 1)`` slots and the bubble fraction is ``(P - 1)/(M + P - 1)``
+(:func:`bubble_fraction` — the analytic term ``plan.cost`` prices and
+``benchmarks/plan_vs_hand.py`` prints).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel.mesh import bound_axis_size
+
+Tree = Any
+
+SCHEDULES = ("gpipe", "1f1b")
+
+
+# ---------------------------------------------------------------------------
+# timetables (pure Python — unit-testable against the analytic formulas)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Timetable:
+    """A static pipeline schedule: ``fwd[t][r]`` / ``bwd[t][r]`` name
+    the microbatch stage ``r`` forwards / backwards at tick ``t``
+    (``-1`` = idle slot). Forward and backward never share a (tick,
+    stage) slot in either shipped schedule (a parity argument the tests
+    re-verify exhaustively), so one masked executor tick hosts both."""
+
+    name: str
+    stages: int
+    microbatches: int
+    fwd: Tuple[Tuple[int, ...], ...]    # [ticks][stages]
+    bwd: Tuple[Tuple[int, ...], ...]
+
+    @property
+    def ticks(self) -> int:
+        return len(self.fwd)
+
+    def busy_slots(self, rank: int) -> int:
+        """Non-idle ticks for one stage — ``2*M`` in both schedules."""
+        return (sum(1 for t in range(self.ticks) if self.fwd[t][rank] >= 0)
+                + sum(1 for t in range(self.ticks)
+                      if self.bwd[t][rank] >= 0))
+
+    def bubble_slots(self, rank: int) -> int:
+        """Idle ticks for one stage: ``ticks - busy`` — analytically
+        ``2*(stages - 1)``, independent of the rank and the schedule."""
+        return self.ticks - self.busy_slots(rank)
+
+    def max_in_flight(self, rank: int) -> int:
+        """Peak microbatches forwarded-but-not-yet-backwarded on one
+        stage — the activation high-water mark. GPipe holds all M;
+        1F1B holds ``min(stages - rank, M)`` (its whole point)."""
+        live = peak = 0
+        for t in range(self.ticks):
+            if self.fwd[t][rank] >= 0:
+                live += 1
+                peak = max(peak, live)
+            if self.bwd[t][rank] >= 0:
+                live -= 1
+        return peak
+
+
+def _empty(stages: int, microbatches: int):
+    if stages < 1 or microbatches < 1:
+        raise ValueError(
+            f"pipeline schedule needs stages >= 1 and microbatches >= 1, "
+            f"got stages={stages}, microbatches={microbatches}")
+    ticks = 2 * (microbatches + stages - 1)
+    return ([[-1] * stages for _ in range(ticks)],
+            [[-1] * stages for _ in range(ticks)])
+
+
+def _freeze(name, stages, microbatches, fwd, bwd) -> Timetable:
+    return Timetable(name=name, stages=stages, microbatches=microbatches,
+                     fwd=tuple(tuple(r) for r in fwd),
+                     bwd=tuple(tuple(r) for r in bwd))
+
+
+def schedule_gpipe(stages: int, microbatches: int) -> Timetable:
+    """All-forward-then-all-backward: stage ``r`` forwards microbatch
+    ``j`` at tick ``r + j`` and backwards it at
+    ``(M + P - 1) + (P - 1 - r) + j`` (the drain starts at the last
+    stage the tick after the last forward arrives there)."""
+    P, M = stages, microbatches
+    fwd, bwd = _empty(P, M)
+    for r in range(P):
+        for j in range(M):
+            fwd[r + j][r] = j
+            bwd[(M + P - 1) + (P - 1 - r) + j][r] = j
+    return _freeze("gpipe", P, M, fwd, bwd)
+
+
+def schedule_1f1b(stages: int, microbatches: int) -> Timetable:
+    """One-forward-one-backward: stage ``r`` warms up with
+    ``min(P - r, M)`` forwards (microbatch ``j`` at tick ``r + j``),
+    then alternates — steady-state forwards land at ``2j + r`` and
+    every backward at ``2P - 1 - r + 2j``, so forward/backward slots
+    interleave by parity and at most ``P - r`` activations are ever
+    live per stage. Same ``2*(M + P - 1)`` ticks as GPipe — 1F1B buys
+    memory, not bubble."""
+    P, M = stages, microbatches
+    fwd, bwd = _empty(P, M)
+    for r in range(P):
+        for j in range(M):
+            fwd[r + j if j < P - r else 2 * j + r][r] = j
+            bwd[2 * P - 1 - r + 2 * j][r] = j
+    return _freeze("1f1b", P, M, fwd, bwd)
+
+
+def make_schedule(name: str, stages: int, microbatches: int) -> Timetable:
+    """Schedule factory by name (:data:`SCHEDULES`); loud on unknowns."""
+    if name == "gpipe":
+        return schedule_gpipe(stages, microbatches)
+    if name == "1f1b":
+        return schedule_1f1b(stages, microbatches)
+    raise ValueError(
+        f"unknown pipeline schedule {name!r}; known: {SCHEDULES}")
+
+
+def bubble_fraction(stages: int, microbatches: int) -> float:
+    """Idle fraction of the (ticks x stages) grid:
+    ``(P - 1) / (M + P - 1)`` — the closed form both timetables realize
+    slot-for-slot and ``plan.cost`` prices as ``bubble_s``."""
+    return (stages - 1) / (microbatches + stages - 1)
+
+
+def stage_partition(layers: int, stages: int) -> List[Tuple[int, int]]:
+    """Contiguous ``[start, stop)`` block ranges per stage, balanced
+    (earlier stages absorb the remainder). The planner only emits
+    evenly-divisible partitions (``search._shape_reason``); the general
+    form serves hand layouts."""
+    if stages < 1 or layers < stages:
+        raise ValueError(
+            f"cannot split {layers} layers into {stages} stages")
+    base, extra = divmod(layers, stages)
+    out, start = [], 0
+    for r in range(stages):
+        stop = start + base + (1 if r < extra else 0)
+        out.append((start, stop))
+        start = stop
+    return out
+
+
+# ---------------------------------------------------------------------------
+# gradient accumulation (the single-stage baseline, ONE definition —
+# plan.adapters delegates here so the pp=1 jaxpr pin is by construction)
+# ---------------------------------------------------------------------------
+
+def accumulate_grads(loss_of: Callable, params: Tree, toks, mb: int):
+    """value-and-grad over ``mb`` sequential microbatches of the local
+    batch (the gradient-accumulation no_sync pattern: ONE collective
+    per step, issued by the caller on the averaged grads)."""
+    if mb == 1:
+        return jax.value_and_grad(loss_of)(params, toks)
+    b_loc = toks.shape[0]
+    chunks = toks.reshape((mb, b_loc // mb) + toks.shape[1:])
+
+    def body(carry, t):
+        acc_l, acc_g = carry
+        loss, g = jax.value_and_grad(loss_of)(params, t)
+        return (acc_l + loss,
+                jax.tree_util.tree_map(jnp.add, acc_g, g)), None
+
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    (loss_sum, grad_sum), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), zeros), chunks)
+    inv = 1.0 / mb
+    return loss_sum * inv, jax.tree_util.tree_map(
+        lambda g: g * inv, grad_sum)
+
+
+# ---------------------------------------------------------------------------
+# the executor
+# ---------------------------------------------------------------------------
+
+def pipelined_grads(embed_fn: Callable, stage_fn: Callable,
+                    loss_fn: Callable, stage_params: Tree, rest: Tree,
+                    toks, microbatch: int, *, axis_name: str = "pipe",
+                    schedule: str = "1f1b"):
+    """Pipeline-parallel microbatched value-and-grad, per-device under
+    ``shard_map`` over ``axis_name``.
+
+    The model splits into three caller-supplied pieces:
+
+      * ``embed_fn(rest, toks_mb) -> acts`` — the input-side compute
+        (embeddings). Runs on every stage each tick (uniform program);
+        only stage 0's result enters the pipeline, so its ``rest``
+        grads are exact zeros off stage 0 (the ``where`` transpose).
+      * ``stage_fn(stage_params, acts) -> acts`` — THIS stage's block
+        run (``stage_params`` is the stacked-block shard, leading dim
+        = layers/stages — see ``pipeline.lm_stack_blocks``).
+      * ``loss_fn(rest, acts, toks_mb) -> scalar`` — the output-side
+        compute (final norm + head + loss). Masked to the last stage,
+        so head/norm grads are exact zeros everywhere else.
+
+    ``rest`` grads are therefore stage-DISJOINT and one ``psum`` over
+    the pipe axis reassembles them exactly (``x + 0``); stage grads stay
+    sharded. Returns ``(loss, (stage_grads, rest_grads))`` with the
+    same microbatch-mean normalization as :func:`accumulate_grads` —
+    and at axis size 1 it IS :func:`accumulate_grads` on the composed
+    function (the inert-default jaxpr pin).
+    """
+    world = bound_axis_size(axis_name)
+    mb = int(microbatch)
+    if world == 1:
+        def loss_of(pr, t):
+            p, r = pr
+            return loss_fn(r, stage_fn(p, embed_fn(r, t)), t)
+        return accumulate_grads(loss_of, (stage_params, rest), toks, mb)
+
+    table = make_schedule(schedule, world, mb)
+    fwd_tbl = jnp.asarray(table.fwd, jnp.int32)    # [ticks, stages]
+    bwd_tbl = jnp.asarray(table.bwd, jnp.int32)
+    rank = jax.lax.axis_index(axis_name)
+    is_last = rank == world - 1
+    b_loc = toks.shape[0]
+    if b_loc % mb:
+        raise ValueError(
+            f"local batch {b_loc} not divisible by microbatch={mb}")
+    chunks = toks.reshape((mb, b_loc // mb) + toks.shape[1:])
+
+    def rank_fwd(p_loc, rst, act_in, t):
+        x0 = embed_fn(rst, t)
+        h = stage_fn(p_loc, jnp.where(rank == 0, x0, act_in))
+        return h, loss_fn(rst, h, t)
+
+    act_sds = jax.eval_shape(embed_fn, rest, chunks[0])
+    # zero-initialized M-slot buffers: idle-tick recomputes run on
+    # finite inputs (NaN-safe), and their zeroed cotangents pull exact
+    # zero gradients — the accumulation identity the bitwise pin needs
+    act0 = jnp.zeros((mb,) + act_sds.shape, act_sds.dtype)
+    cot0 = jnp.zeros_like(act0)
+    right = [(i, i + 1) for i in range(world - 1)]
+    left = [(i + 1, i) for i in range(world - 1)]
+
+    def tick(carry, rows):
+        gp, gr, loss_acc, act_buf, cot_buf = carry
+        row_f, row_b = rows
+        jf = jnp.take(row_f, rank, mode="clip")
+        jb = jnp.take(row_b, rank, mode="clip")
+        is_f, is_b = jf >= 0, jb >= 0
+        # -- forward: this stage's scheduled microbatch (idle slots run
+        #    the same compute on slot 0 and mask every effect)
+        sf = jnp.clip(jf, 0, mb - 1)
+        t_f = jax.lax.dynamic_index_in_dim(chunks, sf, keepdims=False)
+        a_f = jax.lax.dynamic_index_in_dim(act_buf, sf, keepdims=False)
+        h, mb_loss = rank_fwd(stage_params, rest, a_f, t_f)
+        loss_acc = loss_acc + jnp.where(is_f & is_last, mb_loss, 0.0)
+        send_f = jnp.where(is_f, h, jnp.zeros_like(h))
+        # -- backward: recompute-and-transpose of the scheduled
+        #    microbatch. Cotangents: the banked downstream cotangent on
+        #    interior stages, dL/dL = 1 on the last; both zeroed on
+        #    idle slots -> exact zero grads
+        sb = jnp.clip(jb, 0, mb - 1)
+        t_b = jax.lax.dynamic_index_in_dim(chunks, sb, keepdims=False)
+        a_b = jax.lax.dynamic_index_in_dim(act_buf, sb, keepdims=False)
+        c_b = jax.lax.dynamic_index_in_dim(cot_buf, sb, keepdims=False)
+        (_, l_b), pull = jax.vjp(
+            lambda p, r, a: rank_fwd(p, r, a, t_b),
+            stage_params, rest, a_b)
+        dh = jnp.where(is_b & ~is_last, c_b, jnp.zeros_like(c_b))
+        dl = jnp.where(is_b & is_last, jnp.ones_like(l_b),
+                       jnp.zeros_like(l_b))
+        dp, dr, da = pull((dh, dl))
+        gp = jax.tree_util.tree_map(jnp.add, gp, dp)
+        gr = jax.tree_util.tree_map(jnp.add, gr, dr)
+        send_b = jnp.where(is_b, da, jnp.zeros_like(da))
+        # -- wire: activations hop right, cotangents hop left (every
+        #    tick, masked — a cond-gated send would be APX209)
+        recv_f = jax.lax.ppermute(send_f, axis_name, right)
+        recv_b = jax.lax.ppermute(send_b, axis_name, left)
+        # bank arrivals into the SENDER's scheduled microbatch slot
+        jf_l = jnp.take(row_f, rank - 1, mode="clip")
+        sl_f = jnp.clip(jf_l, 0, mb - 1)
+        keep_f = jax.lax.dynamic_index_in_dim(act_buf, sl_f,
+                                              keepdims=False)
+        act_buf = jax.lax.dynamic_update_index_in_dim(
+            act_buf,
+            jnp.where((rank > 0) & (jf_l >= 0), recv_f, keep_f),
+            sl_f, 0)
+        jb_r = jnp.take(row_b, rank + 1, mode="clip")
+        sl_b = jnp.clip(jb_r, 0, mb - 1)
+        keep_b = jax.lax.dynamic_index_in_dim(cot_buf, sl_b,
+                                              keepdims=False)
+        cot_buf = jax.lax.dynamic_update_index_in_dim(
+            cot_buf,
+            jnp.where((rank < world - 1) & (jb_r >= 0), recv_b, keep_b),
+            sl_b, 0)
+        return (gp, gr, loss_acc, act_buf, cot_buf), ()
+
+    carry0 = (jax.tree_util.tree_map(jnp.zeros_like, stage_params),
+              jax.tree_util.tree_map(jnp.zeros_like, rest),
+              jnp.zeros((), jnp.float32), act0, cot0)
+    (gp, gr, loss_sum, _, _), _ = jax.lax.scan(
+        tick, carry0, (fwd_tbl, bwd_tbl))
+    # stage-disjoint rest grads reassemble exactly; the loss lives on
+    # the last stage only (its accumulation mask), so the same psum
+    # broadcasts it
+    gr = jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g, axis_name), gr)
+    loss_sum = jax.lax.psum(loss_sum, axis_name)
+    inv = 1.0 / mb
+    return loss_sum * inv, (
+        jax.tree_util.tree_map(lambda g: g * inv, gp),
+        jax.tree_util.tree_map(lambda g: g * inv, gr))

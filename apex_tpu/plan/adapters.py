@@ -23,13 +23,18 @@ donation-safe by construction (fresh buffers each time).
 Supported families (the ones the multichip dryrun proves AND the step
 builder can emit end to end):
 
-  * GPT:    dp, dp+ZeRO-2, dp x tp (Megatron), dp x seq (ring/Ulysses)
+  * GPT:    dp, dp+ZeRO-2, dp x tp (Megatron), dp x seq (ring/Ulysses),
+            dp x pp (GPipe/1F1B timetable pipeline)
   * ResNet: dp (SyncBN), dp+ZeRO-2
 
-GPipe (pp>1) layouts are PRICED by the cost model but vetoed at build —
-the emitter never pretends to build what it cannot (loud-failure
-doctrine); enable them in a follow-up by teaching this module the
-``pipeline_apply`` stacking from ``__graft_entry__.py`` part 7.
+Pipeline (pp>1) layouts BUILD for GPT: the block stack shards its stage
+dim over ``pipe`` and the step runs the
+:mod:`apex_tpu.parallel.pipeline_schedule` timetable executor — 1F1B by
+default, ``APEX_TPU_PP_SCHEDULE=gpipe`` flips, both bitwise-equal to
+the single-stage accumulation baseline. pp composes with dp only; the
+unbuilt compositions (pp x tp/seq, pp + ZeRO, pp + reduce_dtype) keep
+named vetoes below (loud-failure doctrine — the emitter never pretends
+to build what it cannot).
 """
 
 from __future__ import annotations
@@ -90,27 +95,12 @@ def _wrap(step: Callable, mesh, state_spec, batch_spec) -> Callable:
                      out_specs=(state_spec, P()), check_vma=False)
 
 
-def _accumulate(loss_of: Callable, params: Tree, toks, mb: int):
-    """value-and-grad over ``mb`` sequential microbatches of the local
-    batch (the gradient-accumulation no_sync pattern: ONE collective
-    per step, issued by the caller on the averaged grads)."""
-    if mb == 1:
-        return jax.value_and_grad(loss_of)(params, toks)
-    b_loc = toks.shape[0]
-    chunks = toks.reshape((mb, b_loc // mb) + toks.shape[1:])
-
-    def body(carry, t):
-        acc_l, acc_g = carry
-        loss, g = jax.value_and_grad(loss_of)(params, t)
-        return (acc_l + loss,
-                jax.tree_util.tree_map(jnp.add, acc_g, g)), None
-
-    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
-    (loss_sum, grad_sum), _ = jax.lax.scan(
-        body, (jnp.zeros((), jnp.float32), zeros), chunks)
-    inv = 1.0 / mb
-    return loss_sum * inv, jax.tree_util.tree_map(
-        lambda g: g * inv, grad_sum)
+# ONE definition of microbatch gradient accumulation: the pipeline
+# module owns it (its pp=1 fallback IS this function — the jaxpr-
+# equality pin that makes pp an inert-default axis holds by
+# construction), the step builders here delegate.
+from apex_tpu.parallel.pipeline_schedule import (  # noqa: E402
+    accumulate_grads as _accumulate)
 
 
 class GPTAdapter:
@@ -213,7 +203,16 @@ class GPTAdapter:
                   "tp_replicated": (2 * self.vocab * self.embed
                                     + self.seq * self.embed + self.vocab
                                     + 6 * self.embed * self.layers
-                                    + 2 * self.embed)})
+                                    + 2 * self.embed),
+                  # params the pipeline CANNOT stage (embeddings, final
+                  # norm, LM head) — the stage-disjoint "rest" tree
+                  # that psums over pipe and stays full-size in the dp
+                  # grad sync (unlike tp_replicated this EXCLUDES the
+                  # per-block LN/bias leaves: those ride the stacked
+                  # stage shard under pp)
+                  "pp_rest": (2 * self.vocab * self.embed
+                              + self.seq * self.embed + self.vocab
+                              + 2 * self.embed)})
 
     def _act_bytes_per_sample(self) -> float:
         per_block = GPT_ACT_FACTOR * self.seq * self.embed * 4
@@ -227,8 +226,20 @@ class GPTAdapter:
         :meth:`build` can emit this layout. Shape divisibility is the
         pruner's job; this is about what the step builder implements."""
         if layout.pp > 1:
-            return ("pipeline (pp>1) emission not implemented — priced "
-                    "only; see adapters module doc")
+            if layout.tp > 1 or layout.seq > 1:
+                return ("pipeline composes with dp only — pp x tp / "
+                        "pp x seq would need the per-block tp/seq "
+                        "collectives rescoped under the stage scan; "
+                        "not built")
+            if layout.zero:
+                return ("ZeRO's flat optimizer layout shards over "
+                        "data and assumes replicated params; the "
+                        "pipeline's stage-sharded stack would need a "
+                        "pipe-aware flat layout — not built")
+            if layout.reduce_dtype:
+                return ("reduce_dtype rides the DDP bucketed-allreduce "
+                        "seam; pipeline layouts sync grads with plain "
+                        "collectives")
         if layout.microbatch > 1 and (layout.tp > 1 or layout.seq > 1):
             return ("microbatch accumulation is built for dp/zero "
                     "layouts only")
@@ -251,6 +262,8 @@ class GPTAdapter:
         mesh = named_mesh(layout.mesh_axes(), devices=devices)
         axis_sizes = dict(zip(mesh.axis_names,
                               (int(s) for s in mesh.devices.shape)))
+        if layout.pp > 1:
+            return self._build_pp(layout, mesh, axis_sizes)
         if layout.tp > 1:
             return self._build_tp(layout, mesh, axis_sizes)
         if layout.seq > 1:
@@ -460,6 +473,95 @@ class GPTAdapter:
         def init_state():
             p = self._dense_params()
             return (p, opt.init(p))
+
+        toks_shape = (self.batch, self.seq)
+        return Built(
+            layout=layout, mesh=mesh, step=step,
+            wrapped=_wrap(step, mesh, state_spec, batch_spec),
+            state_spec=state_spec, batch_spec=batch_spec,
+            state_avals=(params_sds, st_sds),
+            batch_avals=jax.ShapeDtypeStruct(toks_shape, jnp.int32),
+            init_state=init_state, batch_fn=self._batch_fn(toks_shape),
+            axis_sizes=axis_sizes)
+
+    def _build_pp(self, layout: Layout, mesh, axis_sizes) -> Built:
+        """dp x pp: the block stack shards into contiguous stages over
+        ``pipe`` (stacked leading dim, ``layers/pp`` blocks per rank)
+        and each step runs the :mod:`~apex_tpu.parallel.
+        pipeline_schedule` timetable executor — 1F1B by default,
+        ``APEX_TPU_PP_SCHEDULE=gpipe`` flips. Both schedules are
+        bitwise-equal to the single-stage accumulation baseline, so
+        the knob is a memory-shape choice, not a numerics one. Stage
+        grads stay pipe-sharded; the stage-disjoint rest grads psum
+        over pipe inside ``pipelined_grads``; dp replicas pmean over
+        ``data`` (plain collectives — see the _build_tp APX206 note)."""
+        import os
+
+        from apex_tpu import optimizers
+        from apex_tpu.models.gpt import Block, next_token_loss
+        from apex_tpu.normalization import layer_norm
+        from apex_tpu.parallel.pipeline import (lm_stack_blocks,
+                                                stacked_block_pspecs)
+        from apex_tpu.parallel.pipeline_schedule import pipelined_grads
+
+        e, heads = self.embed, self.heads
+        mb = layout.microbatch
+        schedule = os.environ.get("APEX_TPU_PP_SCHEDULE", "1f1b")
+        opt = optimizers.FusedAdam(lr=self.lr)
+
+        def embed_fn(rest, t):
+            return (rest["tok_emb"]["embedding"][t]
+                    + rest["pos_emb"]["embedding"][
+                        jnp.arange(t.shape[1])][None])
+
+        def stage_fn(p_loc, h):
+            def body(hh, p):
+                return Block(e, heads, name="b").apply(
+                    {"params": p}, hh), ()
+            return jax.lax.scan(body, h, p_loc)[0]
+
+        def loss_fn(rest, h, t):
+            hid = layer_norm(h.reshape(-1, e), rest["ln_f"]["weight"],
+                             rest["ln_f"]["bias"]).reshape(h.shape)
+            logits = hid @ rest["head"]["kernel"] + rest["head"]["bias"]
+            return next_token_loss(logits.astype(jnp.float32), t)
+
+        # params ride as {"stacked", "rest"} (a dict root — the fused
+        # optimizer's tuple-is-leaf convention must not see a tuple at
+        # the tree root)
+        def step(state, batch):
+            params, opt_state = state
+            loss, (g_stk, g_rest) = pipelined_grads(
+                embed_fn, stage_fn, loss_fn, params["stacked"],
+                params["rest"], batch, mb,
+                axis_name="pipe", schedule=schedule)
+            grads = {"stacked": g_stk, "rest": g_rest}
+            if layout.dp > 1:
+                grads = jax.lax.pmean(grads, "data")
+                loss = jax.lax.pmean(loss, "data")
+            new_p, new_o = opt.step(grads, params, opt_state)
+            return (new_p, new_o), loss
+
+        # avals only (winner's init_state materializes — see _build_dp)
+        stacked_sds, rest_sds = jax.eval_shape(
+            lm_stack_blocks, self._dense_params_sds())
+        params_sds = {"stacked": stacked_sds, "rest": rest_sds}
+        sspecs = stacked_block_pspecs(stacked_sds)
+        p_specs = {"stacked": sspecs,
+                   "rest": jax.tree_util.tree_map(lambda _: P(),
+                                                  rest_sds)}
+        st_sds = jax.eval_shape(opt.init, params_sds)
+        st_specs = type(st_sds)(step=P(), exp_avg=p_specs,
+                                exp_avg_sq=p_specs)
+        state_spec = (p_specs, st_specs)
+        batch_spec = P("data") if layout.dp > 1 else P()
+
+        def init_state():
+            stacked, rest = lm_stack_blocks(self._dense_params())
+            stacked = jax.device_put(stacked, jax.tree_util.tree_map(
+                lambda sp: NamedSharding(mesh, sp), sspecs))
+            params = {"stacked": stacked, "rest": rest}
+            return (params, opt.init(params))
 
         toks_shape = (self.batch, self.seq)
         return Built(

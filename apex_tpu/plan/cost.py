@@ -203,6 +203,14 @@ def analytic_wire(desc: ModelDesc, layout: Layout) -> List[WireItem]:
         local_count = (desc.param_count - repl) / layout.tp + repl
         grad_b = local_count * desc.grad_itemsize
         wire_b = local_count * wire_item
+    if layout.pp > 1:
+        # under pp the dp grad psum likewise carries the LOCAL tree:
+        # the stacked block shard at 1/pp plus the stage-disjoint rest
+        # (embeddings, final norm, head — the adapter's pp_rest dim)
+        rest_n = dims.get("pp_rest", 0)
+        local_count = (desc.param_count - rest_n) / layout.pp + rest_n
+        grad_b = local_count * desc.grad_itemsize
+        wire_b = local_count * wire_item
     if layout.dp > 1:
         if layout.zero:
             n = layout.dp
@@ -269,13 +277,28 @@ def analytic_wire(desc: ModelDesc, layout: Layout) -> List[WireItem]:
         items.append(WireItem(
             "seq", "psum", grad_b, grad_b * _ring("psum", n), 1))
     if layout.pp > 1:
-        # stage-boundary activation sends, fwd + bwd, per microbatch
+        # the timetable executor's wire, closed-form: the scan runs
+        # T = 2*(mb + pp - 1) ticks and EVERY tick issues one
+        # microbatch-sized activation ppermute right and one cotangent
+        # ppermute left — idle slots send zeros, which move bytes all
+        # the same (the walker bills the aval; honesty over optimism)
         b_loc = dims["batch"] // max(layout.dp, 1)
-        act = b_loc * dims.get("seq", 1) * dims.get("embed", 1) * 4
-        count = 2 * (layout.pp - 1)
+        act = (b_loc // max(layout.microbatch, 1)) \
+            * dims.get("seq", 1) * dims.get("embed", 1) * 4
+        count = 2 * 2 * (layout.microbatch + layout.pp - 1)
         items.append(WireItem(
             "pipe", "ppermute", act * count,
             act * count * _ring("ppermute", layout.pp), count))
+        # the stage-disjoint rest grads (embeddings on stage 0, final
+        # norm + head on the last) reassemble with ONE full-size psum
+        # over pipe; the scalar loss broadcast rides the sub-KiB
+        # omission rule above
+        rest_n = dims.get("pp_rest", 0)
+        if rest_n:
+            rest_b = rest_n * desc.grad_itemsize
+            items.append(WireItem(
+                "pipe", "psum", rest_b,
+                rest_b * _ring("psum", layout.pp), 1))
     return items
 
 

@@ -3,7 +3,7 @@ package — ``TrainerConfig`` + shard_map layout (mesh/in_specs) + tune
 cache entries — delivered through the PR 9 trainer plugin seam.
 
 The non-negotiable gate: EVERY emitted layout passes the lint SPMD
-verifier (APX201-APX208) over the exact shard_map-wrapped program the
+verifier (APX201-APX209) over the exact shard_map-wrapped program the
 trainer will compile. A candidate the verifier flags raises
 :class:`PlanRejected` carrying the findings — the planner never hands a
 caller a layout it knows deadlocks or diverges.
@@ -44,7 +44,7 @@ class PlanRejected(RuntimeError):
 
 def verify_built(built: Built, *,
                  threshold_bytes: Optional[int] = None) -> List[Any]:
-    """Run APX201-APX208 over the candidate's shard_map-wrapped program
+    """Run APX201-APX209 over the candidate's shard_map-wrapped program
     (trace-only; the same entry ``Plan.build_trainer`` compiles, with
     the trainer's donation declaration armed). Returns the findings
     list — empty means verified."""
@@ -221,7 +221,7 @@ def format_table(table: List[Dict[str, Any]]) -> str:
 def emit(built: Built, est: CostBreakdown, *, desc: ModelDesc,
          verdicts: Sequence[Any] = (), measured_s: Optional[float] = None,
          write_cache: bool = True, preverified: bool = False) -> Plan:
-    """Gate + package: verify the candidate (APX201-208), write the tune
+    """Gate + package: verify the candidate (APX201-209), write the tune
     cache entries, record the ``plan/*`` telemetry statics, return the
     :class:`Plan`. Raises :class:`PlanRejected` on findings — this is
     the one door every emitted layout walks through. ``preverified``

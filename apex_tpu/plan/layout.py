@@ -71,7 +71,9 @@ class Layout:
         if self.seq > 1:
             parts.append(self.seq_impl)
         if self.pp > 1:
-            parts.append("gpipe")
+            # schedule-agnostic: the pp axis runs 1F1B by default
+            # (APEX_TPU_PP_SCHEDULE=gpipe flips), same wire/bubble bill
+            parts.append("pipe")
         return "x".join(parts)
 
     def layout_id(self) -> str:

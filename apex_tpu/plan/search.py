@@ -45,9 +45,13 @@ class Constraints:
     zero_stages / microbatches / reduce_dtypes:
         The knob values enumerated (defaults cover the proven set).
     allow_seq / allow_tp / allow_pp:
-        Family gates. ``allow_pp`` defaults False: pp candidates are
-        priced but not emittable (adapters veto them), so they only
-        enter the table when explicitly requested.
+        Family gates, all True: every axis the adapters can build
+        competes by default. ``allow_pp`` flipped True in PR 19 when
+        the GPT adapter learned to emit the pipeline_schedule executor
+        (pp candidates additionally enumerate microbatch counts of
+        ``pp`` and ``2*pp`` — a 1-microbatch pipeline is all bubble,
+        so the schedule's natural operating points must be in the
+        table for the bubble term to rank honestly).
     top_k:
         Survivors that get the traced comm bill + lint verification
         (and measurement under ``validate="measure"``).
@@ -79,7 +83,7 @@ class Constraints:
     reduce_dtypes: Tuple[Optional[str], ...] = (None, "bf16")
     allow_seq: bool = True
     allow_tp: bool = True
-    allow_pp: bool = False
+    allow_pp: bool = True
     seq_impls: Tuple[str, ...] = ("ring", "ulysses")
     top_k: int = 4
     validate: str = "trace"
@@ -219,8 +223,15 @@ def enumerate_candidates(n_devices: int, desc: ModelDesc,
         if constraints.allow_seq and is_lm:
             for impl in constraints.seq_impls:
                 _add(dp=dp, seq=rest, seq_impl=impl)
-        if constraints.allow_pp:
-            _add(dp=dp, pp=rest)
+        if constraints.allow_pp and is_lm:
+            # the pipeline's economics live in the microbatch count
+            # (bubble = (pp-1)/(mb+pp-1)): beyond the constraint set,
+            # enumerate the schedule's natural operating points mb=pp
+            # and mb=2*pp so a bubble-starved mb=1 row is never the
+            # only pp candidate in the table
+            for mb in sorted(set(constraints.microbatches)
+                             | {rest, 2 * rest}):
+                _add(dp=dp, pp=rest, microbatch=mb)
     # dedup (the dp==1 branches can collide)
     seen, out = set(), []
     for c in cands:

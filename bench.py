@@ -29,6 +29,13 @@ rounds. BENCH_TRACE=1 turns on host span tracing (apex_tpu.trace) and
 fills "wall_gap" with the top host span families behind the
 device-vs-wall gap.
 
+BENCH_PP=<stages> adds a pipeline-parallel side-measurement: the GPT
+adapter's dp1 x pp<stages> timetable-pipeline step (1F1B default,
+APEX_TPU_PP_SCHEDULE=gpipe flips; BENCH_PP_MB sizes microbatches) timed
+on <stages> devices, landing in the JSON's "pipeline" key as {stages,
+schedule, microbatches, bubble_pct, step_s} (null when off — rows stay
+schema-comparable).
+
 The step is built through apex_tpu.trainer (one step definition for the
 single-step and 25-step-scan programs, donation owned + audited at
 construction) and the measured loop rides its pipelined dispatch: an
@@ -439,6 +446,11 @@ def main():
         # the full SERVE_r*.json row; this training-bench row never
         # measures serving itself) — null keeps the schema stable
         "serve": None,
+        # pipeline-parallel side-measurement (BENCH_PP=<stages>: time a
+        # GPT dp1 x pp<stages> timetable-pipeline step next to this row
+        # and record the analytic bubble share it paid); null when off —
+        # rows stay schema-comparable
+        "pipeline": None,
     }
     if trace_on:
         # the wall-vs-device gap, itemized: top host span families by
@@ -598,6 +610,53 @@ def main():
         log(f"elastic: reshard world {w_from} -> {w_to} of "
             f"{3 * 4 * src_spec['padded'] / 1e6:.1f} MB ZeRO state in "
             f"{reshard_s * 1e3:.1f} ms (gather-verified)")
+
+    # BENCH_PP=<stages>: the pipeline-parallel side-measurement — build
+    # the GPT adapter's dp1 x pp<stages> layout (the PR 19 timetable
+    # executor: 1F1B by default, APEX_TPU_PP_SCHEDULE=gpipe flips) on
+    # <stages> of this host's devices and time a few compiled steps, so
+    # BENCH_r*.json rows track what the schedule actually costs next to
+    # its analytic bubble fraction. BENCH_PP_MB sizes the microbatch
+    # count (default 2*stages — a ~(P-1)/(3P-1) bubble).
+    if os.environ.get("BENCH_PP"):
+        from apex_tpu import plan as _plan
+        from apex_tpu.parallel.pipeline_schedule import bubble_fraction
+        pp_stages = int(os.environ["BENCH_PP"])
+        pp_mb = int(os.environ.get("BENCH_PP_MB", str(2 * pp_stages)))
+        pp_schedule = os.environ.get("APEX_TPU_PP_SCHEDULE", "1f1b")
+        if on_tpu:
+            pp_ad = _plan.GPTAdapter(vocab=32000, layers=4 * pp_stages,
+                                     embed=1024, heads=16,
+                                     batch=8 * pp_mb, seq=512)
+        else:
+            pp_ad = _plan.GPTAdapter(vocab=64, layers=2 * pp_stages,
+                                     embed=64, heads=4,
+                                     batch=4 * pp_mb, seq=64)
+        pp_built = pp_ad.build(
+            _plan.Layout(dp=1, pp=pp_stages, microbatch=pp_mb),
+            devices=jax.devices()[:pp_stages])
+        pp_step = jax.jit(pp_built.wrapped, donate_argnums=(0,))
+        pp_state = pp_built.init_state()
+        pp_batch = pp_built.batch_fn(0)
+        pp_state, pp_loss = pp_step(pp_state, pp_batch)   # compile
+        jax.block_until_ready(pp_loss)
+        pp_reps = 10 if on_tpu else 3
+        t0 = time.perf_counter()
+        for i in range(pp_reps):
+            pp_state, pp_loss = pp_step(pp_state, pp_batch)
+        jax.block_until_ready(pp_loss)
+        pp_step_s = (time.perf_counter() - t0) / pp_reps
+        result["pipeline"] = {
+            "stages": pp_stages,
+            "schedule": pp_schedule,
+            "microbatches": pp_mb,
+            "bubble_pct": round(
+                100.0 * bubble_fraction(pp_stages, pp_mb), 2),
+            "step_s": round(pp_step_s, 6),
+        }
+        log(f"pipeline: pp{pp_stages} {pp_schedule} mb={pp_mb} "
+            f"{pp_step_s * 1e3:.1f} ms/step "
+            f"(analytic bubble {result['pipeline']['bubble_pct']}%)")
 
     # BENCH_PLAN=1: the cost-model honesty check — price the EXECUTED
     # program (flops/bytes from the same XLA cost analysis MFU uses,

@@ -7,7 +7,11 @@ layout `plan.auto` picks is within --tolerance (default 5%) of the
 best measured layout. "Hand layouts" here means the full feasible set
 the dryrun families span at that shape: each is built through the same
 adapters, timed with the same loop, so the comparison is the planner's
-ranking against ground truth, not against a strawman.
+ranking against ground truth, not against a strawman. Since PR 19 the
+candidate set includes pipeline (pp>1) layouts; their rows carry the
+analytic bubble fraction (pipeline_schedule.bubble_fraction) printed
+next to the measured step time, so a bubble-underpricing drift is
+visible in the same table that would hide it.
 
 Usage::
 
@@ -39,6 +43,7 @@ if os.environ.get("JAX_PLATFORMS", "cpu").strip().lower() == "cpu":
     jax.config.update("jax_platforms", "cpu")
 
 from apex_tpu import plan
+from apex_tpu.parallel.pipeline_schedule import bubble_fraction
 
 
 def measure_layout(built, *, steps: int, reps: int) -> float:
@@ -85,7 +90,12 @@ def run_shape(name: str, adapter, constraints, *, steps: int,
                      "modeled_ms": round(v.step_s * 1e3, 4),
                      "measured_ms": round(
                          measure_layout(built, steps=steps,
-                                        reps=reps) * 1e3, 4)})
+                                        reps=reps) * 1e3, 4),
+                     # analytic pipeline-bubble share of the step (null
+                     # off the pp family — rows stay schema-comparable)
+                     "bubble_pct": (round(100.0 * bubble_fraction(
+                         v.layout.pp, v.layout.microbatch), 1)
+                         if v.layout.pp > 1 else None)})
     timed = [r for r in rows if "measured_ms" in r]
     timed.sort(key=lambda r: r["measured_ms"])
     best = timed[0]
@@ -100,8 +110,10 @@ def run_shape(name: str, adapter, constraints, *, steps: int,
           f"{'OK' if ok else 'FAIL'} ==")
     for r in timed:
         mark = " <- pick" if r["layout"] == p.layout_id else ""
+        bub = (f"  bubble {r['bubble_pct']:.1f}%"
+               if r.get("bubble_pct") is not None else "")
         print(f"  {r['layout']:<26}{r['measured_ms']:>10.3f} ms "
-              f"(modeled {r['modeled_ms']:.3f}){mark}")
+              f"(modeled {r['modeled_ms']:.3f}){bub}{mark}")
     return {"shape": name, "pick": p.layout_id,
             "best": best["layout"], "gap_pct": round(gap_pct, 1),
             "ok": ok, "table": timed}

@@ -12,7 +12,7 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "== 1/20 package import =="
+echo "== 1/21 package import =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import apex_tpu
@@ -20,7 +20,7 @@ from apex_tpu import amp, optimizers, parallel, ops
 print('apex_tpu imports OK')
 "
 
-echo "== 2/20 native host runtime builds (g++ -O3 -shared) =="
+echo "== 2/21 native host runtime builds (g++ -O3 -shared) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 from apex_tpu import runtime
@@ -35,7 +35,7 @@ print('flatten/unflatten path OK')
 assert ok, 'host runtime failed to build — check g++ toolchain'
 "
 
-echo "== 3/20 graft entry compiles (single-device + 8-device dryrun) =="
+echo "== 3/21 graft entry compiles (single-device + 8-device dryrun) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import __graft_entry__ as ge
@@ -45,7 +45,7 @@ print('entry() compiles')
 ge.dryrun_multichip(8)
 "
 
-echo "== 4/20 package install (wheel build + clean --target install) =="
+echo "== 4/21 package install (wheel build + clean --target install) =="
 # The reference gates on Docker extension builds
 # (tests/docker_extension_builds/run.sh); the TPU analog: build the wheel
 # from pyproject.toml, install it into an empty --target dir, and import
@@ -88,7 +88,7 @@ jax.jit(step).lower(params, state).compile()
 print('installed-package train step compiles')
 ")
 
-echo "== 5/20 lint (apex_tpu.lint: trace safety / dtype policy / collectives / SPMD / mem) =="
+echo "== 5/21 lint (apex_tpu.lint: trace safety / dtype policy / collectives / SPMD / mem) =="
 # static gate BEFORE the test tier: AST pass over the package + graft
 # entry, jaxpr pass over the registered entry points, SPMD verifier
 # (APX2xx) and mem verifier (APX3xx) over the same lowerings, with
@@ -99,7 +99,7 @@ echo "== 5/20 lint (apex_tpu.lint: trace safety / dtype policy / collectives / S
 python -m apex_tpu.lint apex_tpu/ __graft_entry__.py --strict --spmd \
     --mem --mem-baseline ci/mem_baseline.json
 
-echo "== 6/20 spmd verifier (builtin-entry sweep + committed deadlock fixture) =="
+echo "== 6/21 spmd verifier (builtin-entry sweep + committed deadlock fixture) =="
 # the whole-program SPMD gate, at the API layer: every registered entry
 # (ddp / zero / overlap / trainer-built / fused kernels / graft) must
 # verify clean, AND the analyzer must still catch the canonical
@@ -144,7 +144,7 @@ print('static donation == runtime DonationReport '
       f'({sd.aliased}/{sd.declared} aliased)')
 "
 
-echo "== 7/20 mem verifier (builtin-entry sweep + APX307 doctored-baseline regression gate) =="
+echo "== 7/21 mem verifier (builtin-entry sweep + APX307 doctored-baseline regression gate) =="
 # the peak-HBM/live-range gate, at the API layer: every registered
 # entry must verify clean against the COMMITTED per-entry baseline
 # (ci/mem_baseline.json — re-baseline deliberately with
@@ -180,7 +180,7 @@ print('APX307 gate OK: doctored +20%% baseline fails naming all '
       '%d entries' % len(named))
 "
 
-echo "== 8/20 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
+echo "== 8/21 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
 # A 3-step instrumented GPT train step on the CPU mesh must produce a
 # parseable JSONL carrying step timing, amp loss-scale/overflow, comm
 # bytes and MFU, and the summarize CLI must render it (exit 0) — the
@@ -253,7 +253,7 @@ fi
 echo "health CLI gate OK (healthy=0, injected-NaN=nonzero)"
 rm -rf "$(dirname "$HLT_FILE")"
 
-echo "== 9/20 tune smoke (sweep dry-run + auto-policy tuned train) =="
+echo "== 9/21 tune smoke (sweep dry-run + auto-policy tuned train) =="
 # The autotuner must be drivable offline (sweep plan renders, exit 0) and
 # inline: a 3-step train whose kernels resolve their configs through
 # apex_tpu.tune under APEX_TPU_TUNE=auto. On this CPU backend measurement
@@ -330,7 +330,7 @@ print(f'tune smoke OK: {len(tuned)} tune/* series, '
 " "$TUNE_DIR/tune_run.jsonl" "$TUNE_DIR/cache"
 rm -rf "$TUNE_DIR"
 
-echo "== 10/20 resilience smoke (snapshot -> injected kill -> auto-resume) =="
+echo "== 10/21 resilience smoke (snapshot -> injected kill -> auto-resume) =="
 # Kill-and-resume end to end: a 6-step train snapshotting every 2 steps is
 # SIGKILLed by the fault injector at the top of step 4 (exit 137 — an
 # abrupt death, no final snapshot), then the SAME command with --resume
@@ -387,7 +387,7 @@ python -m apex_tpu.telemetry summarize "$RES_DIR/resume.jsonl" \
     || { echo "summarize did not report the resume point" >&2; exit 1; }
 rm -rf "$RES_DIR"
 
-echo "== 11/20 overlap smoke (staged backward + bf16 wire vs fp32 baseline) =="
+echo "== 11/21 overlap smoke (staged backward + bf16 wire vs fp32 baseline) =="
 # The overlap engine end to end on the 8-device CPU mesh: a 3-step fp32
 # baseline train and the same train under --overlap --reduce-dtype bf16
 # must (a) land within 1e-2 of each other's final loss (the compression
@@ -443,7 +443,7 @@ python -m apex_tpu.telemetry summarize "$OVL_DIR/bf16.jsonl" \
     || { echo "summarize did not render overlap efficiency" >&2; exit 1; }
 rm -rf "$OVL_DIR"
 
-echo "== 12/20 profile smoke (capture -> attribution report -> compare gate) =="
+echo "== 12/21 profile smoke (capture -> attribution report -> compare gate) =="
 # The attribution profiler end to end on the CPU backend: a 3-step train
 # with --profile must produce a capture logdir whose offline report
 # parses with nonzero compute time and carries the named
@@ -504,7 +504,7 @@ fi
 echo "compare gate OK (identical=0, doctored-slower=4)"
 rm -rf "$PROF_DIR"
 
-echo "== 13/20 trace smoke (host spans -> unified timeline -> merge/stragglers) =="
+echo "== 13/21 trace smoke (host spans -> unified timeline -> merge/stragglers) =="
 # The host-tracing layer end to end: a 3-step --trace train must emit
 # parseable span/* begin/end pairs, the unified host+device timeline
 # must export as valid Chrome-trace JSON with BOTH lanes populated,
@@ -577,7 +577,7 @@ grep -q "worst: p" "$TRC_DIR/merged.txt" \
 echo "trace smoke OK (spans + timeline + reconciliation + 2-process merge)"
 rm -rf "$TRC_DIR"
 
-echo "== 14/20 trainer smoke (compiled-step builder: pipelined dispatch + donation audit) =="
+echo "== 14/21 trainer smoke (compiled-step builder: pipelined dispatch + donation audit) =="
 # The compiled trainer end to end: a 3-step train_lm built through
 # apex_tpu.trainer with telemetry+trace on must (a) emit balanced
 # span/* begin/end pairs (the in-flight window's trainer/retire spans
@@ -622,7 +622,7 @@ grep -q "donation audit: .* 0 refused" "$TRN_DIR/out.txt" \
     || { echo "train_lm did not print the donation audit" >&2; exit 1; }
 rm -rf "$TRN_DIR"
 
-echo "== 15/20 fused-kernel regression (Pallas xentropy vs unfused + epilogue/mt scopes) =="
+echo "== 15/21 fused-kernel regression (Pallas xentropy vs unfused + epilogue/mt scopes) =="
 # The fused-kernel tier end to end (docs/kernels.md): the SAME 3-step GPT
 # train profiled unfused and fused (Pallas xentropy in the loss scope)
 # must (a) surface the apex_xentropy scope in the fused breakdown,
@@ -723,7 +723,7 @@ print('conv epilogue + mt flat: parity + capture scopes OK')
 echo "fused-kernel gate OK (scopes + parity + compare exit 0)"
 rm -rf "$KRN_DIR"
 
-echo "== 16/20 elastic smoke (2-process node_loss -> re-shard resume at world 1) =="
+echo "== 16/21 elastic smoke (2-process node_loss -> re-shard resume at world 1) =="
 # Elastic membership end to end (docs/resilience.md "Elastic
 # membership"): a 2-member ZeRO fleet under the multiproc --elastic
 # supervisor loses rank 1 to an injected node_loss SIGKILL at step 3;
@@ -797,7 +797,7 @@ grep -q "train goodput:" "$ELA_DIR/summary.out" \
     || { echo "elastic: ledger has no train goodput line" >&2; exit 1; }
 rm -rf "$ELA_DIR"
 
-echo "== 17/20 rebalance smoke (slow_node straggler -> weighted re-shard -> exit-75 eviction -> world 1) =="
+echo "== 17/21 rebalance smoke (slow_node straggler -> weighted re-shard -> exit-75 eviction -> world 1) =="
 # Heterogeneity-aware rebalancing end to end (docs/resilience.md
 # "Rebalancing"): rank 1 is an injected straggler (slow_node: +250 ms
 # on every step >= 2 while the base step is ~60 ms). The degradation
@@ -877,7 +877,7 @@ grep -q "straggler detected" "$RB_DIR/summary.out" \
          cat "$RB_DIR/summary.out" >&2; exit 1; }
 rm -rf "$RB_DIR"
 
-echo "== 18/20 plan smoke (auto ranked table -> lint-clean pick -> 3-step train) =="
+echo "== 18/21 plan smoke (auto ranked table -> lint-clean pick -> 3-step train) =="
 # The parallelism planner end to end (docs/plan.md): `plan auto` on the
 # GPT example shape over the 8-device CPU mesh must produce a parseable
 # ranked candidate table, the top pick must pass lint.spmd clean (the
@@ -967,7 +967,72 @@ else:
 PY
 rm -rf "$PLAN_DIR"
 
-echo "== 19/20 serve smoke (train snapshot -> paged continuous-batching bench -> shed + SLO gates) =="
+echo "== 19/21 pipeline smoke (2-stage 1F1B train -> loss parity + send bytes + lint) =="
+# Real pipeline parallelism end to end (docs/pipeline.md): build the
+# planner's dp1 x pp2 GPT layout, verify it lint.spmd clean (APX201-209
+# over the exact wrapped program trainer.build compiles), bill the
+# inter-stage ppermute sends through the telemetry.comm walker and pin
+# them into the JSONL, train 3 steps through trainer.build on the
+# 8-device CPU mesh, and check loss parity against the dense
+# single-stage trainer within tolerance. (The families share math, not
+# programs — the BITWISE pin is against the single-stage twin of the
+# same pipelined program, tests/test_pipeline_schedule.py's job.)
+PIPE_DIR="$(mktemp -d)"
+python - "$PIPE_DIR" <<'PY'
+import json
+import sys
+import jax
+jax.config.update('jax_platforms', 'cpu')
+from apex_tpu import plan, telemetry, trainer
+from apex_tpu.plan.emit import verify_built
+
+d = sys.argv[1]
+telemetry.enable()
+ad = plan.GPTAdapter(vocab=64, layers=2, embed=64, heads=4,
+                     batch=16, seq=64)
+
+
+def train3(built):
+    tr = trainer.build(built.step, built.state_avals, built.batch_avals,
+                       mesh=built.mesh, state_spec=built.state_spec,
+                       batch_spec=built.batch_spec,
+                       config=trainer.TrainerConfig(mode='per_step',
+                                                    donate=True))
+    losses = []
+    tr.set_user_on_step(lambda i, aux: losses.append(float(aux)))
+    state = tr.run(jax.device_get(built.init_state()),
+                   built.batch_fn, 3)
+    jax.block_until_ready(state)
+    return losses
+
+
+pp = ad.build(plan.Layout(dp=1, pp=2, microbatch=4))
+findings = verify_built(pp)
+assert not findings, [f.rule_id for f in findings]
+recs = telemetry.record_comm_stats(pp.wrapped, pp.state_avals,
+                                   pp.batch_avals,
+                                   axis_sizes=pp.axis_sizes)
+sends = [r for r in recs
+         if r.axis == 'pipe' and r.primitive == 'ppermute']
+assert sends and all(r.bytes_wire > 0 for r in sends), recs
+pp_losses = train3(pp)
+base_losses = train3(ad.build(plan.Layout(dp=1, microbatch=4)))
+assert len(pp_losses) == len(base_losses) == 3
+for a, b in zip(pp_losses, base_losses):
+    assert abs(a - b) <= 1e-3 * max(1.0, abs(b)), \
+        (pp_losses, base_losses)
+telemetry.write_jsonl(d + '/pipe.jsonl')
+names = {json.loads(line)['name'] for line in open(d + '/pipe.jsonl')}
+assert 'comm/pipe/ppermute_bytes' in names, sorted(names)
+print(f"pipeline smoke OK: 1f1b losses "
+      f"{['%.4f' % l for l in pp_losses]} "
+      f"(dense {['%.4f' % l for l in base_losses]}), "
+      f"{sum(r.count for r in sends)} pipe sends/step = "
+      f"{int(sum(r.bytes_wire for r in sends))} wire bytes billed")
+PY
+rm -rf "$PIPE_DIR"
+
+echo "== 20/21 serve smoke (train snapshot -> paged continuous-batching bench -> shed + SLO gates) =="
 # The serving stack end to end (docs/serve.md): train a tiny LM to a
 # final snapshot (the manifest records the model spec for the serve
 # loader), run the serve CLI bench (50 requests over the 8-device CPU
@@ -1041,7 +1106,7 @@ python -m apex_tpu.serve bench --snapshot-dir "$SERVE_DIR/ckpt" \
 echo "serve smoke OK (bench + shed + summarize + slo gate + pipe guard)"
 rm -rf "$SERVE_DIR"
 
-echo "== 20/20 pytest =="
+echo "== 21/21 pytest =="
 if [[ "${1:-}" == "--full" ]]; then
     # full suite + the complete L1 cross-product matrix (reference
     # tests/L1/cross_product{,_distributed}/run.sh); the convergence
@@ -1061,6 +1126,7 @@ else
         tests/test_trainer.py tests/test_kernels.py \
         tests/test_pyprof.py tests/test_trace.py \
         tests/test_plan.py tests/test_lint_mem.py \
+        tests/test_pipeline_schedule.py \
         tests/test_serve_kvcache.py tests/test_serve_decode.py \
         tests/test_serve_engine.py tests/test_serve_loader.py \
         tests/test_serve_cli.py tests/test_serve_obs.py \

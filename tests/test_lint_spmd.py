@@ -1,4 +1,4 @@
-"""apex_tpu.lint SPMD verifier (APX201-APX208) — per-rule firing
+"""apex_tpu.lint SPMD verifier (APX201-APX209) — per-rule firing
 fixtures, corrected twins, and per-line suppressions; the read-only
 (jaxpr-equality) contract; the static donation re-derivation pinned
 against the trainer's runtime DonationReport; baseline + SARIF output;
@@ -526,6 +526,81 @@ def test_apx208_suppression():
 
 
 # ---------------------------------------------------------------------------
+# APX209: pipeline-schedule divergence (self-axis-gated ppermute)
+# ---------------------------------------------------------------------------
+
+_RING = [(0, 1), (1, 0)]
+
+
+def _pipe_mesh(extra=()):
+    axes = ("pipe",) + tuple(extra)
+    n = 2 * max(1, len(extra) * 4)
+    shape = (2,) + ((4,) if extra else ())
+    return Mesh(np.asarray(jax.devices()[:n]).reshape(shape), axes)
+
+
+def _smap_pipe(fn, extra=()):
+    mesh = _pipe_mesh(extra)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(P("pipe"),),
+                         out_specs=P("pipe"), check_vma=False)
+
+
+def _bad209(x):
+    r = jax.lax.axis_index("pipe")
+    return jax.lax.cond(
+        r < 1,
+        lambda v: jax.lax.ppermute(v, "pipe", _RING),
+        lambda v: v, x)
+
+
+def _good209(x):
+    # the timetable-executor idiom: every rank runs the SAME ppermute
+    # every tick; activity is masked in the payload, not the schedule
+    r = jax.lax.axis_index("pipe")
+    v = jnp.where(r < 1, x, jnp.zeros_like(x))
+    return jax.lax.ppermute(v, "pipe", _RING)
+
+
+def _cross209(x):
+    # gated on the DATA rank, permuting over PIPE: still a schedule
+    # divergence (APX201), but not the pipeline self-gating pattern
+    r = jax.lax.axis_index("data")
+    return jax.lax.cond(
+        r < 1,
+        lambda v: jax.lax.ppermute(v, "pipe", _RING),
+        lambda v: v, x)
+
+
+def _sup209(x):
+    r = jax.lax.axis_index("pipe")
+    return jax.lax.cond(
+        r < 1,
+        lambda v: jax.lax.ppermute(v, "pipe", _RING),  # apexlint: disable=APX209 -- test fixture
+        lambda v: v, x)
+
+
+def test_apx209_self_gated_ppermute_fires_masked_twin_passes():
+    x = jnp.ones((8, 4))
+    assert spmd_ids(_smap_pipe(_bad209), (x,),
+                    mesh_axes=("pipe",)) == ["APX209"]
+    assert check_entry_spmd(_smap_pipe(_good209), (x,),
+                            mesh_axes=("pipe",)) == []
+
+
+def test_apx209_cross_axis_gating_stays_apx201():
+    x = jnp.ones((8, 4))
+    assert spmd_ids(_smap_pipe(_cross209, extra=("data",)), (x,),
+                    mesh_axes=("pipe", "data")) == ["APX201"]
+
+
+def test_apx209_registered_and_suppressible():
+    assert "APX209" in SPMD_RULE_IDS
+    assert RULES["APX209"].name == "pipeline-schedule-divergence"
+    assert_suppressed("APX209", _smap_pipe(_sup209), (jnp.ones((8, 4)),),
+                      mesh_axes=("pipe",))
+
+
+# ---------------------------------------------------------------------------
 # read-only contract: analysis leaves the traced program bit-identical
 # ---------------------------------------------------------------------------
 
@@ -650,11 +725,12 @@ def test_trainer_constructed_directly_raises_on_seam():
 # ---------------------------------------------------------------------------
 
 def test_spmd_rule_ids_registered():
-    assert SPMD_RULE_IDS == tuple(f"APX20{i}" for i in range(1, 9))
+    assert SPMD_RULE_IDS == tuple(f"APX20{i}" for i in range(1, 10))
     for rid in SPMD_RULE_IDS:
         assert RULES[rid].severity in ("error", "warning")
     assert RULES["APX201"].severity == "error"
     assert RULES["APX202"].severity == "error"
+    assert RULES["APX209"].severity == "error"
 
 
 def test_cli_list_rules_includes_spmd(capsys):

@@ -8,7 +8,7 @@ The load-bearing pins:
     from the walker, so a walker/planner drift cannot self-certify.
   * infeasible candidates (HBM overflow, non-divisible axis) raise /
     filter LOUDLY with named reasons.
-  * every emitted layout passes lint.spmd (APX201-208); a deliberately
+  * every emitted layout passes lint.spmd (APX201-209); a deliberately
     rank-gated candidate raises PlanRejected BEFORE emission.
   * the planner-emitted TrainerConfig trains 3 steps bitwise-stable on
     the 8-device CPU mesh.
@@ -144,7 +144,7 @@ def test_wire_bytes_dp4_tp2_hand_computed(desc):
 
 @pytest.mark.parametrize("lid", [
     "dp8", "dp8-bf16", "dp8-zero2", "dp4-tp2", "dp4-sq2", "dp2-uly4",
-    "dp2-sq4",
+    "dp2-sq4", "dp4-pp2-mb2", "dp1-pp2-mb4",
 ])
 def test_analytic_bill_matches_walker(desc, lid):
     """The closed-form bill the full candidate space is ranked with
@@ -189,11 +189,33 @@ def test_auto_raises_when_nothing_survives():
 
 
 def test_adapter_veto_named_reasons():
-    assert "pipeline" in ADAPTER.veto(Layout(dp=4, pp=2))
+    # PR 19 un-veto: plain dp x pp BUILDS; only the unbuilt pp
+    # compositions keep named vetoes
+    assert ADAPTER.veto(Layout(dp=4, pp=2)) is None
+    assert "composes with dp only" in ADAPTER.veto(
+        Layout(dp=2, pp=2, tp=2))
+    assert "pipeline layouts sync grads" in ADAPTER.veto(
+        Layout(dp=2, pp=2, reduce_dtype="bf16"))
+    assert "pipe-aware flat layout" in ADAPTER.veto(
+        Layout(dp=2, pp=2, zero=2))
     assert "DDP bucketed-allreduce" in ADAPTER.veto(
         Layout(dp=4, tp=2, reduce_dtype="bf16"))
     res = plan.ResNetAdapter(batch=16)
     assert "dp/zero layouts only" in res.veto(Layout(dp=4, tp=2))
+
+
+def test_search_enumerates_feasible_pp_candidates(desc):
+    """The un-veto is reachable end to end: the candidate space now
+    contains pp>1 layouts the adapter will build, and at least one
+    survives pruning (so plan.auto CAN return a pipeline layout)."""
+    from apex_tpu.plan.search import enumerate_candidates
+    cons = plan.Constraints(validate="none")
+    cands = enumerate_candidates(N_DEV, desc, cons)
+    pps = [c for c in cands if c.pp > 1]
+    assert pps, "search emitted no pipeline candidates"
+    assert all(ADAPTER.veto(c) is None for c in pps)
+    verdicts = plan.prune(pps, desc, adapter=ADAPTER, constraints=cons)
+    assert any(v.feasible for v in verdicts)
 
 
 def test_hbm_footprint_zero_shards_optimizer(desc):
@@ -224,7 +246,7 @@ def test_no_overlap_credit_off_pure_dp(desc):
 # ---------------------------------------------------------------------------
 
 @pytest.mark.parametrize("lid", ["dp8", "dp8-zero2", "dp4-tp2",
-                                 "dp4-sq2", "dp2-uly4"])
+                                 "dp4-sq2", "dp2-uly4", "dp4-pp2-mb2"])
 def test_emitted_layouts_lint_spmd_clean(lid):
     assert plan.verify_built(built_for(lid)) == []
 
