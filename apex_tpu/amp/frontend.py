@@ -247,6 +247,10 @@ def wrap_apply(apply_fn: Callable, props: _policy.Properties) -> Callable:
 
     * O2/O3/O5: cast floating inputs to the model compute dtype.
     * O1/O4: run the body under :func:`interposition.autocast`.
+    * O6/O7: inputs cast to bf16 like O5 (``cast_model_type``); the fp8
+      QDQ itself activates only inside the caller's
+      ``lowp.fp8_autocast`` scope, which threads the delayed-scaling
+      state the wrapper cannot own (state flows through the train step).
     """
     if not props.enabled:
         return apply_fn
@@ -304,14 +308,19 @@ def initialize(
         enabled=enabled)
 
     if verbosity > 0 and jax.process_index() == 0:
+        fp8_note = ", fp8=True (e4m3 fwd / e5m2 bwd QDQ via " \
+            "lowp.fp8_autocast)" if props.fp8 else ""
         print(f"apex_tpu.amp: opt_level={props.opt_level}, "
               f"cast_model_type={props.cast_model_type}, "
               f"patch_functions={props.patch_functions}, "
               f"keep_batchnorm_fp32={props.keep_batchnorm_fp32}, "
               f"master_weights={props.master_weights}, "
-              f"loss_scale={props.loss_scale}")
+              f"loss_scale={props.loss_scale}{fp8_note}")
 
-    if props.enabled and props.patch_functions:
+    # O1/O4 cast through the wrappers directly; O6/O7 need the same
+    # wrappers installed as the seam lowp.fp8_autocast hooks (inert
+    # until a context is active — the O0-O5 jaxpr-identity pin)
+    if props.enabled and (props.patch_functions or props.fp8):
         interposition.install()
 
     models_was_seq = isinstance(models, (list, tuple))

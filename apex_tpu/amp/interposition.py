@@ -31,13 +31,36 @@ import jax.numpy as jnp
 
 from apex_tpu.amp import lists as _lists
 
-_LOW_DTYPES = (jnp.float16, jnp.bfloat16)
+# The registry's low-precision dtype SET — everything the fp32
+# (blacklist) wrapper promotes back up. Set-driven rather than a
+# hardcoded {fp16, bf16} pair: a low dtype missing here silently falls
+# through promote-on-mismatch and runs blacklisted ops (softmax, norms,
+# losses) at reduced precision — exactly what happened to the fp8
+# formats before the lowp tier registered them.
+LOW_PRECISION_DTYPES = {
+    jnp.dtype(jnp.float16), jnp.dtype(jnp.bfloat16),
+    jnp.dtype(jnp.float8_e4m3fn), jnp.dtype(jnp.float8_e5m2),
+}
+
+
+def register_low_precision_dtype(dtype) -> None:
+    """Add a dtype to the promote-on-mismatch set (for out-of-tree
+    narrow formats; the in-tree fp16/bf16/fp8 set is pre-registered)."""
+    LOW_PRECISION_DTYPES.add(jnp.dtype(dtype))
+
 
 _state = threading.local()
 
 
 def _active_dtype():
     return getattr(_state, "cast_dtype", None)
+
+
+def _fp8_ctx():
+    """The active ``lowp.fp8_autocast`` context, if any (lazy import:
+    amp must stay importable without pulling the lowp tier in)."""
+    from apex_tpu.lowp import interpose as _lowp_interpose
+    return _lowp_interpose.current()
 
 
 
@@ -69,16 +92,27 @@ def _to_low(x, dt, target):
 
 
 def _to_fp32(x, dt):
-    if dt in _LOW_DTYPES:
+    if dt in LOW_PRECISION_DTYPES:
         return x.astype(jnp.float32)
     return x
 
 
 def make_low_prec_wrapper(orig, name: str):
     """Whitelist wrapper (reference ``make_cast_wrapper`` + ``maybe_half`` /
-    ``maybe_bfloat16``, wrap.py:10-29)."""
+    ``maybe_bfloat16``, wrap.py:10-29). Checks the fp8 context first:
+    under ``lowp.fp8_autocast`` the operands run through the e4m3/e5m2
+    QDQ pairs instead of a plain dtype cast. With neither the fp8
+    context nor an autocast dtype active the original function is called
+    untouched — the O0-O5 jaxpr-identity guarantee."""
     @functools.wraps(orig)
     def wrapper(*args, **kwargs):
+        ctx = _fp8_ctx()
+        if ctx is not None:
+            from apex_tpu.lowp import interpose as _lowp_interpose
+            args, kwargs = _cast_tree(
+                args, kwargs, lambda x, dt: ctx.cast(x, dt, name))
+            with _lowp_interpose.suspend():
+                return orig(*args, **kwargs)
         target = _active_dtype()
         if target is None:
             return orig(*args, **kwargs)
@@ -178,13 +212,23 @@ def disable_casts():
     error; under O4 the same path silently degrades in-kernel precision
     to bf16). Kernels own their precision schedule; amp governs the
     graph around them (r4 fix, surfaced by the convergence gate's O1
-    GPT config)."""
+    GPT config).
+
+    Also suspends any active ``lowp.fp8_autocast`` context for the same
+    reason: a Pallas kernel's internal dots must not get QDQ pairs
+    spliced into the Mosaic body (fp8 sim inside a kernel that owns its
+    own precision schedule), and the context's tensor-slot ordering must
+    not be perturbed by kernel-internal ops."""
+    from apex_tpu.lowp import interpose as _lowp_interpose
     prev = _active_dtype()
+    prev_fp8 = _lowp_interpose.current()
     _state.cast_dtype = None
+    _lowp_interpose._state.ctx = None
     try:
         yield
     finally:
         _state.cast_dtype = prev
+        _lowp_interpose._state.ctx = prev_fp8
 
 
 # -- registration API (amp.py:29-71) ---------------------------------------

@@ -10,10 +10,17 @@ Opt levels:
   O3: pure fp16.
   O4: function interposition with bf16, no loss scaling (bf16 has fp32 range).
   O5: bf16 model (batchnorm fp32) + fp32 master weights, no loss scaling.
+  O6: fp8 compute over bf16 weights — whitelisted ops run on e4m3-QDQ
+      operands inside ``lowp.fp8_autocast`` (e5m2 cotangents backward),
+      per-tensor delayed scaling threaded through the step; no loss
+      scaling (e5m2 carries fp16-class exponent range and the per-tensor
+      scales do the range management).
+  O7: O6 + fp32 master weights (the O2:O1 :: O7:O6 relation).
 
 O4/O5 are the reference fork's signature bf16 additions
 (apex/amp/frontend.py:207-246). On TPU the bf16 levels are the natural ones;
 fp16 levels are kept for API/behavior parity (XLA supports f16 storage).
+O6/O7 take the next step down (ROADMAP item 5, ``apex_tpu.lowp``).
 """
 
 from __future__ import annotations
@@ -42,6 +49,10 @@ class Properties:
     keep_batchnorm_fp32: Optional[bool] = None
     master_weights: bool = False
     loss_scale: LossScaleSpec = 1.0
+    # O6/O7: whitelisted ops run through the lowp fp8 QDQ pairs when a
+    # lowp.fp8_autocast context is active (initialize installs the
+    # interposition wrappers so the context has a seam to hook)
+    fp8: bool = False
     # True when the USER passed keep_batchnorm_fp32 (vs the opt-level
     # default): gates the zero-BN-matches warning in cast_model so BN-free
     # models under plain O2/O5 don't warn on every run.
@@ -61,14 +72,17 @@ class Properties:
         return self.loss_scale == "dynamic"
 
 
-def _mk(opt_level, cast_model_type, patch, patch_type, keep_bn, master, scale):
+def _mk(opt_level, cast_model_type, patch, patch_type, keep_bn, master, scale,
+        fp8=False):
     return Properties(
         enabled=True, opt_level=opt_level, cast_model_type=cast_model_type,
         patch_functions=patch, patch_functions_type=patch_type,
-        keep_batchnorm_fp32=keep_bn, master_weights=master, loss_scale=scale)
+        keep_batchnorm_fp32=keep_bn, master_weights=master, loss_scale=scale,
+        fp8=fp8)
 
 
-# Defaults exactly as the reference tables (frontend.py:118-254).
+# Defaults exactly as the reference tables (frontend.py:118-254); O6/O7
+# extend the fork's ladder into fp8 (apex_tpu.lowp, ROADMAP item 5).
 opt_levels = {
     "O0": _mk("O0", jnp.float32, False, None, None, False, 1.0),
     "O1": _mk("O1", None, True, jnp.float16, None, False, "dynamic"),
@@ -76,6 +90,8 @@ opt_levels = {
     "O3": _mk("O3", jnp.float16, False, None, False, False, 1.0),
     "O4": _mk("O4", None, True, jnp.bfloat16, None, False, 1.0),
     "O5": _mk("O5", jnp.bfloat16, False, None, True, True, 1.0),
+    "O6": _mk("O6", jnp.bfloat16, False, None, True, False, 1.0, fp8=True),
+    "O7": _mk("O7", jnp.bfloat16, False, None, True, True, 1.0, fp8=True),
 }
 
 
@@ -88,8 +104,8 @@ def resolve(opt_level: str = "O1", *,
     if opt_level not in opt_levels:
         raise ValueError(
             f"Unexpected optimization level {opt_level!r}; options are "
-            "'O0', 'O1', 'O2', 'O3', 'O4', 'O5' (the letter O + a digit, "
-            "not zero).")
+            "'O0', 'O1', 'O2', 'O3', 'O4', 'O5', 'O6', 'O7' (the letter O "
+            "+ a digit, not zero).")
     base = opt_levels[opt_level]
     props = dataclasses.replace(
         base,

@@ -20,7 +20,10 @@ TPU-native mapping:
   * multiple comm PGs / streams -> XLA latency-hiding scheduler
   * compressed allgather (e5m2 flag) -> ``allgather_dtype=jnp.bfloat16``
   * compressed grad reduction -> ``reduce_dtype="bf16"`` (16-bit wire for
-    the reduce-scatter, fp32 accumulation — docs/overlap.md contract)
+    the reduce-scatter, fp32 accumulation — docs/overlap.md contract);
+    ``"int8"`` steps down to the integer tier: per-bucket symmetric
+    scale agreed via pmax pre-collective, s8 psum_scatter (the scale
+    bound makes the integer sum exact), fp32 dequantize after
   * step-revert on overflow (revert_method 1-3) -> free: the functional step
     returns the previous state under ``lax.cond`` — nothing to undo.
   * ``dwu_group_size`` subgroup sharding (state sharded over a subgroup,
@@ -168,7 +171,7 @@ class _ZeroBase(FusedOptimizer):
         from apex_tpu.parallel import overlap as _overlap
         self.axis_name = axis_name
         self._shard_count = shard_count  # resolved lazily from the mesh
-        # 16-bit wire format for the gradient reduce-scatter (the inbound
+        # Narrow wire format for the gradient reduce-scatter (the inbound
         # analog of the compressed allgather): each bucket is pre-scaled
         # by the full data-parallel world and cast before psum_scatter,
         # and the local shard returns to fp32 immediately after — master
@@ -456,7 +459,23 @@ class _ZeroBase(FusedOptimizer):
         with jax.named_scope("apex_zero_reduce_scatter"):
             for b in spec["buckets"]:
                 flat = _bucket_flat(leaves, b["idxs"], b["padded"])
-                if self.reduce_dtype is not None:
+                if self.reduce_dtype == jnp.int8:
+                    # int8 tier: mean-predivide, then quantize at the
+                    # axis-agreed per-bucket scale (pmax of a scalar).
+                    # The w-aware scale bound keeps the s8 psum_scatter's
+                    # integer accumulation exact; dequantize lands fp32.
+                    # Cross-group psum (below) stays fp32 as for the
+                    # float tiers.
+                    from apex_tpu.parallel import overlap as _ov
+                    y = (flat / world).astype(jnp.float32)
+                    a = jax.lax.pmax(jnp.max(jnp.abs(y)), self.axis_name)
+                    s = _ov.int8_wire_scale(
+                        a, bound_axis_size(self.axis_name))
+                    sh = _ov.int8_dequantize(
+                        jax.lax.psum_scatter(
+                            _ov.int8_quantize(y, s), self.axis_name,
+                            scatter_dimension=0, tiled=True), s)
+                elif self.reduce_dtype is not None:
                     # pre-scaling compression: the full-world mean divide
                     # lands BEFORE the cast so wire-dtype partial sums
                     # carry mean-gradient magnitude (loss-scale-safe;

@@ -30,8 +30,11 @@ _IMPURE_PREFIXES = ("random.", "np.random.", "numpy.random.",
                     "time.", "datetime.")
 _LOWP_DTYPE_ATTRS = {"jnp.float16", "jnp.bfloat16", "jnp.half",
                      "jax.numpy.float16", "jax.numpy.bfloat16",
-                     "np.float16", "numpy.float16", "np.half"}
-_LOWP_DTYPE_STRS = {"float16", "bfloat16"}
+                     "np.float16", "numpy.float16", "np.half",
+                     "jnp.float8_e4m3fn", "jnp.float8_e5m2",
+                     "jax.numpy.float8_e4m3fn", "jax.numpy.float8_e5m2"}
+_LOWP_DTYPE_STRS = {"float16", "bfloat16",
+                    "float8_e4m3fn", "float8_e5m2"}
 _DTYPE_ARG_CALLS = {"asarray", "array", "zeros", "ones", "full", "empty",
                     "zeros_like", "ones_like", "full_like"}
 
@@ -387,8 +390,10 @@ def _check_rejit_and_build(tree: ast.Module, path: str,
 def _check_dtype_literals(tree: ast.Module, path: str,
                           findings: List[Finding]):
     norm = path.replace("\\", "/")
-    if any(part in norm for part in ("/amp/", "/fp16_utils/", "/lint/")):
-        return   # the policy tables / fp16 master-weight utils ARE the policy
+    if any(part in norm
+           for part in ("/amp/", "/fp16_utils/", "/lint/", "/lowp/")):
+        return   # the policy tables / fp16 master-weight utils / fp8
+        # scaling-recipe internals ARE the policy
 
     def is_lowp(node: ast.AST) -> bool:
         d = _dotted(node)

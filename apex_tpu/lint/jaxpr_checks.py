@@ -1,5 +1,5 @@
 """jaxpr pass: lower registered entry points and check what the AST can't
-see (rules APX101-APX105).
+see (rules APX101-APX107).
 
 Where the AST pass reads source, this pass reads the *program*: each
 registered entry point (the graft entry, a model forward+loss, an
@@ -15,7 +15,9 @@ custom-vjp / shard_map / pallas_call sub-jaxprs:
   matmul silently runs fp32 (the classic "slow model, right answer" bug).
   Operands that were *explicitly* upcast from a low dtype (fp32 softmax /
   loss islands — both sides descend from converts) are policy-intended
-  and pass. Sum-reductions must not accumulate in bf16/fp16.
+  and pass. Sum-reductions must not accumulate in bf16/fp16. fp8 dot
+  operands (APX107) must descend from a scale op — a mul/div by a
+  scalar quantization scale — or the matmul is numerically unanchored.
 
 * **collective consistency** (APX103/APX104): every ``psum`` / ``pmean``
   / ``all_gather`` / ``ppermute`` / ``all_to_all`` / ``psum_scatter`` /
@@ -73,6 +75,10 @@ def _is_low(aval) -> bool:
 
 def _is_f32(aval) -> bool:
     return _dtype_name(aval) == "float32"
+
+
+def _is_fp8(aval) -> bool:
+    return _dtype_name(aval).startswith("float8")
 
 
 def _frame_for(eqn, default_path: str, default_line: int
@@ -173,8 +179,8 @@ _APX106_PRIMS = ("psum", "psum_scatter", "reduce_scatter")
 
 
 def _check_wire_dtype(eqn, ctx: _Ctx):
-    """APX106: the entry declares a 16-bit wire format for gradient
-    reduction (``reduce_dtype=`` on its DDP/ZeRO config), but this
+    """APX106: the entry declares a narrow wire format (16-bit or int8)
+    for gradient reduction (``reduce_dtype=`` on its DDP/ZeRO config), but this
     collective moves an fp32 payload of gradient size — a call site that
     routed around ``allreduce_gradients`` / the ZeRO scatter and pays
     full-width wire bytes the config promised to halve."""
@@ -196,6 +202,32 @@ def _check_wire_dtype(eqn, ctx: _Ctx):
                 "through allreduce_gradients / the ZeRO reduce-scatter, "
                 "which honor reduce_dtype)")
             return
+
+
+def _check_fp8_dot(eqn, sc_env: Dict[Any, bool], ctx: _Ctx):
+    """APX107: an fp8 matmul operand must descend from a scale op (the
+    quantize's mul/div by a scalar scale). A tensor raw-cast to e4m3/
+    e5m2 and fed to dot_general clips everything past ±448/±57344 and
+    wastes the exponent range below — the numerically unanchored fp8
+    matmul the lowp tier exists to prevent."""
+    unscaled = []
+    for v in eqn.invars[:2]:
+        aval = getattr(v, "aval", None)
+        if aval is not None and _is_fp8(aval) and not _env_get(sc_env, v):
+            unscaled.append(_dtype_name(aval))
+    if unscaled:
+        ctx.emit(
+            "APX107", eqn,
+            f"dot_general consumes {'/'.join(unscaled)} operand(s) "
+            "with no reaching scale op — quantize at a scale "
+            "(lowp.scaling.quantize / lowp.fp8_matmul, or thread the "
+            "delayed-scaling state via lowp.fp8_autocast) instead of "
+            "raw-casting to fp8")
+
+
+def _is_scalar_shaped(aval) -> bool:
+    shape = getattr(aval, "shape", None)
+    return shape is not None and int(np.prod(shape or (1,))) == 1
 
 
 def _check_dot(eqn, low_env: Dict[Any, bool], ctx: _Ctx):
@@ -271,7 +303,8 @@ def _check_pallas(eqn, ctx: _Ctx):
                 f"breaks (8, 128) tiling: " + "; ".join(bad))
 
 
-def _walk(jaxpr, low_env: Dict[Any, bool], ctx: _Ctx):
+def _walk(jaxpr, low_env: Dict[Any, bool], sc_env: Dict[Any, bool],
+          ctx: _Ctx):
     for eqn in jaxpr.eqns:
         prim = eqn.primitive.name
 
@@ -285,6 +318,7 @@ def _walk(jaxpr, low_env: Dict[Any, bool], ctx: _Ctx):
             _check_wire_dtype(eqn, ctx)
         elif prim == "dot_general":
             _check_dot(eqn, low_env, ctx)
+            _check_fp8_dot(eqn, sc_env, ctx)
         elif prim == "pallas_call":
             _check_pallas(eqn, ctx)
         _check_reduce(eqn, ctx)
@@ -297,23 +331,43 @@ def _walk(jaxpr, low_env: Dict[Any, bool], ctx: _Ctx):
             if (aval is not None and _is_low(aval)) or _env_get(low_env, v):
                 in_low = True
                 break
+        # scale provenance (APX107): a mul/div with a scalar operand IS
+        # a scale op; everything downstream of one inherits "scaled"
+        in_scaled = prim in ("mul", "div") and any(
+            _is_scalar_shaped(getattr(v, "aval", None))
+            for v in eqn.invars)
+        if not in_scaled:
+            for v in eqn.invars:
+                if _env_get(sc_env, v):
+                    in_scaled = True
+                    break
         for ov in eqn.outvars:
             try:
                 low_env[ov] = in_low or _is_low(getattr(ov, "aval", None))
+                sc_env[ov] = in_scaled
             except TypeError:       # DropVar/Literal-like outputs
                 pass
 
         for inner, operands in subjaxprs(eqn):
             env: Dict[Any, bool] = {}
+            senv: Dict[Any, bool] = {}
             if operands is not None and len(operands) == len(inner.invars):
                 for outer, iv in zip(operands, inner.invars):
                     aval = getattr(outer, "aval", None)
                     env[iv] = _env_get(low_env, outer) or (
                         aval is not None and _is_low(aval))
+                    senv[iv] = _env_get(sc_env, outer)
             else:
                 for iv in inner.invars:
                     env[iv] = _is_low(getattr(iv, "aval", None))
-            _walk(inner, env, ctx)
+            if prim == "pallas_call":
+                # a kernel body owns its precision schedule — its fp8
+                # ref operands were quantized by the host-side wrapper
+                # (lowp.fp8_matmul), which this walk cannot see through
+                # the block mappings; exempt, never false-positive
+                for iv in inner.invars:
+                    senv[iv] = True
+            _walk(inner, env, senv, ctx)
 
 
 # ---------------------------------------------------------------------------
@@ -325,7 +379,7 @@ class EntrySpec:
     """A registered lowering target: ``make()`` returns ``(fn, args)``;
     ``opt_level`` ties the dtype rules to the amp.policy tables;
     ``mesh_axes`` declares the collectives' legal axis names;
-    ``reduce_dtype`` declares the entry's configured 16-bit gradient
+    ``reduce_dtype`` declares the entry's configured narrow gradient
     wire format (arms APX106 against fp32 payload collectives);
     ``donate_argnums`` declares which args the entry donates (arms the
     SPMD pass's APX203 use-after-donation liveness check)."""
@@ -393,7 +447,7 @@ def check_entry(fn: Callable, args: tuple, *, name: str = "<entry>",
         return ctx.findings
     env = {v: _is_low(getattr(v, "aval", None))
            for v in closed.jaxpr.invars}
-    _walk(closed.jaxpr, env, ctx)
+    _walk(closed.jaxpr, env, {}, ctx)
     if spmd:
         from apex_tpu.lint.spmd_checks import check_entry_spmd
         # hand over the lowering already done above — entries (GPT
@@ -479,6 +533,35 @@ def builtin_entries() -> List[EntrySpec]:
                           in_specs=(P(), P("data")), out_specs=P(),
                           check_vma=False)
         return f, (params, x)
+
+    def ddp_int8():
+        from jax.sharding import Mesh, PartitionSpec as P
+        from apex_tpu.parallel import allreduce_gradients
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+        params = {"w": jnp.ones((64, 64)), "b": jnp.ones((64,))}
+        x = jnp.ones((4, 64))
+
+        def per_device(p, x):
+            def loss_fn(p):
+                return jnp.mean((x @ p["w"] + p["b"]) ** 2)
+            g = jax.grad(loss_fn)(p)
+            return allreduce_gradients(g, "data", reduce_dtype="int8")
+
+        f = jax.shard_map(per_device, mesh=mesh,
+                          in_specs=(P(), P("data")), out_specs=P(),
+                          check_vma=False)
+        return f, (params, x)
+
+    def fp8_matmul_entry():
+        from apex_tpu.lowp import fp8_matmul
+        x = jnp.ones((64, 32))
+        w = jnp.ones((32, 48))
+
+        def fwd_bwd(x, w):
+            def loss(x, w):
+                return jnp.sum(fp8_matmul(x, w) ** 2)
+            return jax.grad(loss, argnums=(0, 1))(x, w)
+        return fwd_bwd, (x, w)
 
     def zero_step():
         from jax.sharding import Mesh, PartitionSpec as P
@@ -601,6 +684,11 @@ def builtin_entries() -> List[EntrySpec]:
         EntrySpec("ddp_compressed_grads", "apex_tpu/parallel/overlap.py",
                   ddp_compressed, mesh_axes=("data",),
                   reduce_dtype="bfloat16"),
+        EntrySpec("ddp_int8_grads", "apex_tpu/parallel/overlap.py",
+                  ddp_int8, mesh_axes=("data",),
+                  reduce_dtype="int8"),
+        EntrySpec("fp8_matmul_fwd_bwd", "apex_tpu/lowp/matmul.py",
+                  fp8_matmul_entry),
         EntrySpec("zero_adam_step", "apex_tpu/contrib/optimizers/zero.py",
                   zero_step, mesh_axes=("data",)),
         EntrySpec("overlap_staged_grads", "apex_tpu/parallel/overlap.py",

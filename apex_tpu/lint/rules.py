@@ -71,8 +71,12 @@ _RULES = [
          "block dims must be multiples of (8, 128) or span the array"),
     Rule("APX106", "collective-bypasses-reduce-dtype", ERROR,
          "psum/reduce-scatter moves a gradient-sized fp32 payload in an "
-         "entry configured with a 16-bit reduce_dtype — the call site "
-         "bypasses the compressed wire path"),
+         "entry configured with a narrow (16-bit/int8) reduce_dtype — "
+         "the call site bypasses the compressed wire path"),
+    Rule("APX107", "fp8-matmul-unscaled", ERROR,
+         "dot_general consumes a float8 operand with no reaching scale "
+         "op — a raw-cast fp8 matmul is numerically unanchored; "
+         "quantize at a scale (lowp.scaling.quantize / fp8_matmul)"),
     # ---- SPMD verifier pass (whole-program single-device semantics) -------
     Rule("APX201", "collective-schedule-divergence", ERROR,
          "collective reachable under rank-dependent control flow "

@@ -1,0 +1,59 @@
+"""apex_tpu.lowp — the fp8 compute tier (amp opt levels O6/O7).
+
+The reference fork's signature move was stretching Apex's opt levels to
+bf16 (O4/O5); this package takes the next step down (ROADMAP item 5):
+
+  * :mod:`scaling`   — per-tensor delayed scaling: bounded amax history
+    → power-of-two scales, a plain fp32 pytree threaded through the
+    train step like optimizer state.
+  * :mod:`qdq`       — quantize/dequantize cast pairs via ``custom_vjp``:
+    e4m3 for activations/weights forward, e5m2 for cotangents backward.
+  * :mod:`interpose` — ``fp8_autocast``, the trace-time context the amp
+    cast registry consults: whitelisted ops' operands run through the
+    QDQ pairs while it is active, untouched otherwise (O0–O5 stay
+    jaxpr-identical).
+  * :mod:`matmul`    — ``fp8_matmul``: fp8-input fp32-accumulate, jnp
+    reference path by default (CPU/CI hermetic), blocked Pallas kernel
+    behind ``APEX_TPU_FP8_BACKEND=pallas`` (declines off-TPU), block
+    sizes in the tune sweep registry.
+
+Opt-level surface (amp/frontend.py): **O6** = fp8 compute over bf16
+weights, **O7** = fp8 compute + fp32 master weights. The int8 *wire*
+tier (gradient collectives, ``reduce_dtype="int8"``) lives in
+``parallel.overlap`` — wire compression is a collectives property, not
+a compute one; docs/lowp.md has the full table.
+
+Recipe::
+
+    model, opt = amp.initialize(model, opt, opt_level="O6")
+    fp8_state = lowp.warmup_state(
+        lambda p, b: model.apply(p, b), params, batch)
+
+    def step(params, fp8_state, batch):
+        def loss_fn(p):
+            with lowp.fp8_autocast(fp8_state) as ctx:
+                loss = model.apply(p, batch)
+            return loss, ctx.new_state()
+        (loss, new_state), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        ...
+        return loss, new_state
+"""
+
+from apex_tpu.lowp.interpose import (Fp8Context, current, fp8_autocast,
+                                     warmup_state)
+from apex_tpu.lowp.matmul import backend, fp8_matmul, set_backend, supported
+from apex_tpu.lowp.qdq import fake_quant, qdq
+from apex_tpu.lowp.scaling import (DEFAULT_HISTORY, DEFAULT_MARGIN, E4M3,
+                                   E4M3_MAX, E5M2, E5M2_MAX, dequantize,
+                                   fp8_max, init_state, pow2_scale, quantize,
+                                   update_state)
+
+__all__ = [
+    "Fp8Context", "current", "fp8_autocast", "warmup_state",
+    "backend", "fp8_matmul", "set_backend", "supported",
+    "fake_quant", "qdq",
+    "DEFAULT_HISTORY", "DEFAULT_MARGIN", "E4M3", "E4M3_MAX", "E5M2",
+    "E5M2_MAX", "dequantize", "fp8_max", "init_state", "pow2_scale",
+    "quantize", "update_state",
+]

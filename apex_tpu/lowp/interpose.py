@@ -1,0 +1,199 @@
+"""``fp8_autocast`` — the trace-time context that routes the amp cast
+registry's whitelisted ops through fp8 QDQ pairs.
+
+The amp interposition wrappers (amp/interposition.py) check this module
+first: while a context is active, every float operand of a whitelisted
+op (dot_general, matmul, einsum, conv, ...) is passed through
+:func:`apex_tpu.lowp.qdq.fake_quant` — e4m3 QDQ forward, e5m2 QDQ on
+the cotangent backward — instead of a plain dtype cast. With no context
+active the wrappers call the original function untouched, which is what
+keeps O0–O5 programs jaxpr-identical to the pre-fp8 build.
+
+Delayed-scaling state threads through like optimizer state::
+
+    with lowp.fp8_autocast(fp8_state, telemetry_step=step) as ctx:
+        loss = model.apply(params, batch)          # casts consume scales
+    new_fp8_state = ctx.new_state()                # amaxes -> next scales
+
+Inside ``jax.value_and_grad`` the context wraps the *forward* trace;
+the backward e5m2 scales are just-in-time (see qdq.py). Tensor count
+discovery: trace once with ``state=None`` (just-in-time scales
+throughout) — ``warmup_state`` does it via ``jax.eval_shape`` at zero
+FLOPs — then ``scaling.init_state(ctx.num_tensors)``.
+
+Ops are matched to state slots by TRACE ORDER, so the step structure
+must match the warmup trace (same model, same intercepted ops); a
+mismatch raises at ``new_state`` rather than silently mispairing
+scales.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.lowp import qdq as _qdq
+from apex_tpu.lowp import scaling
+
+# dtypes the fp8 cast applies to; anything else (ints, bools, fp8
+# itself, f64 accumulators) passes through untouched
+_CASTABLE = (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16),
+             jnp.dtype(jnp.float16))
+
+_state = threading.local()
+
+
+def current() -> Optional["Fp8Context"]:
+    """The active context (None outside ``fp8_autocast`` — the hot-path
+    check the amp wrappers make on every call)."""
+    return getattr(_state, "ctx", None)
+
+
+@contextlib.contextmanager
+def suspend():
+    """Temporarily deactivate the context. The amp wrappers hold this
+    around the original function call: whitelisted ops nest (jnp.matmul
+    dispatches to the also-patched lax.dot_general), and without the
+    guard each operand would be QDQ'd once per nesting level — burning
+    state slots and double-quantizing."""
+    prev = current()
+    _state.ctx = None
+    try:
+        yield
+    finally:
+        _state.ctx = prev
+
+
+class Fp8Context:
+    """Collects per-tensor amaxes and hands out quantization scales in
+    trace order. Created by :func:`fp8_autocast`; not constructed
+    directly."""
+
+    def __init__(self, state: Optional[dict], *, margin: int,
+                 telemetry_step: Any = None, track: bool = True):
+        if state is not None:
+            n = state["scale"].shape[0]
+            if state["amax_history"].shape[0] != n:
+                raise ValueError("fp8 state scale/amax_history tensor "
+                                 "counts disagree")
+        self.state = state
+        self.margin = margin
+        self.telemetry_step = telemetry_step
+        self.track = track
+        self._amaxes: List[Any] = []
+        self._scales: List[Any] = []
+        self._labels: List[str] = []
+
+    # -- wrapper-facing ----------------------------------------------------
+    def cast(self, x, dt, label: str = "op"):
+        """The registry's fp8 cast: QDQ ``x`` at this tensor slot's scale
+        (delayed from state, or just-in-time when tracing stateless).
+        Non-castable dtypes pass through."""
+        if jnp.dtype(dt) not in _CASTABLE:
+            return x
+        i = len(self._amaxes)
+        amax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+        if self.state is not None and i < self.state["scale"].shape[0]:
+            scale = self.state["scale"][i]
+        else:
+            scale = scaling.pow2_scale(amax, scaling.E4M3_MAX, self.margin)
+        self._amaxes.append(amax)
+        self._scales.append(scale)
+        self._labels.append(f"t{i}:{label.rsplit('.', 1)[-1]}")
+        return _qdq.fake_quant(x, scale)
+
+    # -- step-state machine ------------------------------------------------
+    @property
+    def num_tensors(self) -> int:
+        """Tensors intercepted so far in this trace (sizes init_state)."""
+        return len(self._amaxes)
+
+    def amaxes(self):
+        """Stacked f32[T] of this trace's observed amaxes."""
+        if not self._amaxes:
+            return jnp.zeros((0,), jnp.float32)
+        return jnp.stack(self._amaxes)
+
+    def new_state(self, history: int = scaling.DEFAULT_HISTORY,
+                  axis_name=None) -> dict:
+        """Next step's delayed-scaling state from this trace's amaxes
+        (fresh-initialized from them when the context ran stateless).
+        Also emits the ``lowp/*`` health series for this step — per-
+        tensor amax/scale timelines plus saturation provenance — when
+        numerics health is enabled.
+
+        ``axis_name``: inside ``shard_map``, pmax the per-tensor amaxes
+        over that mesh axis first. Data-parallel shards each observe
+        only their batch shard's activations; without the sync the
+        threaded state (and therefore next step's scales) would diverge
+        across replicas. The health series then carry the synced,
+        replica-consistent amaxes too."""
+        if self.state is not None and \
+                self.num_tensors != self.state["scale"].shape[0]:
+            raise ValueError(
+                f"fp8_autocast intercepted {self.num_tensors} tensors but "
+                f"the threaded state holds {self.state['scale'].shape[0]} "
+                f"— the traced step no longer matches the warmup trace; "
+                f"re-run lowp.warmup_state")
+        # amaxes are monitoring state, not a differentiable path (the
+        # QDQ's custom_vjp already owns the gradient); without the stop,
+        # new_state() inside a value_and_grad aux would drag tangents
+        # into pmax, which has no differentiation rule
+        amaxes = jax.lax.stop_gradient(self.amaxes())
+        if axis_name is not None and self.num_tensors:
+            amaxes = jax.lax.pmax(amaxes, axis_name)
+        self._emit_health(amaxes)
+        if self.state is None:
+            fresh = scaling.init_state(self.num_tensors, history)
+            return scaling.update_state(fresh, amaxes, margin=self.margin)
+        return scaling.update_state(self.state, amaxes, margin=self.margin)
+
+    def _emit_health(self, amaxes=None) -> None:
+        if not self.track or self.num_tensors == 0:
+            return
+        from apex_tpu.telemetry import health as _health
+        if not _health.enabled():
+            return
+        _health.lowp_stats(amaxes if amaxes is not None else self.amaxes(),
+                           jnp.stack(self._scales),
+                           labels=tuple(self._labels),
+                           step=self.telemetry_step)
+
+
+@contextlib.contextmanager
+def fp8_autocast(state: Optional[dict] = None, *,
+                 margin: int = scaling.DEFAULT_MARGIN,
+                 telemetry_step: Any = None, track: bool = True):
+    """Scoped fp8 compute: whitelisted amp-registry ops inside the block
+    run on e4m3-QDQ operands (e5m2 cotangents in backward).
+
+    ``state`` is the delayed-scaling pytree (``scaling.init_state`` /
+    ``warmup_state``); None traces with just-in-time scales. Trace-time
+    scope, same contract as ``amp.autocast``. Requires the amp
+    interposition to be installed (``amp.initialize`` at O6/O7 does it;
+    so does ``amp.interposition.install()``).
+    """
+    ctx = Fp8Context(state, margin=margin, telemetry_step=telemetry_step,
+                     track=track)
+    prev = current()
+    _state.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _state.ctx = prev
+
+
+def warmup_state(fn, *args, history: int = scaling.DEFAULT_HISTORY,
+                 margin: int = scaling.DEFAULT_MARGIN, **kwargs) -> dict:
+    """Size a fresh delayed-scaling state by abstractly tracing ``fn``
+    (``jax.eval_shape`` — zero FLOPs, zero memory) under a stateless
+    context and counting the intercepted tensors."""
+    from apex_tpu.amp import interposition as _interp
+    _interp.install()
+    with fp8_autocast(None, margin=margin, track=False) as ctx:
+        jax.eval_shape(lambda *a: fn(*a, **kwargs), *args)
+    return scaling.init_state(ctx.num_tensors, history)

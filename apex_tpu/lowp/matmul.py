@@ -1,0 +1,178 @@
+"""fp8-input, fp32-accumulate matmul behind the xentropy-style backend
+select (``APEX_TPU_FP8_BACKEND=jnp|pallas``).
+
+Two execution paths, selected by :func:`backend`:
+
+  * **jnp** (the default, CPU/CI hermetic): quantize both operands to
+    e4m3 at their (delayed or just-in-time) scales, then a plain
+    ``lax.dot_general`` **on the fp8 arrays** with
+    ``preferred_element_type=float32`` — XLA widens in-register, so the
+    accumulation is fp32 and the operands carry exact fp8 precision.
+    This is the reference semantics the Pallas path is parity-tested
+    against, and what CI runs on the CPU mesh.
+  * **pallas** (opt-in): a blocked Mosaic kernel taking the e4m3 tiles
+    directly — grid (M/bm, N/bn, K/bk) with K innermost, one fp32 VMEM
+    accumulator tile per (i, j), dequantized by the combined scale once
+    at the end.  fp8 operand tiles want (32, 128) minimum Mosaic tiling,
+    so the path requires 128-aligned shapes and **declines off-TPU**
+    (no interpret-mode fallback: an fp8 candidate must not crash — or
+    silently masquerade — on a host backend; see
+    ``tune.measure.supports_fp8``).  Block sizes come from the tune
+    registry (``tune.fp8_matmul_blocks``) and are sweepable.
+
+Both paths return ``(x @ w)`` computed through the fp8 quantization of
+the inputs — NOT the exact product; parity between the two paths is the
+contract (tests/test_lowp.py), exactness vs fp32 is bounded by e4m3.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.lowp import scaling
+
+_BACKENDS = ("jnp", "pallas")
+_FORCE = os.environ.get("APEX_TPU_FP8_BACKEND", "auto")  # auto|jnp|pallas
+_OVERRIDE: Optional[str] = None
+
+# test hook: lets the CPU suite drive the Mosaic kernel through the
+# Pallas interpreter. NEVER set on the production path — off-TPU the
+# kernel path declines instead (satellite: decline, don't crash).
+_ALLOW_INTERPRET = False
+
+LANES = 128
+SUBLANES = 32  # fp8 min sublane tile
+
+
+def set_backend(name: Optional[str] = None) -> Optional[str]:
+    """Process-level backend override (None restores the env/default).
+    Returns the previous override so callers can save/restore."""
+    global _OVERRIDE
+    if name is not None and name not in _BACKENDS:
+        raise ValueError(f"fp8 matmul backend must be one of {_BACKENDS}, "
+                         f"got {name!r}")
+    prev = _OVERRIDE
+    _OVERRIDE = name
+    return prev
+
+
+def backend() -> str:
+    """Active execution path: ``set_backend`` override, else the
+    ``APEX_TPU_FP8_BACKEND`` env value; ``auto`` resolves to ``jnp``.
+    An unrecognized value raises (loud-failure doctrine: a typo'd opt-in
+    must not silently measure the reference path)."""
+    b = _OVERRIDE if _OVERRIDE is not None else _FORCE
+    if b in _BACKENDS:
+        return b
+    if b in ("auto", ""):
+        return "jnp"
+    raise ValueError(f"APEX_TPU_FP8_BACKEND={b!r} — expected one of "
+                     f"{_BACKENDS} or 'auto'")
+
+
+def supported(m: int, k: int, n: int) -> bool:
+    """Shape gate for the kernel path: fp8 operand tiles are (32, 128)
+    minimum, and the default blocking tiles all three dims by 128."""
+    return m % LANES == 0 and k % LANES == 0 and n % LANES == 0
+
+
+def _on_device() -> bool:
+    return jax.default_backend() in ("tpu", "axon") or _ALLOW_INTERPRET
+
+
+def _use_pallas(m: int, k: int, n: int) -> bool:
+    return backend() == "pallas" and supported(m, k, n) and _on_device()
+
+
+def _resolve_blocks(m, k, n, block_m, block_n, block_k):
+    if block_m is not None and block_n is not None and block_k is not None:
+        return int(block_m), int(block_n), int(block_k)
+    from apex_tpu import tune
+    bm, bn, bk = tune.fp8_matmul_blocks(m=m, k=k, n=n)
+    return (int(block_m) if block_m is not None else bm,
+            int(block_n) if block_n is not None else bn,
+            int(block_k) if block_k is not None else bk)
+
+
+def _jit_scale(x):
+    return scaling.pow2_scale(jnp.max(jnp.abs(x.astype(jnp.float32))),
+                              scaling.E4M3_MAX)
+
+
+def _mm_kernel(x_ref, w_ref, o_ref):
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    # fp8 tiles straight into the dot; fp32 accumulation is forced by
+    # preferred_element_type — the entire point of the kernel
+    o_ref[...] += jax.lax.dot_general(
+        x_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _pallas_mm(x8, w8, block_m, block_n, block_k):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, k = x8.shape
+    n = w8.shape[1]
+    bm = min(block_m, m)
+    bn = min(block_n, n)
+    bk = min(block_k, k)
+    grid = (m // bm, n // bn, k // bk)
+    return pl.pallas_call(
+        _mm_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        interpret=jax.default_backend() not in ("tpu", "axon"),
+    )(x8, w8)
+
+
+def fp8_matmul(x, w, *, scale_x=None, scale_w=None,
+               block_m: Optional[int] = None, block_n: Optional[int] = None,
+               block_k: Optional[int] = None, out_dtype=None):
+    """``x @ w`` through e4m3-quantized operands with fp32 accumulation.
+
+    ``x``: (M, K), ``w``: (K, N), any float dtype. ``scale_x`` /
+    ``scale_w`` are the quantization scales (fp32 scalars, typically the
+    delayed-scaling state's); None derives them just-in-time from the
+    operand's own amax. Output is dequantized by ``1/(scale_x*scale_w)``
+    and returned in ``out_dtype`` (default: the promoted input dtype).
+    """
+    if x.ndim != 2 or w.ndim != 2 or x.shape[1] != w.shape[0]:
+        raise ValueError(f"fp8_matmul wants (M,K)@(K,N), got "
+                         f"{x.shape} @ {w.shape}")
+    out = jnp.dtype(out_dtype) if out_dtype is not None \
+        else jnp.result_type(x.dtype, w.dtype)
+    sx = _jit_scale(x) if scale_x is None else \
+        jnp.asarray(scale_x, jnp.float32)
+    sw = _jit_scale(w) if scale_w is None else \
+        jnp.asarray(scale_w, jnp.float32)
+    x8 = scaling.quantize(x, sx, scaling.E4M3)
+    w8 = scaling.quantize(w, sw, scaling.E4M3)
+    m, k = x.shape
+    n = w.shape[1]
+    if _use_pallas(m, k, n):
+        bm, bn, bk = _resolve_blocks(m, k, n, block_m, block_n, block_k)
+        acc = _pallas_mm(x8, w8, bm, bn, bk)
+    else:
+        acc = jax.lax.dot_general(
+            x8, w8, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    return (acc / (sx * sw)).astype(out)

@@ -1,0 +1,58 @@
+"""Quantize→dequantize cast pairs with the fp8 training vjp contract.
+
+:func:`fake_quant` is the cast the amp registry applies to operands of
+whitelisted ops under ``lowp.fp8_autocast``: forward runs the value
+through **e4m3** (activations/weights — more mantissa), backward runs
+the incoming cotangent through **e5m2** (gradients — more exponent
+range). Both directions are QDQ (quantize, immediately dequantize), so
+the surrounding op executes on values carrying exact fp8 precision
+while the program stays in the compute dtype — the hermetic reference
+semantics; ``lowp.matmul`` holds the true fp8-input kernel.
+
+The forward scale is the delayed-scaling state's (threaded in by the
+caller); the backward scale is derived just-in-time from the
+cotangent's own amax. Cotangent amaxes cannot flow back into forward-
+threaded state through ``custom_vjp`` without mutable collections, and
+JIT scaling is the numerically stronger choice there anyway (the scale
+is never stale, for one extra backward reduction).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.lowp import scaling
+
+
+def qdq(x, scale, dtype=scaling.E4M3):
+    """Plain quantize→dequantize round trip in ``x``'s dtype (no custom
+    gradient — differentiating through it sees the clip's gradient)."""
+    q = scaling.quantize(x, scale, dtype)
+    return scaling.dequantize(q, scale, x.dtype)
+
+
+@jax.custom_vjp
+def fake_quant(x, scale):
+    """fp8 cast pair: e4m3 QDQ forward, e5m2 QDQ on the cotangent
+    backward (straight-through: the cotangent of the clip/round is the
+    quantized cotangent itself). ``scale`` gets a zero cotangent — it is
+    state, not a trained parameter."""
+    return qdq(x, scale, scaling.E4M3)
+
+
+def _fake_quant_fwd(x, scale):
+    # residual: only the zero scale-cotangent (the output is in x's
+    # dtype, so backward recovers the input dtype from g itself)
+    return qdq(x, scale, scaling.E4M3), jnp.zeros_like(scale)
+
+
+def _fake_quant_bwd(res, g):
+    g32 = g.astype(jnp.float32)
+    gscale = scaling.pow2_scale(jnp.max(jnp.abs(g32)), scaling.E5M2_MAX,
+                                margin=0)
+    gq = qdq(g32, gscale, scaling.E5M2)
+    return gq.astype(g.dtype), res
+
+
+fake_quant.defvjp(_fake_quant_fwd, _fake_quant_bwd)

@@ -1,0 +1,122 @@
+"""Per-tensor delayed scaling for fp8 compute (ROADMAP item 5).
+
+fp8 has ~2 decimal digits of dynamic headroom per format (e4m3 tops out
+at 448, e5m2 at 57344), so every tensor must be rescaled into the
+representable band before the cast and rescaled back after.  The scheme
+here is *delayed scaling*: each fp8 tensor keeps a bounded history of
+its recent absolute maxima, and the quantization scale for step N is
+derived from the history as of step N-1.  That keeps the scale a
+trace-time-threaded fp32 array (no data-dependent recompilation, no
+host sync) at the cost of one-step staleness — a tensor whose amax
+jumps past its history saturates for one step (clipped to ±fp8_max, a
+finite value, so the amp overflow check is NOT tripped; the saturation
+event is what ``telemetry.health``'s ``lowp/*`` series records).
+
+State layout (a plain pytree, so it threads through jit/donation/
+checkpoints like any optimizer state)::
+
+    {"amax_history": f32[T, H],   # ring of the last H amaxes per tensor
+     "scale":        f32[T]}      # quantization scale derived from it
+
+Scales are powers of two: ``scale = 2^(floor(log2(fp8_max / amax)) -
+margin)``.  A pow2 scale multiplies mantissas exactly, so quantize →
+dequantize round-trips bit-exactly for values already representable in
+fp8, and the scale composes exactly with amp's pow2 loss scale.
+
+``T`` (the tensor count) is discovered by tracing: run one step inside
+``lowp.fp8_autocast(None)`` (or call :func:`apex_tpu.lowp.warmup_state`
+which does it via ``jax.eval_shape`` — zero FLOPs) and size the state
+from the context's ``num_tensors``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# fp8 wire formats (jax ships both ml_dtypes variants; e4m3fn is the
+# "no infinities, saturating" variant every fp8 training recipe uses)
+E4M3 = jnp.float8_e4m3fn
+E5M2 = jnp.float8_e5m2
+
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+
+DEFAULT_HISTORY = 16
+# one binade of headroom below fp8_max: the delayed scale is one step
+# stale, so leave room for the amax to grow 2x before saturating
+DEFAULT_MARGIN = 1
+
+_FP8_MAX = {jnp.dtype(E4M3): E4M3_MAX, jnp.dtype(E5M2): E5M2_MAX}
+
+
+def fp8_max(dtype) -> float:
+    """Largest finite magnitude of an fp8 dtype."""
+    return _FP8_MAX[jnp.dtype(dtype)]
+
+
+def pow2_scale(amax, max_val: float, margin: int = DEFAULT_MARGIN):
+    """Power-of-two scale mapping ``amax`` just under ``max_val``.
+
+    ``x * scale`` is guaranteed <= max_val for |x| <= amax (floor keeps
+    the exponent conservative); margin subtracts extra binades of
+    headroom. amax == 0 (a dead tensor) resolves to scale 1.0, and the
+    exponent is clamped to ±30 so a denormal amax cannot produce an
+    inf/0 scale.
+    """
+    amax = jnp.asarray(amax, jnp.float32)
+    exp = jnp.floor(jnp.log2(max_val / jnp.maximum(amax, 1e-30))) - margin
+    exp = jnp.clip(exp, -30.0, 30.0)
+    # ldexp, not exp2: XLA's f32 exp2 is off by an ulp for some integer
+    # exponents (e.g. exp2(21) -> 2097153 on CPU), which would break the
+    # exact-pow2 contract everything downstream composes on
+    pow2 = jnp.ldexp(jnp.float32(1.0), exp.astype(jnp.int32))
+    return jnp.where(amax > 0.0, pow2, 1.0).astype(jnp.float32)
+
+
+def init_state(num_tensors: int, history: int = DEFAULT_HISTORY) -> dict:
+    """Fresh delayed-scaling state: empty history, unit scales (the
+    first step quantizes at scale 1.0 and seeds the history)."""
+    if num_tensors < 0:
+        raise ValueError(f"num_tensors must be >= 0, got {num_tensors}")
+    if history < 1:
+        raise ValueError(f"history must be >= 1, got {history}")
+    return {"amax_history": jnp.zeros((num_tensors, history), jnp.float32),
+            "scale": jnp.ones((num_tensors,), jnp.float32)}
+
+
+def update_state(state: dict, amaxes, *, max_val: float = E4M3_MAX,
+                 margin: int = DEFAULT_MARGIN) -> dict:
+    """One state-machine step: push this step's observed amaxes into the
+    ring, derive next step's scales from the history max.
+
+    Pure function of (state, amaxes) — call it inside the jitted step
+    with the amaxes collected by ``fp8_autocast`` and carry the result
+    forward, exactly like optimizer state.
+    """
+    hist = jnp.asarray(state["amax_history"], jnp.float32)
+    amaxes = jnp.asarray(amaxes, jnp.float32)
+    if amaxes.shape != (hist.shape[0],):
+        raise ValueError(
+            f"amaxes shape {amaxes.shape} does not match state with "
+            f"{hist.shape[0]} tensors — re-init the state (warmup_state) "
+            f"after changing the model or the set of intercepted ops")
+    hist = jnp.roll(hist, 1, axis=1).at[:, 0].set(amaxes)
+    amax = jnp.max(hist, axis=1)
+    return {"amax_history": hist,
+            "scale": pow2_scale(amax, max_val, margin)}
+
+
+def quantize(x, scale, dtype=E4M3):
+    """Scale, saturate, cast: the raw fp8 array (``dequantize`` undoes
+    it). Saturation is explicit so e5m2 (which HAS inf) clips instead of
+    overflowing — a saturated fp8 tensor stays finite and is reported
+    through the lowp/* health series, not the amp overflow check."""
+    m = fp8_max(dtype)
+    y = x.astype(jnp.float32) * jnp.asarray(scale, jnp.float32)
+    return jnp.clip(y, -m, m).astype(dtype)
+
+
+def dequantize(q, scale, dtype=jnp.float32):
+    return (q.astype(jnp.float32) / jnp.asarray(scale, jnp.float32)) \
+        .astype(dtype)

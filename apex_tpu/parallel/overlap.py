@@ -13,17 +13,34 @@ Three legs, composable independently (ROADMAP item 1):
     expressed as dataflow for XLA's latency-hiding scheduler. Bucket
     granularity resolves through ``apex_tpu.tune`` (op ``ddp_overlap``).
 
-  * **Wire compression** — ``reduce_dtype`` (bf16/fp16) casts each
-    bucket to a 16-bit wire format for the collective and returns to the
-    original dtype after, halving ``bytes_wire``. Numerics contract
-    (*pre-scaling*): the full mean divide is folded in *before* the cast,
-    so wire-dtype partial sums carry mean-gradient magnitude — fp16 wire
-    stays in range even under a 2^16 amp loss scale, and a true overflow
-    saturates to Inf which the amp scaler's non-finite check catches (the
-    step is skipped and the scale backs off — O2/O5 stay
-    loss-scale-correct). bf16 shares fp32's exponent range, so bf16 wire
-    is range-safe at any loss scale and costs only mantissa (~3 decimal
-    digits on the per-bucket mean).
+  * **Wire compression** — ``reduce_dtype`` (bf16/fp16/int8) casts each
+    bucket to a narrow wire format for the collective and returns to the
+    original dtype after, halving (16-bit) or quartering (int8)
+    ``bytes_wire``. Numerics contract (*pre-scaling*): the full mean
+    divide is folded in *before* the cast, so wire-dtype partial sums
+    carry mean-gradient magnitude — fp16 wire stays in range even under
+    a 2^16 amp loss scale, and a true overflow saturates to Inf which
+    the amp scaler's non-finite check catches (the step is skipped and
+    the scale backs off — O2/O5 stay loss-scale-correct). bf16 shares
+    fp32's exponent range, so bf16 wire is range-safe at any loss scale
+    and costs only mantissa (~3 decimal digits on the per-bucket mean).
+
+    The **int8 tier** (ROADMAP item 5) quantizes each predivided bucket
+    symmetrically at one per-bucket scale agreed globally pre-collective
+    (``pmax`` of the local amax — a scalar, invisible next to the
+    payload): ``s = amax * w / (127 - w/2)``, sized so the integer psum
+    of ``w`` rounded contributions provably cannot exceed ±127 — XLA
+    accumulates s8 collectives IN s8, and wraparound would corrupt
+    silently. Accumulation past the wire is fp32 (the dequantize
+    multiplies the summed integers by ``s``). The scale is *linear in
+    amax*, so a power-of-two loss scale passes through exactly
+    (``quantize(L·g)`` returns the same integers with scale ``L·s``) —
+    amp's 2^16 scaling and Adasum's scale-invariance both survive the
+    wire, pinned by tests/test_lowp.py. Resolution is ~``(127 - w/2)/w``
+    levels per replica contribution: honest at 8-replica scale (~15
+    levels), marginal past ~64 — the planner's cost model weighs the
+    4x wire saving against that, and axis sizes >= 252 (scale bound
+    degenerate) are rejected outright.
 
   * **Adasum** — ``adasum=True`` replaces the mean with adaptive
     summation ("Scaling Distributed Training with Adaptive Summation",
@@ -66,19 +83,24 @@ from apex_tpu.parallel.mesh import bound_axis_size
 
 Tree = Any
 
-# accepted spellings -> canonical dtype name. 16-bit floats only: an 8-bit
-# wire format would need error feedback state this engine does not keep,
-# and a 32-bit "compression" is the identity.
+# accepted spellings -> canonical dtype name. The float tiers cast; the
+# int8 tier quantizes at a per-bucket symmetric scale agreed globally
+# before the collective (see the module numerics contract) — stateless,
+# no error feedback, because the scale bound makes the integer psum
+# exact. A 32-bit "compression" is the identity and stays rejected.
 _WIRE_DTYPES = {
     "bf16": "bfloat16", "bfloat16": "bfloat16",
     "fp16": "float16", "float16": "float16", "half": "float16",
+    "int8": "int8",
 }
+
+INT8_MAX = 127.0
 
 
 def resolve_reduce_dtype(reduce_dtype):
-    """None, a spelling ('bf16', 'fp16', 'bfloat16', 'float16'), or a
-    dtype-like -> canonical ``jnp.dtype`` (or None). Anything that is not
-    a 16-bit float wire format raises."""
+    """None, a spelling ('bf16', 'fp16', 'bfloat16', 'float16', 'int8'),
+    or a dtype-like -> canonical ``jnp.dtype`` (or None). Anything that
+    is not a supported wire format raises."""
     if reduce_dtype is None:
         return None
     name = (reduce_dtype if isinstance(reduce_dtype, str)
@@ -86,9 +108,59 @@ def resolve_reduce_dtype(reduce_dtype):
     canon = _WIRE_DTYPES.get(name.lower())
     if canon is None:
         raise ValueError(
-            f"reduce_dtype must be a 16-bit float wire format "
+            f"reduce_dtype must be a wire format "
             f"({sorted(set(_WIRE_DTYPES))}) or None; got {reduce_dtype!r}")
     return jnp.dtype(canon)
+
+
+def int8_wire_scale(amax, world: int):
+    """The int8 tier's per-bucket symmetric scale: ``amax * w /
+    (127 - w/2)``.
+
+    Derivation: each replica ships ``q_i = round(y_i / s)`` with
+    ``|y_i| <= amax``, so ``|q_i| <= amax/s + 1/2`` and the integer sum
+    over ``w`` replicas is bounded by ``w·amax/s + w/2``; solving
+    ``= 127`` gives this ``s``. XLA accumulates s8 collectives in s8 —
+    the bound is what makes the integer psum exact rather than silently
+    wrapped. Linear in amax (loss-scale/Adasum scale-invariance is
+    exact under power-of-two multipliers); amax == 0 resolves to 1.0.
+    """
+    denom = INT8_MAX - 0.5 * world
+    if denom < 1.0:
+        raise ValueError(
+            f"int8 wire: axis size {world} leaves no integer headroom "
+            f"(the psum bound 127 - w/2 degenerates past w=252; "
+            f"resolution is already marginal past ~64 replicas — use "
+            f"bf16 for axes this wide)")
+    amax = jnp.asarray(amax, jnp.float32)
+    return jnp.where(amax > 0.0, amax * (world / denom),
+                     1.0).astype(jnp.float32)
+
+
+def int8_quantize(y, scale):
+    """clip(round(y / s)) in s8 — the clip is belt-and-braces (the scale
+    bound already keeps |q| <= 127 - w/2 + 1/2)."""
+    q = jnp.round(y.astype(jnp.float32) / scale)
+    return jnp.clip(q, -INT8_MAX, INT8_MAX).astype(jnp.int8)
+
+
+def int8_dequantize(q, scale):
+    """Summed integers back to fp32 gradient magnitude — everything past
+    the wire accumulates fp32, same as the float tiers."""
+    return q.astype(jnp.float32) * scale
+
+
+def _group_world(axis_name: str, axis_index_groups) -> int:
+    """The number of contributions one collective actually sums — the
+    GROUP size when axis_index_groups restricts the ring (this is the
+    ``w`` in the int8 scale bound; the full axis size would
+    over-conservatively shrink the scale)."""
+    if axis_index_groups is not None:
+        try:
+            return len(axis_index_groups[0])
+        except Exception:
+            pass
+    return bound_axis_size(axis_name)
 
 
 def validate_comm_args(*, reduce_dtype, adasum: bool,
@@ -240,7 +312,15 @@ def adasum_flat(flat: jax.Array, axis_name: str, *,
     compute the combination from the SAME quantized views (own is read
     back through the wire dtype when compressing), and the formula is
     symmetric, so the result stays replica-consistent bitwise. Dot
-    products and the combination always run in fp32."""
+    products and the combination always run in fp32.
+
+    int8 wire: each level quantizes at the PAIR's agreed scale
+    (``pmax`` of the local amax over the 2-member groups, w=2 in the
+    scale bound — so ``s = amax/62.5``, two rounded contributions can
+    never overflow the s8 psum) and recovers the partner in exact
+    integer arithmetic; no 0.5 pre-halving is needed because the scale
+    owns the range. Scale linearity keeps the combination's
+    scale-invariance exact under power-of-two loss scales."""
     world = bound_axis_size(axis_name)
     if world == 1:
         return flat
@@ -255,26 +335,42 @@ def adasum_flat(flat: jax.Array, axis_name: str, *,
         span = stride * 2
         groups = [[b * span + j, b * span + j + stride]
                   for b in range(world // span) for j in range(stride)]
-        if wire_dt is None:
-            wire = acc
+        if wire_dt == jnp.int8:
+            # pair-scoped scale agreement (w=2 bound); own is the
+            # dequantized OWN integers, so both members combine the
+            # same quantized views — integers <= 127 are exact in f32,
+            # making total - own an exact partner recovery
+            amax = jax.lax.pmax(jnp.max(jnp.abs(acc)), axis_name,
+                                axis_index_groups=groups)
+            scale = int8_wire_scale(amax, 2)
+            q = int8_quantize(acc, scale)
+            total_q = jax.lax.psum(q, axis_name, axis_index_groups=groups)
+            own = int8_dequantize(q, scale)
+            other = int8_dequantize(total_q, scale) - own
         else:
-            # per-level pre-scaling: halve before the cast so the pair
-            # psum of two near-max values stays in the wire dtype's
-            # range (fp16: two elements at 40k would sum to Inf raw);
-            # the combination is scale-invariant and linear, so doubling
-            # the result after restores magnitude exactly (x0.5/x2 are
-            # power-of-two exact in every float format)
-            wire = (acc * 0.5).astype(wire_dt)
-        total = jax.lax.psum(wire, axis_name, axis_index_groups=groups)
-        own = wire.astype(jnp.float32)
-        other = total.astype(jnp.float32) - own
+            if wire_dt is None:
+                wire = acc
+            else:
+                # per-level pre-scaling: halve before the cast so the
+                # pair psum of two near-max values stays in the wire
+                # dtype's range (fp16: two elements at 40k would sum to
+                # Inf raw); the combination is scale-invariant and
+                # linear, so doubling the result after restores
+                # magnitude exactly (x0.5/x2 are power-of-two exact in
+                # every float format)
+                wire = (acc * 0.5).astype(wire_dt)
+            total = jax.lax.psum(wire, axis_name, axis_index_groups=groups)
+            own = wire.astype(jnp.float32)
+            other = total.astype(jnp.float32) - own
         dot = jnp.sum(own * other)
         n_own = jnp.sum(own * own)
         n_oth = jnp.sum(other * other)
         a = jnp.where(n_own > 0.0, dot / (2.0 * n_own), 0.0)
         b = jnp.where(n_oth > 0.0, dot / (2.0 * n_oth), 0.0)
         acc = (1.0 - a) * own + (1.0 - b) * other
-        if wire_dt is not None:
+        if wire_dt is not None and wire_dt != jnp.int8:
+            # undo the float tiers' x0.5 pre-halving (int8 never
+            # halved: its scale owns the range)
             acc = acc * 2.0
     return acc.astype(flat.dtype)
 
@@ -334,8 +430,21 @@ def reduce_bucket(flat: jax.Array, axis_name: str, *,
         if adasum:
             red = adasum_flat(flat, axis_name, reduce_dtype=wire_dt)
         else:
-            wire = flat if wire_dt is None or flat.dtype == wire_dt \
-                else flat.astype(wire_dt)
+            scale = None
+            if wire_dt == jnp.int8:
+                # int8 tier: agree one per-bucket symmetric scale
+                # globally (pmax of a scalar — invisible next to the
+                # payload), quantize the predivided bucket, ship s8.
+                # The scale bound makes the integer psum exact.
+                w = _group_world(axis_name, axis_index_groups)
+                amax = jax.lax.pmax(
+                    jnp.max(jnp.abs(flat.astype(jnp.float32))),
+                    axis_name, axis_index_groups=axis_index_groups)
+                scale = int8_wire_scale(amax, w)
+                wire = int8_quantize(flat, scale)
+            else:
+                wire = flat if wire_dt is None or flat.dtype == wire_dt \
+                    else flat.astype(wire_dt)
             psum = functools.partial(jax.lax.psum, axis_name=axis_name,
                                      axis_index_groups=axis_index_groups)
             if 0 < message_size < wire.shape[0]:
@@ -345,7 +454,9 @@ def reduce_bucket(flat: jax.Array, axis_name: str, *,
                      for i in range(0, wire.shape[0], message_size)])
             else:
                 red = psum(wire)
-            if wire_dt is not None and red.dtype != jnp.float32:
+            if scale is not None:
+                red = int8_dequantize(red, scale)
+            elif wire_dt is not None and red.dtype != jnp.float32:
                 # fp32 accumulation of everything downstream of the
                 # wire: postdivide, health norms, the caller's
                 # unscale/update

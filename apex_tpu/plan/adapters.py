@@ -250,6 +250,15 @@ class GPTAdapter:
             # that seam, so it is not available here — loudly.
             return ("reduce_dtype rides the DDP bucketed-allreduce "
                     "seam; tp/seq layouts use plain collectives")
+        if layout.fp8:
+            # the cost model prices the tier (Constraints.fp8_modes),
+            # but emitting it needs lowp.fp8_autocast + delayed-scaling
+            # state threaded through the reference step — not built;
+            # pricing a layout we would then build WITHOUT fp8 would
+            # make the traced tier dishonest
+            return ("fp8 compute tier (amp O6) is not threaded through "
+                    "the reference step builder — rank it analytically "
+                    "or wire lowp.fp8_autocast into your own step")
         return None
 
     # -- build -------------------------------------------------------------
@@ -658,6 +667,9 @@ class ResNetAdapter:
         if layout.microbatch > 1:
             return ("microbatch accumulation changes SyncBatchNorm "
                     "statistics semantics — not built for resnet")
+        if layout.fp8:
+            return ("fp8 compute tier (amp O6) is not threaded through "
+                    "the resnet reference step — rank it analytically")
         return None
 
     def build(self, layout: Layout, devices=None) -> Built:

@@ -44,7 +44,8 @@ __all__ = ["CostBreakdown", "HeteroCost", "WireItem", "estimate",
            "analytic_wire", "traced_wire", "hbm_footprint",
            "decode_step_s", "heterogeneous_step_s", "member_speeds",
            "optimal_weights", "OVERLAP_EFFICIENCY", "ici_bytes_per_s",
-           "collective_latency_s"]
+           "collective_latency_s", "WIRE_ITEMSIZE", "fp8_flop_scale",
+           "fp8_bytes_scale"]
 
 # Fraction of a staged dp-collective's time that hides behind backward
 # compute (PR 6 overlap engine; pyprof measured 79.6% on the live GPT
@@ -64,6 +65,28 @@ ICI_BW_DEFAULT = 9e10
 # Fixed per-collective cost (dispatch + link latency) — prices bucket
 #-count trade-offs so a 10k-bucket schedule ranks worse than 8 buckets.
 COLLECTIVE_LATENCY_S = 8e-6
+
+# Per-element wire bytes of each reduce_dtype tier (grad collectives
+# pre-cast to the wire format; fp32 accumulation after). None falls
+# back to the model's grad itemsize.
+WIRE_ITEMSIZE = {"bf16": 2, "fp16": 2, "int8": 1}
+
+# fp8 compute-tier pricing (Layout.fp8 / amp O6): the MXU runs fp8
+# matmuls at ~2x the bf16 rate and the forward stash moves 1-byte
+# activations where bf16 moved 2 — relative ranking multipliers like
+# the roofline CPU constants, env-overridable for new silicon.
+FP8_FLOP_SCALE_DEFAULT = 0.5
+FP8_BYTES_SCALE_DEFAULT = 0.75
+
+
+def fp8_flop_scale() -> float:
+    env = os.environ.get("APEX_TPU_PLAN_FP8_FLOP_SCALE")
+    return float(env) if env else FP8_FLOP_SCALE_DEFAULT
+
+
+def fp8_bytes_scale() -> float:
+    env = os.environ.get("APEX_TPU_PLAN_FP8_BYTES_SCALE")
+    return float(env) if env else FP8_BYTES_SCALE_DEFAULT
 
 
 def ici_bytes_per_s() -> float:
@@ -191,7 +214,8 @@ def analytic_wire(desc: ModelDesc, layout: Layout) -> List[WireItem]:
     items: List[WireItem] = []
     dims = desc.dims
     grad_b = desc.param_count * desc.grad_itemsize
-    wire_item = 2 if layout.reduce_dtype else desc.grad_itemsize
+    wire_item = WIRE_ITEMSIZE.get(layout.reduce_dtype,
+                                  desc.grad_itemsize)
     wire_b = desc.param_count * wire_item
     n_buckets = max(1, -(-desc.param_count
                          // (layout.ddp_bucket or 2 ** 23)))
@@ -365,6 +389,11 @@ def hbm_footprint(desc: ModelDesc, layout: Layout,
                                                * layout.microbatch)
     act = desc.act_bytes_per_sample * local_batch \
         / (layout.seq * layout.pp)
+    if layout.fp8:
+        # fp8 compute tier: the forward stash holds 1-byte e4m3
+        # activations where bf16 held 2 (weights/grads/opt unchanged —
+        # O6 keeps bf16 weights, O7 fp32 masters ride the opt term)
+        act *= 0.5
     out = {"params": params, "grads": grads, "opt": opt, "act": act,
            "total": params + grads + opt + act}
     if capacity is not None:
@@ -414,6 +443,11 @@ def estimate(desc: ModelDesc, layout: Layout, *,
 
     compute_s = desc.flops_per_step / world / peaks["flops"]
     memory_s = desc.bytes_per_step / world / peaks["bytes_per_s"]
+    if layout.fp8:
+        # the lowp compute tier: fp8 matmuls at ~2x MXU rate, narrower
+        # activation traffic (constants above; env-overridable)
+        compute_s *= fp8_flop_scale()
+        memory_s *= fp8_bytes_scale()
     roofline_s = max(compute_s, memory_s)
 
     analytic = analytic_wire(desc, layout)
@@ -448,6 +482,10 @@ def estimate(desc: ModelDesc, layout: Layout, *,
     if layout.reduce_dtype:
         notes.append(f"{layout.reduce_dtype} wire compression "
                      "(pre-scaled, fp32 accumulation)")
+    if layout.fp8:
+        notes.append(
+            f"fp8 compute tier (amp O6: e4m3 fwd / e5m2 bwd QDQ; "
+            f"flops x{fp8_flop_scale()}, hbm x{fp8_bytes_scale()})")
     return CostBreakdown(
         layout_id=layout.layout_id(),
         compute_s=compute_s, memory_s=memory_s, roofline_s=roofline_s,

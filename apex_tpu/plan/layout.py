@@ -19,7 +19,7 @@ import dataclasses
 import re
 from typing import List, Optional, Tuple
 
-REDUCE_DTYPES = (None, "bf16", "fp16")
+REDUCE_DTYPES = (None, "bf16", "fp16", "int8")
 SEQ_IMPLS = ("ring", "ulysses")
 
 # ZeRO stages the toolkit implements: 0 = replicated optimizer state
@@ -43,6 +43,7 @@ class Layout:
     zero: int = 0                        # ZERO_STAGES
     microbatch: int = 1                  # grad-accumulation chunks
     reduce_dtype: Optional[str] = None   # wire dtype for grad collectives
+    fp8: bool = False                    # lowp O6 fp8 compute tier
     overlap: bool = True                 # stage dp collectives in backward
     seq_impl: str = "ring"               # when seq > 1
     # planner-resolved bucket capacities (elements); None = the tune
@@ -93,6 +94,8 @@ class Layout:
             bits.append(f"mb{self.microbatch}")
         if self.reduce_dtype:
             bits.append(self.reduce_dtype)
+        if self.fp8:
+            bits.append("fp8")
         if not self.overlap:
             bits.append("noov")
         return "-".join(bits)
@@ -141,6 +144,10 @@ class Layout:
             raise ValueError(
                 f"Layout.seq_impl must be one of {SEQ_IMPLS}, "
                 f"got {self.seq_impl!r}")
+        if not isinstance(self.fp8, bool):
+            raise ValueError(
+                f"Layout.fp8 must be a bool (the lowp O6 compute "
+                f"tier), got {self.fp8!r}")
         if self.zero and self.dp < 2:
             raise ValueError(
                 "ZeRO shards optimizer state over the data axis — "
@@ -170,7 +177,8 @@ _ID_RE = re.compile(
     r"(?:-(?P<seqtag>sq|uly)(?P<seq>\d+))?"
     r"(?:-zero(?P<zero>\d+))?"
     r"(?:-mb(?P<mb>\d+))?"
-    r"(?:-(?P<rd>bf16|fp16))?"
+    r"(?:-(?P<rd>bf16|fp16|int8))?"
+    r"(?:-(?P<fp8>fp8))?"
     r"(?:-(?P<noov>noov))?$")
 
 
@@ -183,11 +191,12 @@ def parse_layout_id(s: str) -> Layout:
             f"unparseable layout id {s!r}; expected e.g. 'dp8', "
             "'dp4-tp2', 'dp8-zero2-mb2-bf16', 'dp2-sq4' "
             "(grammar: dpN[-tpN][-ppN][-sqN|-ulyN][-zeroN][-mbN]"
-            "[-bf16|-fp16][-noov])")
+            "[-bf16|-fp16|-int8][-fp8][-noov])")
     g = m.groupdict()
     return Layout(
         dp=int(g["dp"]), tp=int(g["tp"] or 1), pp=int(g["pp"] or 1),
         seq=int(g["seq"] or 1), zero=int(g["zero"] or 0),
         microbatch=int(g["mb"] or 1), reduce_dtype=g["rd"],
+        fp8=g["fp8"] is not None,
         overlap=g["noov"] is None,
         seq_impl=("ulysses" if g["seqtag"] == "uly" else "ring"))

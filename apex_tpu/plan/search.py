@@ -43,7 +43,14 @@ class Constraints:
         :func:`apex_tpu.pyprof.roofline.device_hbm_bytes` of the local
         device.
     zero_stages / microbatches / reduce_dtypes:
-        The knob values enumerated (defaults cover the proven set).
+        The knob values enumerated (defaults cover the proven set;
+        ``reduce_dtypes`` additionally accepts ``"fp16"``/``"int8"`` —
+        the int8 wire tier competes only when asked for).
+    fp8_modes:
+        Whether pure-dp candidates additionally enumerate the lowp fp8
+        compute tier (``Layout.fp8`` / amp O6). Default ``(False,)``
+        keeps the search space identical to the pre-fp8 build; pass
+        ``(False, True)`` to let O6 candidates compete.
     allow_seq / allow_tp / allow_pp:
         Family gates, all True: every axis the adapters can build
         competes by default. ``allow_pp`` flipped True in PR 19 when
@@ -81,6 +88,7 @@ class Constraints:
     zero_stages: Tuple[int, ...] = (0, 2)
     microbatches: Tuple[int, ...] = (1, 2)
     reduce_dtypes: Tuple[Optional[str], ...] = (None, "bf16")
+    fp8_modes: Tuple[bool, ...] = (False,)
     allow_seq: bool = True
     allow_tp: bool = True
     allow_pp: bool = True
@@ -212,8 +220,9 @@ def enumerate_candidates(n_devices: int, desc: ModelDesc,
                     for rd in constraints.reduce_dtypes:
                         if dp == 1 and (rd or zero):
                             continue
-                        _add(dp=dp, zero=zero, microbatch=mb,
-                             reduce_dtype=rd)
+                        for f8 in constraints.fp8_modes:
+                            _add(dp=dp, zero=zero, microbatch=mb,
+                                 reduce_dtype=rd, fp8=f8)
             continue
         # one extra axis: tp, seq, or pp takes the remainder (no
         # reduce_dtype variants: compression rides the DDP seam the

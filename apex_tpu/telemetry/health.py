@@ -24,6 +24,13 @@ Apex's whole O1-O5 loss-scaling machinery exists to dodge. Three layers:
     kept separate from Inf counts: an Inf overflow is the scaler's
     normal saturation (skip + halve the scale); a NaN is numerics
     corruption no rescale can fix, and the detector treats it as such.
+  * :func:`lowp_stats` — the fp8 tier's timeline (``apex_tpu.lowp``):
+    per-tensor amax and delayed-scaling scale series
+    (``lowp/<tensor>/amax`` / ``.../scale``) plus fp8-saturation
+    provenance: when a tensor's fresh amax overruns its (one-step-stale)
+    delayed scale, the clip saturates WITHOUT tripping the amp overflow
+    check — ``lowp/saturated`` names the first offending tensor the same
+    way ``overflow_source`` names the first offending param group.
   * :class:`DivergenceDetector` / :func:`detect` — a host-side rolling
     detector over the event stream: non-finite loss, loss z-score spike,
     grad-norm explosion vs the rolling median, repeated-overflow streak,
@@ -322,6 +329,65 @@ def attribute_overflow(overflow: Any, grads: Tree, *,
         jax.debug.callback(
             lambda n, i, s: _emit_overflow(name, gtuple, n, i, s),
             nan_c, inf_c, jnp.asarray(step))
+
+
+def _emit_lowp(labels: Tuple[str, ...], am, sc, sat, s,
+               top_k: int) -> None:
+    am = np.asarray(am, np.float64).reshape(-1)
+    sc = np.asarray(sc, np.float64).reshape(-1)
+    sat = np.asarray(sat, np.float64).reshape(-1)
+    step = None if s is None else int(np.asarray(s))
+    col = _ev.get_collector()
+    # saturated tensors rank first (they are the ones being diagnosed),
+    # then by amax; cardinality bounded at top_k series pairs per step
+    order = np.lexsort((-am, -sat))[:top_k]
+    for i in order:
+        col.record(f"lowp/{labels[int(i)]}/amax", float(am[i]), step=step)
+        col.record(f"lowp/{labels[int(i)]}/scale", float(sc[i]), step=step)
+    total = float(sat.sum())
+    if total > 0:
+        bad = np.flatnonzero(sat > 0)
+        per = {labels[int(i)]: float(am[i] * sc[i]) for i in bad[:16]}
+        col.record("lowp/saturated", total, step=step,
+                   meta={"tensor": labels[int(bad[0])],
+                         "scaled_amax": per})
+
+
+def lowp_stats(amaxes, scales, *, labels: Sequence[str],
+               max_val: float = 448.0, step: Any = None,
+               top_k: int = 16) -> None:
+    """Record the fp8 tier's per-tensor amax/scale timeline plus
+    saturation provenance — trace-safe, no-op when health is disabled.
+
+    ``amaxes``/``scales`` are the stacked f32[T] a ``lowp.fp8_autocast``
+    context collected this step (``ctx.new_state`` calls this for you);
+    ``labels`` names the T tensor slots. A tensor saturates when its
+    fresh amax times its one-step-stale delayed scale overruns
+    ``max_val`` (e4m3's 448 by default) — the clip keeps it finite, so
+    this series is the ONLY place the event is visible; ``lowp/
+    saturated`` carries the first offending tensor in meta like
+    ``attribute_overflow``'s ``overflow_source``.
+    """
+    if not enabled():
+        return
+    amaxes = jnp.asarray(amaxes, jnp.float32)
+    scales = jnp.asarray(scales, jnp.float32)
+    if amaxes.shape[0] == 0:
+        return
+    if len(labels) != amaxes.shape[0]:
+        raise ValueError(f"{len(labels)} labels for {amaxes.shape[0]} "
+                         f"tensors")
+    sat = (amaxes * scales > max_val).astype(jnp.float32)
+    ltuple = tuple(labels)
+
+    if step is None:
+        jax.debug.callback(
+            lambda a, c, t: _emit_lowp(ltuple, a, c, t, None, top_k),
+            amaxes, scales, sat)
+    else:
+        jax.debug.callback(
+            lambda a, c, t, s: _emit_lowp(ltuple, a, c, t, s, top_k),
+            amaxes, scales, sat, jnp.asarray(step))
 
 
 # ---------------------------------------------------------------------------

@@ -161,6 +161,28 @@ def mt_apply_backend(*, n: int, dtype: Any) -> str:
         else heuristics.MT_APPLY_BACKEND
 
 
+def fp8_matmul_blocks(*, m: int, k: int, n: int,
+                      dtype: Any = "bfloat16") -> Tuple[int, int, int]:
+    """(block_m, block_n, block_k) for the lowp fp8 Pallas matmul at
+    this (bucketed) shape. Blocks must be positive 128-multiples within
+    [128, 4096] — anything else in the cache degrades to the heuristic
+    (the kernel additionally clamps each block to the actual dim)."""
+    cfg, _ = resolve("fp8_matmul", {"m": shape_bucket(m),
+                                    "k": shape_bucket(k),
+                                    "n": shape_bucket(n),
+                                    "dtype": _dtype_name(dtype)})
+    heur = heuristics.fp8_matmul({})
+
+    def _blk(name: str) -> int:
+        try:
+            v = int(cfg[name])
+        except (KeyError, TypeError, ValueError):
+            return heur[name]
+        return v if (128 <= v <= 4096 and v % 128 == 0) else heur[name]
+
+    return _blk("block_m"), _blk("block_n"), _blk("block_k")
+
+
 def ddp_message_size(*, total: int, world: int) -> int:
     """Bucket capacity (elements) for the DDP gradient allreduce."""
     cfg, _ = resolve("ddp_message_size",

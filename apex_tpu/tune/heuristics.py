@@ -48,6 +48,15 @@ MT_APPLY_BACKEND = "jnp"
 # divisor of the actual vocab).
 XENT_BLOCK_K = 2048
 
+# fp8 matmul (lowp.fp8_matmul, pallas backend) grid block sizes. 128 is
+# the conservative always-valid floor (fp8 operand tiles are (32, 128)
+# minimum and the kernel requires 128-aligned shapes); the sweep finds
+# the per-generation winner — bigger blocks amortize grid overhead until
+# the three VMEM tiles stop fitting.
+FP8_MM_BLOCK_M = 128
+FP8_MM_BLOCK_N = 128
+FP8_MM_BLOCK_K = 128
+
 # Collective bucket granularity (elements per bucket).
 DDP_MESSAGE_SIZE = 2 ** 23
 ZERO_CHUNK_ELEMENTS = 2 ** 23
@@ -158,6 +167,11 @@ def xentropy_bwd(key: Dict) -> Dict:
     bk = min(int(key["k"]), XENT_BLOCK_K)
     return {"rows": _px._rows_per_block(bk, arrays=2),
             "block_k": XENT_BLOCK_K}
+
+
+def fp8_matmul(key: Dict) -> Dict:
+    return {"block_m": FP8_MM_BLOCK_M, "block_n": FP8_MM_BLOCK_N,
+            "block_k": FP8_MM_BLOCK_K}
 
 
 def ddp_message_size(key: Dict) -> Dict:

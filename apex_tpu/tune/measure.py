@@ -42,6 +42,23 @@ def measurable() -> bool:
         return False
 
 
+def supports_fp8() -> bool:
+    """True when the backend can run fp8 candidates (the lowp Pallas
+    matmul, fp8-operand sweeps). Requires a real TPU backend
+    (:func:`measurable`) AND float8 dtype support in the runtime — a
+    candidate gated on this DECLINES off-TPU (runner returns None, the
+    sweep reports heuristic provenance) instead of crashing or timing
+    the interpreter (satellite contract; see lowp/matmul.py)."""
+    if not measurable():
+        return False
+    try:
+        import jax.numpy as jnp
+        jnp.dtype(jnp.float8_e4m3fn)
+        return True
+    except Exception:
+        return False
+
+
 def time_fn(fn: Callable[[], Any], *, warmup: int = DEFAULT_WARMUP,
             repeats: int = DEFAULT_REPEATS) -> float:
     """Median wall seconds of ``fn()`` fully blocked to completion.

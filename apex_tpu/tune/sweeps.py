@@ -378,6 +378,52 @@ def _mt_apply_runner(key: Key, cfg: Config) -> Optional[Callable]:
 
 
 # ---------------------------------------------------------------------------
+# fp8 matmul (lowp.fp8_matmul pallas backend) block sizes
+# ---------------------------------------------------------------------------
+
+_FP8_MM_BLOCKS = (128, 256, 512)
+
+
+def _fp8_mm_candidates(key: Key) -> List[Config]:
+    cands = [{"block_m": bm, "block_n": bn, "block_k": bk}
+             for bm in _FP8_MM_BLOCKS for bn in _FP8_MM_BLOCKS
+             for bk in (128, 256)]
+    return _with_heuristic_first(_h.fp8_matmul(key), cands)
+
+
+def _fp8_mm_runner(key: Key, cfg: Config) -> Optional[Callable]:
+    """AOT-compiles the Pallas fp8 matmul under the candidate blocks.
+    Gated on :func:`tune.measure.supports_fp8`: off-TPU (or on a runtime
+    without float8) the candidate DECLINES — None, heuristic provenance
+    — rather than crash or time the interpreter (satellite contract)."""
+    import jax
+    from apex_tpu.tune import measure as _measure
+    if not _measure.supports_fp8():
+        return None
+    from apex_tpu.lowp import matmul as _mm
+    m, k, n = int(key["m"]), int(key["k"]), int(key["n"])
+    if not _mm.supported(m, k, n):
+        return None
+    dtype = _np_dtype(key["dtype"])
+    kx, kw = jax.random.split(jax.random.PRNGKey(0))
+    x = jax.random.normal(kx, (m, k)).astype(dtype)
+    w = jax.random.normal(kw, (k, n)).astype(dtype)
+    bm = int(cfg["block_m"])
+    bn = int(cfg["block_n"])
+    bk = int(cfg["block_k"])
+    # backend override is trace-time state: trace + compile HERE (like
+    # _mt_apply_runner), never inside the timing loop
+    prev = _mm.set_backend("pallas")
+    try:
+        compiled = jax.jit(lambda x, w: _mm.fp8_matmul(
+            x, w, block_m=bm, block_n=bn, block_k=bk)
+        ).lower(x, w).compile()
+    finally:
+        _mm.set_backend(prev)
+    return lambda: compiled(x, w)
+
+
+# ---------------------------------------------------------------------------
 # collective bucketing (DDP message_size / ZeRO chunk_elements)
 # ---------------------------------------------------------------------------
 
@@ -546,6 +592,15 @@ def _registry() -> Dict[str, OpSpec]:
             runner=_mt_runner,
             sweep_keys=lambda: [{"n": 2 ** 24, "dtype": "float32"}],
             doc="multi-tensor bucket kernel rows per grid block"),
+        OpSpec(
+            name="fp8_matmul", primary="block_m",
+            heuristic=_h.fp8_matmul,
+            candidates=_fp8_mm_candidates,
+            runner=_fp8_mm_runner,
+            sweep_keys=lambda: [
+                {"m": 1024, "k": 1024, "n": 1024, "dtype": "bfloat16"}],
+            doc="fp8 Pallas matmul grid blocks (block_m, block_n, "
+                "block_k); declines off-TPU (supports_fp8)"),
         OpSpec(
             name="ddp_message_size", primary="message_size",
             heuristic=_h.ddp_message_size,

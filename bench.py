@@ -29,6 +29,13 @@ rounds. BENCH_TRACE=1 turns on host span tracing (apex_tpu.trace) and
 fills "wall_gap" with the top host span families behind the
 device-vs-wall gap.
 
+BENCH_FP8=1 adds a low-precision side-measurement: lowp.fp8_matmul
+(e4m3 inputs, fp32 accumulation; backend from APEX_TPU_FP8_BACKEND)
+timed against the bf16 matmul on the same shape, with the numerics gap
+vs fp32, landing in the JSON's "lowp" key (null when off — rows stay
+schema-comparable). BENCH_REDUCE_DTYPE accepts int8 for the quartered
+gradient wire (docs/lowp.md).
+
 BENCH_PP=<stages> adds a pipeline-parallel side-measurement: the GPT
 adapter's dp1 x pp<stages> timetable-pipeline step (1F1B default,
 APEX_TPU_PP_SCHEDULE=gpipe flips; BENCH_PP_MB sizes microbatches) timed
@@ -125,7 +132,8 @@ def main():
     # back to the post-hoc schedule: default ON stages each gradient
     # bucket's allreduce into the backward so it overlaps the remaining
     # backward compute (the MFU-plateau fix, ROADMAP item 1).
-    # BENCH_REDUCE_DTYPE=bf16|fp16 additionally compresses the wire;
+    # BENCH_REDUCE_DTYPE=bf16|fp16|int8 additionally compresses the
+    # wire (int8 = the PR 20 quartered tier, docs/lowp.md);
     # BENCH_ADASUM=1 switches to adaptive summation.
     overlap_on = os.environ.get("BENCH_OVERLAP", "1").lower() not in (
         "0", "false", "no", "off")
@@ -451,6 +459,10 @@ def main():
         # and record the analytic bubble share it paid); null when off —
         # rows stay schema-comparable
         "pipeline": None,
+        # low-precision side-measurement (BENCH_FP8=1: fp8_matmul vs the
+        # bf16 matmul on one shape + the numerics gap vs fp32,
+        # docs/lowp.md); null when off — rows stay schema-comparable
+        "lowp": None,
     }
     if trace_on:
         # the wall-vs-device gap, itemized: top host span families by
@@ -658,6 +670,52 @@ def main():
             f"{pp_step_s * 1e3:.1f} ms/step "
             f"(analytic bubble {result['pipeline']['bubble_pct']}%)")
 
+    # BENCH_FP8=1: the fp8 compute tier next to this row (docs/lowp.md)
+    # — lowp.fp8_matmul (quantize both operands to e4m3, fp32
+    # accumulation, backend from APEX_TPU_FP8_BACKEND) timed against the
+    # bf16 matmul on one MXU-shaped product, plus the numerics gap vs
+    # the fp32 product. On CPU the jnp reference path runs (hermetic but
+    # not a perf claim); the device row is what item 1's TPU session
+    # fills in.
+    if os.environ.get("BENCH_FP8"):
+        from apex_tpu import lowp
+        mm = 2048 if on_tpu else 512
+        kx8, kw8 = jax.random.split(jax.random.PRNGKey(7))
+        x8 = jax.random.normal(kx8, (mm, mm), jnp.float32)
+        w8 = jax.random.normal(kw8, (mm, mm), jnp.float32)
+        f8_fn = jax.jit(lowp.fp8_matmul)
+        bf_fn = jax.jit(lambda a, b: jnp.dot(
+            a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32))
+
+        def _mm_time(fn):
+            out = fn(x8, w8)
+            jax.block_until_ready(out)      # compile outside the clock
+            reps = 20 if on_tpu else 3
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = fn(x8, w8)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / reps, out
+
+        fp8_s, out_f8 = _mm_time(f8_fn)
+        bf16_s, _ = _mm_time(bf_fn)
+        ref_mm = jnp.dot(x8, w8, preferred_element_type=jnp.float32)
+        rel_err = float(jnp.max(jnp.abs(out_f8 - ref_mm))
+                        / jnp.max(jnp.abs(ref_mm)))
+        result["lowp"] = {
+            "backend": lowp.backend(),
+            "shape": [mm, mm, mm],
+            "fp8_step_s": round(fp8_s, 6),
+            "bf16_step_s": round(bf16_s, 6),
+            "speedup_vs_bf16": (round(bf16_s / fp8_s, 3)
+                                if fp8_s > 0 else None),
+            "max_rel_err_vs_fp32": round(rel_err, 5),
+        }
+        log(f"lowp: fp8_matmul[{lowp.backend()}] {mm}^3 "
+            f"{fp8_s * 1e3:.2f} ms vs bf16 {bf16_s * 1e3:.2f} ms "
+            f"(rel err vs fp32 {rel_err:.4f})")
+
     # BENCH_PLAN=1: the cost-model honesty check — price the EXECUTED
     # program (flops/bytes from the same XLA cost analysis MFU uses,
     # wire bytes from the telemetry.comm jaxpr walker over the same
@@ -674,8 +732,8 @@ def main():
         n_dev = mesh.size
         bench_layout = _plan.Layout(
             dp=n_dev, overlap=overlap_on,
-            reduce_dtype={"bf16": "bf16", "fp16": "fp16"}.get(
-                reduce_dtype or ""))
+            reduce_dtype={"bf16": "bf16", "fp16": "fp16",
+                          "int8": "int8"}.get(reduce_dtype or ""))
         p_bench, bs_bench, _ = state
         cost_an = _prof.analyze(step_fn, state, (x, y))  # jit-cache hit
         desc_bench = ModelDesc(

@@ -12,7 +12,7 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "== 1/21 package import =="
+echo "== 1/22 package import =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import apex_tpu
@@ -20,7 +20,7 @@ from apex_tpu import amp, optimizers, parallel, ops
 print('apex_tpu imports OK')
 "
 
-echo "== 2/21 native host runtime builds (g++ -O3 -shared) =="
+echo "== 2/22 native host runtime builds (g++ -O3 -shared) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 from apex_tpu import runtime
@@ -35,7 +35,7 @@ print('flatten/unflatten path OK')
 assert ok, 'host runtime failed to build — check g++ toolchain'
 "
 
-echo "== 3/21 graft entry compiles (single-device + 8-device dryrun) =="
+echo "== 3/22 graft entry compiles (single-device + 8-device dryrun) =="
 python -c "
 import jax; jax.config.update('jax_platforms', 'cpu')
 import __graft_entry__ as ge
@@ -45,7 +45,7 @@ print('entry() compiles')
 ge.dryrun_multichip(8)
 "
 
-echo "== 4/21 package install (wheel build + clean --target install) =="
+echo "== 4/22 package install (wheel build + clean --target install) =="
 # The reference gates on Docker extension builds
 # (tests/docker_extension_builds/run.sh); the TPU analog: build the wheel
 # from pyproject.toml, install it into an empty --target dir, and import
@@ -88,7 +88,7 @@ jax.jit(step).lower(params, state).compile()
 print('installed-package train step compiles')
 ")
 
-echo "== 5/21 lint (apex_tpu.lint: trace safety / dtype policy / collectives / SPMD / mem) =="
+echo "== 5/22 lint (apex_tpu.lint: trace safety / dtype policy / collectives / SPMD / mem) =="
 # static gate BEFORE the test tier: AST pass over the package + graft
 # entry, jaxpr pass over the registered entry points, SPMD verifier
 # (APX2xx) and mem verifier (APX3xx) over the same lowerings, with
@@ -99,7 +99,7 @@ echo "== 5/21 lint (apex_tpu.lint: trace safety / dtype policy / collectives / S
 python -m apex_tpu.lint apex_tpu/ __graft_entry__.py --strict --spmd \
     --mem --mem-baseline ci/mem_baseline.json
 
-echo "== 6/21 spmd verifier (builtin-entry sweep + committed deadlock fixture) =="
+echo "== 6/22 spmd verifier (builtin-entry sweep + committed deadlock fixture) =="
 # the whole-program SPMD gate, at the API layer: every registered entry
 # (ddp / zero / overlap / trainer-built / fused kernels / graft) must
 # verify clean, AND the analyzer must still catch the canonical
@@ -144,7 +144,7 @@ print('static donation == runtime DonationReport '
       f'({sd.aliased}/{sd.declared} aliased)')
 "
 
-echo "== 7/21 mem verifier (builtin-entry sweep + APX307 doctored-baseline regression gate) =="
+echo "== 7/22 mem verifier (builtin-entry sweep + APX307 doctored-baseline regression gate) =="
 # the peak-HBM/live-range gate, at the API layer: every registered
 # entry must verify clean against the COMMITTED per-entry baseline
 # (ci/mem_baseline.json — re-baseline deliberately with
@@ -180,7 +180,7 @@ print('APX307 gate OK: doctored +20%% baseline fails naming all '
       '%d entries' % len(named))
 "
 
-echo "== 8/21 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
+echo "== 8/22 telemetry smoke (instrumented train step -> JSONL -> summarize) =="
 # A 3-step instrumented GPT train step on the CPU mesh must produce a
 # parseable JSONL carrying step timing, amp loss-scale/overflow, comm
 # bytes and MFU, and the summarize CLI must render it (exit 0) — the
@@ -253,7 +253,7 @@ fi
 echo "health CLI gate OK (healthy=0, injected-NaN=nonzero)"
 rm -rf "$(dirname "$HLT_FILE")"
 
-echo "== 9/21 tune smoke (sweep dry-run + auto-policy tuned train) =="
+echo "== 9/22 tune smoke (sweep dry-run + auto-policy tuned train) =="
 # The autotuner must be drivable offline (sweep plan renders, exit 0) and
 # inline: a 3-step train whose kernels resolve their configs through
 # apex_tpu.tune under APEX_TPU_TUNE=auto. On this CPU backend measurement
@@ -330,7 +330,7 @@ print(f'tune smoke OK: {len(tuned)} tune/* series, '
 " "$TUNE_DIR/tune_run.jsonl" "$TUNE_DIR/cache"
 rm -rf "$TUNE_DIR"
 
-echo "== 10/21 resilience smoke (snapshot -> injected kill -> auto-resume) =="
+echo "== 10/22 resilience smoke (snapshot -> injected kill -> auto-resume) =="
 # Kill-and-resume end to end: a 6-step train snapshotting every 2 steps is
 # SIGKILLed by the fault injector at the top of step 4 (exit 137 — an
 # abrupt death, no final snapshot), then the SAME command with --resume
@@ -387,7 +387,7 @@ python -m apex_tpu.telemetry summarize "$RES_DIR/resume.jsonl" \
     || { echo "summarize did not report the resume point" >&2; exit 1; }
 rm -rf "$RES_DIR"
 
-echo "== 11/21 overlap smoke (staged backward + bf16 wire vs fp32 baseline) =="
+echo "== 11/22 overlap smoke (staged backward + bf16 wire vs fp32 baseline) =="
 # The overlap engine end to end on the 8-device CPU mesh: a 3-step fp32
 # baseline train and the same train under --overlap --reduce-dtype bf16
 # must (a) land within 1e-2 of each other's final loss (the compression
@@ -443,7 +443,7 @@ python -m apex_tpu.telemetry summarize "$OVL_DIR/bf16.jsonl" \
     || { echo "summarize did not render overlap efficiency" >&2; exit 1; }
 rm -rf "$OVL_DIR"
 
-echo "== 12/21 profile smoke (capture -> attribution report -> compare gate) =="
+echo "== 12/22 profile smoke (capture -> attribution report -> compare gate) =="
 # The attribution profiler end to end on the CPU backend: a 3-step train
 # with --profile must produce a capture logdir whose offline report
 # parses with nonzero compute time and carries the named
@@ -504,7 +504,7 @@ fi
 echo "compare gate OK (identical=0, doctored-slower=4)"
 rm -rf "$PROF_DIR"
 
-echo "== 13/21 trace smoke (host spans -> unified timeline -> merge/stragglers) =="
+echo "== 13/22 trace smoke (host spans -> unified timeline -> merge/stragglers) =="
 # The host-tracing layer end to end: a 3-step --trace train must emit
 # parseable span/* begin/end pairs, the unified host+device timeline
 # must export as valid Chrome-trace JSON with BOTH lanes populated,
@@ -577,7 +577,7 @@ grep -q "worst: p" "$TRC_DIR/merged.txt" \
 echo "trace smoke OK (spans + timeline + reconciliation + 2-process merge)"
 rm -rf "$TRC_DIR"
 
-echo "== 14/21 trainer smoke (compiled-step builder: pipelined dispatch + donation audit) =="
+echo "== 14/22 trainer smoke (compiled-step builder: pipelined dispatch + donation audit) =="
 # The compiled trainer end to end: a 3-step train_lm built through
 # apex_tpu.trainer with telemetry+trace on must (a) emit balanced
 # span/* begin/end pairs (the in-flight window's trainer/retire spans
@@ -622,7 +622,7 @@ grep -q "donation audit: .* 0 refused" "$TRN_DIR/out.txt" \
     || { echo "train_lm did not print the donation audit" >&2; exit 1; }
 rm -rf "$TRN_DIR"
 
-echo "== 15/21 fused-kernel regression (Pallas xentropy vs unfused + epilogue/mt scopes) =="
+echo "== 15/22 fused-kernel regression (Pallas xentropy vs unfused + epilogue/mt scopes) =="
 # The fused-kernel tier end to end (docs/kernels.md): the SAME 3-step GPT
 # train profiled unfused and fused (Pallas xentropy in the loss scope)
 # must (a) surface the apex_xentropy scope in the fused breakdown,
@@ -723,7 +723,7 @@ print('conv epilogue + mt flat: parity + capture scopes OK')
 echo "fused-kernel gate OK (scopes + parity + compare exit 0)"
 rm -rf "$KRN_DIR"
 
-echo "== 16/21 elastic smoke (2-process node_loss -> re-shard resume at world 1) =="
+echo "== 16/22 elastic smoke (2-process node_loss -> re-shard resume at world 1) =="
 # Elastic membership end to end (docs/resilience.md "Elastic
 # membership"): a 2-member ZeRO fleet under the multiproc --elastic
 # supervisor loses rank 1 to an injected node_loss SIGKILL at step 3;
@@ -797,7 +797,7 @@ grep -q "train goodput:" "$ELA_DIR/summary.out" \
     || { echo "elastic: ledger has no train goodput line" >&2; exit 1; }
 rm -rf "$ELA_DIR"
 
-echo "== 17/21 rebalance smoke (slow_node straggler -> weighted re-shard -> exit-75 eviction -> world 1) =="
+echo "== 17/22 rebalance smoke (slow_node straggler -> weighted re-shard -> exit-75 eviction -> world 1) =="
 # Heterogeneity-aware rebalancing end to end (docs/resilience.md
 # "Rebalancing"): rank 1 is an injected straggler (slow_node: +250 ms
 # on every step >= 2 while the base step is ~60 ms). The degradation
@@ -877,7 +877,7 @@ grep -q "straggler detected" "$RB_DIR/summary.out" \
          cat "$RB_DIR/summary.out" >&2; exit 1; }
 rm -rf "$RB_DIR"
 
-echo "== 18/21 plan smoke (auto ranked table -> lint-clean pick -> 3-step train) =="
+echo "== 18/22 plan smoke (auto ranked table -> lint-clean pick -> 3-step train) =="
 # The parallelism planner end to end (docs/plan.md): `plan auto` on the
 # GPT example shape over the 8-device CPU mesh must produce a parseable
 # ranked candidate table, the top pick must pass lint.spmd clean (the
@@ -967,7 +967,7 @@ else:
 PY
 rm -rf "$PLAN_DIR"
 
-echo "== 19/21 pipeline smoke (2-stage 1F1B train -> loss parity + send bytes + lint) =="
+echo "== 19/22 pipeline smoke (2-stage 1F1B train -> loss parity + send bytes + lint) =="
 # Real pipeline parallelism end to end (docs/pipeline.md): build the
 # planner's dp1 x pp2 GPT layout, verify it lint.spmd clean (APX201-209
 # over the exact wrapped program trainer.build compiles), bill the
@@ -1032,7 +1032,7 @@ print(f"pipeline smoke OK: 1f1b losses "
 PY
 rm -rf "$PIPE_DIR"
 
-echo "== 20/21 serve smoke (train snapshot -> paged continuous-batching bench -> shed + SLO gates) =="
+echo "== 20/22 serve smoke (train snapshot -> paged continuous-batching bench -> shed + SLO gates) =="
 # The serving stack end to end (docs/serve.md): train a tiny LM to a
 # final snapshot (the manifest records the model spec for the serve
 # loader), run the serve CLI bench (50 requests over the 8-device CPU
@@ -1106,7 +1106,76 @@ python -m apex_tpu.serve bench --snapshot-dir "$SERVE_DIR/ckpt" \
 echo "serve smoke OK (bench + shed + summarize + slo gate + pipe guard)"
 rm -rf "$SERVE_DIR"
 
-echo "== 21/21 pytest =="
+echo "== 21/22 lowp smoke (fp8 O6 train -> bf16 loss parity + int8 wire vs fp32 A/B) =="
+# The fp8 compute tier end to end (docs/lowp.md): train the same tiny
+# LM three steps at O6 with the int8 gradient wire (delayed-scaling
+# state threaded through the step alongside params/opt), at O5 (the
+# bf16 twin), and at O0 (the fp32 wire baseline), then assert the three
+# contracts the tier ships under: the O6 losses track the bf16 twin's
+# (fp8 QDQ is a numerics tweak, not a different objective), the
+# per-tensor lowp/* delayed-scaling series land in the telemetry, and
+# the int8 wire bill on the gradient reduction is < 0.30x the fp32
+# run's (the tier's whole point — exactly 0.25x plus the scalar
+# scale-agreement pmax). The wire comparison reads the jaxpr comm
+# walker's psum accounting from BOTH runs so the two sides are priced
+# by the same meter, and the ddp-level event must carry the
+# reduce_dtype=int8 tag that marks the compressed path as active.
+LOWP_DIR="$(mktemp -d)"
+for lvl in O6 O5 O0; do
+    extra=""
+    [[ $lvl == O6 ]] && extra="--reduce-dtype int8 --health"
+    python examples/gpt/train_lm.py --steps 3 --vocab 64 --layers 2 \
+        --embed-dim 64 --heads 4 --seq-len 64 --batch 8 \
+        --opt-level "$lvl" $extra \
+        --telemetry "$LOWP_DIR/$lvl.jsonl" > "$LOWP_DIR/$lvl.out"
+done
+python - "$LOWP_DIR" <<'PY'
+import json, re, sys
+d = sys.argv[1]
+
+def final_loss(path):
+    steps = re.findall(r"step\s+\d+\s+loss\s+([0-9.]+)",
+                       open(path).read())
+    assert steps, f"no loss lines in {path}"
+    return float(steps[-1])
+
+def events(path):
+    return [json.loads(ln) for ln in open(path)]
+
+# 1. loss parity: O6 (fp8 QDQ compute) vs the O5 bf16 twin, same seed
+# and data. Not bit-equal — fp8 rounds harder — but the same descent.
+l6, l5 = final_loss(d + "/O6.out"), final_loss(d + "/O5.out")
+assert abs(l6 - l5) < 0.1, (l6, l5)
+
+# 2. the delayed-scaling observability: per-tensor amax AND scale
+# timelines under lowp/, emitted by ctx.new_state() inside the step
+ev6 = events(d + "/O6.jsonl")
+amax = {e["name"] for e in ev6
+        if e["name"].startswith("lowp/") and e["name"].endswith("/amax")}
+scale = {e["name"] for e in ev6
+         if e["name"].startswith("lowp/") and e["name"].endswith("/scale")}
+assert amax and len(amax) == len(scale), (len(amax), len(scale))
+
+# 3. wire bill: the int8 run's psum accounting vs the fp32 run's, same
+# jaxpr-walker meter on both sides. 1-byte payload + the scalar scale
+# pmax vs 4-byte payload -> just over 0.25x; gate at 0.30x.
+def psum_wire(evs):
+    ws = [e["meta"]["bytes_wire"] for e in evs
+          if e["name"] == "comm/data/psum_bytes"]
+    assert ws, "no comm/data/psum_bytes event"
+    return max(ws)
+w6, w0 = psum_wire(ev6), psum_wire(events(d + "/O0.jsonl"))
+ratio = w6 / w0
+assert ratio < 0.30, (w6, w0, ratio)
+ddp = [e for e in ev6 if e["name"] == "ddp/data/allreduce_bytes"]
+assert ddp and ddp[0]["meta"].get("reduce_dtype") == "int8", ddp
+print(f"lowp smoke OK: O6 loss {l6:.4f} vs bf16 {l5:.4f}, "
+      f"{len(amax)} fp8 tensor series, "
+      f"int8 wire {w6} vs fp32 {w0} = {ratio:.3f}x")
+PY
+rm -rf "$LOWP_DIR"
+
+echo "== 22/22 pytest =="
 if [[ "${1:-}" == "--full" ]]; then
     # full suite + the complete L1 cross-product matrix (reference
     # tests/L1/cross_product{,_distributed}/run.sh); the convergence
@@ -1116,7 +1185,7 @@ if [[ "${1:-}" == "--full" ]]; then
     APEX_TPU_L1_FULL=1 python -m pytest tests/ -q -x
 else
     # fast subset: kernels, optimizers, amp, param groups, checkpoints,
-    # and the trainer parity/pipelining block
+    # the trainer parity/pipelining block, and the fp8/int8 lowp tier
     python -m pytest tests/test_multi_tensor.py tests/test_optimizers.py \
         tests/test_amp.py tests/test_param_groups.py tests/test_zero.py \
         tests/test_checkpoint.py tests/test_runtime.py tests/test_tune.py \
@@ -1130,7 +1199,8 @@ else
         tests/test_serve_kvcache.py tests/test_serve_decode.py \
         tests/test_serve_engine.py tests/test_serve_loader.py \
         tests/test_serve_cli.py tests/test_serve_obs.py \
-        tests/test_ledger.py tests/test_plan_objective.py -q -x
+        tests/test_ledger.py tests/test_plan_objective.py \
+        tests/test_lowp.py -q -x
 fi
 
 echo "CI GATE PASSED"

@@ -40,7 +40,12 @@ def parse_args(argv=None):
     p.add_argument("--seq-len", type=int, default=2048,
                    help="GLOBAL sequence length")
     p.add_argument("--opt-level", default="O5",
-                   choices=["O0", "O1", "O2", "O3", "O4", "O5"])
+                   choices=["O0", "O1", "O2", "O3", "O4", "O5",
+                            "O6", "O7"],
+                   help="O6/O7 = the fp8 compute levels (e4m3 fwd / "
+                        "e5m2 bwd QDQ over a bf16 model; O7 adds fp32 "
+                        "masters) — the delayed-scaling state threads "
+                        "through the train step, docs/lowp.md")
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup-steps", type=int, default=3)
@@ -56,11 +61,14 @@ def parse_args(argv=None):
                         "backward compute (docs/overlap.md); bucket "
                         "granularity resolves via apex_tpu.tune")
     p.add_argument("--reduce-dtype", default=None,
-                   choices=[None, "bf16", "fp16"],
-                   help="16-bit wire format for the gradient "
-                        "collectives (fp32 accumulation via "
-                        "pre-scaling; loss-scale-safe — see "
-                        "docs/overlap.md numerics contract)")
+                   choices=[None, "bf16", "fp16", "int8"],
+                   help="compressed wire format for the gradient "
+                        "collectives: bf16/fp16 halve the bytes (fp32 "
+                        "accumulation via pre-scaling; loss-scale-safe "
+                        "— docs/overlap.md numerics contract), int8 "
+                        "quarters them (per-bucket symmetric "
+                        "quantization, exact integer psum — "
+                        "docs/lowp.md)")
     p.add_argument("--adasum", action="store_true",
                    help="adaptive summation (arXiv:2006.02924) instead "
                         "of the mean for data-parallel gradients — "
@@ -331,7 +339,21 @@ def main(argv=None):
     print(f"devices: {n_dev} ({jax.devices()[0].platform}), "
           f"axis={axis}, global seq {args.seq_len}")
 
-    compute_dtype = amp.resolve(args.opt_level).cast_model_type
+    props = amp.resolve(args.opt_level)
+    compute_dtype = props.cast_model_type
+    fp8 = props.fp8
+    if fp8 and args.seq_parallel:
+        raise SystemExit(
+            "--opt-level O6/O7 (fp8) is data-parallel only in this "
+            "example: the delayed-scaling state syncs per-tensor "
+            "amaxes over the data axis (pmax); a sequence-sharded "
+            "forward would need the same sync routed through the "
+            "ring/all-to-all collectives")
+    if fp8 and args.scan > 1:
+        raise SystemExit(
+            "--opt-level O6/O7 needs the fp8 state in the step carry; "
+            "the --scan dispatch does not thread it — run without "
+            "--scan")
     if args.relative_bias and args.seq_parallel == "ulysses":
         raise SystemExit(
             "--relative-bias needs --seq-parallel ring (or dense): "
@@ -381,7 +403,33 @@ def main(argv=None):
         args.opt_level, keep_batchnorm_fp32=False))
     opt_state = aopt.init(params)
 
-    def per_device(params, opt_state, tokens, rng, loss_mult):
+    def lm_loss(p, tokens, rng, off=0, loss_axis=None):
+        """Forward + LM objective — ONE definition: the step's
+        ``lowp.fp8_autocast`` scope and ``lowp.warmup_state`` both trace
+        exactly this op sequence, so the delayed-scaling slot count
+        cannot drift between warmup and the train step."""
+        mutable = ["intermediates"] if args.moe else []
+        if args.loss_chunk:
+            hidden, inter = model.apply(
+                {"params": p}, tokens, pos_offset=off,
+                deterministic=args.dropout == 0.0, dropout_rng=rng,
+                return_hidden=True, mutable=mutable)
+            loss = chunked_next_token_loss(
+                hidden, p["head"], tokens, chunk=args.loss_chunk,
+                axis_name=loss_axis)
+        else:
+            logits, inter = model.apply(
+                {"params": p}, tokens, pos_offset=off,
+                deterministic=args.dropout == 0.0, dropout_rng=rng,
+                mutable=mutable)
+            loss = next_token_loss(logits, tokens, loss_axis)
+        if args.moe:
+            from apex_tpu.parallel import moe_aux_total
+            loss = loss + moe_aux_total(inter["intermediates"])
+        return loss
+
+    def per_device(params, opt_state, tokens, rng, loss_mult,
+                   fp8_state=None):
         if args.seq_parallel:
             off = jax.lax.axis_index(axis) * tokens.shape[1]
         else:
@@ -394,40 +442,38 @@ def main(argv=None):
         # computed only when an observer will consume it so the
         # unobserved trace stays identical
         from apex_tpu import telemetry as _telemetry
+        from apex_tpu.telemetry import health as _health
         ddp_step_idx = None
         if ddp is not None and _telemetry.enabled():
             ddp_step_idx = aopt.execution_index(opt_state)
+        fp8_step_idx = None
+        if fp8_state is not None and _health.enabled():
+            fp8_step_idx = aopt.execution_index(opt_state)
 
         def scaled(p):
             if ddp is not None:
                 # overlap staging (identity when overlap is off):
                 # cotangents return bucket-reduced from the backward
                 p = ddp.prepare(p, telemetry_step=ddp_step_idx)
-            mutable = ["intermediates"] if args.moe else []
-            if args.loss_chunk:
-                hidden, inter = model.apply(
-                    {"params": p}, tokens, pos_offset=off,
-                    deterministic=args.dropout == 0.0, dropout_rng=rng,
-                    return_hidden=True, mutable=mutable)
-                loss = chunked_next_token_loss(
-                    hidden, p["head"], tokens, chunk=args.loss_chunk,
-                    axis_name=loss_axis)
+            if fp8_state is not None:
+                from apex_tpu import lowp
+                with lowp.fp8_autocast(
+                        fp8_state, telemetry_step=fp8_step_idx) as ctx:
+                    loss = lm_loss(p, tokens, rng, off, loss_axis)
+                # axis_name: each data shard saw only its batch's
+                # activations — pmax the amaxes so every replica derives
+                # the identical next-step state (and scales)
+                new_fp8 = ctx.new_state(axis_name=axis)
             else:
-                logits, inter = model.apply(
-                    {"params": p}, tokens, pos_offset=off,
-                    deterministic=args.dropout == 0.0, dropout_rng=rng,
-                    mutable=mutable)
-                loss = next_token_loss(logits, tokens, loss_axis)
-            if args.moe:
-                from apex_tpu.parallel import moe_aux_total
-                loss = loss + moe_aux_total(inter["intermediates"])
+                loss = lm_loss(p, tokens, rng, off, loss_axis)
+                new_fp8 = None
             # resilience fault injection (nan_grad): 1.0 normally; NaN on
             # the faulted step, so the poison flows through backward like
             # a real numerics blow-up (the dynamic scaler then skips)
             loss = loss * loss_mult
-            return aopt.scale_loss(loss, opt_state), loss
+            return aopt.scale_loss(loss, opt_state), (loss, new_fp8)
 
-        grads, loss = jax.grad(scaled, has_aux=True)(params)
+        grads, (loss, new_fp8) = jax.grad(scaled, has_aux=True)(params)
         # seq-parallel: the loss is globally normalized (psum inside
         # next_token_loss), so each device's grad holds only its shard's
         # contribution — sum, don't average. The overlap-engine path
@@ -444,7 +490,6 @@ def main(argv=None):
         elif not ddp.overlap:
             grads = ddp.sync(grads, telemetry_step=ddp_step_idx)
         new_params, new_opt, _ = aopt.step(grads, params, opt_state)
-        from apex_tpu.telemetry import health as _health
         if _health.enabled():
             # per-layer grad/weight norms, update ratios, NaN/Inf counts
             # — on the SYNCED grads (replicated, no psum needed), with
@@ -457,7 +502,7 @@ def main(argv=None):
                 updates=jax.tree_util.tree_map(
                     lambda a, b: a - b, new_params, params),
                 scale=opt_state.scaler.loss_scale[0], step=step_idx)
-        return new_params, new_opt, jax.lax.pmean(loss, axis)
+        return new_params, new_opt, jax.lax.pmean(loss, axis), new_fp8
 
     rep = P()
     tok_spec = P(None, "seq") if args.seq_parallel else P("data")
@@ -467,11 +512,15 @@ def main(argv=None):
     # construction-time audit), dispatch pipelining, and the plugin seam
     # telemetry/health/amp/tune attach to.
     def tstep(state, batch):
-        params, opt_state = state
+        if fp8:
+            params, opt_state, fp8_st = state
+        else:
+            (params, opt_state), fp8_st = state, None
         tokens, step_rng, mult = batch
-        params, opt_state, loss = per_device(params, opt_state, tokens,
-                                             step_rng, mult)
-        return (params, opt_state), loss
+        params, opt_state, loss, fp8_st = per_device(
+            params, opt_state, tokens, step_rng, mult, fp8_st)
+        return ((params, opt_state, fp8_st) if fp8
+                else (params, opt_state)), loss
 
     shard = NamedSharding(mesh, tok_spec)
     batch = args.batch_size if args.seq_parallel else \
@@ -501,6 +550,23 @@ def main(argv=None):
                 "without --scan")
         return _run_scan_mode(args, mesh, axis, per_device, params,
                               opt_state, batch, model)
+
+    state0 = (params, opt_state)
+    if fp8:
+        from apex_tpu import lowp
+        # slot discovery: abstract-trace the SAME lm_loss the step's
+        # fp8_autocast scope wraps, at the per-device shard shape
+        # (jax.eval_shape — zero FLOPs, zero memory); the count check
+        # at ctx.new_state() guards against drift from here
+        fp8_state0 = lowp.warmup_state(
+            lm_loss, params,
+            jax.ShapeDtypeStruct((args.batch_size, args.seq_len),
+                                 jnp.int32),
+            jax.random.PRNGKey(args.seed + 3))
+        state0 = (params, opt_state, fp8_state0)
+        print(f"fp8 ({args.opt_level}): "
+              f"{int(fp8_state0['scale'].shape[0])} tensor slots, "
+              f"amax history {int(fp8_state0['amax_history'].shape[1])}")
 
     from apex_tpu import trainer as trainer_mod
 
@@ -546,7 +612,7 @@ def main(argv=None):
         plugins.append(health_plugin)
 
     tr = trainer_mod.build(
-        tstep, (params, opt_state), batch_avals, mesh=mesh,
+        tstep, state0, batch_avals, mesh=mesh,
         state_spec=rep, batch_spec=(tok_spec, rep, rep),
         config=trainer_mod.TrainerConfig(in_flight=in_flight),
         plugins=plugins, name="train_lm")
@@ -611,7 +677,7 @@ def main(argv=None):
             # a resumed run may start beyond the warmup boundary.
             from apex_tpu import pyprof
             timing["flops"] = pyprof.xla_flops(
-                step_fn, (state[0], state[1]), batch_avals)
+                step_fn, tuple(state), batch_avals)
             timing["t0"] = time.perf_counter()
         elif timing["t0"] is not None:
             timing["timed"] += 1
@@ -627,7 +693,7 @@ def main(argv=None):
               f"step {f.step} ({f.path})")
 
     result = resilience.resilient_loop(
-        None, (params, opt_state), data, steps=args.steps,
+        None, state0, data, steps=args.steps,
         trainer=tr,
         manager=manager, snapshot_every=args.snapshot_every,
         resume=args.resume, injector=injector,
@@ -648,7 +714,8 @@ def main(argv=None):
                          "alibi": bool(args.alibi)}},
         on_step=on_step,
         on_resume=on_resume)
-    params, opt_state = result.state
+    cur_state = result.state
+    params, opt_state = cur_state[0], cur_state[1]
     if loader is not None:
         lst = loader.stats()
         print(f"prefetch: {lst['consumed']} batches, "
@@ -725,16 +792,17 @@ def main(argv=None):
         # carry, so these are a few extra real train steps)
         from apex_tpu import pyprof
         prof_batch = make_batch(args.steps)
-        carry = [(params, opt_state)]
+        carry = [cur_state]
 
         def prof_runner():
             carry[0], lo = step_fn(carry[0], prof_batch)
             jax.block_until_ready(lo)
 
-        bd = pyprof.capture(step_fn, (params, opt_state), prof_batch,
+        bd = pyprof.capture(step_fn, cur_state, prof_batch,
                             runner=prof_runner, steps=3, warmup=1,
                             logdir=args.profile)
-        params, opt_state = carry[0]
+        cur_state = carry[0]
+        params, opt_state = cur_state[0], cur_state[1]
         if args.telemetry:
             pyprof.record_breakdown(bd)
         cats = bd["categories"]
@@ -755,7 +823,7 @@ def main(argv=None):
         from apex_tpu import telemetry
         # static comm bill of the step program (per device per step,
         # grouped by mesh axis) joins the run file
-        telemetry.record_comm_stats(step_fn, (params, opt_state),
+        telemetry.record_comm_stats(step_fn, cur_state,
                                     batch_avals, name="comm")
         jax.effects_barrier()   # async debug callbacks land before export
         telemetry.write_jsonl(args.telemetry)
@@ -788,7 +856,7 @@ def _run_scan_mode(args, mesh, axis, per_device, params, opt_state,
         tok_rng = jax.random.fold_in(rng_i, ax_i)
         tokens = jax.random.randint(tok_rng, (local_b, local_s), 0,
                                     args.vocab)
-        p, s, loss = per_device(p, s, tokens, rng_i, jnp.float32(1.0))
+        p, s, loss, _ = per_device(p, s, tokens, rng_i, jnp.float32(1.0))
         return (p, s), loss
 
     def avals(tree):

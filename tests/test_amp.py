@@ -32,7 +32,7 @@ def test_opt_level_overrides():
     p = amp.resolve("O2", loss_scale=128.0, keep_batchnorm_fp32=False)
     assert p.loss_scale == 128.0 and p.keep_batchnorm_fp32 is False
     with pytest.raises(ValueError):
-        amp.resolve("O7")
+        amp.resolve("O8")  # O7 is the last level (the fp8 tier)
     with pytest.raises(ValueError):
         amp.resolve("O1", master_weights=True)  # needs cast_model_type
 

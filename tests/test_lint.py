@@ -616,6 +616,75 @@ def test_jaxpr_apx105_pallas_block_misalignment():
     assert check_entry(call((8, 128)), (x,)) == []
 
 
+def test_ast_apx005_float8_literal_fires():
+    # the fp8 tier's dtypes are policy-owned exactly like bf16/fp16:
+    # a hardcoded float8 literal outside amp/lowp is a drift hazard
+    src = """
+        import jax.numpy as jnp
+
+        def fwd(x):
+            return x.astype(jnp.float8_e4m3fn)
+     """
+    assert ast_ids(src) == ["APX005"]
+    src_e5m2 = """
+        import jax.numpy as jnp
+
+        def bwd(g):
+            return g.astype("float8_e5m2")
+     """
+    assert ast_ids(src_e5m2) == ["APX005"]
+
+
+def test_jaxpr_apx107_unscaled_fp8_dot_fires():
+    x = jnp.ones((16, 32))
+    w = jnp.ones((32, 8))
+
+    def bad(x, w):
+        # raw cast, no scale op reaches the operands: numerically
+        # unanchored fp8 (anything past +-448 silently saturates)
+        x8 = x.astype(jnp.float8_e4m3fn)
+        w8 = w.astype(jnp.float8_e4m3fn)
+        return jax.lax.dot_general(x8, w8, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+
+    ids = {f.rule_id for f in check_entry(bad, (x, w))}
+    assert ids == {"APX107"}
+
+
+def test_jaxpr_apx107_scaled_fp8_dot_is_clean():
+    from apex_tpu.lowp import fp8_matmul
+    x = jnp.ones((16, 32))
+    w = jnp.ones((32, 8))
+
+    # the lowp entry point quantizes AT A SCALE: provenance reaches both
+    # operands and the rule stays silent — forward and backward
+    def good(x, w):
+        return jnp.sum(fp8_matmul(x, w) ** 2)
+
+    assert check_entry(good, (x, w)) == []
+    assert check_entry(jax.grad(good), (x, w)) == []
+
+
+def test_jaxpr_apx107_fake_quant_grad_is_clean():
+    from apex_tpu.lowp import fake_quant
+    x = jnp.ones((16, 16))
+
+    def step(x):
+        return jnp.sum(fake_quant(x, jnp.float32(2.0)) @ x)
+
+    assert check_entry(step, (x,)) == []
+    assert check_entry(jax.grad(step), (x,)) == []
+
+
+def test_jaxpr_apx107_non_fp8_dot_unaffected():
+    x16 = jnp.ones((8, 8), jnp.bfloat16)
+
+    def f(x):
+        return x @ x
+
+    assert check_entry(f, (x16,)) == []
+
+
 # ---------------------------------------------------------------------------
 # suppressions / formats / CLI plumbing
 # ---------------------------------------------------------------------------

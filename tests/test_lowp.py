@@ -1,0 +1,812 @@
+"""apex_tpu.lowp (the fp8 compute tier, amp O6/O7) and the int8 wire
+tier on the 8-device CPU mesh: the delayed-scaling state machine, the
+e4m3/e5m2 QDQ custom_vjp contract, fp8_matmul backend parity (jnp
+reference vs the Pallas kernel in interpret mode) and its off-TPU
+decline, int8 gradient collectives (DDP / adasum / ZeRO reduce-scatter)
+with their exact power-of-two loss-scale invariances, the O0-O5
+jaxpr-identity guarantee, the planner's fp8/int8 pricing pins, the tune
+satellite (fp8 candidates decline off-TPU), and the lowp/* health
+series."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import amp, lowp, parallel
+from apex_tpu.amp import interposition as interp
+from apex_tpu.amp import policy as amp_policy
+from apex_tpu.lowp import interpose as lowp_interpose
+from apex_tpu.lowp import matmul as lowp_mm
+from apex_tpu.lowp import scaling
+from apex_tpu.parallel import overlap
+
+NDEV = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == NDEV, "conftest must set 8 CPU devices"
+    return parallel.make_mesh(axis_names=("data",))
+
+
+@pytest.fixture
+def interposed():
+    """amp interposition installed for the test, restored afterwards."""
+    interp.install()
+    try:
+        yield
+    finally:
+        interp.uninstall()
+
+
+def _params():
+    k = jax.random.split(jax.random.PRNGKey(0), 3)
+    return {"w1": jax.random.normal(k[0], (64, 64)),
+            "w2": jax.random.normal(k[1], (64, 32)),
+            "b": jax.random.normal(k[2], (32,)) * 0.1}
+
+
+def _batch():
+    return jax.random.normal(jax.random.PRNGKey(9), (16, 64))
+
+
+def _loss(p, x):
+    h = jnp.tanh(x @ p["w1"])
+    return jnp.mean((h @ p["w2"] + p["b"]) ** 2)
+
+
+def _mlp():
+    """Fresh closure per call: jax.make_jaxpr caches by function
+    identity, so a context-dependent trace comparison must never reuse
+    the same callable across contexts."""
+    def f(p, x):
+        h = jnp.tanh(jnp.matmul(x, p["w1"]))
+        return jnp.mean(jnp.matmul(h, p["w2"]) ** 2)
+    return f
+
+
+def _mlp_args():
+    k = jax.random.split(jax.random.PRNGKey(3), 3)
+    p = {"w1": jax.random.normal(k[0], (32, 32)),
+         "w2": jax.random.normal(k[1], (32, 16))}
+    return p, jax.random.normal(k[2], (8, 32))
+
+
+# ---------------------------------------------------------------------------
+# delayed-scaling state machine (lowp.scaling)
+# ---------------------------------------------------------------------------
+
+def test_init_state_shapes():
+    st = scaling.init_state(3, history=5)
+    assert st["amax_history"].shape == (3, 5)
+    assert st["scale"].shape == (3,)
+    np.testing.assert_array_equal(st["amax_history"], 0.0)
+    np.testing.assert_array_equal(st["scale"], 1.0)
+
+
+def test_init_state_validates():
+    with pytest.raises(ValueError):
+        scaling.init_state(-1)
+    with pytest.raises(ValueError):
+        scaling.init_state(2, history=0)
+
+
+def test_pow2_scale_properties():
+    amax = jnp.array([0.0, 1.0, 448.0, 1e-4, 3.7])
+    s = np.asarray(scaling.pow2_scale(amax, scaling.E4M3_MAX, margin=0))
+    # dead tensor -> unit scale
+    assert s[0] == 1.0
+    # every scale is a power of two
+    assert np.all(np.exp2(np.round(np.log2(s))) == s)
+    # amax * scale lands at or under fp8_max
+    a = np.asarray(amax)[1:]
+    assert np.all(a * s[1:] <= scaling.E4M3_MAX)
+    # margin subtracts binades
+    s1 = np.asarray(scaling.pow2_scale(amax, scaling.E4M3_MAX, margin=1))
+    np.testing.assert_allclose(s1[1:], s[1:] / 2.0)
+
+
+def test_pow2_scale_exponent_clamped():
+    s_tiny = float(scaling.pow2_scale(1e-36, scaling.E4M3_MAX))
+    s_huge = float(scaling.pow2_scale(1e38, scaling.E4M3_MAX))
+    assert s_tiny == 2.0 ** 30
+    assert s_huge == 2.0 ** -30
+    assert np.isfinite(s_tiny) and s_huge > 0.0
+
+
+def test_update_state_rolls_history_and_rescales():
+    st = scaling.init_state(2, history=3)
+    st = scaling.update_state(st, jnp.array([1.0, 448.0]))
+    np.testing.assert_array_equal(st["amax_history"][:, 0], [1.0, 448.0])
+    # scale derives from the history max at the default margin
+    np.testing.assert_array_equal(
+        np.asarray(st["scale"]),
+        np.asarray(scaling.pow2_scale(jnp.array([1.0, 448.0]),
+                                      scaling.E4M3_MAX)))
+    # second push shifts the first into slot 1
+    st2 = scaling.update_state(st, jnp.array([2.0, 4.0]))
+    np.testing.assert_array_equal(st2["amax_history"][:, 0], [2.0, 4.0])
+    np.testing.assert_array_equal(st2["amax_history"][:, 1], [1.0, 448.0])
+    # the history MAX drives the scale: tensor 1's 448 still governs
+    np.testing.assert_array_equal(
+        np.asarray(st2["scale"])[1],
+        np.asarray(scaling.pow2_scale(448.0, scaling.E4M3_MAX)))
+
+
+def test_update_state_bounded_history_forgets():
+    st = scaling.init_state(1, history=2)
+    st = scaling.update_state(st, jnp.array([448.0]))
+    small = scaling.update_state(
+        scaling.update_state(st, jnp.array([1.0])), jnp.array([1.0]))
+    # the 448 spike has aged out of the 2-deep ring
+    np.testing.assert_array_equal(
+        np.asarray(small["scale"]),
+        np.asarray(scaling.pow2_scale(jnp.array([1.0]), scaling.E4M3_MAX)))
+
+
+def test_update_state_count_mismatch_raises():
+    st = scaling.init_state(2)
+    with pytest.raises(ValueError, match="does not match"):
+        scaling.update_state(st, jnp.array([1.0, 2.0, 3.0]))
+
+
+def test_quantize_dequantize_pow2_exact():
+    # values already representable in e4m3 at a pow2 scale round-trip
+    # bit-exactly (pow2 scales multiply mantissas exactly)
+    x = jnp.array([0.5, 1.0, 1.5, -2.0, 0.0])
+    for s in (1.0, 2.0, 0.25):
+        q = scaling.quantize(x, s)
+        np.testing.assert_array_equal(
+            np.asarray(scaling.dequantize(q, s)), np.asarray(x))
+    # a full-mantissa e4m3 value survives at unit scale
+    np.testing.assert_array_equal(
+        np.asarray(scaling.dequantize(
+            scaling.quantize(jnp.array([240.0]), 1.0), 1.0)), [240.0])
+
+
+def test_quantize_saturates_not_inf():
+    q = scaling.quantize(jnp.array([1e6, -1e6]), 1.0, scaling.E5M2)
+    d = np.asarray(scaling.dequantize(q, 1.0))
+    assert np.all(np.isfinite(d))
+    np.testing.assert_array_equal(np.abs(d), scaling.E5M2_MAX)
+
+
+# ---------------------------------------------------------------------------
+# QDQ cast pairs (lowp.qdq)
+# ---------------------------------------------------------------------------
+
+def test_qdq_roundtrip_error_bound():
+    x = jax.random.normal(jax.random.PRNGKey(1), (256,))
+    s = scaling.pow2_scale(jnp.max(jnp.abs(x)), scaling.E4M3_MAX, margin=0)
+    y = np.asarray(lowp.qdq(x, s))
+    # e4m3: 3 mantissa bits -> half-ulp relative error 2^-4
+    err = np.abs(y - np.asarray(x))
+    bound = np.maximum(2.0 ** -3 * np.abs(np.asarray(x)), 2.0 ** -6)
+    assert np.all(err <= bound)
+
+
+def test_fake_quant_forward_matches_qdq():
+    x = jax.random.normal(jax.random.PRNGKey(2), (64,))
+    s = jnp.float32(4.0)
+    np.testing.assert_array_equal(np.asarray(lowp.fake_quant(x, s)),
+                                  np.asarray(lowp.qdq(x, s)))
+
+
+def test_fake_quant_grad_of_sum_is_exact_ones():
+    # the cotangent of sum() is ones — exactly representable in e5m2 at
+    # a pow2 scale, so the straight-through backward is bit-exact
+    x = jax.random.normal(jax.random.PRNGKey(3), (32,))
+    g = jax.grad(lambda x: jnp.sum(lowp.fake_quant(x, jnp.float32(1.0))))(x)
+    np.testing.assert_array_equal(np.asarray(g), 1.0)
+
+
+def test_fake_quant_grad_is_e5m2_of_cotangent():
+    x = jax.random.normal(jax.random.PRNGKey(4), (128,))
+    r = jax.random.normal(jax.random.PRNGKey(5), (128,))
+    g = jax.grad(
+        lambda x: jnp.sum(lowp.fake_quant(x, jnp.float32(1.0)) * r))(x)
+    # backward = e5m2 QDQ of the cotangent r at its own JIT pow2 scale
+    gs = scaling.pow2_scale(jnp.max(jnp.abs(r)), scaling.E5M2_MAX, margin=0)
+    want = lowp.qdq(r, gs, scaling.E5M2)
+    np.testing.assert_array_equal(np.asarray(g), np.asarray(want))
+    # e5m2: 2 mantissa bits -> half-ulp relative error 2^-3
+    err = np.abs(np.asarray(g) - np.asarray(r))
+    assert np.all(err <= np.maximum(0.13 * np.abs(np.asarray(r)), 2e-2))
+
+
+def test_fake_quant_scale_gets_zero_cotangent():
+    x = jax.random.normal(jax.random.PRNGKey(6), (16,))
+    gs = jax.grad(lambda s: jnp.sum(lowp.fake_quant(x, s)))(jnp.float32(2.0))
+    assert float(gs) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# fp8_matmul: reference path, Pallas parity, off-TPU decline
+# ---------------------------------------------------------------------------
+
+def _mm_operands(m=128, k=128, n=128, dtype=jnp.float32):
+    kx, kw = jax.random.split(jax.random.PRNGKey(7))
+    return (jax.random.normal(kx, (m, k)).astype(dtype),
+            jax.random.normal(kw, (k, n)).astype(dtype))
+
+
+def test_fp8_matmul_close_to_fp32():
+    x, w = _mm_operands()
+    got = np.asarray(lowp.fp8_matmul(x, w))
+    want = np.asarray(x @ w)
+    rel = np.linalg.norm(got - want) / np.linalg.norm(want)
+    assert rel < 0.1  # bounded by e4m3 operand quantization
+
+
+def test_fp8_matmul_explicit_scales_match_manual():
+    x, w = _mm_operands(64, 32, 48)
+    sx, sw = jnp.float32(64.0), jnp.float32(32.0)
+    got = lowp.fp8_matmul(x, w, scale_x=sx, scale_w=sw)
+    acc = jax.lax.dot_general(
+        scaling.quantize(x, sx), scaling.quantize(w, sw),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.asarray(acc / (sx * sw)))
+
+
+def test_fp8_matmul_out_dtype():
+    x, w = _mm_operands(dtype=jnp.bfloat16)
+    assert lowp.fp8_matmul(x, w).dtype == jnp.bfloat16
+    assert lowp.fp8_matmul(x, w, out_dtype=jnp.float32).dtype == jnp.float32
+
+
+def test_fp8_matmul_shape_validation():
+    with pytest.raises(ValueError, match="fp8_matmul"):
+        lowp.fp8_matmul(jnp.ones((4, 8)), jnp.ones((4, 8)))
+
+
+def test_supported_requires_128_alignment():
+    assert lowp.supported(128, 256, 512)
+    assert not lowp.supported(100, 128, 128)
+    assert not lowp.supported(128, 130, 128)
+
+
+def test_backend_select():
+    assert lowp_mm.backend() == "jnp"  # auto resolves to the reference
+    with pytest.raises(ValueError):
+        lowp_mm.set_backend("cuda")
+    prev = lowp_mm.set_backend("pallas")
+    try:
+        assert lowp_mm.backend() == "pallas"
+    finally:
+        lowp_mm.set_backend(prev)
+
+
+def test_pallas_backend_declines_off_tpu():
+    """satellite: an fp8 Pallas candidate off-TPU must decline (fall to
+    the jnp reference), not crash or silently interpret."""
+    x, w = _mm_operands()
+    want = lowp.fp8_matmul(x, w)
+    prev = lowp_mm.set_backend("pallas")
+    try:
+        assert not lowp_mm._use_pallas(128, 128, 128)
+        np.testing.assert_array_equal(np.asarray(lowp.fp8_matmul(x, w)),
+                                      np.asarray(want))
+    finally:
+        lowp_mm.set_backend(prev)
+
+
+@pytest.mark.slow
+def test_pallas_interpret_parity():
+    """The Mosaic kernel (via the interpreter — test hook only) must
+    reproduce the jnp reference: bit-for-bit when one grid step covers
+    the whole product (identical dot), and within f32 summation-
+    reordering noise under real blocking (XLA's reduction order differs
+    per dot shape; the fp8 operand quantization is identical)."""
+    x, w = _mm_operands(256, 256, 256)
+    want = lowp.fp8_matmul(x, w)
+    prev = lowp_mm.set_backend("pallas")
+    lowp_mm._ALLOW_INTERPRET = True
+    try:
+        assert lowp_mm._use_pallas(256, 256, 256)
+        whole = lowp.fp8_matmul(x, w, block_m=256, block_n=256,
+                                block_k=256)
+        blocked = lowp.fp8_matmul(x, w, block_m=128, block_n=128,
+                                  block_k=128)
+    finally:
+        lowp_mm._ALLOW_INTERPRET = False
+        lowp_mm.set_backend(prev)
+    np.testing.assert_array_equal(np.asarray(whole), np.asarray(want))
+    np.testing.assert_allclose(np.asarray(blocked), np.asarray(want),
+                               rtol=1e-4, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# fp8_autocast: interposition, warmup, state threading, O0-O5 identity
+# ---------------------------------------------------------------------------
+
+def test_interposition_inert_without_context(interposed):
+    """The tentpole's jaxpr-identity pin: installed wrappers with no fp8
+    context and no autocast dtype trace the ORIGINAL program."""
+    p, x = _mlp_args()
+    interp.uninstall()
+    j_plain = str(jax.make_jaxpr(_mlp())(p, x))
+    interp.install()
+    j_installed = str(jax.make_jaxpr(_mlp())(p, x))
+    assert j_installed == j_plain
+    with lowp.fp8_autocast(track=False):
+        j_fp8 = str(jax.make_jaxpr(_mlp())(p, x))
+    assert j_fp8 != j_plain
+    assert "f8_e4m3" in j_fp8  # QDQ pairs actually spliced in
+
+
+def test_autocast_without_install_is_inert():
+    interp.uninstall()
+    p, x = _mlp_args()
+    j_plain = str(jax.make_jaxpr(_mlp())(p, x))
+    with lowp.fp8_autocast(track=False) as ctx:
+        j_ctx = str(jax.make_jaxpr(_mlp())(p, x))
+        assert ctx.num_tensors == 0
+    assert j_ctx == j_plain
+
+
+def test_opt_levels_o0_to_o5_have_no_fp8():
+    for lvl in ("O0", "O1", "O2", "O3", "O4", "O5"):
+        assert amp_policy.resolve(lvl).fp8 is False
+
+
+def test_opt_level_o6_o7_properties():
+    o6 = amp_policy.resolve("O6")
+    assert o6.fp8 and o6.cast_model_type == jnp.bfloat16
+    assert not o6.master_weights and o6.loss_scale == 1.0
+    o7 = amp_policy.resolve("O7")
+    assert o7.fp8 and o7.master_weights
+    assert o7.cast_model_type == jnp.bfloat16
+
+
+def test_warmup_state_counts_intercepted_tensors(interposed):
+    p, x = _mlp_args()
+    st = lowp.warmup_state(_mlp(), p, x)
+    # two matmuls x two float operands each = 4 tensor slots
+    assert st["scale"].shape == (4,)
+    assert st["amax_history"].shape == (4, scaling.DEFAULT_HISTORY)
+
+
+def test_suspend_deactivates_context():
+    with lowp.fp8_autocast(track=False) as ctx:
+        assert lowp_interpose.current() is ctx
+        with lowp_interpose.suspend():
+            assert lowp_interpose.current() is None
+        assert lowp_interpose.current() is ctx
+    assert lowp_interpose.current() is None
+
+
+def test_disable_casts_suspends_fp8_context():
+    with lowp.fp8_autocast(track=False) as ctx:
+        with interp.disable_casts():
+            assert lowp_interpose.current() is None
+        assert lowp_interpose.current() is ctx
+
+
+def test_state_threading_through_jitted_steps(interposed):
+    f = _mlp()
+    p, x = _mlp_args()
+    st0 = lowp.warmup_state(f, p, x)
+
+    @jax.jit
+    def step(p, st, x):
+        def loss_fn(p):
+            with lowp.fp8_autocast(st, track=False) as ctx:
+                loss = f(p, x)
+            return loss, ctx.new_state()
+        (loss, new_st), g = jax.value_and_grad(loss_fn, has_aux=True)(p)
+        return loss, new_st, g
+
+    l1, st1, g1 = step(p, st0, x)
+    assert np.isfinite(float(l1))
+    # scales moved off the unit init once real amaxes arrived
+    assert not np.all(np.asarray(st1["scale"]) == 1.0)
+    l2, st2, g2 = step(p, st1, x)
+    # same data -> same history max -> scales are a fixed point
+    np.testing.assert_array_equal(np.asarray(st2["scale"]),
+                                  np.asarray(st1["scale"]))
+    # fp8 grads track the fp32 grads within quantization noise
+    g32 = jax.grad(lambda p: f(p, x))(p)
+    for k in g32:
+        rel = (np.linalg.norm(np.asarray(g1[k]) - np.asarray(g32[k]))
+               / np.linalg.norm(np.asarray(g32[k])))
+        assert rel < 0.35, (k, rel)
+
+
+def test_new_state_count_mismatch_raises(interposed):
+    p, x = _mlp_args()
+    st = lowp.warmup_state(_mlp(), p, x)  # 4 slots
+    with lowp.fp8_autocast(st, track=False) as ctx:
+        jnp.matmul(x, p["w1"])  # only 2 slots used
+    with pytest.raises(ValueError, match="warmup"):
+        ctx.new_state()
+
+
+def test_new_state_axis_name_syncs_amaxes(mesh, interposed):
+    """Data-parallel shards each observe only their batch shard's
+    activations: without ``new_state(axis_name=)`` the threaded state
+    diverges across replicas; with it every shard gets the pmax-combined
+    amaxes. Runs inside a value_and_grad aux, which also pins the
+    stop_gradient guard in front of the pmax (pmax has no
+    differentiation rule)."""
+    f = _mlp()
+    p, x = _mlp_args()
+    # give every shard a DIFFERENT input magnitude -> different local
+    # amaxes on the activation slots
+    xs = jnp.concatenate([x * (i + 1) for i in range(NDEV)])
+    st0 = lowp.warmup_state(f, p, x)
+
+    def run(axis_name):
+        def body(p, xs):
+            def loss_fn(p):
+                with lowp.fp8_autocast(st0, track=False) as ctx:
+                    loss = f(p, xs)
+                return loss, ctx.new_state(axis_name=axis_name)
+            (_, st), _ = jax.value_and_grad(loss_fn, has_aux=True)(p)
+            # newest history row = this step's amaxes; scale consumes it
+            return st["amax_history"][0], st["scale"]
+        amax, scale = jax.jit(shard_map(
+            body, mesh=mesh, in_specs=(P(), P("data")),
+            out_specs=P("data"), check_vma=False))(p, xs)
+        return (np.asarray(amax).reshape(NDEV, -1),
+                np.asarray(scale).reshape(NDEV, -1))
+
+    amax_local, _ = run(None)
+    assert not np.all(amax_local == amax_local[0]), \
+        "shards should disagree without the sync"
+    amax_sync, scale_sync = run("data")
+    # every shard holds the same, globally max-combined amaxes -> the
+    # next step's scales are replica-consistent
+    np.testing.assert_array_equal(amax_sync,
+                                  np.broadcast_to(amax_sync[0],
+                                                  amax_sync.shape))
+    np.testing.assert_array_equal(scale_sync,
+                                  np.broadcast_to(scale_sync[0],
+                                                  scale_sync.shape))
+    np.testing.assert_array_equal(amax_sync[0], amax_local.max(axis=0))
+
+
+def test_amp_initialize_o6_trains(interposed):
+    from apex_tpu import optimizers
+    k = jax.random.split(jax.random.PRNGKey(11), 4)
+    p = {"w1": jax.random.normal(k[0], (32, 32)) * 0.3,
+         "w2": jax.random.normal(k[1], (32, 8)) * 0.3}
+    x = jax.random.normal(k[2], (16, 32))
+    y = jax.random.normal(k[3], (16, 8))
+
+    def apply_fn(q, x):
+        return jnp.matmul(jnp.tanh(jnp.matmul(x, q["w1"])), q["w2"])
+
+    model, aopt = amp.initialize(apply_fn, optimizers.FusedSGD(lr=0.1),
+                                 opt_level="O6", verbosity=0)
+    st = lowp.warmup_state(lambda q: model(q, x), p)
+    ost = aopt.init(p)
+
+    @jax.jit
+    def step(p, ost, st):
+        def loss_fn(q):
+            with lowp.fp8_autocast(st, track=False) as ctx:
+                pred = model(q, x)
+                loss = jnp.mean((pred.astype(jnp.float32) - y) ** 2)
+            return loss, ctx.new_state()
+        (loss, new_st), g = jax.value_and_grad(loss_fn, has_aux=True)(p)
+        p2, ost2, _ = aopt.step(g, p, ost)
+        return loss, p2, ost2, new_st
+
+    l0, p, ost, st = step(p, ost, st)
+    losses = [float(l0)]
+    for _ in range(3):
+        l, p, ost, st = step(p, ost, st)
+        losses.append(float(l))
+    assert all(np.isfinite(v) for v in losses)
+    assert losses[-1] < losses[0]  # fp8 O6 actually optimizes
+
+
+# ---------------------------------------------------------------------------
+# int8 wire tier (parallel.overlap / DDP / adasum / ZeRO)
+# ---------------------------------------------------------------------------
+
+def test_int8_wire_scale_value_and_bound():
+    a, w = 3.0, 8
+    s = float(overlap.int8_wire_scale(jnp.float32(a), w))
+    assert s == pytest.approx(a * w / (overlap.INT8_MAX - 0.5 * w))
+    # the derivation's fixed point: w replicas each shipping
+    # |q_i| <= amax/s + 1/2 sum to exactly the int8 ceiling
+    assert w * (a / s + 0.5) == pytest.approx(overlap.INT8_MAX)
+    # dead bucket -> unit scale
+    assert float(overlap.int8_wire_scale(jnp.float32(0.0), w)) == 1.0
+
+
+def test_int8_wire_scale_world_too_large_raises():
+    with pytest.raises(ValueError, match="headroom"):
+        overlap.int8_wire_scale(jnp.float32(1.0), 253)
+    # w = 252 is the last world size with >= 1 integer of headroom
+    overlap.int8_wire_scale(jnp.float32(1.0), 252)
+
+
+def test_int8_quantize_roundtrip_bound():
+    y = jax.random.normal(jax.random.PRNGKey(8), (1024,)) * 0.1
+    s = overlap.int8_wire_scale(jnp.max(jnp.abs(y)), 8)
+    d = overlap.int8_dequantize(overlap.int8_quantize(y, s), s)
+    assert np.abs(np.asarray(d) - np.asarray(y)).max() <= float(s) * 0.51
+
+
+def test_resolve_reduce_dtype_int8():
+    assert overlap.resolve_reduce_dtype("int8") == jnp.int8
+
+
+def _grads(mesh, scale=1.0, **kw):
+    def body(p, x):
+        g = jax.grad(lambda p, x: scale * _loss(p, x))(p, x)
+        return parallel.allreduce_gradients(g, "data", **kw)
+    return jax.jit(shard_map(body, mesh=mesh,
+                             in_specs=(P(), P("data")), out_specs=P(),
+                             check_vma=False))(_params(), _batch())
+
+
+def test_allreduce_int8_close_to_fp32(mesh):
+    g32 = _grads(mesh)
+    g8 = _grads(mesh, reduce_dtype="int8")
+    ref = max(np.abs(np.asarray(v)).max() for v in g32.values())
+    for k in g32:
+        err = np.abs(np.asarray(g8[k]) - np.asarray(g32[k])).max()
+        # worst case w*s/2 where s tracks the pre-averaged local amax;
+        # ~15% of the global grad max in practice on this model
+        assert err <= 0.2 * ref + 1e-7, (k, err, ref)
+
+
+def test_allreduce_int8_pow2_loss_scale_exact(mesh):
+    """The composition pin: a 2^16 amp loss scale passes through the
+    int8 wire EXACTLY — the per-bucket scale is linear in the global
+    amax, so the quantized integers are identical and the pow2 factor
+    cancels bit-for-bit on dequant."""
+    g1 = _grads(mesh, reduce_dtype="int8")
+    g2 = _grads(mesh, scale=2.0 ** 16, reduce_dtype="int8")
+    for k in g1:
+        np.testing.assert_array_equal(np.asarray(g2[k]),
+                                      np.asarray(g1[k]) * 2.0 ** 16)
+
+
+def test_staged_backward_matches_posthoc_int8(mesh):
+    def staged(p, x):
+        return jax.grad(lambda p: _loss(
+            overlap.sync_in_backward(p, "data", reduce_dtype="int8"), x))(p)
+    gs = jax.jit(shard_map(staged, mesh=mesh,
+                           in_specs=(P(), P("data")), out_specs=P(),
+                           check_vma=False))(_params(), _batch())
+    gp = _grads(mesh, reduce_dtype="int8")
+    for k in gs:
+        np.testing.assert_allclose(np.asarray(gs[k]), np.asarray(gp[k]),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_adasum_int8_pow2_scale_invariance_exact(mesh):
+    """Adasum's defining property survives the int8 wire: scaling every
+    input by a power of two scales the output by exactly that factor
+    (int8 level scales are linear in the pair amax)."""
+    g1 = _grads(mesh, adasum=True, reduce_dtype="int8")
+    g2 = _grads(mesh, scale=2.0 ** 16, adasum=True, reduce_dtype="int8")
+    for k in g1:
+        np.testing.assert_array_equal(np.asarray(g2[k]),
+                                      np.asarray(g1[k]) * 2.0 ** 16)
+
+
+def test_adasum_int8_close_to_adasum_fp32(mesh):
+    g32 = _grads(mesh, adasum=True)
+    g8 = _grads(mesh, adasum=True, reduce_dtype="int8")
+    for k in g32:
+        rel = (np.linalg.norm(np.asarray(g8[k]) - np.asarray(g32[k]))
+               / max(np.linalg.norm(np.asarray(g32[k])), 1e-12))
+        # pairwise tree of w=2 int8 stages: ~15 int levels per operand
+        assert rel < 0.15, (k, rel)
+
+
+def _zero_scatter(mesh, reduce_dtype=None, scale=1.0):
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+    opt = DistributedFusedAdam(lr=0.1, axis_name="data",
+                               reduce_dtype=reduce_dtype)
+    p = _params()
+    g = jax.tree_util.tree_map(
+        lambda a: a * (0.1 * scale), p)
+    spec = opt._pack(p)
+    f = jax.jit(shard_map(lambda t: opt._scatter_grads(t, spec),
+                          mesh=mesh, in_specs=(P(),),
+                          out_specs=P("data"), check_vma=False))
+    return f(g)
+
+
+def test_zero_scatter_int8_close_to_fp32(mesh):
+    s32 = np.asarray(_zero_scatter(mesh))
+    s8 = np.asarray(_zero_scatter(mesh, reduce_dtype="int8"))
+    err = np.abs(s8 - s32).max()
+    assert err <= 0.15 * np.abs(s32).max() + 1e-7
+
+
+def test_zero_scatter_int8_pow2_scale_exact(mesh):
+    s1 = np.asarray(_zero_scatter(mesh, reduce_dtype="int8"))
+    s2 = np.asarray(_zero_scatter(mesh, reduce_dtype="int8",
+                                  scale=2.0 ** 16))
+    np.testing.assert_array_equal(s2, s1 * 2.0 ** 16)
+
+
+# ---------------------------------------------------------------------------
+# planner: fp8/int8 pricing pins, layout grammar
+# ---------------------------------------------------------------------------
+
+def _desc(flops=1e15, params=int(1e8)):
+    from apex_tpu.plan import ModelDesc
+    return ModelDesc(name="pin", param_count=params,
+                     param_bytes=params * 4, flops_per_step=flops,
+                     bytes_per_step=1e12, act_bytes_per_sample=1e6,
+                     opt_state_bytes=params * 12,
+                     dims={"batch": 64, "seq": 128, "heads": 8,
+                           "embed": 512, "layers": 4, "vocab": 1024,
+                           "mlp_width": 2048})
+
+
+def test_layout_id_roundtrip_int8_fp8():
+    from apex_tpu.plan import Layout, parse_layout_id
+    for kw in (dict(dp=8, reduce_dtype="int8"),
+               dict(dp=8, fp8=True),
+               dict(dp=4, tp=2, reduce_dtype="int8", fp8=True),
+               dict(dp=8, zero=2, reduce_dtype="bf16"),
+               dict(dp=8, reduce_dtype="int8", fp8=True, overlap=False)):
+        lid = Layout(**kw).layout_id()
+        assert parse_layout_id(lid).layout_id() == lid
+    assert Layout(dp=8, reduce_dtype="int8", fp8=True).layout_id() \
+        == "dp8-int8-fp8"
+
+
+def test_layout_fp8_must_be_bool():
+    from apex_tpu.plan import Layout
+    with pytest.raises(ValueError):
+        Layout(dp=8, fp8="yes").validate()
+
+
+def test_int8_wire_bytes_quarter_of_fp32(mesh):
+    from apex_tpu.plan import Layout, analytic_wire
+    desc = _desc()
+
+    def wire_bytes(**kw):
+        return sum(w.bytes_wire * w.count
+                   for w in analytic_wire(desc, Layout(dp=8, **kw)))
+
+    full = wire_bytes()
+    assert wire_bytes(reduce_dtype="bf16") == pytest.approx(0.5 * full)
+    assert wire_bytes(reduce_dtype="int8") == pytest.approx(0.25 * full)
+
+
+def test_planner_fp8_pick_flip():
+    """fp8 pricing must flip a pick on a compute-bound model: the same
+    mesh with the fp8 bit wins the ranking."""
+    from apex_tpu.plan import Layout, estimate
+    desc = _desc(flops=1e16, params=int(1e7))  # compute-dominated
+    peaks = {"flops": 2e14, "bytes_per_s": 1e12, "hbm_bytes": 16e9}
+    base = estimate(desc, Layout(dp=8), peaks=peaks)
+    f8 = estimate(desc, Layout(dp=8, fp8=True), peaks=peaks)
+    assert f8.step_s < base.step_s
+    assert f8.compute_s == pytest.approx(base.compute_s * 0.5)
+    assert any("fp8" in n for n in f8.notes)
+    assert not any("fp8" in n for n in base.notes)
+
+
+def test_planner_int8_wire_pick_flip():
+    """int8 wire must rank below bf16 below fp32 on a comm-bound model."""
+    from apex_tpu.plan import Layout, estimate
+    desc = _desc(flops=1e12, params=int(4e9))  # wire-dominated
+    peaks = {"flops": 2e14, "bytes_per_s": 1e12, "hbm_bytes": 64e9}
+
+    def step_s(rd):
+        return estimate(desc, Layout(dp=8, reduce_dtype=rd),
+                        peaks=peaks).step_s
+
+    assert step_s("int8") < step_s("bf16") < step_s(None)
+
+
+def test_enumerate_fp8_default_inert():
+    from apex_tpu.plan import Constraints, enumerate_candidates
+    desc = _desc()
+    base = enumerate_candidates(8, desc, Constraints())
+    assert all(not l.fp8 for l in base)
+    both = enumerate_candidates(
+        8, desc, Constraints(fp8_modes=(False, True)))
+    assert {l.layout_id() for l in base} <= {l.layout_id() for l in both}
+    assert any(l.fp8 for l in both)
+
+
+def test_adapters_veto_fp8_builds():
+    from apex_tpu.plan import GPTAdapter, Layout
+    veto = GPTAdapter().veto(Layout(dp=8, fp8=True))
+    assert veto is not None and "fp8" in veto
+    assert GPTAdapter().veto(Layout(dp=8)) is None
+
+
+# ---------------------------------------------------------------------------
+# tune: fp8 sweep declines off-TPU (satellite), block resolution
+# ---------------------------------------------------------------------------
+
+def test_supports_fp8_false_off_tpu():
+    from apex_tpu.tune import measure
+    assert jax.default_backend() != "tpu"
+    assert measure.supports_fp8() is False
+
+
+def test_fp8_sweep_runner_declines_off_tpu():
+    from apex_tpu.tune import sweeps
+    spec = sweeps.registry()["fp8_matmul"]
+    key = spec.sweep_keys()[0]
+    cands = spec.candidates(key)
+    assert cands[0] == spec.heuristic(key)  # heuristic leads the sweep
+    assert spec.runner(key, cands[0]) is None  # decline, don't crash
+
+
+def test_fp8_matmul_blocks_defaults_and_alignment():
+    from apex_tpu import tune
+    bm, bn, bk = tune.fp8_matmul_blocks(m=1024, k=1024, n=1024)
+    assert (bm, bn, bk) == (128, 128, 128)
+    for b in (bm, bn, bk):
+        assert 128 <= b <= 4096 and b % 128 == 0
+
+
+# ---------------------------------------------------------------------------
+# telemetry: lowp/* health series
+# ---------------------------------------------------------------------------
+
+def test_lowp_stats_emits_series():
+    from apex_tpu.telemetry import events as tel_events
+    from apex_tpu.telemetry import health
+    prev = health._health_enabled
+    with tel_events.capture() as col:
+        health.enable()
+        try:
+            health.lowp_stats(jnp.array([1.0, 500.0]),
+                              jnp.array([128.0, 1.0]),
+                              labels=("t0:matmul", "t1:matmul"), step=3)
+            names = {e.name for e in col.snapshot()}
+        finally:
+            if not prev:
+                health.disable()
+    assert "lowp/t0:matmul/amax" in names
+    assert "lowp/t0:matmul/scale" in names
+    # tensor 1 saturated (amax * scale > 448) -> provenance event
+    assert "lowp/saturated" in names
+
+
+def test_lowp_stats_label_mismatch_raises():
+    from apex_tpu.telemetry import events as tel_events
+    from apex_tpu.telemetry import health
+    prev = health._health_enabled
+    with tel_events.capture():
+        health.enable()
+        try:
+            with pytest.raises(ValueError, match="labels"):
+                health.lowp_stats(jnp.ones((2,)), jnp.ones((2,)),
+                                  labels=("only-one",))
+        finally:
+            if not prev:
+                health.disable()
+
+
+def test_autocast_emits_lowp_series(interposed):
+    from apex_tpu.telemetry import events as tel_events
+    from apex_tpu.telemetry import health
+    p, x = _mlp_args()
+    prev = health._health_enabled
+    with tel_events.capture() as col:
+        health.enable()
+        try:
+            with lowp.fp8_autocast(telemetry_step=0) as ctx:
+                _mlp()(p, x)
+            ctx.new_state()
+            names = {e.name for e in col.snapshot()}
+        finally:
+            if not prev:
+                health.disable()
+    assert any(n.startswith("lowp/") and n.endswith("/amax")
+               for n in names)
+    assert any(n.startswith("lowp/") and n.endswith("/scale")
+               for n in names)

@@ -117,11 +117,14 @@ def test_wire_compression_loss_scale_safe(mesh):
     np.testing.assert_allclose(got, 4.5e-3, rtol=2e-2)
 
 
-def test_reduce_dtype_rejects_non16bit():
-    with pytest.raises(ValueError, match="16-bit float wire format"):
+def test_reduce_dtype_rejects_non_wire_formats():
+    # fp32 on the wire is not compression; int4 is not implemented.
+    # int8 IS a wire format since the lowp tier (tests/test_lowp.py).
+    with pytest.raises(ValueError, match="wire format"):
         overlap.resolve_reduce_dtype("float32")
-    with pytest.raises(ValueError, match="16-bit float wire format"):
-        overlap.resolve_reduce_dtype("int8")
+    with pytest.raises(ValueError, match="wire format"):
+        overlap.resolve_reduce_dtype("int4")
+    assert overlap.resolve_reduce_dtype("int8") == jnp.int8
 
 
 def test_reduce_dtype_conflicts_with_always_fp32():

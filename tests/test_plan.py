@@ -81,7 +81,7 @@ def test_layout_id_parse_rejects_garbage():
     (dict(zero=2, dp=1), "requires dp >= 2"),
     (dict(zero=2, dp=2, tp=2), "not a supported composition"),
     (dict(dp=2, tp=2, seq=2), "two axes at once"),
-    (dict(reduce_dtype="int8"), "reduce_dtype"),
+    (dict(reduce_dtype="int4"), "reduce_dtype"),
     (dict(zero=3, dp=2), "stages the toolkit implements"),
     (dict(ddp_bucket=0, dp=2), "positive element count"),
 ])
